@@ -1,0 +1,13 @@
+# Dev loop. Tests run on a simulated 8-device CPU mesh (never over the TPU
+# tunnel); bench runs on the real chip (default env).
+TEST_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
+
+test:
+	$(TEST_ENV) python -m pytest tests/ -x -q
+
+bench:
+	python bench.py
+
+dryrun:
+	$(TEST_ENV) XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	  python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"

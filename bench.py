@@ -1,0 +1,79 @@
+"""Round benchmark: run on the real TPU chip, print ONE JSON line.
+
+Current benchmark (round 1): single-chip prefill TTFT + decode throughput on
+a ~1B-param Llama-family decoder (bf16, batch 8). The north-star metric
+(BASELINE.json) is p50 TTFT < 1 s for the RAG generate path; until the full
+RAG stack is wired into this bench, `vs_baseline` is the TTFT target ratio
+target_s / measured_p50_s (>1.0 = beating the 1 s target).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from generativeaiexamples_tpu.models import llama
+
+TTFT_TARGET_S = 1.0
+
+
+def main() -> None:
+    cfg = llama.LlamaConfig(
+        vocab_size=32000, dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+        hidden_dim=5632, head_dim=128, dtype="bfloat16")
+    batch, prompt_len, max_seq, decode_steps = 8, 512, 1024, 64
+
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    cache = llama.KVCache.create(cfg, batch=batch, max_seq=max_seq)
+    tokens = jnp.ones((batch, prompt_len), jnp.int32)
+    start = jnp.zeros((batch,), jnp.int32)
+    lens = jnp.full((batch,), prompt_len, jnp.int32)
+
+    prefill = jax.jit(lambda p, t, c, s, l: llama.prefill(p, cfg, t, c, s, l))
+    decode = jax.jit(lambda p, t, c: llama.decode_step(p, cfg, t, c))
+
+    # warmup / compile
+    logits, cache1 = prefill(params, tokens, cache, start, lens)
+    jax.block_until_ready(logits)
+    tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    logits2, cache2 = decode(params, tok, cache1)
+    jax.block_until_ready(logits2)
+
+    # TTFT: prefill + one decode sample, median of 5
+    ttfts = []
+    for _ in range(5):
+        c = llama.KVCache.create(cfg, batch=batch, max_seq=max_seq)
+        t0 = time.perf_counter()
+        logits, c = prefill(params, tokens, c, start, lens)
+        tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        jax.block_until_ready(tok)
+        ttfts.append(time.perf_counter() - t0)
+    ttfts.sort()
+    ttft_p50 = ttfts[len(ttfts) // 2]
+
+    # decode throughput
+    t0 = time.perf_counter()
+    cache_d = cache1
+    cur = tok
+    for _ in range(decode_steps):
+        logits, cache_d = decode(params, cur, cache_d)
+        cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    jax.block_until_ready(cur)
+    dt = time.perf_counter() - t0
+    tok_s = batch * decode_steps / dt
+
+    print(json.dumps({
+        "metric": "prefill_p50_ttft_s (1B-class llama, b8 s512, 1 chip)",
+        "value": round(ttft_p50, 4),
+        "unit": "s",
+        "vs_baseline": round(TTFT_TARGET_S / ttft_p50, 3),
+        "decode_tok_s": round(tok_s, 1),
+        "device": str(jax.devices()[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

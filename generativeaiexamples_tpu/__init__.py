@@ -1,0 +1,25 @@
+"""generativeaiexamples_tpu — a TPU-native generative-AI application framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capability surface of
+NVIDIA's GenerativeAIExamples (reference: /root/reference): an enterprise RAG
+suite (chain-orchestration server, pluggable RAG pipelines, LoRA/SFT
+fine-tuning, evaluation + observability) — with the external GPU model-serving
+containers (NIM/TRT-LLM, NeMo Retriever, Milvus-GPU) replaced by **in-tree
+TPU engines**: a continuous-batching LLM server, jit-compiled bi-encoder /
+cross-encoder services, and an on-device vector search, all sharded over a
+`jax.sharding.Mesh`.
+
+Layer map (cf. reference docs/architecture.md:23-43):
+
+    playground/   web UI                  (ref: RAG/src/rag_playground)
+    server/       chain server REST+SSE   (ref: RAG/src/chain_server/server.py)
+    chains/       pluggable RAG examples  (ref: RAG/examples/{basic,advanced}_rag)
+    engine/       TPU LLM serving         (replaces NIM, docker-compose-nim-ms.yaml:2-28)
+    encoders/     embed + rerank services (replaces NeMo Retriever NIMs)
+    retrieval/    vector search on TPU    (replaces Milvus GPU)
+    train/        LoRA/SFT trainer        (replaces NeMo/Megatron containers)
+    models/ ops/ parallel/                TPU compute foundation
+    core/ observability/ eval/            config, tracing, evaluation
+"""
+
+__version__ = "0.1.0"

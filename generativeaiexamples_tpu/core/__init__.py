@@ -1,0 +1,15 @@
+"""Core foundation: configuration, logging, metrics, tracing."""
+
+from generativeaiexamples_tpu.core.config import (  # noqa: F401
+    AppConfig,
+    EmbeddingConfig,
+    EngineConfig,
+    LLMConfig,
+    RankingConfig,
+    RetrieverConfig,
+    TextSplitterConfig,
+    VectorStoreConfig,
+    configfield,
+    get_config,
+    load_config,
+)

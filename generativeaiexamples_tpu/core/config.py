@@ -1,0 +1,270 @@
+"""Typed application configuration with YAML/JSON file loading and env overlay.
+
+TPU-native re-design of the reference's ConfigWizard flag system
+(ref: RAG/src/chain_server/configuration_wizard.py:90-283 — dataclass-wizard
+based loader with recursive ``APP_*`` env-var override and auto-generated help;
+schema in RAG/src/chain_server/configuration.py:21-204).
+
+Semantics preserved:
+  * nested frozen dataclasses describe the schema;
+  * config file comes from ``APP_CONFIG_FILE`` (YAML or JSON); missing file
+    means "all defaults" (ref: utils.py:180-186, default ``/dev/null``);
+  * every leaf field can be overridden by ``APP_<SECTION>_<FIELD>`` env vars,
+    computed recursively from the schema
+    (ref: configuration_wizard.py:164-234);
+  * ``print_help`` enumerates every env var with its help text
+    (ref: configuration_wizard.py:95-162).
+
+Implementation is new: plain ``dataclasses`` + a small recursive loader —
+no dataclass-wizard dependency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import typing
+from dataclasses import MISSING, dataclass, field, fields, is_dataclass
+from functools import lru_cache
+from typing import Any, Dict, Mapping, Optional, TextIO
+
+import yaml
+
+logger = logging.getLogger(__name__)
+
+ENV_PREFIX = "APP"
+_HELP_KEY = "__config_help__"
+
+
+def configfield(name: str, *, default: Any = MISSING, default_factory: Any = MISSING,
+                help_txt: str = "") -> Any:
+    """Declare a documented config field (ref: configuration_wizard.py:42-63).
+
+    ``name`` is the canonical file/env key (lowercase, may differ from the
+    attribute name); ``help_txt`` feeds the env-var help printer.
+    """
+    meta = {"name": name, _HELP_KEY: help_txt}
+    if default_factory is not MISSING:
+        return field(default_factory=default_factory, metadata=meta)
+    if default is MISSING:
+        return field(metadata=meta)
+    return field(default=default, metadata=meta)
+
+
+def _field_key(f: dataclasses.Field) -> str:
+    return f.metadata.get("name", f.name)
+
+
+def _coerce(value: Any, ftype: Any) -> Any:
+    """Coerce a string (from env) or YAML scalar into the annotated type."""
+    origin = typing.get_origin(ftype)
+    if origin is typing.Union:  # Optional[...]
+        args = [a for a in typing.get_args(ftype) if a is not type(None)]
+        if value is None:
+            return None
+        return _coerce(value, args[0]) if args else value
+    if ftype is bool:
+        if isinstance(value, bool):
+            return value
+        return str(value).strip().lower() in ("1", "true", "yes", "on")
+    if ftype is int:
+        return int(value)
+    if ftype is float:
+        return float(value)
+    if ftype is str:
+        return str(value)
+    if origin in (list, tuple):
+        if isinstance(value, str):
+            value = json.loads(value)
+        return list(value) if origin is list else tuple(value)
+    if origin is dict:
+        if isinstance(value, str):
+            value = json.loads(value)
+        return dict(value)
+    return value
+
+
+def _from_dict(cls: type, data: Mapping[str, Any], env_path: str) -> Any:
+    """Recursively build ``cls`` from ``data`` with env overlay at each leaf.
+
+    Env var for a leaf is ``APP_<PATH>_<FIELD>`` where path components are the
+    uppercase canonical field keys (ref: configuration_wizard.py:164-234).
+    """
+    kwargs: Dict[str, Any] = {}
+    for f in fields(cls):
+        key = _field_key(f)
+        env_name = f"{env_path}_{key.upper()}" if env_path else key.upper()
+        if is_dataclass(f.type if isinstance(f.type, type) else _resolve_type(cls, f)):
+            sub_cls = f.type if isinstance(f.type, type) else _resolve_type(cls, f)
+            sub_data = data.get(key, {}) if isinstance(data, Mapping) else {}
+            kwargs[f.name] = _from_dict(sub_cls, sub_data or {}, env_name)
+            continue
+        env_val = os.environ.get(env_name)
+        if env_val is not None:
+            kwargs[f.name] = _coerce(env_val, _resolve_type(cls, f))
+        elif isinstance(data, Mapping) and key in data:
+            kwargs[f.name] = _coerce(data[key], _resolve_type(cls, f))
+        elif f.default is not MISSING:
+            kwargs[f.name] = f.default
+        elif f.default_factory is not MISSING:  # type: ignore[misc]
+            kwargs[f.name] = f.default_factory()  # type: ignore[misc]
+        else:
+            raise ValueError(f"missing required config field {env_name}")
+    return cls(**kwargs)
+
+
+@lru_cache(maxsize=None)
+def _type_hints(cls: type) -> Dict[str, Any]:
+    return typing.get_type_hints(cls)
+
+
+def _resolve_type(cls: type, f: dataclasses.Field) -> Any:
+    t = _type_hints(cls).get(f.name, f.type)
+    return t
+
+
+def _iter_env_vars(cls: type, env_path: str):
+    for f in fields(cls):
+        key = _field_key(f)
+        env_name = f"{env_path}_{key.upper()}" if env_path else key.upper()
+        ftype = _resolve_type(cls, f)
+        if is_dataclass(ftype):
+            yield from _iter_env_vars(ftype, env_name)
+        else:
+            default = f.default if f.default is not MISSING else (
+                f.default_factory() if f.default_factory is not MISSING else None)  # type: ignore[misc]
+            yield env_name, ftype, default, f.metadata.get(_HELP_KEY, "")
+
+
+# ---------------------------------------------------------------------------
+# Schema (ref: RAG/src/chain_server/configuration.py)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class VectorStoreConfig:
+    """Vector store settings (ref: configuration.py:21-46)."""
+
+    name: str = configfield("name", default="tpu", help_txt="Vector store backend: tpu|milvus|pgvector.")
+    url: str = configfield("url", default="", help_txt="Remote vector DB URL (unused for the in-proc TPU store).")
+    nlist: int = configfield("nlist", default=64, help_txt="IVF: number of coarse cells (ref GPU_IVF_FLAT nlist, configuration.py:42).")
+    nprobe: int = configfield("nprobe", default=16, help_txt="IVF: cells probed per query (ref configuration.py:44).")
+    index_type: str = configfield("index_type", default="exact", help_txt="Index kind: exact|ivf.")
+
+
+@dataclass(frozen=True)
+class LLMConfig:
+    """LLM engine/client settings (ref: configuration.py:48-84)."""
+
+    model_name: str = configfield("model_name", default="llama3-8b-instruct", help_txt="Served model name.")
+    server_url: str = configfield("server_url", default="", help_txt="Remote OpenAI-compatible server; empty = in-process TPU engine.")
+    model_engine: str = configfield("model_engine", default="tpu", help_txt="Engine kind: tpu|openai-compat.")
+
+
+@dataclass(frozen=True)
+class TextSplitterConfig:
+    """Splitter settings (ref: configuration.py:86-112)."""
+
+    model_name: str = configfield("model_name", default="byte-bpe", help_txt="Tokenizer used to count tokens while chunking.")
+    chunk_size: int = configfield("chunk_size", default=510, help_txt="Chunk size in tokens (ref default 510, configuration.py:90).")
+    chunk_overlap: int = configfield("chunk_overlap", default=200, help_txt="Chunk overlap in tokens (ref default 200).")
+
+
+@dataclass(frozen=True)
+class EmbeddingConfig:
+    """Embedder settings (ref: configuration.py:114-138)."""
+
+    model_name: str = configfield("model_name", default="e5-base-tpu", help_txt="Embedding model name.")
+    dimensions: int = configfield("dimensions", default=512, help_txt="Embedding dimensionality.")
+    model_engine: str = configfield("model_engine", default="tpu", help_txt="tpu|openai-compat.")
+    server_url: str = configfield("server_url", default="", help_txt="Remote embedding server; empty = in-process.")
+
+
+@dataclass(frozen=True)
+class RankingConfig:
+    """Reranker settings (ref: configuration.py ranking section, utils.py:448-471)."""
+
+    model_name: str = configfield("model_name", default="rerank-minilm-tpu", help_txt="Cross-encoder model name.")
+    model_engine: str = configfield("model_engine", default="tpu", help_txt="tpu|openai-compat.")
+    server_url: str = configfield("server_url", default="", help_txt="Remote rerank server; empty = in-process.")
+
+
+@dataclass(frozen=True)
+class RetrieverConfig:
+    """Retrieval knobs (ref: configuration.py:140-165)."""
+
+    top_k: int = configfield("top_k", default=4, help_txt="Documents returned to the prompt (ref default 4).")
+    score_threshold: float = configfield("score_threshold", default=0.25, help_txt="Minimum similarity score (ref default 0.25).")
+    nr_top_k: int = configfield("nr_top_k", default=40, help_txt="Docs fetched before reranking (ref multi_turn 40→4 funnel).")
+    max_context_tokens: int = configfield("max_context_tokens", default=1500, help_txt="Retrieved-context token budget (ref DEFAULT_MAX_CONTEXT, utils.py:103).")
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """In-tree TPU serving engine knobs (no reference equivalent — replaces NIM)."""
+
+    max_batch_size: int = configfield("max_batch_size", default=8, help_txt="Decode-slot capacity of the continuous batcher.")
+    max_seq_len: int = configfield("max_seq_len", default=2048, help_txt="KV-cache length per slot.")
+    page_size: int = configfield("page_size", default=128, help_txt="KV page granularity (tokens).")
+    prefill_chunk: int = configfield("prefill_chunk", default=512, help_txt="Chunked-prefill bucket size.")
+    dtype: str = configfield("dtype", default="bfloat16", help_txt="Activation/weight dtype.")
+    mesh_shape: str = configfield("mesh_shape", default="", help_txt="Device mesh, e.g. '1x8'; empty = all devices on one tensor axis.")
+    checkpoint_dir: str = configfield("checkpoint_dir", default="", help_txt="Orbax checkpoint to serve; empty = random init (test mode).")
+
+
+@dataclass(frozen=True)
+class AppConfig:
+    """Top-level app configuration (ref: configuration.py:166-204)."""
+
+    vector_store: VectorStoreConfig = configfield("vector_store", default_factory=VectorStoreConfig, help_txt="Vector store.")
+    llm: LLMConfig = configfield("llm", default_factory=LLMConfig, help_txt="LLM engine.")
+    text_splitter: TextSplitterConfig = configfield("text_splitter", default_factory=TextSplitterConfig, help_txt="Splitter.")
+    embeddings: EmbeddingConfig = configfield("embeddings", default_factory=EmbeddingConfig, help_txt="Embedder.")
+    ranking: RankingConfig = configfield("ranking", default_factory=RankingConfig, help_txt="Reranker.")
+    retriever: RetrieverConfig = configfield("retriever", default_factory=RetrieverConfig, help_txt="Retriever.")
+    engine: EngineConfig = configfield("engine", default_factory=EngineConfig, help_txt="TPU engine.")
+
+
+# ---------------------------------------------------------------------------
+# Loading
+# ---------------------------------------------------------------------------
+
+def load_config(path: Optional[str] = None, cls: type = AppConfig) -> Any:
+    """Load config from YAML/JSON ``path`` (or ``APP_CONFIG_FILE``) + env overlay.
+
+    A missing/empty path yields all-defaults, matching the reference's
+    ``/dev/null`` default config file (ref: utils.py:180-186).
+    """
+    path = path or os.environ.get(f"{ENV_PREFIX}_CONFIG_FILE", "")
+    data: Dict[str, Any] = {}
+    if path and os.path.exists(path) and os.path.isfile(path):
+        with open(path, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        if text.strip():
+            try:
+                data = json.loads(text)
+            except json.JSONDecodeError:
+                data = yaml.safe_load(text) or {}
+        if not isinstance(data, dict):
+            logger.warning("config file %s did not parse to a mapping; using defaults", path)
+            data = {}
+    return _from_dict(cls, data, ENV_PREFIX)
+
+
+@lru_cache(maxsize=1)
+def get_config() -> AppConfig:
+    """Cached process-wide config (ref: utils.py get_config lru_cache pattern, utils.py:137-186)."""
+    return load_config()
+
+
+def print_help(stream: Optional[TextIO] = None, cls: type = AppConfig) -> None:
+    """Print every supported env var with type, default, and help text
+    (ref: configuration_wizard.py:95-162 auto-generated help)."""
+    import sys
+
+    stream = stream or sys.stdout
+    print(f"{ENV_PREFIX}_CONFIG_FILE  <str>  path to YAML/JSON config file", file=stream)
+    for env_name, ftype, default, help_txt in _iter_env_vars(cls, ENV_PREFIX):
+        tname = getattr(ftype, "__name__", str(ftype))
+        print(f"{env_name}  <{tname}>  default={default!r}  {help_txt}", file=stream)

@@ -1,0 +1,119 @@
+"""Serving metrics registry: req/s, TTFT, tokens/s/chip, batch occupancy.
+
+The reference has no first-class metrics (metrics ride on spans; SURVEY §5.5) —
+these are the north-star measurements in BASELINE.json, so the TPU stack makes
+them first-class: lock-protected counters + streaming histograms with exact
+percentiles over a bounded reservoir, exposed via ``snapshot()`` and the chain
+server's ``/metrics`` endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from bisect import insort
+from typing import Dict, List, Optional
+
+
+class Counter:
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Bounded-reservoir histogram with exact percentiles (keeps newest N)."""
+
+    def __init__(self, name: str, max_samples: int = 4096) -> None:
+        self.name = name
+        self._max = max_samples
+        self._samples: List[float] = []
+        self._ring: List[float] = []
+        self._count = 0
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._sum += value
+            self._ring.append(value)
+            insort(self._samples, value)
+            if len(self._ring) > self._max:
+                old = self._ring.pop(0)
+                idx = self._index(old)
+                if idx is not None:
+                    self._samples.pop(idx)
+
+    def _index(self, value: float) -> Optional[int]:
+        import bisect
+        i = bisect.bisect_left(self._samples, value)
+        if i < len(self._samples) and self._samples[i] == value:
+            return i
+        return None
+
+    def percentile(self, q: float) -> float:
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            idx = min(len(self._samples) - 1, int(q / 100.0 * len(self._samples)))
+            return self._samples[idx]
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._counters: Dict[str, Counter] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+        self._start = time.time()
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            if name not in self._counters:
+                self._counters[name] = Counter(name)
+            return self._counters[name]
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            if name not in self._histograms:
+                self._histograms[name] = Histogram(name)
+            return self._histograms[name]
+
+    def snapshot(self) -> Dict[str, object]:
+        uptime = time.time() - self._start
+        with self._lock:
+            counters = dict(self._counters)
+            histograms = dict(self._histograms)
+        out: Dict[str, object] = {"uptime_s": round(uptime, 3)}
+        for name, c in counters.items():
+            out[name] = c.value
+            out[f"{name}_per_s"] = round(c.value / uptime, 4) if uptime > 0 else 0.0
+        for name, h in histograms.items():
+            out[name] = {
+                "count": h.count,
+                "mean": round(h.mean, 6),
+                "p50": round(h.percentile(50), 6),
+                "p90": round(h.percentile(90), 6),
+                "p99": round(h.percentile(99), 6),
+            }
+        return out
+
+
+REGISTRY = MetricsRegistry()

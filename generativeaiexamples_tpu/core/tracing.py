@@ -1,0 +1,71 @@
+"""Tracing bootstrap + instrumentation decorators for server endpoints.
+
+TPU-stack equivalent of RAG/src/chain_server/tracing.py: the reference sets up
+an OTel provider then wraps endpoint coroutines so each request gets a span
+with the incoming HTTP trace context attached
+(ref: tracing.py:36-59 provider setup; 62-103 wrapper decorators).
+
+Here the wrappers target aiohttp handlers (our chain server) and arbitrary
+chain methods; span context rides the in-tree tracer
+(generativeaiexamples_tpu.observability.otel).
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Any, Callable
+
+from generativeaiexamples_tpu.observability import otel
+
+tracer = otel.get_tracer("generativeaiexamples_tpu")
+
+
+def instrumentation_wrapper(func: Callable) -> Callable:
+    """Wrap an aiohttp handler: extract remote traceparent, open a span named
+    after the handler (ref: tracing.py:103-114 instrumentation_wrapper)."""
+
+    @functools.wraps(func)
+    async def wrapper(request: Any, *args: Any, **kwargs: Any) -> Any:
+        headers = dict(getattr(request, "headers", {}) or {})
+        parent = otel.extract_traceparent(headers)
+        with otel.use_parent(parent):
+            with tracer.span(f"http:{func.__name__}",
+                             attributes={"http.path": str(getattr(request, "path", ""))}):
+                return await func(request, *args, **kwargs)
+
+    return wrapper
+
+
+def chain_instrumentation(func: Callable) -> Callable:
+    """Wrap a chain method (llm_chain / rag_chain / ingest_docs) in a span
+    (ref: langchain_instrumentation_class_wrapper, tracing.py:87-93)."""
+
+    if inspect.isasyncgenfunction(func):
+        @functools.wraps(func)
+        async def agen_wrapper(*args: Any, **kwargs: Any) -> Any:
+            with tracer.span(f"chain:{func.__qualname__}") as span:
+                n = 0
+                async for item in func(*args, **kwargs):
+                    n += 1
+                    yield item
+                span.set_attribute("chunks", n)
+        return agen_wrapper
+
+    if inspect.isgeneratorfunction(func):
+        @functools.wraps(func)
+        def gen_wrapper(*args: Any, **kwargs: Any) -> Any:
+            with tracer.span(f"chain:{func.__qualname__}") as span:
+                n = 0
+                for item in func(*args, **kwargs):
+                    n += 1
+                    yield item
+                span.set_attribute("chunks", n)
+        return gen_wrapper
+
+    @functools.wraps(func)
+    def wrapper(*args: Any, **kwargs: Any) -> Any:
+        with tracer.span(f"chain:{func.__qualname__}"):
+            return func(*args, **kwargs)
+
+    return wrapper

@@ -1,0 +1,11 @@
+"""Model zoo: functional JAX implementations of the model families the
+reference serves through external containers (SURVEY §2.5).
+
+Decoder LMs (replace NIM LLM containers): Llama-3 family (`llama`), Gemma
+(`gemma`) — pure-function forward passes over parameter pytrees, layers
+stacked + `lax.scan`-ed for compile time, logical-axis annotations for mesh
+sharding.
+
+Encoders (replace NeMo Retriever NIMs): e5-class bi-encoder and cross-encoder
+reranker (`bert`), CLIP-style vision tower (`clip`).
+"""

@@ -1,0 +1,19 @@
+"""Observability: in-tree tracing SDK, span exporters, system metrics.
+
+Replaces the reference's OTel-SDK + collector + Jaeger sidecar stack
+(ref: RAG/tools/observability/, RAG/src/chain_server/tracing.py) with a
+self-contained span model: same trace/span semantics and W3C TraceContext
+propagation, exporters pluggable (console, in-memory for tests, JSONL file).
+"""
+
+from generativeaiexamples_tpu.observability.otel import (  # noqa: F401
+    ConsoleSpanExporter,
+    InMemorySpanExporter,
+    JsonlSpanExporter,
+    Span,
+    Tracer,
+    extract_traceparent,
+    get_tracer,
+    inject_traceparent,
+    set_exporter,
+)

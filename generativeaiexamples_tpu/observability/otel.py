@@ -1,0 +1,241 @@
+"""Minimal OpenTelemetry-compatible tracing core.
+
+The reference wires `opentelemetry-sdk` with an OTLP gRPC exporter, a
+SimpleSpanProcessor, and a W3C TraceContext propagator
+(ref: RAG/src/chain_server/tracing.py:36-59), then converts LangChain /
+LlamaIndex lifecycle events into spans via callback handlers
+(ref: RAG/tools/observability/langchain/opentelemetry_callback.py:137-606).
+
+This module provides the same span model in-tree with zero hard deps:
+
+  * ``Tracer.span(name)`` context manager → ``Span`` with trace_id/span_id,
+    parent linkage, attributes, events, status, wall-time;
+  * W3C ``traceparent`` header inject/extract for cross-service propagation
+    (ref: tracing.py:46, chat_client.py:43 carrier propagation);
+  * exporters: console, in-memory (tests), JSONL file (offline analysis —
+    the stand-in for the OTLP→Jaeger pipeline in
+    RAG/tools/observability/configs/otel-collector-config.yaml);
+  * tail-filtering of health-check spans, matching the collector's
+    tail_sampling drop of ``/health`` (otel-collector-config.yaml:10-20).
+
+Tracing is opt-in via ``ENABLE_TRACING=true`` (ref: tracing.py:38,44); when
+disabled every API is a cheap no-op.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import secrets
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Mapping, Optional
+
+_current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "gaie_tpu_current_span", default=None
+)
+
+
+def tracing_enabled() -> bool:
+    return os.environ.get("ENABLE_TRACING", "").strip().lower() in ("1", "true", "yes")
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str] = None
+    start_ns: int = 0
+    end_ns: int = 0
+    attributes: Dict[str, Any] = field(default_factory=dict)
+    events: List[Dict[str, Any]] = field(default_factory=list)
+    status: str = "OK"
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def add_event(self, name: str, attributes: Optional[Mapping[str, Any]] = None) -> None:
+        """Record a point-in-time event (used per-token in the stream hot loop,
+        mirroring on_llm_new_token spans, ref opentelemetry_callback.py:230)."""
+        self.events.append({
+            "name": name,
+            "time_ns": time.time_ns(),
+            "attributes": dict(attributes or {}),
+        })
+
+    def record_exception(self, exc: BaseException) -> None:
+        self.status = "ERROR"
+        self.add_event("exception", {"type": type(exc).__name__, "message": str(exc)})
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_ns": self.start_ns,
+            "end_ns": self.end_ns,
+            "duration_ms": (self.end_ns - self.start_ns) / 1e6,
+            "attributes": self.attributes,
+            "events": self.events,
+            "status": self.status,
+        }
+
+
+class SpanExporter:
+    def export(self, span: Span) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class ConsoleSpanExporter(SpanExporter):
+    def export(self, span: Span) -> None:
+        print(json.dumps(span.to_dict(), default=str))
+
+
+class InMemorySpanExporter(SpanExporter):
+    """Test exporter (the stand-in for Jaeger assertions)."""
+
+    def __init__(self) -> None:
+        self.spans: List[Span] = []
+        self._lock = threading.Lock()
+
+    def export(self, span: Span) -> None:
+        with self._lock:
+            self.spans.append(span)
+
+    def clear(self) -> None:
+        with self._lock:
+            self.spans.clear()
+
+
+class JsonlSpanExporter(SpanExporter):
+    """Append spans as JSON lines — offline replacement for OTLP→Jaeger."""
+
+    def __init__(self, path: str) -> None:
+        self._path = path
+        self._lock = threading.Lock()
+
+    def export(self, span: Span) -> None:
+        line = json.dumps(span.to_dict(), default=str)
+        with self._lock:
+            with open(self._path, "a", encoding="utf-8") as fh:
+                fh.write(line + "\n")
+
+
+_exporter: SpanExporter = ConsoleSpanExporter()
+_drop_name_substrings = ("/health",)  # ref: otel-collector-config.yaml tail_sampling lines 10-20
+
+
+def set_exporter(exporter: SpanExporter) -> None:
+    global _exporter
+    _exporter = exporter
+
+
+class Tracer:
+    """Factory of spans; one per instrumented component."""
+
+    def __init__(self, name: str, enabled: Optional[bool] = None) -> None:
+        self.name = name
+        self._enabled = enabled
+
+    @property
+    def enabled(self) -> bool:
+        return tracing_enabled() if self._enabled is None else self._enabled
+
+    @contextmanager
+    def span(self, name: str, attributes: Optional[Mapping[str, Any]] = None,
+             parent: Optional[Span] = None) -> Iterator[Span]:
+        if not self.enabled:
+            yield _NOOP_SPAN
+            return
+        parent = parent or _current_span.get()
+        span = Span(
+            name=name,
+            trace_id=parent.trace_id if parent else secrets.token_hex(16),
+            span_id=secrets.token_hex(8),
+            parent_id=parent.span_id if parent else None,
+            start_ns=time.time_ns(),
+            attributes=dict(attributes or {}),
+        )
+        token = _current_span.set(span)
+        try:
+            yield span
+        except BaseException as exc:
+            span.record_exception(exc)
+            raise
+        finally:
+            span.end_ns = time.time_ns()
+            _current_span.reset(token)
+            if not any(s in span.name for s in _drop_name_substrings):
+                _exporter.export(span)
+
+    @contextmanager
+    def start_as_current_span(self, name: str, **kw: Any) -> Iterator[Span]:
+        with self.span(name, **kw) as s:
+            yield s
+
+
+_NOOP_SPAN = Span(name="noop", trace_id="0" * 32, span_id="0" * 16)
+_tracers: Dict[str, Tracer] = {}
+
+
+def get_tracer(name: str) -> Tracer:
+    if name not in _tracers:
+        _tracers[name] = Tracer(name)
+    return _tracers[name]
+
+
+def current_span() -> Optional[Span]:
+    return _current_span.get()
+
+
+# ---------------------------------------------------------------------------
+# W3C TraceContext propagation (ref: tracing.py:46 TraceContextTextMapPropagator)
+# ---------------------------------------------------------------------------
+
+def inject_traceparent(headers: Dict[str, str]) -> Dict[str, str]:
+    span = _current_span.get()
+    if span is not None and tracing_enabled():
+        headers["traceparent"] = f"00-{span.trace_id}-{span.span_id}-01"
+    return headers
+
+
+def extract_traceparent(headers: Mapping[str, str]) -> Optional[Span]:
+    """Parse an incoming ``traceparent`` into a synthetic parent span
+    (ref: llamaindex_instrumentation_wrapper extracting ctx from HTTP headers,
+    tracing.py:62-73)."""
+    raw = headers.get("traceparent")
+    if raw is None:  # HTTP header names are case-insensitive on the wire
+        for key, value in headers.items():
+            if key.lower() == "traceparent":
+                raw = value
+                break
+    if not raw:
+        return None
+    parts = raw.split("-")
+    if len(parts) != 4:
+        return None
+    _, trace_id, span_id, _ = parts
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    return Span(name="remote-parent", trace_id=trace_id, span_id=span_id)
+
+
+@contextmanager
+def use_parent(span: Optional[Span]) -> Iterator[None]:
+    """Attach an extracted remote parent for the duration of a request."""
+    if span is None:
+        yield
+        return
+    token = _current_span.set(span)
+    try:
+        yield
+    finally:
+        _current_span.reset(token)

@@ -1,0 +1,11 @@
+"""TPU compute primitives: norms, RoPE, attention (XLA + Pallas), sampling.
+
+These are the in-tree replacements for the fused kernels that live inside the
+reference's external NIM/TRT-LLM containers (SURVEY §2.5). XLA fuses the
+elementwise chains into the matmuls; Pallas kernels cover what fusion can't
+(flash prefill attention, ragged paged decode attention).
+"""
+
+from generativeaiexamples_tpu.ops.layers import rms_norm, swiglu, rotary_embedding, apply_rope  # noqa: F401
+from generativeaiexamples_tpu.ops.attention import mha_prefill, mha_decode  # noqa: F401
+from generativeaiexamples_tpu.ops.sampling import sample_logits, SamplingParams  # noqa: F401

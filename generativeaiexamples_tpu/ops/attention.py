@@ -1,0 +1,79 @@
+"""Attention: XLA reference paths for prefill and cached decode (GQA-aware).
+
+These einsum formulations are the numerically-authoritative implementations;
+the Pallas flash/ragged kernels in ``ops.pallas`` are validated against them.
+Softmax is computed in float32; inputs/outputs stay in the carrier dtype
+(bf16 on TPU so the matmuls hit the MXU).
+
+GQA grouping is expressed by reshaping Q to (B, S, kv_heads, group, head_dim)
+and batching the einsum over kv_heads — no materialized repeat_kv, which
+would burn HBM bandwidth on (group×) duplicated K/V.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def mha_prefill(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                q_positions: Optional[jnp.ndarray] = None,
+                kv_positions: Optional[jnp.ndarray] = None,
+                kv_mask: Optional[jnp.ndarray] = None,
+                causal: bool = True) -> jnp.ndarray:
+    """Full-sequence attention.
+
+    q: (B, S, n_heads, hd); k,v: (B, T, n_kv, hd) with n_heads % n_kv == 0.
+    q_positions/kv_positions: (B, S)/(B, T) absolute positions for causal
+    masking when q is a suffix of the kv sequence (chunked prefill).
+    kv_mask: (B, T) validity mask for right-padded kv.
+    Returns (B, S, n_heads, hd).
+    """
+    B, S, H, D = q.shape
+    T, KV = k.shape[1], k.shape[2]
+    G = H // KV
+    qg = q.reshape(B, S, KV, G, D)
+    scale = 1.0 / (D ** 0.5)
+    # scores: (B, KV, G, S, T)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    mask = jnp.ones((B, 1, 1, S, T), dtype=bool)
+    if causal:
+        qp = q_positions if q_positions is not None else jnp.broadcast_to(
+            jnp.arange(S)[None, :], (B, S))
+        kp = kv_positions if kv_positions is not None else jnp.broadcast_to(
+            jnp.arange(T)[None, :], (B, T))
+        mask = mask & (kp[:, None, None, None, :] <= qp[:, None, None, :, None])
+    if kv_mask is not None:
+        mask = mask & kv_mask[:, None, None, None, :]
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v.astype(jnp.float32))
+    return out.reshape(B, S, H, D).astype(q.dtype)
+
+
+def mha_decode(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+               lengths: jnp.ndarray) -> jnp.ndarray:
+    """Single-token decode against a dense KV cache.
+
+    q: (B, 1, n_heads, hd); k_cache,v_cache: (B, max_seq, n_kv, hd);
+    lengths: (B,) number of valid cache entries (including the new token).
+    Returns (B, 1, n_heads, hd).
+    """
+    B, _, H, D = q.shape
+    T, KV = k_cache.shape[1], k_cache.shape[2]
+    G = H // KV
+    qg = q.reshape(B, KV, G, D)
+    scale = 1.0 / (D ** 0.5)
+    scores = jnp.einsum("bkgd,btkd->bkgt", qg.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) * scale
+    valid = jnp.arange(T)[None, :] < lengths[:, None]          # (B, T)
+    scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bkgt,btkd->bkgd", probs, v_cache.astype(jnp.float32))
+    return out.reshape(B, 1, H, D).astype(q.dtype)

@@ -1,0 +1,61 @@
+"""Elementwise / normalization / rotary primitives.
+
+Written to fuse: every op here is jnp-composable so XLA folds it into the
+surrounding matmuls (HBM bandwidth is the TPU bottleneck — SURVEY §7 design
+notes). Accumulations happen in float32 regardless of the bf16 carrier dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """RMSNorm (Llama/Gemma family). Computes the moment in f32, returns x.dtype."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(dtype)
+
+
+def silu(x: jnp.ndarray) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    return (xf * (1.0 / (1.0 + jnp.exp(-xf)))).astype(x.dtype)
+
+
+def swiglu(gate: jnp.ndarray, up: jnp.ndarray) -> jnp.ndarray:
+    """SwiGLU activation: silu(gate) * up."""
+    return silu(gate) * up
+
+
+def rotary_embedding(positions: jnp.ndarray, head_dim: int,
+                     theta: float = 500000.0) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for the given positions, HF split-half convention.
+
+    positions: (..., S) int32 → cos,sin: (..., S, head_dim) where the second
+    half duplicates the first (rotate-half layout, matching HF Llama so HF
+    checkpoints load without permutation).
+    """
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # (..., S, half)
+    angles = jnp.concatenate([angles, angles], axis=-1)        # (..., S, hd)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """Apply rotary embedding, split-half (HF) convention.
+
+    x: (..., S, n_heads, head_dim); cos/sin: (..., S, head_dim) broadcast over
+    the heads axis.
+    """
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    cos = cos[..., None, :]
+    sin = sin[..., None, :]
+    return (x.astype(jnp.float32) * cos + rotated.astype(jnp.float32) * sin).astype(x.dtype)

@@ -1,0 +1,88 @@
+"""Token sampling: greedy, temperature, top-k, top-p — jit-safe, batched.
+
+Implements the OpenAI-API sampling surface the reference's LLM clients expose
+(temperature/top_p knobs flow from the chain server request,
+ref: RAG/src/chain_server/server.py:104-147 Prompt fields) as pure functions
+over logits, usable inside the jitted decode step.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Static sampling configuration (hashable → usable as a jit static arg)."""
+
+    temperature: float = 1.0
+    top_k: int = 0        # 0 = disabled
+    top_p: float = 1.0    # 1.0 = disabled
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+
+def _mask_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def _mask_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens while cumulative prob (exclusive) < p; always keep the top-1
+    keep_sorted = jnp.roll(cum, 1, axis=-1).at[..., 0].set(0.0) < p
+    cutoff = jnp.where(keep_sorted, sorted_logits, jnp.inf).min(axis=-1, keepdims=True)
+    return jnp.where(logits < cutoff, -jnp.inf, logits)
+
+
+def sample_logits(rng: jax.Array, logits: jnp.ndarray,
+                  params: SamplingParams) -> jnp.ndarray:
+    """Sample token ids from (B, vocab) logits. Returns (B,) int32."""
+    if params.greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / max(params.temperature, 1e-6)
+    if params.top_k > 0:
+        logits = _mask_top_k(logits, params.top_k)
+    if params.top_p < 1.0:
+        logits = _mask_top_p(logits, params.top_p)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def sample_logits_dynamic(rng: jax.Array, logits: jnp.ndarray,
+                          temperature: jnp.ndarray, top_k: jnp.ndarray,
+                          top_p: jnp.ndarray) -> jnp.ndarray:
+    """Per-sequence dynamic sampling for the continuous batcher: each slot in
+    the decode batch carries its own (temperature, top_k, top_p) — traced
+    values, so one compiled program serves mixed requests.
+
+    temperature<=0 ⇒ greedy for that slot. top_k<=0 ⇒ disabled.
+    logits: (B, V); temperature/top_k/top_p: (B,).
+    """
+    B, V = logits.shape
+    lf = logits.astype(jnp.float32)
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+    scaled = lf / safe_t
+
+    # top-k: rank of each logit within its row (0 = largest)
+    ranks = jnp.argsort(jnp.argsort(scaled, axis=-1)[..., ::-1], axis=-1)
+    k_eff = jnp.where(top_k > 0, top_k, V)[:, None]
+    scaled = jnp.where(ranks < k_eff, scaled, -jnp.inf)
+
+    # top-p over the k-filtered distribution
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum_excl = jnp.roll(jnp.cumsum(probs, axis=-1), 1, axis=-1).at[..., 0].set(0.0)
+    keep = cum_excl < top_p[:, None]
+    cutoff = jnp.where(keep, sorted_desc, jnp.inf).min(axis=-1, keepdims=True)
+    scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
+
+    sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+    greedy = jnp.argmax(lf, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy)

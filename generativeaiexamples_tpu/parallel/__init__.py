@@ -1,0 +1,19 @@
+"""Parallelism foundation: device mesh, sharding rules, collectives, ring attention.
+
+The reference has **no in-tree parallelism** — DP/TP/PP are config knobs into
+external NeMo/Megatron containers over NCCL (ref: finetuning/Gemma/lora.ipynb
+cell 26; SURVEY §2.4/§5.8). This package is the TPU-native replacement: a
+`jax.sharding.Mesh` over ICI/DCN, NamedSharding rules for params and
+activations, and XLA collectives instead of NCCL process groups.
+"""
+
+from generativeaiexamples_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    create_mesh,
+    local_mesh,
+)
+from generativeaiexamples_tpu.parallel.sharding import (  # noqa: F401
+    ShardingRules,
+    logical_to_spec,
+    shard_params,
+)

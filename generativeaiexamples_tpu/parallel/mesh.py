@@ -1,0 +1,84 @@
+"""Device-mesh construction over ICI (intra-slice) and DCN (cross-slice).
+
+Replaces the reference's NCCL process-group world (inside NIM/TRT-LLM via
+``INFERENCE_GPU_COUNT``, ref docker-compose-nim-ms.yaml:18-20, and NeMo
+trainer TP/PP, ref Gemma/lora.ipynb cell 26) with an explicit
+`jax.sharding.Mesh`. Axis conventions:
+
+  * ``data``    — batch/data parallel (gradient all-reduce rides ICI)
+  * ``fsdp``    — fully-sharded params (reduce-scatter/all-gather)
+  * ``tensor``  — megatron-style tensor parallel (activation collectives)
+  * ``seq``     — sequence/context parallel (ring attention, §5.7)
+  * ``expert``  — MoE expert parallel (all_to_all dispatch)
+
+Meshes are created with `jax.make_mesh`, which orders axes so the innermost
+(fastest-varying) axis maps to physically adjacent devices — put ``tensor``
+last so its collectives ride the shortest ICI hops.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+INFER_AXES: Tuple[str, ...] = ("data", "tensor")
+TRAIN_AXES: Tuple[str, ...] = ("data", "fsdp", "tensor")
+LONGCTX_AXES: Tuple[str, ...] = ("data", "seq", "tensor")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Declarative mesh spec, e.g. MeshConfig(axes=("data","tensor"), shape=(1, 8))."""
+
+    axes: Tuple[str, ...] = INFER_AXES
+    shape: Tuple[int, ...] = ()  # empty = auto: all devices on the last axis
+
+    def resolve_shape(self, n_devices: int) -> Tuple[int, ...]:
+        if self.shape:
+            if math.prod(self.shape) != n_devices:
+                raise ValueError(
+                    f"mesh shape {self.shape} needs {math.prod(self.shape)} devices, "
+                    f"have {n_devices}")
+            return self.shape
+        return (1,) * (len(self.axes) - 1) + (n_devices,)
+
+
+def create_mesh(config: Optional[MeshConfig] = None,
+                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh over all (or the given) devices.
+
+    On real TPU slices `jax.make_mesh` picks an ICI-friendly device order;
+    on CPU simulation (xla_force_host_platform_device_count) order is trivial.
+    """
+    config = config or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    shape = config.resolve_shape(len(devices))
+    # Auto axis types: the partitioner infers intermediate shardings (jax 0.9
+    # `make_mesh` defaults to Explicit, which rejects ambiguous gathers like
+    # token-embedding lookups instead of inferring).
+    auto = (jax.sharding.AxisType.Auto,) * len(config.axes)
+    if devices == list(jax.devices()):
+        return jax.make_mesh(shape, config.axes, axis_types=auto)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, config.axes, axis_types=auto)
+
+
+def local_mesh(axes: Tuple[str, ...] = INFER_AXES) -> Mesh:
+    """All local devices on the last axis — the single-host v5e-8 default
+    (1×8 ICI ring, tensor-parallel serving)."""
+    return create_mesh(MeshConfig(axes=axes))
+
+
+def parse_mesh_shape(spec: str, axes: Tuple[str, ...] = INFER_AXES) -> MeshConfig:
+    """Parse 'AxB[xC...]' (e.g. '1x8') into a MeshConfig."""
+    if not spec:
+        return MeshConfig(axes=axes)
+    dims = tuple(int(p) for p in spec.lower().split("x"))
+    if len(dims) != len(axes):
+        raise ValueError(f"mesh spec {spec!r} has {len(dims)} dims for axes {axes}")
+    return MeshConfig(axes=axes, shape=dims)

@@ -1,0 +1,113 @@
+"""Logical-axis sharding rules → NamedSharding for parameter pytrees.
+
+The scaling-book recipe: annotate each parameter dimension with a *logical*
+axis name ("embed", "mlp", "heads", "vocab", ...), then map logical names to
+mesh axes via a rule table. Changing the parallelism strategy (pure TP for
+serving vs FSDP+TP for training) is a rule-table swap — the model code never
+mentions mesh axes.
+
+This is the in-tree replacement for the reference's Megatron
+``tensor_model_parallel_size``/``pipeline_model_parallel_size`` knobs
+(ref: finetuning/Gemma/lora.ipynb cell 26): here the same intent is expressed
+as (logical axis → mesh axis) rules and XLA inserts the collectives.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Mapping, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Logical dimension names used by models in generativeaiexamples_tpu.models.
+Logical = Tuple[Optional[str], ...]
+
+
+@dataclass(frozen=True)
+class ShardingRules:
+    """Mapping logical axis name → mesh axis name (or None = replicate)."""
+
+    rules: Mapping[str, Optional[str]]
+
+    def mesh_axes(self, logical: Logical) -> P:
+        return P(*(self.rules.get(name) if name else None for name in logical))
+
+
+# Serving on one host: megatron-style TP over the "tensor" axis.
+INFERENCE_RULES = ShardingRules(rules={
+    "vocab": "tensor",
+    "vocab_table": None,
+    "embed_table": "tensor",        # embed/unembed split over vocab
+    "embed": None,            # replicate the model dim
+    "heads": "tensor",        # attention heads split (Q)
+    "kv_heads": "tensor",     # KV heads split (GQA: requires kv_heads % tp == 0)
+    "mlp": "tensor",          # FFN hidden split
+    "batch": "data",
+    "seq": None,
+    "expert": "expert",
+})
+
+# Training: FSDP over params + optional TP.
+TRAIN_RULES = ShardingRules(rules={
+    "vocab": "tensor",
+    "vocab_table": None,
+    "embed_table": "fsdp",
+    "embed": "fsdp",          # shard the big dim of every matrix over fsdp
+    "heads": "tensor",
+    "kv_heads": "tensor",
+    "mlp": "tensor",
+    "batch": "data",
+    "seq": None,
+    "expert": "expert",
+})
+
+# Long-context serving: sequence axis sharded for ring attention (§5.7).
+LONG_CONTEXT_RULES = ShardingRules(rules={
+    "vocab": "tensor",
+    "vocab_table": None,
+    "embed_table": "tensor",
+    "embed": None,
+    "heads": "tensor",
+    "kv_heads": "tensor",
+    "mlp": "tensor",
+    "batch": "data",
+    "seq": "seq",
+    "expert": "expert",
+})
+
+
+def logical_to_spec(logical: Logical, rules: ShardingRules, mesh: Mesh) -> P:
+    """Resolve a logical annotation to a PartitionSpec valid on ``mesh``
+    (axes absent from the mesh degrade to replication)."""
+    axes = []
+    for name in logical:
+        mesh_axis = rules.rules.get(name) if name else None
+        axes.append(mesh_axis if mesh_axis in mesh.axis_names else None)
+    return P(*axes)
+
+
+def shard_params(params: Any, logical_tree: Any, rules: ShardingRules,
+                 mesh: Mesh) -> Any:
+    """Device-put a parameter pytree according to its logical annotations.
+
+    ``logical_tree`` mirrors ``params`` with a Logical tuple per leaf (models
+    expose it via ``Model.logical_axes()``).
+    """
+    def place(leaf, logical):
+        spec = logical_to_spec(logical, rules, mesh)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree.map(place, params, logical_tree,
+                        is_leaf=lambda x: isinstance(x, tuple) and all(
+                            isinstance(e, (str, type(None))) for e in x))
+
+
+def sharding_tree(logical_tree: Any, rules: ShardingRules, mesh: Mesh) -> Any:
+    """Build a pytree of NamedShardings (for jit in_shardings/out_shardings)."""
+    def to_sharding(logical):
+        return NamedSharding(mesh, logical_to_spec(logical, rules, mesh))
+
+    return jax.tree.map(to_sharding, logical_tree,
+                        is_leaf=lambda x: isinstance(x, tuple) and all(
+                            isinstance(e, (str, type(None))) for e in x))

@@ -1,0 +1,30 @@
+"""Test configuration: simulate an 8-device TPU mesh on CPU.
+
+Per SURVEY §4 (the reference ships no test suite — we add one): sharding and
+collective tests run against `xla_force_host_platform_device_count=8` so the
+full multi-chip path (pjit, shard_map, ring collectives) executes hostless.
+Must run before jax initializes a backend, hence env mutation at import time.
+"""
+
+import os
+
+# Force CPU: the interactive environment pre-sets JAX_PLATFORMS=axon (the
+# tunneled single TPU chip) — tests must not compile over the tunnel.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    import jax
+    return jax.random.PRNGKey(0)
+
+
+@pytest.fixture(autouse=True)
+def _np_seed():
+    np.random.seed(0)

@@ -1,0 +1,70 @@
+"""Config system tests (parity with ConfigWizard semantics, SURVEY §5.6)."""
+
+import io
+import json
+
+from generativeaiexamples_tpu.core.config import (
+    AppConfig, load_config, print_help,
+)
+
+
+def test_defaults_match_reference_knobs():
+    cfg = load_config(path="")
+    # latency-shaping defaults from BASELINE.md
+    assert cfg.retriever.top_k == 4
+    assert cfg.retriever.score_threshold == 0.25
+    assert cfg.text_splitter.chunk_size == 510
+    assert cfg.text_splitter.chunk_overlap == 200
+    assert cfg.retriever.max_context_tokens == 1500
+    assert cfg.retriever.nr_top_k == 40
+
+
+def test_yaml_file_loading(tmp_path):
+    p = tmp_path / "config.yaml"
+    p.write_text("retriever:\n  top_k: 7\nllm:\n  model_name: test-model\n")
+    cfg = load_config(path=str(p))
+    assert cfg.retriever.top_k == 7
+    assert cfg.llm.model_name == "test-model"
+    assert cfg.retriever.score_threshold == 0.25  # untouched default
+
+
+def test_json_file_loading(tmp_path):
+    p = tmp_path / "config.json"
+    p.write_text(json.dumps({"embeddings": {"dimensions": 128}}))
+    cfg = load_config(path=str(p))
+    assert cfg.embeddings.dimensions == 128
+
+
+def test_env_override_beats_file(tmp_path, monkeypatch):
+    p = tmp_path / "config.yaml"
+    p.write_text("retriever:\n  top_k: 7\n")
+    monkeypatch.setenv("APP_RETRIEVER_TOP_K", "11")
+    monkeypatch.setenv("APP_VECTOR_STORE_NAME", "milvus")
+    monkeypatch.setenv("APP_RETRIEVER_SCORE_THRESHOLD", "0.5")
+    cfg = load_config(path=str(p))
+    assert cfg.retriever.top_k == 11
+    assert cfg.vector_store.name == "milvus"
+    assert cfg.retriever.score_threshold == 0.5
+
+
+def test_env_bool_coercion(monkeypatch):
+    monkeypatch.setenv("APP_ENGINE_MAX_BATCH_SIZE", "16")
+    cfg = load_config(path="")
+    assert cfg.engine.max_batch_size == 16
+
+
+def test_missing_file_is_all_defaults(monkeypatch):
+    monkeypatch.setenv("APP_CONFIG_FILE", "/nonexistent/path.yaml")
+    cfg = load_config()
+    assert isinstance(cfg, AppConfig)
+    assert cfg.llm.model_name == "llama3-8b-instruct"
+
+
+def test_help_lists_every_env_var():
+    buf = io.StringIO()
+    print_help(stream=buf)
+    text = buf.getvalue()
+    assert "APP_CONFIG_FILE" in text
+    assert "APP_RETRIEVER_TOP_K" in text
+    assert "APP_ENGINE_MAX_SEQ_LEN" in text
+    assert "APP_VECTOR_STORE_NAME" in text
