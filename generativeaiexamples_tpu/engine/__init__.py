@@ -1,0 +1,18 @@
+"""TPU LLM serving engine — the in-tree replacement for the reference's
+"NIM for LLMs" container (TensorRT-LLM/vLLM continuous batching behind an
+OpenAI-compatible /v1 API; ref: RAG/examples/local_deploy/
+docker-compose-nim-ms.yaml:2-28, docs/architecture.md:49-61).
+
+Architecture (JetStream-style, XLA-static):
+  * `engine.py`   — jitted prefill / insert / decode-step programs over a
+                    fixed-capacity slot batch (static shapes, bucketed prompts)
+  * `scheduler.py`— continuous-batching orchestrator: request queue → prefill
+                    → slot insertion → decode loop → per-request token streams
+  * `tokenizer.py`— byte-level fallback + HF `tokenizers` wrapper + Llama-3
+                    chat formatting
+  * `server.py`   — aiohttp OpenAI-compatible /v1 endpoints with SSE streaming
+"""
+
+from generativeaiexamples_tpu.engine.engine import EngineCore, DecodeState  # noqa: F401
+from generativeaiexamples_tpu.engine.scheduler import Scheduler, Request  # noqa: F401
+from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer, get_tokenizer  # noqa: F401

@@ -1,0 +1,60 @@
+"""CLI entry: run the OpenAI-compatible TPU model server.
+
+    python -m generativeaiexamples_tpu.engine [--tiny] [--port 8000]
+
+`--tiny` serves the deterministic test-scale model with the byte tokenizer
+(the hostless fake backend, SURVEY §4) — used by tests, local dev, and the
+chain-server compose parity flow. Without `--tiny`, the model/config come
+from AppConfig (APP_ENGINE_* env), loading an orbax checkpoint when
+`APP_ENGINE_CHECKPOINT_DIR` is set and random weights otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+import jax
+
+from generativeaiexamples_tpu.core.config import get_config
+from generativeaiexamples_tpu.engine.engine import EngineCore
+from generativeaiexamples_tpu.engine.scheduler import Scheduler
+from generativeaiexamples_tpu.engine.server import run_server
+from generativeaiexamples_tpu.engine.tokenizer import get_tokenizer
+from generativeaiexamples_tpu.models import llama
+
+
+def build_scheduler(tiny: bool = False) -> tuple:
+    cfg = get_config()
+    if tiny:
+        model_cfg = llama.LlamaConfig.tiny(vocab_size=300)
+        tokenizer = get_tokenizer("")
+        params = llama.init_params(jax.random.PRNGKey(5), model_cfg)
+        model_name = "tiny-llama-test"
+    else:
+        model_cfg = llama.LlamaConfig.llama3_8b()
+        tokenizer = get_tokenizer(cfg.engine.checkpoint_dir)
+        if cfg.engine.checkpoint_dir:
+            from generativeaiexamples_tpu.train.checkpoints import load_params
+            params = load_params(cfg.engine.checkpoint_dir, model_cfg)
+        else:
+            logging.warning("no checkpoint_dir set — serving RANDOM weights")
+            params = llama.init_params(jax.random.PRNGKey(0), model_cfg)
+        model_name = cfg.llm.model_name
+    core = EngineCore(model_cfg, cfg.engine, params, eos_id=tokenizer.eos_id)
+    return Scheduler(core, tokenizer), model_name
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--tiny", action="store_true", help="serve the tiny test model")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8000)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    scheduler, model_name = build_scheduler(tiny=args.tiny)
+    run_server(scheduler, model_name, host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

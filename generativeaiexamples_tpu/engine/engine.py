@@ -1,0 +1,207 @@
+"""Jitted serving core: prefill → slot insert → batched decode step.
+
+Replaces the continuous-batching executor inside the reference's NIM
+container (TRT-LLM inflight batching; ref docker-compose-nim-ms.yaml:2-28).
+TPU-first design constraints (SURVEY §7 "hard parts" #1-3):
+
+  * **Static shapes everywhere.** The decode batch is a fixed-capacity slot
+    array; requests are *inserted into* and *retired from* slots, the compiled
+    program never changes shape. Prompts are right-padded to a small set of
+    power-of-two buckets so prefill compiles once per bucket.
+  * **Prefill/decode disaggregation.** Prefill runs as its own jitted program
+    per request (batch=1, bucketed length), producing the slot's KV block and
+    first token; `insert` splices both into the decode state with
+    `dynamic_update_slice` (no host round-trip of KV).
+  * **Per-slot sampling.** temperature/top-k/top-p ride the decode state as
+    traced (B,) vectors (`sample_logits_dynamic`), so one compiled decode step
+    serves heterogeneous requests.
+  * **Dispatch-ahead streaming.** `decode_step` returns small (B,) arrays;
+    the host only syncs on those, never on the KV cache.
+
+All functions are pure; `EngineCore` owns the jitted callables and the donate
+annotations (cache buffers are donated through insert/decode to avoid copies).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from generativeaiexamples_tpu.core.config import EngineConfig
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.ops.sampling import sample_logits_dynamic
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class DecodeState:
+    """Fixed-capacity slot batch for continuous decoding."""
+
+    cache: llama.KVCache      # (L, B, T, n_kv, hd); lengths (B,)
+    tokens: jnp.ndarray       # (B,) last token per slot
+    active: jnp.ndarray       # (B,) bool — slot currently generating
+    generated: jnp.ndarray    # (B,) tokens generated so far per slot
+    max_gen: jnp.ndarray      # (B,) per-request generation budget
+    temperature: jnp.ndarray  # (B,) f32
+    top_k: jnp.ndarray        # (B,) i32
+    top_p: jnp.ndarray        # (B,) f32
+    rng: jnp.ndarray          # PRNG key
+
+    def tree_flatten(self):
+        return ((self.cache, self.tokens, self.active, self.generated,
+                 self.max_gen, self.temperature, self.top_k, self.top_p,
+                 self.rng), None)
+
+    @classmethod
+    def tree_unflatten(cls, _, c):
+        return cls(*c)
+
+
+def _round_up_bucket(n: int, buckets: Tuple[int, ...]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"prompt length {n} exceeds largest prefill bucket {buckets[-1]}")
+
+
+class EngineCore:
+    """Owns params + jitted programs. Thread-safety: call from one driver
+    thread (the scheduler); jax dispatch itself is async."""
+
+    def __init__(self, model_cfg: llama.LlamaConfig, engine_cfg: EngineConfig,
+                 params: llama.Params, eos_id: int,
+                 adapters: Optional[llama.Params] = None) -> None:
+        self.model_cfg = model_cfg
+        self.cfg = engine_cfg
+        self.params = params
+        self.adapters = adapters
+        self.eos_id = eos_id
+        self.batch = engine_cfg.max_batch_size
+        self.max_seq = engine_cfg.max_seq_len
+        # prefill buckets: powers of two from 64 (or prefill_chunk) to max
+        buckets = []
+        b = min(64, engine_cfg.prefill_chunk)
+        while b < min(engine_cfg.prefill_chunk * 4, self.max_seq):
+            buckets.append(b)
+            b *= 2
+        buckets.append(min(engine_cfg.prefill_chunk * 4, self.max_seq))
+        self.buckets = tuple(sorted(set(buckets)))
+
+        self._prefill = jax.jit(self._prefill_impl)
+        self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
+        self._decode = jax.jit(self._decode_impl, donate_argnums=(0,))
+
+    # ------------------------------------------------------------------ state
+
+    def init_state(self, rng: Optional[jax.Array] = None) -> DecodeState:
+        B = self.batch
+        cache = llama.KVCache.create(self.model_cfg, B, self.max_seq)
+        return DecodeState(
+            cache=cache,
+            tokens=jnp.zeros((B,), jnp.int32),
+            active=jnp.zeros((B,), bool),
+            generated=jnp.zeros((B,), jnp.int32),
+            max_gen=jnp.zeros((B,), jnp.int32),
+            temperature=jnp.ones((B,), jnp.float32),
+            top_k=jnp.zeros((B,), jnp.int32),
+            top_p=jnp.ones((B,), jnp.float32),
+            rng=rng if rng is not None else jax.random.PRNGKey(0),
+        )
+
+    # ---------------------------------------------------------------- prefill
+
+    def _prefill_impl(self, params, tokens, true_len, rng, temperature, top_k, top_p):
+        """tokens (1, S_bucket) right-padded; true_len (1,). Returns first
+        sampled token (1,) and the prefill KV block (L, 1, S, kv, hd)."""
+        cache = llama.KVCache.create(self.model_cfg, 1, tokens.shape[1])
+        logits, cache = llama.prefill(
+            params, self.model_cfg, tokens, cache,
+            start_pos=jnp.zeros((1,), jnp.int32), seq_lens=true_len,
+            adapters=self.adapters, last_only=True)
+        first_tok = sample_logits_dynamic(rng, logits[:, 0], temperature,
+                                          top_k, top_p)
+        return first_tok, cache.k, cache.v
+
+    def prefill(self, prompt_ids, temperature: float, top_k: int, top_p: float,
+                rng: jax.Array):
+        """Host wrapper: bucket/pad the prompt, run the jitted prefill."""
+        n = len(prompt_ids)
+        S = _round_up_bucket(n, self.buckets)
+        padded = jnp.zeros((1, S), jnp.int32).at[0, :n].set(
+            jnp.asarray(prompt_ids, jnp.int32))
+        return self._prefill(
+            self.params, padded, jnp.array([n], jnp.int32), rng,
+            jnp.array([temperature], jnp.float32),
+            jnp.array([top_k], jnp.int32), jnp.array([top_p], jnp.float32))
+
+    # ----------------------------------------------------------------- insert
+
+    def _insert_impl(self, state: DecodeState, k_pre, v_pre, first_tok,
+                     slot, length, max_gen, temperature, top_k, top_p) -> DecodeState:
+        """Splice a prefilled request into decode slot ``slot``."""
+        L = self.model_cfg.n_layers
+        S = k_pre.shape[2]
+        zeros5 = (jnp.int32(0),) * 5
+        # write (L, 1, S, kv, hd) into (L, B, T, kv, hd) at batch=slot
+        idx = (jnp.int32(0), slot, jnp.int32(0), jnp.int32(0), jnp.int32(0))
+        k = jax.lax.dynamic_update_slice(state.cache.k, k_pre, idx)
+        v = jax.lax.dynamic_update_slice(state.cache.v, v_pre, idx)
+        upd = lambda arr, val: arr.at[slot].set(val)
+        return DecodeState(
+            cache=llama.KVCache(k=k, v=v, lengths=upd(state.cache.lengths, length)),
+            tokens=upd(state.tokens, first_tok),
+            active=upd(state.active, True),
+            generated=upd(state.generated, 1),
+            max_gen=upd(state.max_gen, max_gen),
+            temperature=upd(state.temperature, temperature),
+            top_k=upd(state.top_k, top_k),
+            top_p=upd(state.top_p, top_p),
+            rng=state.rng,
+        )
+
+    def insert(self, state: DecodeState, prefill_result, slot: int, length: int,
+               max_gen: int, temperature: float, top_k: int, top_p: float) -> DecodeState:
+        first_tok, k_pre, v_pre = prefill_result
+        return self._insert(
+            state, k_pre, v_pre, first_tok[0], jnp.int32(slot),
+            jnp.int32(length), jnp.int32(max_gen), jnp.float32(temperature),
+            jnp.int32(top_k), jnp.float32(top_p))
+
+    # ----------------------------------------------------------------- decode
+
+    def _decode_impl(self, state: DecodeState, params) -> Tuple[DecodeState, Dict[str, Any]]:
+        logits, cache = llama.decode_step(
+            params, self.model_cfg, state.tokens, state.cache,
+            adapters=self.adapters)
+        rng, sub = jax.random.split(state.rng)
+        sampled = sample_logits_dynamic(sub, logits, state.temperature,
+                                        state.top_k, state.top_p)
+        generated = state.generated + state.active.astype(jnp.int32)
+        hit_eos = sampled == self.eos_id
+        out_of_budget = generated >= state.max_gen
+        out_of_cache = cache.lengths >= self.max_seq - 1
+        done = state.active & (hit_eos | out_of_budget | out_of_cache)
+        active = state.active & ~done
+        # inactive slots keep their old lengths so cache positions stay put
+        lengths = jnp.where(state.active, cache.lengths, state.cache.lengths)
+        new_state = DecodeState(
+            cache=llama.KVCache(k=cache.k, v=cache.v, lengths=lengths),
+            tokens=jnp.where(state.active, sampled, state.tokens),
+            active=active,
+            generated=generated,
+            max_gen=state.max_gen,
+            temperature=state.temperature,
+            top_k=state.top_k,
+            top_p=state.top_p,
+            rng=rng,
+        )
+        out = {"sampled": sampled, "emitted": state.active, "done": done,
+               "hit_eos": hit_eos}
+        return new_state, out
+
+    def decode(self, state: DecodeState) -> Tuple[DecodeState, Dict[str, Any]]:
+        return self._decode(state, self.params)

@@ -1,0 +1,213 @@
+"""Continuous-batching scheduler: request queue → slots → token streams.
+
+The host-side orchestrator around `EngineCore` — the in-tree stand-in for
+TRT-LLM's inflight batcher (ref: NIM container, docker-compose-nim-ms.yaml:2-28).
+One driver thread owns the device: it admits pending requests into free decode
+slots (prefill + insert), then steps the whole slot batch, fanning sampled
+tokens out to per-request queues. Callers (the aiohttp server or in-process
+chains) block on those queues — a thread-safe iterator of text deltas.
+
+Scheduling policy: prefill-priority admission (new requests are inserted as
+soon as a slot frees, keeping batch occupancy high, which is what determines
+tok/s on the MXU); decode runs whenever any slot is active. The device only
+syncs on small (B,) arrays per step — KV stays resident.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.engine.engine import DecodeState, EngineCore
+from generativeaiexamples_tpu.engine.tokenizer import IncrementalDetokenizer, Tokenizer
+
+logger = logging.getLogger(__name__)
+
+_STOP = object()
+
+
+@dataclass
+class Request:
+    prompt_ids: List[int]
+    max_tokens: int = 128
+    temperature: float = 0.7
+    top_k: int = 0
+    top_p: float = 1.0
+    request_id: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
+    # filled by the scheduler:
+    out_queue: "queue.Queue" = field(default_factory=queue.Queue)
+    submitted_at: float = field(default_factory=time.perf_counter)
+    first_token_at: Optional[float] = None
+    completion_tokens: int = 0
+    error: Optional[str] = None
+
+
+@dataclass
+class _SlotInfo:
+    request: Request
+    detok: IncrementalDetokenizer
+
+
+class Scheduler:
+    """Drives an EngineCore from a single background thread."""
+
+    def __init__(self, core: EngineCore, tokenizer: Tokenizer) -> None:
+        self.core = core
+        self.tokenizer = tokenizer
+        self._pending: "queue.Queue" = queue.Queue()
+        self._slots: Dict[int, _SlotInfo] = {}
+        self._free: List[int] = list(range(core.batch))
+        self._state: DecodeState = core.init_state()
+        self._rng = jax.random.PRNGKey(1234)
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+
+    # ------------------------------------------------------------------ API
+
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, name="engine-driver",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        self._wake.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+        self._fail_all("scheduler stopped")
+
+    def _fail_all(self, reason: str) -> None:
+        """Unblock every queued and in-flight consumer (shutdown/crash path)."""
+        while True:
+            try:
+                req: Request = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            req.error = reason
+            req.out_queue.put(_STOP)
+        for slot, info in list(self._slots.items()):
+            info.request.error = reason
+            info.request.out_queue.put(_STOP)
+            del self._slots[slot]
+            self._free.append(slot)
+
+    def submit(self, request: Request) -> Request:
+        """Enqueue; stream deltas via `iter_text(request)`."""
+        self._pending.put(request)
+        self._wake.set()
+        REGISTRY.counter("requests_submitted").inc()
+        return request
+
+    def iter_text(self, request: Request) -> Iterator[str]:
+        """Blocking iterator over the request's text deltas."""
+        while True:
+            item = request.out_queue.get()
+            if item is _STOP:
+                return
+            yield item
+
+    def generate(self, prompt_ids: Sequence[int], **kw) -> str:
+        """Synchronous convenience: submit and join the full text."""
+        req = Request(prompt_ids=list(prompt_ids), **kw)
+        self.submit(req)
+        return "".join(self.iter_text(req))
+
+    # ------------------------------------------------------------- internals
+
+    def _admit(self) -> None:
+        """Prefill pending requests into free slots."""
+        while self._free and not self._pending.empty():
+            try:
+                req: Request = self._pending.get_nowait()
+            except queue.Empty:
+                return
+            if len(req.prompt_ids) >= self.core.buckets[-1]:
+                # truncate from the left (keep the end of the prompt) to fit
+                req.prompt_ids = req.prompt_ids[-(self.core.buckets[-1] - 1):]
+            self._rng, sub = jax.random.split(self._rng)
+            t0 = time.perf_counter()
+            result = self.core.prefill(req.prompt_ids, req.temperature,
+                                       req.top_k, req.top_p, sub)
+            first_tok = int(jax.device_get(result[0])[0])
+            req.first_token_at = time.perf_counter()
+            REGISTRY.histogram("ttft_s").observe(req.first_token_at - req.submitted_at)
+            REGISTRY.histogram("prefill_s").observe(req.first_token_at - t0)
+
+            detok = IncrementalDetokenizer(self.tokenizer)
+            if first_tok == self.core.eos_id or req.max_tokens <= 1:
+                if first_tok != self.core.eos_id:
+                    req.completion_tokens = 1
+                    req.out_queue.put(detok.push(first_tok) + detok.flush())
+                req.out_queue.put(_STOP)
+                REGISTRY.counter("requests_completed").inc()
+                continue
+            slot = self._free.pop()
+            self._state = self.core.insert(
+                self._state, result, slot, len(req.prompt_ids), req.max_tokens,
+                req.temperature, req.top_k, req.top_p)
+            req.completion_tokens = 1
+            delta = detok.push(first_tok)
+            if delta:
+                req.out_queue.put(delta)
+            self._slots[slot] = _SlotInfo(request=req, detok=detok)
+
+    def _step(self) -> None:
+        self._state, out = self.core.decode(self._state)
+        sampled = np.asarray(jax.device_get(out["sampled"]))
+        emitted = np.asarray(jax.device_get(out["emitted"]))
+        done = np.asarray(jax.device_get(out["done"]))
+        hit_eos = np.asarray(jax.device_get(out["hit_eos"]))
+        REGISTRY.counter("decode_steps").inc()
+        REGISTRY.counter("tokens_generated").inc(int(emitted.sum()))
+        for slot, info in list(self._slots.items()):
+            if not emitted[slot]:
+                continue
+            if not (done[slot] and hit_eos[slot]):
+                info.request.completion_tokens += 1
+                delta = info.detok.push(int(sampled[slot]))
+                if delta:
+                    info.request.out_queue.put(delta)
+            if done[slot]:
+                tail = info.detok.flush()
+                if tail:
+                    info.request.out_queue.put(tail)
+                info.request.out_queue.put(_STOP)
+                del self._slots[slot]
+                self._free.append(slot)
+                REGISTRY.counter("requests_completed").inc()
+                REGISTRY.histogram("request_latency_s").observe(
+                    time.perf_counter() - info.request.submitted_at)
+
+    def _loop(self) -> None:
+        logger.info("engine driver thread started (slots=%d)", self.core.batch)
+        while self._running:
+            try:
+                self._admit()
+                if self._slots:
+                    self._step()
+                else:
+                    # idle: wait for work without burning the core
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+            except Exception:
+                # Fail loudly but keep the driver alive: release every blocked
+                # consumer, reset device state, and continue serving — a dead
+                # silent driver with /health green is the worst failure mode.
+                logger.exception("engine driver step failed; resetting state")
+                REGISTRY.counter("driver_errors").inc()
+                self._fail_all("engine error")
+                self._state = self.core.init_state()
+        logger.info("engine driver thread stopped")

@@ -1,0 +1,168 @@
+"""OpenAI-compatible model server over the TPU engine (aiohttp + SSE).
+
+API parity with the surface the reference's clients consume
+(`ChatNVIDIA(base_url=...)` speaks OpenAI `/v1`; ref RAG/src/chain_server/
+utils.py:366-399 and docker-compose-nim-ms.yaml:2-28):
+
+  * POST /v1/chat/completions   — messages → chat template → streamed or whole
+  * POST /v1/completions        — raw prompt completion
+  * GET  /v1/models             — served model card
+  * GET  /health                — liveness (compose healthcheck parity,
+                                  ref docker-compose-nim-ms.yaml:23-28)
+  * GET  /metrics               — engine metrics (req/s, TTFT, tok/s)
+
+Streaming uses `text/event-stream` with `data: {chunk}\n\n` frames and a
+final `data: [DONE]`, matching the OpenAI SSE contract the reference's
+LangChain clients parse.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
+
+MAX_TOKENS_CAP = 1024  # ref: server.py:104-110 caps max_tokens at 1024
+
+
+def _chunk(model: str, rid: str, delta: Dict[str, Any],
+           finish_reason: Optional[str] = None) -> str:
+    return json.dumps({
+        "id": rid,
+        "object": "chat.completion.chunk",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{"index": 0, "delta": delta, "finish_reason": finish_reason}],
+    })
+
+
+class ModelServer:
+    def __init__(self, scheduler: Scheduler, model_name: str) -> None:
+        self.scheduler = scheduler
+        self.model_name = model_name
+        self.app = web.Application()
+        self.app.add_routes([
+            web.get("/health", self.health),
+            web.get("/metrics", self.metrics),
+            web.get("/v1/models", self.models),
+            web.post("/v1/chat/completions", self.chat_completions),
+            web.post("/v1/completions", self.completions),
+        ])
+
+    # ------------------------------------------------------------- endpoints
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response({"message": "Service is up."})
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        return web.json_response(REGISTRY.snapshot())
+
+    async def models(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "object": "list",
+            "data": [{"id": self.model_name, "object": "model",
+                      "owned_by": "generativeaiexamples_tpu"}],
+        })
+
+    def _parse_sampling(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        def get(key, default, cast):
+            value = body.get(key)
+            return default if value is None else cast(value)  # JSON null = default
+
+        return {
+            "max_tokens": min(get("max_tokens", 128, int), MAX_TOKENS_CAP),
+            "temperature": get("temperature", 0.7, float),
+            "top_p": get("top_p", 1.0, float),
+            "top_k": get("top_k", 0, int),
+        }
+
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        body = await request.json()
+        messages = body.get("messages", [])
+        if not messages:
+            raise web.HTTPBadRequest(text=json.dumps(
+                {"error": "messages must be non-empty"}))
+        prompt_ids = self.scheduler.tokenizer.apply_chat_template(messages)
+        return await self._run(request, body, prompt_ids, chat=True)
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        body = await request.json()
+        prompt = body.get("prompt", "")
+        prompt_ids = self.scheduler.tokenizer.encode(prompt, add_bos=True)
+        return await self._run(request, body, prompt_ids, chat=False)
+
+    # --------------------------------------------------------------- serving
+
+    async def _run(self, request: web.Request, body: Dict[str, Any],
+                   prompt_ids, chat: bool) -> web.StreamResponse:
+        sampling = self._parse_sampling(body)
+        req = Request(prompt_ids=list(prompt_ids), **sampling)
+        rid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
+        stream = bool(body.get("stream", False))
+        loop = asyncio.get_running_loop()
+        self.scheduler.submit(req)
+
+        def next_delta() -> Optional[str]:
+            for delta in self.scheduler.iter_text(req):
+                return delta
+            return None
+
+        if not stream:
+            parts = []
+            while True:
+                delta = await loop.run_in_executor(None, next_delta)
+                if delta is None:
+                    break
+                parts.append(delta)
+            text = "".join(parts)
+            key = "message" if chat else "text"
+            choice: Dict[str, Any] = {"index": 0, "finish_reason": "stop"}
+            if chat:
+                choice["message"] = {"role": "assistant", "content": text}
+            else:
+                choice["text"] = text
+            if req.error:
+                raise web.HTTPServiceUnavailable(text=json.dumps({"error": req.error}))
+            return web.json_response({
+                "id": rid, "object": "chat.completion" if chat else "text_completion",
+                "created": int(time.time()), "model": self.model_name,
+                "choices": [choice],
+                "usage": {"prompt_tokens": len(prompt_ids),
+                          "completion_tokens": req.completion_tokens,
+                          "total_tokens": len(prompt_ids) + req.completion_tokens},
+            })
+
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "Connection": "keep-alive",
+        })
+        await resp.prepare(request)
+        if chat:
+            await resp.write(
+                f"data: {_chunk(self.model_name, rid, {'role': 'assistant'})}\n\n".encode())
+        while True:
+            delta = await loop.run_in_executor(None, next_delta)
+            if delta is None:
+                break
+            payload = _chunk(self.model_name, rid, {"content": delta})
+            await resp.write(f"data: {payload}\n\n".encode())
+        await resp.write(
+            f"data: {_chunk(self.model_name, rid, {}, 'stop')}\n\n".encode())
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
+
+def run_server(scheduler: Scheduler, model_name: str, host: str = "0.0.0.0",
+               port: int = 8000) -> None:
+    server = ModelServer(scheduler, model_name)
+    scheduler.start()
+    web.run_app(server.app, host=host, port=port, print=None)

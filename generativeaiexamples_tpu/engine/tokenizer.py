@@ -1,0 +1,132 @@
+"""Tokenizers for the serving engine.
+
+The reference delegates tokenization to the model containers; in-tree we need
+one. Two implementations behind one protocol:
+
+  * `ByteTokenizer` — UTF-8 bytes + special tokens. Zero-dependency,
+    deterministic, used by tests and the fake tiny model (the "fake inference
+    backend" SURVEY §4 calls for).
+  * `HFTokenizer`  — wraps a local `tokenizers` JSON file (Llama-3/Gemma
+    vocabularies) when a checkpoint directory provides one. No network.
+
+Chat formatting follows the Llama-3 instruct convention (header/eot special
+tokens); the byte tokenizer uses readable tag strings so tests can assert on
+the rendered prompt.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+    bos_id: int
+    eos_id: int
+    pad_id: int
+
+    def encode(self, text: str, add_bos: bool = False) -> List[int]: ...
+    def decode(self, ids: Sequence[int]) -> str: ...
+    def apply_chat_template(self, messages: Sequence[dict]) -> List[int]: ...
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer: ids 0..255 are bytes; specials appended after."""
+
+    def __init__(self) -> None:
+        self.bos_id = 256
+        self.eos_id = 257
+        self.pad_id = 258
+        self.vocab_size = 259
+
+    def encode(self, text: str, add_bos: bool = False) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def apply_chat_template(self, messages: Sequence[dict]) -> List[int]:
+        parts = []
+        for m in messages:
+            parts.append(f"<|{m.get('role', 'user')}|>\n{m.get('content', '')}\n")
+        parts.append("<|assistant|>\n")
+        return self.encode("".join(parts), add_bos=True)
+
+
+class HFTokenizer:
+    """Wrapper over a local HuggingFace `tokenizers` JSON file."""
+
+    def __init__(self, path: str) -> None:
+        from tokenizers import Tokenizer as _Tok
+
+        self._tok = _Tok.from_file(path)
+        self.vocab_size = self._tok.get_vocab_size()
+        self.bos_id = self._special("<|begin_of_text|>", "<s>", "<bos>")
+        self.eos_id = self._special("<|eot_id|>", "</s>", "<eos>", "<|end_of_text|>")
+        self.pad_id = self.eos_id
+
+    def _special(self, *names: str) -> int:
+        vocab = self._tok.get_vocab()
+        for n in names:
+            if n in vocab:
+                return vocab[n]
+        return 0
+
+    def encode(self, text: str, add_bos: bool = False) -> List[int]:
+        ids = self._tok.encode(text, add_special_tokens=False).ids
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    def apply_chat_template(self, messages: Sequence[dict]) -> List[int]:
+        # Llama-3 instruct convention: header tokens around each role block.
+        ids: List[int] = [self.bos_id]
+        for m in messages:
+            ids += self.encode(f"<|start_header_id|>{m.get('role', 'user')}"
+                               f"<|end_header_id|>\n\n{m.get('content', '')}<|eot_id|>")
+        ids += self.encode("<|start_header_id|>assistant<|end_header_id|>\n\n")
+        return ids
+
+
+class IncrementalDetokenizer:
+    """Streaming detokenizer: feed ids, get printable text deltas.
+
+    Holds back trailing bytes that form an incomplete UTF-8 sequence so SSE
+    chunks never contain replacement characters mid-codepoint (the per-token
+    stream hot loop, ref server.py:350-376 semantics).
+    """
+
+    def __init__(self, tokenizer: Tokenizer) -> None:
+        self._tok = tokenizer
+        self._ids: List[int] = []
+        self._emitted = 0  # chars already streamed out
+
+    def push(self, token_id: int) -> str:
+        self._ids.append(token_id)
+        text = self._tok.decode(self._ids)
+        # hold back a trailing replacement char (partial UTF-8 sequence)
+        safe = len(text)
+        while safe > 0 and text[safe - 1] == "�":
+            safe -= 1
+        delta = text[self._emitted:safe]
+        self._emitted = safe
+        return delta
+
+    def flush(self) -> str:
+        text = self._tok.decode(self._ids)
+        delta = text[self._emitted:]
+        self._emitted = len(text)
+        return delta
+
+
+def get_tokenizer(checkpoint_dir: str = "") -> Tokenizer:
+    """HF tokenizer if the checkpoint ships one, else the byte fallback."""
+    if checkpoint_dir:
+        p = os.path.join(checkpoint_dir, "tokenizer.json")
+        if os.path.exists(p):
+            return HFTokenizer(p)
+    return ByteTokenizer()

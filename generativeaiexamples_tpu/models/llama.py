@@ -279,13 +279,16 @@ def _scan_cached_blocks(cfg: LlamaConfig, h: jnp.ndarray, params: Params,
 def prefill(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
             cache: KVCache, start_pos: jnp.ndarray,
             seq_lens: jnp.ndarray,
-            adapters: Optional[Params] = None) -> Tuple[jnp.ndarray, KVCache]:
+            adapters: Optional[Params] = None,
+            last_only: bool = False) -> Tuple[jnp.ndarray, KVCache]:
     """Prompt-processing pass that fills the dense KV cache.
 
     tokens: (B, S) right-padded prompts; start_pos: (B,) cache offset (0 for
     fresh sequences, >0 for chunked prefill); seq_lens: (B,) valid token
-    counts in this chunk. Returns logits at each position (B, S, V) and the
-    updated cache (lengths = start_pos + seq_lens).
+    counts in this chunk. Returns logits at each position (B, S, V) — or only
+    at the last valid position (B, 1, V) when ``last_only`` (serving prefill
+    needs one row; skipping the rest avoids a S×vocab unembed per admission)
+    — and the updated cache (lengths = start_pos + seq_lens).
     """
     B, S = tokens.shape
     T = cache.k.shape[2]
@@ -303,6 +306,9 @@ def prefill(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
 
     h, k_stack, v_stack = _scan_cached_blocks(
         cfg, h, params, cache, cos, sin, start_pos, attn, adapters)
+    if last_only:
+        h = jnp.take_along_axis(
+            h, (seq_lens - 1)[:, None, None].astype(jnp.int32), axis=1)
     logits = _unembed(cfg, params, h)
     new_cache = KVCache(k=k_stack, v=v_stack, lengths=start_pos + seq_lens)
     return logits, new_cache

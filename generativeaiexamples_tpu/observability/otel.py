@@ -173,7 +173,9 @@ class Tracer:
         finally:
             span.end_ns = time.time_ns()
             _current_span.reset(token)
-            if not any(s in span.name for s in _drop_name_substrings):
+            # tail-drop health probes by name OR http.path attribute
+            haystack = span.name + " " + str(span.attributes.get("http.path", ""))
+            if not any(s in haystack for s in _drop_name_substrings):
                 _exporter.export(span)
 
     @contextmanager

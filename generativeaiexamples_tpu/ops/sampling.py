@@ -36,8 +36,10 @@ def _mask_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
-    # keep tokens while cumulative prob (exclusive) < p; always keep the top-1
+    # keep tokens while cumulative prob (exclusive) < p; the top-1 is always
+    # kept so p=0 degrades to greedy instead of masking everything
     keep_sorted = jnp.roll(cum, 1, axis=-1).at[..., 0].set(0.0) < p
+    keep_sorted = keep_sorted.at[..., 0].set(True)
     cutoff = jnp.where(keep_sorted, sorted_logits, jnp.inf).min(axis=-1, keepdims=True)
     return jnp.where(logits < cutoff, -jnp.inf, logits)
 
@@ -80,6 +82,7 @@ def sample_logits_dynamic(rng: jax.Array, logits: jnp.ndarray,
     probs = jax.nn.softmax(sorted_desc, axis=-1)
     cum_excl = jnp.roll(jnp.cumsum(probs, axis=-1), 1, axis=-1).at[..., 0].set(0.0)
     keep = cum_excl < top_p[:, None]
+    keep = keep.at[..., 0].set(True)  # top_p=0 degrades to greedy, not all -inf
     cutoff = jnp.where(keep, sorted_desc, jnp.inf).min(axis=-1, keepdims=True)
     scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
 

@@ -1,0 +1,7 @@
+"""Training: LoRA / SFT trainers, orbax checkpointing, data pipelines.
+
+Replaces the reference's notebook-driven NeMo/Megatron fine-tuning containers
+(ref: finetuning/Gemma/lora.ipynb, SURVEY §2.4) with in-tree JAX trainers:
+DP/FSDP(+TP) via pjit sharding over ICI, XLA collectives instead of NCCL,
+orbax sharded checkpoints instead of `.nemo` files.
+"""

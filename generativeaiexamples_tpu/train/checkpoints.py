@@ -1,0 +1,40 @@
+"""Orbax checkpoint save/load for model parameter pytrees.
+
+The TPU-native replacement for the reference's two checkpoint stories
+(SURVEY §5.4): NeMo `.nemo` archives written by `exp_manager`
+(ref: finetuning/Gemma/lora.ipynb cell 30) and the NIM model cache volume
+(ref: docker-compose-nim-ms.yaml:6-7). Checkpoints are sharded + async-able
+via orbax; serving (`engine/__main__.py`) and the trainer share this module.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from generativeaiexamples_tpu.models import llama
+
+PARAMS_SUBDIR = "params"
+
+
+def save_params(directory: str, params: Any) -> None:
+    """Write a parameter pytree to ``directory``/params (overwrites)."""
+    path = os.path.abspath(os.path.join(directory, PARAMS_SUBDIR))
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, params, force=True)
+    ckptr.wait_until_finished()
+
+
+def load_params(directory: str, model_cfg: llama.LlamaConfig,
+                target: Optional[Any] = None) -> Any:
+    """Restore a parameter pytree; shape/dtype template comes from the model
+    config unless an explicit ``target`` (e.g. sharded abstract tree) is given."""
+    path = os.path.abspath(os.path.join(directory, PARAMS_SUBDIR))
+    if target is None:
+        target = jax.eval_shape(
+            lambda: llama.init_params(jax.random.PRNGKey(0), model_cfg))
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(path, target)

@@ -1,0 +1,52 @@
+"""Checkpoint round-trip (orbax) + sampling edge cases + tracing filter."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.ops.sampling import (
+    SamplingParams, sample_logits, sample_logits_dynamic,
+)
+from generativeaiexamples_tpu.train import checkpoints
+
+
+def test_params_roundtrip(tmp_path):
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(2), cfg)
+    checkpoints.save_params(str(tmp_path / "ckpt"), params)
+    restored = checkpoints.load_params(str(tmp_path / "ckpt"), cfg)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                            np.asarray(b)),
+                 params, restored)
+
+
+def test_top_p_zero_degrades_to_greedy():
+    logits = jnp.array([[0.1, 3.0, 0.2, -1.0]])
+    tok = sample_logits(jax.random.PRNGKey(0), logits,
+                        SamplingParams(temperature=1.0, top_p=0.0))
+    assert int(tok[0]) == 1
+    tok = sample_logits_dynamic(jax.random.PRNGKey(0), logits,
+                                jnp.array([1.0]), jnp.array([0]),
+                                jnp.array([0.0]))
+    assert int(tok[0]) == 1
+
+
+def test_health_span_dropped_by_path_attribute():
+    import os
+    from generativeaiexamples_tpu.observability import otel
+
+    os.environ["ENABLE_TRACING"] = "true"
+    try:
+        exp = otel.InMemorySpanExporter()
+        otel.set_exporter(exp)
+        tracer = otel.get_tracer("t")
+        with tracer.span("http:health", attributes={"http.path": "/health"}):
+            pass
+        with tracer.span("http:generate", attributes={"http.path": "/generate"}):
+            pass
+        names = [s.name for s in exp.spans]
+        assert names == ["http:generate"]
+    finally:
+        del os.environ["ENABLE_TRACING"]
+        otel.set_exporter(otel.ConsoleSpanExporter())
