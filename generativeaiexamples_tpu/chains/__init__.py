@@ -1,0 +1,22 @@
+"""Example chains — the pluggable RAG pipelines (ref: RAG/examples/).
+
+Each module registers a `BaseExample` with the server registry:
+
+  basic_rag            ingest→split→embed→store; retrieve→prompt→stream
+                       (ref basic_rag/langchain/chains.py)
+  multi_turn_rag       conversation memory + retrieve-40→rerank-4 funnel
+                       (ref advanced_rag/multi_turn_rag/chains.py)
+  query_decomposition  recursive sub-question agent with search+math tools
+                       (ref advanced_rag/query_decomposition_rag/chains.py)
+  structured_data      CSV Q&A over pandas (ref advanced_rag/structured_data_rag)
+  multimodal           PDF/PPTX/image ingestion + captioning
+                       (ref advanced_rag/multimodal_rag)
+  agentic_rag          self-corrective graph: grade→rewrite→regenerate
+                       (ref notebooks/langchain/agentic_rag_with_nemo_retriever_nim.ipynb)
+
+All chains share `ChainContext` (engine + encoders + stores) so one process
+serves any example — the compose-file indirection of the reference collapses
+into in-proc wiring.
+"""
+
+from generativeaiexamples_tpu.chains.context import ChainContext, get_context  # noqa: F401

@@ -1,0 +1,113 @@
+"""basic_rag — ingest→split→embed→store; retrieve→prompt→stream.
+
+Behavioral parity with the reference's flagship example
+(ref: RAG/examples/basic_rag/langchain/chains.py): `ingest_docs` loads and
+chunks the file then indexes it (chains.py:54-88); `rag_chain` retrieves
+top-k above the score threshold, trims context to the token budget, builds
+the RAG prompt, and streams (chains.py:121-192 + retriever wiring 156-167;
+budget DEFAULT_MAX_CONTEXT utils.py:103). `llm_chain` answers without
+retrieval (chains.py:91-118).
+
+The pipeline differences are architectural, not behavioral: embedding and
+generation are in-process TPU calls instead of HTTP hops to NIM containers.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, Iterator, List, Sequence
+
+from generativeaiexamples_tpu.chains.context import ChainContext, get_context
+from generativeaiexamples_tpu.chains.loaders import load_document
+from generativeaiexamples_tpu.core.tracing import chain_instrumentation
+from generativeaiexamples_tpu.retrieval.store import Document
+from generativeaiexamples_tpu.server.base import BaseExample
+from generativeaiexamples_tpu.server.registry import register_example
+
+logger = logging.getLogger(__name__)
+
+COLLECTION = "basic_rag"
+
+
+def _sampling(llm_settings: Dict[str, Any]) -> Dict[str, Any]:
+    return {
+        "max_tokens": int(llm_settings.get("max_tokens", 256)),
+        "temperature": float(llm_settings.get("temperature", 0.2)),
+        "top_p": float(llm_settings.get("top_p", 0.7)),
+    }
+
+
+def trim_context(chunks: Sequence[str], tokenizer, budget: int) -> str:
+    """Concatenate retrieved chunks up to the token budget
+    (ref: LimitRetrievedNodesLength._postprocess_nodes, utils.py:106-134)."""
+    used = 0
+    kept: List[str] = []
+    for chunk in chunks:
+        n = len(tokenizer.encode(chunk))
+        if used + n > budget:
+            break
+        kept.append(chunk)
+        used += n
+    return "\n\n".join(kept)
+
+
+@register_example("basic_rag")
+class BasicRAG(BaseExample):
+    def __init__(self, context: ChainContext = None) -> None:
+        self.ctx = context or get_context()
+
+    # ------------------------------------------------------------ ingestion
+
+    @chain_instrumentation
+    def ingest_docs(self, filepath: str, filename: str) -> None:
+        text = load_document(filepath)
+        if not text.strip():
+            raise ValueError(f"no text extracted from {filename}")
+        chunks = self.ctx.splitter().split(text)
+        docs = [Document(content=c, metadata={"source": filename})
+                for c in chunks]
+        embeddings = self.ctx.embedder.embed_documents([d.content for d in docs])
+        self.ctx.store(COLLECTION).add(docs, embeddings)
+        logger.info("ingested %s: %d chunks", filename, len(docs))
+
+    # ----------------------------------------------------------- generation
+
+    @chain_instrumentation
+    def llm_chain(self, query: str, chat_history: Sequence[Dict[str, str]],
+                  **llm_settings: Any) -> Iterator[str]:
+        messages = ([{"role": "system", "content": self.ctx.prompts["chat_template"]}]
+                    + list(chat_history) + [{"role": "user", "content": query}])
+        yield from self.ctx.llm.chat(messages, **_sampling(llm_settings))
+
+    @chain_instrumentation
+    def rag_chain(self, query: str, chat_history: Sequence[Dict[str, str]],
+                  **llm_settings: Any) -> Iterator[str]:
+        rcfg = self.ctx.config.retriever
+        qvec = self.ctx.embedder.embed_queries([query])[0]
+        hits = self.ctx.store(COLLECTION).search(
+            qvec, top_k=rcfg.top_k, score_threshold=rcfg.score_threshold)
+        context_text = trim_context([d.content for d, _ in hits],
+                                    self.ctx.embedder.tokenizer,
+                                    rcfg.max_context_tokens)
+        system = self.ctx.prompts["rag_template"].format(context=context_text)
+        messages = ([{"role": "system", "content": system}]
+                    + list(chat_history) + [{"role": "user", "content": query}])
+        yield from self.ctx.llm.chat(messages, **_sampling(llm_settings))
+
+    # ------------------------------------------------------------ documents
+
+    def document_search(self, query: str, num_docs: int = 4) -> List[Dict[str, Any]]:
+        qvec = self.ctx.embedder.embed_queries([query])[0]
+        hits = self.ctx.store(COLLECTION).search(
+            qvec, top_k=num_docs,
+            score_threshold=self.ctx.config.retriever.score_threshold)
+        return [{"source": str(d.metadata.get("source", "")),
+                 "content": d.content, "score": score}
+                for d, score in hits]
+
+    def get_documents(self) -> List[str]:
+        return self.ctx.store(COLLECTION).list_sources()
+
+    def delete_documents(self, filenames: Sequence[str]) -> bool:
+        return self.ctx.store(COLLECTION).delete_by_source(filenames) > 0

@@ -1,0 +1,79 @@
+"""Shared runtime context for chains: LLM, encoders, stores, splitter, prompts.
+
+The in-proc equivalent of the reference's cached client factories hub
+(ref: utils.py get_llm:366 / get_embedding_model:407 / get_ranking_model:448 /
+get_text_splitter:474 / create_vectorstore_langchain:288): one `ChainContext`
+owns the TPU engines and hands chains their dependencies, so every example
+runs in a single process with no HTTP hops between pipeline stages.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from generativeaiexamples_tpu.core.config import AppConfig, get_config
+from generativeaiexamples_tpu.core.prompts import get_prompts
+from generativeaiexamples_tpu.encoders.embedder import Embedder
+from generativeaiexamples_tpu.encoders.reranker import Reranker
+from generativeaiexamples_tpu.retrieval.store import VectorStore
+from generativeaiexamples_tpu.retrieval.text_splitter import TokenTextSplitter
+
+
+@dataclass
+class ChainContext:
+    config: AppConfig
+    llm: object
+    embedder: Embedder
+    reranker: Optional[Reranker] = None
+    stores: Dict[str, VectorStore] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    @property
+    def prompts(self) -> Dict[str, str]:
+        return get_prompts()
+
+    def store(self, collection: str = "default") -> VectorStore:
+        """Named collections (ref: COLLECTION_NAME env per example,
+        docker-compose.yaml:24-27)."""
+        with self._lock:
+            if collection not in self.stores:
+                vs = self.config.vector_store
+                self.stores[collection] = VectorStore(
+                    dim=self.embedder.dim, index_type=vs.index_type,
+                    nlist=vs.nlist, nprobe=vs.nprobe, name=collection)
+            return self.stores[collection]
+
+    def splitter(self) -> TokenTextSplitter:
+        ts = self.config.text_splitter
+        return TokenTextSplitter(chunk_size=ts.chunk_size,
+                                 chunk_overlap=ts.chunk_overlap)
+
+
+_context: Optional[ChainContext] = None
+_context_lock = threading.Lock()
+
+
+def get_context(scheduler=None) -> ChainContext:
+    """Process-wide context; builds engines on first use."""
+    global _context
+    with _context_lock:
+        if _context is None:
+            from generativeaiexamples_tpu.chains.llm_client import get_llm
+
+            config = get_config()
+            _context = ChainContext(
+                config=config,
+                llm=get_llm(scheduler),
+                embedder=Embedder(),
+                reranker=Reranker(),
+            )
+        return _context
+
+
+def set_context(context: Optional[ChainContext]) -> None:
+    """Test hook / server wiring."""
+    global _context
+    with _context_lock:
+        _context = context

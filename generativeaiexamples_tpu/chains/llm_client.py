@@ -1,0 +1,90 @@
+"""LLM client seam: in-process TPU engine or remote OpenAI-compatible server.
+
+The reference's chains obtain their LLM through `get_llm()` which returns a
+ChatNVIDIA pointed either at a local NIM or the hosted API catalog
+(ref: utils.py:366-399 — "the seam" per SURVEY §7.2). Here the same seam
+yields `LocalLLM` (direct scheduler calls, zero HTTP) or `RemoteLLM`
+(httpx to any /v1 server), both exposing a streaming `chat` iterator.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from functools import lru_cache
+from typing import Dict, Iterator, Optional, Sequence
+
+from generativeaiexamples_tpu.core.config import get_config
+
+logger = logging.getLogger(__name__)
+
+
+class LocalLLM:
+    """Directly drives the in-proc continuous-batching scheduler."""
+
+    def __init__(self, scheduler) -> None:
+        self.scheduler = scheduler
+
+    def chat(self, messages: Sequence[Dict[str, str]], max_tokens: int = 256,
+             temperature: float = 0.7, top_p: float = 1.0,
+             top_k: int = 0) -> Iterator[str]:
+        from generativeaiexamples_tpu.engine.scheduler import Request
+
+        prompt_ids = self.scheduler.tokenizer.apply_chat_template(list(messages))
+        req = Request(prompt_ids=prompt_ids, max_tokens=max_tokens,
+                      temperature=temperature, top_p=top_p, top_k=top_k)
+        self.scheduler.submit(req)
+        yield from self.scheduler.iter_text(req)
+
+
+class RemoteLLM:
+    """OpenAI-compatible /v1 client (the reference's server_url path)."""
+
+    def __init__(self, base_url: str, model: str) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.model = model
+
+    def chat(self, messages: Sequence[Dict[str, str]], max_tokens: int = 256,
+             temperature: float = 0.7, top_p: float = 1.0,
+             top_k: int = 0) -> Iterator[str]:
+        import httpx
+
+        payload = {"model": self.model, "messages": list(messages),
+                   "max_tokens": max_tokens, "temperature": temperature,
+                   "top_p": top_p, "stream": True}
+        with httpx.stream("POST", f"{self.base_url}/v1/chat/completions",
+                          json=payload, timeout=120.0) as resp:
+            for line in resp.iter_lines():
+                if not line.startswith("data: "):
+                    continue
+                data = line[len("data: "):]
+                if data.strip() == "[DONE]":
+                    return
+                chunk = json.loads(data)
+                delta = chunk["choices"][0].get("delta", {})
+                content = delta.get("content")
+                if content:
+                    yield content
+
+
+@lru_cache(maxsize=1)
+def _default_scheduler():
+    """Build the in-proc engine once per process (tiny model unless a
+    checkpoint is configured) — mirrors the reference's cached get_llm
+    (utils.py lru_cache pattern)."""
+    from generativeaiexamples_tpu.engine.__main__ import build_scheduler
+
+    cfg = get_config()
+    tiny = not cfg.engine.checkpoint_dir
+    scheduler, _ = build_scheduler(tiny=tiny)
+    scheduler.start()
+    return scheduler
+
+
+def get_llm(scheduler=None):
+    """The factory chains call (ref utils.py:366): remote when
+    APP_LLM_SERVER_URL is set, local TPU engine otherwise."""
+    cfg = get_config()
+    if cfg.llm.server_url:
+        return RemoteLLM(cfg.llm.server_url, cfg.llm.model_name)
+    return LocalLLM(scheduler if scheduler is not None else _default_scheduler())

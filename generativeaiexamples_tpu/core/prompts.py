@@ -1,0 +1,68 @@
+"""Prompt templates with user-override merge.
+
+Parity with the reference's prompt config: each example ships default
+templates; a user-mounted YAML overrides/extends them
+(ref: per-example prompt.yaml; merge logic get_prompts/_combine_dicts,
+utils.py:190-216, 689-715; mount point docker-compose.yaml:17-18).
+Override file path comes from ``APP_PROMPTS_FILE``.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import lru_cache
+from typing import Any, Dict, Optional
+
+import yaml
+
+DEFAULT_PROMPTS: Dict[str, str] = {
+    # ref basic_rag prompt.yaml semantics: a chat template and a rag template
+    "chat_template": (
+        "You are a helpful, respectful and honest assistant. Always answer as "
+        "helpfully as possible. If you don't know the answer to a question, "
+        "say so rather than guessing."),
+    "rag_template": (
+        "You are a helpful AI assistant. Use the following pieces of retrieved "
+        "context to answer the question. If the context does not contain the "
+        "answer, say you don't know. Keep the answer concise.\n\n"
+        "Context:\n{context}\n"),
+    "multi_turn_rag_template": (
+        "You are a document chatbot. Answer the user's question using only the "
+        "retrieved context and the conversation so far. If unsure, say so.\n\n"
+        "Context:\n{context}\n"),
+    "query_rewriter_prompt": (
+        "Given the conversation history and a follow-up question, rewrite the "
+        "follow-up into a standalone question. Return only the question."),
+    "tool_selector_prompt": (
+        "Answer the question by decomposing it into simpler sub-questions when "
+        "needed. Respond with a JSON list of sub-questions, or \"Nil\" if the "
+        "question needs no decomposition."),
+    "csv_prompt": (
+        "You are a data analyst. Given the table description below, answer the "
+        "user's question about the data.\n\nTable info:\n{table_info}\n"),
+    "multimodal_rag_template": (
+        "Answer using the retrieved text and image descriptions.\n\n"
+        "Context:\n{context}\n"),
+}
+
+
+def _combine(base: Dict[str, Any], override: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(base)
+    for key, value in override.items():
+        if isinstance(value, dict) and isinstance(out.get(key), dict):
+            out[key] = _combine(out[key], value)
+        else:
+            out[key] = value
+    return out
+
+
+@lru_cache(maxsize=1)
+def get_prompts(override_path: Optional[str] = None) -> Dict[str, Any]:
+    prompts: Dict[str, Any] = dict(DEFAULT_PROMPTS)
+    path = override_path or os.environ.get("APP_PROMPTS_FILE", "")
+    if path and os.path.isfile(path):
+        with open(path, "r", encoding="utf-8") as fh:
+            user = yaml.safe_load(fh) or {}
+        if isinstance(user, dict):
+            prompts = _combine(prompts, user)
+    return prompts

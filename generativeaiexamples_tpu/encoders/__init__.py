@@ -1,0 +1,11 @@
+"""Encoder services: embeddings + reranking on TPU.
+
+Replace the NeMo Retriever embedding NIM (`nv-embedqa-e5-v5`,
+ref docker-compose-nim-ms.yaml:30-56) and reranking NIM
+(`nv-rerankqa-mistral-4b-v3`, ref :58-81) with jitted, batch-bucketed
+BERT-class encoders servable in-process or over the same `/v1` REST shapes
+the reference's clients consume (utils.py:431-440, 458-471).
+"""
+
+from generativeaiexamples_tpu.encoders.embedder import Embedder  # noqa: F401
+from generativeaiexamples_tpu.encoders.reranker import Reranker  # noqa: F401

@@ -1,0 +1,86 @@
+"""REST surface for the encoder services — NIM-shape parity.
+
+Endpoints match what the reference's LangChain clients call:
+  * POST /v1/embeddings — OpenAI embeddings shape with the NIM `input_type`
+    extension (query|passage) the embedding NIM exposes
+    (ref: utils.py:431-440; docker-compose-nim-ms.yaml:30-56, port 9080)
+  * POST /v1/ranking — rerank NIM shape {query:{text}, passages:[{text}]}
+    → {rankings:[{index, logit}]} (ref: utils.py:458-466; compose :58-81)
+  * GET /health — compose healthcheck parity
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from aiohttp import web
+
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.encoders.embedder import Embedder
+from generativeaiexamples_tpu.encoders.reranker import Reranker
+
+
+class EncoderServer:
+    def __init__(self, embedder: Optional[Embedder] = None,
+                 reranker: Optional[Reranker] = None,
+                 model_name: str = "e5-base-tpu",
+                 rerank_model_name: str = "rerank-tpu") -> None:
+        self.embedder = embedder
+        self.reranker = reranker
+        self.model_name = model_name
+        self.rerank_model_name = rerank_model_name
+        self.app = web.Application()
+        routes = [web.get("/health", self.health),
+                  web.get("/metrics", self.metrics)]
+        if embedder is not None:
+            routes.append(web.post("/v1/embeddings", self.embeddings))
+        if reranker is not None:
+            routes.append(web.post("/v1/ranking", self.ranking))
+        self.app.add_routes(routes)
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response({"message": "Service is up."})
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        return web.json_response(REGISTRY.snapshot())
+
+    async def embeddings(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        texts = body.get("input", [])
+        if isinstance(texts, str):
+            texts = [texts]
+        if not texts:
+            raise web.HTTPBadRequest(text=json.dumps({"error": "empty input"}))
+        input_type = body.get("input_type", "passage")
+        fn = (self.embedder.embed_queries if input_type == "query"
+              else self.embedder.embed_documents)
+        vecs = fn(texts)
+        return web.json_response({
+            "object": "list",
+            "model": self.model_name,
+            "data": [{"object": "embedding", "index": i, "embedding": v.tolist()}
+                     for i, v in enumerate(vecs)],
+            "usage": {"prompt_tokens": 0, "total_tokens": 0},
+        })
+
+    async def ranking(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        query = (body.get("query") or {}).get("text", "")
+        passages = [p.get("text", "") for p in body.get("passages", [])]
+        if not query or not passages:
+            raise web.HTTPBadRequest(text=json.dumps(
+                {"error": "query and passages required"}))
+        top_n = int(body.get("top_n") or len(passages))
+        ranked = self.reranker.rerank(query, passages, top_n=top_n)
+        return web.json_response({
+            "model": self.rerank_model_name,
+            "rankings": [{"index": i, "logit": s} for i, s in ranked],
+        })
+
+
+def run_server(embedder: Optional[Embedder] = None,
+               reranker: Optional[Reranker] = None,
+               host: str = "0.0.0.0", port: int = 9080) -> None:
+    server = EncoderServer(embedder, reranker)
+    web.run_app(server.app, host=host, port=port, print=None)

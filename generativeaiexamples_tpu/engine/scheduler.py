@@ -86,7 +86,14 @@ class Scheduler:
         self._running = False
         self._wake.set()
         if self._thread:
-            self._thread.join(timeout=10)
+            self._thread.join(timeout=60)
+            if self._thread.is_alive():
+                # Driver still mid-step (e.g. a long XLA compile): touching
+                # _slots/_free concurrently would corrupt bookkeeping — leave
+                # cleanup to the driver, which checks _running after the step.
+                logger.warning("driver thread still busy at stop(); "
+                               "skipping forced cleanup")
+                return
         self._fail_all("scheduler stopped")
 
     def _fail_all(self, reason: str) -> None:
@@ -210,4 +217,5 @@ class Scheduler:
                 REGISTRY.counter("driver_errors").inc()
                 self._fail_all("engine error")
                 self._state = self.core.init_state()
+        self._fail_all("scheduler stopped")
         logger.info("engine driver thread stopped")

@@ -18,7 +18,6 @@ LangChain clients parse.
 
 from __future__ import annotations
 
-import asyncio
 import json
 import time
 import uuid
@@ -26,10 +25,11 @@ from typing import Any, Dict, Optional
 
 from aiohttp import web
 
-from generativeaiexamples_tpu.core.metrics import REGISTRY
 from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
-
-MAX_TOKENS_CAP = 1024  # ref: server.py:104-110 caps max_tokens at 1024
+from generativeaiexamples_tpu.server.common import (
+    MAX_TOKENS_CAP, StreamDrain, health_handler, metrics_handler, sse_done,
+    sse_write,
+)
 
 
 def _chunk(model: str, rid: str, delta: Dict[str, Any],
@@ -49,20 +49,14 @@ class ModelServer:
         self.model_name = model_name
         self.app = web.Application()
         self.app.add_routes([
-            web.get("/health", self.health),
-            web.get("/metrics", self.metrics),
+            web.get("/health", health_handler),
+            web.get("/metrics", metrics_handler),
             web.get("/v1/models", self.models),
             web.post("/v1/chat/completions", self.chat_completions),
             web.post("/v1/completions", self.completions),
         ])
 
     # ------------------------------------------------------------- endpoints
-
-    async def health(self, request: web.Request) -> web.Response:
-        return web.json_response({"message": "Service is up."})
-
-    async def metrics(self, request: web.Request) -> web.Response:
-        return web.json_response(REGISTRY.snapshot())
 
     async def models(self, request: web.Request) -> web.Response:
         return web.json_response({
@@ -106,30 +100,18 @@ class ModelServer:
         req = Request(prompt_ids=list(prompt_ids), **sampling)
         rid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
         stream = bool(body.get("stream", False))
-        loop = asyncio.get_running_loop()
         self.scheduler.submit(req)
-
-        def next_delta() -> Optional[str]:
-            for delta in self.scheduler.iter_text(req):
-                return delta
-            return None
+        drain = StreamDrain(self.scheduler.iter_text(req))
 
         if not stream:
-            parts = []
-            while True:
-                delta = await loop.run_in_executor(None, next_delta)
-                if delta is None:
-                    break
-                parts.append(delta)
-            text = "".join(parts)
-            key = "message" if chat else "text"
+            text = await drain.join_text()
+            if req.error:
+                raise web.HTTPServiceUnavailable(text=json.dumps({"error": req.error}))
             choice: Dict[str, Any] = {"index": 0, "finish_reason": "stop"}
             if chat:
                 choice["message"] = {"role": "assistant", "content": text}
             else:
                 choice["text"] = text
-            if req.error:
-                raise web.HTTPServiceUnavailable(text=json.dumps({"error": req.error}))
             return web.json_response({
                 "id": rid, "object": "chat.completion" if chat else "text_completion",
                 "created": int(time.time()), "model": self.model_name,
@@ -146,18 +128,15 @@ class ModelServer:
         })
         await resp.prepare(request)
         if chat:
-            await resp.write(
-                f"data: {_chunk(self.model_name, rid, {'role': 'assistant'})}\n\n".encode())
-        while True:
-            delta = await loop.run_in_executor(None, next_delta)
-            if delta is None:
-                break
-            payload = _chunk(self.model_name, rid, {"content": delta})
-            await resp.write(f"data: {payload}\n\n".encode())
-        await resp.write(
-            f"data: {_chunk(self.model_name, rid, {}, 'stop')}\n\n".encode())
-        await resp.write(b"data: [DONE]\n\n")
-        await resp.write_eof()
+            await sse_write(resp, _chunk(self.model_name, rid, {"role": "assistant"}))
+        async for delta in drain:
+            await sse_write(resp, _chunk(self.model_name, rid, {"content": delta}))
+        # an engine failure mid-stream must not masquerade as a clean stop
+        finish = "error" if req.error else "stop"
+        if req.error:
+            await sse_write(resp, json.dumps({"error": req.error}))
+        await sse_write(resp, _chunk(self.model_name, rid, {}, finish))
+        await sse_done(resp)
         return resp
 
 

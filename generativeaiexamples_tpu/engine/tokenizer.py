@@ -98,28 +98,38 @@ class IncrementalDetokenizer:
     Holds back trailing bytes that form an incomplete UTF-8 sequence so SSE
     chunks never contain replacement characters mid-codepoint (the per-token
     stream hot loop, ref server.py:350-376 semantics).
+
+    Runs on the single engine-driver thread, so per-push work must stay O(1):
+    only the ids since the last *clean* decode are re-decoded. The pending
+    buffer resets every time the decoded text ends on a codepoint boundary —
+    which is nearly every token — so it never grows past a few ids in
+    practice (a codepoint/BPE piece spans a handful of tokens at most).
     """
 
     def __init__(self, tokenizer: Tokenizer) -> None:
         self._tok = tokenizer
-        self._ids: List[int] = []
-        self._emitted = 0  # chars already streamed out
+        self._pending: List[int] = []
+        self._pending_emitted = 0  # chars of decode(_pending) already streamed
 
     def push(self, token_id: int) -> str:
-        self._ids.append(token_id)
-        text = self._tok.decode(self._ids)
-        # hold back a trailing replacement char (partial UTF-8 sequence)
+        self._pending.append(token_id)
+        text = self._tok.decode(self._pending)
         safe = len(text)
-        while safe > 0 and text[safe - 1] == "�":
+        while safe > 0 and text[safe - 1] == "�":  # partial UTF-8 tail
             safe -= 1
-        delta = text[self._emitted:safe]
-        self._emitted = safe
+        delta = text[self._pending_emitted:safe]
+        if safe == len(text):
+            self._pending = []
+            self._pending_emitted = 0
+        else:
+            self._pending_emitted = safe
         return delta
 
     def flush(self) -> str:
-        text = self._tok.decode(self._ids)
-        delta = text[self._emitted:]
-        self._emitted = len(text)
+        text = self._tok.decode(self._pending)
+        delta = text[self._pending_emitted:]
+        self._pending = []
+        self._pending_emitted = 0
         return delta
 
 
@@ -129,4 +139,9 @@ def get_tokenizer(checkpoint_dir: str = "") -> Tokenizer:
         p = os.path.join(checkpoint_dir, "tokenizer.json")
         if os.path.exists(p):
             return HFTokenizer(p)
+        import logging
+        logging.getLogger(__name__).warning(
+            "checkpoint dir %s has no tokenizer.json — falling back to the "
+            "259-id byte tokenizer, which will garble a real vocabulary",
+            checkpoint_dir)
     return ByteTokenizer()

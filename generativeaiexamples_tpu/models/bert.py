@@ -1,0 +1,270 @@
+"""BERT-family encoder — bi-encoder embeddings + cross-encoder reranking.
+
+The in-tree replacement for the models inside the reference's NeMo Retriever
+NIM containers: the `nv-embedqa-e5-v5` passage/query embedder
+(ref: RAG/examples/local_deploy/docker-compose-nim-ms.yaml:30-56, client
+utils.py:407-446) and the `nv-rerankqa-mistral-4b-v3` cross-encoder reranker
+(ref: docker-compose-nim-ms.yaml:58-81, client utils.py:448-471).
+
+Architecture: standard pre-LN-free BERT encoder (post-LN, learned positions,
+GELU) so HF `BertModel` checkpoints (e5-class bi-encoders are BERT-backboned)
+load directly; parity-tested against transformers like the Llama decoder.
+
+TPU-first shape: layers stacked + `lax.scan`; bidirectional attention is one
+fused einsum per block (no flash needed at e5 sequence lengths — 512 tokens
+fits VMEM-friendly tiles); logical sharding axes match the decoder so the
+same mesh rules apply. Pooling variants: mean (e5 convention), CLS.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    hidden_dim: int = 3072
+    max_positions: int = 512
+    type_vocab_size: int = 2
+    norm_eps: float = 1e-12
+    dtype: str = "float32"
+    pooling: str = "mean"  # "mean" (e5) | "cls" (rerank head input)
+
+    @staticmethod
+    def e5_base() -> "BertConfig":
+        return BertConfig()
+
+    @staticmethod
+    def tiny(vocab_size: int = 300) -> "BertConfig":
+        return BertConfig(vocab_size=vocab_size, dim=32, n_layers=2, n_heads=2,
+                          hidden_dim=64, max_positions=128)
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def jdtype(self) -> jnp.dtype:
+        return jnp.dtype(self.dtype)
+
+
+def init_params(rng: jax.Array, cfg: BertConfig,
+                with_rank_head: bool = False) -> Params:
+    L, D, F = cfg.n_layers, cfg.dim, cfg.hidden_dim
+    keys = jax.random.split(rng, 12)
+    dt = cfg.jdtype
+
+    def normal(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(dt)
+
+    params: Params = {
+        "tok_embed": normal(keys[0], (cfg.vocab_size, D), D),
+        "pos_embed": normal(keys[1], (cfg.max_positions, D), D),
+        "type_embed": normal(keys[2], (cfg.type_vocab_size, D), D),
+        "embed_norm": {"scale": jnp.ones((D,), dt), "bias": jnp.zeros((D,), dt)},
+        "layers": {
+            "wq": normal(keys[3], (L, D, D), D),
+            "bq": jnp.zeros((L, D), dt),
+            "wk": normal(keys[4], (L, D, D), D),
+            "bk": jnp.zeros((L, D), dt),
+            "wv": normal(keys[5], (L, D, D), D),
+            "bv": jnp.zeros((L, D), dt),
+            "wo": normal(keys[6], (L, D, D), D),
+            "bo": jnp.zeros((L, D), dt),
+            "attn_norm": {"scale": jnp.ones((L, D), dt), "bias": jnp.zeros((L, D), dt)},
+            "w_up": normal(keys[7], (L, D, F), D),
+            "b_up": jnp.zeros((L, F), dt),
+            "w_down": normal(keys[8], (L, F, D), F),
+            "b_down": jnp.zeros((L, D), dt),
+            "mlp_norm": {"scale": jnp.ones((L, D), dt), "bias": jnp.zeros((L, D), dt)},
+        },
+    }
+    if with_rank_head:
+        # cross-encoder relevance head on pooled output → scalar score
+        params["rank_head"] = {"w": normal(keys[9], (D, 1), D),
+                               "b": jnp.zeros((1,), dt)}
+    return params
+
+
+def logical_axes(cfg: BertConfig, with_rank_head: bool = False) -> Params:
+    def norm_ax(layered: bool):
+        lead = (None,) if layered else ()
+        return {"scale": lead + ("embed",), "bias": lead + ("embed",)}
+
+    ax: Params = {
+        "tok_embed": ("vocab_table", "embed_table"),
+        "pos_embed": (None, "embed_table"),
+        "type_embed": (None, "embed_table"),
+        "embed_norm": norm_ax(False),
+        "layers": {
+            "wq": (None, "embed", "heads"), "bq": (None, "heads"),
+            "wk": (None, "embed", "heads"), "bk": (None, "heads"),
+            "wv": (None, "embed", "heads"), "bv": (None, "heads"),
+            "wo": (None, "heads", "embed"), "bo": (None, "embed"),
+            "attn_norm": norm_ax(True),
+            "w_up": (None, "embed", "mlp"), "b_up": (None, "mlp"),
+            "w_down": (None, "mlp", "embed"), "b_down": (None, "embed"),
+            "mlp_norm": norm_ax(True),
+        },
+    }
+    if with_rank_head:
+        ax["rank_head"] = {"w": ("embed", None), "b": (None,)}
+    return ax
+
+
+def _layer_norm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
+                eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(axis=-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(axis=-1, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (out * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _gelu(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.nn.gelu(x.astype(jnp.float32), approximate=False).astype(x.dtype)
+
+
+def encode(params: Params, cfg: BertConfig, tokens: jnp.ndarray,
+           attn_mask: Optional[jnp.ndarray] = None,
+           token_types: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """tokens (B, S) → contextual embeddings (B, S, D)."""
+    B, S = tokens.shape
+    if attn_mask is None:
+        attn_mask = jnp.ones((B, S), bool)
+    if token_types is None:
+        token_types = jnp.zeros((B, S), jnp.int32)
+    h = (params["tok_embed"][tokens]
+         + params["pos_embed"][jnp.arange(S)][None]
+         + params["type_embed"][token_types]).astype(cfg.jdtype)
+    h = _layer_norm(h, params["embed_norm"]["scale"], params["embed_norm"]["bias"],
+                    cfg.norm_eps)
+    H, HD = cfg.n_heads, cfg.head_dim
+    mask = attn_mask[:, None, None, :]  # (B, 1, 1, S)
+    scale = 1.0 / math.sqrt(HD)
+
+    def body(h, layer):
+        q = (h @ layer["wq"] + layer["bq"]).reshape(B, S, H, HD)
+        k = (h @ layer["wk"] + layer["bk"]).reshape(B, S, H, HD)
+        v = (h @ layer["wv"] + layer["bv"]).reshape(B, S, H, HD)
+        scores = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * scale
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bhst,bthd->bshd", probs, v.astype(jnp.float32))
+        ctx = ctx.reshape(B, S, H * HD).astype(h.dtype)
+        h = _layer_norm(h + ctx @ layer["wo"] + layer["bo"],
+                        layer["attn_norm"]["scale"], layer["attn_norm"]["bias"],
+                        cfg.norm_eps)
+        up = _gelu(h @ layer["w_up"] + layer["b_up"])
+        h = _layer_norm(h + up @ layer["w_down"] + layer["b_down"],
+                        layer["mlp_norm"]["scale"], layer["mlp_norm"]["bias"],
+                        cfg.norm_eps)
+        return h, None
+
+    h, _ = jax.lax.scan(body, h, params["layers"])
+    return h
+
+
+def embed(params: Params, cfg: BertConfig, tokens: jnp.ndarray,
+          attn_mask: Optional[jnp.ndarray] = None,
+          normalize: bool = True) -> jnp.ndarray:
+    """Sentence embeddings (B, D): masked-mean pooling (e5) or CLS."""
+    B, S = tokens.shape
+    if attn_mask is None:
+        attn_mask = jnp.ones((B, S), bool)
+    h = encode(params, cfg, tokens, attn_mask)
+    if cfg.pooling == "cls":
+        pooled = h[:, 0]
+    else:
+        m = attn_mask[..., None].astype(jnp.float32)
+        pooled = (h.astype(jnp.float32) * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
+    if normalize:
+        pooled = pooled / jnp.linalg.norm(pooled, axis=-1, keepdims=True).clip(1e-9)
+    return pooled.astype(jnp.float32)
+
+
+def rank_score(params: Params, cfg: BertConfig, tokens: jnp.ndarray,
+               attn_mask: Optional[jnp.ndarray] = None,
+               token_types: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Cross-encoder relevance: (query ⊕ passage) pairs (B, S) → scores (B,).
+
+    Pairs are packed as [CLS] query [SEP] passage [SEP] with token_type 1 on
+    the passage segment (BERT pair convention); score = rank_head(CLS)."""
+    h = encode(params, cfg, tokens, attn_mask, token_types)
+    cls = h[:, 0].astype(jnp.float32)
+    head = params["rank_head"]
+    return (cls @ head["w"].astype(jnp.float32) + head["b"])[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# HuggingFace import (BertModel state_dict)
+# ---------------------------------------------------------------------------
+
+def params_from_hf(state_dict: Dict[str, Any], cfg: BertConfig,
+                   prefix: str = "") -> Params:
+    """Map HF `BertModel.state_dict()` into this layout (parity tests + local
+    e5 checkpoints). `prefix` handles nesting (e.g. 'bert.')."""
+    import numpy as np
+
+    def t(name):
+        w = state_dict[prefix + name]
+        arr = w.detach().cpu().numpy() if hasattr(w, "detach") else np.asarray(w)
+        return jnp.asarray(arr, cfg.jdtype)
+
+    def lin(name):
+        return t(name).T
+
+    L = cfg.n_layers
+    stacks: Dict[str, list] = {k: [] for k in (
+        "wq", "bq", "wk", "bk", "wv", "bv", "wo", "bo",
+        "attn_scale", "attn_bias", "w_up", "b_up", "w_down", "b_down",
+        "mlp_scale", "mlp_bias")}
+    for i in range(L):
+        p = f"encoder.layer.{i}."
+        stacks["wq"].append(lin(p + "attention.self.query.weight"))
+        stacks["bq"].append(t(p + "attention.self.query.bias"))
+        stacks["wk"].append(lin(p + "attention.self.key.weight"))
+        stacks["bk"].append(t(p + "attention.self.key.bias"))
+        stacks["wv"].append(lin(p + "attention.self.value.weight"))
+        stacks["bv"].append(t(p + "attention.self.value.bias"))
+        stacks["wo"].append(lin(p + "attention.output.dense.weight"))
+        stacks["bo"].append(t(p + "attention.output.dense.bias"))
+        stacks["attn_scale"].append(t(p + "attention.output.LayerNorm.weight"))
+        stacks["attn_bias"].append(t(p + "attention.output.LayerNorm.bias"))
+        stacks["w_up"].append(lin(p + "intermediate.dense.weight"))
+        stacks["b_up"].append(t(p + "intermediate.dense.bias"))
+        stacks["w_down"].append(lin(p + "output.dense.weight"))
+        stacks["b_down"].append(t(p + "output.dense.bias"))
+        stacks["mlp_scale"].append(t(p + "output.LayerNorm.weight"))
+        stacks["mlp_bias"].append(t(p + "output.LayerNorm.bias"))
+
+    stack = lambda k: jnp.stack(stacks[k])
+    return {
+        "tok_embed": t("embeddings.word_embeddings.weight"),
+        "pos_embed": t("embeddings.position_embeddings.weight"),
+        "type_embed": t("embeddings.token_type_embeddings.weight"),
+        "embed_norm": {"scale": t("embeddings.LayerNorm.weight"),
+                       "bias": t("embeddings.LayerNorm.bias")},
+        "layers": {
+            "wq": stack("wq"), "bq": stack("bq"),
+            "wk": stack("wk"), "bk": stack("bk"),
+            "wv": stack("wv"), "bv": stack("bv"),
+            "wo": stack("wo"), "bo": stack("bo"),
+            "attn_norm": {"scale": stack("attn_scale"), "bias": stack("attn_bias")},
+            "w_up": stack("w_up"), "b_up": stack("b_up"),
+            "w_down": stack("w_down"), "b_down": stack("b_down"),
+            "mlp_norm": {"scale": stack("mlp_scale"), "bias": stack("mlp_bias")},
+        },
+    }

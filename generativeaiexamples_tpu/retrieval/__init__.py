@@ -1,0 +1,13 @@
+"""Retrieval: on-device vector search, BM25, text splitting, doc store.
+
+Replaces the reference's storage layer L2 (Milvus-GPU / pgvector / FAISS,
+ref docker-compose-vectordb.yaml; client factories utils.py:220-332) with an
+in-process store whose similarity search is a jitted TPU matmul — embeddings
+at e5 scale make brute-force over millions of vectors a single MXU-friendly
+GEMM, with an IVF mode mirroring the GPU_IVF_FLAT config knobs
+(configuration.py:42-44).
+"""
+
+from generativeaiexamples_tpu.retrieval.store import Document, VectorStore  # noqa: F401
+from generativeaiexamples_tpu.retrieval.text_splitter import TokenTextSplitter  # noqa: F401
+from generativeaiexamples_tpu.retrieval.bm25 import BM25Index  # noqa: F401

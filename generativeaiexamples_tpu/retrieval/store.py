@@ -1,0 +1,198 @@
+"""In-process vector store with TPU matmul search (exact + IVF).
+
+API parity with the vector-store operations the chain server exercises
+(ref: utils.py — create_vectorstore_langchain:288, get_docs_vectorstore:492,
+del_docs_vectorstore:532; search with top-k + score threshold,
+basic_rag/langchain/chains.py:156-167): add / search / list-sources /
+delete-by-source, plus collection semantics.
+
+Design: vectors live in a device-resident matrix grown in power-of-two
+blocks (static shapes → one compiled search kernel per capacity step).
+Exact search = one GEMM + top-k; IVF mode (`GPU_IVF_FLAT` parity,
+configuration.py:42-44) clusters with on-device k-means and probes
+``nprobe`` cells. Cosine scores in [−1, 1] are mapped to the [0, 1] range
+the reference's score_threshold=0.25 default expects.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class Document:
+    content: str
+    metadata: Dict[str, object] = field(default_factory=dict)
+    doc_id: str = field(default_factory=lambda: uuid.uuid4().hex)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _topk_scores(matrix: jnp.ndarray, query: jnp.ndarray, valid: jnp.ndarray,
+                 k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """scores = matrix @ query, invalid rows masked; returns (vals, idx)."""
+    scores = matrix @ query  # (N,)
+    scores = jnp.where(valid, scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+@partial(jax.jit, static_argnames=("nprobe", "k"))
+def _ivf_search(matrix: jnp.ndarray, centroids: jnp.ndarray,
+                assignments: jnp.ndarray, valid: jnp.ndarray,
+                query: jnp.ndarray, nprobe: int, k: int):
+    cell_scores = centroids @ query                      # (nlist,)
+    probe = jax.lax.top_k(cell_scores, nprobe)[1]        # (nprobe,)
+    in_probe = (assignments[:, None] == probe[None, :]).any(axis=1)
+    scores = matrix @ query
+    scores = jnp.where(valid & in_probe, scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+class VectorStore:
+    """One named collection (ref collection_name semantics, utils.py:240)."""
+
+    def __init__(self, dim: int, index_type: str = "exact", nlist: int = 64,
+                 nprobe: int = 16, name: str = "default") -> None:
+        self.dim = dim
+        self.name = name
+        self.index_type = index_type
+        self.nlist = nlist
+        self.nprobe = min(nprobe, nlist)
+        self._lock = threading.Lock()
+        self._docs: List[Optional[Document]] = []
+        self._capacity = 0
+        self._matrix: Optional[jnp.ndarray] = None   # (cap, dim) on device
+        self._valid_host = np.zeros((0,), bool)
+        self._centroids: Optional[jnp.ndarray] = None
+        self._assignments: Optional[jnp.ndarray] = None
+        self._ivf_dirty = True
+
+    # ------------------------------------------------------------------ add
+
+    def add(self, docs: Sequence[Document], embeddings: np.ndarray) -> List[str]:
+        if len(docs) != len(embeddings):
+            raise ValueError("docs/embeddings length mismatch")
+        with self._lock:
+            n_old = len(self._docs)
+            n_new = n_old + len(docs)
+            if n_new > self._capacity:
+                cap = max(256, self._capacity)
+                while cap < n_new:
+                    cap *= 2
+                new_matrix = np.zeros((cap, self.dim), np.float32)
+                if self._matrix is not None:
+                    new_matrix[:n_old] = np.asarray(self._matrix)[:n_old]
+                self._capacity = cap
+                self._matrix = jnp.asarray(new_matrix)
+                self._valid_host = np.resize(self._valid_host, cap)
+                self._valid_host[n_old:] = False
+            emb = np.asarray(embeddings, np.float32)
+            emb = emb / np.clip(np.linalg.norm(emb, axis=1, keepdims=True), 1e-9, None)
+            self._matrix = jax.lax.dynamic_update_slice(
+                self._matrix, jnp.asarray(emb), (n_old, 0))
+            self._docs.extend(docs)
+            self._valid_host[n_old:n_new] = True
+            self._ivf_dirty = True
+            return [d.doc_id for d in docs]
+
+    # --------------------------------------------------------------- search
+
+    def search(self, query_embedding: np.ndarray, top_k: int = 4,
+               score_threshold: float = 0.0) -> List[Tuple[Document, float]]:
+        with self._lock:
+            if not self._docs or self._matrix is None:
+                return []
+            q = jnp.asarray(np.asarray(query_embedding, np.float32))
+            q = q / jnp.linalg.norm(q).clip(1e-9)
+            valid = jnp.asarray(self._valid_host)
+            k = min(top_k, self._capacity)
+            if self.index_type == "ivf" and len(self._docs) > self.nlist * 4:
+                self._maybe_build_ivf()
+                vals, idx = _ivf_search(self._matrix, self._centroids,
+                                        self._assignments, valid, q,
+                                        self.nprobe, k)
+            else:
+                vals, idx = _topk_scores(self._matrix, q, valid, k)
+        vals = np.asarray(vals)
+        idx = np.asarray(idx)
+        out: List[Tuple[Document, float]] = []
+        for score, i in zip(vals, idx):
+            if not np.isfinite(score):
+                continue
+            doc = self._docs[int(i)]
+            if doc is None:
+                continue
+            relevance = (float(score) + 1.0) / 2.0  # cosine → [0,1]
+            if relevance >= score_threshold:
+                out.append((doc, relevance))
+        return out
+
+    # ------------------------------------------------------------------ IVF
+
+    def _maybe_build_ivf(self, iters: int = 8) -> None:
+        """On-device mini k-means over the current vectors (caller holds lock)."""
+        if not self._ivf_dirty and self._centroids is not None:
+            return
+        data = np.asarray(self._matrix)[self._valid_host[: self._capacity]]
+        rng = np.random.default_rng(0)
+        seeds = data[rng.choice(len(data), self.nlist, replace=len(data) < self.nlist)]
+        centroids = jnp.asarray(seeds)
+        mat = jnp.asarray(data)
+
+        @jax.jit
+        def step(c):
+            assign = jnp.argmax(mat @ c.T, axis=1)
+            onehot = jax.nn.one_hot(assign, self.nlist, dtype=jnp.float32)
+            sums = onehot.T @ mat
+            counts = onehot.sum(axis=0)[:, None]
+            new_c = jnp.where(counts > 0, sums / jnp.maximum(counts, 1.0), c)
+            norm = jnp.linalg.norm(new_c, axis=1, keepdims=True).clip(1e-9)
+            return new_c / norm
+
+        for _ in range(iters):
+            centroids = step(centroids)
+        full_assign = np.full((self._capacity,), -1, np.int32)
+        assign = np.asarray(jnp.argmax(mat @ centroids.T, axis=1))
+        full_assign[np.flatnonzero(self._valid_host[: self._capacity])] = assign
+        self._centroids = centroids
+        self._assignments = jnp.asarray(full_assign)
+        self._ivf_dirty = False
+
+    # ------------------------------------------------------------ documents
+
+    def list_sources(self) -> List[str]:
+        """Distinct source filenames (ref get_docs_vectorstore_langchain,
+        utils.py:492-530 returns uploaded file names)."""
+        with self._lock:
+            seen = []
+            for d in self._docs:
+                if d is None:
+                    continue
+                src = str(d.metadata.get("source", ""))
+                if src and src not in seen:
+                    seen.append(src)
+            return seen
+
+    def delete_by_source(self, sources: Sequence[str]) -> int:
+        """Remove all chunks from the named source files (ref
+        del_docs_vectorstore_langchain, utils.py:532-560)."""
+        targets = set(sources)
+        removed = 0
+        with self._lock:
+            for i, d in enumerate(self._docs):
+                if d is not None and str(d.metadata.get("source", "")) in targets:
+                    self._docs[i] = None
+                    self._valid_host[i] = False
+                    removed += 1
+            self._ivf_dirty = True
+        return removed
+
+    def __len__(self) -> int:
+        return sum(1 for d in self._docs if d is not None)

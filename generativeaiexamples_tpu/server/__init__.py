@@ -1,0 +1,10 @@
+"""Chain server: REST + SSE orchestration API with pluggable example chains.
+
+The TPU stack's L6 (ref: RAG/src/chain_server/server.py): same endpoint set
+(/health, /generate, /search, /documents GET/POST/DELETE) and SSE chunk
+contract, rebuilt on aiohttp with an explicit plugin registry instead of
+os.walk duck-typing (ref server.py:203-238).
+"""
+
+from generativeaiexamples_tpu.server.base import BaseExample  # noqa: F401
+from generativeaiexamples_tpu.server.registry import get_example, register_example  # noqa: F401

@@ -1,0 +1,38 @@
+"""CLI entry: run the chain server.
+
+    python -m generativeaiexamples_tpu.server [--example basic_rag] [--port 8081] [--tiny]
+
+One server binary, example selected by flag or ``EXAMPLE`` env — compose
+parity with the reference's one-image-many-examples pattern
+(ref: chain_server/Dockerfile:42-48, EXAMPLE_PATH).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--example", default=None, help="chain to serve")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8081)
+    parser.add_argument("--tiny", action="store_true",
+                        help="force the tiny deterministic model (tests/dev)")
+    args = parser.parse_args()
+    logging.basicConfig(level=os.environ.get("LOGLEVEL", "INFO").upper())
+
+    if args.tiny:
+        os.environ.pop("APP_ENGINE_CHECKPOINT_DIR", None)
+
+    from generativeaiexamples_tpu.server.api import run_server
+    from generativeaiexamples_tpu.server.registry import get_example
+
+    example = get_example(args.example)
+    run_server(example, host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,216 @@
+"""Chain server REST + SSE API.
+
+Endpoint-for-endpoint parity with the reference chain server
+(ref: RAG/src/chain_server/server.py — /health:249, /generate:313,
+/search:418 (as "/search" POST:407), /documents GET:441 POST:270 DELETE:467),
+including:
+
+  * request sanitization with bleach on user-controlled strings
+    (ref server.py:68-80, 120-137);
+  * the SSE chunk contract: ``data: {ChainResponse}\n\n`` frames with
+    id/choices/message/finish_reason, closed by a finish chunk and [DONE]
+    (ref ChainResponse server.py:148-170, response_generator:350-376);
+  * generation error → canned SSE message instead of a broken stream
+    (ref Milvus error path server.py:380-392);
+  * max_tokens capped at 1024, message length capped
+    (ref server.py:61-66, 104-110).
+
+Built on aiohttp; generation runs on an executor thread because chains yield
+from the blocking scheduler queue.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import queue as queue_mod
+import time
+import uuid
+from typing import Any, Dict, Iterator, Optional
+
+import bleach
+from aiohttp import web
+
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.core.tracing import instrumentation_wrapper
+from generativeaiexamples_tpu.server.base import BaseExample
+from generativeaiexamples_tpu.server.common import (
+    MAX_TOKENS_CAP, health_handler, metrics_handler,
+)
+
+logger = logging.getLogger(__name__)
+
+MAX_CONTENT_CHARS = 131072   # ref server.py:61-66
+UPLOAD_DIR = os.environ.get("UPLOAD_DIR", "/tmp/gaie-tpu-uploads")
+_SENTINEL = object()
+
+
+def _sanitize(text: str) -> str:
+    return bleach.clean(text[:MAX_CONTENT_CHARS], strip=True)
+
+
+def _chain_chunk(rid: str, content: str, finish_reason: Optional[str] = None) -> str:
+    """ChainResponse-shaped SSE chunk (ref server.py:148-170)."""
+    return json.dumps({
+        "id": rid,
+        "choices": [{"index": 0,
+                     "message": {"role": "assistant", "content": content},
+                     "finish_reason": finish_reason}],
+    })
+
+
+class ChainServer:
+    def __init__(self, example: BaseExample) -> None:
+        self.example = example
+        self.app = web.Application(client_max_size=128 * 1024 * 1024)
+        self.app.add_routes([
+            web.get("/health", health_handler),
+            web.get("/metrics", metrics_handler),
+            web.post("/generate", self.generate),
+            web.post("/search", self.search),
+            web.get("/documents", self.get_documents),
+            web.post("/documents", self.upload_document),
+            web.delete("/documents", self.delete_document),
+        ])
+
+    # ------------------------------------------------------------ generate
+
+    @instrumentation_wrapper
+    async def generate(self, request: web.Request) -> web.StreamResponse:
+        t_start = time.perf_counter()
+        body = await request.json()
+        messages = body.get("messages", [])
+        if not isinstance(messages, list) or not messages:
+            raise web.HTTPUnprocessableEntity(text=json.dumps(
+                {"error": "messages must be a non-empty list"}))
+        history = [{"role": str(m.get("role", "user")),
+                    "content": _sanitize(str(m.get("content", "")))}
+                   for m in messages]
+        # last user message is the query (ref server.py:327-338)
+        query = history.pop()["content"]
+        use_kb = bool(body.get("use_knowledge_base", True))
+        settings: Dict[str, Any] = {
+            "temperature": float(body.get("temperature") or 0.2),
+            "top_p": float(body.get("top_p") or 0.7),
+            "max_tokens": min(int(body.get("max_tokens") or 256), MAX_TOKENS_CAP),
+        }
+        REGISTRY.counter("generate_requests").inc()
+        rid = uuid.uuid4().hex
+
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        await resp.prepare(request)
+
+        loop = asyncio.get_running_loop()
+        q: "queue_mod.Queue" = queue_mod.Queue()
+
+        def producer() -> None:
+            try:
+                chain = (self.example.rag_chain if use_kb else self.example.llm_chain)
+                for delta in chain(query, history, **settings):
+                    q.put(delta)
+            except Exception as exc:  # canned error message (ref :380-392)
+                logger.exception("generation failed")
+                REGISTRY.counter("generate_errors").inc()
+                q.put("Error from chain server. Please check chain-server logs "
+                      "for more details.")
+            finally:
+                q.put(_SENTINEL)
+
+        loop.run_in_executor(None, producer)
+        first = True
+        while True:
+            item = await loop.run_in_executor(None, q.get)
+            if item is _SENTINEL:
+                break
+            if first:
+                REGISTRY.histogram("e2e_ttft_s").observe(time.perf_counter() - t_start)
+                first = False
+            await resp.write(f"data: {_chain_chunk(rid, item)}\n\n".encode())
+        await resp.write(f"data: {_chain_chunk(rid, '', 'stop')}\n\n".encode())
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        REGISTRY.histogram("e2e_latency_s").observe(time.perf_counter() - t_start)
+        return resp
+
+    # -------------------------------------------------------------- search
+
+    @instrumentation_wrapper
+    async def search(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        query = _sanitize(str(body.get("query", "")))
+        top_k = int(body.get("top_k", 4))
+        if not query:
+            raise web.HTTPUnprocessableEntity(text=json.dumps(
+                {"error": "query required"}))
+        loop = asyncio.get_running_loop()
+        try:
+            chunks = await loop.run_in_executor(
+                None, lambda: self.example.document_search(query, top_k))
+        except NotImplementedError:
+            raise web.HTTPNotImplemented(text=json.dumps(
+                {"error": "example does not support search"}))
+        return web.json_response({"chunks": [
+            {"content": c.get("content", ""), "filename": c.get("source", ""),
+             "score": c.get("score", 0.0)} for c in chunks]})
+
+    # ----------------------------------------------------------- documents
+
+    @instrumentation_wrapper
+    async def get_documents(self, request: web.Request) -> web.Response:
+        try:
+            docs = self.example.get_documents()
+        except NotImplementedError:
+            docs = []
+        return web.json_response({"documents": docs})
+
+    @instrumentation_wrapper
+    async def upload_document(self, request: web.Request) -> web.Response:
+        reader = await request.multipart()
+        field = await reader.next()
+        while field is not None and field.name != "file":
+            field = await reader.next()
+        if field is None:
+            raise web.HTTPUnprocessableEntity(text=json.dumps(
+                {"error": "multipart field 'file' required"}))
+        filename = os.path.basename(field.filename or f"upload-{uuid.uuid4().hex}")
+        os.makedirs(UPLOAD_DIR, exist_ok=True)
+        path = os.path.join(UPLOAD_DIR, filename)
+        with open(path, "wb") as fh:
+            while True:
+                chunk = await field.read_chunk()
+                if not chunk:
+                    break
+                fh.write(chunk)
+        loop = asyncio.get_running_loop()
+        try:
+            await loop.run_in_executor(
+                None, lambda: self.example.ingest_docs(path, filename))
+        except Exception as exc:
+            logger.exception("ingestion failed for %s", filename)
+            raise web.HTTPInternalServerError(text=json.dumps(
+                {"error": f"ingestion failed: {exc}"}))
+        REGISTRY.counter("documents_uploaded").inc()
+        return web.json_response({"message": "File uploaded successfully"})
+
+    @instrumentation_wrapper
+    async def delete_document(self, request: web.Request) -> web.Response:
+        filename = request.query.get("filename", "")
+        if not filename:
+            raise web.HTTPUnprocessableEntity(text=json.dumps(
+                {"error": "filename query param required"}))
+        try:
+            ok = self.example.delete_documents([filename])
+        except NotImplementedError:
+            ok = False
+        return web.json_response({"deleted": bool(ok)})
+
+
+def run_server(example: BaseExample, host: str = "0.0.0.0",
+               port: int = 8081) -> None:
+    server = ChainServer(example)
+    web.run_app(server.app, host=host, port=port, print=None)

@@ -1,0 +1,75 @@
+"""Shared HTTP plumbing for the model server and the chain server.
+
+One copy of the generation cap, the health/metrics handlers (compose
+healthcheck parity, ref docker-compose-nim-ms.yaml:23-28 / server.py:249),
+and the SSE framing + per-request drain thread, so the two servers cannot
+drift apart.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import AsyncIterator, Optional
+
+from aiohttp import web
+
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+
+MAX_TOKENS_CAP = 1024  # ref: RAG/src/chain_server/server.py:104-110
+
+
+async def health_handler(request: web.Request) -> web.Response:
+    return web.json_response({"message": "Service is up."})
+
+
+async def metrics_handler(request: web.Request) -> web.Response:
+    return web.json_response(REGISTRY.snapshot())
+
+
+async def sse_write(resp: web.StreamResponse, payload: str) -> None:
+    await resp.write(f"data: {payload}\n\n".encode())
+
+
+async def sse_done(resp: web.StreamResponse) -> None:
+    await resp.write(b"data: [DONE]\n\n")
+    await resp.write_eof()
+
+
+class StreamDrain:
+    """Bridge a blocking delta iterator onto the event loop.
+
+    One dedicated reader thread per request pushes deltas into an
+    asyncio.Queue via call_soon_threadsafe — no executor-pool round trip per
+    token, and slow consumers can't starve other requests' streams.
+    """
+
+    _DONE = object()
+
+    def __init__(self, iterator) -> None:
+        self._iterator = iterator
+        self._loop = asyncio.get_running_loop()
+        self._queue: "asyncio.Queue" = asyncio.Queue()
+        self._thread = threading.Thread(target=self._pump, daemon=True)
+        self._thread.start()
+
+    def _pump(self) -> None:
+        try:
+            for delta in self._iterator:
+                self._loop.call_soon_threadsafe(self._queue.put_nowait, delta)
+        finally:
+            self._loop.call_soon_threadsafe(self._queue.put_nowait, self._DONE)
+
+    async def __aiter__(self) -> AsyncIterator[str]:
+        while True:
+            item = await self._queue.get()
+            if item is self._DONE:
+                return
+            yield item
+
+    async def join_text(self) -> str:
+        parts = []
+        async for delta in self:
+            parts.append(delta)
+        return "".join(parts)

@@ -1,0 +1,53 @@
+"""Explicit example registry — replaces os.walk duck-typing discovery.
+
+The reference discovers its chain by walking `EXAMPLE_PATH` and duck-typing
+any class with {ingest_docs, llm_chain, rag_chain} (ref: server.py:203-238).
+In-tree chains make that indirection unnecessary: examples register by name,
+the served one is chosen by the ``EXAMPLE`` env var (compose parity with
+`EXAMPLE_PATH`, ref basic_rag/langchain/docker-compose.yaml:20-23).
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import os
+from typing import Callable, Dict, Optional
+
+from generativeaiexamples_tpu.server.base import BaseExample
+
+logger = logging.getLogger(__name__)
+
+_REGISTRY: Dict[str, Callable[..., BaseExample]] = {}
+
+# name → module that registers it on import (lazy, so chains' deps load only
+# when selected)
+_KNOWN = {
+    "basic_rag": "generativeaiexamples_tpu.chains.basic_rag",
+    "multi_turn_rag": "generativeaiexamples_tpu.chains.multi_turn_rag",
+    "query_decomposition_rag": "generativeaiexamples_tpu.chains.query_decomposition",
+    "structured_data_rag": "generativeaiexamples_tpu.chains.structured_data",
+    "multimodal_rag": "generativeaiexamples_tpu.chains.multimodal",
+    "agentic_rag": "generativeaiexamples_tpu.chains.agentic_rag",
+}
+
+
+def register_example(name: str):
+    def wrap(factory: Callable[..., BaseExample]):
+        _REGISTRY[name] = factory
+        return factory
+    return wrap
+
+
+def get_example(name: Optional[str] = None, **kwargs) -> BaseExample:
+    """Instantiate the selected example (env ``EXAMPLE``, default basic_rag)."""
+    name = name or os.environ.get("EXAMPLE", "basic_rag")
+    if name not in _REGISTRY:
+        module = _KNOWN.get(name)
+        if module is None:
+            raise KeyError(f"unknown example {name!r}; known: {sorted(_KNOWN)}")
+        importlib.import_module(module)
+    if name not in _REGISTRY:
+        raise KeyError(f"module for {name!r} imported but did not register")
+    logger.info("serving example: %s", name)
+    return _REGISTRY[name](**kwargs)

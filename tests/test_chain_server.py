@@ -1,0 +1,164 @@
+"""End-to-end chain-server tests over real HTTP.
+
+Covers the full reference endpoint surface (SURVEY §1 L6): upload → list →
+search → generate (SSE contract) → delete, with the tiny deterministic model
+as the engine — the hostless integration test the reference never had
+(SURVEY §4 implication). The aiohttp app runs in a background thread on a
+real socket; tests speak plain HTTP.
+"""
+
+import asyncio
+import json
+import socket
+import threading
+
+import pytest
+import requests
+
+from generativeaiexamples_tpu.chains.context import set_context
+from generativeaiexamples_tpu.server.api import ChainServer
+from generativeaiexamples_tpu.server.registry import get_example
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _ServerThread:
+    def __init__(self, app, port: int) -> None:
+        self.app = app
+        self.port = port
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.started = threading.Event()
+
+    def _run(self) -> None:
+        from aiohttp import web
+
+        asyncio.set_event_loop(self.loop)
+        runner = web.AppRunner(self.app)
+        self.loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", self.port)
+        self.loop.run_until_complete(site.start())
+        self.started.set()
+        self.loop.run_forever()
+
+    def start(self) -> None:
+        self.thread.start()
+        assert self.started.wait(timeout=30)
+
+    def stop(self) -> None:
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=10)
+
+
+@pytest.fixture(scope="module")
+def base_url():
+    set_context(None)
+    example = get_example("basic_rag")
+    port = _free_port()
+    server = _ServerThread(ChainServer(example).app, port)
+    server.start()
+    yield f"http://127.0.0.1:{port}"
+    server.stop()
+    from generativeaiexamples_tpu.chains import llm_client
+    llm_client._default_scheduler().stop()
+    llm_client._default_scheduler.cache_clear()
+    set_context(None)
+
+
+def _parse_sse(resp) -> list:
+    chunks = []
+    for raw in resp.iter_lines():
+        line = raw.decode() if isinstance(raw, bytes) else raw
+        if not line.startswith("data: "):
+            continue
+        data = line[len("data: "):]
+        if data == "[DONE]":
+            break
+        chunks.append(json.loads(data))
+    return chunks
+
+
+def test_health(base_url):
+    resp = requests.get(f"{base_url}/health", timeout=10)
+    assert resp.status_code == 200
+    assert resp.json()["message"] == "Service is up."
+
+
+def test_upload_list_search_delete(base_url, tmp_path):
+    doc = tmp_path / "kb.txt"
+    doc.write_text("The TPU v5e has 16 GB of HBM per chip.\n\n"
+                   "Llamas are domesticated camelids from South America.")
+    with open(doc, "rb") as fh:
+        resp = requests.post(f"{base_url}/documents",
+                             files={"file": ("kb.txt", fh)}, timeout=60)
+    assert resp.status_code == 200, resp.text
+    assert "uploaded" in resp.json()["message"].lower()
+
+    resp = requests.get(f"{base_url}/documents", timeout=10)
+    assert resp.json()["documents"] == ["kb.txt"]
+
+    resp = requests.post(f"{base_url}/search",
+                         json={"query": "how much HBM", "top_k": 2}, timeout=60)
+    body = resp.json()
+    assert resp.status_code == 200
+    assert body["chunks"], "expected at least one hit"
+    assert body["chunks"][0]["filename"] == "kb.txt"
+    assert "score" in body["chunks"][0]
+
+    resp = requests.delete(f"{base_url}/documents",
+                           params={"filename": "kb.txt"}, timeout=10)
+    assert resp.json()["deleted"] is True
+    assert requests.get(f"{base_url}/documents", timeout=10).json()["documents"] == []
+
+
+def test_generate_sse_contract(base_url):
+    resp = requests.post(f"{base_url}/generate", json={
+        "messages": [{"role": "user", "content": "say something"}],
+        "use_knowledge_base": False,
+        "max_tokens": 8, "temperature": 0.0,
+    }, stream=True, timeout=120)
+    assert resp.status_code == 200
+    assert resp.headers["Content-Type"].startswith("text/event-stream")
+    chunks = _parse_sse(resp)
+    assert len(chunks) >= 1
+    for c in chunks:
+        assert c["choices"][0]["message"]["role"] == "assistant"
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+
+
+def test_generate_with_kb_uses_context(base_url, tmp_path):
+    doc = tmp_path / "facts.txt"
+    doc.write_text("zebras have stripes")
+    with open(doc, "rb") as fh:
+        requests.post(f"{base_url}/documents",
+                      files={"file": ("facts.txt", fh)}, timeout=60)
+    resp = requests.post(f"{base_url}/generate", json={
+        "messages": [{"role": "user", "content": "what do zebras have?"}],
+        "use_knowledge_base": True,
+        "max_tokens": 8, "temperature": 0.0,
+    }, stream=True, timeout=120)
+    chunks = _parse_sse(resp)
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+    requests.delete(f"{base_url}/documents", params={"filename": "facts.txt"},
+                    timeout=10)
+
+
+def test_generate_validation_errors(base_url):
+    assert requests.post(f"{base_url}/generate", json={"messages": []},
+                         timeout=10).status_code == 422
+    assert requests.post(f"{base_url}/search", json={"query": ""},
+                         timeout=10).status_code == 422
+    assert requests.delete(f"{base_url}/documents", timeout=10).status_code == 422
+
+
+def test_sanitization_strips_html(base_url):
+    resp = requests.post(f"{base_url}/generate", json={
+        "messages": [{"role": "user",
+                      "content": "<script>alert(1)</script>hello"}],
+        "use_knowledge_base": False, "max_tokens": 4, "temperature": 0.0,
+    }, stream=True, timeout=120)
+    assert _parse_sse(resp)

@@ -186,3 +186,13 @@ def test_scheduler_more_requests_than_slots(engine):
         assert isinstance(out, str)
     finally:
         sched.stop()
+
+
+def test_incremental_detokenizer_long_sequence_windowing():
+    """Windowed decode must emit exactly the full text over 100+ tokens."""
+    tok = ByteTokenizer()
+    text = ("hello wörld ⚡ " * 20).strip()
+    ids = tok.encode(text)
+    detok = IncrementalDetokenizer(tok)
+    emitted = "".join(detok.push(i) for i in ids) + detok.flush()
+    assert emitted == text
