@@ -61,7 +61,8 @@ class RemoteLLM:
                 if data.strip() == "[DONE]":
                     return
                 chunk = json.loads(data)
-                delta = chunk["choices"][0].get("delta", {})
+                choices = chunk.get("choices") or [{}]
+                delta = choices[0].get("delta", {})
                 content = delta.get("content")
                 if content:
                     yield content

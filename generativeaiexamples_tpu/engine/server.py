@@ -131,11 +131,14 @@ class ModelServer:
             await sse_write(resp, _chunk(self.model_name, rid, {"role": "assistant"}))
         async for delta in drain:
             await sse_write(resp, _chunk(self.model_name, rid, {"content": delta}))
-        # an engine failure mid-stream must not masquerade as a clean stop
+        # an engine failure mid-stream must not masquerade as a clean stop;
+        # the error rides inside a schema-shaped chunk so conforming clients
+        # (chunk["choices"][0]) keep parsing
         finish = "error" if req.error else "stop"
+        final = json.loads(_chunk(self.model_name, rid, {}, finish))
         if req.error:
-            await sse_write(resp, json.dumps({"error": req.error}))
-        await sse_write(resp, _chunk(self.model_name, rid, {}, finish))
+            final["error"] = req.error
+        await sse_write(resp, json.dumps(final))
         await sse_done(resp)
         return resp
 

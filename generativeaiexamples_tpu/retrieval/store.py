@@ -113,7 +113,11 @@ class VectorStore:
             q = q / jnp.linalg.norm(q).clip(1e-9)
             valid = jnp.asarray(self._valid_host)
             k = min(top_k, self._capacity)
-            if self.index_type == "ivf" and len(self._docs) > self.nlist * 4:
+            # gate on *live* rows (deleted entries stay as None placeholders);
+            # an all-deleted store must fall through to brute force rather
+            # than k-means over zero vectors
+            n_live = int(np.count_nonzero(self._valid_host[: self._capacity]))
+            if self.index_type == "ivf" and n_live > self.nlist * 4:
                 self._maybe_build_ivf()
                 vals, idx = _ivf_search(self._matrix, self._centroids,
                                         self._assignments, valid, q,

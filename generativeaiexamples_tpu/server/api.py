@@ -25,7 +25,6 @@ import asyncio
 import json
 import logging
 import os
-import queue as queue_mod
 import time
 import uuid
 from typing import Any, Dict, Iterator, Optional
@@ -37,14 +36,13 @@ from generativeaiexamples_tpu.core.metrics import REGISTRY
 from generativeaiexamples_tpu.core.tracing import instrumentation_wrapper
 from generativeaiexamples_tpu.server.base import BaseExample
 from generativeaiexamples_tpu.server.common import (
-    MAX_TOKENS_CAP, health_handler, metrics_handler,
+    MAX_TOKENS_CAP, StreamDrain, health_handler, metrics_handler,
 )
 
 logger = logging.getLogger(__name__)
 
 MAX_CONTENT_CHARS = 131072   # ref server.py:61-66
 UPLOAD_DIR = os.environ.get("UPLOAD_DIR", "/tmp/gaie-tpu-uploads")
-_SENTINEL = object()
 
 
 def _sanitize(text: str) -> str:
@@ -91,10 +89,14 @@ class ChainServer:
         # last user message is the query (ref server.py:327-338)
         query = history.pop()["content"]
         use_kb = bool(body.get("use_knowledge_base", True))
+        def setting(name, default, cast):
+            value = body.get(name)
+            return default if value is None else cast(value)
+
         settings: Dict[str, Any] = {
-            "temperature": float(body.get("temperature") or 0.2),
-            "top_p": float(body.get("top_p") or 0.7),
-            "max_tokens": min(int(body.get("max_tokens") or 256), MAX_TOKENS_CAP),
+            "temperature": setting("temperature", 0.2, float),
+            "top_p": setting("top_p", 0.7, float),
+            "max_tokens": min(setting("max_tokens", 256, int), MAX_TOKENS_CAP),
         }
         REGISTRY.counter("generate_requests").inc()
         rid = uuid.uuid4().hex
@@ -105,28 +107,18 @@ class ChainServer:
         })
         await resp.prepare(request)
 
-        loop = asyncio.get_running_loop()
-        q: "queue_mod.Queue" = queue_mod.Queue()
-
-        def producer() -> None:
+        def guarded():
             try:
                 chain = (self.example.rag_chain if use_kb else self.example.llm_chain)
-                for delta in chain(query, history, **settings):
-                    q.put(delta)
-            except Exception as exc:  # canned error message (ref :380-392)
+                yield from chain(query, history, **settings)
+            except Exception:  # canned error message (ref :380-392)
                 logger.exception("generation failed")
                 REGISTRY.counter("generate_errors").inc()
-                q.put("Error from chain server. Please check chain-server logs "
-                      "for more details.")
-            finally:
-                q.put(_SENTINEL)
+                yield ("Error from chain server. Please check chain-server "
+                       "logs for more details.")
 
-        loop.run_in_executor(None, producer)
         first = True
-        while True:
-            item = await loop.run_in_executor(None, q.get)
-            if item is _SENTINEL:
-                break
+        async for item in StreamDrain(guarded()):
             if first:
                 REGISTRY.histogram("e2e_ttft_s").observe(time.perf_counter() - t_start)
                 first = False

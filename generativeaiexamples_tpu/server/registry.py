@@ -46,7 +46,12 @@ def get_example(name: Optional[str] = None, **kwargs) -> BaseExample:
         module = _KNOWN.get(name)
         if module is None:
             raise KeyError(f"unknown example {name!r}; known: {sorted(_KNOWN)}")
-        importlib.import_module(module)
+        try:
+            importlib.import_module(module)
+        except ModuleNotFoundError as exc:
+            raise KeyError(
+                f"example {name!r} is not implemented yet "
+                f"(module {module} missing)") from exc
     if name not in _REGISTRY:
         raise KeyError(f"module for {name!r} imported but did not register")
     logger.info("serving example: %s", name)

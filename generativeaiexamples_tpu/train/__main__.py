@@ -1,0 +1,95 @@
+"""CLI fine-tuning driver: `python -m generativeaiexamples_tpu.train`.
+
+One-command replacement for the reference's notebook+container recipes
+(ref: finetuning/Gemma/README.md — pull nvcr nemo image, run lora.ipynb):
+
+    python -m generativeaiexamples_tpu.train \
+        --recipe lora_pubmedqa --data train.jsonl \
+        --init-checkpoint ckpts/base --checkpoint-dir runs/lora1 --merge
+
+Loads a recipe preset (train/recipes.py), streams jsonl SFT data, trains on
+the local mesh, and optionally writes merged serving-ready params (the
+reference's merge_lora_weights step, Gemma/lora.ipynb cell 48).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+
+import jax
+
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.train import checkpoints, data as data_lib, recipes
+from generativeaiexamples_tpu.train.trainer import Trainer
+
+log = logging.getLogger(__name__)
+
+MODEL_CONFIGS = {
+    "llama3-8b": llama.LlamaConfig.llama3_8b,
+    "llama3-70b": llama.LlamaConfig.llama3_70b,
+    "tiny": llama.LlamaConfig.tiny,
+}
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser("generativeaiexamples_tpu.train")
+    ap.add_argument("--recipe", default="lora_pubmedqa",
+                    choices=sorted(recipes.RECIPES))
+    ap.add_argument("--model", default="tiny", choices=sorted(MODEL_CONFIGS))
+    ap.add_argument("--data", required=True, help="jsonl with prompt/completion")
+    ap.add_argument("--tokenizer", default="", help="HF tokenizer dir (default: byte)")
+    ap.add_argument("--init-checkpoint", default="",
+                    help="orbax params dir (default: random init)")
+    ap.add_argument("--checkpoint-dir", default="")
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--max-steps", type=int, default=0, help="override recipe")
+    ap.add_argument("--merge", action="store_true",
+                    help="write merged serving params to <checkpoint-dir>/merged")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO, force=True)
+    model_cfg = MODEL_CONFIGS[args.model]()
+    tcfg = recipes.get_recipe(args.recipe)
+    overrides = {"checkpoint_dir": args.checkpoint_dir}
+    if args.max_steps:
+        overrides["max_steps"] = args.max_steps
+    tcfg = dataclasses.replace(tcfg, **overrides)
+
+    if args.init_checkpoint:
+        params = checkpoints.load_params(args.init_checkpoint, model_cfg)
+    else:
+        log.info("no --init-checkpoint: random init (%s)", args.model)
+        params = llama.init_params(jax.random.PRNGKey(0), model_cfg)
+
+    from generativeaiexamples_tpu.engine.tokenizer import get_tokenizer
+    tok = get_tokenizer(args.tokenizer)
+    examples = data_lib.load_jsonl(args.data)
+    log.info("loaded %d examples from %s", len(examples), args.data)
+    stream = data_lib.batches(
+        examples, tok.encode, batch_size=tcfg.global_batch_size,
+        seq_len=tcfg.seq_len, epochs=10_000)  # trainer stops at max_steps
+
+    trainer = Trainer(model_cfg, tcfg, params)
+    if args.resume and args.checkpoint_dir:
+        trainer.restore(args.checkpoint_dir)
+        log.info("resumed at step %d", trainer.step)
+
+    def on_step(step, m):
+        if step % tcfg.log_every == 0 or step == tcfg.max_steps:
+            log.info("step %d loss %.4f grad_norm %.3f tok/s/chip %.1f",
+                     step, m["loss"], m["grad_norm"],
+                     m["tokens_per_s_per_chip"])
+
+    final = trainer.fit(stream, on_step=on_step)
+    log.info("done at step %d: %s", trainer.step, final)
+
+    if args.merge and args.checkpoint_dir:
+        merged_dir = f"{args.checkpoint_dir}/merged"
+        checkpoints.save_params(merged_dir, trainer.merged_params())
+        log.info("merged serving params → %s", merged_dir)
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,7 @@ import orbax.checkpoint as ocp
 from generativeaiexamples_tpu.models import llama
 
 PARAMS_SUBDIR = "params"
+TRAIN_STATE_SUBDIR = "train_state"
 
 
 def save_params(directory: str, params: Any) -> None:
@@ -38,3 +39,31 @@ def load_params(directory: str, model_cfg: llama.LlamaConfig,
             lambda: llama.init_params(jax.random.PRNGKey(0), model_cfg))
     ckptr = ocp.StandardCheckpointer()
     return ckptr.restore(path, target)
+
+
+def save_train_state(directory: str, *, step: int, trainable: Any,
+                     opt_state: Any) -> None:
+    """Write trainer state (step + trainable params + optimizer state) for
+    resume — the orbax replacement for NeMo's `exp_manager` .nemo archives
+    (ref: finetuning/Gemma/lora.ipynb cell 30)."""
+    import jax.numpy as jnp
+
+    path = os.path.abspath(os.path.join(directory, TRAIN_STATE_SUBDIR))
+    tree = {"step": jnp.asarray(step, jnp.int32), "trainable": trainable,
+            "opt_state": opt_state}
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, tree, force=True)
+    ckptr.wait_until_finished()
+
+
+def load_train_state(directory: str, *, trainable: Any, opt_state: Any):
+    """Restore (step, trainable, opt_state); current values are the
+    shape/dtype/sharding template."""
+    import jax.numpy as jnp
+
+    path = os.path.abspath(os.path.join(directory, TRAIN_STATE_SUBDIR))
+    target = {"step": jnp.asarray(0, jnp.int32), "trainable": trainable,
+              "opt_state": opt_state}
+    ckptr = ocp.StandardCheckpointer()
+    restored = ckptr.restore(path, target)
+    return int(restored["step"]), restored["trainable"], restored["opt_state"]

@@ -1,0 +1,98 @@
+"""SFT data pipeline: jsonl prompt/completion → packed token batches.
+
+Replaces the reference's NeMo data prep (ref: finetuning/Gemma/lora.ipynb
+"Step 2: Prepare the data" — PubMedQA converted to
+`{"input": ..., "output": ...}` jsonl consumed by
+`megatron_gpt_finetuning_config`'s train_ds). Same on-disk contract
+(jsonl with input/output or prompt/completion keys); tokenization and
+batching are host-side Python feeding jit-shaped arrays:
+
+  * loss is masked over prompt tokens (train on completions only, the
+    SFT convention NeMo applies via `answer_only_loss`);
+  * fixed (batch, seq_len) shapes — right padding, one compiled train step;
+  * deterministic shuffling per epoch from a seed, so runs are replayable.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+Encode = Callable[[str], List[int]]
+
+
+@dataclass(frozen=True)
+class SFTExample:
+    prompt: str
+    completion: str
+
+
+def load_jsonl(path: str) -> List[SFTExample]:
+    """Accepts {"prompt","completion"} or NeMo-style {"input","output"} rows."""
+    out: List[SFTExample] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            prompt = row.get("prompt", row.get("input"))
+            completion = row.get("completion", row.get("output"))
+            if prompt is None or completion is None:
+                raise ValueError(f"row missing prompt/completion keys: {row.keys()}")
+            out.append(SFTExample(prompt=prompt, completion=completion))
+    return out
+
+
+def encode_example(ex: SFTExample, encode: Encode, bos_id: int | None,
+                   eos_id: int | None, max_len: int) -> Tuple[List[int], List[int]]:
+    """Token ids + loss mask (1 on completion tokens and EOS, 0 on prompt)."""
+    prompt_ids = ([bos_id] if bos_id is not None else []) + encode(ex.prompt)
+    comp_ids = encode(ex.completion) + ([eos_id] if eos_id is not None else [])
+    ids = (prompt_ids + comp_ids)[:max_len]
+    mask = ([0] * len(prompt_ids) + [1] * len(comp_ids))[:max_len]
+    return ids, mask
+
+
+@dataclass(frozen=True)
+class Batch:
+    """tokens/loss_mask: (B, S) int32/float32 host arrays (np, fed to jit)."""
+
+    tokens: np.ndarray
+    loss_mask: np.ndarray
+
+    @property
+    def supervised_tokens(self) -> int:
+        return int(self.loss_mask.sum())
+
+
+def batches(examples: Sequence[SFTExample], encode: Encode, *,
+            batch_size: int, seq_len: int, bos_id: int | None = None,
+            eos_id: int | None = None, epochs: int = 1,
+            seed: int = 0, drop_remainder: bool = True) -> Iterator[Batch]:
+    """Yield fixed-shape right-padded batches; shuffled each epoch."""
+    encoded = [encode_example(ex, encode, bos_id, eos_id, seq_len + 1)
+               for ex in examples]
+    rng = random.Random(seed)
+    order = list(range(len(encoded)))
+    for _ in range(epochs):
+        rng.shuffle(order)
+        for i in range(0, len(order), batch_size):
+            idx = order[i:i + batch_size]
+            if len(idx) < batch_size:
+                if drop_remainder:
+                    continue
+                while len(idx) < batch_size:  # wrap-fill from the remainder
+                    idx = idx + idx[: batch_size - len(idx)]
+            # +1: the train step shifts (predict t+1 from ≤t)
+            tokens = np.zeros((batch_size, seq_len + 1), np.int32)
+            mask = np.zeros((batch_size, seq_len + 1), np.float32)
+            for r, j in enumerate(idx):
+                ids, m = encoded[j]
+                tokens[r, :len(ids)] = ids
+                mask[r, :len(m)] = m
+            yield Batch(tokens=tokens, loss_mask=mask)

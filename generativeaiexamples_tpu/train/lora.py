@@ -1,0 +1,115 @@
+"""LoRA adapters for the stacked-layer Llama pytree.
+
+TPU-native replacement for the reference's NeMo LoRA path
+(ref: finetuning/Gemma/lora.ipynb cells 34-36 `model.add_adapter(
+LoraPEFTConfig(model_cfg))`, cell 48 merge via
+`scripts/nlp_language_modeling/merge_lora_weights/merge.py`). There the
+adapter lives inside Megatron modules and NCCL shards it; here it is a
+separate pytree threaded through `models.llama` (`_maybe_lora`), so:
+
+  * the base params stay frozen device buffers — the optimizer state covers
+    only the adapter (tiny), which is what makes LoRA cheap;
+  * serving merged vs unmerged is the same code path (`merge_adapters` folds
+    the low-rank product into the base weights for zero-overhead inference);
+  * adapters are stacked on a leading layer axis like the base params, so the
+    model's `lax.scan` slices them per layer, and sharding is the same
+    rule-table mechanism (`adapter_logical_axes`).
+
+Parameterization: the effective update is ``x @ a @ b`` with
+``a ~ N(0, 1/in) * (alpha/rank)`` and ``b = 0`` — the conventional
+(alpha/rank) scale is folded into ``a``'s init instead of multiplying the
+product every step (same function class; at init the product is zero either
+way, matching LoRA's identity-at-start property).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from generativeaiexamples_tpu.models import llama
+
+Params = Dict[str, Any]
+
+# target name → (in_dim, out_dim) extractors on LlamaConfig
+_TARGET_DIMS = {
+    "wq": lambda c: (c.dim, c.n_heads * c.head_dim),
+    "wk": lambda c: (c.dim, c.n_kv_heads * c.head_dim),
+    "wv": lambda c: (c.dim, c.n_kv_heads * c.head_dim),
+    "wo": lambda c: (c.n_heads * c.head_dim, c.dim),
+    "w_gate": lambda c: (c.dim, c.hidden_dim),
+    "w_up": lambda c: (c.dim, c.hidden_dim),
+    "w_down": lambda c: (c.hidden_dim, c.dim),
+}
+
+# logical axis names of each target's (in, out) dims, for sharding rules
+_TARGET_AXES = {
+    "wq": ("embed", "heads"),
+    "wk": ("embed", "kv_heads"),
+    "wv": ("embed", "kv_heads"),
+    "wo": ("heads", "embed"),
+    "w_gate": ("embed", "mlp"),
+    "w_up": ("embed", "mlp"),
+    "w_down": ("mlp", "embed"),
+}
+
+
+@dataclass(frozen=True)
+class LoraConfig:
+    """Adapter spec; defaults mirror common attention-only LoRA (the
+    reference's NeMo `LoraPEFTConfig` targets attention projections)."""
+
+    rank: int = 8
+    alpha: float = 16.0
+    targets: Tuple[str, ...] = ("wq", "wk", "wv", "wo")
+
+    def __post_init__(self):
+        unknown = set(self.targets) - set(_TARGET_DIMS)
+        if unknown:
+            raise ValueError(f"unknown LoRA targets {sorted(unknown)}; "
+                             f"valid: {sorted(_TARGET_DIMS)}")
+
+
+def init_adapters(rng: jax.Array, model_cfg: llama.LlamaConfig,
+                  cfg: LoraConfig, dtype: Any = jnp.float32) -> Params:
+    """Adapter pytree {target: {"a": (L, in, r), "b": (L, r, out)}}."""
+    L = model_cfg.n_layers
+    scale = cfg.alpha / cfg.rank
+    keys = jax.random.split(rng, len(cfg.targets))
+    adapters: Params = {}
+    for key, name in zip(keys, cfg.targets):
+        d_in, d_out = _TARGET_DIMS[name](model_cfg)
+        a = jax.random.normal(key, (L, d_in, cfg.rank), jnp.float32)
+        a = (a / math.sqrt(d_in) * scale).astype(dtype)
+        adapters[name] = {"a": a, "b": jnp.zeros((L, cfg.rank, d_out), dtype)}
+    return adapters
+
+
+def adapter_logical_axes(cfg: LoraConfig) -> Params:
+    """Logical annotations matching `init_adapters` (rank dim replicated)."""
+    ax: Params = {}
+    for name in cfg.targets:
+        in_ax, out_ax = _TARGET_AXES[name]
+        ax[name] = {"a": (None, in_ax, None), "b": (None, None, out_ax)}
+    return ax
+
+
+def merge_adapters(params: Params, adapters: Params) -> Params:
+    """Fold each low-rank product into the base weight: W' = W + a@b.
+
+    Parity with the reference's merge step (Gemma/lora.ipynb cell 48) —
+    the merged tree serves with zero adapter overhead.
+    """
+    merged_layers = dict(params["layers"])
+    for name, ab in adapters.items():
+        w = merged_layers[name]
+        delta = jnp.einsum("lir,lro->lio", ab["a"].astype(jnp.float32),
+                           ab["b"].astype(jnp.float32))
+        merged_layers[name] = (w.astype(jnp.float32) + delta).astype(w.dtype)
+    out = dict(params)
+    out["layers"] = merged_layers
+    return out

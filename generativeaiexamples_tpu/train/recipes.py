@@ -1,0 +1,73 @@
+"""Fine-tuning recipes: named presets matching the reference notebooks.
+
+The reference ships one notebook per recipe, each a hydra-config variation on
+the same NeMo/Megatron path (ref: finetuning/Gemma/lora.ipynb cells 26-28 —
+LoRA, mbs=1, gbs=8, bf16, max_steps 50; finetuning/Gemma/sft.ipynb —
+full-parameter SFT; finetuning/Codegemma/lora.ipynb;
+finetuning/StarCoder2/lora.ipynb; finetuning/NeMo/slm — small-LM pretrain+SFT).
+Here each recipe is a `TrainConfig` preset + a prompt formatter for its
+dataset shape; all run through the one `Trainer`.
+
+PubMedQA formatting mirrors the reference's data prep (Gemma/lora.ipynb
+"Step 2": question+context → long-answer jsonl).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from generativeaiexamples_tpu.train.data import SFTExample
+from generativeaiexamples_tpu.train.lora import LoraConfig
+from generativeaiexamples_tpu.train.trainer import TrainConfig
+
+
+def format_pubmedqa(row: Dict) -> SFTExample:
+    """{'QUESTION','CONTEXTS',...,'LONG_ANSWER'} → prompt/completion."""
+    contexts = "\n".join(row.get("CONTEXTS", []))
+    prompt = (f"Context: {contexts}\nQuestion: {row['QUESTION']}\n"
+              f"Answer: ")
+    return SFTExample(prompt=prompt, completion=row["LONG_ANSWER"])
+
+
+def format_alpaca(row: Dict) -> SFTExample:
+    """{'instruction','input','output'} instruction-tuning rows."""
+    inp = row.get("input", "")
+    prompt = (f"Instruction: {row['instruction']}\n"
+              + (f"Input: {inp}\n" if inp else "") + "Response: ")
+    return SFTExample(prompt=prompt, completion=row["output"])
+
+
+RECIPES: Dict[str, TrainConfig] = {
+    # Gemma/lora.ipynb cell 26-28: LoRA on attention, mbs 1 / gbs 8, 50 steps
+    "lora_pubmedqa": TrainConfig(
+        mode="lora", lora=LoraConfig(rank=8, alpha=16.0),
+        micro_batch_size=1, global_batch_size=8, max_steps=50,
+        learning_rate=1e-4, seq_len=1024),
+    # Gemma/sft.ipynb: full-parameter SFT (multi-chip FSDP)
+    "sft_full": TrainConfig(
+        mode="full", micro_batch_size=1, global_batch_size=8, max_steps=50,
+        learning_rate=5e-6, seq_len=1024),
+    # StarCoder2/lora.ipynb: code LoRA (longer sequences)
+    "lora_code": TrainConfig(
+        mode="lora", lora=LoraConfig(rank=16, alpha=32.0,
+                                     targets=("wq", "wk", "wv", "wo",
+                                              "w_gate", "w_up", "w_down")),
+        micro_batch_size=1, global_batch_size=8, max_steps=50,
+        learning_rate=2e-4, seq_len=2048),
+    # test/demo-scale recipe (the suite's fast path)
+    "demo": TrainConfig(
+        mode="lora", lora=LoraConfig(rank=4, alpha=8.0),
+        micro_batch_size=2, global_batch_size=4, max_steps=10,
+        warmup_steps=2, seq_len=64, log_every=1),
+}
+
+FORMATTERS: Dict[str, Callable[[Dict], SFTExample]] = {
+    "pubmedqa": format_pubmedqa,
+    "alpaca": format_alpaca,
+}
+
+
+def get_recipe(name: str) -> TrainConfig:
+    if name not in RECIPES:
+        raise KeyError(f"unknown recipe {name!r}; have {sorted(RECIPES)}")
+    return RECIPES[name]

@@ -1,0 +1,245 @@
+"""SFT / LoRA trainer: pjit DP/FSDP(+TP) over an ICI mesh.
+
+In-tree replacement for the reference's notebook-driven NeMo/Megatron path
+(ref: finetuning/Gemma/lora.ipynb cells 26-39 — TP/PP/micro/global batch
+knobs into `MegatronLMPPTrainerBuilder`, `MegatronGPTSFTModel.restore_from`,
+`add_adapter(LoraPEFTConfig)`, `trainer.fit`; executed by an external
+container over NCCL). Here the same recipe is one process:
+
+  * parallelism = sharding rules over a `jax.sharding.Mesh` (data/fsdp/
+    tensor axes); XLA inserts the gradient all-reduces the NCCL world did;
+  * micro/global batch = `accum` microbatch scan inside one jitted step
+    (grads averaged on device, no host round-trips);
+  * LoRA = optimizer state over the adapter pytree only, base params are
+    frozen donated buffers; full SFT = same step with the roles collapsed;
+  * checkpoints/resume via orbax (train/checkpoints.py), replacing NeMo's
+    `exp_manager` .nemo archives (ref: lora.ipynb cell 30).
+
+Metrics reported per step: loss, grad-norm, tokens/s and tokens/s/chip —
+the BASELINE.json LoRA north star.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.parallel import mesh as pmesh
+from generativeaiexamples_tpu.parallel import sharding as psh
+from generativeaiexamples_tpu.train import checkpoints
+from generativeaiexamples_tpu.train import lora as lora_lib
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Trainer knobs; names mirror the reference's hydra overrides
+    (micro_batch_size/global_batch_size/max_steps, lora.ipynb cells 26-28)."""
+
+    mode: str = "lora"                     # "lora" | "full"
+    lora: lora_lib.LoraConfig = field(default_factory=lora_lib.LoraConfig)
+    seq_len: int = 512
+    micro_batch_size: int = 1
+    global_batch_size: int = 8
+    max_steps: int = 50
+    learning_rate: float = 1e-4
+    weight_decay: float = 0.01
+    warmup_steps: int = 10
+    grad_clip_norm: float = 1.0
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 0              # 0 = only at end
+    log_every: int = 10
+
+    @property
+    def accum(self) -> int:
+        if self.global_batch_size % self.micro_batch_size:
+            raise ValueError("global_batch_size must divide by micro_batch_size")
+        return self.global_batch_size // self.micro_batch_size
+
+
+def causal_lm_loss(model_cfg: llama.LlamaConfig, params: Params,
+                   tokens: jnp.ndarray, loss_mask: jnp.ndarray,
+                   adapters: Optional[Params] = None) -> jnp.ndarray:
+    """Masked next-token cross-entropy. tokens/loss_mask: (B, S+1); loss over
+    predicting tokens[:,1:] from tokens[:,:-1], masked by loss_mask[:,1:]."""
+    logits = llama.forward(params, model_cfg, tokens[:, :-1], adapters=adapters)
+    targets = tokens[:, 1:]
+    mask = loss_mask[:, 1:]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=cfg.learning_rate,
+        warmup_steps=max(cfg.warmup_steps, 1),
+        decay_steps=max(cfg.max_steps, cfg.warmup_steps + 1))
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip_norm),
+        optax.adamw(schedule, weight_decay=cfg.weight_decay))
+
+
+class Trainer:
+    """Drives the jitted train step over a mesh; owns state + checkpoints.
+
+    `trainable` is the adapter pytree in LoRA mode (base `params` frozen) or
+    the full param tree in full-SFT mode (`params` then aliases it).
+    """
+
+    def __init__(self, model_cfg: llama.LlamaConfig, cfg: TrainConfig,
+                 params: Params, mesh: Optional[Mesh] = None,
+                 rng: Optional[jax.Array] = None):
+        self.model_cfg, self.cfg = model_cfg, cfg
+        self.mesh = mesh or pmesh.create_mesh(
+            pmesh.MeshConfig(axes=pmesh.TRAIN_AXES))
+        self.opt = make_optimizer(cfg)
+        self.step = 0
+
+        rules = psh.TRAIN_RULES
+        self.params = psh.shard_params(
+            params, llama.logical_axes(model_cfg), rules, self.mesh)
+        if cfg.mode == "lora":
+            adapters = lora_lib.init_adapters(
+                rng if rng is not None else jax.random.PRNGKey(0),
+                model_cfg, cfg.lora)
+            self.trainable = psh.shard_params(
+                adapters, lora_lib.adapter_logical_axes(cfg.lora), rules,
+                self.mesh)
+        elif cfg.mode == "full":
+            # The train step donates the trainable buffers; device_put may
+            # have aliased the caller's arrays, so copy (a non-donated jit
+            # cannot alias inputs into outputs) to avoid deleting them.
+            self.params = jax.jit(lambda t: jax.tree.map(jnp.copy, t))(
+                self.params)
+            self.trainable = self.params
+        else:
+            raise ValueError(f"unknown mode {cfg.mode!r}")
+        self.opt_state = jax.jit(self.opt.init)(self.trainable)
+        self._train_step = self._build_step()
+
+    # -- jitted step -------------------------------------------------------
+    def _build_step(self):
+        cfg, model_cfg, opt = self.cfg, self.model_cfg, self.opt
+        is_lora = cfg.mode == "lora"
+        # shard the microbatch over the dp axes when it divides evenly,
+        # otherwise replicate (tiny test batches)
+        dp = self.mesh.shape.get("data", 1) * self.mesh.shape.get("fsdp", 1)
+        batch_ax = ("data", "fsdp") if cfg.micro_batch_size % dp == 0 else None
+        batch_spec = NamedSharding(self.mesh, P(None, batch_ax, None))
+
+        def loss_fn(trainable, params, tokens, loss_mask):
+            adapters = trainable if is_lora else None
+            p = params if is_lora else trainable
+            return causal_lm_loss(model_cfg, p, tokens, loss_mask, adapters)
+
+        def step_fn(trainable, opt_state, params, tokens, loss_mask):
+            # microbatch scan: (accum, mbs, S+1) → averaged grads on device
+            def micro(carry, xs):
+                loss_acc, grad_acc = carry
+                t, m = xs
+                loss, grads = jax.value_and_grad(loss_fn)(trainable, params, t, m)
+                return (loss_acc + loss,
+                        jax.tree.map(jnp.add, grad_acc, grads)), None
+
+            zero = jax.tree.map(jnp.zeros_like, trainable)
+            (loss_sum, grad_sum), _ = jax.lax.scan(
+                micro, (jnp.zeros((), jnp.float32), zero), (tokens, loss_mask))
+            inv = 1.0 / tokens.shape[0]
+            grads = jax.tree.map(lambda g: g * inv, grad_sum)
+            gnorm = optax.global_norm(grads)
+            updates, opt_state = opt.update(grads, opt_state, trainable)
+            trainable = optax.apply_updates(trainable, updates)
+            return trainable, opt_state, loss_sum * inv, gnorm
+
+        jitted = jax.jit(step_fn, donate_argnums=(0, 1))
+
+        def run(trainable, opt_state, params, batch):
+            accum, mbs = cfg.accum, cfg.micro_batch_size
+            tokens = jax.device_put(
+                batch.tokens.reshape(accum, mbs, -1), batch_spec)
+            mask = jax.device_put(
+                batch.loss_mask.reshape(accum, mbs, -1), batch_spec)
+            # full mode: params is an alias of trainable, which is donated —
+            # pass an empty tree instead of aliasing a donated buffer
+            return jitted(trainable, opt_state, params if is_lora else {},
+                          tokens, mask)
+
+        return run
+
+    # -- loop --------------------------------------------------------------
+    def fit(self, data: Iterable[Any],
+            on_step: Optional[Callable[[int, Dict[str, float]], None]] = None
+            ) -> Dict[str, float]:
+        cfg = self.cfg
+        n_chips = self.mesh.devices.size
+        last: Dict[str, float] = {}
+        t_prev = time.perf_counter()
+        for batch in data:
+            if self.step >= cfg.max_steps:
+                break
+            self.trainable, self.opt_state, loss, gnorm = self._train_step(
+                self.trainable, self.opt_state, self.params, batch)
+            if cfg.mode == "full":
+                self.params = self.trainable
+            self.step += 1
+            loss_f = float(jax.block_until_ready(loss))
+            dt = time.perf_counter() - t_prev
+            t_prev = time.perf_counter()
+            toks = batch.tokens.size
+            last = {"loss": loss_f, "grad_norm": float(gnorm),
+                    "tokens_per_s": toks / dt,
+                    "tokens_per_s_per_chip": toks / dt / n_chips}
+            REGISTRY.histogram("train.loss").observe(loss_f)
+            REGISTRY.histogram("train.tokens_per_s_per_chip").observe(
+                last["tokens_per_s_per_chip"])
+            if on_step:
+                on_step(self.step, last)
+            if (cfg.checkpoint_dir and cfg.checkpoint_every
+                    and self.step % cfg.checkpoint_every == 0):
+                self.save(cfg.checkpoint_dir)
+        if cfg.checkpoint_dir:
+            self.save(cfg.checkpoint_dir)
+        return last
+
+    # -- checkpoint / resume (SURVEY §5.4) ---------------------------------
+    def save(self, directory: str) -> None:
+        checkpoints.save_train_state(
+            directory, step=self.step, trainable=self.trainable,
+            opt_state=self.opt_state)
+
+    def restore(self, directory: str) -> None:
+        # orbax restores onto committed single-device arrays for leaves whose
+        # template was an uncommitted scalar (opt.init's count); committed
+        # single-device + mesh-sharded can't mix in one jitted step, so
+        # re-place every leaf: keep mesh shardings, replicate the rest.
+        replicated = NamedSharding(self.mesh, P())
+
+        def live_sharding(x):
+            s = x.sharding
+            return s if isinstance(s, NamedSharding) else replicated
+
+        t_sh = jax.tree.map(live_sharding, self.trainable)
+        o_sh = jax.tree.map(live_sharding, self.opt_state)
+        self.step, trainable, opt_state = checkpoints.load_train_state(
+            directory, trainable=self.trainable, opt_state=self.opt_state)
+        self.trainable = jax.tree.map(jax.device_put, trainable, t_sh)
+        self.opt_state = jax.tree.map(jax.device_put, opt_state, o_sh)
+        if self.cfg.mode == "full":
+            self.params = self.trainable
+
+    def merged_params(self) -> Params:
+        """Base params with adapters folded in (serving-ready); full mode
+        returns the trained params unchanged."""
+        if self.cfg.mode == "lora":
+            return lora_lib.merge_adapters(self.params, self.trainable)
+        return self.params

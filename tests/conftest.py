@@ -18,6 +18,13 @@ if "xla_force_host_platform_device_count" not in flags:
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Env mutation alone is not enough: auto-loaded pytest plugins may import jax
+# before this conftest, and jax snapshots JAX_PLATFORMS into its config at
+# import time. jax.config.update works any time before backend init.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 
 @pytest.fixture(scope="session")
 def rng():

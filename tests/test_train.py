@@ -1,0 +1,187 @@
+"""Trainer subsystem: LoRA math, SFT data masking, loss descent, resume.
+
+Covers the capability the reference delegates to NeMo containers
+(SURVEY §2.4): LoRA adapter init/merge parity, completion-only loss masking
+(NeMo `answer_only_loss`), full + LoRA train steps over a simulated 8-device
+mesh, and checkpoint/resume round-trips.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.parallel import mesh as pmesh
+from generativeaiexamples_tpu.train import data as data_lib
+from generativeaiexamples_tpu.train import lora as lora_lib
+from generativeaiexamples_tpu.train import recipes
+from generativeaiexamples_tpu.train.trainer import TrainConfig, Trainer
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(1), cfg)
+    return cfg, params
+
+
+def _byte_encode(text: str):
+    return [b + 1 for b in text.encode("utf-8")]  # 0 reserved for pad
+
+
+# -- LoRA ------------------------------------------------------------------
+
+def test_lora_init_identity_and_merge(tiny):
+    """Fresh adapters (b=0) are a no-op; after perturbing b, merged base
+    weights reproduce the adapter forward exactly."""
+    cfg, params = tiny
+    lcfg = lora_lib.LoraConfig(rank=4, targets=("wq", "wo", "w_down"))
+    adapters = lora_lib.init_adapters(jax.random.PRNGKey(0), cfg, lcfg)
+    tokens = jnp.array([[5, 3, 8, 1]], jnp.int32)
+
+    base = llama.forward(params, cfg, tokens)
+    fresh = llama.forward(params, cfg, tokens, adapters=adapters)
+    np.testing.assert_allclose(np.asarray(base), np.asarray(fresh), atol=1e-6)
+
+    adapters = jax.tree.map(
+        lambda x: x + 0.05 * jax.random.normal(jax.random.PRNGKey(7), x.shape),
+        adapters)
+    tuned = llama.forward(params, cfg, tokens, adapters=adapters)
+    assert not np.allclose(np.asarray(base), np.asarray(tuned))
+
+    merged = lora_lib.merge_adapters(params, adapters)
+    via_merge = llama.forward(merged, cfg, tokens)
+    np.testing.assert_allclose(np.asarray(tuned), np.asarray(via_merge),
+                               atol=1e-4)
+
+
+def test_lora_rejects_unknown_target():
+    with pytest.raises(ValueError):
+        lora_lib.LoraConfig(targets=("wq", "nope"))
+
+
+# -- data ------------------------------------------------------------------
+
+def test_sft_batches_mask_prompt_only(tmp_path):
+    rows = [
+        '{"prompt": "ab", "completion": "cd"}',
+        '{"input": "xy", "output": "z"}',  # NeMo-style keys
+    ]
+    p = tmp_path / "train.jsonl"
+    p.write_text("\n".join(rows))
+    examples = data_lib.load_jsonl(str(p))
+    assert examples[1].prompt == "xy" and examples[1].completion == "z"
+
+    batches = list(data_lib.batches(
+        examples, _byte_encode, batch_size=2, seq_len=8, eos_id=200, seed=0))
+    assert len(batches) == 1
+    b = batches[0]
+    assert b.tokens.shape == (2, 9) and b.loss_mask.shape == (2, 9)
+    for r in range(2):
+        ids = b.tokens[r]
+        mask = b.loss_mask[r]
+        n_prompt = len(_byte_encode(examples[0].prompt))
+        # first tokens (prompt) unsupervised; completion + eos supervised
+        assert mask[:2].sum() == 0
+        assert (mask * (ids == 200)).sum() == 1  # eos supervised
+
+
+def test_batches_fixed_shapes_and_epochs():
+    examples = [data_lib.SFTExample("a", "bb"), data_lib.SFTExample("c", "d"),
+                data_lib.SFTExample("e", "f")]
+    got = list(data_lib.batches(examples, _byte_encode, batch_size=2,
+                                seq_len=4, epochs=2, seed=1))
+    assert len(got) == 2  # 3 examples, drop remainder → 1 batch/epoch
+    assert all(b.tokens.shape == (2, 5) for b in got)
+
+
+# -- trainer ---------------------------------------------------------------
+
+def _toy_batches(cfg: TrainConfig, vocab: int, n: int, seed: int = 0):
+    """Memorizable pattern: completion is the prompt reversed."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        B, S = cfg.global_batch_size, cfg.seq_len
+        tokens = rng.randint(1, vocab, size=(B, S + 1)).astype(np.int32)
+        mask = np.ones((B, S + 1), np.float32)
+        mask[:, : S // 2] = 0.0
+        out.append(data_lib.Batch(tokens=tokens, loss_mask=mask))
+    return out
+
+
+def test_lora_training_descends_and_freezes_base(tiny):
+    cfg, params = tiny
+    tcfg = TrainConfig(mode="lora",
+                       lora=lora_lib.LoraConfig(rank=4, alpha=8.0),
+                       micro_batch_size=2, global_batch_size=4,
+                       max_steps=8, warmup_steps=2, learning_rate=5e-3,
+                       seq_len=16)
+    mesh = pmesh.create_mesh(pmesh.MeshConfig(axes=pmesh.TRAIN_AXES,
+                                              shape=(2, 2, 2)))
+    trainer = Trainer(cfg, tcfg, params, mesh=mesh)
+    base_before = jax.tree.map(np.asarray, trainer.params)
+
+    # one fixed batch repeated → loss must drop (memorization)
+    batch = _toy_batches(tcfg, cfg.vocab_size, 1)[0]
+    losses = []
+    trainer.fit([batch] * tcfg.max_steps,
+                on_step=lambda s, m: losses.append(m["loss"]))
+    assert losses[-1] < losses[0], losses
+
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, np.asarray(b)),
+                 base_before, trainer.params)
+    # merged params differ from base → adapters actually learned
+    merged = trainer.merged_params()
+    assert not np.allclose(np.asarray(merged["layers"]["wq"]),
+                           np.asarray(trainer.params["layers"]["wq"]))
+
+
+def test_full_sft_training_descends(tiny):
+    cfg, params = tiny
+    tcfg = TrainConfig(mode="full", micro_batch_size=4, global_batch_size=4,
+                       max_steps=6, warmup_steps=1, learning_rate=1e-3,
+                       seq_len=12)
+    trainer = Trainer(cfg, tcfg, params)
+    batch = _toy_batches(tcfg, cfg.vocab_size, 1)[0]
+    losses = []
+    trainer.fit([batch] * tcfg.max_steps,
+                on_step=lambda s, m: losses.append(m["loss"]))
+    assert losses[-1] < losses[0], losses
+
+
+def test_checkpoint_resume_roundtrip(tiny, tmp_path):
+    cfg, params = tiny
+    tcfg = TrainConfig(mode="lora", lora=lora_lib.LoraConfig(rank=2),
+                       micro_batch_size=2, global_batch_size=2, max_steps=3,
+                       warmup_steps=1, seq_len=8,
+                       checkpoint_dir=str(tmp_path / "ck"))
+    trainer = Trainer(cfg, tcfg, params)
+    batch = _toy_batches(tcfg, cfg.vocab_size, 1)[0]
+    trainer.fit([batch] * 3)
+    assert trainer.step == 3
+
+    fresh = Trainer(cfg, tcfg, params)
+    fresh.restore(str(tmp_path / "ck"))
+    assert fresh.step == 3
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), trainer.trainable, fresh.trainable)
+    # training must continue from restored state (regression: orbax restored
+    # scalar opt-state leaves onto one device, breaking the next jitted step)
+    import dataclasses
+    fresh.cfg = dataclasses.replace(tcfg, max_steps=4)
+    fresh.fit([batch])
+    assert fresh.step == 4
+
+
+def test_recipes_resolve():
+    assert recipes.get_recipe("lora_pubmedqa").mode == "lora"
+    assert recipes.get_recipe("sft_full").mode == "full"
+    with pytest.raises(KeyError):
+        recipes.get_recipe("nope")
+    ex = recipes.format_pubmedqa({"QUESTION": "q?", "CONTEXTS": ["c1", "c2"],
+                                  "LONG_ANSWER": "ans"})
+    assert "q?" in ex.prompt and ex.completion == "ans"
+    ex2 = recipes.format_alpaca({"instruction": "do", "input": "", "output": "ok"})
+    assert "Input:" not in ex2.prompt
