@@ -62,6 +62,12 @@ class RemoteLLM:
                     return
                 chunk = json.loads(data)
                 choices = chunk.get("choices") or [{}]
+                # engine/server.py reports failures as a schema-shaped final
+                # chunk (finish_reason="error" + top-level "error") — surface
+                # it instead of ending the stream as an apparent success
+                if chunk.get("error") or choices[0].get("finish_reason") == "error":
+                    raise RuntimeError(
+                        f"LLM stream error: {chunk.get('error', 'unknown')}")
                 delta = choices[0].get("delta", {})
                 content = delta.get("content")
                 if content:

@@ -209,6 +209,7 @@ class EngineConfig:
     page_size: int = configfield("page_size", default=128, help_txt="KV page granularity (tokens).")
     prefill_chunk: int = configfield("prefill_chunk", default=512, help_txt="Chunked-prefill bucket size.")
     dtype: str = configfield("dtype", default="bfloat16", help_txt="Activation/weight dtype.")
+    attention: str = configfield("attention", default="auto", help_txt="Attention backend: auto (pallas on TPU, xla elsewhere) | pallas | xla.")
     mesh_shape: str = configfield("mesh_shape", default="", help_txt="Device mesh, e.g. '1x8'; empty = all devices on one tensor axis.")
     checkpoint_dir: str = configfield("checkpoint_dir", default="", help_txt="Orbax checkpoint to serve; empty = random init (test mode).")
 

@@ -24,6 +24,7 @@ annotations (cache buffers are donated through insert/decode to avoid copies).
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
@@ -75,6 +76,14 @@ class EngineCore:
     def __init__(self, model_cfg: llama.LlamaConfig, engine_cfg: EngineConfig,
                  params: llama.Params, eos_id: int,
                  adapters: Optional[llama.Params] = None) -> None:
+        attn = engine_cfg.attention
+        if attn == "auto":
+            # pallas kernels assume unsharded head layouts; the engine runs
+            # the model unsharded today, so TPU ⇒ pallas is safe. When TP
+            # sharding lands here, this gate must also check the mesh.
+            attn = "pallas" if jax.default_backend() == "tpu" else "xla"
+        if attn != model_cfg.attn_impl:
+            model_cfg = dataclasses.replace(model_cfg, attn_impl=attn)
         self.model_cfg = model_cfg
         self.cfg = engine_cfg
         self.params = params

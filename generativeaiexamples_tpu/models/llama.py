@@ -36,6 +36,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from generativeaiexamples_tpu.ops import pallas as pallas_ops
 from generativeaiexamples_tpu.ops.attention import mha_decode, mha_prefill
 from generativeaiexamples_tpu.ops.layers import apply_rope, rms_norm, rotary_embedding, swiglu
 
@@ -55,6 +56,11 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
     dtype: str = "bfloat16"
+    # "xla" | "pallas": inference attention backend. Pallas kernels
+    # (ops/pallas/attention.py) need head-axis-unsharded layouts; callers
+    # that shard heads over a tensor axis must keep "xla" (or wrap the
+    # kernels in shard_map).
+    attn_impl: str = "xla"
 
     @staticmethod
     def llama3_8b() -> "LlamaConfig":
@@ -298,7 +304,14 @@ def prefill(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
     cache_positions = jnp.arange(T, dtype=jnp.int32)[None]
     kv_valid_through = (start_pos + seq_lens)
 
+    use_pallas = (cfg.attn_impl == "pallas"
+                  and pallas_ops.prefill_supported(S, T, cfg.head_dim))
+
     def attn(q, k_new, v_new):
+        if use_pallas:
+            return pallas_ops.flash_prefill(
+                q, k_new, v_new, start_pos=start_pos,
+                kv_valid_through=kv_valid_through)
         kv_mask = cache_positions < kv_valid_through[:, None]
         return mha_prefill(q, k_new, v_new, q_positions=positions,
                            kv_positions=jnp.broadcast_to(cache_positions, (B, T)),
@@ -329,9 +342,13 @@ def decode_step(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
     cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
     new_lengths = cache.lengths + 1
 
+    use_pallas = (cfg.attn_impl == "pallas"
+                  and pallas_ops.decode_supported(T, cfg.head_dim))
+    attn = (pallas_ops.ragged_decode if use_pallas else mha_decode)
+
     h, k_stack, v_stack = _scan_cached_blocks(
         cfg, h, params, cache, cos, sin, cache.lengths,
-        lambda q, k_new, v_new: mha_decode(q, k_new, v_new, new_lengths),
+        lambda q, k_new, v_new: attn(q, k_new, v_new, new_lengths),
         adapters)
     logits = _unembed(cfg, params, h)[:, 0]
     return logits, KVCache(k=k_stack, v=v_stack, lengths=new_lengths)

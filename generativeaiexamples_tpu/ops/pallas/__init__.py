@@ -1,0 +1,13 @@
+"""Pallas TPU kernels for the serving hot path.
+
+The XLA einsum paths in ``ops.attention`` are the numerical reference; every
+kernel here is validated against them (tests/test_pallas.py, interpret mode on
+CPU + compiled on TPU).
+"""
+
+from generativeaiexamples_tpu.ops.pallas.attention import (  # noqa: F401
+    flash_prefill,
+    ragged_decode,
+    decode_supported,
+    prefill_supported,
+)

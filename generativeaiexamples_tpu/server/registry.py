@@ -49,6 +49,8 @@ def get_example(name: Optional[str] = None, **kwargs) -> BaseExample:
         try:
             importlib.import_module(module)
         except ModuleNotFoundError as exc:
+            if exc.name != module:  # a transitive dep is missing, not the example
+                raise
             raise KeyError(
                 f"example {name!r} is not implemented yet "
                 f"(module {module} missing)") from exc

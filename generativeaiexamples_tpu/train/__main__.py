@@ -39,6 +39,8 @@ def main(argv=None) -> None:
                     choices=sorted(recipes.RECIPES))
     ap.add_argument("--model", default="tiny", choices=sorted(MODEL_CONFIGS))
     ap.add_argument("--data", required=True, help="jsonl with prompt/completion")
+    ap.add_argument("--format", default="", choices=["", *sorted(recipes.FORMATTERS)],
+                    help="convert raw dataset rows (e.g. pubmedqa) to prompt/completion")
     ap.add_argument("--tokenizer", default="", help="HF tokenizer dir (default: byte)")
     ap.add_argument("--init-checkpoint", default="",
                     help="orbax params dir (default: random init)")
@@ -65,11 +67,18 @@ def main(argv=None) -> None:
 
     from generativeaiexamples_tpu.engine.tokenizer import get_tokenizer
     tok = get_tokenizer(args.tokenizer)
-    examples = data_lib.load_jsonl(args.data)
+    if args.format:
+        examples = data_lib.load_jsonl_with(args.data, recipes.FORMATTERS[args.format])
+    else:
+        examples = data_lib.load_jsonl(args.data)
+    if not examples:
+        raise SystemExit(f"no training examples in {args.data}")
     log.info("loaded %d examples from %s", len(examples), args.data)
+    # wrap-fill so datasets smaller than a global batch still train
     stream = data_lib.batches(
         examples, tok.encode, batch_size=tcfg.global_batch_size,
-        seq_len=tcfg.seq_len, epochs=10_000)  # trainer stops at max_steps
+        seq_len=tcfg.seq_len, epochs=10_000,  # trainer stops at max_steps
+        drop_remainder=False)
 
     trainer = Trainer(model_cfg, tcfg, params)
     if args.resume and args.checkpoint_dir:

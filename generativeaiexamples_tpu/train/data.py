@@ -48,6 +48,18 @@ def load_jsonl(path: str) -> List[SFTExample]:
     return out
 
 
+def load_jsonl_with(path: str, formatter) -> List[SFTExample]:
+    """Load raw dataset rows (e.g. PubMedQA/Alpaca) through a
+    recipes.FORMATTERS entry instead of expecting prompt/completion keys."""
+    out: List[SFTExample] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(formatter(json.loads(line)))
+    return out
+
+
 def encode_example(ex: SFTExample, encode: Encode, bos_id: int | None,
                    eos_id: int | None, max_len: int) -> Tuple[List[int], List[int]]:
     """Token ids + loss mask (1 on completion tokens and EOS, 0 on prompt)."""
