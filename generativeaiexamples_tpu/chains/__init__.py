@@ -20,3 +20,8 @@ into in-proc wiring.
 """
 
 from generativeaiexamples_tpu.chains.context import ChainContext, get_context  # noqa: F401
+
+# Shared retrieval-failure message (ref chains.py "No response generated…"
+# strings, identical across the reference examples).
+NO_CONTEXT_MSG = ("No response generated from LLM, make sure your query is "
+                  "relevant to the ingested document.")

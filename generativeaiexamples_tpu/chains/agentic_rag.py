@@ -1,0 +1,195 @@
+"""agentic_rag — self-corrective RAG with hybrid retrieval.
+
+Behavioral parity with the reference's agentic notebook (ref: RAG/notebooks/
+langchain/agentic_rag_with_nemo_retriever_nim.ipynb): hybrid BM25 + dense
+ensemble retrieval (cells ~227-235, EnsembleRetriever), a retrieval grader
+that filters irrelevant documents, question rewriting when retrieval fails,
+generation, a hallucination grader checking groundedness, and an answer
+grader checking usefulness — wired as a state machine (LangGraph build,
+cells 13-37) with bounded retries.
+
+In-tree the graph is an explicit loop: retrieve → grade docs →
+(rewrite + retry | generate) → grade generation → (accept | regenerate |
+rewrite + retry), capped at `max_retries` passes.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Dict, Iterator, List, Sequence
+
+from generativeaiexamples_tpu.chains.basic_rag import _sampling, trim_context
+from generativeaiexamples_tpu.chains.context import ChainContext, get_context
+from generativeaiexamples_tpu.chains.loaders import load_document
+from generativeaiexamples_tpu.chains.query_decomposition import extract_json
+from generativeaiexamples_tpu.core.tracing import chain_instrumentation
+from generativeaiexamples_tpu.retrieval.bm25 import (
+    BM25Index, reciprocal_rank_fusion)
+from generativeaiexamples_tpu.retrieval.store import Document
+from generativeaiexamples_tpu.server.base import BaseExample
+from generativeaiexamples_tpu.server.registry import register_example
+
+logger = logging.getLogger(__name__)
+
+from generativeaiexamples_tpu.chains import NO_CONTEXT_MSG
+
+COLLECTION = "agentic_rag"
+MAX_RETRIES = 2
+
+
+@register_example("agentic_rag")
+class AgenticRAG(BaseExample):
+    def __init__(self, context: ChainContext = None) -> None:
+        self.ctx = context or get_context()
+        self.bm25 = BM25Index()
+        self._bm25_docs: List[Document] = []
+
+    # ------------------------------------------------------------ ingestion
+
+    @chain_instrumentation
+    def ingest_docs(self, filepath: str, filename: str) -> None:
+        text = load_document(filepath)
+        if not text.strip():
+            raise ValueError(f"no text extracted from {filename}")
+        chunks = self.ctx.splitter().split(text)
+        docs = [Document(content=c, metadata={"source": filename})
+                for c in chunks]
+        embeddings = self.ctx.embedder.embed_documents([d.content for d in docs])
+        self.ctx.store(COLLECTION).add(docs, embeddings)
+        self.bm25.add([d.content for d in docs])
+        self._bm25_docs.extend(docs)
+
+    # ------------------------------------------------------------ retrieval
+
+    def _hybrid_retrieve(self, query: str, top_k: int) -> List[Document]:
+        """BM25 + dense, fused by reciprocal rank (the EnsembleRetriever
+        equivalent)."""
+        qvec = self.ctx.embedder.embed_queries([query])[0]
+        dense_hits = self.ctx.store(COLLECTION).search(
+            qvec, top_k=top_k * 2, score_threshold=0.0)
+        sparse_hits = self.bm25.search(query, top_k=top_k * 2)
+
+        # fuse over content identity
+        pool: List[Document] = []
+        key_to_idx: Dict[str, int] = {}
+
+        def pool_idx(doc: Document) -> int:
+            key = doc.content
+            if key not in key_to_idx:
+                key_to_idx[key] = len(pool)
+                pool.append(doc)
+            return key_to_idx[key]
+
+        dense_rank = [pool_idx(d) for d, _ in dense_hits]
+        sparse_rank = [pool_idx(self._bm25_docs[i]) for i, _ in sparse_hits]
+        fused = reciprocal_rank_fusion([dense_rank, sparse_rank], top_k=top_k)
+        return [pool[i] for i in fused]
+
+    # -------------------------------------------------------------- graders
+
+    def _grade(self, prompt: str, **settings: Any) -> bool:
+        s = _sampling(settings)
+        s.update(max_tokens=32, temperature=0.0)
+        raw = "".join(self.ctx.llm.chat(
+            [{"role": "user", "content": prompt}], **s))
+        parsed = extract_json(raw)
+        if parsed and "score" in parsed:
+            return str(parsed["score"]).strip().lower().startswith("y")
+        return "yes" in raw.lower()
+
+    def _grade_documents(self, question: str, docs: List[Document],
+                         **settings: Any) -> List[Document]:
+        kept = []
+        for doc in docs:
+            prompt = self.ctx.prompts["retrieval_grader_prompt"].format(
+                document=doc.content, question=question)
+            if self._grade(prompt, **settings):
+                kept.append(doc)
+        logger.info("retrieval grader kept %d/%d docs", len(kept), len(docs))
+        return kept
+
+    def _rewrite_question(self, question: str, **settings: Any) -> str:
+        s = _sampling(settings)
+        s.update(max_tokens=96, temperature=0.0)
+        out = "".join(self.ctx.llm.chat(
+            [{"role": "user",
+              "content": self.ctx.prompts["question_rewriter_prompt"].format(
+                  question=question)}], **s)).strip()
+        return out or question
+
+    def _generate(self, question: str, context_text: str,
+                  **settings: Any) -> str:
+        system = self.ctx.prompts["rag_template"].format(context=context_text)
+        return "".join(self.ctx.llm.chat(
+            [{"role": "system", "content": system},
+             {"role": "user", "content": question}], **_sampling(settings)))
+
+    # ----------------------------------------------------------- generation
+
+    @chain_instrumentation
+    def llm_chain(self, query: str, chat_history: Sequence[Dict[str, str]],
+                  **llm_settings: Any) -> Iterator[str]:
+        messages = ([{"role": "system",
+                      "content": self.ctx.prompts["chat_template"]}]
+                    + list(chat_history) + [{"role": "user", "content": query}])
+        yield from self.ctx.llm.chat(messages, **_sampling(llm_settings))
+
+    @chain_instrumentation
+    def rag_chain(self, query: str, chat_history: Sequence[Dict[str, str]],
+                  **llm_settings: Any) -> Iterator[str]:
+        rcfg = self.ctx.config.retriever
+        question = query
+        generation = ""
+        for attempt in range(MAX_RETRIES + 1):
+            docs = self._hybrid_retrieve(question, rcfg.top_k)
+            docs = self._grade_documents(question, docs, **llm_settings)
+            if not docs:
+                if attempt >= MAX_RETRIES:
+                    yield NO_CONTEXT_MSG
+                    return
+                question = self._rewrite_question(question, **llm_settings)
+                logger.info("no relevant docs; rewrote question to %r",
+                            question)
+                continue
+            context_text = trim_context(
+                [d.content for d in docs], self.ctx.embedder.tokenizer,
+                rcfg.max_context_tokens)
+            generation = self._generate(question, context_text,
+                                        **llm_settings)
+            grounded = self._grade(
+                self.ctx.prompts["hallucination_grader_prompt"].format(
+                    documents=context_text, generation=generation),
+                **llm_settings)
+            useful = grounded and self._grade(
+                self.ctx.prompts["answer_grader_prompt"].format(
+                    generation=generation, question=question),
+                **llm_settings)
+            if useful or attempt >= MAX_RETRIES:
+                break
+            if grounded:  # answered but not useful → rewrite the question
+                question = self._rewrite_question(question, **llm_settings)
+            logger.info("generation rejected (grounded=%s); retrying",
+                        grounded)
+        yield generation or NO_CONTEXT_MSG
+
+    # ------------------------------------------------------------ documents
+
+    def document_search(self, query: str, num_docs: int = 4) -> List[Dict[str, Any]]:
+        docs = self._hybrid_retrieve(query, num_docs)
+        return [{"source": str(d.metadata.get("source", "")),
+                 "content": d.content, "score": 0.0} for d in docs]
+
+    def get_documents(self) -> List[str]:
+        return self.ctx.store(COLLECTION).list_sources()
+
+    def delete_documents(self, filenames: Sequence[str]) -> bool:
+        removed = self.ctx.store(COLLECTION).delete_by_source(filenames) > 0
+        names = set(filenames)
+        keep = [d for d in self._bm25_docs
+                if d.metadata.get("source") not in names]
+        if len(keep) != len(self._bm25_docs):
+            self.bm25 = BM25Index()
+            self.bm25.add([d.content for d in keep])
+            self._bm25_docs = keep
+        return removed

@@ -1,0 +1,178 @@
+"""multimodal_rag — PDF/PPTX/PNG ingestion with image description.
+
+Behavioral parity with the reference example (ref: RAG/examples/
+advanced_rag/multimodal_rag/chains.py: ingest accepts only pdf/pptx/png
+(chains.py:69-75); images are described by a VLM before embedding
+(vectorstore/vectorstore_updater.py:69 + llm/llm_client.py
+multimodal_invoke:48); retrieval then augments the prompt with the text
+and image descriptions (chains.py rag_chain)).
+
+The VLM is a seam: `ImageDescriber`. The default deterministic describer
+captions from image structure (Pillow stats) so the pipeline is fully
+self-contained; when the vision tower (encoders/vision.py) or a remote
+VLM endpoint (APP_VLM_SERVER_URL) is available, richer captions plug in
+without touching the chain.
+"""
+
+from __future__ import annotations
+
+import base64
+import logging
+import os
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+from generativeaiexamples_tpu.chains.basic_rag import _sampling, trim_context
+from generativeaiexamples_tpu.chains.context import ChainContext, get_context
+from generativeaiexamples_tpu.chains.multimodal_parsers import (
+    Element, image_summary, parse_multimodal)
+from generativeaiexamples_tpu.core.tracing import chain_instrumentation
+from generativeaiexamples_tpu.retrieval.store import Document
+from generativeaiexamples_tpu.server.base import BaseExample
+from generativeaiexamples_tpu.server.registry import register_example
+
+logger = logging.getLogger(__name__)
+
+from generativeaiexamples_tpu.chains import NO_CONTEXT_MSG
+
+COLLECTION = "multimodal"
+
+# type: takes (image_bytes, metadata) -> caption
+ImageDescriber = Callable[[bytes, Dict[str, str]], str]
+
+
+def stub_describer(image_bytes: bytes, metadata: Dict[str, str]) -> str:
+    """Deterministic caption from image structure (no model)."""
+    summary = image_summary(image_bytes) or "undecodable image"
+    src = metadata.get("source", "unknown")
+    return f"Image from {src}: {summary}"
+
+
+def remote_vlm_describer(base_url: str, model: str) -> ImageDescriber:
+    """Caption via an OpenAI-compatible VLM endpoint (the reference's
+    NeuVA/VLM path, ref llm/llm_client.py multimodal_invoke:48)."""
+    def describe(image_bytes: bytes, metadata: Dict[str, str]) -> str:
+        import httpx
+
+        b64 = base64.b64encode(image_bytes).decode("ascii")
+        payload = {
+            "model": model,
+            "messages": [{"role": "user", "content": [
+                {"type": "text",
+                 "text": "Describe this image concisely, including any "
+                         "chart or graph content."},
+                {"type": "image_url",
+                 "image_url": {"url": f"data:image/png;base64,{b64}"}},
+            ]}],
+            "max_tokens": 160,
+        }
+        resp = httpx.post(f"{base_url.rstrip('/')}/v1/chat/completions",
+                          json=payload, timeout=60.0)
+        resp.raise_for_status()
+        return resp.json()["choices"][0]["message"]["content"]
+    return describe
+
+
+def get_describer() -> ImageDescriber:
+    url = os.environ.get("APP_VLM_SERVER_URL", "")
+    if url:
+        model = os.environ.get("APP_VLM_MODEL_NAME", "vlm")
+        return remote_vlm_describer(url, model)
+    return stub_describer
+
+
+@register_example("multimodal_rag")
+class MultimodalRAG(BaseExample):
+    def __init__(self, context: ChainContext = None,
+                 describer: Optional[ImageDescriber] = None) -> None:
+        self.ctx = context or get_context()
+        self.describer = describer or get_describer()
+
+    # ------------------------------------------------------------ ingestion
+
+    def _element_docs(self, elements: List[Element]) -> List[Document]:
+        docs: List[Document] = []
+        splitter = self.ctx.splitter()
+        for el in elements:
+            if el.kind == "text":
+                for chunk in splitter.split(el.text):
+                    docs.append(Document(
+                        content=chunk,
+                        metadata={**el.metadata, "kind": "text"}))
+            else:
+                try:
+                    caption = self.describer(el.image_bytes, el.metadata)
+                except Exception as exc:
+                    logger.warning("image description failed: %s", exc)
+                    caption = stub_describer(el.image_bytes, el.metadata)
+                docs.append(Document(
+                    content=caption,
+                    metadata={**el.metadata, "kind": "image"}))
+        return docs
+
+    @chain_instrumentation
+    def ingest_docs(self, filepath: str, filename: str) -> None:
+        if not filename.lower().endswith((".pdf", ".pptx", ".png")):
+            raise ValueError(
+                f"{filename} is not a valid PDF/PPTX/PNG file. Only "
+                f"PDF/PPTX/PNG files are supported for multimodal rag.")
+        elements = parse_multimodal(filepath)
+        for el in elements:
+            el.metadata["source"] = filename
+        docs = self._element_docs(elements)
+        if not docs:
+            raise ValueError(f"no content extracted from {filename}")
+        embeddings = self.ctx.embedder.embed_documents(
+            [d.content for d in docs])
+        self.ctx.store(COLLECTION).add(docs, embeddings)
+        n_img = sum(1 for d in docs if d.metadata.get("kind") == "image")
+        logger.info("ingested %s: %d text chunks, %d images",
+                    filename, len(docs) - n_img, n_img)
+
+    # ----------------------------------------------------------- generation
+
+    @chain_instrumentation
+    def llm_chain(self, query: str, chat_history: Sequence[Dict[str, str]],
+                  **llm_settings: Any) -> Iterator[str]:
+        messages = [{"role": "system",
+                     "content": self.ctx.prompts["chat_template"]},
+                    {"role": "user", "content": query}]
+        yield from self.ctx.llm.chat(messages, **_sampling(llm_settings))
+
+    @chain_instrumentation
+    def rag_chain(self, query: str, chat_history: Sequence[Dict[str, str]],
+                  **llm_settings: Any) -> Iterator[str]:
+        rcfg = self.ctx.config.retriever
+        qvec = self.ctx.embedder.embed_queries([query])[0]
+        hits = self.ctx.store(COLLECTION).search(
+            qvec, top_k=rcfg.top_k, score_threshold=rcfg.score_threshold)
+        if not hits:
+            yield NO_CONTEXT_MSG
+            return
+        chunks = []
+        for d, _ in hits:
+            prefix = ("[image] " if d.metadata.get("kind") == "image" else "")
+            chunks.append(prefix + d.content)
+        context_text = trim_context(chunks, self.ctx.embedder.tokenizer,
+                                    rcfg.max_context_tokens)
+        system = self.ctx.prompts["multimodal_rag_template"].format(
+            context=context_text)
+        messages = [{"role": "system", "content": system},
+                    {"role": "user", "content": query}]
+        yield from self.ctx.llm.chat(messages, **_sampling(llm_settings))
+
+    # ------------------------------------------------------------ documents
+
+    def document_search(self, query: str, num_docs: int = 4) -> List[Dict[str, Any]]:
+        qvec = self.ctx.embedder.embed_queries([query])[0]
+        hits = self.ctx.store(COLLECTION).search(
+            qvec, top_k=num_docs,
+            score_threshold=self.ctx.config.retriever.score_threshold)
+        return [{"source": str(d.metadata.get("source", "")),
+                 "content": d.content, "score": score}
+                for d, score in hits]
+
+    def get_documents(self) -> List[str]:
+        return self.ctx.store(COLLECTION).list_sources()
+
+    def delete_documents(self, filenames: Sequence[str]) -> bool:
+        return self.ctx.store(COLLECTION).delete_by_source(filenames) > 0

@@ -28,21 +28,87 @@ DEFAULT_PROMPTS: Dict[str, str] = {
         "Context:\n{context}\n"),
     "multi_turn_rag_template": (
         "You are a document chatbot. Answer the user's question using only the "
-        "retrieved context and the conversation so far. If unsure, say so.\n\n"
-        "Context:\n{context}\n"),
+        "retrieved context and the conversation so far. If unsure, say so. "
+        "Make your response conversational.\n\n"
+        "Conversation history retrieved:\n{history}\n\n"
+        "Document context retrieved:\n{context}\n"),
     "query_rewriter_prompt": (
         "Given the conversation history and a follow-up question, rewrite the "
         "follow-up into a standalone question. Return only the question."),
+    # query-decomposition agent (ref: query_decomposition_rag/prompt.yaml
+    # tool_selector_prompt / math_tool_prompt — JSON tool-request protocol)
     "tool_selector_prompt": (
-        "Answer the question by decomposing it into simpler sub-questions when "
-        "needed. Respond with a JSON list of sub-questions, or \"Nil\" if the "
-        "question needs no decomposition."),
-    "csv_prompt": (
-        "You are a data analyst. Given the table description below, answer the "
-        "user's question about the data.\n\nTable info:\n{table_info}\n"),
+        "Your task is to answer questions. If you cannot answer the question "
+        "directly, request a tool and break the question into specific "
+        "sub-questions. Fill with Nil where no action is required. Return ONLY "
+        "a JSON object with the tool and the generated sub-questions — no "
+        "other text. You are given two tools:\n"
+        "- Search: finds and retrieves relevant answers from the ingested "
+        "documents.\n"
+        "- Math: performs arithmetic (addition, subtraction, multiplication, "
+        "division, comparisons).\n"
+        "Do not pass sub-questions to a tool if the contextual information "
+        "already answers them. If you have all the information needed, set "
+        "Tool_Request to Nil.\n\n"
+        "Contextual Information:\n{context}\n\n"
+        "Question:\n{question}\n\n"
+        '{{"Tool_Request": "<Fill>", "Generated Sub Questions": [<Fill>]}}'),
+    "math_tool_prompt": (
+        "Identify two numeric variables and one operation from the question. "
+        "Return ONLY a JSON object with keys IsPossible (\"Possible\" or "
+        "\"Not Possible\"), variable1, variable2, and operation (one of "
+        "+ - * / = > < >= <=) — no other text.\n\n"
+        "Contextual Information:\n{context}\n\n"
+        "Question:\n{question}\n\n"
+        '{{"IsPossible": "<Fill>", "variable1": <Fill>, "variable2": <Fill>, '
+        '"operation": "<Fill>"}}'),
+    "answer_extraction_prompt": (
+        "Below is a question and a set of passages that may or may not be "
+        "relevant. Extract the answer to the question using only the "
+        "information in the passages. Be as concise as possible and only "
+        "include the answer if present. Do not infer beyond the passages."),
+    # structured-data CSV chain (ref: structured_data_rag/prompt.yaml
+    # csv_data_retrieval_template / csv_response_template)
+    "csv_data_retrieval_template": (
+        "You are an expert data analyst who writes pandas code.\n"
+        "Write python code that computes the answer to the user's query from "
+        "the DataFrame `df` (already loaded; do NOT read any files). Assign "
+        "the final answer to a variable named `result`. Use only `df`, `pd`, "
+        "and builtins. Return ONLY the code, no explanations or markdown.\n\n"
+        "The data contains: {description}\n"
+        "Instructions:\n{instructions}\n\n"
+        "DataFrame columns and sample rows:\n{data_frame}\n"),
+    "csv_response_template": (
+        "Provide a response to the user's query based on the given data "
+        "point. Do not add anything beyond the information provided in the "
+        "data.\n\nUser's query:\n{query}\n\n"
+        "Data point computed from the table:\n{data}\n\nResponse:"),
     "multimodal_rag_template": (
-        "Answer using the retrieved text and image descriptions.\n\n"
-        "Context:\n{context}\n"),
+        "Answer using the retrieved text passages, table contents, and image "
+        "descriptions.\n\nContext:\n{context}\n"),
+    # agentic self-corrective RAG (ref: RAG/notebooks/langchain/
+    # agentic_rag_with_nemo_retriever_nim.ipynb — grader/rewriter prompts)
+    "retrieval_grader_prompt": (
+        "You are a grader assessing the relevance of a retrieved document to "
+        "a user question. If the document contains keywords or semantic "
+        "meaning related to the question, grade it relevant. Return ONLY a "
+        "JSON object {{\"score\": \"yes\"}} or {{\"score\": \"no\"}}.\n\n"
+        "Document:\n{document}\n\nQuestion: {question}"),
+    "hallucination_grader_prompt": (
+        "You are a grader assessing whether an answer is grounded in the "
+        "provided facts. Return ONLY a JSON object {{\"score\": \"yes\"}} if "
+        "the answer is supported by the facts, else {{\"score\": \"no\"}}.\n\n"
+        "Facts:\n{documents}\n\nAnswer: {generation}"),
+    "answer_grader_prompt": (
+        "You are a grader assessing whether an answer resolves the question. "
+        "Return ONLY a JSON object {{\"score\": \"yes\"}} or "
+        "{{\"score\": \"no\"}}.\n\nAnswer:\n{generation}\n\n"
+        "Question: {question}"),
+    "question_rewriter_prompt": (
+        "You are a question re-writer that converts an input question into a "
+        "better version optimized for vector-store retrieval. Reason about "
+        "the underlying semantic intent. Return only the rewritten "
+        "question.\n\nQuestion: {question}"),
 }
 
 

@@ -1,0 +1,367 @@
+"""Advanced-chain tests: multi-turn funnel, query-decomposition agent,
+structured-data CSV sandbox, multimodal parsing, agentic self-correction.
+
+A scripted FakeLLM plays the model so each chain's control flow (the part
+the reference delegates to LangChain agents/PandasAI) is tested
+deterministically; embeddings/rerank use the real tiny TPU encoders.
+"""
+
+import json
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.chains.context import ChainContext, set_context
+from generativeaiexamples_tpu.core.config import get_config
+from generativeaiexamples_tpu.encoders.embedder import Embedder
+from generativeaiexamples_tpu.encoders.reranker import Reranker
+
+
+class FakeLLM:
+    """Yields scripted responses in order; records prompts."""
+
+    def __init__(self, responses):
+        self.responses = list(responses)
+        self.calls = []
+
+    def chat(self, messages, **settings):
+        self.calls.append(messages)
+        if not self.responses:
+            text = "default answer"
+        else:
+            text = self.responses.pop(0)
+        # stream in two chunks to exercise the iterator path
+        mid = max(1, len(text) // 2)
+        yield text[:mid]
+        yield text[mid:]
+
+
+@pytest.fixture(scope="module")
+def encoders():
+    return Embedder(), Reranker()
+
+
+def make_ctx(responses, encoders, reranker=True):
+    embedder, rr = encoders
+    return ChainContext(config=get_config(), llm=FakeLLM(responses),
+                        embedder=embedder, reranker=rr if reranker else None)
+
+
+@pytest.fixture(autouse=True)
+def _clear_context():
+    yield
+    set_context(None)
+
+
+# ------------------------------------------------------------- multi-turn
+
+
+def test_multi_turn_funnel_and_memory(tmp_path, encoders):
+    from generativeaiexamples_tpu.chains.multi_turn_rag import (
+        CONV, MultiTurnRAG)
+
+    ctx = make_ctx(["the answer is 42"], encoders)
+    chain = MultiTurnRAG(context=ctx)
+    doc = tmp_path / "facts.txt"
+    doc.write_text("The meaning of life is 42.\n\nLlamas live in the Andes.")
+    chain.ingest_docs(str(doc), "facts.txt")
+
+    out = "".join(chain.rag_chain("what is the meaning of life?", []))
+    assert out == "the answer is 42"
+    # the exchange was written into the conversation store
+    assert len(ctx.store(CONV)) == 2
+    # the system prompt carried both retrieved sections
+    system = ctx.llm.calls[-1][0]["content"]
+    assert "Document context retrieved" in system
+
+    # second turn retrieves conversation memory
+    ctx.llm.responses = ["I told you already"]
+    "".join(chain.rag_chain("repeat what you said", []))
+    assert len(ctx.store(CONV)) == 4
+
+    assert chain.get_documents() == ["facts.txt"]
+    assert chain.delete_documents(["facts.txt"]) is True
+
+
+def test_multi_turn_rejects_bad_extension(tmp_path, encoders):
+    from generativeaiexamples_tpu.chains.multi_turn_rag import MultiTurnRAG
+
+    chain = MultiTurnRAG(context=make_ctx([], encoders))
+    bad = tmp_path / "data.xyz"
+    bad.write_text("hi")
+    with pytest.raises(ValueError):
+        chain.ingest_docs(str(bad), "data.xyz")
+
+
+# ----------------------------------------------- query decomposition agent
+
+
+def test_query_decomposition_search_then_final(tmp_path, encoders):
+    from generativeaiexamples_tpu.chains.query_decomposition import (
+        QueryDecompositionRAG)
+
+    responses = [
+        # tool selector → Search with one sub-question
+        json.dumps({"Tool_Request": "Search",
+                    "Generated Sub Questions": ["height of Everest?"]}),
+        # extract_answer for the sub-question
+        "8849 meters",
+        # tool selector round 2 → done
+        json.dumps({"Tool_Request": "Nil",
+                    "Generated Sub Questions": ["Nil"]}),
+        # final answer stream
+        "Everest is 8849 meters tall.",
+    ]
+    ctx = make_ctx(responses, encoders)
+    chain = QueryDecompositionRAG(context=ctx)
+    doc = tmp_path / "mountains.txt"
+    doc.write_text("Mount Everest is 8849 meters tall. K2 is 8611 meters.")
+    chain.ingest_docs(str(doc), "mountains.txt")
+
+    out = "".join(chain.rag_chain("how tall is Everest?", []))
+    assert out == "Everest is 8849 meters tall."
+    # the final prompt contains the ledger
+    final_prompt = ctx.llm.calls[-1][0]["content"]
+    assert "Sub Question: height of Everest?" in final_prompt
+    assert "Sub Answer: 8849 meters" in final_prompt
+
+
+def test_query_decomposition_math_tool(tmp_path, encoders):
+    from generativeaiexamples_tpu.chains.query_decomposition import (
+        QueryDecompositionRAG)
+
+    responses = [
+        json.dumps({"Tool_Request": "Math",
+                    "Generated Sub Questions": ["what is 6 times 7?"]}),
+        json.dumps({"IsPossible": "Possible", "variable1": 6,
+                    "variable2": 7, "operation": "*"}),
+        "The result is 42.",
+    ]
+    ctx = make_ctx(responses, encoders)
+    chain = QueryDecompositionRAG(context=ctx)
+    out = "".join(chain.rag_chain("what is 6*7?", []))
+    assert out == "The result is 42."
+    final_prompt = ctx.llm.calls[-1][0]["content"]
+    assert "6.0*7.0=42.0" in final_prompt
+
+
+def test_extract_json_robust():
+    from generativeaiexamples_tpu.chains.query_decomposition import extract_json
+
+    assert extract_json('noise {"a": 1} trailing') == {"a": 1}
+    assert extract_json("no json here") is None
+    assert extract_json('{"bad": } {"good": [1, 2]}') == {"good": [1, 2]}
+
+
+# ------------------------------------------------------- structured data
+
+
+def test_structured_data_pandas_agent(tmp_path, encoders):
+    from generativeaiexamples_tpu.chains.structured_data import (
+        StructuredDataRAG)
+
+    csv1 = tmp_path / "machines.csv"
+    csv1.write_text("machine,age\nm1,7\nm2,14\nm3,3\n")
+    responses = [
+        "result = df['age'].max()",        # code generation
+        "The oldest machine is 14 months old.",  # paraphrase
+    ]
+    ctx = make_ctx(responses, encoders)
+    chain = StructuredDataRAG(context=ctx, state_dir=str(tmp_path / "state"))
+    chain.ingest_docs(str(csv1), "machines.csv")
+    out = "".join(chain.rag_chain("oldest machine age?", []))
+    assert out == "The oldest machine is 14 months old."
+    assert chain.get_documents() == ["machines.csv"]
+
+
+def test_structured_data_retry_on_bad_code(tmp_path, encoders):
+    from generativeaiexamples_tpu.chains.structured_data import (
+        StructuredDataRAG)
+
+    csv1 = tmp_path / "t.csv"
+    csv1.write_text("x\n1\n2\n")
+    responses = [
+        "import os\nresult = os.getcwd()",   # rejected by sandbox
+        "```python\nresult = df['x'].sum()\n```",  # retry succeeds (fenced)
+        "The sum is 3.",
+    ]
+    ctx = make_ctx(responses, encoders)
+    chain = StructuredDataRAG(context=ctx, state_dir=str(tmp_path / "state"))
+    chain.ingest_docs(str(csv1), "t.csv")
+    out = "".join(chain.rag_chain("sum of x?", []))
+    assert out == "The sum is 3."
+
+
+def test_structured_data_column_mismatch(tmp_path, encoders):
+    from generativeaiexamples_tpu.chains.structured_data import (
+        StructuredDataRAG)
+
+    a = tmp_path / "a.csv"
+    a.write_text("x,y\n1,2\n")
+    b = tmp_path / "b.csv"
+    b.write_text("p,q\n1,2\n")
+    chain = StructuredDataRAG(context=make_ctx([], encoders),
+                              state_dir=str(tmp_path / "state"))
+    chain.ingest_docs(str(a), "a.csv")
+    with pytest.raises(ValueError):
+        chain.ingest_docs(str(b), "b.csv")
+
+
+def test_pandas_sandbox_blocks_escapes():
+    from generativeaiexamples_tpu.chains.structured_data import (
+        run_pandas_code, validate_code)
+    import pandas as pd
+
+    df = pd.DataFrame({"x": [1, 2, 3]})
+    assert run_pandas_code("result = df['x'].sum()", df) == 6
+    # bare trailing expression becomes the result
+    assert run_pandas_code("df['x'].mean()", df) == 2.0
+    for evil in [
+        "import os",
+        "__import__('os')",
+        "df.__class__",
+        "open('/etc/passwd')",
+        "exec('x=1')",
+        "eval('1')",
+        "result = pd.io.common.os.getcwd()",       # submodule traversal
+        "result = pd.read_csv('/etc/passwd')",      # pandas IO
+        "df.to_csv('/tmp/leak.csv')",               # dataframe IO
+        "df.eval('x + 1')",                         # string-eval surface
+        "df.query('x > 0')",
+    ]:
+        with pytest.raises(Exception):
+            run_pandas_code(evil, df)
+
+
+# ------------------------------------------------------------ multimodal
+
+
+def _tiny_png() -> bytes:
+    import io
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.new("RGB", (32, 16), (200, 30, 30)).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _make_pptx(path, texts, image_bytes=None):
+    ns = ('xmlns:a="http://schemas.openxmlformats.org/drawingml/2006/main" '
+          'xmlns:p="http://schemas.openxmlformats.org/presentationml/2006/main"')
+    with zipfile.ZipFile(path, "w") as zf:
+        for i, text in enumerate(texts, 1):
+            zf.writestr(
+                f"ppt/slides/slide{i}.xml",
+                f'<p:sld {ns}><p:cSld><p:spTree>'
+                f'<a:t>{text}</a:t></p:spTree></p:cSld></p:sld>')
+        if image_bytes:
+            zf.writestr("ppt/media/image1.png", image_bytes)
+
+
+def test_pptx_parser(tmp_path):
+    from generativeaiexamples_tpu.chains.multimodal_parsers import parse_pptx
+
+    path = tmp_path / "deck.pptx"
+    _make_pptx(path, ["TPU v5e architecture", "HBM bandwidth numbers"],
+               image_bytes=_tiny_png())
+    elements = parse_pptx(str(path))
+    texts = [e for e in elements if e.kind == "text"]
+    images = [e for e in elements if e.kind == "image"]
+    assert len(texts) == 2 and len(images) == 1
+    assert texts[0].text == "TPU v5e architecture"
+    assert texts[0].metadata["slide"] == "1"
+
+
+def test_image_parser_and_summary(tmp_path):
+    from generativeaiexamples_tpu.chains.multimodal_parsers import (
+        image_summary, parse_image)
+
+    path = tmp_path / "img.png"
+    path.write_bytes(_tiny_png())
+    (el,) = parse_image(str(path))
+    assert el.kind == "image"
+    summary = image_summary(el.image_bytes)
+    assert "32x16" in summary
+
+
+def test_multimodal_chain_ingest_and_rag(tmp_path, encoders):
+    from generativeaiexamples_tpu.chains.multimodal import MultimodalRAG
+
+    path = tmp_path / "deck.pptx"
+    _make_pptx(path, ["Quarterly revenue grew 20 percent"],
+               image_bytes=_tiny_png())
+    ctx = make_ctx(["revenue grew 20 percent"], encoders)
+    chain = MultimodalRAG(context=ctx)
+    chain.ingest_docs(str(path), "deck.pptx")
+
+    hits = chain.document_search("revenue growth", num_docs=4)
+    assert any("revenue" in h["content"] for h in hits)
+
+    out = "".join(chain.rag_chain("how did revenue do?", []))
+    assert out == "revenue grew 20 percent"
+    # image caption was indexed alongside text
+    assert any(h.get("source") == "deck.pptx" for h in hits)
+
+    with pytest.raises(ValueError):
+        chain.ingest_docs(str(path), "deck.docx")
+
+
+# ------------------------------------------------------------ agentic rag
+
+
+def test_agentic_rag_accepts_grounded_answer(tmp_path, encoders):
+    from generativeaiexamples_tpu.chains.agentic_rag import AgenticRAG
+
+    ctx = make_ctx([], encoders)
+    chain = AgenticRAG(context=ctx)
+    doc = tmp_path / "kb.txt"
+    doc.write_text("Ring attention passes KV blocks around the ICI ring. "
+                   "It enables long-context prefill on TPU pods.")
+    chain.ingest_docs(str(doc), "kb.txt")
+
+    top_k = ctx.config.retriever.top_k
+    n_docs = min(top_k, 1)  # one chunk ingested
+    responses = (
+        [json.dumps({"score": "yes"})] * n_docs  # retrieval grader
+        + ["Ring attention enables long context."]  # generation
+        + [json.dumps({"score": "yes"})]  # hallucination grader
+        + [json.dumps({"score": "yes"})]  # answer grader
+    )
+    ctx.llm.responses = responses
+    out = "".join(chain.rag_chain("what does ring attention do?", []))
+    assert out == "Ring attention enables long context."
+
+
+def test_agentic_rag_rewrites_on_irrelevant_docs(tmp_path, encoders):
+    from generativeaiexamples_tpu.chains.agentic_rag import AgenticRAG
+
+    ctx = make_ctx([], encoders)
+    chain = AgenticRAG(context=ctx)
+    doc = tmp_path / "kb.txt"
+    doc.write_text("Bananas are yellow fruit rich in potassium.")
+    chain.ingest_docs(str(doc), "kb.txt")
+
+    responses = (
+        [json.dumps({"score": "no"})]      # grader rejects the one doc
+        + ["what color are bananas?"]       # rewriter
+        + [json.dumps({"score": "yes"})]    # grader accepts after rewrite
+        + ["Bananas are yellow."]           # generation
+        + [json.dumps({"score": "yes"})]    # hallucination grader
+        + [json.dumps({"score": "yes"})]    # answer grader
+    )
+    ctx.llm.responses = responses
+    out = "".join(chain.rag_chain("hue of the fruit?", []))
+    assert out == "Bananas are yellow."
+
+
+# ------------------------------------------------------- registry wiring
+
+
+def test_registry_knows_all_examples(encoders):
+    from generativeaiexamples_tpu.server.registry import _KNOWN
+
+    for name in ["basic_rag", "multi_turn_rag", "query_decomposition_rag",
+                 "structured_data_rag", "multimodal_rag", "agentic_rag"]:
+        assert name in _KNOWN
