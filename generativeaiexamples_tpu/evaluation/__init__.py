@@ -1,0 +1,16 @@
+"""Evaluation harness (ref: RAG/tools/evaluation/).
+
+The reference scores a deployed RAG stack two ways: the ragas metric suite
+(rag_evaluator/evaluator.py eval_ragas:97-162) and an LLM-as-judge Likert
+rating (eval_llm_judge:165-235), fed by an answer generator that drives the
+live /generate + /search endpoints (llm_answer_generator.py:29-60) and a
+synthetic QnA generator (synthetic_data_generator/data_generator.py:43).
+
+In-tree, the ragas metrics are implemented directly on the TPU embedder and
+the serving LLM (metrics.py) instead of importing the ragas library — same
+metric definitions, no external API keys.
+"""
+
+from generativeaiexamples_tpu.evaluation.metrics import (  # noqa: F401
+    EvalSample, RagasEvaluator, ragas_score)
+from generativeaiexamples_tpu.evaluation.judge import LLMJudge  # noqa: F401

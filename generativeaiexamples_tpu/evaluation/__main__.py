@@ -1,0 +1,98 @@
+"""Evaluation CLI (ref: rag_evaluator/main.py:26-83 flag surface).
+
+    python -m generativeaiexamples_tpu.evaluation \
+        --base_url http://localhost:8081 \
+        [--synthesize --docs DIR --ga_input qa.json] \
+        [--generate_answer --docs DIR --ga_input qa.json --ga_output eval.json] \
+        [--evaluate --ev_input eval.json --ev_result results --metrics ragas|judge_llm]
+
+The grader/judge LLM is the in-tree engine by default, or any
+OpenAI-compatible endpoint via APP_LLM_SERVER_URL (same seam as the chains,
+chains/llm_client.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+
+logging.basicConfig(level=logging.INFO)
+logger = logging.getLogger(__name__)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--base_url", default="http://localhost:8081",
+                        help="chain-server URL")
+    parser.add_argument("--synthesize", action="store_true",
+                        help="generate synthetic QnA pairs from --docs")
+    parser.add_argument("--generate_answer", action="store_true",
+                        help="generate answers through the RAG pipeline")
+    parser.add_argument("--evaluate", action="store_true",
+                        help="score an eval file")
+    parser.add_argument("--docs", default="", help="dataset folder")
+    parser.add_argument("--ga_input", default="",
+                        help="QnA JSON for answer generation")
+    parser.add_argument("--ga_output", default="eval.json",
+                        help="output eval file")
+    parser.add_argument("--ev_input", default="",
+                        help="eval JSON to score")
+    parser.add_argument("--ev_result", default="eval_result",
+                        help="result file prefix")
+    parser.add_argument("--metrics", default="judge_llm",
+                        choices=["ragas", "judge_llm"])
+    args = parser.parse_args()
+
+    if args.synthesize:
+        if not args.ga_input:
+            parser.error("--synthesize requires --ga_input (output QnA file)")
+        from generativeaiexamples_tpu.chains.llm_client import get_llm
+        from generativeaiexamples_tpu.evaluation.synthetic import (
+            generate_synthetic_data)
+
+        rows = generate_synthetic_data(get_llm(), args.docs, args.ga_input)
+        logger.info("synthesized %d QnA pairs → %s", len(rows), args.ga_input)
+
+    if args.generate_answer:
+        from generativeaiexamples_tpu.evaluation.answer_generator import (
+            generate_answers)
+
+        generate_answers(args.base_url, args.docs, args.ga_input,
+                         args.ga_output)
+
+    if args.evaluate:
+        with open(args.ev_input, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+        from generativeaiexamples_tpu.chains.llm_client import get_llm
+
+        llm = get_llm()
+        if args.metrics == "ragas":
+            from generativeaiexamples_tpu.encoders.embedder import Embedder
+            from generativeaiexamples_tpu.evaluation.metrics import (
+                EvalSample, RagasEvaluator)
+
+            samples = [EvalSample(
+                question=d["question"],
+                answer=d.get("generated_answer") or d.get("answer", ""),
+                contexts=(d.get("retrieved_context") or [])
+                if isinstance(d.get("retrieved_context"), list)
+                else [d.get("retrieved_context") or ""],
+                ground_truth=d.get("ground_truth_answer", ""),
+            ) for d in data]
+            result = RagasEvaluator(llm, Embedder()).evaluate(samples)
+        else:
+            from generativeaiexamples_tpu.evaluation.judge import LLMJudge
+
+            result = LLMJudge(llm).judge(data)
+        out = f"{args.ev_result}.json"
+        with open(out, "w", encoding="utf-8") as fh:
+            json.dump(result, fh, indent=2)
+        logger.info("results written to %s", out)
+        agg = result.get("aggregate") or {
+            "mean_rating": result.get("mean_rating")}
+        print(json.dumps(agg, indent=2))
+
+
+if __name__ == "__main__":
+    main()

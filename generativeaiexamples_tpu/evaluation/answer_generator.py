@@ -1,0 +1,128 @@
+"""Answer generator: drive the live chain server to build an eval file.
+
+Parity with the reference generator (ref: rag_evaluator/
+llm_answer_generator.py:29-60 generate_answers): upload every document in a
+folder to /documents, then for each QnA pair call /generate
+(use_knowledge_base=true, temperature 0.2, top_p 0.7, max_tokens 256) and
+/search (num_docs=1) and write rows with the generated answer and retrieved
+context alongside the ground truth.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import mimetypes
+import os
+from typing import Any, Dict, List, Optional
+
+import requests
+
+logger = logging.getLogger(__name__)
+
+GENERATE_PARAMS = {"use_knowledge_base": True, "temperature": 0.2,
+                   "top_p": 0.7, "max_tokens": 256}
+SEARCH_PARAMS = {"num_docs": 1}
+
+
+def upload_documents(folder_path: str, base_url: str) -> int:
+    """Upload every file in `folder_path` (ref upload_pdf_files; extended
+    to any loader-supported type since ingestion is in-tree)."""
+    count = 0
+    names = sorted(os.listdir(folder_path))
+    for i, name in enumerate(names, 1):
+        path = os.path.join(folder_path, name)
+        if not os.path.isfile(path):
+            continue
+        mime, _ = mimetypes.guess_type(path)
+        with open(path, "rb") as fh:
+            resp = requests.post(f"{base_url}/documents",
+                                 files={"file": (name, fh, mime)},
+                                 timeout=300)
+        if resp.status_code == 200:
+            count += 1
+        else:
+            logger.warning("upload %s failed: %s", name, resp.text[:200])
+        logger.info("uploaded %d/%d", i, len(names))
+    return count
+
+
+def _sse_text(resp) -> str:
+    """Collect the streamed content of a /generate SSE response."""
+    text = []
+    for raw in resp.iter_lines():
+        line = raw.decode() if isinstance(raw, bytes) else raw
+        if not line.startswith("data: "):
+            continue
+        data = line[len("data: "):]
+        if data == "[DONE]":
+            break
+        chunk = json.loads(data)
+        for choice in chunk.get("choices", []):
+            content = (choice.get("message") or {}).get("content", "")
+            if content:
+                text.append(content)
+    return "".join(text)
+
+
+def generate_answers(base_url: str, dataset_folder_path: str,
+                     qa_generation_file_path: str,
+                     eval_file_path: str,
+                     generate_api_params: Optional[Dict[str, Any]] = None,
+                     document_search_api_params: Optional[Dict[str, Any]] = None,
+                     ) -> List[Dict[str, Any]]:
+    """Upload docs, answer each QnA question through the RAG stack, save
+    the eval file (ref generate_answers, llm_answer_generator.py:59+)."""
+    base_url = base_url.rstrip("/")
+    gen_params = dict(GENERATE_PARAMS, **(generate_api_params or {}))
+    search_params = dict(SEARCH_PARAMS, **(document_search_api_params or {}))
+
+    if dataset_folder_path:
+        upload_documents(dataset_folder_path, base_url)
+
+    with open(qa_generation_file_path, "r", encoding="utf-8") as fh:
+        qa_pairs = json.load(fh)
+
+    rows: List[Dict[str, Any]] = []
+    for i, pair in enumerate(qa_pairs, 1):
+        question = pair["question"]
+        with requests.post(
+                f"{base_url}/generate",
+                json={"messages": [{"role": "user", "content": question}],
+                      **gen_params},
+                stream=True, timeout=600) as resp:
+            if resp.status_code != 200:
+                logger.warning("/generate failed for %r: %d %.200s",
+                               question, resp.status_code, resp.text)
+                answer = ""
+            else:
+                answer = _sse_text(resp)
+
+        search_resp = requests.post(
+            f"{base_url}/search",
+            json={"query": question,
+                  "top_k": search_params.get("num_docs", 1)},
+            timeout=120)
+        if search_resp.status_code != 200:
+            logger.warning("/search failed for %r: %d %.200s", question,
+                           search_resp.status_code, search_resp.text)
+            contexts: List[str] = []
+        else:
+            contexts = [c.get("content", "")
+                        for c in search_resp.json().get("chunks", [])]
+
+        rows.append({
+            "question": question,
+            "generated_answer": answer,
+            "answer": answer,
+            "retrieved_context": contexts,
+            "ground_truth_answer": pair.get("answer", ""),
+            "ground_truth_context": pair.get("context", ""),
+        })
+        logger.info("answered %d/%d", i, len(qa_pairs))
+
+    if eval_file_path:
+        with open(eval_file_path, "w", encoding="utf-8") as fh:
+            json.dump(rows, fh, indent=2)
+        logger.info("eval file written to %s", eval_file_path)
+    return rows

@@ -1,0 +1,97 @@
+"""LLM-as-judge evaluation: few-shot Likert 1–5 rating.
+
+Parity with the reference judge (ref: rag_evaluator/evaluator.py
+eval_llm_judge:165-235 + LLAMA_PROMPT_TEMPLATE:35-86): a few-shot prompt
+shows a 5-rated and a 1-rated example, the judge returns JSON
+{"Rating": n, "Explanation": ...}; ratings of 0 are clamped to 1 and the
+mean is reported (evaluator.py:215-219).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import statistics
+from typing import Any, Dict, List, Optional, Sequence
+
+from generativeaiexamples_tpu.chains.query_decomposition import extract_json
+
+logger = logging.getLogger(__name__)
+
+_SETTINGS = dict(max_tokens=200, temperature=0.1, top_p=1.0)
+
+SYS_PROMPT = (
+    "You are an impartial judge that evaluates the quality of an "
+    "assistant's answer to the question provided. Your evaluation takes "
+    "into account helpfulness, relevancy, accuracy, and level of detail of "
+    "the answer. You must use both the reference context and reference "
+    "answer to guide your evaluation.")
+
+_EXAMPLE_CTX = (
+    "On 8 September 2022, Buckingham Palace announced the Queen's doctors "
+    "were concerned for her health. She died peacefully at 15:10 BST at the "
+    "age of 96; Charles immediately succeeded as monarch.")
+
+FEW_SHOT = (
+    "Example 1:\n"
+    "[Question]\nWhen did Queen Elizabeth II die?\n"
+    f"[Reference Context]\n{_EXAMPLE_CTX}\n"
+    "[Reference Answer]\nQueen Elizabeth II died on September 8, 2022.\n"
+    "[Assistant's Answer]\nShe died on September 8, 2022\n"
+    '{"Rating": 5, "Explanation": "The answer is helpful, relevant, '
+    'accurate, and concise. It matches the reference context and answer."}\n'
+    "\nExample 2:\n"
+    "[Question]\nWhen did Queen Elizabeth II die?\n"
+    f"[Reference Context]\n{_EXAMPLE_CTX}\n"
+    "[Reference Answer]\nQueen Elizabeth II died on September 8, 2022.\n"
+    "[Assistant's Answer]\nQueen Elizabeth II was the longest reigning "
+    "monarch of the United Kingdom.\n"
+    '{"Rating": 1, "Explanation": "The answer is not helpful or relevant. '
+    'It does not answer the question."}\n')
+
+PROMPT_TEMPLATE = (
+    "{system_prompt}\n\n{few_shot}\n"
+    "Follow the exact same format as above. Rating must be between 1 and 5. "
+    "Return the rating and explanation for the following assistant's answer "
+    "as JSON.\n"
+    "[Question]\n{question}\n"
+    "[Reference Context]\n{ctx_ref}\n"
+    "[Reference Answer]\n{answer_ref}\n"
+    "[Assistant's Answer]\n{answer}\n")
+
+
+class LLMJudge:
+    def __init__(self, llm) -> None:
+        self.llm = llm
+
+    def judge_one(self, question: str, ground_truth_context: str,
+                  ground_truth_answer: str, answer: str) -> Dict[str, Any]:
+        prompt = PROMPT_TEMPLATE.format(
+            system_prompt=SYS_PROMPT, few_shot=FEW_SHOT, question=question,
+            ctx_ref=ground_truth_context, answer_ref=ground_truth_answer,
+            answer=answer)
+        raw = "".join(self.llm.chat(
+            [{"role": "user", "content": prompt}], **_SETTINGS))
+        parsed = extract_json(raw) or {}
+        rating: Optional[int] = None
+        try:
+            rating = int(parsed.get("Rating"))
+            rating = max(1, min(5, rating))  # clamp; 0→1 per evaluator.py:215
+        except (TypeError, ValueError):
+            logger.info("judge returned unparseable rating: %.120s", raw)
+        return {"rating": rating,
+                "explanation": str(parsed.get("Explanation", ""))}
+
+    def judge(self, samples: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+        """samples: dicts with question / ground_truth_context /
+        ground_truth_answer / answer keys (ref eval file schema)."""
+        results: List[Dict[str, Any]] = []
+        for d in samples:
+            res = self.judge_one(
+                d["question"], d.get("ground_truth_context", ""),
+                d.get("ground_truth_answer", ""), d["answer"])
+            results.append({**d, **res})
+        ratings = [r["rating"] for r in results if r["rating"]]
+        mean = round(statistics.mean(ratings), 1) if ratings else None
+        return {"results": results, "mean_rating": mean,
+                "num_rated": len(ratings)}

@@ -1,0 +1,206 @@
+"""Ragas-style RAG metrics on in-tree models.
+
+Implements the metric suite the reference gets from the ragas library
+(ref: rag_evaluator/evaluator.py:26-33 imports answer_relevancy,
+answer_similarity, context_precision, context_recall, context_relevancy,
+faithfulness; harmonic-mean "ragas_score" evaluator.py:95-97,154-158):
+
+  faithfulness       statements in the answer supported by the retrieved
+                     context (statement extraction + NLI-style verdicts)
+  answer_relevancy   cosine similarity between the question and questions
+                     regenerated from the answer
+  answer_similarity  embedding cosine between answer and ground truth
+  context_precision  average precision of retrieved chunks judged useful
+                     for the ground-truth answer
+  context_recall     ground-truth sentences attributable to the context
+  context_relevancy  context sentences needed to answer the question
+
+The grader LLM is any object with the `chat(messages, **settings)` iterator
+contract (chains/llm_client.py); embeddings come from encoders/embedder.py.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import statistics
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_GRADER_SETTINGS = dict(max_tokens=200, temperature=0.1, top_p=1.0)
+# ref evaluator.py:102-106 llm_params
+
+
+@dataclass
+class EvalSample:
+    """One row of the eval file (ref evaluator.py:126-131 keys)."""
+    question: str
+    answer: str
+    contexts: List[str] = field(default_factory=list)
+    ground_truth: str = ""
+
+
+def _sentences(text: str) -> List[str]:
+    parts = re.split(r"(?<=[.!?])\s+|\n+", text.strip())
+    return [p.strip() for p in parts if len(p.strip()) > 2]
+
+
+def _json_list(text: str) -> Optional[List[Any]]:
+    start = text.find("[")
+    if start == -1:
+        return None
+    depth = 0
+    for i in range(start, len(text)):
+        if text[i] == "[":
+            depth += 1
+        elif text[i] == "]":
+            depth -= 1
+            if depth == 0:
+                try:
+                    return json.loads(text[start:i + 1])
+                except json.JSONDecodeError:
+                    return None
+    return None
+
+
+class RagasEvaluator:
+    def __init__(self, llm, embedder) -> None:
+        self.llm = llm
+        self.embedder = embedder
+
+    # ------------------------------------------------------------ LLM utils
+
+    def _ask(self, prompt: str) -> str:
+        return "".join(self.llm.chat(
+            [{"role": "user", "content": prompt}], **_GRADER_SETTINGS))
+
+    def _verdict(self, prompt: str) -> bool:
+        out = self._ask(prompt + "\nAnswer with exactly one word: yes or no.")
+        return out.strip().lower().startswith("yes")
+
+    def _cosine(self, a: str, b: str) -> float:
+        va, vb = self.embedder.embed_queries([a, b])
+        return max(0.0, min(1.0, float(np.dot(va, vb))))
+
+    # -------------------------------------------------------------- metrics
+
+    def faithfulness(self, s: EvalSample) -> float:
+        """Fraction of answer statements inferable from the context."""
+        if not s.contexts:
+            return 0.0
+        raw = self._ask(
+            "Break the following answer into its individual factual "
+            "statements. Return a JSON list of strings only.\n\n"
+            f"Answer: {s.answer}")
+        statements = _json_list(raw) or _sentences(s.answer)
+        statements = [str(x) for x in statements][:10]
+        if not statements:
+            return 0.0
+        ctx = "\n".join(s.contexts)
+        supported = sum(
+            self._verdict(
+                f"Context:\n{ctx}\n\nStatement: {st}\n\n"
+                "Can the statement be directly inferred from the context?")
+            for st in statements)
+        return supported / len(statements)
+
+    def answer_relevancy(self, s: EvalSample, n_questions: int = 3) -> float:
+        """Mean cosine(question, questions regenerated from the answer)."""
+        raw = self._ask(
+            f"Generate {n_questions} questions that the following answer "
+            "directly answers. Return a JSON list of strings only.\n\n"
+            f"Answer: {s.answer}")
+        questions = [str(q) for q in (_json_list(raw) or [])][:n_questions]
+        if not questions:
+            return 0.0
+        vecs = self.embedder.embed_queries([s.question] + questions)
+        sims = np.clip(vecs[1:] @ vecs[0], 0.0, 1.0)
+        return float(np.mean(sims))
+
+    def answer_similarity(self, s: EvalSample) -> float:
+        """Embedding cosine between answer and ground truth."""
+        if not s.ground_truth:
+            return 0.0
+        return self._cosine(s.answer, s.ground_truth)
+
+    def context_precision(self, s: EvalSample) -> float:
+        """Average precision over retrieved chunks judged useful for
+        arriving at the ground truth."""
+        if not s.contexts:
+            return 0.0
+        verdicts = [
+            self._verdict(
+                f"Question: {s.question}\n"
+                f"Ground-truth answer: {s.ground_truth}\n\n"
+                f"Context chunk:\n{c}\n\n"
+                "Was this chunk useful in arriving at the answer?")
+            for c in s.contexts]
+        score, hits = 0.0, 0
+        for k, v in enumerate(verdicts, start=1):
+            if v:
+                hits += 1
+                score += hits / k
+        return score / hits if hits else 0.0
+
+    def context_recall(self, s: EvalSample) -> float:
+        """Fraction of ground-truth sentences attributable to the context."""
+        if not s.contexts or not s.ground_truth:
+            return 0.0
+        ctx = "\n".join(s.contexts)
+        sentences = _sentences(s.ground_truth)[:10]
+        if not sentences:
+            return 0.0
+        attributed = sum(
+            self._verdict(
+                f"Context:\n{ctx}\n\nSentence: {sent}\n\n"
+                "Can the sentence be attributed to the context?")
+            for sent in sentences)
+        return attributed / len(sentences)
+
+    def context_relevancy(self, s: EvalSample) -> float:
+        """Fraction of context sentences needed to answer the question."""
+        if not s.contexts:
+            return 0.0
+        sentences = [sent for c in s.contexts for sent in _sentences(c)][:20]
+        if not sentences:
+            return 0.0
+        needed = sum(
+            self._verdict(
+                f"Question: {s.question}\n\nSentence: {sent}\n\n"
+                "Is this sentence needed to answer the question?")
+            for sent in sentences)
+        return needed / len(sentences)
+
+    # ------------------------------------------------------------- driving
+
+    METRICS = ("faithfulness", "answer_relevancy", "answer_similarity",
+               "context_precision", "context_recall", "context_relevancy")
+
+    def evaluate_sample(self, s: EvalSample) -> Dict[str, float]:
+        row = {name: getattr(self, name)(s) for name in self.METRICS}
+        row["ragas_score"] = ragas_score(row)
+        return row
+
+    def evaluate(self, samples: Sequence[EvalSample]) -> Dict[str, Any]:
+        """Per-sample rows + aggregate means (ref evaluator.py:140-160)."""
+        rows = [self.evaluate_sample(s) for s in samples]
+        aggregate = {name: float(np.mean([r[name] for r in rows]))
+                     for name in self.METRICS} if rows else {}
+        if rows:
+            aggregate["ragas_score"] = ragas_score(aggregate)
+        return {"rows": rows, "aggregate": aggregate}
+
+
+def ragas_score(row: Dict[str, float]) -> float:
+    """Harmonic mean of faithfulness, context_relevancy, answer_relevancy,
+    context_recall (ref calculate_ragas_score, evaluator.py:95-97)."""
+    values = [row["faithfulness"], row["context_relevancy"],
+              row["answer_relevancy"], row["context_recall"]]
+    if any(v <= 0 for v in values):
+        return 0.0
+    return statistics.harmonic_mean(values)

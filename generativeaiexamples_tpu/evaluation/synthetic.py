@@ -1,0 +1,91 @@
+"""Synthetic QnA generation for eval sets.
+
+Parity with the reference generator (ref: synthetic_data_generator/
+data_generator.py:43 generate_synthetic_data + prompt:25-38): chunk each
+document (3000 chars / 100 overlap) and have the LLM emit two QnA pairs per
+chunk as JSON, accumulating {question, answer, context} rows.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Dict, List
+
+from generativeaiexamples_tpu.chains.loaders import load_document
+from generativeaiexamples_tpu.evaluation.metrics import _json_list
+
+logger = logging.getLogger(__name__)
+
+SYS_PROMPT = (
+    "Given the context paragraph, create two very good question answer "
+    "pairs. Your output should be strictly a JSON list of objects with "
+    'keys "question" and "answer". Restrict the questions to the context '
+    "information provided.")
+
+_SETTINGS = dict(max_tokens=300, temperature=0.2)
+CHUNK_SIZE = 3000   # chars (ref data_generator.py:47 text_splitter_params)
+CHUNK_OVERLAP = 100
+
+
+def _char_chunks(text: str, size: int = CHUNK_SIZE,
+                 overlap: int = CHUNK_OVERLAP) -> List[str]:
+    chunks = []
+    step = max(1, size - overlap)
+    for start in range(0, max(1, len(text)), step):
+        chunk = text[start:start + size]
+        if chunk.strip():
+            chunks.append(chunk)
+        if start + size >= len(text):
+            break
+    return chunks
+
+
+def _extract_pairs(raw: str) -> List[Dict[str, str]]:
+    data = _json_list(raw)
+    if data is None:  # maybe a single object or {question,answer} lines
+        from generativeaiexamples_tpu.chains.query_decomposition import (
+            extract_json)
+
+        obj = extract_json(raw)
+        data = [obj] if obj else []
+    pairs = []
+    for item in data:
+        if (isinstance(item, dict) and item.get("question")
+                and item.get("answer")):
+            pairs.append({"question": str(item["question"]),
+                          "answer": str(item["answer"])})
+    return pairs
+
+
+def generate_synthetic_data(llm, dataset_folder_path: str,
+                            qa_generation_file_path: str = "",
+                            max_chunks_per_doc: int = 0) -> List[Dict[str, Any]]:
+    """QnA pairs for every document in the folder; optionally saved as the
+    qa file consumed by answer_generator (ref data_generator.py:43-90)."""
+    rows: List[Dict[str, Any]] = []
+    for name in sorted(os.listdir(dataset_folder_path)):
+        path = os.path.join(dataset_folder_path, name)
+        if not os.path.isfile(path):
+            continue
+        try:
+            text = load_document(path)
+        except Exception as exc:
+            logger.warning("skipping %s: %s", name, exc)
+            continue
+        chunks = _char_chunks(text)
+        if max_chunks_per_doc:
+            chunks = chunks[:max_chunks_per_doc]
+        for chunk in chunks:
+            raw = "".join(llm.chat(
+                [{"role": "system", "content": SYS_PROMPT},
+                 {"role": "user", "content": f"[Context]\n{chunk}"}],
+                **_SETTINGS))
+            for pair in _extract_pairs(raw):
+                rows.append({**pair, "context": chunk, "source": name})
+        logger.info("%s: %d pairs so far", name, len(rows))
+    if qa_generation_file_path:
+        with open(qa_generation_file_path, "w", encoding="utf-8") as fh:
+            json.dump(rows, fh, indent=2)
+    return rows

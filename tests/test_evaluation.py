@@ -1,0 +1,149 @@
+"""Evaluation harness tests: ragas-style metrics, LLM judge, synthetic QnA.
+
+A scripted FakeLLM gives deterministic verdicts so the metric arithmetic
+(fractions, average precision, harmonic-mean ragas score, Likert clamping)
+is tested exactly; similarity metrics use the real tiny embedder.
+"""
+
+import json
+
+import pytest
+
+from generativeaiexamples_tpu.encoders.embedder import Embedder
+from generativeaiexamples_tpu.evaluation.judge import LLMJudge
+from generativeaiexamples_tpu.evaluation.metrics import (
+    EvalSample, RagasEvaluator, ragas_score)
+
+
+class FakeLLM:
+    def __init__(self, responses):
+        self.responses = list(responses)
+        self.calls = []
+
+    def chat(self, messages, **settings):
+        self.calls.append(messages[-1]["content"])
+        yield self.responses.pop(0) if self.responses else "no"
+
+
+@pytest.fixture(scope="module")
+def embedder():
+    return Embedder()
+
+
+def test_faithfulness_fraction(embedder):
+    # 2 statements, one supported
+    llm = FakeLLM([json.dumps(["Paris is in France", "Paris has 10M people"]),
+                   "yes", "no"])
+    ev = RagasEvaluator(llm, embedder)
+    s = EvalSample(question="q", answer="a", contexts=["Paris is in France."])
+    assert ev.faithfulness(s) == 0.5
+
+
+def test_faithfulness_no_context(embedder):
+    ev = RagasEvaluator(FakeLLM([]), embedder)
+    assert ev.faithfulness(EvalSample("q", "a")) == 0.0
+
+
+def test_context_precision_average_precision(embedder):
+    # verdicts [yes, no, yes] → AP = (1/1 + 2/3) / 2 = 5/6
+    llm = FakeLLM(["yes", "no", "yes"])
+    ev = RagasEvaluator(llm, embedder)
+    s = EvalSample("q", "a", contexts=["c1", "c2", "c3"], ground_truth="gt")
+    assert ev.context_precision(s) == pytest.approx(5 / 6)
+
+
+def test_context_recall_fraction(embedder):
+    llm = FakeLLM(["yes", "yes", "no"])
+    ev = RagasEvaluator(llm, embedder)
+    s = EvalSample("q", "a", contexts=["ctx"],
+                   ground_truth="First fact. Second fact. Third fact.")
+    assert ev.context_recall(s) == pytest.approx(2 / 3)
+
+
+def test_answer_similarity_identical_text(embedder):
+    ev = RagasEvaluator(FakeLLM([]), embedder)
+    s = EvalSample("q", "the TPU has 16 GB HBM",
+                   ground_truth="the TPU has 16 GB HBM")
+    assert ev.answer_similarity(s) == pytest.approx(1.0, abs=1e-3)
+
+
+def test_answer_relevancy_uses_regenerated_questions(embedder):
+    llm = FakeLLM([json.dumps(["how much HBM does the TPU have?"])])
+    ev = RagasEvaluator(llm, embedder)
+    s = EvalSample("how much HBM does the TPU have?", "16 GB")
+    assert ev.answer_relevancy(s) == pytest.approx(1.0, abs=1e-3)
+
+
+def test_ragas_score_harmonic_mean():
+    row = {"faithfulness": 1.0, "context_relevancy": 0.5,
+           "answer_relevancy": 1.0, "context_recall": 0.5}
+    assert ragas_score(row) == pytest.approx(4 / 6)
+    row["faithfulness"] = 0.0
+    assert ragas_score(row) == 0.0
+
+
+def test_evaluate_aggregates(embedder):
+    # single sample; every verdict "yes", statements/questions provided
+    llm = FakeLLM([json.dumps(["fact one"]), "yes",      # faithfulness
+                   json.dumps(["q again"]),               # answer_relevancy
+                   "yes",                                  # context_precision
+                   "yes",                                  # context_recall
+                   "yes"])                                 # context_relevancy
+    ev = RagasEvaluator(llm, embedder)
+    s = EvalSample("q again", "fact one", contexts=["fact one."],
+                   ground_truth="fact one")
+    result = ev.evaluate([s])
+    agg = result["aggregate"]
+    assert agg["faithfulness"] == 1.0
+    assert agg["ragas_score"] > 0.9
+    assert len(result["rows"]) == 1
+
+
+# ------------------------------------------------------------------ judge
+
+
+def test_judge_parses_and_means():
+    llm = FakeLLM([json.dumps({"Rating": 5, "Explanation": "good"}),
+                   json.dumps({"Rating": 0, "Explanation": "bad"}),
+                   "not json at all"])
+    judge = LLMJudge(llm)
+    samples = [{"question": "q1", "answer": "a1",
+                "ground_truth_answer": "g", "ground_truth_context": "c"}] * 3
+    out = judge.judge(samples)
+    ratings = [r["rating"] for r in out["results"]]
+    assert ratings == [5, 1, None]          # 0 clamped to 1; junk → None
+    assert out["mean_rating"] == 3.0
+    assert out["num_rated"] == 2
+    # few-shot prompt carried both examples
+    assert "Example 2" in llm.calls[0]
+
+
+# -------------------------------------------------------------- synthetic
+
+
+def test_synthetic_generation(tmp_path):
+    from generativeaiexamples_tpu.evaluation.synthetic import (
+        generate_synthetic_data)
+
+    doc = tmp_path / "notes.txt"
+    doc.write_text("TPU v5e has 16 GB HBM. " * 10)
+    llm = FakeLLM([json.dumps([
+        {"question": "How much HBM?", "answer": "16 GB"},
+        {"question": "Which TPU?", "answer": "v5e"}])])
+    out_file = tmp_path / "qa.json"
+    rows = generate_synthetic_data(llm, str(tmp_path),
+                                   str(out_file))
+    assert len(rows) == 2
+    assert rows[0]["question"] == "How much HBM?"
+    assert rows[0]["source"] == "notes.txt"
+    saved = json.loads(out_file.read_text())
+    assert saved == rows
+
+
+def test_char_chunks_overlap():
+    from generativeaiexamples_tpu.evaluation.synthetic import _char_chunks
+
+    text = "x" * 7000
+    chunks = _char_chunks(text, size=3000, overlap=100)
+    assert all(len(c) <= 3000 for c in chunks)
+    assert sum(len(c) for c in chunks) >= 7000  # full coverage w/ overlap
