@@ -223,20 +223,28 @@ def _unembed(cfg: LlamaConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
 def forward(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
             positions: Optional[jnp.ndarray] = None,
             attn_mask: Optional[jnp.ndarray] = None,
-            adapters: Optional[Params] = None) -> jnp.ndarray:
+            adapters: Optional[Params] = None,
+            attn_fn=None) -> jnp.ndarray:
     """Full-sequence causal LM: tokens (B, S) → logits (B, S, vocab) f32.
 
     Training/scoring path (no cache). `attn_mask` (B, S) marks valid tokens
-    for right-padded batches.
+    for right-padded batches. ``attn_fn(q, k, v) -> ctx`` overrides the
+    attention implementation (e.g. sequence-parallel ring attention); the
+    default is full-sequence `mha_prefill`.
     """
     B, S = tokens.shape
+    if attn_fn is not None and attn_mask is not None:
+        raise ValueError(
+            "attn_mask is ignored when attn_fn is supplied — encode padding "
+            "into attn_fn (e.g. sequence_parallel_attention's kv_lens)")
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
     h = params["embed"].astype(cfg.jdtype)[tokens]
     cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
 
-    attn = partial(mha_prefill, q_positions=positions, kv_positions=positions,
-                   kv_mask=attn_mask, causal=True)
+    attn = attn_fn if attn_fn is not None else partial(
+        mha_prefill, q_positions=positions, kv_positions=positions,
+        kv_mask=attn_mask, causal=True)
 
     def body(h, xs):
         layer, ad = xs
@@ -246,6 +254,33 @@ def forward(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
     # _maybe_lora sees an empty adapter dict — one code path either way.
     h, _ = jax.lax.scan(body, h, (params["layers"], adapters or {}))
     return _unembed(cfg, params, h)
+
+
+def forward_seq_parallel(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
+                         mesh, attn_mask: Optional[jnp.ndarray] = None,
+                         adapters: Optional[Params] = None,
+                         impl: str = "ring") -> jnp.ndarray:
+    """Long-context full-sequence forward, sequence-sharded over mesh["seq"].
+
+    Same math as :func:`forward`, but attention runs as ring attention (or
+    Ulysses all-to-all) via `parallel.ring_attention`, with activations laid
+    out (B, S/"seq", ...) so a context that would blow single-chip HBM is
+    spread over the ICI ring. Everything outside attention is pointwise in
+    the sequence dim, so XLA keeps the "seq" sharding end to end; callers
+    place ``tokens`` with P(("data" if present), "seq") and params per
+    LONG_CONTEXT_RULES. This is the §5.7 capability the reference lacks
+    (its long-context story is trimming retrieval to 1,500 tokens,
+    ref utils.py:103).
+    """
+    from generativeaiexamples_tpu.parallel.ring_attention import (
+        sequence_parallel_attention)
+
+    B, S = tokens.shape
+    kv_lens = (attn_mask.sum(-1).astype(jnp.int32) if attn_mask is not None
+               else jnp.full((B,), S, jnp.int32))
+    attn = partial(sequence_parallel_attention, mesh=mesh, impl=impl,
+                   kv_lens=kv_lens, causal=True)
+    return forward(params, cfg, tokens, adapters=adapters, attn_fn=attn)
 
 
 def _scan_cached_blocks(cfg: LlamaConfig, h: jnp.ndarray, params: Params,

@@ -12,6 +12,9 @@ from generativeaiexamples_tpu.parallel.mesh import (  # noqa: F401
     create_mesh,
     local_mesh,
 )
+from generativeaiexamples_tpu.parallel.ring_attention import (  # noqa: F401
+    sequence_parallel_attention,
+)
 from generativeaiexamples_tpu.parallel.sharding import (  # noqa: F401
     ShardingRules,
     logical_to_spec,

@@ -1,0 +1,177 @@
+"""Sequence/context parallelism: ring attention + Ulysses head-scatter.
+
+The reference has no long-context story at all — its context budget is
+retrieval-side trimming to 1,500 tokens (ref RAG/src/chain_server/utils.py:103
+``DEFAULT_MAX_CONTEXT`` and ``LimitRetrievedNodesLength``, utils.py:106-134)
+and the Megatron ``sequence_parallel`` knobs its notebooks never set (ref
+finetuning/Gemma/lora.ipynb cell 26 sets only TP/PP). Here long context is
+first-class: activations are sharded along the sequence dimension over the
+``seq`` mesh axis and attention runs as an SPMD program over the ICI ring.
+
+Two interchangeable strategies, both exposed through :func:`sequence_parallel_attention`:
+
+* **Ring attention** — each device keeps its local Q block resident and
+  rotates K/V blocks around the ring with ``lax.ppermute`` (one ICI hop per
+  step, n_seq steps total), accumulating blockwise softmax in the streaming
+  (m, l, acc) form — flash attention's online softmax, distributed. Works for
+  any head count; K/V traffic per step is (B, S/n, kv_heads, hd), which on a
+  v5e ring overlaps with the block matmul.
+* **Ulysses** — ``lax.all_to_all`` re-shards from sequence-split to
+  head-split, runs ordinary full attention locally (full sequence, H/n
+  heads), and re-shards back. Two all-to-alls instead of n ppermutes; needs
+  n_heads and kv_heads divisible by the axis size.
+
+Both compute causal masking from *global* positions derived from
+``lax.axis_index``, so results are bitwise-independent of the mesh size up to
+float reassociation. Validated against ``ops.attention.mha_prefill`` on an
+8-device CPU mesh (tests/test_ring_attention.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import NEG_INF, mha_prefill
+
+
+def _gqa_block_scores(q: jnp.ndarray, k: jnp.ndarray, scale: float) -> jnp.ndarray:
+    """(B,S,KV,G,D) x (B,T,KV,D) -> (B,KV,G,S,T) f32 scores (no repeat_kv)."""
+    return jnp.einsum("bskgd,btkd->bkgst", q.astype(jnp.float32),
+                      k.astype(jnp.float32)) * scale
+
+
+def _ring_body(q, k, v, kv_lens, *, axis_name: str, causal: bool):
+    """shard_map body: local Q stays, K/V rotate around ``axis_name``.
+
+    q: (B, S_loc, H, D); k, v: (B, T_loc, KV, D) — the local shards.
+    kv_lens: (B,) replicated global valid lengths (right-padded batches).
+    """
+    idx = lax.axis_index(axis_name)
+    n = lax.psum(1, axis_name)
+    B, S_loc, H, D = q.shape
+    T_loc, KV = k.shape[1], k.shape[2]
+    G = H // KV
+    scale = 1.0 / (D ** 0.5)
+
+    qg = q.reshape(B, S_loc, KV, G, D)
+    q_pos = idx * S_loc + jnp.arange(S_loc, dtype=jnp.int32)          # (S_loc,)
+    # ppermute: device i sends to i+1, so after t steps we hold chunk (i - t).
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    m0 = jnp.full((B, KV, G, S_loc), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, KV, G, S_loc), jnp.float32)
+    acc0 = jnp.zeros((B, KV, G, S_loc, D), jnp.float32)
+
+    def accumulate(t, k_c, v_c, m, l, acc):
+        src = (idx - t) % n
+        kv_pos = src * T_loc + jnp.arange(T_loc, dtype=jnp.int32)     # (T_loc,)
+        valid = kv_pos[None, :] < kv_lens[:, None]                    # (B, T_loc)
+        mask = valid[:, None, None, None, :]                          # (B,1,1,1,T)
+        if causal:
+            mask = mask & (kv_pos[None, :] <= q_pos[:, None])[None, None, None]
+        s = _gqa_block_scores(qg, k_c, scale)                         # (B,KV,G,S,T)
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # exp(NEG_INF - NEG_INF) would be 1 for rows with no live key yet;
+        # keep the correction 0 there so fully-masked blocks contribute nothing.
+        p = jnp.where(s > NEG_INF / 2, jnp.exp(s - m_new[..., None]), 0.0)
+        corr = jnp.where(m > NEG_INF / 2, jnp.exp(m - m_new), 0.0)
+        l = l * corr + p.sum(axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bkgst,btkd->bkgsd", p, v_c.astype(jnp.float32))
+        return m_new, l, acc
+
+    def maybe_accumulate(t, k_c, v_c, m, l, acc):
+        """Skip blocks entirely in the causal future (their mask is all-off).
+
+        Safe under shard_map: `accumulate` contains no collectives, so a
+        per-device predicate is fine. This halves attention FLOPs on average;
+        per-*step* wall clock is still set by the busiest device (the known
+        ring imbalance — a striped/zigzag chunk layout is the follow-up).
+        """
+        if not causal:
+            return accumulate(t, k_c, v_c, m, l, acc)
+        src = (idx - t) % n
+        live = src * T_loc <= idx * S_loc + (S_loc - 1)
+        return lax.cond(live,
+                        lambda ops: accumulate(t, *ops),
+                        lambda ops: (ops[2], ops[3], ops[4]),
+                        (k_c, v_c, m, l, acc))
+
+    def step(t, carry):
+        k_c, v_c, m, l, acc = carry
+        m, l, acc = maybe_accumulate(t, k_c, v_c, m, l, acc)
+        k_c = lax.ppermute(k_c, axis_name, perm)
+        v_c = lax.ppermute(v_c, axis_name, perm)
+        return k_c, v_c, m, l, acc
+
+    # last block accumulates outside the loop: no wasted final K/V rotation
+    k_c, v_c, m, l, acc = lax.fori_loop(0, n - 1, step, (k, v, m0, l0, acc0))
+    _, l, acc = maybe_accumulate(n - 1, k_c, v_c, m, l, acc)
+    out = acc / jnp.where(l > 0, l, 1.0)[..., None]        # (B, KV, G, S, D)
+    out = jnp.transpose(out, (0, 3, 1, 2, 4))              # -> (B, S, KV, G, D)
+    return out.reshape(B, S_loc, H, D).astype(q.dtype)
+
+
+def _ulysses_body(q, k, v, kv_lens, *, axis_name: str, causal: bool):
+    """shard_map body: all_to_all seq-split -> head-split, local full attention.
+
+    Requires n_heads % n == 0 and kv_heads % n == 0 (checked by the wrapper).
+    """
+    a2a = partial(lax.all_to_all, axis_name=axis_name, tiled=True)
+    qh = a2a(q, split_axis=2, concat_axis=1)     # (B, S, H/n, D)
+    kh = a2a(k, split_axis=2, concat_axis=1)     # (B, S, KV/n, D)
+    vh = a2a(v, split_axis=2, concat_axis=1)
+    B, S = qh.shape[0], qh.shape[1]
+    kv_mask = jnp.arange(S, dtype=jnp.int32)[None, :] < kv_lens[:, None]
+    out = mha_prefill(qh, kh, vh, kv_mask=kv_mask, causal=causal)
+    return a2a(out, split_axis=1, concat_axis=2)  # back to (B, S/n, H, D)
+
+
+def sequence_parallel_attention(
+        q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+        mesh: Mesh, axis: str = "seq", impl: str = "ring",
+        kv_lens: Optional[jnp.ndarray] = None,
+        causal: bool = True) -> jnp.ndarray:
+    """Causal self-attention with Q/K/V sharded on dim 1 over ``mesh[axis]``.
+
+    q: (B, S, H, D); k, v: (B, S, KV, D) — *global* shapes; dim 1 must be
+    divisible by the axis size. kv_lens: (B,) valid lengths for right-padded
+    batches (defaults to S). Composable under jit/scan: the shard_map is
+    closed over ``mesh`` and partitions only the sequence dimension, so head
+    and batch sharding from outer rules pass through untouched.
+    """
+    if impl not in ("ring", "ulysses"):
+        raise ValueError(f"impl must be 'ring' or 'ulysses', got {impl!r}")
+    n = mesh.shape[axis]
+    B, S, H, D = q.shape
+    KV = k.shape[2]
+    if S % n != 0:
+        raise ValueError(f"seq len {S} not divisible by {axis} axis size {n}")
+    # Batch and head sharding from the outer mesh pass straight through: the
+    # bodies are pointwise in batch and (for ring) in heads, so we map those
+    # dims onto their usual axes instead of forcing an all-gather.
+    data_ax = "data" if "data" in mesh.axis_names else None
+    tp_ax = "tensor" if "tensor" in mesh.axis_names else None
+    n_t = mesh.shape[tp_ax] if tp_ax else 1
+    if impl == "ulysses" and ((H // n_t) % n or (KV // n_t) % n):
+        raise ValueError(
+            f"ulysses needs per-TP-shard heads divisible by {axis} axis size: "
+            f"H={H}/{n_t} KV={KV}/{n_t} n={n}")
+    if kv_lens is None:
+        kv_lens = jnp.full((B,), S, jnp.int32)
+    body = {"ring": _ring_body, "ulysses": _ulysses_body}[impl]
+    seq_spec = P(data_ax, axis, tp_ax, None)
+    fn = jax.shard_map(
+        partial(body, axis_name=axis, causal=causal),
+        mesh=mesh,
+        in_specs=(seq_spec, seq_spec, seq_spec, P(data_ax)),
+        out_specs=seq_spec,
+        check_vma=False)
+    return fn(q, k, v, kv_lens)
