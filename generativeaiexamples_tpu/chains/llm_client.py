@@ -35,6 +35,10 @@ class LocalLLM:
                       temperature=temperature, top_p=top_p, top_k=top_k)
         self.scheduler.submit(req)
         yield from self.scheduler.iter_text(req)
+        # the scheduler rejects e.g. over-capacity prompts per-request
+        # (no silent truncation) — surface that instead of yielding ''
+        if req.error:
+            raise RuntimeError(f"LLM request failed: {req.error}")
 
 
 class RemoteLLM:

@@ -207,6 +207,7 @@ class EngineConfig:
     max_batch_size: int = configfield("max_batch_size", default=8, help_txt="Decode-slot capacity of the continuous batcher.")
     max_seq_len: int = configfield("max_seq_len", default=2048, help_txt="KV-cache length per slot.")
     page_size: int = configfield("page_size", default=128, help_txt="KV page granularity (tokens).")
+    num_pages: int = configfield("num_pages", default=0, help_txt="Physical KV pages in the pool (bounds HBM by live tokens); 0 = full slot capacity.")
     prefill_chunk: int = configfield("prefill_chunk", default=512, help_txt="Chunked-prefill bucket size.")
     dtype: str = configfield("dtype", default="bfloat16", help_txt="Activation/weight dtype.")
     attention: str = configfield("attention", default="auto", help_txt="Attention backend: auto (pallas on TPU, xla elsewhere) | pallas | xla.")

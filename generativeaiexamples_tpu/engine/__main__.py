@@ -41,7 +41,28 @@ def build_scheduler(tiny: bool = False) -> tuple:
             logging.warning("no checkpoint_dir set — serving RANDOM weights")
             params = llama.init_params(jax.random.PRNGKey(0), model_cfg)
         model_name = cfg.llm.model_name
-    core = EngineCore(model_cfg, cfg.engine, params, eos_id=tokenizer.eos_id)
+    # Tensor-parallel serving by config (ref INFERENCE_GPU_COUNT parity).
+    # Default (empty mesh_shape): the largest tensor degree that divides both
+    # head counts, remaining devices on "data" — so any device count boots
+    # (v5e-8 + 8 kv heads ⇒ pure tp=8). Tiny mode stays single-device unless
+    # a mesh is explicitly configured.
+    mesh = None
+    if cfg.engine.mesh_shape or (jax.device_count() > 1 and not tiny):
+        from generativeaiexamples_tpu.parallel import mesh as pmesh
+        if cfg.engine.mesh_shape:
+            mesh_cfg = pmesh.parse_mesh_shape(cfg.engine.mesh_shape,
+                                              pmesh.INFER_AXES)
+        else:
+            n = jax.device_count()
+            tp = max(t for t in range(1, n + 1)
+                     if n % t == 0 and model_cfg.n_heads % t == 0
+                     and model_cfg.n_kv_heads % t == 0)
+            mesh_cfg = pmesh.MeshConfig(axes=pmesh.INFER_AXES,
+                                        shape=(n // tp, tp))
+        mesh = pmesh.create_mesh(mesh_cfg)
+        logging.info("serving over mesh %s", dict(mesh.shape))
+    core = EngineCore(model_cfg, cfg.engine, params, eos_id=tokenizer.eos_id,
+                      mesh=mesh)
     return Scheduler(core, tokenizer), model_name
 
 

@@ -1,48 +1,63 @@
-"""Jitted serving core: prefill → slot insert → batched decode step.
+"""Jitted serving core: paged chunked prefill → slot activate → batched decode.
 
 Replaces the continuous-batching executor inside the reference's NIM
-container (TRT-LLM inflight batching; ref docker-compose-nim-ms.yaml:2-28).
+container (TRT-LLM inflight batching with paged attention; ref
+docker-compose-nim-ms.yaml:2-28, docs/architecture.md:49-61).
 TPU-first design constraints (SURVEY §7 "hard parts" #1-3):
 
   * **Static shapes everywhere.** The decode batch is a fixed-capacity slot
     array; requests are *inserted into* and *retired from* slots, the compiled
-    program never changes shape. Prompts are right-padded to a small set of
-    power-of-two buckets so prefill compiles once per bucket.
-  * **Prefill/decode disaggregation.** Prefill runs as its own jitted program
-    per request (batch=1, bucketed length), producing the slot's KV block and
-    first token; `insert` splices both into the decode state with
-    `dynamic_update_slice` (no host round-trip of KV).
+    program never changes shape. Prompts are processed in page-aligned chunks
+    (``prefill_chunk`` mid-chunks, a small power-of-two bucket ladder for the
+    final chunk), so prefill compiles once per bucket.
+  * **Paged KV.** KV lives in a single block-table paged pool
+    (engine/kv_cache.py): prefill chunks scatter whole pages, decode appends
+    one row per slot, HBM is bounded by live tokens. Chunked prefill writes
+    straight into the slot's pages — there is no separate prefill cache and
+    no KV splice on insert.
+  * **Chunked-prefill interleave.** Each chunk is its own dispatch, so the
+    scheduler can interleave decode steps between the chunks of a long
+    admission — active slots never stall for a whole prompt (the TTFT vs
+    tok/s tradeoff of SURVEY hard-part #2). Long prompts are chunked, never
+    truncated.
+  * **Tensor-parallel over a device mesh.** Given a mesh, params are placed
+    by `parallel.sharding.INFERENCE_RULES` (heads/kv-heads/mlp split over
+    "tensor"), the KV pool is sharded on its kv-head axis, and XLA inserts
+    the activation collectives — the same TP-by-config the reference gets
+    from ``INFERENCE_GPU_COUNT`` (docker-compose-nim-ms.yaml:18-20).
   * **Per-slot sampling.** temperature/top-k/top-p ride the decode state as
     traced (B,) vectors (`sample_logits_dynamic`), so one compiled decode step
     serves heterogeneous requests.
-  * **Dispatch-ahead streaming.** `decode_step` returns small (B,) arrays;
-    the host only syncs on those, never on the KV cache.
+  * **Dispatch-ahead streaming.** `decode` returns small (B,) arrays; the
+    host only syncs on those, never on the KV pool.
 
 All functions are pure; `EngineCore` owns the jitted callables and the donate
-annotations (cache buffers are donated through insert/decode to avoid copies).
+annotations (the paged pool is donated through every chunk/decode step).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from dataclasses import dataclass
-from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from generativeaiexamples_tpu.core.config import EngineConfig
+from generativeaiexamples_tpu.engine import kv_cache
+from generativeaiexamples_tpu.engine.kv_cache import PagedKVCache
 from generativeaiexamples_tpu.models import llama
 from generativeaiexamples_tpu.ops.sampling import sample_logits_dynamic
 
 
 @jax.tree_util.register_pytree_node_class
-@dataclass
+@dataclasses.dataclass
 class DecodeState:
     """Fixed-capacity slot batch for continuous decoding."""
 
-    cache: llama.KVCache      # (L, B, T, n_kv, hd); lengths (B,)
+    cache: PagedKVCache       # paged pool; lengths (B,)
     tokens: jnp.ndarray       # (B,) last token per slot
     active: jnp.ndarray       # (B,) bool — slot currently generating
     generated: jnp.ndarray    # (B,) tokens generated so far per slot
@@ -62,54 +77,99 @@ class DecodeState:
         return cls(*c)
 
 
-def _round_up_bucket(n: int, buckets: Tuple[int, ...]) -> int:
-    for b in buckets:
-        if n <= b:
-            return b
-    raise ValueError(f"prompt length {n} exceeds largest prefill bucket {buckets[-1]}")
-
-
 class EngineCore:
     """Owns params + jitted programs. Thread-safety: call from one driver
     thread (the scheduler); jax dispatch itself is async."""
 
     def __init__(self, model_cfg: llama.LlamaConfig, engine_cfg: EngineConfig,
                  params: llama.Params, eos_id: int,
-                 adapters: Optional[llama.Params] = None) -> None:
+                 adapters: Optional[llama.Params] = None,
+                 mesh: Optional[Mesh] = None) -> None:
+        self.mesh = mesh
+        tp = int(mesh.shape.get("tensor", 1)) if mesh is not None else 1
         attn = engine_cfg.attention
         if attn == "auto":
-            # pallas kernels assume unsharded head layouts; the engine runs
-            # the model unsharded today, so TPU ⇒ pallas is safe. When TP
-            # sharding lands here, this gate must also check the mesh.
-            attn = "pallas" if jax.default_backend() == "tpu" else "xla"
+            # The pallas kernels assume head-axis-unsharded layouts; with TP
+            # over heads the XLA path (shardable by the partitioner) is used
+            # until the kernels grow a shard_map wrapper.
+            attn = ("pallas" if jax.default_backend() == "tpu" and tp == 1
+                    else "xla")
         if attn != model_cfg.attn_impl:
             model_cfg = dataclasses.replace(model_cfg, attn_impl=attn)
+        if tp > 1:
+            if model_cfg.n_kv_heads % tp or model_cfg.n_heads % tp:
+                raise ValueError(
+                    f"tensor parallel degree {tp} must divide heads "
+                    f"({model_cfg.n_heads}) and kv heads "
+                    f"({model_cfg.n_kv_heads}) — set engine.mesh_shape "
+                    f"(APP_ENGINE_MESH_SHAPE), e.g. 'DxT' with a dividing T")
         self.model_cfg = model_cfg
         self.cfg = engine_cfg
-        self.params = params
-        self.adapters = adapters
         self.eos_id = eos_id
         self.batch = engine_cfg.max_batch_size
         self.max_seq = engine_cfg.max_seq_len
-        # prefill buckets: powers of two from 64 (or prefill_chunk) to max
+        self.page_size = engine_cfg.page_size
+        self.chunk = engine_cfg.prefill_chunk
+        if self.chunk % self.page_size:
+            raise ValueError(
+                f"prefill_chunk ({self.chunk}) must be a multiple of "
+                f"page_size ({self.page_size})")
+        if self.max_seq % self.chunk:
+            # guarantees every chunk (mid or final bucket) stays inside the
+            # block-table row — a clamped page scatter would silently corrupt
+            # earlier pages
+            raise ValueError(
+                f"max_seq_len ({self.max_seq}) must be a multiple of "
+                f"prefill_chunk ({self.chunk})")
+        self.max_pages_per_slot = -(-self.max_seq // self.page_size)
+        # total physical pages: 0 = full slot capacity (+ null page 0)
+        self.num_pages = (engine_cfg.num_pages or
+                          self.batch * self.max_pages_per_slot + 1)
+        # final-chunk buckets: page-aligned powers of two up to the chunk size
         buckets = []
-        b = min(64, engine_cfg.prefill_chunk)
-        while b < min(engine_cfg.prefill_chunk * 4, self.max_seq):
+        b = self.page_size
+        while b < self.chunk:
             buckets.append(b)
             b *= 2
-        buckets.append(min(engine_cfg.prefill_chunk * 4, self.max_seq))
-        self.buckets = tuple(sorted(set(buckets)))
+        buckets.append(self.chunk)
+        self.buckets = tuple(buckets)
 
-        self._prefill = jax.jit(self._prefill_impl)
-        self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
-        self._decode = jax.jit(self._decode_impl, donate_argnums=(0,))
+        if mesh is not None:
+            from generativeaiexamples_tpu.parallel import sharding as psh
+            params = psh.shard_params(
+                params, llama.logical_axes(model_cfg),
+                psh.INFERENCE_RULES, mesh)
+            if adapters is not None:
+                adapters = jax.device_put(
+                    adapters, NamedSharding(mesh, P()))
+            # KV pool: shard the kv-head axis over "tensor" so each chip
+            # holds its heads' pages; page/block dims stay local.
+            self._kv_sharding = NamedSharding(
+                mesh, P(None, None, None, "tensor", None))
+            self._replicated = NamedSharding(mesh, P())
+        else:
+            self._kv_sharding = None
+            self._replicated = None
+        self.params = params
+        self.adapters = adapters
+
+        self._chunk_fn = jax.jit(self._chunk_impl, donate_argnums=(0,))
+        self._decode_fn = jax.jit(self._decode_impl, donate_argnums=(0,))
+        self._activate_fn = jax.jit(self._activate_impl, donate_argnums=(0,))
+        self._release_fn = jax.jit(self._release_impl, donate_argnums=(0,))
+        self._sample_fn = jax.jit(self._sample_impl)
 
     # ------------------------------------------------------------------ state
 
     def init_state(self, rng: Optional[jax.Array] = None) -> DecodeState:
         B = self.batch
-        cache = llama.KVCache.create(self.model_cfg, B, self.max_seq)
-        return DecodeState(
+        # The KV pool is the big buffer: under a mesh, allocate it directly
+        # with its target sharding (never materialized on one chip).
+        cache = PagedKVCache.create(self.model_cfg, B, self.num_pages,
+                                    self.page_size,
+                                    kv_sharding=self._kv_sharding,
+                                    aux_sharding=self._replicated)
+        state = DecodeState(
             cache=cache,
             tokens=jnp.zeros((B,), jnp.int32),
             active=jnp.zeros((B,), bool),
@@ -120,72 +180,107 @@ class EngineCore:
             top_p=jnp.ones((B,), jnp.float32),
             rng=rng if rng is not None else jax.random.PRNGKey(0),
         )
+        if self.mesh is not None:
+            rest = jax.device_put(
+                (state.tokens, state.active, state.generated, state.max_gen,
+                 state.temperature, state.top_k, state.top_p, state.rng),
+                self._replicated)
+            state = DecodeState(cache, *rest)
+        return state
+
+    def new_allocator(self) -> kv_cache.PageAllocator:
+        return kv_cache.PageAllocator(self.num_pages)
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages required so positions 0..n_tokens (inclusive next-write) fit."""
+        return n_tokens // self.page_size + 1
+
+    def put_table(self, table: np.ndarray) -> jax.Array:
+        """Host block table → device (replicated under a mesh)."""
+        arr = jnp.asarray(table, jnp.int32)
+        if self.mesh is not None:
+            arr = jax.device_put(arr, self._replicated)
+        return arr
 
     # ---------------------------------------------------------------- prefill
 
-    def _prefill_impl(self, params, tokens, true_len, rng, temperature, top_k, top_p):
-        """tokens (1, S_bucket) right-padded; true_len (1,). Returns first
-        sampled token (1,) and the prefill KV block (L, 1, S, kv, hd)."""
-        cache = llama.KVCache.create(self.model_cfg, 1, tokens.shape[1])
-        logits, cache = llama.prefill(
-            params, self.model_cfg, tokens, cache,
-            start_pos=jnp.zeros((1,), jnp.int32), seq_lens=true_len,
-            adapters=self.adapters, last_only=True)
-        first_tok = sample_logits_dynamic(rng, logits[:, 0], temperature,
-                                          top_k, top_p)
-        return first_tok, cache.k, cache.v
+    def _chunk_impl(self, state: DecodeState, tokens, page_row, slot,
+                    start_pos, chunk_len) -> Tuple[DecodeState, jnp.ndarray]:
+        logits, cache = kv_cache.prefill_chunk(
+            self.params, self.model_cfg, tokens, state.cache, page_row, slot,
+            start_pos, chunk_len, adapters=self.adapters)
+        return dataclasses.replace(state, cache=cache), logits[0]
 
-    def prefill(self, prompt_ids, temperature: float, top_k: int, top_p: float,
-                rng: jax.Array):
-        """Host wrapper: bucket/pad the prompt, run the jitted prefill."""
-        n = len(prompt_ids)
-        S = _round_up_bucket(n, self.buckets)
-        padded = jnp.zeros((1, S), jnp.int32).at[0, :n].set(
-            jnp.asarray(prompt_ids, jnp.int32))
-        return self._prefill(
-            self.params, padded, jnp.array([n], jnp.int32), rng,
-            jnp.array([temperature], jnp.float32),
-            jnp.array([top_k], jnp.int32), jnp.array([top_p], jnp.float32))
+    def prefill_chunk(self, state: DecodeState, chunk_ids, page_row, slot: int,
+                      start_pos: int) -> Tuple[DecodeState, jax.Array]:
+        """Host wrapper: pad the chunk to a bucket, run the jitted chunk.
 
-    # ----------------------------------------------------------------- insert
+        chunk_ids: the token ids of this chunk (<= prefill_chunk of them);
+        page_row: (max_pages_per_slot,) int32 block-table row for the slot.
+        Returns (state, last-position logits (V,)) — callers sample from the
+        logits only on the final chunk.
+        """
+        n = len(chunk_ids)
+        S = next(b for b in self.buckets if n <= b)
+        padded = np.zeros((1, S), np.int32)
+        padded[0, :n] = chunk_ids
+        return self._chunk_fn(
+            state, jnp.asarray(padded), jnp.asarray(page_row, jnp.int32),
+            jnp.int32(slot), jnp.int32(start_pos), jnp.int32(n))
 
-    def _insert_impl(self, state: DecodeState, k_pre, v_pre, first_tok,
-                     slot, length, max_gen, temperature, top_k, top_p) -> DecodeState:
-        """Splice a prefilled request into decode slot ``slot``."""
-        L = self.model_cfg.n_layers
-        S = k_pre.shape[2]
-        zeros5 = (jnp.int32(0),) * 5
-        # write (L, 1, S, kv, hd) into (L, B, T, kv, hd) at batch=slot
-        idx = (jnp.int32(0), slot, jnp.int32(0), jnp.int32(0), jnp.int32(0))
-        k = jax.lax.dynamic_update_slice(state.cache.k, k_pre, idx)
-        v = jax.lax.dynamic_update_slice(state.cache.v, v_pre, idx)
+    def _sample_impl(self, logits, rng, temperature, top_k, top_p):
+        return sample_logits_dynamic(rng, logits[None], temperature[None],
+                                     top_k[None], top_p[None])[0]
+
+    def sample(self, logits: jax.Array, rng: jax.Array, temperature: float,
+               top_k: int, top_p: float) -> int:
+        """Sample one token from final-chunk logits (host sync point: TTFT)."""
+        tok = self._sample_fn(logits, rng, jnp.float32(temperature),
+                              jnp.int32(top_k), jnp.float32(top_p))
+        return int(jax.device_get(tok))
+
+    # --------------------------------------------------------- slot lifecycle
+
+    def _activate_impl(self, state: DecodeState, slot, token, generated,
+                       max_gen, temperature, top_k, top_p) -> DecodeState:
         upd = lambda arr, val: arr.at[slot].set(val)
-        return DecodeState(
-            cache=llama.KVCache(k=k, v=v, lengths=upd(state.cache.lengths, length)),
-            tokens=upd(state.tokens, first_tok),
+        return dataclasses.replace(
+            state,
+            tokens=upd(state.tokens, token),
             active=upd(state.active, True),
-            generated=upd(state.generated, 1),
+            generated=upd(state.generated, generated),
             max_gen=upd(state.max_gen, max_gen),
             temperature=upd(state.temperature, temperature),
             top_k=upd(state.top_k, top_k),
             top_p=upd(state.top_p, top_p),
-            rng=state.rng,
         )
 
-    def insert(self, state: DecodeState, prefill_result, slot: int, length: int,
-               max_gen: int, temperature: float, top_k: int, top_p: float) -> DecodeState:
-        first_tok, k_pre, v_pre = prefill_result
-        return self._insert(
-            state, k_pre, v_pre, first_tok[0], jnp.int32(slot),
-            jnp.int32(length), jnp.int32(max_gen), jnp.float32(temperature),
-            jnp.int32(top_k), jnp.float32(top_p))
+    def activate(self, state: DecodeState, slot: int, token: int,
+                 generated: int, max_gen: int, temperature: float, top_k: int,
+                 top_p: float) -> DecodeState:
+        """Start decoding a prefilled slot (its lengths were set by the last
+        chunk; ``generated`` counts tokens already produced, >=1)."""
+        return self._activate_fn(
+            state, jnp.int32(slot), jnp.int32(token), jnp.int32(generated),
+            jnp.int32(max_gen), jnp.float32(temperature), jnp.int32(top_k),
+            jnp.float32(top_p))
+
+    def _release_impl(self, state: DecodeState, slot) -> DecodeState:
+        return dataclasses.replace(state,
+                                   active=state.active.at[slot].set(False))
+
+    def release(self, state: DecodeState, slot: int) -> DecodeState:
+        """Deactivate a slot (preemption); its pages may be reused at once —
+        subsequent decode writes for the slot go to the null page."""
+        return self._release_fn(state, jnp.int32(slot))
 
     # ----------------------------------------------------------------- decode
 
-    def _decode_impl(self, state: DecodeState, params) -> Tuple[DecodeState, Dict[str, Any]]:
-        logits, cache = llama.decode_step(
-            params, self.model_cfg, state.tokens, state.cache,
-            adapters=self.adapters)
+    def _decode_impl(self, state: DecodeState, page_table
+                     ) -> Tuple[DecodeState, Dict[str, Any]]:
+        logits, cache = kv_cache.decode_step(
+            self.params, self.model_cfg, state.tokens, state.cache,
+            page_table, state.active, adapters=self.adapters)
         rng, sub = jax.random.split(state.rng)
         sampled = sample_logits_dynamic(sub, logits, state.temperature,
                                         state.top_k, state.top_p)
@@ -197,20 +292,19 @@ class EngineCore:
         active = state.active & ~done
         # inactive slots keep their old lengths so cache positions stay put
         lengths = jnp.where(state.active, cache.lengths, state.cache.lengths)
-        new_state = DecodeState(
-            cache=llama.KVCache(k=cache.k, v=cache.v, lengths=lengths),
+        new_state = dataclasses.replace(
+            state,
+            cache=PagedKVCache(k=cache.k, v=cache.v, lengths=lengths),
             tokens=jnp.where(state.active, sampled, state.tokens),
             active=active,
             generated=generated,
-            max_gen=state.max_gen,
-            temperature=state.temperature,
-            top_k=state.top_k,
-            top_p=state.top_p,
             rng=rng,
         )
         out = {"sampled": sampled, "emitted": state.active, "done": done,
                "hit_eos": hit_eos}
         return new_state, out
 
-    def decode(self, state: DecodeState) -> Tuple[DecodeState, Dict[str, Any]]:
-        return self._decode(state, self.params)
+    def decode(self, state: DecodeState, page_table: jax.Array
+               ) -> Tuple[DecodeState, Dict[str, Any]]:
+        """One decode step over all slots; ``page_table`` from `put_table`."""
+        return self._decode_fn(state, page_table)

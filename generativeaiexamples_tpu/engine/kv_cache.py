@@ -1,0 +1,221 @@
+"""Block-table paged KV cache + paged prefill/decode passes.
+
+The TPU-native counterpart of the paged inflight-batching KV management the
+reference gets from its NIM/TRT-LLM container (ref: docs/architecture.md:49-61
+— "paged attention", inflight batching). Design constraints that differ from
+the GPU original:
+
+  * **One physical pool, static shapes.** K/V live in a single
+    ``(L, P, page, KV, HD)`` buffer; a request owns an ordered list of page
+    ids (its row of the block table). Compiled programs never change shape —
+    growing a sequence is a host-side page-id append, not a reallocation.
+  * **Writes are scatters at page granularity; reads are gathers.** A prefill
+    chunk is page-aligned (``prefill_chunk % page_size == 0``), so its KV
+    scatters whole pages (`.at[pages].set`). Decode writes one (page, offset)
+    row per slot. Attention reads gather the slot's pages into a dense view —
+    XLA keeps the gather on-chip — and reuse the exact same flash/ragged
+    kernels as the dense path (ops/pallas/attention.py), so the pallas DMA
+    length-clamping still skips dead *blocks* within the gathered view.
+  * **Page 0 is the null page.** Slots that are inactive during a decode step
+    still execute the (unconditional, statically shaped) write; their write
+    row is redirected to page 0, which no request ever owns. Freed pages can
+    therefore be re-issued immediately without a device-side barrier.
+
+HBM held by the cache is ``num_pages × page_size`` tokens — bounded by live
+tokens (plus page-rounding), not ``max_batch × max_seq`` slot capacity.
+
+The host-side :class:`PageAllocator` is a free-list; admission and decode in
+engine/scheduler.py allocate/free against it and mirror the block table.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.ops import pallas as pallas_ops
+from generativeaiexamples_tpu.ops.attention import mha_decode, mha_prefill
+from generativeaiexamples_tpu.ops.layers import rotary_embedding
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class PagedKVCache:
+    """Paged KV pool: k, v (L, P, page_size, KV, HD); lengths (B,)."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    lengths: jnp.ndarray
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.lengths), None
+
+    @classmethod
+    def tree_unflatten(cls, _, children):
+        return cls(*children)
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+    @staticmethod
+    def create(cfg: llama.LlamaConfig, batch: int, num_pages: int,
+               page_size: int, kv_sharding=None,
+               aux_sharding=None) -> "PagedKVCache":
+        """Allocate the pool; shardings (if given) apply at creation so the
+        multi-GB k/v buffers are never materialized on a single chip."""
+        shape = (cfg.n_layers, num_pages, page_size, cfg.n_kv_heads,
+                 cfg.head_dim)
+        return PagedKVCache(
+            k=jnp.zeros(shape, cfg.jdtype, device=kv_sharding),
+            v=jnp.zeros(shape, cfg.jdtype, device=kv_sharding),
+            lengths=jnp.zeros((batch,), jnp.int32, device=aux_sharding))
+
+
+class PageAllocator:
+    """Host-side free-list over physical pages 1..num_pages-1 (0 = null)."""
+
+    def __init__(self, num_pages: int) -> None:
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        self.num_pages = num_pages
+        self._free: deque = deque(range(1, num_pages))
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Pop n pages, or None (and no change) if fewer are free."""
+        if n > len(self._free):
+            return None
+        return [self._free.popleft() for _ in range(n)]
+
+    def free(self, pages: Iterable[int]) -> None:
+        for p in pages:
+            if not 1 <= p < self.num_pages:
+                raise ValueError(f"freeing invalid page id {p}")
+            self._free.append(p)
+
+
+# ---------------------------------------------------------------------------
+# Paged forward passes (jitted by engine/engine.py)
+# ---------------------------------------------------------------------------
+
+def prefill_chunk(params: llama.Params, cfg: llama.LlamaConfig,
+                  tokens: jnp.ndarray, cache: PagedKVCache,
+                  page_row: jnp.ndarray, slot: jnp.ndarray,
+                  start_pos: jnp.ndarray, chunk_len: jnp.ndarray,
+                  adapters: Optional[llama.Params] = None,
+                  ) -> Tuple[jnp.ndarray, PagedKVCache]:
+    """One chunk of paged prompt processing for a single slot.
+
+    tokens: (1, C) right-padded chunk, C page-aligned; page_row: (max_pages,)
+    the slot's block-table row; start_pos: scalar absolute position of the
+    chunk (a multiple of the engine's chunk size); chunk_len: scalar valid
+    tokens in this chunk. Returns logits at the last valid position (1, V)
+    and the cache with the chunk's KV scattered into the slot's pages and
+    ``lengths[slot] = start_pos + chunk_len``.
+    """
+    _, C = tokens.shape
+    ps = cache.page_size
+    if C % ps != 0:
+        raise ValueError(f"chunk size {C} must be page-aligned (page={ps})")
+    n_cp = C // ps
+    maxp = page_row.shape[0]
+    T = maxp * ps
+    KV, HD = cfg.n_kv_heads, cfg.head_dim
+
+    positions = start_pos + jnp.arange(C, dtype=jnp.int32)[None]    # (1, C)
+    h = params["embed"].astype(cfg.jdtype)[tokens]
+    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
+    valid_through = (start_pos + chunk_len)[None]                   # (1,)
+    chunk_pages = jax.lax.dynamic_slice(page_row, (start_pos // ps,), (n_cp,))
+    cache_positions = jnp.arange(T, dtype=jnp.int32)[None]          # (1, T)
+
+    use_pallas = (cfg.attn_impl == "pallas"
+                  and pallas_ops.prefill_supported(C, T, HD))
+
+    def attn_and_update(q, k, v, k_l, v_l):
+        new_k_l = k_l.at[chunk_pages].set(
+            k.astype(k_l.dtype).reshape(n_cp, ps, KV, HD))
+        new_v_l = v_l.at[chunk_pages].set(
+            v.astype(v_l.dtype).reshape(n_cp, ps, KV, HD))
+        k_dense = new_k_l[page_row].reshape(1, T, KV, HD)
+        v_dense = new_v_l[page_row].reshape(1, T, KV, HD)
+        if use_pallas:
+            ctx = pallas_ops.flash_prefill(
+                q, k_dense, v_dense, start_pos=start_pos[None],
+                kv_valid_through=valid_through)
+        else:
+            ctx = mha_prefill(
+                q, k_dense, v_dense, q_positions=positions,
+                kv_positions=cache_positions,
+                kv_mask=cache_positions < valid_through[:, None], causal=True)
+        return ctx, new_k_l, new_v_l
+
+    h, k_stack, v_stack = llama.scan_blocks(
+        cfg, h, params, (cache.k, cache.v), cos, sin, attn_and_update,
+        adapters)
+    h_last = jnp.take_along_axis(
+        h, (chunk_len - 1)[None, None, None].astype(jnp.int32), axis=1)
+    logits = llama._unembed(cfg, params, h_last)[:, 0]               # (1, V)
+    new_lengths = cache.lengths.at[slot].set(start_pos + chunk_len)
+    return logits, PagedKVCache(k=k_stack, v=v_stack, lengths=new_lengths)
+
+
+def decode_step(params: llama.Params, cfg: llama.LlamaConfig,
+                tokens: jnp.ndarray, cache: PagedKVCache,
+                page_table: jnp.ndarray, write_mask: jnp.ndarray,
+                adapters: Optional[llama.Params] = None,
+                ) -> Tuple[jnp.ndarray, PagedKVCache]:
+    """One paged decode step for every slot in the batch.
+
+    tokens: (B,) last sampled token per slot; page_table: (B, max_pages);
+    write_mask: (B,) bool — slots allowed to append (inactive slots write to
+    the null page instead). Returns logits (B, V) and the cache with
+    ``lengths + 1`` (the engine restores lengths of inactive slots).
+    """
+    B = tokens.shape[0]
+    ps = cache.page_size
+    maxp = page_table.shape[1]
+    T = maxp * ps
+    KV, HD = cfg.n_kv_heads, cfg.head_dim
+
+    positions = cache.lengths[:, None]                               # (B, 1)
+    h = params["embed"].astype(cfg.jdtype)[tokens[:, None]]
+    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
+    new_lengths = cache.lengths + 1
+
+    batch_ix = jnp.arange(B, dtype=jnp.int32)
+    rows = jnp.where(write_mask,
+                     page_table[batch_ix, cache.lengths // ps],
+                     jnp.int32(0))
+    offs = cache.lengths % ps
+
+    use_pallas = (cfg.attn_impl == "pallas"
+                  and pallas_ops.decode_supported(T, HD))
+    attn = pallas_ops.ragged_decode if use_pallas else mha_decode
+
+    def attn_and_update(q, k, v, k_l, v_l):
+        new_k_l = k_l.at[rows, offs].set(k[:, 0].astype(k_l.dtype))
+        new_v_l = v_l.at[rows, offs].set(v[:, 0].astype(v_l.dtype))
+        k_dense = new_k_l[page_table].reshape(B, T, KV, HD)
+        v_dense = new_v_l[page_table].reshape(B, T, KV, HD)
+        ctx = attn(q, k_dense, v_dense, new_lengths)
+        return ctx, new_k_l, new_v_l
+
+    h, k_stack, v_stack = llama.scan_blocks(
+        cfg, h, params, (cache.k, cache.v), cos, sin, attn_and_update,
+        adapters)
+    logits = llama._unembed(cfg, params, h)[:, 0]
+    return logits, PagedKVCache(k=k_stack, v=v_stack, lengths=new_lengths)

@@ -1,16 +1,27 @@
-"""Continuous-batching scheduler: request queue → slots → token streams.
+"""Continuous-batching scheduler: request queue → pages/slots → token streams.
 
 The host-side orchestrator around `EngineCore` — the in-tree stand-in for
 TRT-LLM's inflight batcher (ref: NIM container, docker-compose-nim-ms.yaml:2-28).
-One driver thread owns the device: it admits pending requests into free decode
-slots (prefill + insert), then steps the whole slot batch, fanning sampled
-tokens out to per-request queues. Callers (the aiohttp server or in-process
-chains) block on those queues — a thread-safe iterator of text deltas.
+One driver thread owns the device; each tick it
 
-Scheduling policy: prefill-priority admission (new requests are inserted as
-soon as a slot frees, keeping batch occupancy high, which is what determines
-tok/s on the MXU); decode runs whenever any slot is active. The device only
-syncs on small (B,) arrays per step — KV stays resident.
+  1. **admits** pending requests: allocates a slot and the prompt's KV pages
+     (FIFO — a request that doesn't fit blocks later ones, no starvation);
+  2. runs **one prefill chunk** of the oldest admission — chunked prefill
+     interleaves with decode, so active slots never stall for a whole prompt
+     and arbitrarily long prompts are processed without truncation;
+  3. runs **one decode step** over all active slots, fanning sampled tokens
+     out to per-request queues (thread-safe iterators of text deltas).
+
+Page management: the scheduler mirrors the device block table on the host,
+growing a slot's page list as decode crosses page boundaries. When the pool
+is exhausted, the *youngest* active slot is preempted: its pages are freed
+and the request re-queued as a resume (prompt + tokens generated so far), so
+its stream continues seamlessly after re-prefill — recompute-style preemption,
+the same policy the reference's paged batcher applies under memory pressure.
+
+Requests whose prompts cannot fit the cache at all are failed loudly (the
+chain server also caps prompt length at the API, ref server.py:61-66) —
+never silently truncated.
 """
 
 from __future__ import annotations
@@ -20,8 +31,9 @@ import queue
 import threading
 import time
 import uuid
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional, Sequence
+from typing import Deque, Dict, Iterator, List, Optional, Sequence
 
 import jax
 import numpy as np
@@ -52,9 +64,24 @@ class Request:
 
 
 @dataclass
-class _SlotInfo:
+class _Job:
+    """A request's journey through the engine: prefilling, then decoding.
+
+    ``ids`` is the sequence prefilled so far — the prompt, plus (after a
+    preemption) the tokens already generated, so a resume re-prefills the
+    full context and the stream continues where it left off.
+    """
+
     request: Request
     detok: IncrementalDetokenizer
+    ids: List[int]
+    slot: int = -1
+    pages: List[int] = field(default_factory=list)
+    prefilled: int = 0            # tokens of `ids` already chunked in
+    total_len: int = 0            # host mirror of cache lengths[slot]
+    gen_ids: List[int] = field(default_factory=list)   # generated so far
+    admit_seq: int = 0            # admission order (preemption picks max)
+    prefill_elapsed: float = 0.0  # wall time across this prompt's chunks
 
 
 class Scheduler:
@@ -63,9 +90,15 @@ class Scheduler:
     def __init__(self, core: EngineCore, tokenizer: Tokenizer) -> None:
         self.core = core
         self.tokenizer = tokenizer
-        self._pending: "queue.Queue" = queue.Queue()
-        self._slots: Dict[int, _SlotInfo] = {}
+        self._lock = threading.Lock()
+        self._pending: Deque[_Job] = deque()     # awaiting slot+pages
+        self._prefilling: Deque[_Job] = deque()  # admitted, chunking in
+        self._slots: Dict[int, _Job] = {}        # decoding
         self._free: List[int] = list(range(core.batch))
+        self._alloc = core.new_allocator()
+        self._table = np.zeros((core.batch, core.max_pages_per_slot), np.int32)
+        self._table_dev: Optional[jax.Array] = None
+        self._admit_counter = 0
         self._state: DecodeState = core.init_state()
         self._rng = jax.random.PRNGKey(1234)
         self._running = False
@@ -89,31 +122,20 @@ class Scheduler:
             self._thread.join(timeout=60)
             if self._thread.is_alive():
                 # Driver still mid-step (e.g. a long XLA compile): touching
-                # _slots/_free concurrently would corrupt bookkeeping — leave
+                # job state concurrently would corrupt bookkeeping — leave
                 # cleanup to the driver, which checks _running after the step.
                 logger.warning("driver thread still busy at stop(); "
                                "skipping forced cleanup")
                 return
         self._fail_all("scheduler stopped")
 
-    def _fail_all(self, reason: str) -> None:
-        """Unblock every queued and in-flight consumer (shutdown/crash path)."""
-        while True:
-            try:
-                req: Request = self._pending.get_nowait()
-            except queue.Empty:
-                break
-            req.error = reason
-            req.out_queue.put(_STOP)
-        for slot, info in list(self._slots.items()):
-            info.request.error = reason
-            info.request.out_queue.put(_STOP)
-            del self._slots[slot]
-            self._free.append(slot)
-
     def submit(self, request: Request) -> Request:
         """Enqueue; stream deltas via `iter_text(request)`."""
-        self._pending.put(request)
+        job = _Job(request=request,
+                   detok=IncrementalDetokenizer(self.tokenizer),
+                   ids=list(request.prompt_ids))
+        with self._lock:
+            self._pending.append(job)
         self._wake.set()
         REGISTRY.counter("requests_submitted").inc()
         return request
@@ -127,85 +149,266 @@ class Scheduler:
             yield item
 
     def generate(self, prompt_ids: Sequence[int], **kw) -> str:
-        """Synchronous convenience: submit and join the full text."""
+        """Synchronous convenience: submit and join the full text. Raises on
+        per-request failure (e.g. over-capacity prompt) — never returns a
+        silently empty string for a rejected request."""
         req = Request(prompt_ids=list(prompt_ids), **kw)
         self.submit(req)
-        return "".join(self.iter_text(req))
+        text = "".join(self.iter_text(req))
+        if req.error:
+            raise RuntimeError(f"request {req.request_id} failed: {req.error}")
+        return text
 
     # ------------------------------------------------------------- internals
 
+    def _fail_all(self, reason: str) -> None:
+        """Unblock every queued and in-flight consumer (shutdown/crash path)."""
+        with self._lock:
+            jobs = list(self._pending)
+            self._pending.clear()
+        jobs += list(self._prefilling) + list(self._slots.values())
+        self._prefilling.clear()
+        self._slots.clear()
+        for job in jobs:
+            job.request.error = reason
+            job.request.out_queue.put(_STOP)
+            job.pages = []
+            job.slot = -1
+        # rebuild slot/page bookkeeping to a clean slate
+        self._alloc = self.core.new_allocator()
+        self._free = list(range(self.core.batch))
+        self._table[:] = 0
+        self._table_dev = None
+
+    def _release(self, job: _Job) -> None:
+        """Return the job's slot and pages to the pools."""
+        if job.slot >= 0:
+            self._free.append(job.slot)
+            self._table[job.slot, :] = 0
+            self._table_dev = None
+            job.slot = -1
+        if job.pages:
+            self._alloc.free(job.pages)
+            job.pages = []
+
+    def _finish(self, job: _Job) -> None:
+        tail = job.detok.flush()
+        if tail:
+            job.request.out_queue.put(tail)
+        job.request.out_queue.put(_STOP)
+        self._release(job)
+        REGISTRY.counter("requests_completed").inc()
+        REGISTRY.histogram("request_latency_s").observe(
+            time.perf_counter() - job.request.submitted_at)
+
+    def _fail(self, job: _Job, reason: str) -> None:
+        job.request.error = reason
+        job.request.out_queue.put(_STOP)
+        REGISTRY.counter("requests_failed").inc()
+
+    def _table_device(self) -> jax.Array:
+        if self._table_dev is None:
+            self._table_dev = self.core.put_table(self._table)
+        return self._table_dev
+
+    # -- admission ----------------------------------------------------------
+
     def _admit(self) -> None:
-        """Prefill pending requests into free slots."""
-        while self._free and not self._pending.empty():
-            try:
-                req: Request = self._pending.get_nowait()
-            except queue.Empty:
-                return
-            if len(req.prompt_ids) >= self.core.buckets[-1]:
-                # truncate from the left (keep the end of the prompt) to fit
-                req.prompt_ids = req.prompt_ids[-(self.core.buckets[-1] - 1):]
-            self._rng, sub = jax.random.split(self._rng)
-            t0 = time.perf_counter()
-            result = self.core.prefill(req.prompt_ids, req.temperature,
-                                       req.top_k, req.top_p, sub)
-            first_tok = int(jax.device_get(result[0])[0])
-            req.first_token_at = time.perf_counter()
-            REGISTRY.histogram("ttft_s").observe(req.first_token_at - req.submitted_at)
-            REGISTRY.histogram("prefill_s").observe(req.first_token_at - t0)
-
-            detok = IncrementalDetokenizer(self.tokenizer)
-            if first_tok == self.core.eos_id or req.max_tokens <= 1:
-                if first_tok != self.core.eos_id:
-                    req.completion_tokens = 1
-                    req.out_queue.put(detok.push(first_tok) + detok.flush())
-                req.out_queue.put(_STOP)
-                REGISTRY.counter("requests_completed").inc()
+        """Move pending jobs into the prefilling set while slots+pages last."""
+        while self._free:
+            with self._lock:
+                if not self._pending:
+                    return
+                job = self._pending[0]
+            n = len(job.ids)
+            need = self.core.pages_for(n)
+            if n + 1 >= self.core.max_seq or need > self.core.num_pages - 1:
+                with self._lock:
+                    self._pending.popleft()
+                if job.gen_ids:
+                    # a preempted resume that has outgrown capacity: end it
+                    # cleanly at its current length (mirrors the engine's
+                    # out_of_cache cap), keeping the streamed output valid
+                    logger.warning("resume of %s no longer fits (%d tokens); "
+                                   "finishing at capacity",
+                                   job.request.request_id, n)
+                    self._finish(job)
+                else:
+                    # could never be served — fail loudly rather than hang
+                    # the FIFO head forever (the API also caps prompts,
+                    # ref server.py:61-66)
+                    self._fail(job, f"prompt of {n} tokens needs {need} KV "
+                                    f"pages and {n + 1} cache positions "
+                                    f"(prompt + first token); capacity is "
+                                    f"{self.core.num_pages - 1} pages / "
+                                    f"{self.core.max_seq - 1} positions "
+                                    f"(max prompt {self.core.max_seq - 2})")
                 continue
+            pages = self._alloc.alloc(need)
+            if pages is None:
+                return  # FIFO head-of-line: wait for pages to free up
+            with self._lock:
+                self._pending.popleft()
             slot = self._free.pop()
-            self._state = self.core.insert(
-                self._state, result, slot, len(req.prompt_ids), req.max_tokens,
-                req.temperature, req.top_k, req.top_p)
-            req.completion_tokens = 1
-            delta = detok.push(first_tok)
-            if delta:
-                req.out_queue.put(delta)
-            self._slots[slot] = _SlotInfo(request=req, detok=detok)
+            job.slot = slot
+            job.pages = pages
+            job.prefilled = 0
+            job.total_len = 0
+            if job.admit_seq == 0:
+                # resumes keep their original admission age, so preemption
+                # (youngest-first) cannot thrash an old request forever
+                self._admit_counter += 1
+                job.admit_seq = self._admit_counter
+            self._table[slot, :] = 0
+            self._table[slot, :len(pages)] = pages
+            self._table_dev = None
+            self._prefilling.append(job)
 
-    def _step(self) -> None:
-        self._state, out = self.core.decode(self._state)
+    # -- prefill ------------------------------------------------------------
+
+    def _prefill_step(self) -> None:
+        """Run ONE chunk of the oldest admission (interleaves with decode)."""
+        job = self._prefilling[0]
+        req = job.request
+        start = job.prefilled
+        remaining = len(job.ids) - start
+        chunk_ids = job.ids[start:start + min(remaining, self.core.chunk)]
+        t0 = time.perf_counter()
+        self._state, logits = self.core.prefill_chunk(
+            self._state, chunk_ids, self._table[job.slot], job.slot, start)
+        job.prefilled += len(chunk_ids)
+        job.total_len = job.prefilled
+        REGISTRY.counter("prefill_chunks").inc()
+        if job.prefilled < len(job.ids):
+            job.prefill_elapsed += time.perf_counter() - t0
+            return  # mid-prompt; decode interleaves before the next chunk
+
+        # final chunk: sample the first token (host sync = TTFT)
+        self._prefilling.popleft()
+        self._rng, sub = jax.random.split(self._rng)
+        tok = self.core.sample(logits, sub, req.temperature, req.top_k,
+                               req.top_p)
+        resumed = bool(job.gen_ids)
+        if not resumed:
+            req.first_token_at = time.perf_counter()
+            REGISTRY.histogram("ttft_s").observe(
+                req.first_token_at - req.submitted_at)
+        # whole-prompt prefill time: every chunk (accumulated across the
+        # interleaved ticks) plus the first-token sample sync above
+        job.prefill_elapsed += time.perf_counter() - t0
+        REGISTRY.histogram("prefill_s").observe(job.prefill_elapsed)
+
+        already = len(job.gen_ids)
+        if tok == self.core.eos_id or already + 1 >= req.max_tokens:
+            if tok != self.core.eos_id:
+                self._emit_token(job, tok)
+            self._finish(job)
+            return
+        self._emit_token(job, tok)
+        self._state = self.core.activate(
+            self._state, job.slot, tok, generated=already + 1,
+            max_gen=req.max_tokens, temperature=req.temperature,
+            top_k=req.top_k, top_p=req.top_p)
+        self._slots[job.slot] = job
+
+    def _emit_token(self, job: _Job, tok: int) -> None:
+        job.gen_ids.append(tok)
+        job.request.completion_tokens += 1
+        job.total_len += 1
+        delta = job.detok.push(tok)
+        if delta:
+            job.request.out_queue.put(delta)
+
+    # -- decode -------------------------------------------------------------
+
+    def _grow_pages(self) -> None:
+        """Give every active slot a page for its next write; preempt the
+        youngest admissions when the pool runs dry."""
+        for slot in list(self._slots):
+            job = self._slots.get(slot)
+            if job is None:
+                continue
+            # total_len counts the just-sampled (not yet written) token, so
+            # the next decode write lands at index total_len - 1; while the
+            # slot is active that stays < max_seq and within the table row.
+            while len(job.pages) < self.core.pages_for(job.total_len - 1):
+                got = self._alloc.alloc(1)
+                if got is not None:
+                    self._table[slot, len(job.pages)] = got[0]
+                    job.pages.extend(got)
+                    self._table_dev = None
+                    continue
+                victim = self._pick_victim()
+                self._preempt(victim)
+                if victim is job:
+                    break  # the grower was youngest: it waits in the queue
+
+    def _pick_victim(self) -> _Job:
+        """Youngest admission — decoding slots and mid-prefill jobs alike
+        (both hold pages). The growing job is a candidate too: if IT is the
+        youngest, it preempts itself rather than evicting an older request
+        (no thrash — resumes keep their original admission age)."""
+        cands = (list(self._prefilling) + list(self._slots.values()))
+        return max(cands, key=lambda j: j.admit_seq)
+
+    def _preempt(self, job: _Job) -> None:
+        """Recompute-preemption: free the slot, requeue prompt+generated."""
+        if job.slot in self._slots and self._slots[job.slot] is job:
+            del self._slots[job.slot]
+        else:
+            self._prefilling.remove(job)
+        self._state = self.core.release(self._state, job.slot)
+        self._release(job)
+        job.ids = list(job.request.prompt_ids) + list(job.gen_ids)
+        job.prefilled = 0
+        job.total_len = 0
+        job.prefill_elapsed = 0.0   # the resume's re-prefill is a fresh sample
+        with self._lock:
+            self._pending.appendleft(job)
+        REGISTRY.counter("preemptions").inc()
+        logger.info("preempted request %s at %d generated tokens",
+                    job.request.request_id, len(job.gen_ids))
+
+    def _decode_once(self) -> None:
+        self._grow_pages()
+        if not self._slots:
+            return
+        self._state, out = self.core.decode(self._state, self._table_device())
         sampled = np.asarray(jax.device_get(out["sampled"]))
         emitted = np.asarray(jax.device_get(out["emitted"]))
         done = np.asarray(jax.device_get(out["done"]))
         hit_eos = np.asarray(jax.device_get(out["hit_eos"]))
         REGISTRY.counter("decode_steps").inc()
         REGISTRY.counter("tokens_generated").inc(int(emitted.sum()))
-        for slot, info in list(self._slots.items()):
+        for slot, job in list(self._slots.items()):
             if not emitted[slot]:
                 continue
             if not (done[slot] and hit_eos[slot]):
-                info.request.completion_tokens += 1
-                delta = info.detok.push(int(sampled[slot]))
-                if delta:
-                    info.request.out_queue.put(delta)
+                self._emit_token(job, int(sampled[slot]))
             if done[slot]:
-                tail = info.detok.flush()
-                if tail:
-                    info.request.out_queue.put(tail)
-                info.request.out_queue.put(_STOP)
                 del self._slots[slot]
-                self._free.append(slot)
-                REGISTRY.counter("requests_completed").inc()
-                REGISTRY.histogram("request_latency_s").observe(
-                    time.perf_counter() - info.request.submitted_at)
+                self._finish(job)
+
+    # -- driver loop --------------------------------------------------------
+
+    def _tick(self) -> bool:
+        """One scheduling round; returns False when fully idle."""
+        self._admit()
+        worked = False
+        if self._prefilling:
+            self._prefill_step()
+            worked = True
+        if self._slots:
+            self._decode_once()
+            worked = True
+        return worked
 
     def _loop(self) -> None:
-        logger.info("engine driver thread started (slots=%d)", self.core.batch)
+        logger.info("engine driver thread started (slots=%d pages=%d)",
+                    self.core.batch, self.core.num_pages)
         while self._running:
             try:
-                self._admit()
-                if self._slots:
-                    self._step()
-                else:
+                if not self._tick():
                     # idle: wait for work without burning the core
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()

@@ -283,11 +283,41 @@ def forward_seq_parallel(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
     return forward(params, cfg, tokens, adapters=adapters, attn_fn=attn)
 
 
+def scan_blocks(cfg: LlamaConfig, h: jnp.ndarray, params: Params,
+                kv_layers: Tuple[jnp.ndarray, jnp.ndarray],
+                cos: jnp.ndarray, sin: jnp.ndarray, attn_and_update,
+                adapters: Optional[Params]):
+    """Scan the layer stack with per-layer KV-cache state threaded through.
+
+    ``kv_layers`` is the (k, v) cache with a leading layer axis (any layout —
+    dense (L, B, T, KV, HD) or paged (L, P, page, KV, HD)).
+    ``attn_and_update(q, k_chunk, v_chunk, k_layer, v_layer) ->
+    (ctx, new_k_layer, new_v_layer)`` owns both the cache write and the
+    attention read, so dense prefill, dense decode, and block-table paged
+    variants (engine/kv_cache.py) all share this one compiled block scan.
+    """
+    def body(h, xs):
+        layer, k_l, v_l, ad = xs
+        store = {}
+
+        def attn(q, k, v):
+            ctx, store["k"], store["v"] = attn_and_update(q, k, v, k_l, v_l)
+            return ctx
+
+        h = _block(cfg, h, layer, cos, sin, attn, ad)
+        return h, (store["k"], store["v"])
+
+    h, (k_stack, v_stack) = jax.lax.scan(
+        body, h, (params["layers"], kv_layers[0], kv_layers[1],
+                  adapters or {}))
+    return h, k_stack, v_stack
+
+
 def _scan_cached_blocks(cfg: LlamaConfig, h: jnp.ndarray, params: Params,
                         cache: KVCache, cos: jnp.ndarray, sin: jnp.ndarray,
                         write_pos: jnp.ndarray, attn_with_cache,
                         adapters: Optional[Params]):
-    """Scan the layer stack, writing this step's K/V into the cache.
+    """Dense-cache specialization of :func:`scan_blocks`.
 
     The new K/V chunk is slice-written at ``write_pos`` per batch row; writes
     into a right-padded tail land garbage past seq_len, which stays masked and
@@ -299,22 +329,13 @@ def _scan_cached_blocks(cfg: LlamaConfig, h: jnp.ndarray, params: Params,
     write = jax.vmap(lambda buf, upd, start: jax.lax.dynamic_update_slice(
         buf, upd, (start, jnp.int32(0), jnp.int32(0))))
 
-    def body(h, xs):
-        layer, k_l, v_l, ad = xs
-        store = {}
+    def attn_and_update(q, k, v, k_l, v_l):
+        k_new = write(k_l, k.astype(k_l.dtype), write_pos)
+        v_new = write(v_l, v.astype(v_l.dtype), write_pos)
+        return attn_with_cache(q, k_new, v_new), k_new, v_new
 
-        def attn(q, k, v):
-            k_new = write(k_l, k.astype(k_l.dtype), write_pos)
-            v_new = write(v_l, v.astype(v_l.dtype), write_pos)
-            store["k"], store["v"] = k_new, v_new
-            return attn_with_cache(q, k_new, v_new)
-
-        h = _block(cfg, h, layer, cos, sin, attn, ad)
-        return h, (store["k"], store["v"])
-
-    h, (k_stack, v_stack) = jax.lax.scan(
-        body, h, (params["layers"], cache.k, cache.v, adapters or {}))
-    return h, k_stack, v_stack
+    return scan_blocks(cfg, h, params, (cache.k, cache.v), cos, sin,
+                       attn_and_update, adapters)
 
 
 def prefill(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,

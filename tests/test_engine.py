@@ -1,12 +1,9 @@
-"""Engine tests: tokenizers, prefill/insert/decode slot machine, scheduler.
+"""Engine tests: tokenizers, paged chunked prefill/decode slot machine,
+scheduler (interleave, preemption, no-truncation).
 
 Uses the tiny deterministic model (the fake backend of SURVEY §4) so the
 continuous-batching path runs hostless on the CPU mesh simulation.
 """
-
-import queue
-import threading
-import time
 
 import jax
 import jax.numpy as jnp
@@ -56,6 +53,16 @@ def test_incremental_detokenizer_utf8_boundary():
     assert emitted == "⚡x"
 
 
+def test_incremental_detokenizer_long_sequence_windowing():
+    """Windowed decode must emit exactly the full text over 100+ tokens."""
+    tok = ByteTokenizer()
+    text = ("hello wörld ⚡ " * 20).strip()
+    ids = tok.encode(text)
+    detok = IncrementalDetokenizer(tok)
+    emitted = "".join(detok.push(i) for i in ids) + detok.flush()
+    assert emitted == text
+
+
 # ------------------------------------------------------------------- engine
 
 @pytest.fixture(scope="module")
@@ -63,17 +70,34 @@ def engine():
     cfg = llama.LlamaConfig.tiny(vocab_size=300)  # > ByteTokenizer specials
     params = llama.init_params(jax.random.PRNGKey(5), cfg)
     tok = ByteTokenizer()
-    ecfg = EngineConfig(max_batch_size=4, max_seq_len=128, prefill_chunk=32)
+    ecfg = EngineConfig(max_batch_size=4, max_seq_len=128, prefill_chunk=32,
+                        page_size=16)
     core = EngineCore(cfg, ecfg, params, eos_id=tok.eos_id)
     return core, tok, cfg, params
 
 
-def test_engine_matches_model_greedy(engine):
-    """Slot-machine greedy decode must equal the raw model's greedy decode."""
-    core, tok, cfg, params = engine
-    prompt = tok.encode("abcd", add_bos=True)
+def _prefill_into(core, state, table, alloc, slot, ids):
+    """Chunked paged prefill of a whole prompt into ``slot`` (test driver
+    mirroring scheduler._prefill_step, one chunk per call)."""
+    pages = alloc.alloc(core.pages_for(len(ids)))
+    assert pages is not None
+    table[slot, :len(pages)] = pages
+    start = 0
+    while start < len(ids):
+        chunk = ids[start:start + core.chunk]
+        state, logits = core.prefill_chunk(state, chunk, table[slot], slot,
+                                           start)
+        start += len(chunk)
+    return state, logits
 
-    # reference greedy continuation with the raw model
+
+def test_engine_matches_model_greedy(engine):
+    """Paged chunked greedy decode must equal the raw model's greedy decode,
+    including prompts longer than the prefill chunk (multi-chunk path)."""
+    core, tok, cfg, params = engine
+    prompt = tok.encode("abcd" * 20, add_bos=True)     # 81 ids > 2 chunks
+    assert len(prompt) > 2 * core.chunk
+
     seq = list(prompt)
     for _ in range(6):
         logits = llama.forward(params, cfg, jnp.array([seq], jnp.int32))
@@ -81,14 +105,15 @@ def test_engine_matches_model_greedy(engine):
     expect = seq[len(prompt):]
 
     state = core.init_state()
-    result = core.prefill(prompt, temperature=0.0, top_k=0, top_p=1.0,
-                          rng=jax.random.PRNGKey(0))
-    first = int(jax.device_get(result[0])[0])
-    state = core.insert(state, result, slot=2, length=len(prompt), max_gen=6,
-                        temperature=0.0, top_k=0, top_p=1.0)
+    alloc = core.new_allocator()
+    table = np.zeros((core.batch, core.max_pages_per_slot), np.int32)
+    state, logits = _prefill_into(core, state, table, alloc, 2, prompt)
+    first = core.sample(logits, jax.random.PRNGKey(0), 0.0, 0, 1.0)
+    state = core.activate(state, 2, first, generated=1, max_gen=6,
+                          temperature=0.0, top_k=0, top_p=1.0)
     got = [first]
     for _ in range(5):
-        state, out = core.decode(state)
+        state, out = core.decode(state, core.put_table(table))
         assert bool(out["emitted"][2])
         got.append(int(out["sampled"][2]))
     assert got == expect
@@ -100,11 +125,14 @@ def test_engine_slots_are_independent(engine):
 
     def solo(prompt, steps):
         state = core.init_state()
-        r = core.prefill(prompt, 0.0, 0, 1.0, jax.random.PRNGKey(0))
-        state = core.insert(state, r, 0, len(prompt), steps + 1, 0.0, 0, 1.0)
-        toks = [int(jax.device_get(r[0])[0])]
+        alloc = core.new_allocator()
+        table = np.zeros((core.batch, core.max_pages_per_slot), np.int32)
+        state, logits = _prefill_into(core, state, table, alloc, 0, prompt)
+        first = core.sample(logits, jax.random.PRNGKey(0), 0.0, 0, 1.0)
+        state = core.activate(state, 0, first, 1, steps + 1, 0.0, 0, 1.0)
+        toks = [first]
         for _ in range(steps):
-            state, out = core.decode(state)
+            state, out = core.decode(state, core.put_table(table))
             toks.append(int(out["sampled"][0]))
         return toks
 
@@ -113,14 +141,17 @@ def test_engine_slots_are_independent(engine):
     want1, want2 = solo(p1, 4), solo(p2, 4)
 
     state = core.init_state()
-    r1 = core.prefill(p1, 0.0, 0, 1.0, jax.random.PRNGKey(0))
-    state = core.insert(state, r1, 0, len(p1), 5, 0.0, 0, 1.0)
-    r2 = core.prefill(p2, 0.0, 0, 1.0, jax.random.PRNGKey(0))
-    state = core.insert(state, r2, 3, len(p2), 5, 0.0, 0, 1.0)
-    got1 = [int(jax.device_get(r1[0])[0])]
-    got2 = [int(jax.device_get(r2[0])[0])]
+    alloc = core.new_allocator()
+    table = np.zeros((core.batch, core.max_pages_per_slot), np.int32)
+    state, l1 = _prefill_into(core, state, table, alloc, 0, p1)
+    f1 = core.sample(l1, jax.random.PRNGKey(0), 0.0, 0, 1.0)
+    state = core.activate(state, 0, f1, 1, 5, 0.0, 0, 1.0)
+    state, l2 = _prefill_into(core, state, table, alloc, 3, p2)
+    f2 = core.sample(l2, jax.random.PRNGKey(0), 0.0, 0, 1.0)
+    state = core.activate(state, 3, f2, 1, 5, 0.0, 0, 1.0)
+    got1, got2 = [f1], [f2]
     for _ in range(4):
-        state, out = core.decode(state)
+        state, out = core.decode(state, core.put_table(table))
         got1.append(int(out["sampled"][0]))
         got2.append(int(out["sampled"][3]))
     assert got1 == want1
@@ -130,23 +161,69 @@ def test_engine_slots_are_independent(engine):
 def test_engine_budget_and_slot_reuse(engine):
     core, tok, cfg, params = engine
     prompt = tok.encode("xy", add_bos=True)
+
+    def fresh_start(state, table, alloc, max_gen):
+        state, logits = _prefill_into(core, state, table, alloc, 1, prompt)
+        first = core.sample(logits, jax.random.PRNGKey(0), 0.0, 0, 1.0)
+        return core.activate(state, 1, first, 1, max_gen, 0.0, 0, 1.0)
+
     state = core.init_state()
-    r = core.prefill(prompt, 0.0, 0, 1.0, jax.random.PRNGKey(0))
-    state = core.insert(state, r, 1, len(prompt), 3, 0.0, 0, 1.0)
-    state, out = core.decode(state)           # generated=2
+    alloc = core.new_allocator()
+    table = np.zeros((core.batch, core.max_pages_per_slot), np.int32)
+    state = fresh_start(state, table, alloc, max_gen=3)
+    state, out = core.decode(state, core.put_table(table))   # generated=2
     assert not bool(out["done"][1])
-    state, out = core.decode(state)           # generated=3 → budget hit
+    state, out = core.decode(state, core.put_table(table))   # generated=3
     assert bool(out["done"][1])
     assert not bool(state.active[1])
-    # reuse the slot with a fresh request → decodes like a fresh engine
-    r2 = core.prefill(prompt, 0.0, 0, 1.0, jax.random.PRNGKey(0))
-    state = core.insert(state, r2, 1, len(prompt), 8, 0.0, 0, 1.0)
-    state, out = core.decode(state)
+    # reuse the slot with a fresh request (fresh pages) → like a fresh engine
+    state = fresh_start(state, table, alloc, max_gen=8)
+    state, out = core.decode(state, core.put_table(table))
     fresh = core.init_state()
-    rf = core.prefill(prompt, 0.0, 0, 1.0, jax.random.PRNGKey(0))
-    fresh = core.insert(fresh, rf, 1, len(prompt), 8, 0.0, 0, 1.0)
-    fresh, outf = core.decode(fresh)
+    table2 = np.zeros((core.batch, core.max_pages_per_slot), np.int32)
+    fresh = fresh_start(fresh, table2, core.new_allocator(), max_gen=8)
+    fresh, outf = core.decode(fresh, core.put_table(table2))
     assert int(out["sampled"][1]) == int(outf["sampled"][1])
+
+
+def test_released_slot_writes_go_to_null_page(engine):
+    """After release, a slot's decode writes must not corrupt reused pages."""
+    core, tok, cfg, params = engine
+    prompt = tok.encode("stable", add_bos=True)
+
+    state = core.init_state()
+    alloc = core.new_allocator()
+    table = np.zeros((core.batch, core.max_pages_per_slot), np.int32)
+    # slot 0: the victim; slot 1: the survivor whose output must stay exact
+    state, l0 = _prefill_into(core, state, table, alloc, 0,
+                              tok.encode("victim", add_bos=True))
+    f0 = core.sample(l0, jax.random.PRNGKey(0), 0.0, 0, 1.0)
+    state = core.activate(state, 0, f0, 1, 50, 0.0, 0, 1.0)
+    state, l1 = _prefill_into(core, state, table, alloc, 1, prompt)
+    f1 = core.sample(l1, jax.random.PRNGKey(0), 0.0, 0, 1.0)
+    state = core.activate(state, 1, f1, 1, 8, 0.0, 0, 1.0)
+
+    # release slot 0, hand its pages to nobody — but keep decoding; slot 0's
+    # (masked) writes must go to the null page, not its old pages
+    state = core.release(state, 0)
+    got = [f1]
+    for _ in range(5):
+        state, out = core.decode(state, core.put_table(table))
+        assert not bool(out["emitted"][0])
+        got.append(int(out["sampled"][1]))
+
+    # reference: slot 1 alone
+    ref_state = core.init_state()
+    t2 = np.zeros((core.batch, core.max_pages_per_slot), np.int32)
+    ref_state, lr = _prefill_into(core, ref_state, t2, core.new_allocator(),
+                                  1, prompt)
+    fr = core.sample(lr, jax.random.PRNGKey(0), 0.0, 0, 1.0)
+    ref_state = core.activate(ref_state, 1, fr, 1, 8, 0.0, 0, 1.0)
+    want = [fr]
+    for _ in range(5):
+        ref_state, out = core.decode(ref_state, core.put_table(t2))
+        want.append(int(out["sampled"][1]))
+    assert got == want
 
 
 # ---------------------------------------------------------------- scheduler
@@ -188,11 +265,142 @@ def test_scheduler_more_requests_than_slots(engine):
         sched.stop()
 
 
-def test_incremental_detokenizer_long_sequence_windowing():
-    """Windowed decode must emit exactly the full text over 100+ tokens."""
-    tok = ByteTokenizer()
-    text = ("hello wörld ⚡ " * 20).strip()
-    ids = tok.encode(text)
-    detok = IncrementalDetokenizer(tok)
-    emitted = "".join(detok.push(i) for i in ids) + detok.flush()
-    assert emitted == text
+def test_scheduler_long_prompt_not_truncated(engine):
+    """Prompts far beyond prefill_chunk are chunk-prefilled, never truncated:
+    greedy output equals the raw model's continuation of the FULL prompt."""
+    core, tok, cfg, params = engine
+    prompt = tok.encode("m" * 100, add_bos=True)   # 101 ids, chunk=32
+    assert len(prompt) > 3 * core.chunk
+
+    seq = list(prompt)
+    for _ in range(5):
+        logits = llama.forward(params, cfg, jnp.array([seq], jnp.int32))
+        seq.append(int(jnp.argmax(logits[0, -1])))
+    want = tok.decode(seq[len(prompt):])
+
+    sched = Scheduler(core, tok)
+    sched.start()
+    try:
+        req = Request(prompt_ids=list(prompt), max_tokens=5, temperature=0.0)
+        sched.submit(req)
+        got = "".join(sched.iter_text(req))
+        assert req.error is None
+        assert got == want
+    finally:
+        sched.stop()
+
+
+def test_scheduler_rejects_over_capacity_prompt(engine):
+    """A prompt that cannot fit the KV capacity fails loudly, not silently."""
+    core, tok, cfg, params = engine
+    sched = Scheduler(core, tok)
+    sched.start()
+    try:
+        req = Request(prompt_ids=list(range(32, 32 + core.max_seq)),
+                      max_tokens=4, temperature=0.0)
+        sched.submit(req)
+        text = "".join(sched.iter_text(req))
+        assert text == ""
+        assert req.error is not None and "capacity" in req.error
+    finally:
+        sched.stop()
+
+
+def test_scheduler_decode_interleaves_with_chunked_prefill(engine):
+    """Active slots emit tokens between the chunks of a long admission."""
+    core, tok, cfg, params = engine
+    sched = Scheduler(core, tok)   # not started: we drive ticks by hand
+    short = Request(prompt_ids=tok.encode("hi", add_bos=True), max_tokens=40,
+                    temperature=0.0)
+    sched.submit(short)
+    sched._tick()                  # admit + prefill + first decode
+    assert sched._slots, "short request should be decoding"
+    emitted_before = short.completion_tokens
+
+    long = Request(prompt_ids=tok.encode("n" * 100, add_bos=True),
+                   max_tokens=4, temperature=0.0)
+    sched.submit(long)
+    sched._tick()                  # one chunk of `long` + one decode step
+    assert sched._prefilling, "long prompt must still be mid-prefill"
+    assert short.completion_tokens > emitted_before, \
+        "decode stalled during chunked admission"
+    while sched._tick():
+        pass
+    assert short.error is None and long.error is None
+    assert long.completion_tokens == 4
+
+
+def test_scheduler_preempts_and_resumes_under_page_pressure(engine):
+    """Pool exhaustion preempts the youngest request; its stream continues
+    byte-for-byte after resume (recompute preemption)."""
+    from generativeaiexamples_tpu.core.metrics import REGISTRY
+    _, tok, cfg, params = engine
+    p1 = tok.encode("first request with a fairly long prompt here ok")
+    p2 = tok.encode("second one")
+
+    def run(num_pages):
+        ecfg = EngineConfig(max_batch_size=4, max_seq_len=128, page_size=8,
+                            prefill_chunk=16, num_pages=num_pages)
+        core = EngineCore(cfg, ecfg, params, eos_id=tok.eos_id)
+        sched = Scheduler(core, tok)
+        q1 = Request(prompt_ids=list(p1), max_tokens=24, temperature=0.0)
+        q2 = Request(prompt_ids=list(p2), max_tokens=24, temperature=0.0)
+        sched.submit(q1)
+        sched.submit(q2)
+        while sched._tick():
+            pass
+        assert q1.error is None and q2.error is None
+        return q1, q2
+
+    def drain(req):
+        parts = []
+        while not req.out_queue.empty():
+            item = req.out_queue.get_nowait()
+            if isinstance(item, str):
+                parts.append(item)
+        return "".join(parts)
+
+    before = REGISTRY.counter("preemptions").value
+    a1, a2 = run(num_pages=0)          # roomy pool: no preemption
+    b1, b2 = run(num_pages=10)         # 9 usable pages: forces preemption
+    assert REGISTRY.counter("preemptions").value > before
+    assert drain(b1) == drain(a1)
+    assert drain(b2) == drain(a2)
+
+
+# ------------------------------------------------------- tensor parallelism
+
+def test_engine_tensor_parallel_matches_single_device(engine):
+    """TP-sharded serving (INFERENCE_RULES over a (data, tensor) mesh) must
+    produce the single-device stream exactly (ref parity:
+    docker-compose-nim-ms.yaml:18-20 INFERENCE_GPU_COUNT)."""
+    from generativeaiexamples_tpu.parallel import mesh as pmesh
+    _, tok, cfg, params = engine
+    prompt = tok.encode("the quick brown fox jumps over the lazy dog again",
+                        add_bos=True)
+    ecfg = EngineConfig(max_batch_size=4, max_seq_len=128, page_size=16,
+                        prefill_chunk=32)
+
+    def run(mesh):
+        core = EngineCore(cfg, ecfg, params, eos_id=tok.eos_id, mesh=mesh)
+        sched = Scheduler(core, tok)
+        req = Request(prompt_ids=list(prompt), max_tokens=10, temperature=0.0)
+        sched.submit(req)
+        while sched._tick():
+            pass
+        assert req.error is None
+        parts = []
+        while not req.out_queue.empty():
+            item = req.out_queue.get_nowait()
+            if isinstance(item, str):
+                parts.append(item)
+        return "".join(parts)
+
+    base = run(None)
+    mesh = pmesh.create_mesh(
+        pmesh.MeshConfig(axes=pmesh.INFER_AXES, shape=(1, 2)),
+        devices=jax.devices()[:2])
+    assert run(mesh) == base
+    mesh8 = pmesh.create_mesh(
+        pmesh.MeshConfig(axes=pmesh.INFER_AXES, shape=(4, 2)))
+    assert run(mesh8) == base
