@@ -1,76 +1,194 @@
-"""Round benchmark: run on the real TPU chip, print ONE JSON line.
+"""Round benchmark: the SERVING path on the real TPU chip, one JSON line.
 
-Current benchmark (round 1): single-chip prefill TTFT + decode throughput on
-a ~1B-param Llama-family decoder (bf16, batch 8). The north-star metric
-(BASELINE.json) is p50 TTFT < 1 s for the RAG generate path; until the full
-RAG stack is wired into this bench, `vs_baseline` is the TTFT target ratio
-target_s / measured_p50_s (>1.0 = beating the 1 s target).
+Measures what BASELINE.json actually targets — p50 time-to-first-token and
+generation throughput of the continuous-batching engine under concurrent
+load (mixed prompt lengths, chunked prefill interleaved with decode), not a
+raw model microbenchmark. Two phases, as latency and throughput are opposed
+knobs:
+
+  * **latency phase** — concurrency = slot count (no queueing): p50/p99 TTFT
+    against the BASELINE 1 s target;
+  * **throughput phase** — 2x oversubscribed: aggregate generated tok/s and
+    batch occupancy.
+
+Honesty guards (round-1 verdict: numbers 50x past chip peak prove the
+harness, not the engine):
+
+  * every timed quantity is a host-observed event — TTFT is stamped when the
+    first sampled token's *value* reaches the host, and throughput counts
+    tokens the host actually received; async dispatch cannot fake either
+    (`block_until_ready` demonstrably lies over the tunneled chip; nothing
+    here relies on it).
+  * achieved model-FLOP utilization (MFU) and HBM read bandwidth are computed
+    from first principles next to every number and asserted < 1.0 of the
+    detected chip's physical peak — a result that beats physics aborts the
+    bench with a nonzero exit instead of reporting.
+
+`vs_baseline` is target_ttft / measured_p50 (>1.0 = beating the 1 s target
+of BASELINE.md; the reference publishes no numbers of its own).
+
+On non-TPU backends (local dev) a tiny config keeps the run under a minute;
+the driver's run on the tunneled chip uses the largest-fitting single-chip
+config (3B-class bf16 Llama — 8B bf16 weights alone exceed one v5e's 16 GB;
+the 8B target runs TP over the mesh, engine/__main__.py).
 """
 
 from __future__ import annotations
 
 import json
+import statistics
+import sys
+import threading
 import time
 
 import jax
-import jax.numpy as jnp
 
+from generativeaiexamples_tpu.core.config import EngineConfig
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.engine.engine import EngineCore
+from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
+from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
 from generativeaiexamples_tpu.models import llama
 
 TTFT_TARGET_S = 1.0
 
+# bf16 matmul peak (FLOP/s) and HBM bandwidth (B/s) per chip generation
+_CHIP_PEAKS = {
+    "v5 lite": (197e12, 819e9),    # v5e
+    "v5p": (459e12, 2765e9),
+    "v4": (275e12, 1228e9),
+    "v6": (918e12, 1640e9),        # Trillium
+}
+
+
+def _chip_peaks(device) -> tuple:
+    kind = getattr(device, "device_kind", "") or ""
+    for key, peaks in _CHIP_PEAKS.items():
+        if key in kind:
+            return peaks
+    return (None, None)
+
+
+def _run_load(sched, reqs) -> float:
+    """Submit all requests, stream-drain them concurrently, return wall."""
+    t0 = time.perf_counter()
+    for r in reqs:
+        sched.submit(r)
+
+    def drain(r: Request) -> None:
+        for _ in sched.iter_text(r):
+            pass
+
+    threads = [threading.Thread(target=drain, args=(r,)) for r in reqs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.perf_counter() - t0
+
 
 def main() -> None:
-    cfg = llama.LlamaConfig(
-        vocab_size=32000, dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
-        hidden_dim=5632, head_dim=128, dtype="bfloat16")
-    batch, prompt_len, max_seq, decode_steps = 8, 512, 1024, 64
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        # largest-fitting single-chip config: Llama-3.2-3B shape, bf16
+        model_cfg = llama.LlamaConfig(
+            vocab_size=128256, dim=3072, n_layers=28, n_heads=24,
+            n_kv_heads=8, hidden_dim=8192, head_dim=128,
+            tie_embeddings=True, dtype="bfloat16")
+        ecfg = EngineConfig(max_batch_size=16, max_seq_len=1536,
+                            page_size=128, prefill_chunk=512,
+                            decode_steps_per_dispatch=8)
+        lat_prompts = [480] * 12 + [1200] * 4          # = slot count
+        thr_prompts = [480] * 20 + [1200] * 6 + [96] * 6   # 2x slots
+        max_tokens, warm_lens = 96, (128, 480, 1200)
+    else:
+        model_cfg = llama.LlamaConfig.tiny(vocab_size=300)
+        ecfg = EngineConfig(max_batch_size=4, max_seq_len=128,
+                            page_size=16, prefill_chunk=32)
+        lat_prompts = [24] * 4
+        thr_prompts = [24] * 6 + [70] * 2
+        max_tokens, warm_lens = 8, (24, 70)
 
-    params = llama.init_params(jax.random.PRNGKey(0), cfg)
-    cache = llama.KVCache.create(cfg, batch=batch, max_seq=max_seq)
-    tokens = jnp.ones((batch, prompt_len), jnp.int32)
-    start = jnp.zeros((batch,), jnp.int32)
-    lens = jnp.full((batch,), prompt_len, jnp.int32)
+    tok = ByteTokenizer()
+    params = llama.init_params(jax.random.PRNGKey(0), model_cfg)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    core = EngineCore(model_cfg, ecfg, params, eos_id=tok.eos_id)
+    sched = Scheduler(core, tok)
+    sched.start()
 
-    prefill = jax.jit(lambda p, t, c, s, l: llama.prefill(p, cfg, t, c, s, l))
-    decode = jax.jit(lambda p, t, c: llama.decode_step(p, cfg, t, c))
+    def make_req(n_prompt: int) -> Request:
+        ids = [32 + (i * 7) % 90 for i in range(n_prompt)]
+        return Request(prompt_ids=ids, max_tokens=max_tokens, temperature=0.0)
 
-    # warmup / compile
-    logits, cache1 = prefill(params, tokens, cache, start, lens)
-    jax.block_until_ready(logits)
-    tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-    logits2, cache2 = decode(params, tok, cache1)
-    jax.block_until_ready(logits2)
+    # warmup: compile every prefill bucket, the chunk path, and BOTH decode
+    # step-count variants (full depth, and the halved depth used while a
+    # prefill is in flight — hence concurrent submission)
+    warm = [make_req(n) for n in warm_lens] + [make_req(warm_lens[0])]
+    for req in warm:
+        sched.submit(req)
+    for req in warm:
+        for _ in sched.iter_text(req):
+            pass
 
-    # TTFT: prefill + one decode sample, median of 5
-    ttfts = []
-    for _ in range(5):
-        c = llama.KVCache.create(cfg, batch=batch, max_seq=max_seq)
-        t0 = time.perf_counter()
-        logits, c = prefill(params, tokens, c, start, lens)
-        tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-        jax.block_until_ready(tok)
-        ttfts.append(time.perf_counter() - t0)
-    ttfts.sort()
-    ttft_p50 = ttfts[len(ttfts) // 2]
+    # -- latency phase: load = slots, no queueing --------------------------
+    lat_reqs = [make_req(n) for n in lat_prompts]
+    _run_load(sched, lat_reqs)
 
-    # decode throughput
-    t0 = time.perf_counter()
-    cache_d = cache1
-    cur = tok
-    for _ in range(decode_steps):
-        logits, cache_d = decode(params, cur, cache_d)
-        cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    jax.block_until_ready(cur)
-    dt = time.perf_counter() - t0
-    tok_s = batch * decode_steps / dt
+    # -- throughput phase: 2x oversubscribed -------------------------------
+    steps0 = REGISTRY.counter("decode_steps").value
+    gen0 = REGISTRY.counter("tokens_generated").value
+    thr_reqs = [make_req(n) for n in thr_prompts]
+    wall = _run_load(sched, thr_reqs)
+    sched.stop()
+
+    errors = [r.error for r in lat_reqs + thr_reqs if r.error]
+    if errors:
+        print(json.dumps({"metric": "serving_bench_FAILED", "value": -1,
+                          "unit": "error", "vs_baseline": 0,
+                          "errors": errors[:3]}))
+        sys.exit(1)
+
+    ttfts = sorted(r.first_token_at - r.submitted_at for r in lat_reqs)
+    ttft_p50 = statistics.median(ttfts)
+    gen_tokens = sum(r.completion_tokens for r in thr_reqs)
+    prompt_tokens = sum(len(r.prompt_ids) for r in thr_reqs)
+    decode_steps = REGISTRY.counter("decode_steps").value - steps0
+    emitted = REGISTRY.counter("tokens_generated").value - gen0
+    occupancy = (emitted / (decode_steps * ecfg.max_batch_size)
+                 if decode_steps else 0.0)
+    tok_s = gen_tokens / wall
+
+    # honesty: achieved FLOPs and HBM traffic vs physical peak
+    flops = 2.0 * n_params * (prompt_tokens + gen_tokens)
+    achieved_flops = flops / wall
+    param_bytes = n_params * jax.dtypes.canonicalize_dtype(
+        model_cfg.jdtype).itemsize
+    hbm_read = decode_steps * float(param_bytes)      # weight reads alone
+    achieved_bw = hbm_read / wall
+    peak_flops, peak_bw = _chip_peaks(jax.devices()[0])
+    mfu = achieved_flops / peak_flops if peak_flops else None
+    bw_util = achieved_bw / peak_bw if peak_bw else None
+    for name, util in (("MFU", mfu), ("HBM", bw_util)):
+        if util is not None and util >= 1.0:
+            print(json.dumps({
+                "metric": "serving_bench_IMPLAUSIBLE", "value": util,
+                "unit": name, "vs_baseline": 0,
+                "detail": f"{name} utilization {util:.2f} >= 1.0 — timing "
+                          f"harness is lying; refusing to report"}))
+            sys.exit(1)
 
     print(json.dumps({
-        "metric": "prefill_p50_ttft_s (1B-class llama, b8 s512, 1 chip)",
+        "metric": f"serving_p50_ttft_s ({n_params/1e9:.1f}B llama bf16, "
+                  f"load=slots={ecfg.max_batch_size}, 1 chip)",
         "value": round(ttft_p50, 4),
         "unit": "s",
         "vs_baseline": round(TTFT_TARGET_S / ttft_p50, 3),
-        "decode_tok_s": round(tok_s, 1),
+        "ttft_max_s": round(ttfts[-1], 4),
+        "gen_tok_s_2x_load": round(tok_s, 1),
+        "decode_steps": int(decode_steps),
+        "batch_occupancy": round(occupancy, 3),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "hbm_weight_read_util": round(bw_util, 4) if bw_util is not None else None,
         "device": str(jax.devices()[0]),
     }))
 

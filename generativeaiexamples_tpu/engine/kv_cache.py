@@ -46,7 +46,16 @@ from generativeaiexamples_tpu.ops.layers import rotary_embedding
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class PagedKVCache:
-    """Paged KV pool: k, v (L, P, page_size, KV, HD); lengths (B,)."""
+    """Paged KV pool in the decode kernel's native FLAT layout.
+
+    k, v: (L*P, page_size, KV*HD) — layer l's physical page p lives at row
+    ``l*P + p``. The flat layout is load-bearing: the pool is a multi-GB
+    loop-carried buffer in decode/prefill, and any reshape or per-layer
+    slice of a loop carry makes XLA materialize a full copy per layer
+    (profiled at ~2 s per 8-step dispatch on a 3B model before this layout).
+    All access is by computed row index: pallas index maps for attention
+    reads, scatters for token writes. ``lengths``: (B,) live rows per slot.
+    """
 
     k: jnp.ndarray
     v: jnp.ndarray
@@ -61,10 +70,6 @@ class PagedKVCache:
 
     @property
     def page_size(self) -> int:
-        return self.k.shape[2]
-
-    @property
-    def num_pages(self) -> int:
         return self.k.shape[1]
 
     @staticmethod
@@ -73,8 +78,8 @@ class PagedKVCache:
                aux_sharding=None) -> "PagedKVCache":
         """Allocate the pool; shardings (if given) apply at creation so the
         multi-GB k/v buffers are never materialized on a single chip."""
-        shape = (cfg.n_layers, num_pages, page_size, cfg.n_kv_heads,
-                 cfg.head_dim)
+        shape = (cfg.n_layers * num_pages, page_size,
+                 cfg.n_kv_heads * cfg.head_dim)
         return PagedKVCache(
             k=jnp.zeros(shape, cfg.jdtype, device=kv_sharding),
             v=jnp.zeros(shape, cfg.jdtype, device=kv_sharding),
@@ -115,6 +120,7 @@ def prefill_chunk(params: llama.Params, cfg: llama.LlamaConfig,
                   tokens: jnp.ndarray, cache: PagedKVCache,
                   page_row: jnp.ndarray, slot: jnp.ndarray,
                   start_pos: jnp.ndarray, chunk_len: jnp.ndarray,
+                  num_pages: int,
                   adapters: Optional[llama.Params] = None,
                   ) -> Tuple[jnp.ndarray, PagedKVCache]:
     """One chunk of paged prompt processing for a single slot.
@@ -122,8 +128,9 @@ def prefill_chunk(params: llama.Params, cfg: llama.LlamaConfig,
     tokens: (1, C) right-padded chunk, C page-aligned; page_row: (max_pages,)
     the slot's block-table row; start_pos: scalar absolute position of the
     chunk (a multiple of the engine's chunk size); chunk_len: scalar valid
-    tokens in this chunk. Returns logits at the last valid position (1, V)
-    and the cache with the chunk's KV scattered into the slot's pages and
+    tokens in this chunk; num_pages: pages per layer in the flat pool.
+    Returns logits at the last valid position (1, V) and the cache with the
+    chunk's KV scattered into the slot's pages and
     ``lengths[slot] = start_pos + chunk_len``.
     """
     _, C = tokens.shape
@@ -145,13 +152,15 @@ def prefill_chunk(params: llama.Params, cfg: llama.LlamaConfig,
     use_pallas = (cfg.attn_impl == "pallas"
                   and pallas_ops.prefill_supported(C, T, HD))
 
-    def attn_and_update(q, k, v, k_l, v_l):
-        new_k_l = k_l.at[chunk_pages].set(
-            k.astype(k_l.dtype).reshape(n_cp, ps, KV, HD))
-        new_v_l = v_l.at[chunk_pages].set(
-            v.astype(v_l.dtype).reshape(n_cp, ps, KV, HD))
-        k_dense = new_k_l[page_row].reshape(1, T, KV, HD)
-        v_dense = new_v_l[page_row].reshape(1, T, KV, HD)
+    def attn_and_update(q, k, v, k_pool, v_pool, idx):
+        flat_pages = idx * num_pages + chunk_pages
+        new_k = k_pool.at[flat_pages].set(
+            k.astype(k_pool.dtype).reshape(n_cp, ps, KV * HD))
+        new_v = v_pool.at[flat_pages].set(
+            v.astype(v_pool.dtype).reshape(n_cp, ps, KV * HD))
+        flat_row = idx * num_pages + page_row
+        k_dense = new_k[flat_row].reshape(1, T, KV, HD)
+        v_dense = new_v[flat_row].reshape(1, T, KV, HD)
         if use_pallas:
             ctx = pallas_ops.flash_prefill(
                 q, k_dense, v_dense, start_pos=start_pos[None],
@@ -161,9 +170,9 @@ def prefill_chunk(params: llama.Params, cfg: llama.LlamaConfig,
                 q, k_dense, v_dense, q_positions=positions,
                 kv_positions=cache_positions,
                 kv_mask=cache_positions < valid_through[:, None], causal=True)
-        return ctx, new_k_l, new_v_l
+        return ctx, new_k, new_v
 
-    h, k_stack, v_stack = llama.scan_blocks(
+    h, k_stack, v_stack = llama.scan_blocks_inplace(
         cfg, h, params, (cache.k, cache.v), cos, sin, attn_and_update,
         adapters)
     h_last = jnp.take_along_axis(
@@ -176,14 +185,16 @@ def prefill_chunk(params: llama.Params, cfg: llama.LlamaConfig,
 def decode_step(params: llama.Params, cfg: llama.LlamaConfig,
                 tokens: jnp.ndarray, cache: PagedKVCache,
                 page_table: jnp.ndarray, write_mask: jnp.ndarray,
+                num_pages: int,
                 adapters: Optional[llama.Params] = None,
                 ) -> Tuple[jnp.ndarray, PagedKVCache]:
     """One paged decode step for every slot in the batch.
 
     tokens: (B,) last sampled token per slot; page_table: (B, max_pages);
     write_mask: (B,) bool — slots allowed to append (inactive slots write to
-    the null page instead). Returns logits (B, V) and the cache with
-    ``lengths + 1`` (the engine restores lengths of inactive slots).
+    the null page instead); num_pages: pages per layer in the flat pool.
+    Returns logits (B, V) and the cache with ``lengths + 1`` (the engine
+    restores lengths of inactive slots).
     """
     B = tokens.shape[0]
     ps = cache.page_size
@@ -203,18 +214,30 @@ def decode_step(params: llama.Params, cfg: llama.LlamaConfig,
     offs = cache.lengths % ps
 
     use_pallas = (cfg.attn_impl == "pallas"
-                  and pallas_ops.decode_supported(T, HD))
-    attn = pallas_ops.ragged_decode if use_pallas else mha_decode
+                  and pallas_ops.paged_decode_supported(ps, HD))
 
-    def attn_and_update(q, k, v, k_l, v_l):
-        new_k_l = k_l.at[rows, offs].set(k[:, 0].astype(k_l.dtype))
-        new_v_l = v_l.at[rows, offs].set(v[:, 0].astype(v_l.dtype))
-        k_dense = new_k_l[page_table].reshape(B, T, KV, HD)
-        v_dense = new_v_l[page_table].reshape(B, T, KV, HD)
-        ctx = attn(q, k_dense, v_dense, new_lengths)
-        return ctx, new_k_l, new_v_l
+    def attn_and_update(q, k, v, k_pool, v_pool, idx):
+        flat_rows = idx * num_pages + rows       # layer idx's pages
+        new_k = k_pool.at[flat_rows, offs].set(
+            k[:, 0].astype(k_pool.dtype).reshape(B, KV * HD))
+        new_v = v_pool.at[flat_rows, offs].set(
+            v[:, 0].astype(v_pool.dtype).reshape(B, KV * HD))
+        if use_pallas:
+            # reads this layer's pages straight from the carried pool via
+            # the block table + layer index — no dense gather, no slice,
+            # no reshape (any of which copies the multi-GB carry)
+            ctx = pallas_ops.paged_decode(q, new_k, new_v, page_table,
+                                          new_lengths, layer=idx,
+                                          pages_per_layer=num_pages)
+        else:
+            k_dense = new_k[idx * num_pages + page_table].reshape(
+                B, T, KV, HD)
+            v_dense = new_v[idx * num_pages + page_table].reshape(
+                B, T, KV, HD)
+            ctx = mha_decode(q, k_dense, v_dense, new_lengths)
+        return ctx, new_k, new_v
 
-    h, k_stack, v_stack = llama.scan_blocks(
+    h, k_stack, v_stack = llama.scan_blocks_inplace(
         cfg, h, params, (cache.k, cache.v), cos, sin, attn_and_update,
         adapters)
     logits = llama._unembed(cfg, params, h)[:, 0]

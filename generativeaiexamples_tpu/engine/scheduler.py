@@ -32,6 +32,7 @@ import threading
 import time
 import uuid
 from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Deque, Dict, Iterator, List, Optional, Sequence
 
@@ -39,12 +40,19 @@ import jax
 import numpy as np
 
 from generativeaiexamples_tpu.core.metrics import REGISTRY
-from generativeaiexamples_tpu.engine.engine import DecodeState, EngineCore
+from generativeaiexamples_tpu.engine.engine import (
+    DecodeState, EngineCore, unpack_decode_out)
 from generativeaiexamples_tpu.engine.tokenizer import IncrementalDetokenizer, Tokenizer
 
 logger = logging.getLogger(__name__)
 
 _STOP = object()
+
+
+def _fetch(arr) -> np.ndarray:
+    """Device→host fetch, run on the fetcher thread (releases the GIL during
+    the transfer, so it overlaps the driver thread's dispatching)."""
+    return np.asarray(jax.device_get(arr))
 
 
 @dataclass
@@ -82,6 +90,10 @@ class _Job:
     gen_ids: List[int] = field(default_factory=list)   # generated so far
     admit_seq: int = 0            # admission order (preemption picks max)
     prefill_elapsed: float = 0.0  # wall time across this prompt's chunks
+    # set when the fused final chunk has sampled this job's first token
+    # on-device; resolved (and cleared) at the next decode sync via
+    # out["input_tokens"]
+    first_pending: bool = False
 
 
 class Scheduler:
@@ -98,9 +110,19 @@ class Scheduler:
         self._alloc = core.new_allocator()
         self._table = np.zeros((core.batch, core.max_pages_per_slot), np.int32)
         self._table_dev: Optional[jax.Array] = None
+        self._inflight: Deque[tuple] = deque()   # dispatched, not yet synced
+        self._pending_steps = 0                  # decode steps in flight
+        # Dispatches kept in flight: results stream back on the fetcher
+        # thread while the driver keeps dispatching — on a remote-attached
+        # chip (~135 ms round trip) this is what keeps decode from being
+        # round-trip-bound. Staleness cost: done slots are reused (and first
+        # tokens resolve) up to depth dispatches late, so depth trades a
+        # little TTFT for transfer overlap.
+        self._pipeline_depth = 2
+        self._fetcher = ThreadPoolExecutor(max_workers=1,
+                                           thread_name_prefix="kv-fetch")
         self._admit_counter = 0
         self._state: DecodeState = core.init_state()
-        self._rng = jax.random.PRNGKey(1234)
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self._wake = threading.Event()
@@ -118,6 +140,7 @@ class Scheduler:
     def stop(self) -> None:
         self._running = False
         self._wake.set()
+        self._fetcher.shutdown(wait=False)
         if self._thread:
             self._thread.join(timeout=60)
             if self._thread.is_alive():
@@ -179,6 +202,8 @@ class Scheduler:
         self._free = list(range(self.core.batch))
         self._table[:] = 0
         self._table_dev = None
+        self._inflight.clear()
+        self._pending_steps = 0
 
     def _release(self, job: _Job) -> None:
         """Return the job's slot and pages to the pools."""
@@ -274,41 +299,31 @@ class Scheduler:
         remaining = len(job.ids) - start
         chunk_ids = job.ids[start:start + min(remaining, self.core.chunk)]
         t0 = time.perf_counter()
-        self._state, logits = self.core.prefill_chunk(
-            self._state, chunk_ids, self._table[job.slot], job.slot, start)
-        job.prefilled += len(chunk_ids)
-        job.total_len = job.prefilled
         REGISTRY.counter("prefill_chunks").inc()
-        if job.prefilled < len(job.ids):
+        if job.prefilled + len(chunk_ids) < len(job.ids):
+            self._state, _ = self.core.prefill_chunk(
+                self._state, chunk_ids, self._table[job.slot], job.slot,
+                start)
+            job.prefilled += len(chunk_ids)
+            job.total_len = job.prefilled
             job.prefill_elapsed += time.perf_counter() - t0
             return  # mid-prompt; decode interleaves before the next chunk
 
-        # final chunk: sample the first token (host sync = TTFT)
+        # Final chunk: sampling + activation are FUSED into the chunk program
+        # (engine._chunk_last_impl) — admission never blocks on a host round
+        # trip. The first token's value arrives with the next decode sync
+        # (out["input_tokens"]), where TTFT is stamped.
         self._prefilling.popleft()
-        self._rng, sub = jax.random.split(self._rng)
-        tok = self.core.sample(logits, sub, req.temperature, req.top_k,
-                               req.top_p)
-        resumed = bool(job.gen_ids)
-        if not resumed:
-            req.first_token_at = time.perf_counter()
-            REGISTRY.histogram("ttft_s").observe(
-                req.first_token_at - req.submitted_at)
-        # whole-prompt prefill time: every chunk (accumulated across the
-        # interleaved ticks) plus the first-token sample sync above
+        already = len(job.gen_ids)
+        self._state, _ = self.core.prefill_chunk_last(
+            self._state, chunk_ids, self._table[job.slot], job.slot, start,
+            generated=already + 1, max_gen=req.max_tokens,
+            temperature=req.temperature, top_k=req.top_k, top_p=req.top_p)
+        job.prefilled += len(chunk_ids)
+        job.total_len = job.prefilled
+        job.first_pending = True
         job.prefill_elapsed += time.perf_counter() - t0
         REGISTRY.histogram("prefill_s").observe(job.prefill_elapsed)
-
-        already = len(job.gen_ids)
-        if tok == self.core.eos_id or already + 1 >= req.max_tokens:
-            if tok != self.core.eos_id:
-                self._emit_token(job, tok)
-            self._finish(job)
-            return
-        self._emit_token(job, tok)
-        self._state = self.core.activate(
-            self._state, job.slot, tok, generated=already + 1,
-            max_gen=req.max_tokens, temperature=req.temperature,
-            top_k=req.top_k, top_p=req.top_p)
         self._slots[job.slot] = job
 
     def _emit_token(self, job: _Job, tok: int) -> None:
@@ -321,27 +336,65 @@ class Scheduler:
 
     # -- decode -------------------------------------------------------------
 
-    def _grow_pages(self) -> None:
-        """Give every active slot a page for its next write; preempt the
-        youngest admissions when the pool runs dry."""
+    def _grow_pages(self, steps: int) -> int:
+        """Give every active slot pages for its next writes, targeting a
+        ``steps``-deep dispatch. Preemption (youngest first) only kicks in
+        when even ONE step cannot be covered; mere horizon pressure instead
+        shrinks the dispatch depth. Returns the number of fused steps every
+        surviving slot has pages for (>= 1)."""
+        effective = steps
         for slot in list(self._slots):
             job = self._slots.get(slot)
             if job is None:
                 continue
-            # total_len counts the just-sampled (not yet written) token, so
-            # the next decode write lands at index total_len - 1; while the
-            # slot is active that stays < max_seq and within the table row.
-            while len(job.pages) < self.core.pages_for(job.total_len - 1):
+            while self._slots.get(slot) is job:
+                # total_len is the host view (updated only when a dispatch is
+                # processed); writes already in flight plus this dispatch's
+                # K steps land at indices up to total_len + pending + K - 1
+                # (ceiling: covers just-activated and mid-decode cases).
+                # Device-side out_of_cache keeps writes under max_seq,
+                # mirrored here by the table-row clamp.
+                next_write = job.total_len + self._pending_steps
+                target = min(self.core.pages_for(next_write + steps - 1),
+                             self.core.max_pages_per_slot)
+                minimum = min(self.core.pages_for(next_write),
+                              self.core.max_pages_per_slot)
+                if len(job.pages) >= target:
+                    break
                 got = self._alloc.alloc(1)
                 if got is not None:
                     self._table[slot, len(job.pages)] = got[0]
                     job.pages.extend(got)
                     self._table_dev = None
                     continue
+                if len(job.pages) >= minimum:
+                    break  # one step covered; just shrink the horizon
+                if self._inflight:
+                    # the host view is up to pending_steps stale — in-flight
+                    # results may already finish this job or free pages.
+                    # Drain before any destructive decision (rare slow path).
+                    while self._inflight:
+                        self._process_decode()
+                    continue  # re-evaluate with fresh totals
                 victim = self._pick_victim()
                 self._preempt(victim)
                 if victim is job:
                     break  # the grower was youngest: it waits in the queue
+            if self._slots.get(slot) is not job:
+                continue  # finished while draining, or preempted itself
+            if len(job.pages) < self.core.max_pages_per_slot:
+                next_write = job.total_len + self._pending_steps
+                covered = len(job.pages) * self.core.page_size - next_write
+                effective = max(1, min(effective, covered))
+            # at full table capacity the device-side out_of_cache guard ends
+            # the slot before it could outrun its row — no clamp needed
+        # round down to a power of two: `steps` is a compile-time constant of
+        # the decode program, so an unbounded range of values would trigger
+        # a fresh XLA compile (seconds) mid-serving under page pressure
+        p2 = 1
+        while p2 * 2 <= effective:
+            p2 *= 2
+        return p2
 
     def _pick_victim(self) -> _Job:
         """Youngest admission — decoding slots and mid-prefill jobs alike
@@ -363,31 +416,86 @@ class Scheduler:
         job.prefilled = 0
         job.total_len = 0
         job.prefill_elapsed = 0.0   # the resume's re-prefill is a fresh sample
+        # an unsynced first token is recomputed by the resume's re-prefill
+        job.first_pending = False
         with self._lock:
             self._pending.appendleft(job)
         REGISTRY.counter("preemptions").inc()
         logger.info("preempted request %s at %d generated tokens",
                     job.request.request_id, len(job.gen_ids))
 
-    def _decode_once(self) -> None:
-        self._grow_pages()
+    @property
+    def _steps(self) -> int:
+        """Fused decode steps per dispatch: full depth when no admission is
+        in flight; halved while prefilling so chunk interleave (and thus
+        TTFT of queued prompts) stays reasonably fine-grained."""
+        k = max(1, self.core.cfg.decode_steps_per_dispatch)
+        return max(1, k // 2) if self._prefilling else k
+
+    def _dispatch_decode(self) -> None:
+        """Issue one K-step decode dispatch without waiting for its result
+        (dispatch-ahead pipelining: the transfer of dispatch N overlaps the
+        compute of dispatch N+1, hiding host-device sync latency entirely —
+        the difference between ~470 and ~900 tok/s over a remote-attached
+        chip). Freshly-activated slots are snapshotted with the dispatch so
+        their fused-prefill first token is resolved against the right step-0
+        input."""
+        steps = self._grow_pages(self._steps)
         if not self._slots:
             return
-        self._state, out = self.core.decode(self._state, self._table_device())
-        sampled = np.asarray(jax.device_get(out["sampled"]))
-        emitted = np.asarray(jax.device_get(out["emitted"]))
-        done = np.asarray(jax.device_get(out["done"]))
-        hit_eos = np.asarray(jax.device_get(out["hit_eos"]))
-        REGISTRY.counter("decode_steps").inc()
-        REGISTRY.counter("tokens_generated").inc(int(emitted.sum()))
-        for slot, job in list(self._slots.items()):
-            if not emitted[slot]:
-                continue
-            if not (done[slot] and hit_eos[slot]):
-                self._emit_token(job, int(sampled[slot]))
-            if done[slot]:
+        fresh = [(s, j) for s, j in self._slots.items() if j.first_pending]
+        for _, j in fresh:
+            j.first_pending = False
+        self._state, out = self.core.decode(self._state, self._table_device(),
+                                            steps)
+        # hand the result to the fetcher thread NOW: device→host round trips
+        # (~135 ms over a remote-attached chip) then overlap with further
+        # dispatching instead of serializing into the driver loop
+        packed = self._fetcher.submit(_fetch, out["packed"])
+        # snapshot slot→job at dispatch time: a slot freed and reused while
+        # this dispatch is in flight must not leak the old job's tokens into
+        # the new job's stream (identity-checked at processing)
+        self._inflight.append((steps, packed, fresh, dict(self._slots)))
+        self._pending_steps += steps
+        REGISTRY.counter("decode_steps").inc(steps)
+
+    def _process_decode(self) -> None:
+        """Sync + fan out the OLDEST in-flight dispatch (FIFO)."""
+        steps, packed, fresh, active_map = self._inflight.popleft()
+        self._pending_steps -= steps
+        # one transfer per dispatch, already in flight on the fetcher thread
+        out = unpack_decode_out(packed.result())
+        now = time.perf_counter()
+        REGISTRY.counter("tokens_generated").inc(int(out["emitted"].sum()))
+        for slot, job in fresh:
+            if self._slots.get(slot) is not job:
+                continue  # preempted while in flight; resume re-samples
+            req = job.request
+            first = int(out["input_tokens"][0, slot])
+            if req.first_token_at is None:         # not a preemption resume
+                req.first_token_at = now
+                REGISTRY.histogram("ttft_s").observe(now - req.submitted_at)
+            already = len(job.gen_ids)
+            if first == self.core.eos_id:
                 del self._slots[slot]
                 self._finish(job)
+                continue
+            self._emit_token(job, first)
+            if already + 1 >= req.max_tokens:
+                del self._slots[slot]
+                self._finish(job)
+        for slot, job in active_map.items():
+            if self._slots.get(slot) is not job:
+                continue  # finished or preempted since this dispatch
+            for k in range(steps):
+                if not out["emitted"][k, slot]:
+                    continue
+                if not (out["done"][k, slot] and out["hit_eos"][k, slot]):
+                    self._emit_token(job, int(out["sampled"][k, slot]))
+                if out["done"][k, slot]:
+                    del self._slots[slot]
+                    self._finish(job)
+                    break
 
     # -- driver loop --------------------------------------------------------
 
@@ -396,10 +504,24 @@ class Scheduler:
         self._admit()
         worked = False
         if self._prefilling:
-            self._prefill_step()
+            # prefill-priority rampup: while the decode batch is underfilled,
+            # burn several chunks per tick (each dispatch pays a fixed
+            # round-trip cost on remote-attached chips — batching admissions
+            # is what gets queued requests their first token sooner)
+            burst = 4 if len(self._slots) < self.core.batch // 2 else 1
+            for _ in range(burst):
+                if not self._prefilling:
+                    break
+                self._prefill_step()
             worked = True
         if self._slots:
-            self._decode_once()
+            self._dispatch_decode()
+            worked = True
+        # keep at most one dispatch in flight beyond the one just issued;
+        # drain fully once nothing is left to dispatch
+        while (len(self._inflight) > self._pipeline_depth
+               or (self._inflight and not self._slots)):
+            self._process_decode()
             worked = True
         return worked
 

@@ -313,6 +313,39 @@ def scan_blocks(cfg: LlamaConfig, h: jnp.ndarray, params: Params,
     return h, k_stack, v_stack
 
 
+def scan_blocks_inplace(cfg: LlamaConfig, h: jnp.ndarray, params: Params,
+                        kv_pool: Tuple[jnp.ndarray, jnp.ndarray],
+                        cos: jnp.ndarray, sin: jnp.ndarray, attn_and_update,
+                        adapters: Optional[Params]):
+    """Layer scan with the FULL KV pool as loop carry, updated in place.
+
+    Unlike :func:`scan_blocks` (per-layer cache slices as scan inputs and
+    freshly-stacked outputs — XLA copies the whole cache through the loop
+    every call, ~2x the cache size in HBM traffic per decode step), the pool
+    here is a while-loop carry: with the caller donating the buffers, XLA
+    aliases the carry and each layer's write is a true in-place scatter.
+    ``attn_and_update(q, k_chunk, v_chunk, k_pool, v_pool, layer_idx) ->
+    (ctx, k_pool', v_pool')`` owns the write and the (paged) attention read.
+    """
+    def body(carry, xs):
+        h, k_pool, v_pool, idx = carry
+        layer, ad = xs
+        store = {}
+
+        def attn(q, k, v):
+            ctx, store["k"], store["v"] = attn_and_update(
+                q, k, v, k_pool, v_pool, idx)
+            return ctx
+
+        h = _block(cfg, h, layer, cos, sin, attn, ad)
+        return (h, store["k"], store["v"], idx + 1), None
+
+    (h, k_pool, v_pool, _), _ = jax.lax.scan(
+        body, (h, kv_pool[0], kv_pool[1], jnp.int32(0)),
+        (params["layers"], adapters or {}))
+    return h, k_pool, v_pool
+
+
 def _scan_cached_blocks(cfg: LlamaConfig, h: jnp.ndarray, params: Params,
                         cache: KVCache, cos: jnp.ndarray, sin: jnp.ndarray,
                         write_pos: jnp.ndarray, attn_with_cache,

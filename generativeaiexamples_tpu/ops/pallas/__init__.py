@@ -7,6 +7,8 @@ CPU + compiled on TPU).
 
 from generativeaiexamples_tpu.ops.pallas.attention import (  # noqa: F401
     flash_prefill,
+    paged_decode,
+    paged_decode_supported,
     ragged_decode,
     decode_supported,
     prefill_supported,

@@ -66,26 +66,37 @@ def sample_logits_dynamic(rng: jax.Array, logits: jnp.ndarray,
 
     temperature<=0 ⇒ greedy for that slot. top_k<=0 ⇒ disabled.
     logits: (B, V); temperature/top_k/top_p: (B,).
+
+    The full path costs three (B, V) vocab sorts per decode step (~3 ms at
+    V=128k on v5e); when the whole batch is greedy — a common serving mix
+    and every deterministic eval — a `lax.cond` skips straight to argmax.
     """
     B, V = logits.shape
     lf = logits.astype(jnp.float32)
-    safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
-    scaled = lf / safe_t
-
-    # top-k: rank of each logit within its row (0 = largest)
-    ranks = jnp.argsort(jnp.argsort(scaled, axis=-1)[..., ::-1], axis=-1)
-    k_eff = jnp.where(top_k > 0, top_k, V)[:, None]
-    scaled = jnp.where(ranks < k_eff, scaled, -jnp.inf)
-
-    # top-p over the k-filtered distribution
-    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
-    probs = jax.nn.softmax(sorted_desc, axis=-1)
-    cum_excl = jnp.roll(jnp.cumsum(probs, axis=-1), 1, axis=-1).at[..., 0].set(0.0)
-    keep = cum_excl < top_p[:, None]
-    keep = keep.at[..., 0].set(True)  # top_p=0 degrades to greedy, not all -inf
-    cutoff = jnp.where(keep, sorted_desc, jnp.inf).min(axis=-1, keepdims=True)
-    scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
-
-    sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
     greedy = jnp.argmax(lf, axis=-1).astype(jnp.int32)
-    return jnp.where(temperature > 0, sampled, greedy)
+
+    def full_path(_):
+        safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+        scaled = lf / safe_t
+
+        # top-k: rank of each logit within its row (0 = largest)
+        ranks = jnp.argsort(jnp.argsort(scaled, axis=-1)[..., ::-1], axis=-1)
+        k_eff = jnp.where(top_k > 0, top_k, V)[:, None]
+        scaled = jnp.where(ranks < k_eff, scaled, -jnp.inf)
+
+        # top-p over the k-filtered distribution
+        sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum_excl = jnp.roll(jnp.cumsum(probs, axis=-1), 1,
+                            axis=-1).at[..., 0].set(0.0)
+        keep = cum_excl < top_p[:, None]
+        keep = keep.at[..., 0].set(True)  # top_p=0 degrades to greedy
+        cutoff = jnp.where(keep, sorted_desc, jnp.inf).min(axis=-1,
+                                                           keepdims=True)
+        scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
+
+        sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+        return jnp.where(temperature > 0, sampled, greedy)
+
+    return jax.lax.cond(jnp.any(temperature > 0), full_path,
+                        lambda _: greedy, operand=None)

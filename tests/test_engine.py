@@ -114,8 +114,8 @@ def test_engine_matches_model_greedy(engine):
     got = [first]
     for _ in range(5):
         state, out = core.decode(state, core.put_table(table))
-        assert bool(out["emitted"][2])
-        got.append(int(out["sampled"][2]))
+        assert bool(out["emitted"][0, 2])
+        got.append(int(out["sampled"][0, 2]))
     assert got == expect
 
 
@@ -133,7 +133,7 @@ def test_engine_slots_are_independent(engine):
         toks = [first]
         for _ in range(steps):
             state, out = core.decode(state, core.put_table(table))
-            toks.append(int(out["sampled"][0]))
+            toks.append(int(out["sampled"][0, 0]))
         return toks
 
     p1 = tok.encode("hello", add_bos=True)
@@ -152,8 +152,8 @@ def test_engine_slots_are_independent(engine):
     got1, got2 = [f1], [f2]
     for _ in range(4):
         state, out = core.decode(state, core.put_table(table))
-        got1.append(int(out["sampled"][0]))
-        got2.append(int(out["sampled"][3]))
+        got1.append(int(out["sampled"][0, 0]))
+        got2.append(int(out["sampled"][0, 3]))
     assert got1 == want1
     assert got2 == want2
 
@@ -172,9 +172,9 @@ def test_engine_budget_and_slot_reuse(engine):
     table = np.zeros((core.batch, core.max_pages_per_slot), np.int32)
     state = fresh_start(state, table, alloc, max_gen=3)
     state, out = core.decode(state, core.put_table(table))   # generated=2
-    assert not bool(out["done"][1])
+    assert not bool(out["done"][0, 1])
     state, out = core.decode(state, core.put_table(table))   # generated=3
-    assert bool(out["done"][1])
+    assert bool(out["done"][0, 1])
     assert not bool(state.active[1])
     # reuse the slot with a fresh request (fresh pages) → like a fresh engine
     state = fresh_start(state, table, alloc, max_gen=8)
@@ -183,7 +183,7 @@ def test_engine_budget_and_slot_reuse(engine):
     table2 = np.zeros((core.batch, core.max_pages_per_slot), np.int32)
     fresh = fresh_start(fresh, table2, core.new_allocator(), max_gen=8)
     fresh, outf = core.decode(fresh, core.put_table(table2))
-    assert int(out["sampled"][1]) == int(outf["sampled"][1])
+    assert int(out["sampled"][0, 1]) == int(outf["sampled"][0, 1])
 
 
 def test_released_slot_writes_go_to_null_page(engine):
@@ -209,8 +209,8 @@ def test_released_slot_writes_go_to_null_page(engine):
     got = [f1]
     for _ in range(5):
         state, out = core.decode(state, core.put_table(table))
-        assert not bool(out["emitted"][0])
-        got.append(int(out["sampled"][1]))
+        assert not bool(out["emitted"][0, 0])
+        got.append(int(out["sampled"][0, 1]))
 
     # reference: slot 1 alone
     ref_state = core.init_state()
@@ -222,7 +222,7 @@ def test_released_slot_writes_go_to_null_page(engine):
     want = [fr]
     for _ in range(5):
         ref_state, out = core.decode(ref_state, core.put_table(t2))
-        want.append(int(out["sampled"][1]))
+        want.append(int(out["sampled"][0, 1]))
     assert got == want
 
 
@@ -307,26 +307,33 @@ def test_scheduler_rejects_over_capacity_prompt(engine):
 
 
 def test_scheduler_decode_interleaves_with_chunked_prefill(engine):
-    """Active slots emit tokens between the chunks of a long admission."""
-    core, tok, cfg, params = engine
+    """Active slots keep decoding between the chunks of a long admission
+    (dispatches are pipelined, so progress is asserted at the dispatch
+    level: decode steps are issued while the long prompt is mid-prefill)."""
+    from generativeaiexamples_tpu.core.metrics import REGISTRY
+    _, tok, cfg, params = engine
+    core = EngineCore(cfg, EngineConfig(max_batch_size=4, max_seq_len=256,
+                                        prefill_chunk=32, page_size=16),
+                      params, eos_id=tok.eos_id)
     sched = Scheduler(core, tok)   # not started: we drive ticks by hand
     short = Request(prompt_ids=tok.encode("hi", add_bos=True), max_tokens=40,
                     temperature=0.0)
     sched.submit(short)
-    sched._tick()                  # admit + prefill + first decode
+    sched._tick()                  # admit + prefill + first decode dispatch
     assert sched._slots, "short request should be decoding"
-    emitted_before = short.completion_tokens
+    steps_before = REGISTRY.counter("decode_steps").value
 
-    long = Request(prompt_ids=tok.encode("n" * 100, add_bos=True),
-                   max_tokens=4, temperature=0.0)
+    long = Request(prompt_ids=tok.encode("n" * 200, add_bos=True),
+                   max_tokens=4, temperature=0.0)   # 7 chunks > one burst
     sched.submit(long)
-    sched._tick()                  # one chunk of `long` + one decode step
+    sched._tick()                  # a chunk burst of `long` + decode dispatch
     assert sched._prefilling, "long prompt must still be mid-prefill"
-    assert short.completion_tokens > emitted_before, \
+    assert REGISTRY.counter("decode_steps").value > steps_before, \
         "decode stalled during chunked admission"
     while sched._tick():
         pass
     assert short.error is None and long.error is None
+    assert short.completion_tokens == 40
     assert long.completion_tokens == 4
 
 

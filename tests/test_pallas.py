@@ -102,12 +102,86 @@ def test_ragged_decode_single_kv_head():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=TOL)
 
 
+def test_paged_decode_matches_gathered_reference():
+    """Block-table paged decode == mha_decode on the gathered dense view,
+    with shuffled physical pages and per-slot ragged lengths."""
+    from generativeaiexamples_tpu.ops.pallas import paged_decode
+
+    rng = np.random.default_rng(7)
+    B, ps, maxp, H, KV, HD = 3, 16, 8, 8, 4, 32
+    P = B * maxp + 1                       # + null page 0
+    q = _rand(rng, (B, 1, H, HD))
+    k_pages = _rand(rng, (P, ps, KV * HD))   # kernel-native flat layout
+    v_pages = _rand(rng, (P, ps, KV * HD))
+    # each slot owns a shuffled, disjoint set of physical pages
+    perm = rng.permutation(np.arange(1, P))
+    table = jnp.asarray(perm.reshape(B, maxp), jnp.int32)
+    lens = jnp.array([5, 128, 77], jnp.int32)
+
+    k_dense = k_pages[table].reshape(B, maxp * ps, KV, HD)
+    v_dense = v_pages[table].reshape(B, maxp * ps, KV, HD)
+    ref = mha_decode(q, k_dense, v_dense, lens)
+    out = paged_decode(q, k_pages, v_pages, table, lens, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=TOL)
+
+
+def test_paged_decode_layer_indexed_pool():
+    """A multi-layer flat pool (L*P rows) with a dynamic layer index must
+    match slicing that layer's pages out by hand."""
+    from generativeaiexamples_tpu.ops.pallas import paged_decode
+
+    rng = np.random.default_rng(9)
+    L, B, ps, maxp, H, KV, HD = 3, 2, 16, 4, 4, 2, 16
+    P = B * maxp + 1
+    q = _rand(rng, (B, 1, H, HD))
+    k_pool = _rand(rng, (L * P, ps, KV * HD))
+    v_pool = _rand(rng, (L * P, ps, KV * HD))
+    table = jnp.asarray(
+        rng.permutation(np.arange(1, P)).reshape(B, maxp), jnp.int32)
+    lens = jnp.array([30, 64], jnp.int32)
+
+    for layer in range(L):
+        layer_k = k_pool[layer * P:(layer + 1) * P]
+        layer_v = v_pool[layer * P:(layer + 1) * P]
+        ref = paged_decode(q, layer_k, layer_v, table, lens, interpret=True)
+        out = paged_decode(q, k_pool, v_pool, table, lens,
+                           layer=jnp.int32(layer), pages_per_layer=P,
+                           interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=TOL)
+
+
+def test_paged_decode_stale_table_entries_are_masked():
+    """Entries past the slot's live pages may point anywhere (stale/0) —
+    length masking must keep them out of the result."""
+    from generativeaiexamples_tpu.ops.pallas import paged_decode
+
+    rng = np.random.default_rng(8)
+    B, ps, maxp, H, KV, HD = 2, 16, 4, 4, 2, 16
+    P = 16
+    q = _rand(rng, (B, 1, H, HD))
+    k_pages = _rand(rng, (P, ps, KV * HD))
+    v_pages = _rand(rng, (P, ps, KV * HD))
+    lens = jnp.array([20, 9], jnp.int32)   # 2 pages / 1 page live
+    table = jnp.array([[3, 7, 0, 0], [5, 0, 0, 0]], jnp.int32)
+    garbage = jnp.array([[3, 7, 11, 12], [5, 9, 13, 1]], jnp.int32)
+
+    out_clean = paged_decode(q, k_pages, v_pages, table, lens, interpret=True)
+    out_noisy = paged_decode(q, k_pages, v_pages, garbage, lens, interpret=True)
+    np.testing.assert_allclose(np.asarray(out_noisy), np.asarray(out_clean),
+                               atol=TOL)
+
+
 def test_supported_predicates():
+    from generativeaiexamples_tpu.ops.pallas import paged_decode_supported
+
     assert prefill_supported(512, 512, 128)
     assert prefill_supported(64, 2048, 128)
     assert not prefill_supported(7, 512, 128)     # odd chunk length
     assert decode_supported(2048, 128)
     assert not decode_supported(12, 128)          # tiny cache, no 8-divisor
+    assert paged_decode_supported(128, 128)
+    assert paged_decode_supported(16, 16)
+    assert not paged_decode_supported(4, 128)     # page too small to DMA
 
 
 def test_model_prefill_decode_with_pallas_backend():
