@@ -11,3 +11,11 @@ bench:
 dryrun:
 	$(TEST_ENV) XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	  python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
+
+# Serve the chain server (tiny model) and the playground UI against it.
+serve:
+	$(TEST_ENV) python -m generativeaiexamples_tpu.server --tiny --port 8081
+
+playground:
+	$(TEST_ENV) python -m generativeaiexamples_tpu.playground \
+	  --chain-url http://localhost:8081 --port 8090

@@ -209,7 +209,7 @@ class EngineConfig:
     page_size: int = configfield("page_size", default=128, help_txt="KV page granularity (tokens).")
     num_pages: int = configfield("num_pages", default=0, help_txt="Physical KV pages in the pool (bounds HBM by live tokens); 0 = full slot capacity.")
     prefill_chunk: int = configfield("prefill_chunk", default=512, help_txt="Chunked-prefill bucket size.")
-    decode_steps_per_dispatch: int = configfield("decode_steps_per_dispatch", default=8, help_txt="Decode steps fused into one device dispatch (lax.scan); amortizes host sync latency.")
+    decode_steps_per_dispatch: int = configfield("decode_steps_per_dispatch", default=8, help_txt="Decode steps fused into one device dispatch (lax.scan); amortizes host sync latency. Must be a power of two (each distinct step count is a separate compile).")
     donate_buffers: str = configfield("donate_buffers", default="auto", help_txt="Donate the KV pool through dispatches: on | off | auto (off on remote-attached chips, where the client blocks ~RTT per donated dispatch; costs a transient 2x pool copy when off).")
     dtype: str = configfield("dtype", default="bfloat16", help_txt="Activation/weight dtype.")
     attention: str = configfield("attention", default="auto", help_txt="Attention backend: auto (pallas on TPU, xla elsewhere) | pallas | xla.")

@@ -130,6 +130,13 @@ class EngineCore:
             raise ValueError(
                 f"max_seq_len ({self.max_seq}) must be a multiple of "
                 f"prefill_chunk ({self.chunk})")
+        k = engine_cfg.decode_steps_per_dispatch
+        if k < 1 or k & (k - 1):
+            # the scheduler restricts dynamic step counts to powers of two
+            # (each distinct value is a separate XLA compile); reject rather
+            # than silently round the operator's setting down
+            raise ValueError(
+                f"decode_steps_per_dispatch ({k}) must be a power of two")
         self.max_pages_per_slot = -(-self.max_seq // self.page_size)
         # total physical pages: 0 = full slot capacity (+ null page 0)
         self.num_pages = (engine_cfg.num_pages or

@@ -89,7 +89,7 @@ class _Job:
     total_len: int = 0            # host mirror of cache lengths[slot]
     gen_ids: List[int] = field(default_factory=list)   # generated so far
     admit_seq: int = 0            # admission order (preemption picks max)
-    prefill_elapsed: float = 0.0  # wall time across this prompt's chunks
+    prefill_started: float = 0.0  # wall clock of this prompt's first chunk
     # set when the fused final chunk has sampled this job's first token
     # on-device; resolved (and cleared) at the next decode sync via
     # out["input_tokens"]
@@ -140,7 +140,6 @@ class Scheduler:
     def stop(self) -> None:
         self._running = False
         self._wake.set()
-        self._fetcher.shutdown(wait=False)
         if self._thread:
             self._thread.join(timeout=60)
             if self._thread.is_alive():
@@ -150,6 +149,9 @@ class Scheduler:
                 logger.warning("driver thread still busy at stop(); "
                                "skipping forced cleanup")
                 return
+        # only after the driver has exited: a mid-tick dispatch must not see
+        # a shut-down executor
+        self._fetcher.shutdown(wait=False)
         self._fail_all("scheduler stopped")
 
     def submit(self, request: Request) -> Request:
@@ -298,7 +300,8 @@ class Scheduler:
         start = job.prefilled
         remaining = len(job.ids) - start
         chunk_ids = job.ids[start:start + min(remaining, self.core.chunk)]
-        t0 = time.perf_counter()
+        if start == 0:
+            job.prefill_started = time.perf_counter()
         REGISTRY.counter("prefill_chunks").inc()
         if job.prefilled + len(chunk_ids) < len(job.ids):
             self._state, _ = self.core.prefill_chunk(
@@ -306,7 +309,6 @@ class Scheduler:
                 start)
             job.prefilled += len(chunk_ids)
             job.total_len = job.prefilled
-            job.prefill_elapsed += time.perf_counter() - t0
             return  # mid-prompt; decode interleaves before the next chunk
 
         # Final chunk: sampling + activation are FUSED into the chunk program
@@ -322,8 +324,6 @@ class Scheduler:
         job.prefilled += len(chunk_ids)
         job.total_len = job.prefilled
         job.first_pending = True
-        job.prefill_elapsed += time.perf_counter() - t0
-        REGISTRY.histogram("prefill_s").observe(job.prefill_elapsed)
         self._slots[job.slot] = job
 
     def _emit_token(self, job: _Job, tok: int) -> None:
@@ -415,7 +415,7 @@ class Scheduler:
         job.ids = list(job.request.prompt_ids) + list(job.gen_ids)
         job.prefilled = 0
         job.total_len = 0
-        job.prefill_elapsed = 0.0   # the resume's re-prefill is a fresh sample
+        job.prefill_started = 0.0   # the resume's re-prefill is a fresh sample
         # an unsynced first token is recomputed by the resume's re-prefill
         job.first_pending = False
         with self._lock:
@@ -475,6 +475,14 @@ class Scheduler:
             if req.first_token_at is None:         # not a preemption resume
                 req.first_token_at = now
                 REGISTRY.histogram("ttft_s").observe(now - req.submitted_at)
+            # whole-prompt prefill latency, first chunk dispatched → first
+            # token value on the host (an upper bound that includes the
+            # pipeline's resolution lag; every dispatch is async, so there
+            # is no tighter host-observable event)
+            if job.prefill_started:
+                REGISTRY.histogram("prefill_s").observe(
+                    now - job.prefill_started)
+                job.prefill_started = 0.0
             already = len(job.gen_ids)
             if first == self.core.eos_id:
                 del self._slots[slot]

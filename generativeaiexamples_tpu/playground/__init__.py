@@ -1,0 +1,12 @@
+"""RAG Playground — the in-tree web UI over the chain-server API.
+
+Counterpart of the reference's L7 layer (ref: RAG/src/rag_playground/default —
+gradio Blocks pages `converse.py` and `kb.py` talking to the chain server via
+`chat_client.py`). Re-designed dependency-free: a small aiohttp app serves a
+static single-page UI (vanilla JS, SSE over fetch) and proxies `/api/*` to
+the chain server, injecting W3C ``traceparent`` headers on every upstream
+call the way the reference's ChatClient does (ref chat_client.py:43,63-171)
+so one trace spans UI → chain server → engine.
+"""
+
+from generativeaiexamples_tpu.playground.app import PlaygroundServer, run_playground  # noqa: F401

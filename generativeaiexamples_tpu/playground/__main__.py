@@ -1,0 +1,40 @@
+"""CLI entry: run the RAG Playground web UI.
+
+    python -m generativeaiexamples_tpu.playground \
+        [--chain-url http://localhost:8081] [--port 8090]
+
+Counterpart of the reference's `python -m frontend` service (ref
+rag_playground/default/__main__.py: --host/--port args, APP_SERVERURL/
+APP_SERVERPORT env pointing at the chain server).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+
+from generativeaiexamples_tpu.playground.app import run_playground
+
+
+def main() -> None:
+    default_chain = os.environ.get("APP_SERVERURL", "http://localhost")
+    default_port = os.environ.get("APP_SERVERPORT", "8081")
+    if not default_chain.startswith("http"):
+        default_chain = "http://" + default_chain
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--chain-url",
+                        default=f"{default_chain}:{default_port}",
+                        help="chain server base URL")
+    parser.add_argument("--model-name", default=os.environ.get(
+        "APP_MODELNAME", "tpu-llm"))
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8090)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    run_playground(args.chain_url, args.model_name, host=args.host,
+                   port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,168 @@
+"""Playground web app: static UI + traced reverse proxy to the chain server.
+
+The reference runs its UI as a separate service pointed at the chain server
+(ref: docker-compose `rag-playground` service, APP_SERVERURL/APP_SERVERPORT;
+chat_client.py builds `{server_url}/generate` etc. and streams SSE). Same
+topology here: `python -m generativeaiexamples_tpu.playground
+--chain-url http://host:8081` serves the UI and forwards
+
+    POST /api/generate    → {chain}/generate      (SSE passthrough)
+    POST /api/search      → {chain}/search
+    GET  /api/documents   → {chain}/documents
+    POST /api/documents   → {chain}/documents     (multipart passthrough)
+    DELETE /api/documents → {chain}/documents?filename=...
+
+with a fresh UI span's ``traceparent`` injected upstream per request
+(ref chat_client.py:43 — every client call is wrapped in a span; the
+playground is where traces begin).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from generativeaiexamples_tpu.observability import otel
+
+logger = logging.getLogger(__name__)
+
+STATIC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "static")
+
+
+class PlaygroundServer:
+    def __init__(self, chain_url: str, model_name: str = "tpu-llm") -> None:
+        self.chain_url = chain_url.rstrip("/")
+        self.model_name = model_name
+        self.app = web.Application(client_max_size=128 * 1024 * 1024)
+        self.app.add_routes([
+            web.get("/", self.index),
+            web.get("/health", self.health),
+            web.get("/api/config", self.config),
+            web.post("/api/generate", self.generate),
+            web.post("/api/search", self.search),
+            web.get("/api/documents", self.get_documents),
+            web.post("/api/documents", self.upload_document),
+            web.delete("/api/documents", self.delete_document),
+            web.static("/static", STATIC_DIR),
+        ])
+        self.app.cleanup_ctx.append(self._client_ctx)
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    async def _client_ctx(self, app):
+        self._session = aiohttp.ClientSession()
+        yield
+        await self._session.close()
+
+    def _headers(self, span_name: str) -> dict:
+        """Fresh UI span + its traceparent for the upstream hop."""
+        tracer = otel.get_tracer("playground")
+        with tracer.span(span_name):
+            return otel.inject_traceparent({})
+
+    # ----------------------------------------------------------------- pages
+
+    async def index(self, request: web.Request) -> web.FileResponse:
+        return web.FileResponse(os.path.join(STATIC_DIR, "index.html"))
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response({"message": "Service is up."})
+
+    async def config(self, request: web.Request) -> web.Response:
+        return web.json_response({"model_name": self.model_name,
+                                  "chain_url": self.chain_url})
+
+    # ----------------------------------------------------------------- proxy
+
+    async def generate(self, request: web.Request) -> web.StreamResponse:
+        body = await request.read()
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        await resp.prepare(request)
+        try:
+            async with self._session.post(
+                    f"{self.chain_url}/generate", data=body,
+                    headers={"Content-Type": "application/json",
+                             **self._headers("ui.generate")},
+                    timeout=aiohttp.ClientTimeout(total=600)) as upstream:
+                async for chunk in upstream.content.iter_any():
+                    await resp.write(chunk)
+        except Exception as exc:
+            logger.exception("generate proxy failed")
+            err = json.dumps({"id": "error", "choices": [{
+                "index": 0,
+                "message": {"role": "assistant",
+                            "content": f"chain server unreachable: {exc}"},
+                "finish_reason": "error"}]})
+            await resp.write(f"data: {err}\n\ndata: [DONE]\n\n".encode())
+        await resp.write_eof()
+        return resp
+
+    async def _forward_json(self, method: str, path: str, span: str,
+                            data: Optional[bytes] = None,
+                            params: Optional[dict] = None) -> web.Response:
+        headers = self._headers(span)
+        if data is not None:
+            headers["Content-Type"] = "application/json"
+        try:
+            async with self._session.request(
+                    method, f"{self.chain_url}{path}", data=data,
+                    params=params, headers=headers,
+                    timeout=aiohttp.ClientTimeout(total=300)) as upstream:
+                payload = await upstream.read()
+                return web.Response(body=payload, status=upstream.status,
+                                    content_type="application/json")
+        except Exception as exc:
+            logger.exception("%s %s proxy failed", method, path)
+            return web.json_response(
+                {"error": f"chain server unreachable: {exc}"}, status=502)
+
+    async def search(self, request: web.Request) -> web.Response:
+        return await self._forward_json("POST", "/search", "ui.search",
+                                        data=await request.read())
+
+    async def get_documents(self, request: web.Request) -> web.Response:
+        return await self._forward_json("GET", "/documents", "ui.documents")
+
+    async def upload_document(self, request: web.Request) -> web.Response:
+        # multipart passthrough: re-wrap the uploaded file for the chain API
+        reader = await request.multipart()
+        field = await reader.next()
+        while field is not None and field.name != "file":
+            field = await reader.next()
+        if field is None:
+            return web.json_response({"error": "field 'file' required"},
+                                     status=422)
+        payload = await field.read()
+        form = aiohttp.FormData()
+        form.add_field("file", payload,
+                       filename=field.filename or "upload.bin")
+        try:
+            async with self._session.post(
+                    f"{self.chain_url}/documents", data=form,
+                    headers=self._headers("ui.upload"),
+                    timeout=aiohttp.ClientTimeout(total=600)) as upstream:
+                body = await upstream.read()
+                return web.Response(body=body, status=upstream.status,
+                                    content_type="application/json")
+        except Exception as exc:
+            logger.exception("upload proxy failed")
+            return web.json_response(
+                {"error": f"chain server unreachable: {exc}"}, status=502)
+
+    async def delete_document(self, request: web.Request) -> web.Response:
+        return await self._forward_json(
+            "DELETE", "/documents", "ui.delete",
+            params={"filename": request.query.get("filename", "")})
+
+
+def run_playground(chain_url: str, model_name: str = "tpu-llm",
+                   host: str = "0.0.0.0", port: int = 8090) -> None:
+    server = PlaygroundServer(chain_url, model_name)
+    web.run_app(server.app, host=host, port=port, print=None)

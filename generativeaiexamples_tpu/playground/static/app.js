@@ -1,0 +1,194 @@
+/* RAG Playground client: SSE chat over fetch, KB management.
+   Mirrors the reference ChatClient behaviors (ref chat_client.py):
+   predict() streams /generate chunks, search() fetches context documents,
+   upload/list/delete manage the knowledge base. */
+
+const state = {
+  history: [],          // [{role, content}]
+  kb: false,
+  busy: false,
+};
+
+const $ = (id) => document.getElementById(id);
+
+// ---------------------------------------------------------------- tabs
+function showTab(name) {
+  $("page-converse").classList.toggle("hidden", name !== "converse");
+  $("page-kb").classList.toggle("hidden", name !== "kb");
+  $("tab-converse").classList.toggle("active", name === "converse");
+  $("tab-kb").classList.toggle("active", name === "kb");
+  if (name === "kb") refreshFiles();
+}
+$("tab-converse").onclick = () => showTab("converse");
+$("tab-kb").onclick = () => showTab("kb");
+
+// ------------------------------------------------------------- converse
+function addBubble(role, text) {
+  const div = document.createElement("div");
+  div.className = "bubble " + role;
+  div.textContent = text;
+  $("chat").appendChild(div);
+  $("chat").scrollTop = $("chat").scrollHeight;
+  return div;
+}
+
+function renderContext(chunks) {
+  const list = $("context-list");
+  list.innerHTML = "";
+  if (!chunks || !chunks.length) {
+    list.textContent = "No context retrieved.";
+    return;
+  }
+  for (const c of chunks) {
+    const d = document.createElement("div");
+    d.className = "ctx-chunk";
+    const head = document.createElement("div");
+    head.className = "ctx-head";
+    head.textContent = `${c.filename || "unknown"} (score ${(+c.score).toFixed(3)})`;
+    const body = document.createElement("div");
+    body.textContent = c.content;
+    d.appendChild(head);
+    d.appendChild(body);
+    list.appendChild(d);
+  }
+}
+
+async function streamGenerate(question) {
+  const payload = {
+    messages: [...state.history, { role: "user", content: question }],
+    use_knowledge_base: state.kb,
+    max_tokens: 1024,
+  };
+  const resp = await fetch("/api/generate", {
+    method: "POST",
+    headers: { "Content-Type": "application/json" },
+    body: JSON.stringify(payload),
+  });
+  const reader = resp.body.getReader();
+  const decoder = new TextDecoder();
+  const bubble = addBubble("assistant", "");
+  let buf = "", full = "";
+  for (;;) {
+    const { done, value } = await reader.read();
+    if (done) break;
+    buf += decoder.decode(value, { stream: true });
+    const frames = buf.split("\n\n");
+    buf = frames.pop();
+    for (const frame of frames) {
+      if (!frame.startsWith("data: ")) continue;
+      const data = frame.slice(6);
+      if (data === "[DONE]") continue;
+      try {
+        const chunk = JSON.parse(data);
+        const content = chunk.choices?.[0]?.message?.content || "";
+        if (content) {
+          full += content;
+          bubble.textContent = full;
+          $("chat").scrollTop = $("chat").scrollHeight;
+        }
+      } catch (e) { /* partial frame */ }
+    }
+  }
+  return full;
+}
+
+$("chat-form").onsubmit = async (ev) => {
+  ev.preventDefault();
+  const question = $("msg").value.trim();
+  if (!question || state.busy) return;
+  state.busy = true;
+  $("send").disabled = true;
+  $("msg").value = "";
+  addBubble("user", question);
+  try {
+    if (state.kb) {
+      fetch("/api/search", {
+        method: "POST",
+        headers: { "Content-Type": "application/json" },
+        body: JSON.stringify({ query: question, top_k: 4 }),
+      }).then((r) => r.json()).then((d) => renderContext(d.chunks)).catch(() => {});
+    }
+    const answer = await streamGenerate(question);
+    state.history.push({ role: "user", content: question });
+    state.history.push({ role: "assistant", content: answer });
+  } catch (e) {
+    addBubble("assistant", "Error: " + e);
+  } finally {
+    state.busy = false;
+    $("send").disabled = false;
+  }
+};
+
+$("use-kb").onchange = (ev) => { state.kb = ev.target.checked; };
+$("clear-history").onclick = () => {
+  state.history = [];
+  $("chat").innerHTML = "";
+};
+$("toggle-context").onclick = () => {
+  const panel = $("context-panel");
+  panel.classList.toggle("hidden");
+  $("toggle-context").textContent =
+    panel.classList.contains("hidden") ? "Show Context" : "Hide Context";
+};
+
+// ------------------------------------------------------------------- kb
+async function refreshFiles() {
+  const rows = $("file-rows");
+  try {
+    const resp = await fetch("/api/documents");
+    const data = await resp.json();
+    rows.innerHTML = "";
+    const docs = data.documents || [];
+    if (!docs.length) {
+      rows.innerHTML = "<tr><td colspan=2>No Files uploaded</td></tr>";
+      return;
+    }
+    for (const name of docs) {
+      const tr = document.createElement("tr");
+      const td = document.createElement("td");
+      td.textContent = name;
+      const act = document.createElement("td");
+      const btn = document.createElement("button");
+      btn.textContent = "Delete";
+      btn.onclick = async () => {
+        const r = await fetch(
+          "/api/documents?filename=" + encodeURIComponent(name),
+          { method: "DELETE" });
+        const d = await r.json();
+        $("kb-message").textContent =
+          d.deleted ? `Deleted ${name}` : `Could not delete ${name}`;
+        refreshFiles();
+      };
+      act.appendChild(btn);
+      tr.appendChild(td);
+      tr.appendChild(act);
+      rows.appendChild(tr);
+    }
+  } catch (e) {
+    rows.innerHTML = "<tr><td colspan=2>Error loading files</td></tr>";
+  }
+}
+
+$("upload-form").onsubmit = async (ev) => {
+  ev.preventDefault();
+  const files = $("file-input").files;
+  if (!files.length) return;
+  for (const file of files) {
+    const form = new FormData();
+    form.append("file", file, file.name);
+    try {
+      const resp = await fetch("/api/documents", { method: "POST", body: form });
+      const data = await resp.json();
+      $("kb-message").textContent = data.message || data.error || "";
+    } catch (e) {
+      $("kb-message").textContent = "Upload failed: " + e;
+    }
+  }
+  $("file-input").value = "";
+  refreshFiles();
+};
+
+// ----------------------------------------------------------------- init
+fetch("/api/config").then((r) => r.json()).then((cfg) => {
+  $("model-name").textContent = cfg.model_name || "";
+}).catch(() => {});
