@@ -7,11 +7,12 @@ advanced_rag/multimodal_rag/chains.py: ingest accepts only pdf/pptx/png
 multimodal_invoke:48); retrieval then augments the prompt with the text
 and image descriptions (chains.py rag_chain)).
 
-The VLM is a seam: `ImageDescriber`. The default deterministic describer
-captions from image structure (Pillow stats) so the pipeline is fully
-self-contained; when the vision tower (encoders/vision.py) or a remote
-VLM endpoint (APP_VLM_SERVER_URL) is available, richer captions plug in
-without touching the chain.
+The VLM is a seam: `ImageDescriber`. Three backends, picked by
+`get_describer`: a remote OpenAI-compatible VLM endpoint
+(APP_VLM_SERVER_URL), the in-tree CLIP vision tower's zero-shot captioner
+(encoders/vision.ClipCaptioner, when APP_VISION_CHECKPOINT_DIR supplies
+real weights or APP_VISION_CAPTIONER=clip), and a deterministic
+structural-stats stub so the pipeline is fully self-contained.
 """
 
 from __future__ import annotations
@@ -72,11 +73,27 @@ def remote_vlm_describer(base_url: str, model: str) -> ImageDescriber:
     return describe
 
 
+def clip_describer() -> ImageDescriber:
+    """Caption with the in-tree CLIP tower (encoders/vision.ClipCaptioner):
+    zero-shot caption-bank scoring in the joint space + structural stats."""
+    from generativeaiexamples_tpu.encoders.vision import ClipCaptioner
+
+    captioner = ClipCaptioner()
+    return captioner.describe
+
+
 def get_describer() -> ImageDescriber:
+    """Priority: served VLM endpoint > in-tree CLIP tower (when a real
+    checkpoint is configured, or explicitly requested) > structural stub.
+    A random-weight CLIP would caption noise, so the tower is only the
+    default once APP_VISION_CHECKPOINT_DIR points at real weights."""
     url = os.environ.get("APP_VLM_SERVER_URL", "")
     if url:
         model = os.environ.get("APP_VLM_MODEL_NAME", "vlm")
         return remote_vlm_describer(url, model)
+    if (os.environ.get("APP_VISION_CHECKPOINT_DIR")
+            or os.environ.get("APP_VISION_CAPTIONER") == "clip"):
+        return clip_describer()
     return stub_describer
 
 

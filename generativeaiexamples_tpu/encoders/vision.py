@@ -1,0 +1,250 @@
+"""Vision encoder service: image embeddings, zero-shot captioning, and
+text↔image search on the joint CLIP space.
+
+The in-tree counterpart of the reference's hosted vision stack (ref:
+vision_workflows/README.md — NVCLIP multimodal search, NV-DINOv2 few-shot;
+RAG/examples/advanced_rag/multimodal_rag's served VLM). Components:
+
+  * :class:`ImageEmbedder` — jitted, batch-bucketed CLIP towers. Loads a
+    HuggingFace `CLIPModel` checkpoint from ``APP_VISION_CHECKPOINT_DIR``
+    (torch CPU → `models.clip.params_from_hf`); random init serves tests,
+    mirroring encoders/embedder.py.
+  * :class:`ClipCaptioner` — zero-shot captioning: candidate captions are
+    scored by the text tower against the image embedding and the best
+    (above a margin) is combined with structural image stats. A real vision
+    model behind chains.multimodal's `ImageDescriber` seam.
+  * :class:`MultimodalIndex` — image vectors in the device-resident
+    retrieval store, queried by text through the joint space (the NVCLIP
+    multimodal-search workflow shape).
+
+Preprocessing (resize to the tower's square input + CLIP mean/std
+normalization) runs in numpy/PIL on the host — decode is IO, the towers are
+the TPU work.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import os
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from generativeaiexamples_tpu.models import clip
+from generativeaiexamples_tpu.retrieval.store import Document, VectorStore
+
+logger = logging.getLogger(__name__)
+
+# CLIP pixel normalization constants (openai/clip-vit family)
+_MEAN = np.array([0.48145466, 0.4578275, 0.40821073], np.float32)
+_STD = np.array([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+_DEFAULT_CAPTIONS = (
+    "a photo", "a chart or graph", "a diagram", "a table of data",
+    "a screenshot of a document", "a logo", "a map", "a drawing",
+    "a photo of people", "a photo of a landscape", "a photo of an object",
+    "text on a plain background",
+)
+
+
+def _decode_image(image_bytes: bytes, size: int) -> Optional[np.ndarray]:
+    """bytes → (size, size, 3) float32 in [0,1], or None if undecodable."""
+    try:
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(image_bytes)).convert("RGB")
+        img = img.resize((size, size), Image.BICUBIC)
+        return np.asarray(img, np.float32) / 255.0
+    except Exception:
+        return None
+
+
+def _bucket(n: int) -> int:
+    """Smallest power of two >= n (one XLA compile per bucket, not per N)."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+class ImageEmbedder:
+    """Batched CLIP towers with jit-per-bucket compilation."""
+
+    def __init__(self, cfg: Optional[clip.ClipConfig] = None,
+                 params: Optional[clip.Params] = None,
+                 checkpoint_dir: str = "") -> None:
+        checkpoint_dir = checkpoint_dir or os.environ.get(
+            "APP_VISION_CHECKPOINT_DIR", "")
+        self._hf_tokenizer = None
+        if params is None and checkpoint_dir:
+            cfg, params, self._hf_tokenizer = _load_hf_checkpoint(
+                checkpoint_dir, cfg)
+        self.cfg = cfg or clip.ClipConfig.vit_b32()
+        if params is None:
+            logger.warning("no vision checkpoint — using RANDOM weights "
+                           "(set APP_VISION_CHECKPOINT_DIR for real ones)")
+            params = clip.init_params(jax.random.PRNGKey(17), self.cfg)
+        self.params = params
+        self._img_fn = jax.jit(partial(clip.encode_image, cfg=self.cfg))
+        self._txt_fn = jax.jit(partial(clip.encode_text, cfg=self.cfg))
+
+    @property
+    def dim(self) -> int:
+        return self.cfg.projection_dim
+
+    # ------------------------------------------------------------- images
+
+    def embed_images(self, images: Sequence[bytes]) -> np.ndarray:
+        """L2-normalized joint-space vectors (N, dim); undecodable images
+        embed to zero vectors (never retrieved)."""
+        size = self.cfg.image_size
+        pixels, ok = [], []
+        for b in images:
+            arr = _decode_image(b, size)
+            ok.append(arr is not None)
+            pixels.append(arr if arr is not None
+                          else np.zeros((size, size, 3), np.float32))
+        n = len(pixels)
+        pad = _bucket(n) - n
+        pixels += [pixels[0] * 0] * pad
+        batch = (np.stack(pixels) - _MEAN) / _STD
+        emb = np.array(self._img_fn(self.params,
+                                    pixels=jnp.asarray(batch)))[:n]
+        emb /= np.maximum(np.linalg.norm(emb, axis=-1, keepdims=True), 1e-9)
+        emb[~np.asarray(ok)] = 0.0
+        return emb
+
+    # -------------------------------------------------------------- texts
+
+    def _tokenize(self, texts: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
+        """Token ids + eos positions for the text tower.
+
+        With a HF checkpoint, the checkpoint's own BPE tokenizer is used
+        (trained weights are meaningless on any other vocabulary). The
+        byte-level fallback serves random-weight (test) towers only, where
+        the requirement is merely deterministic, consistent ids.
+        """
+        S = self.cfg.max_text_len
+        toks = np.zeros((len(texts), S), np.int32)
+        eos = np.zeros((len(texts),), np.int32)
+        if self._hf_tokenizer is not None:
+            enc = self._hf_tokenizer(list(texts), padding="max_length",
+                                     truncation=True, max_length=S)
+            ids = np.asarray(enc["input_ids"], np.int32)
+            toks[:, :ids.shape[1]] = ids
+            eos_id = self._hf_tokenizer.eos_token_id
+            for i in range(len(texts)):
+                hits = np.nonzero(ids[i] == eos_id)[0]
+                eos[i] = int(hits[0]) if hits.size else ids.shape[1] - 1
+            return toks, eos
+        for i, text in enumerate(texts):
+            ids = list(text.encode("utf-8"))[: S - 2]
+            row = [self.cfg.vocab_size - 2] + \
+                [b % (self.cfg.vocab_size - 4) for b in ids] + \
+                [self.cfg.vocab_size - 1]
+            toks[i, :len(row)] = row
+            eos[i] = len(row) - 1
+        return toks, eos
+
+    def embed_texts(self, texts: Sequence[str]) -> np.ndarray:
+        toks, eos = self._tokenize(texts)
+        n = len(texts)
+        pad = _bucket(n) - n
+        if pad:
+            toks = np.concatenate([toks, np.zeros((pad, toks.shape[1]),
+                                                  np.int32)])
+            eos = np.concatenate([eos, np.zeros((pad,), np.int32)])
+        emb = np.asarray(self._txt_fn(self.params, tokens=jnp.asarray(toks),
+                                      eos_positions=jnp.asarray(eos)))[:n]
+        return emb / np.maximum(np.linalg.norm(emb, axis=-1, keepdims=True),
+                                1e-9)
+
+
+def _load_hf_checkpoint(path: str, cfg: Optional[clip.ClipConfig]):
+    """Load a local HF CLIP checkpoint directory (torch CPU) + its BPE."""
+    from transformers import AutoTokenizer, CLIPConfig as HFClipConfig, CLIPModel
+
+    hf_cfg = HFClipConfig.from_pretrained(path)
+    cfg = cfg or clip.ClipConfig(
+        image_size=hf_cfg.vision_config.image_size,
+        patch_size=hf_cfg.vision_config.patch_size,
+        vision_dim=hf_cfg.vision_config.hidden_size,
+        vision_layers=hf_cfg.vision_config.num_hidden_layers,
+        vision_heads=hf_cfg.vision_config.num_attention_heads,
+        vocab_size=hf_cfg.text_config.vocab_size,
+        max_text_len=hf_cfg.text_config.max_position_embeddings,
+        text_dim=hf_cfg.text_config.hidden_size,
+        text_layers=hf_cfg.text_config.num_hidden_layers,
+        text_heads=hf_cfg.text_config.num_attention_heads,
+        projection_dim=hf_cfg.projection_dim)
+    model = CLIPModel.from_pretrained(path)
+    try:
+        tokenizer = AutoTokenizer.from_pretrained(path)
+    except Exception:
+        logger.warning("checkpoint %s has no tokenizer files — text-tower "
+                       "queries will use the byte fallback and be "
+                       "semantically meaningless on trained weights", path)
+        tokenizer = None
+    return cfg, clip.params_from_hf(model.state_dict(), cfg), tokenizer
+
+
+class ClipCaptioner:
+    """Zero-shot image captioning via joint-space scoring.
+
+    Candidate captions (a configurable bank) are ranked against the image
+    embedding; the winner is merged with structural stats (dimensions,
+    source) into the caption the multimodal chain embeds. This is the
+    in-tree `ImageDescriber` backed by an actual vision model —
+    the reference defers to a served VLM (ref multimodal_rag
+    llm/llm_client.py:48 multimodal_invoke).
+    """
+
+    def __init__(self, embedder: Optional[ImageEmbedder] = None,
+                 captions: Sequence[str] = _DEFAULT_CAPTIONS) -> None:
+        self.embedder = embedder or ImageEmbedder()
+        self.captions = list(captions)
+        self._caption_emb = self.embedder.embed_texts(self.captions)
+
+    def describe(self, image_bytes: bytes, metadata: Dict[str, str]) -> str:
+        from generativeaiexamples_tpu.chains.multimodal_parsers import (
+            image_summary)
+
+        emb = self.embedder.embed_images([image_bytes])[0]
+        stats = image_summary(image_bytes) or "undecodable image"
+        src = metadata.get("source", "unknown")
+        if not emb.any():
+            return f"Image from {src}: {stats}"
+        scores = self._caption_emb @ emb
+        best = int(np.argmax(scores))
+        return (f"Image from {src}: {self.captions[best]} "
+                f"(clip score {float(scores[best]):.3f}); {stats}")
+
+
+class MultimodalIndex:
+    """Text→image search over the joint space (NVCLIP-workflow shape):
+    images land in the device-resident VectorStore as joint-space vectors;
+    queries embed through the text tower."""
+
+    def __init__(self, embedder: Optional[ImageEmbedder] = None) -> None:
+        self.embedder = embedder or ImageEmbedder()
+        self.store = VectorStore(dim=self.embedder.dim)
+
+    def add_images(self, images: Sequence[bytes],
+                   metadatas: Sequence[Dict[str, str]]) -> int:
+        emb = self.embedder.embed_images(images)
+        keep = [i for i in range(len(images)) if emb[i].any()]
+        docs = [Document(content=str(metadatas[i].get("caption", "")),
+                         metadata=dict(metadatas[i])) for i in keep]
+        if docs:
+            self.store.add(docs, emb[keep])
+        return len(docs)
+
+    def search(self, query: str, top_k: int = 4,
+               score_threshold: float = 0.0) -> List[Tuple[Document, float]]:
+        qvec = self.embedder.embed_texts([query])[0]
+        return self.store.search(qvec, top_k=top_k,
+                                 score_threshold=score_threshold)

@@ -146,8 +146,11 @@ class Scheduler:
                 # Driver still mid-step (e.g. a long XLA compile): touching
                 # job state concurrently would corrupt bookkeeping — leave
                 # cleanup to the driver, which checks _running after the step.
+                # The fetcher still gets released (it tolerates a racing
+                # submit by raising into the driver's guarded loop).
                 logger.warning("driver thread still busy at stop(); "
                                "skipping forced cleanup")
+                self._fetcher.shutdown(wait=False)
                 return
         # only after the driver has exited: a mid-tick dispatch must not see
         # a shut-down executor

@@ -1,0 +1,332 @@
+"""CLIP-class dual-tower vision/text encoder — functional JAX, TPU-first.
+
+The in-tree counterpart of the reference's hosted vision encoders (ref:
+vision_workflows/README.md — "NVCLIP Multimodal Search" and "NV-DINOv2"
+workflows run NIM containers; RAG/examples/advanced_rag/multimodal_rag uses a
+served VLM). One joint-embedding model covers both roles: the vision tower is
+a ViT usable alone (DINOv2-style image features), and with the text tower it
+does zero-shot scoring and text↔image retrieval.
+
+Design mirrors models/llama.py:
+  * per-layer tensors stacked on a leading layer axis, block applied with
+    `lax.scan` — one compiled block per tower regardless of depth;
+  * logical-axis annotations per leaf (`logical_axes`) so parallel.sharding
+    rule tables place the towers on a mesh without the model naming axes;
+  * patch embedding as an unfold+matmul (XLA fuses it into one big GEMM on
+    the MXU — no conv primitive needed at stride == kernel);
+  * QuickGELU and pre-LayerNorm per the original CLIP architecture, so
+    `params_from_hf` maps a HuggingFace `CLIPModel.state_dict()` for real
+    checkpoints (openai/clip-vit-* family); random init serves tests.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class ClipConfig:
+    # vision tower
+    image_size: int = 224
+    patch_size: int = 32
+    vision_dim: int = 768
+    vision_layers: int = 12
+    vision_heads: int = 12
+    # text tower
+    vocab_size: int = 49408
+    max_text_len: int = 77
+    text_dim: int = 512
+    text_layers: int = 12
+    text_heads: int = 8
+    # joint space
+    projection_dim: int = 512
+    norm_eps: float = 1e-5
+    dtype: str = "float32"
+
+    @staticmethod
+    def vit_b32() -> "ClipConfig":
+        return ClipConfig()
+
+    @staticmethod
+    def tiny() -> "ClipConfig":
+        """Deterministic test-scale config (SURVEY §4 fake-backend style)."""
+        return ClipConfig(image_size=32, patch_size=8, vision_dim=32,
+                          vision_layers=2, vision_heads=2, vocab_size=300,
+                          max_text_len=16, text_dim=32, text_layers=2,
+                          text_heads=2, projection_dim=16)
+
+    @property
+    def jdtype(self) -> jnp.dtype:
+        return jnp.dtype(self.dtype)
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+def _tower_init(rng, L: int, D: int, dt) -> Params:
+    keys = jax.random.split(rng, 6)
+
+    def normal(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                / math.sqrt(fan_in)).astype(dt)
+
+    return {
+        "ln1_w": jnp.ones((L, D), dt), "ln1_b": jnp.zeros((L, D), dt),
+        "wqkv": normal(keys[0], (L, D, 3 * D), D),
+        "bqkv": jnp.zeros((L, 3 * D), dt),
+        "wo": normal(keys[1], (L, D, D), D), "bo": jnp.zeros((L, D), dt),
+        "ln2_w": jnp.ones((L, D), dt), "ln2_b": jnp.zeros((L, D), dt),
+        "w_up": normal(keys[2], (L, D, 4 * D), D),
+        "b_up": jnp.zeros((L, 4 * D), dt),
+        "w_down": normal(keys[3], (L, 4 * D, D), 4 * D),
+        "b_down": jnp.zeros((L, D), dt),
+    }
+
+
+def init_params(rng: jax.Array, cfg: ClipConfig) -> Params:
+    dt = cfg.jdtype
+    kv, kt, k1, k2, k3, k4, k5 = jax.random.split(rng, 7)
+    patch_in = 3 * cfg.patch_size ** 2
+
+    def normal(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                / math.sqrt(fan_in)).astype(dt)
+
+    return {
+        "vision": {
+            "patch_embed": normal(k1, (patch_in, cfg.vision_dim), patch_in),
+            "class_embed": normal(k2, (cfg.vision_dim,), cfg.vision_dim),
+            "pos_embed": normal(k3, (cfg.n_patches + 1, cfg.vision_dim),
+                                cfg.vision_dim),
+            "pre_ln_w": jnp.ones((cfg.vision_dim,), dt),
+            "pre_ln_b": jnp.zeros((cfg.vision_dim,), dt),
+            "layers": _tower_init(kv, cfg.vision_layers, cfg.vision_dim, dt),
+            "post_ln_w": jnp.ones((cfg.vision_dim,), dt),
+            "post_ln_b": jnp.zeros((cfg.vision_dim,), dt),
+            "proj": normal(k4, (cfg.vision_dim, cfg.projection_dim),
+                           cfg.vision_dim),
+        },
+        "text": {
+            "tok_embed": normal(k5, (cfg.vocab_size, cfg.text_dim),
+                                cfg.text_dim),
+            "pos_embed": normal(k3, (cfg.max_text_len, cfg.text_dim),
+                                cfg.text_dim),
+            "layers": _tower_init(kt, cfg.text_layers, cfg.text_dim, dt),
+            "final_ln_w": jnp.ones((cfg.text_dim,), dt),
+            "final_ln_b": jnp.zeros((cfg.text_dim,), dt),
+            "proj": normal(k2, (cfg.text_dim, cfg.projection_dim),
+                           cfg.text_dim),
+        },
+        "logit_scale": jnp.asarray(math.log(1 / 0.07), dt),
+    }
+
+
+def logical_axes(cfg: ClipConfig) -> Params:
+    def tower(_):
+        return {
+            "ln1_w": (None, "embed"), "ln1_b": (None, "embed"),
+            "wqkv": (None, "embed", "heads"), "bqkv": (None, "heads"),
+            "wo": (None, "heads", "embed"), "bo": (None, "embed"),
+            "ln2_w": (None, "embed"), "ln2_b": (None, "embed"),
+            "w_up": (None, "embed", "mlp"), "b_up": (None, "mlp"),
+            "w_down": (None, "mlp", "embed"), "b_down": (None, "embed"),
+        }
+    return {
+        "vision": {
+            "patch_embed": (None, "embed"),
+            "class_embed": ("embed",),
+            "pos_embed": (None, "embed"),
+            "pre_ln_w": ("embed",), "pre_ln_b": ("embed",),
+            "layers": tower(None),
+            "post_ln_w": ("embed",), "post_ln_b": ("embed",),
+            "proj": ("embed", None),
+        },
+        "text": {
+            "tok_embed": ("vocab_table", "embed_table"),
+            "pos_embed": (None, "embed"),
+            "layers": tower(None),
+            "final_ln_w": ("embed",), "final_ln_b": ("embed",),
+            "proj": ("embed", None),
+        },
+        "logit_scale": (),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _layer_norm(x, w, b, eps):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * w + b
+
+
+def _quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def _encoder(cfg: ClipConfig, h: jnp.ndarray, tower: Params, n_heads: int,
+             causal: bool) -> jnp.ndarray:
+    """Pre-LN transformer encoder over stacked layers via lax.scan."""
+    B, S, D = h.shape
+    HD = D // n_heads
+    mask = (jnp.tril(jnp.ones((S, S), bool)) if causal else None)
+
+    def block(h, layer):
+        x = _layer_norm(h, layer["ln1_w"], layer["ln1_b"], cfg.norm_eps)
+        qkv = x @ layer["wqkv"] + layer["bqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, n_heads, HD).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, n_heads, HD).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, n_heads, HD).transpose(0, 2, 1, 3)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(HD)
+        if mask is not None:
+            s = jnp.where(mask[None, None], s, -1e30)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, D)
+        h = h + ctx @ layer["wo"] + layer["bo"]
+        x = _layer_norm(h, layer["ln2_w"], layer["ln2_b"], cfg.norm_eps)
+        h = h + _quick_gelu(x @ layer["w_up"] + layer["b_up"]) @ layer["w_down"] + layer["b_down"]
+        return h, None
+
+    h, _ = jax.lax.scan(block, h, tower["layers"])
+    return h
+
+
+def encode_image(params: Params, cfg: ClipConfig,
+                 pixels: jnp.ndarray) -> jnp.ndarray:
+    """pixels (B, H, W, 3) normalized → joint-space embeddings (B, P).
+
+    Patch embedding is unfold+matmul: (B, H/p, p, W/p, p, 3) → a (B, N,
+    3p²)·(3p², D) GEMM — stride==kernel convolution expressed MXU-natively.
+    """
+    v = params["vision"]
+    B = pixels.shape[0]
+    p = cfg.patch_size
+    g = cfg.image_size // p
+    x = pixels.reshape(B, g, p, g, p, 3).transpose(0, 1, 3, 2, 4, 5)
+    x = x.reshape(B, g * g, p * p * 3)
+    h = x.astype(cfg.jdtype) @ v["patch_embed"]
+    cls = jnp.broadcast_to(v["class_embed"], (B, 1, cfg.vision_dim))
+    h = jnp.concatenate([cls, h], axis=1) + v["pos_embed"][None]
+    h = _layer_norm(h, v["pre_ln_w"], v["pre_ln_b"], cfg.norm_eps)
+    h = _encoder(cfg, h, v, cfg.vision_heads, causal=False)
+    pooled = _layer_norm(h[:, 0], v["post_ln_w"], v["post_ln_b"],
+                         cfg.norm_eps)
+    return pooled @ v["proj"]
+
+
+def encode_text(params: Params, cfg: ClipConfig, tokens: jnp.ndarray,
+                eos_positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """tokens (B, S) right-padded → joint-space embeddings (B, P).
+
+    The pooled feature is the hidden state at the sequence's EOS position
+    (HF CLIPTextModel semantics); ``eos_positions`` defaults to the last
+    position of each row.
+    """
+    t = params["text"]
+    B, S = tokens.shape
+    if eos_positions is None:
+        eos_positions = jnp.full((B,), S - 1, jnp.int32)
+    h = t["tok_embed"].astype(cfg.jdtype)[tokens] + t["pos_embed"][None, :S]
+    h = _encoder(cfg, h, t, cfg.text_heads, causal=True)
+    h = _layer_norm(h, t["final_ln_w"], t["final_ln_b"], cfg.norm_eps)
+    pooled = jnp.take_along_axis(
+        h, eos_positions[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    return pooled @ t["proj"]
+
+
+def similarity(params: Params, image_emb: jnp.ndarray,
+               text_emb: jnp.ndarray) -> jnp.ndarray:
+    """Temperature-scaled cosine logits (n_img, n_text)."""
+    img = image_emb / jnp.linalg.norm(image_emb, axis=-1, keepdims=True)
+    txt = text_emb / jnp.linalg.norm(text_emb, axis=-1, keepdims=True)
+    return jnp.exp(params["logit_scale"]) * img @ txt.T
+
+
+# ---------------------------------------------------------------------------
+# HuggingFace weight import (CLIPModel.state_dict())
+# ---------------------------------------------------------------------------
+
+def params_from_hf(state_dict: Dict[str, Any], cfg: ClipConfig) -> Params:
+    """Map a HF `CLIPModel.state_dict()` (torch tensors or ndarrays) into
+    this layout. Linear weights transpose (torch keeps (out, in)); per-layer
+    q/k/v projections concatenate into the stacked wqkv."""
+    import numpy as np
+
+    def t(name):
+        w = state_dict[name]
+        arr = w.detach().cpu().numpy() if hasattr(w, "detach") else np.asarray(w)
+        return jnp.asarray(arr, cfg.jdtype)
+
+    def lin(name):
+        return t(name).T
+
+    def tower(prefix: str, n_layers: int) -> Params:
+        acc = {k: [] for k in ("ln1_w", "ln1_b", "wqkv", "bqkv", "wo", "bo",
+                               "ln2_w", "ln2_b", "w_up", "b_up", "w_down",
+                               "b_down")}
+        for i in range(n_layers):
+            p = f"{prefix}.encoder.layers.{i}."
+            acc["ln1_w"].append(t(p + "layer_norm1.weight"))
+            acc["ln1_b"].append(t(p + "layer_norm1.bias"))
+            acc["wqkv"].append(jnp.concatenate([
+                lin(p + "self_attn.q_proj.weight"),
+                lin(p + "self_attn.k_proj.weight"),
+                lin(p + "self_attn.v_proj.weight")], axis=1))
+            acc["bqkv"].append(jnp.concatenate([
+                t(p + "self_attn.q_proj.bias"),
+                t(p + "self_attn.k_proj.bias"),
+                t(p + "self_attn.v_proj.bias")]))
+            acc["wo"].append(lin(p + "self_attn.out_proj.weight"))
+            acc["bo"].append(t(p + "self_attn.out_proj.bias"))
+            acc["ln2_w"].append(t(p + "layer_norm2.weight"))
+            acc["ln2_b"].append(t(p + "layer_norm2.bias"))
+            acc["w_up"].append(lin(p + "mlp.fc1.weight"))
+            acc["b_up"].append(t(p + "mlp.fc1.bias"))
+            acc["w_down"].append(lin(p + "mlp.fc2.weight"))
+            acc["b_down"].append(t(p + "mlp.fc2.bias"))
+        return {k: jnp.stack(v) for k, v in acc.items()}
+
+    # HF conv patch embed: (D, 3, p, p) → unfold layout (p*p*3, D) matching
+    # encode_image's (row-major patch pixels, channel minor) flattening
+    conv = state_dict["vision_model.embeddings.patch_embedding.weight"]
+    conv = conv.detach().cpu().numpy() if hasattr(conv, "detach") else conv
+    patch = jnp.asarray(conv, cfg.jdtype).transpose(2, 3, 1, 0).reshape(
+        cfg.patch_size * cfg.patch_size * 3, cfg.vision_dim)
+
+    return {
+        "vision": {
+            "patch_embed": patch,
+            "class_embed": t("vision_model.embeddings.class_embedding"),
+            "pos_embed": t("vision_model.embeddings.position_embedding.weight"),
+            "pre_ln_w": t("vision_model.pre_layrnorm.weight"),
+            "pre_ln_b": t("vision_model.pre_layrnorm.bias"),
+            "layers": tower("vision_model", cfg.vision_layers),
+            "post_ln_w": t("vision_model.post_layernorm.weight"),
+            "post_ln_b": t("vision_model.post_layernorm.bias"),
+            "proj": lin("visual_projection.weight"),
+        },
+        "text": {
+            "tok_embed": t("text_model.embeddings.token_embedding.weight"),
+            "pos_embed": t("text_model.embeddings.position_embedding.weight"),
+            "layers": tower("text_model", cfg.text_layers),
+            "final_ln_w": t("text_model.final_layer_norm.weight"),
+            "final_ln_b": t("text_model.final_layer_norm.bias"),
+            "proj": lin("text_projection.weight"),
+        },
+        "logit_scale": t("logit_scale"),
+    }

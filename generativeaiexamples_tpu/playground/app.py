@@ -58,11 +58,10 @@ class PlaygroundServer:
         yield
         await self._session.close()
 
-    def _headers(self, span_name: str) -> dict:
-        """Fresh UI span + its traceparent for the upstream hop."""
-        tracer = otel.get_tracer("playground")
-        with tracer.span(span_name):
-            return otel.inject_traceparent({})
+    def _span(self, span_name: str):
+        """UI span wrapping the whole upstream call (its traceparent rides
+        via `otel.inject_traceparent` while the span is current)."""
+        return otel.get_tracer("playground").span(span_name)
 
     # ----------------------------------------------------------------- pages
 
@@ -78,6 +77,14 @@ class PlaygroundServer:
 
     # ----------------------------------------------------------------- proxy
 
+    @staticmethod
+    def _error_frames(message: str) -> bytes:
+        err = json.dumps({"id": "error", "choices": [{
+            "index": 0,
+            "message": {"role": "assistant", "content": message},
+            "finish_reason": "error"}]})
+        return f"data: {err}\n\ndata: [DONE]\n\n".encode()
+
     async def generate(self, request: web.Request) -> web.StreamResponse:
         body = await request.read()
         resp = web.StreamResponse(headers={
@@ -86,38 +93,46 @@ class PlaygroundServer:
         })
         await resp.prepare(request)
         try:
-            async with self._session.post(
-                    f"{self.chain_url}/generate", data=body,
-                    headers={"Content-Type": "application/json",
-                             **self._headers("ui.generate")},
-                    timeout=aiohttp.ClientTimeout(total=600)) as upstream:
-                async for chunk in upstream.content.iter_any():
-                    await resp.write(chunk)
+            with self._span("ui.generate"):
+                async with self._session.post(
+                        f"{self.chain_url}/generate", data=body,
+                        headers={"Content-Type": "application/json",
+                                 **otel.inject_traceparent({})},
+                        timeout=aiohttp.ClientTimeout(total=600)) as upstream:
+                    if upstream.status != 200:
+                        # surface errors as frames the UI understands — a
+                        # bare non-SSE body would render as a silent empty
+                        # assistant turn
+                        detail = (await upstream.read()).decode(
+                            "utf-8", "replace")[:500]
+                        await resp.write(self._error_frames(
+                            f"chain server error {upstream.status}: "
+                            f"{detail}"))
+                    else:
+                        async for chunk in upstream.content.iter_any():
+                            await resp.write(chunk)
         except Exception as exc:
             logger.exception("generate proxy failed")
-            err = json.dumps({"id": "error", "choices": [{
-                "index": 0,
-                "message": {"role": "assistant",
-                            "content": f"chain server unreachable: {exc}"},
-                "finish_reason": "error"}]})
-            await resp.write(f"data: {err}\n\ndata: [DONE]\n\n".encode())
+            await resp.write(self._error_frames(
+                f"chain server unreachable: {exc}"))
         await resp.write_eof()
         return resp
 
     async def _forward_json(self, method: str, path: str, span: str,
                             data: Optional[bytes] = None,
                             params: Optional[dict] = None) -> web.Response:
-        headers = self._headers(span)
-        if data is not None:
-            headers["Content-Type"] = "application/json"
         try:
-            async with self._session.request(
-                    method, f"{self.chain_url}{path}", data=data,
-                    params=params, headers=headers,
-                    timeout=aiohttp.ClientTimeout(total=300)) as upstream:
-                payload = await upstream.read()
-                return web.Response(body=payload, status=upstream.status,
-                                    content_type="application/json")
+            with self._span(span):
+                headers = otel.inject_traceparent({})
+                if data is not None:
+                    headers["Content-Type"] = "application/json"
+                async with self._session.request(
+                        method, f"{self.chain_url}{path}", data=data,
+                        params=params, headers=headers,
+                        timeout=aiohttp.ClientTimeout(total=300)) as upstream:
+                    payload = await upstream.read()
+                    return web.Response(body=payload, status=upstream.status,
+                                        content_type="application/json")
         except Exception as exc:
             logger.exception("%s %s proxy failed", method, path)
             return web.json_response(
@@ -144,13 +159,14 @@ class PlaygroundServer:
         form.add_field("file", payload,
                        filename=field.filename or "upload.bin")
         try:
-            async with self._session.post(
-                    f"{self.chain_url}/documents", data=form,
-                    headers=self._headers("ui.upload"),
-                    timeout=aiohttp.ClientTimeout(total=600)) as upstream:
-                body = await upstream.read()
-                return web.Response(body=body, status=upstream.status,
-                                    content_type="application/json")
+            with self._span("ui.upload"):
+                async with self._session.post(
+                        f"{self.chain_url}/documents", data=form,
+                        headers=otel.inject_traceparent({}),
+                        timeout=aiohttp.ClientTimeout(total=600)) as upstream:
+                    body = await upstream.read()
+                    return web.Response(body=body, status=upstream.status,
+                                        content_type="application/json")
         except Exception as exc:
             logger.exception("upload proxy failed")
             return web.json_response(

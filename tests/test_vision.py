@@ -1,0 +1,147 @@
+"""Vision tower tests: CLIP forward shapes, HF CLIPModel numerical parity,
+zero-shot captioner determinism, and text→image search through the joint
+space (VERDICT round-1 item #6)."""
+
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.encoders.vision import (
+    ClipCaptioner, ImageEmbedder, MultimodalIndex)
+from generativeaiexamples_tpu.models import clip
+
+
+def _png_bytes(color, size=(40, 30)):
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.new("RGB", size, color).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+@pytest.fixture(scope="module")
+def tiny_embedder():
+    cfg = clip.ClipConfig.tiny()
+    params = clip.init_params(jax.random.PRNGKey(3), cfg)
+    return ImageEmbedder(cfg=cfg, params=params)
+
+
+def test_clip_forward_shapes():
+    cfg = clip.ClipConfig.tiny()
+    params = clip.init_params(jax.random.PRNGKey(0), cfg)
+    pixels = jnp.zeros((2, cfg.image_size, cfg.image_size, 3))
+    img = clip.encode_image(params, cfg, pixels)
+    assert img.shape == (2, cfg.projection_dim)
+    toks = jnp.ones((3, 8), jnp.int32)
+    txt = clip.encode_text(params, cfg, toks)
+    assert txt.shape == (3, cfg.projection_dim)
+    logits = clip.similarity(params, img, txt)
+    assert logits.shape == (2, 3)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_clip_matches_hf_reference():
+    """Numerical parity with transformers CLIPModel via params_from_hf."""
+    torch = pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+    from transformers import (
+        CLIPConfig as HFConfig, CLIPModel, CLIPTextConfig, CLIPVisionConfig)
+
+    hf_cfg = HFConfig.from_text_vision_configs(
+        CLIPTextConfig(vocab_size=96, hidden_size=32, intermediate_size=128,
+                       num_hidden_layers=2, num_attention_heads=2,
+                       max_position_embeddings=16, hidden_act="quick_gelu",
+                       # eos_token_id=2 selects HF's argmax(input_ids)
+                       # pooling, mirrored below with eos_positions
+                       eos_token_id=2),
+        CLIPVisionConfig(hidden_size=32, intermediate_size=128,
+                         num_hidden_layers=2, num_attention_heads=2,
+                         image_size=32, patch_size=8,
+                         hidden_act="quick_gelu"),
+        projection_dim=24)
+    torch.manual_seed(0)
+    hf = CLIPModel(hf_cfg).eval()
+
+    cfg = clip.ClipConfig(image_size=32, patch_size=8, vision_dim=32,
+                          vision_layers=2, vision_heads=2, vocab_size=96,
+                          max_text_len=16, text_dim=32, text_layers=2,
+                          text_heads=2, projection_dim=24)
+    params = clip.params_from_hf(hf.state_dict(), cfg)
+
+    rng = np.random.default_rng(1)
+    pixels = rng.standard_normal((2, 32, 32, 3)).astype(np.float32)
+    with torch.no_grad():
+        hf_img = hf.get_image_features(
+            pixel_values=torch.tensor(pixels).permute(0, 3, 1, 2))
+    ours_img = clip.encode_image(params, cfg, jnp.asarray(pixels))
+    np.testing.assert_allclose(np.asarray(ours_img), hf_img.numpy(),
+                               atol=2e-4, rtol=2e-3)
+
+    toks = rng.integers(1, 96, (2, 12)).astype(np.int64)
+    with torch.no_grad():
+        hf_txt = hf.get_text_features(input_ids=torch.tensor(toks))
+    # HF pools at argmax(input_ids) for non-eos vocabularies; mirror it
+    eos = toks.argmax(axis=-1).astype(np.int32)
+    ours_txt = clip.encode_text(params, cfg, jnp.asarray(toks, jnp.int32),
+                                eos_positions=jnp.asarray(eos))
+    np.testing.assert_allclose(np.asarray(ours_txt), hf_txt.numpy(),
+                               atol=2e-4, rtol=2e-3)
+
+
+def test_image_embedder_normalizes_and_flags_undecodable(tiny_embedder):
+    good = _png_bytes((200, 30, 30))
+    bad = b"this is not an image"
+    emb = tiny_embedder.embed_images([good, bad])
+    assert emb.shape == (2, tiny_embedder.dim)
+    np.testing.assert_allclose(np.linalg.norm(emb[0]), 1.0, atol=1e-5)
+    assert not emb[1].any()
+
+
+def test_captioner_deterministic_and_stats(tiny_embedder):
+    cap = ClipCaptioner(embedder=tiny_embedder)
+    img = _png_bytes((10, 200, 10))
+    meta = {"source": "greens.png"}
+    c1 = cap.describe(img, meta)
+    c2 = cap.describe(img, meta)
+    assert c1 == c2
+    assert c1.startswith("Image from greens.png:")
+    assert "clip score" in c1
+    # undecodable input degrades to the structural stub text
+    assert "undecodable" in cap.describe(b"nope", {"source": "x"})
+
+
+def test_text_to_image_search(tiny_embedder):
+    idx = MultimodalIndex(embedder=tiny_embedder)
+    reds = [_png_bytes((220, 20, 20)), _png_bytes((180, 40, 40))]
+    blue = _png_bytes((20, 20, 220))
+    n = idx.add_images(reds + [blue, b"junk-not-an-image"],
+                       [{"caption": "red one"}, {"caption": "red two"},
+                        {"caption": "blue one"}, {"caption": "junk"}])
+    assert n == 3   # undecodable image skipped
+    hits = idx.search("anything", top_k=3)
+    assert len(hits) == 3
+    scores = [s for _, s in hits]
+    assert scores == sorted(scores, reverse=True)
+    # joint space is consistent: identical queries rank identically
+    again = idx.search("anything", top_k=3)
+    assert [d.metadata["caption"] for d, _ in hits] == \
+        [d.metadata["caption"] for d, _ in again]
+
+
+def test_multimodal_chain_uses_clip_describer(tiny_embedder, tmp_path):
+    """The ImageDescriber seam accepts the CLIP captioner end to end."""
+    from generativeaiexamples_tpu.chains.context import ChainContext
+    from generativeaiexamples_tpu.chains.multimodal import MultimodalRAG
+
+    png = tmp_path / "pic.png"
+    png.write_bytes(_png_bytes((5, 5, 250), size=(64, 48)))
+    cap = ClipCaptioner(embedder=tiny_embedder)
+    example = MultimodalRAG(describer=cap.describe)
+    example.ingest_docs(str(png), "pic.png")
+    docs = example.document_search("an image", num_docs=2)
+    assert docs
+    assert any("clip score" in d["content"] for d in docs)
+    assert example.delete_documents(["pic.png"])
