@@ -19,3 +19,7 @@ serve:
 playground:
 	$(TEST_ENV) python -m generativeaiexamples_tpu.playground \
 	  --chain-url http://localhost:8081 --port 8090
+
+# One-command stack: chain server + playground, health-gated (compose parity).
+up:
+	$(TEST_ENV) python -m generativeaiexamples_tpu.deploy up --tiny

@@ -36,13 +36,16 @@ class ChainContext:
 
     def store(self, collection: str = "default") -> VectorStore:
         """Named collections (ref: COLLECTION_NAME env per example,
-        docker-compose.yaml:24-27)."""
+        docker-compose.yaml:24-27). The backend is config-dispatched —
+        in-proc device-resident by default, Milvus/pgvector adapters for
+        deployments running those services (ref utils.py:220-332)."""
+        from generativeaiexamples_tpu.retrieval.adapters import make_store
+
         with self._lock:
             if collection not in self.stores:
-                vs = self.config.vector_store
-                self.stores[collection] = VectorStore(
-                    dim=self.embedder.dim, index_type=vs.index_type,
-                    nlist=vs.nlist, nprobe=vs.nprobe, name=collection)
+                self.stores[collection] = make_store(
+                    dim=self.embedder.dim, config=self.config.vector_store,
+                    name=collection)
             return self.stores[collection]
 
     def splitter(self) -> TokenTextSplitter:

@@ -1,0 +1,15 @@
+"""Deployment: one-command multi-service bring-up with health gating.
+
+The reference deploys as docker-compose stacks whose services gate on each
+other's health (ref: RAG/examples/basic_rag/langchain/docker-compose.yaml:
+59-64 `depends_on: condition: service_healthy`) and restart on failure.
+This package is the native equivalent for TPU hosts: a process supervisor
+(`supervisor.Supervisor`) that starts services in dependency order, admits
+each only after its /health endpoint answers, restarts crashed services
+with exponential backoff, and tears the stack down in reverse order —
+plus the stock stack definition (chain server → playground) behind
+``python -m generativeaiexamples_tpu.deploy up``.
+"""
+
+from generativeaiexamples_tpu.deploy.supervisor import (  # noqa: F401
+    ServiceSpec, Supervisor)

@@ -1,0 +1,81 @@
+"""CLI: bring up the full stack with one command (compose-parity dev loop).
+
+    python -m generativeaiexamples_tpu.deploy up [--tiny] \
+        [--chain-port 8081] [--ui-port 8090]
+
+Starts the chain server (in-proc TPU engine + encoders) and, once it
+reports healthy, the playground UI against it — the reference's
+`docker compose up` flow (ref basic_rag/langchain/docker-compose.yaml)
+without containers. Ctrl-C tears the stack down in reverse order. Crashed
+services restart with backoff (supervisor monitor)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import time
+
+from generativeaiexamples_tpu.deploy.supervisor import ServiceSpec, Supervisor
+
+
+def build_stack(tiny: bool, chain_port: int, ui_port: int):
+    py = sys.executable
+    chain_cmd = [py, "-m", "generativeaiexamples_tpu.server",
+                 "--port", str(chain_port)]
+    if tiny:
+        chain_cmd.append("--tiny")
+    return [
+        ServiceSpec(
+            name="chain-server",
+            command=chain_cmd,
+            health_url=f"http://127.0.0.1:{chain_port}/health",
+            startup_timeout_s=600.0,      # first TPU compile is slow
+        ),
+        ServiceSpec(
+            name="playground",
+            command=[py, "-m", "generativeaiexamples_tpu.playground",
+                     "--chain-url", f"http://127.0.0.1:{chain_port}",
+                     "--port", str(ui_port)],
+            health_url=f"http://127.0.0.1:{ui_port}/health",
+            depends_on=["chain-server"],
+        ),
+    ]
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("action", choices=["up"],
+                        help="bring the stack up (runs in the foreground)")
+    parser.add_argument("--tiny", action="store_true",
+                        help="tiny deterministic model (dev/test)")
+    parser.add_argument("--chain-port", type=int, default=8081)
+    parser.add_argument("--ui-port", type=int, default=8090)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+
+    sup = Supervisor(build_stack(args.tiny, args.chain_port, args.ui_port))
+    stop = {"flag": False}
+
+    def handle(sig, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, handle)
+    signal.signal(signal.SIGTERM, handle)
+    try:
+        # inside the try: a failed bring-up (health timeout, early exit of a
+        # later service) must still tear down the services already started
+        sup.up()
+        logging.info("stack up: chain http://127.0.0.1:%d  "
+                     "ui http://127.0.0.1:%d (Ctrl-C to stop)",
+                     args.chain_port, args.ui_port)
+        while not stop["flag"]:
+            time.sleep(1)
+    finally:
+        sup.down()
+
+
+if __name__ == "__main__":
+    main()

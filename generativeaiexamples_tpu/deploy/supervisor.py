@@ -1,0 +1,202 @@
+"""Process supervisor: health-gated startup, crash restart, ordered teardown.
+
+Native counterpart of the reference's compose semantics
+(ref docker-compose.yaml:59-64 `depends_on: service_healthy`, restart
+policies) — the failure-detection/recovery layer SURVEY §5.3 calls for:
+
+  * **health-gated ordering** — a service starts only after everything in
+    its ``depends_on`` reports healthy (HTTP /health 200), so the chain
+    server never races its engine, the UI never races the chain server;
+  * **failure detection** — the monitor thread polls both process liveness
+    (exit code) and the health endpoint; either failing marks the service
+    down;
+  * **recovery** — crashed services restart with exponential backoff (and
+    their dependents simply keep running: the per-request failure path is
+    handled inside each service — e.g. the scheduler fails streams loudly
+    and keeps serving, engine/scheduler.py);
+  * **ordered teardown** — reverse dependency order, SIGTERM then SIGKILL.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class ServiceSpec:
+    name: str
+    command: List[str]
+    health_url: str = ""                 # empty = liveness-only (no probe)
+    depends_on: List[str] = field(default_factory=list)
+    env: Dict[str, str] = field(default_factory=dict)
+    startup_timeout_s: float = 120.0
+    restart: bool = True
+    max_restarts: int = 5
+
+
+@dataclass
+class _ServiceState:
+    spec: ServiceSpec
+    proc: Optional[subprocess.Popen] = None
+    healthy: bool = False
+    restarts: int = 0
+    backoff_until: float = 0.0
+
+
+def _http_ok(url: str, timeout: float = 2.0) -> bool:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return 200 <= resp.status < 300
+    except Exception:
+        return False
+
+
+class Supervisor:
+    """Owns a stack of ServiceSpecs for its lifetime."""
+
+    def __init__(self, services: Sequence[ServiceSpec],
+                 poll_interval_s: float = 1.0) -> None:
+        self._order = self._toposort(services)
+        self._states = {s.name: _ServiceState(spec=s) for s in services}
+        self.poll_interval_s = poll_interval_s
+        self._running = False
+        self._monitor: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _toposort(services: Sequence[ServiceSpec]) -> List[ServiceSpec]:
+        by_name = {s.name: s for s in services}
+        seen: Dict[str, int] = {}          # 0 = visiting, 1 = done
+        order: List[ServiceSpec] = []
+
+        def visit(name: str) -> None:
+            if seen.get(name) == 1:
+                return
+            if seen.get(name) == 0:
+                raise ValueError(f"dependency cycle through {name!r}")
+            if name not in by_name:
+                raise ValueError(f"unknown dependency {name!r}")
+            seen[name] = 0
+            for dep in by_name[name].depends_on:
+                visit(dep)
+            seen[name] = 1
+            order.append(by_name[name])
+
+        for s in services:
+            visit(s.name)
+        return order
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _spawn(self, st: _ServiceState) -> None:
+        env = {**os.environ, **st.spec.env}
+        logger.info("starting %s: %s", st.spec.name,
+                    " ".join(st.spec.command))
+        st.proc = subprocess.Popen(st.spec.command, env=env,
+                                   start_new_session=True)
+        st.healthy = not st.spec.health_url   # liveness-only = healthy-ish
+
+    def _wait_healthy(self, st: _ServiceState) -> None:
+        if not st.spec.health_url:
+            return
+        deadline = time.monotonic() + st.spec.startup_timeout_s
+        while time.monotonic() < deadline:
+            if st.proc.poll() is not None:
+                raise RuntimeError(
+                    f"{st.spec.name} exited (rc={st.proc.returncode}) "
+                    f"before becoming healthy")
+            if _http_ok(st.spec.health_url):
+                st.healthy = True
+                logger.info("%s healthy at %s", st.spec.name,
+                            st.spec.health_url)
+                return
+            time.sleep(self.poll_interval_s)
+        raise RuntimeError(f"{st.spec.name} failed health check at "
+                           f"{st.spec.health_url} within "
+                           f"{st.spec.startup_timeout_s}s")
+
+    def up(self) -> None:
+        """Start every service in dependency order, gating on health."""
+        self._running = True
+        for spec in self._order:
+            st = self._states[spec.name]
+            self._spawn(st)
+            self._wait_healthy(st)
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         name="deploy-monitor", daemon=True)
+        self._monitor.start()
+
+    def down(self) -> None:
+        """Reverse-order teardown: SIGTERM, then SIGKILL stragglers."""
+        self._running = False
+        if self._monitor:
+            self._monitor.join(timeout=10)
+        for spec in reversed(self._order):
+            st = self._states[spec.name]
+            if st.proc and st.proc.poll() is None:
+                logger.info("stopping %s", spec.name)
+                st.proc.terminate()
+        deadline = time.monotonic() + 15
+        for spec in reversed(self._order):
+            st = self._states[spec.name]
+            if not st.proc:
+                continue
+            try:
+                st.proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                logger.warning("killing %s", spec.name)
+                st.proc.kill()
+
+    def status(self) -> Dict[str, Dict[str, object]]:
+        out = {}
+        for name, st in self._states.items():
+            alive = bool(st.proc and st.proc.poll() is None)
+            out[name] = {"alive": alive,
+                         "healthy": alive and st.healthy,
+                         "restarts": st.restarts,
+                         "pid": st.proc.pid if st.proc else None}
+        return out
+
+    # -------------------------------------------------------------- monitor
+
+    def _monitor_loop(self) -> None:
+        while self._running:
+            for spec in self._order:
+                st = self._states[spec.name]
+                if not self._running:
+                    return
+                alive = st.proc and st.proc.poll() is None
+                if alive and st.spec.health_url:
+                    st.healthy = _http_ok(st.spec.health_url)
+                if alive:
+                    continue
+                st.healthy = False
+                if not st.spec.restart:
+                    continue
+                if st.restarts >= st.spec.max_restarts:
+                    logger.error("%s exceeded %d restarts; giving up",
+                                 spec.name, spec.max_restarts)
+                    continue
+                now = time.monotonic()
+                if now < st.backoff_until:
+                    continue
+                st.restarts += 1
+                st.backoff_until = now + min(2 ** st.restarts, 60)
+                logger.warning("%s died (rc=%s); restart %d/%d",
+                               spec.name,
+                               st.proc.returncode if st.proc else "?",
+                               st.restarts, spec.max_restarts)
+                try:
+                    self._spawn(st)
+                except Exception:
+                    logger.exception("restart of %s failed", spec.name)
+            time.sleep(self.poll_interval_s)

@@ -102,6 +102,8 @@ class ImageEmbedder:
         """L2-normalized joint-space vectors (N, dim); undecodable images
         embed to zero vectors (never retrieved)."""
         size = self.cfg.image_size
+        if not images:
+            return np.zeros((0, self.dim), np.float32)
         pixels, ok = [], []
         for b in images:
             arr = _decode_image(b, size)
@@ -110,7 +112,7 @@ class ImageEmbedder:
                           else np.zeros((size, size, 3), np.float32))
         n = len(pixels)
         pad = _bucket(n) - n
-        pixels += [pixels[0] * 0] * pad
+        pixels += [np.zeros((size, size, 3), np.float32)] * pad
         batch = (np.stack(pixels) - _MEAN) / _STD
         emb = np.array(self._img_fn(self.params,
                                     pixels=jnp.asarray(batch)))[:n]

@@ -143,7 +143,7 @@ def prefill_chunk(params: llama.Params, cfg: llama.LlamaConfig,
     KV, HD = cfg.n_kv_heads, cfg.head_dim
 
     positions = start_pos + jnp.arange(C, dtype=jnp.int32)[None]    # (1, C)
-    h = params["embed"].astype(cfg.jdtype)[tokens]
+    h = llama.embed_tokens(params, cfg, tokens)
     cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
     valid_through = (start_pos + chunk_len)[None]                   # (1,)
     chunk_pages = jax.lax.dynamic_slice(page_row, (start_pos // ps,), (n_cp,))
@@ -203,7 +203,7 @@ def decode_step(params: llama.Params, cfg: llama.LlamaConfig,
     KV, HD = cfg.n_kv_heads, cfg.head_dim
 
     positions = cache.lengths[:, None]                               # (B, 1)
-    h = params["embed"].astype(cfg.jdtype)[tokens[:, None]]
+    h = llama.embed_tokens(params, cfg, tokens[:, None])
     cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
     new_lengths = cache.lengths + 1
 

@@ -97,7 +97,7 @@ def _tower_init(rng, L: int, D: int, dt) -> Params:
 
 def init_params(rng: jax.Array, cfg: ClipConfig) -> Params:
     dt = cfg.jdtype
-    kv, kt, k1, k2, k3, k4, k5 = jax.random.split(rng, 7)
+    (kv, kt, k1, k2, k3, k4, k5, k6, k7) = jax.random.split(rng, 9)
     patch_in = 3 * cfg.patch_size ** 2
 
     def normal(key, shape, fan_in):
@@ -121,12 +121,12 @@ def init_params(rng: jax.Array, cfg: ClipConfig) -> Params:
         "text": {
             "tok_embed": normal(k5, (cfg.vocab_size, cfg.text_dim),
                                 cfg.text_dim),
-            "pos_embed": normal(k3, (cfg.max_text_len, cfg.text_dim),
+            "pos_embed": normal(k6, (cfg.max_text_len, cfg.text_dim),
                                 cfg.text_dim),
             "layers": _tower_init(kt, cfg.text_layers, cfg.text_dim, dt),
             "final_ln_w": jnp.ones((cfg.text_dim,), dt),
             "final_ln_b": jnp.zeros((cfg.text_dim,), dt),
-            "proj": normal(k2, (cfg.text_dim, cfg.projection_dim),
+            "proj": normal(k7, (cfg.text_dim, cfg.projection_dim),
                            cfg.text_dim),
         },
         "logit_scale": jnp.asarray(math.log(1 / 0.07), dt),

@@ -1,0 +1,78 @@
+"""Gemma-family decoder LM — the recipe gallery's second architecture.
+
+The reference fine-tunes Gemma/CodeGemma through NeMo notebooks
+(ref: finetuning/Gemma/lora.ipynb, finetuning/Gemma/sft.ipynb,
+finetuning/Codegemma/lora.ipynb). Architecturally Gemma-1 is the llama block
+with three deltas, all expressible as `models.llama.LlamaConfig` knobs plus
+weight-folding at import time — so serving (paged engine), LoRA/SFT training,
+sharding rules, and ring attention all work on Gemma with zero new model
+code:
+
+  * **GeGLU MLP** — tanh-approx GELU gating (``hidden_act="gelu_tanh"``);
+  * **embedding scaling** — hidden states are multiplied by sqrt(dim) after
+    the token lookup (``embed_scale``);
+  * **RMSNorm offset** — Gemma computes ``x_norm * (1 + w)``; `params_from_hf`
+    folds the +1 into the stored weights, so the shared rms_norm applies
+    unchanged (random init uses ones, the folded identity).
+
+Gemma always ties embeddings (no lm_head) and allows head_dim * n_heads !=
+dim (e.g. 2B: dim 2048, 8 heads of 256), which the llama layout already
+supports.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.models.llama import LlamaConfig
+
+Params = Dict[str, Any]
+
+
+def gemma_2b() -> LlamaConfig:
+    return LlamaConfig(
+        vocab_size=256000, dim=2048, n_layers=18, n_heads=8, n_kv_heads=1,
+        hidden_dim=16384, head_dim=256, rope_theta=10000.0, norm_eps=1e-6,
+        tie_embeddings=True, hidden_act="gelu_tanh",
+        embed_scale=math.sqrt(2048.0))
+
+
+def gemma_7b() -> LlamaConfig:
+    return LlamaConfig(
+        vocab_size=256000, dim=3072, n_layers=28, n_heads=16, n_kv_heads=16,
+        hidden_dim=24576, head_dim=256, rope_theta=10000.0, norm_eps=1e-6,
+        tie_embeddings=True, hidden_act="gelu_tanh",
+        embed_scale=math.sqrt(3072.0))
+
+
+def codegemma_7b() -> LlamaConfig:
+    """CodeGemma shares the 7B architecture (code-specialized weights)."""
+    return gemma_7b()
+
+
+def tiny(vocab_size: int = 256) -> LlamaConfig:
+    """Deterministic test-scale gemma (SURVEY §4 fake-backend style)."""
+    return LlamaConfig(
+        vocab_size=vocab_size, dim=64, n_layers=2, n_heads=4, n_kv_heads=1,
+        hidden_dim=128, head_dim=16, rope_theta=10000.0, norm_eps=1e-6,
+        tie_embeddings=True, hidden_act="gelu_tanh",
+        embed_scale=math.sqrt(64.0), dtype="float32")
+
+
+def params_from_hf(state_dict: Dict[str, Any], cfg: LlamaConfig) -> Params:
+    """Map a HF `GemmaForCausalLM.state_dict()` into the llama layout.
+
+    Identical tensor names to llama (q/k/v/o, gate/up/down, norms), so the
+    llama importer does the transposes/stacking; the Gemma-specific step is
+    folding the RMSNorm ``(1 + w)`` offset into the stored norm weights.
+    """
+    params = llama.params_from_hf(state_dict, cfg)
+    one = jnp.asarray(1.0, params["final_norm"].dtype)
+    params["layers"]["attn_norm"] = params["layers"]["attn_norm"] + one
+    params["layers"]["mlp_norm"] = params["layers"]["mlp_norm"] + one
+    params["final_norm"] = params["final_norm"] + one
+    return params

@@ -38,7 +38,7 @@ import jax.numpy as jnp
 
 from generativeaiexamples_tpu.ops import pallas as pallas_ops
 from generativeaiexamples_tpu.ops.attention import mha_decode, mha_prefill
-from generativeaiexamples_tpu.ops.layers import apply_rope, rms_norm, rotary_embedding, swiglu
+from generativeaiexamples_tpu.ops.layers import apply_rope, glu, rms_norm, rotary_embedding
 
 Params = Dict[str, Any]
 
@@ -56,6 +56,10 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
     dtype: str = "bfloat16"
+    # family knobs: "silu" (llama SwiGLU) | "gelu_tanh" (gemma GeGLU) MLP
+    # gating, and an embedding-output multiplier (gemma scales by sqrt(dim))
+    hidden_act: str = "silu"
+    embed_scale: float = 1.0
     # "xla" | "pallas": inference attention backend. Pallas kernels
     # (ops/pallas/attention.py) need head-axis-unsharded layouts; callers
     # that shard heads over a tensor axis must keep "xla" (or wrap the
@@ -174,6 +178,15 @@ class KVCache:
 # Forward passes
 # ---------------------------------------------------------------------------
 
+def embed_tokens(params: Params, cfg: LlamaConfig,
+                 tokens: jnp.ndarray) -> jnp.ndarray:
+    """Token embedding lookup with the family's output scaling."""
+    h = params["embed"].astype(cfg.jdtype)[tokens]
+    if cfg.embed_scale != 1.0:
+        h = h * jnp.asarray(cfg.embed_scale, h.dtype)
+    return h
+
+
 def _maybe_lora(x: jnp.ndarray, base_out: jnp.ndarray, adapters: Optional[Params],
                 name: str) -> jnp.ndarray:
     """Add a low-rank update x@A@B·(α/r) if an adapter exists for `name`.
@@ -209,7 +222,7 @@ def _block(cfg: LlamaConfig, h: jnp.ndarray, layer: Params,
     x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
     gate = _maybe_lora(x, x @ layer["w_gate"], adapters, "w_gate")
     up = _maybe_lora(x, x @ layer["w_up"], adapters, "w_up")
-    act = swiglu(gate, up)
+    act = glu(gate, up, cfg.hidden_act)
     h = h + _maybe_lora(act, act @ layer["w_down"], adapters, "w_down")
     return h
 
@@ -239,7 +252,7 @@ def forward(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
             "into attn_fn (e.g. sequence_parallel_attention's kv_lens)")
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
-    h = params["embed"].astype(cfg.jdtype)[tokens]
+    h = embed_tokens(params, cfg, tokens)
     cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
 
     attn = attn_fn if attn_fn is not None else partial(
@@ -388,7 +401,7 @@ def prefill(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
     B, S = tokens.shape
     T = cache.k.shape[2]
     positions = start_pos[:, None] + jnp.arange(S, dtype=jnp.int32)[None]
-    h = params["embed"].astype(cfg.jdtype)[tokens]
+    h = embed_tokens(params, cfg, tokens)
     cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
     cache_positions = jnp.arange(T, dtype=jnp.int32)[None]
     kv_valid_through = (start_pos + seq_lens)
@@ -427,7 +440,7 @@ def decode_step(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
     B = tokens.shape[0]
     T = cache.k.shape[2]
     positions = cache.lengths[:, None]                      # (B, 1)
-    h = params["embed"].astype(cfg.jdtype)[tokens[:, None]]  # (B, 1, D)
+    h = embed_tokens(params, cfg, tokens[:, None])       # (B, 1, D)
     cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
     new_lengths = cache.lengths + 1
 

@@ -32,6 +32,16 @@ def swiglu(gate: jnp.ndarray, up: jnp.ndarray) -> jnp.ndarray:
     return silu(gate) * up
 
 
+def glu(gate: jnp.ndarray, up: jnp.ndarray, act: str = "silu") -> jnp.ndarray:
+    """Gated linear unit with a selectable gate activation:
+    "silu" (llama SwiGLU) or "gelu_tanh" (gemma GeGLU)."""
+    if act == "silu":
+        return swiglu(gate, up)
+    if act == "gelu_tanh":
+        return jax.nn.gelu(gate, approximate=True) * up
+    raise ValueError(f"unknown gated activation {act!r}")
+
+
 def rotary_embedding(positions: jnp.ndarray, head_dim: int,
                      theta: float = 500000.0) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """cos/sin tables for the given positions, HF split-half convention.

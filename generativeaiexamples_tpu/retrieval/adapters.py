@@ -1,0 +1,221 @@
+"""External vector-DB adapters: Milvus and pgvector behind the store seam.
+
+Parity with the reference's `get_vector_db` dispatch (ref:
+RAG/src/chain_server/utils.py:220-332 — branches on
+``APP_VECTORSTORE_NAME`` to build a Milvus or PGVector langchain store; the
+compose files run the actual services). The in-process device-resident
+`retrieval.store.VectorStore` stays the default ("tpu"); these adapters give
+deployments that already operate a Milvus/Postgres the same drop-in surface:
+``add / search / list_sources / delete_by_source / __len__``, scores in
+cosine-similarity terms.
+
+The client objects are injected (constructor arg) and otherwise imported
+lazily — `pymilvus` / `psycopg2` are NOT vendored dependencies of this
+framework; a missing driver raises immediately with the package name instead
+of degrading silently. Tests exercise the adapters against in-memory fakes
+of the wire surface.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from generativeaiexamples_tpu.retrieval.store import Document
+
+logger = logging.getLogger(__name__)
+
+
+class MilvusStore:
+    """Milvus collection adapter (ref utils.py:253-287 Milvus branch).
+
+    Schema: auto-id pk, float-vector field "embedding" (COSINE), varchar
+    "content", JSON "metadata", varchar "source" (delete-by-source filter).
+    """
+
+    def __init__(self, dim: int, url: str = "http://localhost:19530",
+                 name: str = "default", client: Any = None) -> None:
+        self.dim = dim
+        self.name = f"gaie_{name}"
+        if client is None:
+            try:
+                from pymilvus import MilvusClient
+            except ImportError as exc:   # pragma: no cover - env-dependent
+                raise ImportError(
+                    "MilvusStore needs the 'pymilvus' package (or pass a "
+                    "compatible client=)") from exc
+            client = MilvusClient(uri=url)
+        self.client = client
+        if not self.client.has_collection(self.name):
+            self.client.create_collection(
+                collection_name=self.name, dimension=dim,
+                metric_type="COSINE", auto_id=False,
+                id_type="string", max_length=64)   # uuid4 hex string pks
+
+    def add(self, docs: Sequence[Document], embeddings: np.ndarray) -> List[str]:
+        emb = np.asarray(embeddings, np.float32)
+        rows, ids = [], []
+        for doc, vec in zip(docs, emb):
+            pk = uuid.uuid4().hex
+            ids.append(pk)
+            rows.append({"id": pk, "vector": vec.tolist(),
+                         "content": doc.content,
+                         "source": str(doc.metadata.get("source", "")),
+                         "metadata": json.dumps(doc.metadata)})
+        if rows:
+            self.client.insert(collection_name=self.name, data=rows)
+        return ids
+
+    def search(self, query_embedding: np.ndarray, top_k: int = 4,
+               score_threshold: float = 0.0
+               ) -> List[Tuple[Document, float]]:
+        res = self.client.search(
+            collection_name=self.name,
+            data=[np.asarray(query_embedding, np.float32).tolist()],
+            limit=top_k, output_fields=["content", "metadata"])
+        hits: List[Tuple[Document, float]] = []
+        for hit in (res[0] if res else []):
+            score = float(hit.get("distance", 0.0))
+            if score < score_threshold:
+                continue
+            entity = hit.get("entity", hit)
+            meta = entity.get("metadata", "{}")
+            meta = json.loads(meta) if isinstance(meta, str) else dict(meta)
+            hits.append((Document(content=entity.get("content", ""),
+                                  metadata=meta), score))
+        return hits
+
+    def list_sources(self) -> List[str]:
+        rows = self.client.query(collection_name=self.name,
+                                 filter="source != ''",
+                                 output_fields=["source"])
+        return sorted({r["source"] for r in rows})
+
+    def delete_by_source(self, sources: Sequence[str]) -> int:
+        n = 0
+        for src in sources:
+            # escape the quoted value: a filename like x" || source != "
+            # must not widen the filter expression
+            quoted = str(src).replace("\\", "\\\\").replace('"', '\\"')
+            res = self.client.delete(
+                collection_name=self.name,
+                filter=f'source == "{quoted}"')
+            n += int(res.get("delete_count", 0)) if isinstance(res, dict) \
+                else len(res or [])
+        return n
+
+    def __len__(self) -> int:
+        rows = self.client.query(collection_name=self.name,
+                                 output_fields=["count(*)"])
+        return int(rows[0]["count(*)"]) if rows else 0
+
+
+class PgVectorStore:
+    """Postgres + pgvector adapter (ref utils.py:289-332 PGVector branch).
+
+    One table per collection: (id uuid, content text, source text,
+    metadata jsonb, embedding vector(dim)); cosine distance operator <=>.
+    """
+
+    def __init__(self, dim: int, url: str = "", name: str = "default",
+                 conn: Any = None) -> None:
+        self.dim = dim
+        self.table = f"gaie_{name}"
+        if conn is None:
+            try:
+                import psycopg2
+            except ImportError as exc:   # pragma: no cover - env-dependent
+                raise ImportError(
+                    "PgVectorStore needs the 'psycopg2' package (or pass a "
+                    "compatible conn=)") from exc
+            conn = psycopg2.connect(url)
+        self.conn = conn
+        with self.conn.cursor() as cur:
+            cur.execute("CREATE EXTENSION IF NOT EXISTS vector")
+            cur.execute(
+                f"CREATE TABLE IF NOT EXISTS {self.table} ("
+                f"id text PRIMARY KEY, content text, source text, "
+                f"metadata jsonb, embedding vector({dim}))")
+        self.conn.commit()
+
+    @staticmethod
+    def _vec_literal(vec: np.ndarray) -> str:
+        return "[" + ",".join(f"{x:.8f}" for x in np.asarray(vec)) + "]"
+
+    def add(self, docs: Sequence[Document], embeddings: np.ndarray) -> List[str]:
+        ids = []
+        with self.conn.cursor() as cur:
+            for doc, vec in zip(docs, np.asarray(embeddings, np.float32)):
+                pk = uuid.uuid4().hex
+                ids.append(pk)
+                cur.execute(
+                    f"INSERT INTO {self.table} "
+                    f"(id, content, source, metadata, embedding) "
+                    f"VALUES (%s, %s, %s, %s, %s)",
+                    (pk, doc.content, str(doc.metadata.get("source", "")),
+                     json.dumps(doc.metadata), self._vec_literal(vec)))
+        self.conn.commit()
+        return ids
+
+    def search(self, query_embedding: np.ndarray, top_k: int = 4,
+               score_threshold: float = 0.0
+               ) -> List[Tuple[Document, float]]:
+        lit = self._vec_literal(query_embedding)
+        with self.conn.cursor() as cur:
+            cur.execute(
+                f"SELECT content, metadata, 1 - (embedding <=> %s) AS score "
+                f"FROM {self.table} ORDER BY embedding <=> %s LIMIT %s",
+                (lit, lit, top_k))
+            rows = cur.fetchall()
+        hits = []
+        for content, meta, score in rows:
+            if float(score) < score_threshold:
+                continue
+            meta = json.loads(meta) if isinstance(meta, str) else dict(meta)
+            hits.append((Document(content=content, metadata=meta),
+                         float(score)))
+        return hits
+
+    def list_sources(self) -> List[str]:
+        with self.conn.cursor() as cur:
+            cur.execute(f"SELECT DISTINCT source FROM {self.table} "
+                        f"WHERE source != ''")
+            return sorted(r[0] for r in cur.fetchall())
+
+    def delete_by_source(self, sources: Sequence[str]) -> int:
+        n = 0
+        with self.conn.cursor() as cur:
+            for src in sources:
+                cur.execute(f"DELETE FROM {self.table} WHERE source = %s",
+                            (src,))
+                n += cur.rowcount
+        self.conn.commit()
+        return n
+
+    def __len__(self) -> int:
+        with self.conn.cursor() as cur:
+            cur.execute(f"SELECT count(*) FROM {self.table}")
+            return int(cur.fetchone()[0])
+
+
+def make_store(dim: int, config, name: str = "default",
+               client: Any = None):
+    """Backend dispatch on VectorStoreConfig.name (ref utils.py:220-250):
+    "tpu" (default, in-proc device-resident) | "milvus" | "pgvector"."""
+    backend = (config.name or "tpu").lower()
+    if backend in ("tpu", "inproc", "default"):
+        from generativeaiexamples_tpu.retrieval.store import VectorStore
+
+        return VectorStore(dim=dim, index_type=config.index_type,
+                           nlist=config.nlist, nprobe=config.nprobe,
+                           name=name)
+    if backend == "milvus":
+        return MilvusStore(dim=dim, url=config.url, name=name, client=client)
+    if backend == "pgvector":
+        return PgVectorStore(dim=dim, url=config.url, name=name, conn=client)
+    raise ValueError(f"unknown vector store backend {config.name!r} "
+                     f"(expected tpu|milvus|pgvector)")

@@ -1,0 +1,26 @@
+"""Speech layer: ASR/TTS seams for the voice playground variant.
+
+The reference's speech playground (ref: RAG/src/rag_playground/speech —
+`asr_utils.py` streams mic audio to a Riva ASR gRPC service, `tts_utils.py`
+synthesizes replies through Riva TTS; both are EXTERNAL GPU services, with
+the UI degrading gracefully when they are unreachable, asr_utils.py:24-26).
+
+SURVEY §2.5 records this row as an opt-out stub for the TPU stack — there
+is no in-tree speech model family (yet); what the framework owes is the
+SEAM, the degraded path, and an HTTP client for deployments that do run a
+speech service:
+
+  * :class:`ASRClient` / :class:`TTSClient` protocols — what the voice UI
+    codes against;
+  * :class:`HTTPSpeechClient` — OpenAI-compatible `/v1/audio/transcriptions`
+    and `/v1/audio/speech` endpoints (the hosted-service path; Riva also
+    exposes this shape through its proxy);
+  * :class:`DisabledSpeech` — the explicit opt-out: available() is False and
+    use raises with setup instructions, mirroring the reference's
+    "speech features disabled" degradation rather than failing silently.
+
+`get_speech()` dispatches on APP_SPEECH_SERVER_URL.
+"""
+
+from generativeaiexamples_tpu.speech.clients import (  # noqa: F401
+    ASRClient, DisabledSpeech, HTTPSpeechClient, TTSClient, get_speech)

@@ -1,0 +1,89 @@
+"""ASR/TTS client seams + the HTTP implementation and explicit opt-out."""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import List, Optional, Protocol
+
+logger = logging.getLogger(__name__)
+
+_SETUP_HINT = (
+    "speech features are disabled: set APP_SPEECH_SERVER_URL to an "
+    "OpenAI-compatible audio endpoint (/v1/audio/transcriptions + "
+    "/v1/audio/speech) to enable them")
+
+
+class ASRClient(Protocol):
+    def available(self) -> bool: ...
+    def transcribe(self, audio: bytes, language: str = "en-US") -> str: ...
+
+
+class TTSClient(Protocol):
+    def available(self) -> bool: ...
+    def synthesize(self, text: str, voice: str = "default") -> bytes: ...
+
+
+class DisabledSpeech:
+    """The documented opt-out (ref asr_utils.py:24-26 degradation): feature
+    flags report unavailable, use raises with the setup hint — never a
+    silent no-op transcription."""
+
+    def available(self) -> bool:
+        return False
+
+    def languages(self) -> List[str]:
+        return []
+
+    def transcribe(self, audio: bytes, language: str = "en-US") -> str:
+        raise RuntimeError(_SETUP_HINT)
+
+    def synthesize(self, text: str, voice: str = "default") -> bytes:
+        raise RuntimeError(_SETUP_HINT)
+
+
+class HTTPSpeechClient:
+    """OpenAI-audio-shaped client for a deployed ASR/TTS service."""
+
+    def __init__(self, base_url: str, model: str = "whisper-1",
+                 timeout_s: float = 120.0) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.model = model
+        self.timeout_s = timeout_s
+
+    def available(self) -> bool:
+        return True
+
+    def languages(self) -> List[str]:
+        return ["en-US"]
+
+    def transcribe(self, audio: bytes, language: str = "en-US") -> str:
+        import httpx
+
+        resp = httpx.post(
+            f"{self.base_url}/v1/audio/transcriptions",
+            data={"model": self.model, "language": language.split("-")[0]},
+            files={"file": ("audio.wav", audio, "audio/wav")},
+            timeout=self.timeout_s)
+        resp.raise_for_status()
+        return resp.json().get("text", "")
+
+    def synthesize(self, text: str, voice: str = "default") -> bytes:
+        import httpx
+
+        resp = httpx.post(
+            f"{self.base_url}/v1/audio/speech",
+            json={"model": self.model, "input": text, "voice": voice},
+            timeout=self.timeout_s)
+        resp.raise_for_status()
+        return resp.content
+
+
+def get_speech(url: Optional[str] = None):
+    """Factory: HTTPSpeechClient when configured, DisabledSpeech otherwise."""
+    url = url if url is not None else os.environ.get(
+        "APP_SPEECH_SERVER_URL", "")
+    if url:
+        return HTTPSpeechClient(url, model=os.environ.get(
+            "APP_SPEECH_MODEL_NAME", "whisper-1"))
+    return DisabledSpeech()

@@ -20,7 +20,7 @@ import logging
 
 import jax
 
-from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.models import gemma, llama
 from generativeaiexamples_tpu.train import checkpoints, data as data_lib, recipes
 from generativeaiexamples_tpu.train.trainer import Trainer
 
@@ -29,7 +29,11 @@ log = logging.getLogger(__name__)
 MODEL_CONFIGS = {
     "llama3-8b": llama.LlamaConfig.llama3_8b,
     "llama3-70b": llama.LlamaConfig.llama3_70b,
+    "gemma-2b": gemma.gemma_2b,
+    "gemma-7b": gemma.gemma_7b,
+    "codegemma-7b": gemma.codegemma_7b,
     "tiny": llama.LlamaConfig.tiny,
+    "tiny-gemma": gemma.tiny,
 }
 
 

@@ -1,0 +1,190 @@
+"""Vector-DB adapter tests against in-memory fakes of the Milvus / psycopg2
+wire surfaces (the services themselves aren't part of this environment —
+ref utils.py:220-332 parity is in the adapter logic, not the server)."""
+
+import json
+import re
+
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.retrieval.adapters import (
+    MilvusStore, PgVectorStore, make_store)
+from generativeaiexamples_tpu.retrieval.store import Document, VectorStore
+
+
+# ---------------------------------------------------------------- fakes
+
+class FakeMilvusClient:
+    """Enough of pymilvus.MilvusClient for the adapter: has/create
+    collection, insert, COSINE search, filter query/delete."""
+
+    def __init__(self):
+        self.collections = {}
+
+    def has_collection(self, name):
+        return name in self.collections
+
+    def create_collection(self, collection_name, dimension, **kw):
+        self.collections[collection_name] = []
+
+    def insert(self, collection_name, data):
+        self.collections[collection_name].extend(data)
+
+    def search(self, collection_name, data, limit, output_fields):
+        q = np.asarray(data[0])
+        qn = q / np.linalg.norm(q)
+        rows = self.collections[collection_name]
+        scored = []
+        for r in rows:
+            v = np.asarray(r["vector"])
+            score = float(v / np.linalg.norm(v) @ qn)
+            scored.append({"id": r["id"], "distance": score,
+                           "entity": {f: r[f] for f in output_fields}})
+        scored.sort(key=lambda h: -h["distance"])
+        return [scored[:limit]]
+
+    def _match(self, row, filt):
+        m = re.match(r'source\s*(==|!=)\s*"(.*)"', filt)
+        if m:
+            op, val = m.groups()
+            return (row["source"] == val) == (op == "==")
+        if filt == "source != ''":
+            return row["source"] != ""
+        return True
+
+    def query(self, collection_name, filter="", output_fields=()):
+        rows = self.collections[collection_name]
+        if output_fields and output_fields[0] == "count(*)":
+            return [{"count(*)": len(rows)}]
+        out = [r for r in rows if not filter or self._match(r, filter)]
+        return [{f: r[f] for f in output_fields} for r in out]
+
+    def delete(self, collection_name, filter=""):
+        rows = self.collections[collection_name]
+        keep = [r for r in rows if not self._match(r, filter)]
+        removed = len(rows) - len(keep)
+        self.collections[collection_name] = keep
+        return {"delete_count": removed}
+
+
+class FakePgCursor:
+    def __init__(self, db):
+        self.db = db
+        self.rowcount = 0
+        self._result = []
+
+    def execute(self, sql, args=()):
+        sql = sql.strip()
+        self._result = []
+        if sql.startswith(("CREATE EXTENSION", "CREATE TABLE")):
+            return
+        if sql.startswith("INSERT"):
+            pk, content, source, meta, emb = args
+            vec = np.asarray(json.loads(emb))
+            self.db.append(dict(id=pk, content=content, source=source,
+                                metadata=meta, embedding=vec))
+            return
+        if sql.startswith("SELECT content"):
+            lit, _, top_k = args
+            q = np.asarray(json.loads(lit))
+            qn = q / np.linalg.norm(q)
+            scored = sorted(
+                ((r, float(r["embedding"] / np.linalg.norm(r["embedding"])
+                           @ qn)) for r in self.db),
+                key=lambda t: -t[1])
+            self._result = [(r["content"], r["metadata"], s)
+                            for r, s in scored[:top_k]]
+            return
+        if sql.startswith("SELECT DISTINCT source"):
+            self._result = sorted({(r["source"],) for r in self.db
+                                   if r["source"]})
+            return
+        if sql.startswith("DELETE"):
+            before = len(self.db)
+            self.db[:] = [r for r in self.db if r["source"] != args[0]]
+            self.rowcount = before - len(self.db)
+            return
+        if sql.startswith("SELECT count"):
+            self._result = [(len(self.db),)]
+            return
+        raise AssertionError(f"unexpected SQL: {sql}")
+
+    def fetchall(self):
+        return list(self._result)
+
+    def fetchone(self):
+        return self._result[0]
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class FakePgConn:
+    def __init__(self):
+        self.db = []
+
+    def cursor(self):
+        return FakePgCursor(self.db)
+
+    def commit(self):
+        pass
+
+
+# ----------------------------------------------------------------- tests
+
+def _docs():
+    return [
+        Document(content="red apples", metadata={"source": "fruit.txt"}),
+        Document(content="green pears", metadata={"source": "fruit.txt"}),
+        Document(content="blue whales", metadata={"source": "sea.txt"}),
+    ]
+
+
+def _vecs():
+    v = np.eye(3, 4, dtype=np.float32) + 0.1
+    return v / np.linalg.norm(v, axis=1, keepdims=True)
+
+
+@pytest.mark.parametrize("factory", [
+    lambda: MilvusStore(dim=4, name="t", client=FakeMilvusClient()),
+    lambda: PgVectorStore(dim=4, name="t", conn=FakePgConn()),
+])
+def test_adapter_contract(factory):
+    """add/search/list/delete/len behave like the in-proc store."""
+    store = factory()
+    store.add(_docs(), _vecs())
+    assert len(store) == 3
+    assert store.list_sources() == ["fruit.txt", "sea.txt"]
+
+    hits = store.search(_vecs()[0], top_k=2)
+    assert len(hits) == 2
+    assert hits[0][0].content == "red apples"
+    assert hits[0][1] >= hits[1][1]
+    assert hits[0][0].metadata["source"] == "fruit.txt"
+
+    # threshold filters low scores
+    strict = store.search(_vecs()[0], top_k=3, score_threshold=0.99)
+    assert all(s >= 0.99 for _, s in strict)
+
+    assert store.delete_by_source(["fruit.txt"]) == 2
+    assert len(store) == 1
+    assert store.list_sources() == ["sea.txt"]
+
+
+def test_make_store_dispatch():
+    from generativeaiexamples_tpu.core.config import VectorStoreConfig
+
+    inproc = make_store(4, VectorStoreConfig(), name="x")
+    assert isinstance(inproc, VectorStore)
+    milvus = make_store(4, VectorStoreConfig(name="milvus"), name="x",
+                        client=FakeMilvusClient())
+    assert isinstance(milvus, MilvusStore)
+    pg = make_store(4, VectorStoreConfig(name="pgvector"), name="x",
+                    client=FakePgConn())
+    assert isinstance(pg, PgVectorStore)
+    with pytest.raises(ValueError):
+        make_store(4, VectorStoreConfig(name="chroma"))

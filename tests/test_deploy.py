@@ -1,0 +1,123 @@
+"""Supervisor tests: health-gated ordering, crash restart with backoff,
+ordered teardown — SURVEY §5.3 failure detection/recovery + compose-parity
+(VERDICT round-1 missing items #6/#7 done-criteria). Services are tiny
+python HTTP servers so the tests run in seconds."""
+
+import socket
+import sys
+import textwrap
+import time
+
+import pytest
+import requests
+
+from generativeaiexamples_tpu.deploy.supervisor import ServiceSpec, Supervisor
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _http_service(port: int, delay: float = 0.0, die_after: float = 0.0,
+                  marker_file: str = "") -> list:
+    """Command for a toy /health HTTP service (optionally slow to start or
+    self-crashing once a marker file does not yet exist)."""
+    code = textwrap.dedent(f"""
+        import http.server, os, sys, threading, time
+        time.sleep({delay})
+        marker = {marker_file!r}
+        if marker and not os.path.exists(marker):
+            open(marker, "w").write("crashed once")
+            sys.exit(3)
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                self.send_response(200 if self.path == "/health" else 404)
+                self.end_headers()
+                self.wfile.write(b'ok')
+            def log_message(self, *a):
+                pass
+        http.server.HTTPServer(("127.0.0.1", {port}), H).serve_forever()
+    """)
+    return [sys.executable, "-c", code]
+
+
+def test_health_gated_ordering_and_teardown():
+    """B (depends on A) must not start until A is healthy; down() stops
+    both."""
+    pa, pb = _free_port(), _free_port()
+    sup = Supervisor([
+        ServiceSpec(name="a", command=_http_service(pa, delay=1.0),
+                    health_url=f"http://127.0.0.1:{pa}/health",
+                    startup_timeout_s=30),
+        ServiceSpec(name="b", command=_http_service(pb),
+                    health_url=f"http://127.0.0.1:{pb}/health",
+                    depends_on=["a"], startup_timeout_s=30),
+    ], poll_interval_s=0.1)
+    t0 = time.monotonic()
+    sup.up()
+    try:
+        assert time.monotonic() - t0 >= 1.0   # gated on A's slow start
+        st = sup.status()
+        assert st["a"]["healthy"] and st["b"]["healthy"]
+        assert requests.get(f"http://127.0.0.1:{pb}/health",
+                            timeout=5).status_code == 200
+    finally:
+        sup.down()
+    st = sup.status()
+    assert not st["a"]["alive"] and not st["b"]["alive"]
+
+
+def test_crash_restart_with_backoff(tmp_path):
+    """A service that dies once is detected and restarted; the restart
+    counter records the recovery."""
+    port = _free_port()
+    marker = str(tmp_path / "crashed")
+    spec = ServiceSpec(name="flaky",
+                       command=_http_service(port, marker_file=marker),
+                       health_url=f"http://127.0.0.1:{port}/health",
+                       startup_timeout_s=30)
+    sup = Supervisor([spec], poll_interval_s=0.1)
+    # first run exits rc=3 before ever serving → up() reports it loudly
+    with pytest.raises(RuntimeError, match="exited"):
+        sup.up()
+    # second run (marker exists) serves; crash it mid-flight and watch the
+    # monitor bring it back
+    sup2 = Supervisor([spec], poll_interval_s=0.1)
+    sup2.up()
+    try:
+        pid = sup2.status()["flaky"]["pid"]
+        import os
+        import signal as sig
+        os.kill(pid, sig.SIGKILL)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            st = sup2.status()["flaky"]
+            if st["alive"] and st["restarts"] == 1:
+                break
+            time.sleep(0.2)
+        st = sup2.status()["flaky"]
+        assert st["restarts"] == 1 and st["alive"]
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if sup2.status()["flaky"]["healthy"]:
+                break
+            time.sleep(0.2)
+        assert sup2.status()["flaky"]["healthy"]
+    finally:
+        sup2.down()
+
+
+def test_dependency_cycle_rejected():
+    with pytest.raises(ValueError, match="cycle"):
+        Supervisor([
+            ServiceSpec(name="x", command=["true"], depends_on=["y"]),
+            ServiceSpec(name="y", command=["true"], depends_on=["x"]),
+        ])
+
+
+def test_unknown_dependency_rejected():
+    with pytest.raises(ValueError, match="unknown dependency"):
+        Supervisor([ServiceSpec(name="x", command=["true"],
+                                depends_on=["ghost"])])

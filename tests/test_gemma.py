@@ -1,0 +1,102 @@
+"""Gemma family tests: numerical parity with transformers GemmaForCausalLM,
+and the family knobs flowing through serving + LoRA training unchanged
+(VERDICT round-1 item #8: second architecture in the recipe gallery)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.models import gemma, llama
+
+
+def test_gemma_matches_hf_reference():
+    """Architecture parity with transformers GemmaForCausalLM (config knobs:
+    GeGLU, sqrt(dim) embed scaling, folded (1+w) RMSNorm, MQA, 256-head)."""
+    torch = pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+    from transformers import GemmaConfig as HFConfig, GemmaForCausalLM
+
+    hf_cfg = HFConfig(vocab_size=160, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=1, head_dim=16,
+                      max_position_embeddings=64, rms_norm_eps=1e-6,
+                      rope_theta=10000.0, hidden_act="gelu_pytorch_tanh",
+                      attention_bias=False, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = GemmaForCausalLM(hf_cfg).eval()
+
+    cfg = llama.LlamaConfig(
+        vocab_size=160, dim=64, n_layers=2, n_heads=4, n_kv_heads=1,
+        hidden_dim=128, head_dim=16, rope_theta=10000.0, norm_eps=1e-6,
+        tie_embeddings=True, hidden_act="gelu_tanh",
+        embed_scale=float(64 ** 0.5), dtype="float32")
+    params = gemma.params_from_hf(hf.state_dict(), cfg)
+
+    tokens = np.array([[3, 17, 42, 9, 101, 77, 5, 150],
+                       [1, 2, 3, 4, 5, 6, 7, 8]], np.int64)
+    with torch.no_grad():
+        hf_logits = hf(input_ids=torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(llama.forward(params, cfg,
+                                    jnp.asarray(tokens, jnp.int32)))
+    np.testing.assert_allclose(ours, hf_logits, atol=2e-3, rtol=2e-3)
+
+
+def test_gemma_serves_through_the_paged_engine():
+    """The gemma knobs ride LlamaConfig, so the continuous-batching engine
+    serves gemma unchanged; greedy output equals the raw model's."""
+    from generativeaiexamples_tpu.core.config import EngineConfig
+    from generativeaiexamples_tpu.engine.engine import EngineCore
+    from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
+    from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+
+    cfg = gemma.tiny(vocab_size=300)
+    params = llama.init_params(jax.random.PRNGKey(9), cfg)
+    tok = ByteTokenizer()
+    prompt = tok.encode("gemma on tpu", add_bos=True)
+
+    seq = list(prompt)
+    for _ in range(6):
+        logits = llama.forward(params, cfg, jnp.array([seq], jnp.int32))
+        seq.append(int(jnp.argmax(logits[0, -1])))
+    want = tok.decode(seq[len(prompt):])
+
+    core = EngineCore(cfg, EngineConfig(max_batch_size=2, max_seq_len=128,
+                                        page_size=16, prefill_chunk=32),
+                      params, eos_id=tok.eos_id)
+    sched = Scheduler(core, tok)
+    req = Request(prompt_ids=list(prompt), max_tokens=6, temperature=0.0)
+    sched.submit(req)
+    while sched._tick():
+        pass
+    parts = []
+    while not req.out_queue.empty():
+        item = req.out_queue.get_nowait()
+        if isinstance(item, str):
+            parts.append(item)
+    assert req.error is None
+    assert "".join(parts) == want
+
+
+def test_gemma_lora_training_step():
+    """The gemma recipe runs through the one Trainer (loss decreases)."""
+    from generativeaiexamples_tpu.train import data as data_lib
+    from generativeaiexamples_tpu.train.lora import LoraConfig
+    from generativeaiexamples_tpu.train.trainer import TrainConfig, Trainer
+
+    cfg = gemma.tiny(vocab_size=300)
+    params = llama.init_params(jax.random.PRNGKey(4), cfg)
+    tcfg = TrainConfig(mode="lora", lora=LoraConfig(rank=4, alpha=8.0),
+                       micro_batch_size=2, global_batch_size=4, max_steps=6,
+                       warmup_steps=1, learning_rate=5e-3, seq_len=16)
+    trainer = Trainer(cfg, tcfg, params)
+    rng = np.random.RandomState(0)
+    B, S = tcfg.global_batch_size, tcfg.seq_len
+    tokens = rng.randint(1, 300, size=(B, S + 1)).astype(np.int32)
+    batch = data_lib.Batch(tokens=tokens,
+                           loss_mask=np.ones((B, S + 1), np.float32))
+    losses = []
+    trainer.fit([batch] * tcfg.max_steps,
+                on_step=lambda s, m: losses.append(m["loss"]))
+    assert len(losses) == 6
+    assert losses[-1] < losses[0], losses

@@ -1,0 +1,80 @@
+"""Speech seam tests: dispatch, explicit opt-out behavior, and the HTTP
+client against a local fake audio endpoint."""
+
+import asyncio
+import socket
+import threading
+
+import pytest
+
+from generativeaiexamples_tpu.speech import (
+    DisabledSpeech, HTTPSpeechClient, get_speech)
+
+
+def test_disabled_is_loud_not_silent(monkeypatch):
+    monkeypatch.delenv("APP_SPEECH_SERVER_URL", raising=False)
+    sp = get_speech()
+    assert isinstance(sp, DisabledSpeech)
+    assert not sp.available()
+    with pytest.raises(RuntimeError, match="APP_SPEECH_SERVER_URL"):
+        sp.transcribe(b"audio")
+    with pytest.raises(RuntimeError, match="APP_SPEECH_SERVER_URL"):
+        sp.synthesize("hello")
+
+
+def test_dispatch_on_env(monkeypatch):
+    monkeypatch.setenv("APP_SPEECH_SERVER_URL", "http://example:9000")
+    sp = get_speech()
+    assert isinstance(sp, HTTPSpeechClient)
+    assert sp.available()
+
+
+def test_http_client_round_trip():
+    from aiohttp import web
+
+    async def transcriptions(request):
+        reader = await request.multipart()
+        got_file = False
+        while True:
+            part = await reader.next()
+            if part is None:
+                break
+            if part.name == "file":
+                got_file = (await part.read()) == b"fake-wav"
+        assert got_file
+        return web.json_response({"text": "hello from asr"})
+
+    async def speech(request):
+        body = await request.json()
+        return web.Response(body=f"AUDIO:{body['input']}".encode(),
+                            content_type="audio/wav")
+
+    app = web.Application()
+    app.router.add_post("/v1/audio/transcriptions", transcriptions)
+    app.router.add_post("/v1/audio/speech", speech)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        loop.run_until_complete(site.start())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(timeout=15)
+    try:
+        client = HTTPSpeechClient(f"http://127.0.0.1:{port}")
+        assert client.transcribe(b"fake-wav") == "hello from asr"
+        assert client.synthesize("hi there") == b"AUDIO:hi there"
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=10)
