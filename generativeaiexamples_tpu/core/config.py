@@ -212,6 +212,7 @@ class EngineConfig:
     decode_steps_per_dispatch: int = configfield("decode_steps_per_dispatch", default=8, help_txt="Decode steps fused into one device dispatch (lax.scan); amortizes host sync latency. Must be a power of two (each distinct step count is a separate compile).")
     donate_buffers: str = configfield("donate_buffers", default="auto", help_txt="Donate the KV pool through dispatches: on | off | auto (off on remote-attached chips, where the client blocks ~RTT per donated dispatch; costs a transient 2x pool copy when off).")
     dtype: str = configfield("dtype", default="bfloat16", help_txt="Activation/weight dtype.")
+    quant: str = configfield("quant", default="none", help_txt="Weight quantization: none | int8 (per-channel weight-only; halves weight HBM reads — the decode bottleneck — and fits 8B-class weights on one v5e chip).")
     attention: str = configfield("attention", default="auto", help_txt="Attention backend: auto (pallas on TPU, xla elsewhere) | pallas | xla.")
     mesh_shape: str = configfield("mesh_shape", default="", help_txt="Device mesh, e.g. '1x8'; empty = all devices on one tensor axis.")
     checkpoint_dir: str = configfield("checkpoint_dir", default="", help_txt="Orbax checkpoint to serve; empty = random init (test mode).")

@@ -88,7 +88,12 @@ class DecodeState:
 
 class EngineCore:
     """Owns params + jitted programs. Thread-safety: call from one driver
-    thread (the scheduler); jax dispatch itself is async."""
+    thread (the scheduler); jax dispatch itself is async.
+
+    With ``engine_cfg.quant == "int8"`` the constructor CONSUMES the params
+    tree (buffer donation frees each bf16 leaf as its int8 copy lands — the
+    only way a 3B+ model quantizes within one chip's HBM); callers must not
+    reuse the tree they passed in."""
 
     def __init__(self, model_cfg: llama.LlamaConfig, engine_cfg: EngineConfig,
                  params: llama.Params, eos_id: int,
@@ -167,6 +172,21 @@ class EngineCore:
         else:
             self._kv_sharding = None
             self._replicated = None
+        if engine_cfg.quant == "int8":
+            # after shard_params: elementwise quantize + keepdims amax
+            # propagate each weight's NamedSharding onto q and s, so TP
+            # layouts survive quantization. donate=True frees each bf16
+            # source buffer as its int8 copy lands (ops/quant.py) — the
+            # caller's params tree is consumed, which is exactly the load
+            # path's contract (EngineCore owns the weights from here on).
+            from generativeaiexamples_tpu.ops import quant as quant_ops
+            params = quant_ops.quantize_params(params, donate=True)
+            import logging
+            logging.getLogger(__name__).info(
+                "serving with int8 weight-only quantization")
+        elif engine_cfg.quant not in ("none", ""):
+            raise ValueError(f"unknown quant mode {engine_cfg.quant!r}; "
+                             "expected 'none' or 'int8'")
         self.params = params
         self.adapters = adapters
 

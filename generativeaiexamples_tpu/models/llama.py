@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from generativeaiexamples_tpu.ops import pallas as pallas_ops
+from generativeaiexamples_tpu.ops import quant
 from generativeaiexamples_tpu.ops.attention import mha_decode, mha_prefill
 from generativeaiexamples_tpu.ops.layers import apply_rope, glu, rms_norm, rotary_embedding
 
@@ -181,7 +182,7 @@ class KVCache:
 def embed_tokens(params: Params, cfg: LlamaConfig,
                  tokens: jnp.ndarray) -> jnp.ndarray:
     """Token embedding lookup with the family's output scaling."""
-    h = params["embed"].astype(cfg.jdtype)[tokens]
+    h = quant.take(params["embed"], tokens, cfg.jdtype)
     if cfg.embed_scale != 1.0:
         h = h * jnp.asarray(cfg.embed_scale, h.dtype)
     return h
@@ -210,26 +211,29 @@ def _block(cfg: LlamaConfig, h: jnp.ndarray, layer: Params,
     B, S, D = h.shape
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
+    mm = quant.matmul  # one matmul seam serves bf16 and int8 weights alike
     x = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
-    q = _maybe_lora(x, x @ layer["wq"], adapters, "wq").reshape(B, S, H, HD)
-    k = _maybe_lora(x, x @ layer["wk"], adapters, "wk").reshape(B, S, KV, HD)
-    v = _maybe_lora(x, x @ layer["wv"], adapters, "wv").reshape(B, S, KV, HD)
+    q = _maybe_lora(x, mm(x, layer["wq"]), adapters, "wq").reshape(B, S, H, HD)
+    k = _maybe_lora(x, mm(x, layer["wk"]), adapters, "wk").reshape(B, S, KV, HD)
+    v = _maybe_lora(x, mm(x, layer["wv"]), adapters, "wv").reshape(B, S, KV, HD)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     ctx = attn_fn(q, k, v).reshape(B, S, H * HD)
-    h = h + _maybe_lora(ctx, ctx @ layer["wo"], adapters, "wo")
+    h = h + _maybe_lora(ctx, mm(ctx, layer["wo"]), adapters, "wo")
 
     x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
-    gate = _maybe_lora(x, x @ layer["w_gate"], adapters, "w_gate")
-    up = _maybe_lora(x, x @ layer["w_up"], adapters, "w_up")
+    gate = _maybe_lora(x, mm(x, layer["w_gate"]), adapters, "w_gate")
+    up = _maybe_lora(x, mm(x, layer["w_up"]), adapters, "w_up")
     act = glu(gate, up, cfg.hidden_act)
-    h = h + _maybe_lora(act, act @ layer["w_down"], adapters, "w_down")
+    h = h + _maybe_lora(act, mm(act, layer["w_down"]), adapters, "w_down")
     return h
 
 
 def _unembed(cfg: LlamaConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    if isinstance(head, quant.QTensor):
+        return quant.matmul(h, head).astype(jnp.float32)
     return (h @ head.astype(h.dtype)).astype(jnp.float32)
 
 

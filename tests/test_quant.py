@@ -1,0 +1,140 @@
+"""Weight-only int8 quantization (ops/quant.py): accuracy bounds, the llama
+forward parity seam, engine serving with quant="int8", and TP sharding
+survival — the in-tree counterpart of the reference NIM's quantized serving
+engines (ref docs/architecture.md:49-61)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.core.config import EngineConfig
+from generativeaiexamples_tpu.engine.engine import EngineCore
+from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
+from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.ops import quant
+
+
+def test_quantize_roundtrip_error_bound():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 48)) * 0.3
+    qt = quant.quantize(w, axis=0)
+    assert qt.q.dtype == jnp.int8
+    assert qt.s.shape == (1, 48)
+    err = jnp.abs(quant.dequantize(qt) - w)
+    # symmetric rounding: |error| <= s/2 per output channel
+    assert bool(jnp.all(err <= qt.s / 2 + 1e-7))
+
+
+def test_quantized_matmul_close():
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    x = jax.random.normal(k1, (4, 64))
+    w = jax.random.normal(k2, (64, 32))
+    exact = x @ w
+    approx = quant.matmul(x, quant.quantize(w, axis=0))
+    rel = jnp.linalg.norm(approx - exact) / jnp.linalg.norm(exact)
+    assert float(rel) < 0.01
+
+
+def test_qtensor_transpose_tied_unembed():
+    w = jax.random.normal(jax.random.PRNGKey(2), (40, 16))  # (V, D) embed
+    qt = quant.quantize(w, axis=1)          # per-row scales (V, 1)
+    h = jax.random.normal(jax.random.PRNGKey(3), (2, 16))
+    exact = h @ w.T
+    approx = quant.matmul(h, qt.T)          # (D, V) with (1, V) scales
+    rel = jnp.linalg.norm(approx - exact) / jnp.linalg.norm(exact)
+    assert float(rel) < 0.02
+
+
+def _cosine(a, b, axis=-1):
+    a = a / (jnp.linalg.norm(a, axis=axis, keepdims=True) + 1e-9)
+    b = b / (jnp.linalg.norm(b, axis=axis, keepdims=True) + 1e-9)
+    return (a * b).sum(axis)
+
+
+def test_llama_forward_parity_int8():
+    """Per-position logit cosine similarity of the quantized forward must
+    stay near 1 on the tiny model (trained checkpoints do better: random
+    init has no redundancy for quantization noise to hide in)."""
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(7), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(8), (2, 32), 0,
+                                cfg.vocab_size)
+    base = llama.forward(params, cfg, tokens)
+    qlogits = llama.forward(quant.quantize_params(params), cfg, tokens)
+    cos = _cosine(base, qlogits)
+    assert float(cos.min()) > 0.98, float(cos.min())
+
+
+def test_quantize_params_structure():
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    qp = quant.quantize_params(params)
+    assert isinstance(qp["layers"]["wq"], quant.QTensor)
+    assert qp["layers"]["wq"].s.shape == (cfg.n_layers, 1,
+                                          cfg.n_heads * cfg.head_dim)
+    assert isinstance(qp["embed"], quant.QTensor)
+    assert qp["embed"].s.shape == (cfg.vocab_size, 1)
+    # norms stay high-precision
+    assert not isinstance(qp["final_norm"], quant.QTensor)
+    assert not isinstance(qp["layers"]["attn_norm"], quant.QTensor)
+
+
+@pytest.fixture(scope="module")
+def served():
+    cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    tok = ByteTokenizer()
+    return cfg, tok
+
+
+def _generate(cfg, tok, ecfg, mesh=None, prompt="the quick brown fox", n=8):
+    # fresh params per engine: quant="int8" DONATES the tree (EngineCore
+    # consumes the weights — reusing a donated tree dies on real TPUs, where
+    # donation actually invalidates buffers, even though CPU runs ignore it)
+    params = llama.init_params(jax.random.PRNGKey(5), cfg)
+    core = EngineCore(cfg, ecfg, params, eos_id=tok.eos_id, mesh=mesh)
+    sched = Scheduler(core, tok)
+    req = Request(prompt_ids=tok.encode(prompt, add_bos=True), max_tokens=n,
+                  temperature=0.0)
+    sched.submit(req)
+    while sched._tick():
+        pass
+    assert req.error is None
+    parts = []
+    while not req.out_queue.empty():
+        item = req.out_queue.get_nowait()
+        if isinstance(item, str):
+            parts.append(item)
+    return "".join(parts)
+
+
+def test_engine_serves_int8(served):
+    """quant="int8" must stream a deterministic non-empty greedy completion
+    through the full paged/chunked/scheduled path."""
+    cfg, tok = served
+    ecfg = EngineConfig(max_batch_size=2, max_seq_len=128, page_size=16,
+                        prefill_chunk=32, quant="int8")
+    out1 = _generate(cfg, tok, ecfg)
+    out2 = _generate(cfg, tok, ecfg)
+    assert out1 and out1 == out2
+
+
+def test_engine_int8_tensor_parallel_matches_single_device(served):
+    """Quantized weights sharded over the tensor axis (scales ride the same
+    output-channel split) must reproduce the single-device int8 stream."""
+    from generativeaiexamples_tpu.parallel import mesh as pmesh
+    cfg, tok = served
+    ecfg = EngineConfig(max_batch_size=2, max_seq_len=128, page_size=16,
+                        prefill_chunk=32, quant="int8")
+    base = _generate(cfg, tok, ecfg)
+    mesh = pmesh.create_mesh(
+        pmesh.MeshConfig(axes=pmesh.INFER_AXES, shape=(1, 2)),
+        devices=jax.devices()[:2])
+    assert _generate(cfg, tok, ecfg, mesh=mesh) == base
+
+
+def test_engine_rejects_unknown_quant(served):
+    cfg, tok = served
+    params = llama.init_params(jax.random.PRNGKey(5), cfg)
+    with pytest.raises(ValueError, match="quant"):
+        EngineCore(cfg, EngineConfig(quant="fp4"), params, eos_id=2)
