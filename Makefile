@@ -5,6 +5,14 @@ TEST_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 test:
 	$(TEST_ENV) python -m pytest tests/ -x -q
 
+# Build the native (C++) components: byte-level BPE tokenizer core.
+# Delegates to the one build recipe in native_tokenizer.py (also used by
+# the on-demand auto-build) so the two can't drift.
+.PHONY: native
+native:
+	$(TEST_ENV) python -c "from generativeaiexamples_tpu.engine.native_tokenizer \
+	  import _build_lib; import sys; sys.exit(0 if _build_lib() else 1)"
+
 bench:
 	python bench.py
 

@@ -1,0 +1,362 @@
+"""ctypes bindings for the native byte-level BPE core (native/bpe_tokenizer.cpp).
+
+The reference keeps tokenization in the Rust `tokenizers` runtime inside its
+model containers; this is the in-tree native equivalent for the serving and
+ingest hot paths (prompt encode, document tokenization for the splitter).
+
+Split of labor: Python does everything cold — parse ``tokenizer.json``,
+invert the GPT-2 byte<->unicode alphabet so the C++ side sees raw bytes,
+resolve merge rules to id triples, build \\p{L} / \\p{N} bitsets from
+unicodedata, handle added special tokens — and C++ does everything hot
+(UTF-8 scan, GPT-2 pre-tokenization, the BPE merge loop).
+
+`NativeBPETokenizer` implements the same `Tokenizer` protocol as
+`HFTokenizer` (engine/tokenizer.py) and is preferred by `get_tokenizer`
+when the shared library is available; everything degrades to the Python
+path when the toolchain or the JSON shape doesn't cooperate.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import logging
+import os
+import re
+import subprocess
+import threading
+from typing import Dict, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_BUILD_DIR = os.path.join(_NATIVE_DIR, "_build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libgenx_native.so")
+_SRC_PATH = os.path.join(_NATIVE_DIR, "bpe_tokenizer.cpp")
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+_MAX_CP = 0x110000
+_BITS_LEN = _MAX_CP // 8
+
+
+def _build_lib() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC_PATH,
+           "-o", _LIB_PATH]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        logger.warning("native tokenizer build failed to run: %s", exc)
+        return False
+    if proc.returncode != 0:
+        logger.warning("native tokenizer build failed:\n%s", proc.stderr)
+        return False
+    return True
+
+
+def load_native_lib() -> Optional[ctypes.CDLL]:
+    """Load (building on demand) the native library; None = unavailable."""
+    global _lib, _lib_failed
+    with _lib_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        stale = (not os.path.exists(_LIB_PATH) or
+                 (os.path.exists(_SRC_PATH) and
+                  os.path.getmtime(_SRC_PATH) > os.path.getmtime(_LIB_PATH)))
+        if stale and not _build_lib():
+            _lib_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as exc:
+            logger.warning("native tokenizer load failed: %s", exc)
+            _lib_failed = True
+            return None
+        lib.bpe_create.restype = ctypes.c_void_p
+        lib.bpe_create.argtypes = [
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32, ctypes.c_int32]
+        lib.bpe_free.restype = None
+        lib.bpe_free.argtypes = [ctypes.c_void_p]
+        lib.bpe_encode.restype = ctypes.c_int32
+        lib.bpe_encode.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
+        lib.bpe_decode.restype = ctypes.c_int32
+        lib.bpe_decode.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32]
+        _lib = lib
+        return _lib
+
+
+def _bytes_to_unicode() -> Dict[int, str]:
+    """The GPT-2 byte → printable-codepoint alphabet (every byte-level BPE
+    vocab is written in it)."""
+    bs = (list(range(ord("!"), ord("~") + 1)) +
+          list(range(ord("¡"), ord("¬") + 1)) +
+          list(range(ord("®"), ord("ÿ") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {b: chr(c) for b, c in zip(bs, cs)}
+
+
+_B2U = _bytes_to_unicode()
+_U2B = {u: b for b, u in _B2U.items()}
+
+
+def _token_to_bytes(token: str) -> Optional[bytes]:
+    """Vocab entry (byte-alphabet domain) → raw bytes; None if it contains
+    characters outside the alphabet (e.g. an added special in the vocab)."""
+    out = bytearray()
+    for ch in token:
+        b = _U2B.get(ch)
+        if b is None:
+            return None
+        out.append(b)
+    return bytes(out)
+
+
+# The two pre-tokenization patterns the native scanner implements. Anything
+# else must raise so get_tokenizer falls back to the Python path — silently
+# applying the wrong split would encode ids the model was never trained on.
+_GPT2_MODE, _LLAMA3_MODE = 0, 1
+_LLAMA3_PATTERN = (r"(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+"
+                   r"|\p{N}{1,3}| ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+"
+                   r"|\s+(?!\S)|\s+")
+_GPT2_PATTERN = (r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+"
+                 r"| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+")
+
+
+def _detect_pre_tokenizer(pre: dict) -> tuple:
+    """Map a tokenizer.json pre_tokenizer config onto a native scanner mode.
+
+    Supported shapes:
+      * ByteLevel with its built-in regex (use_regex != false) → GPT-2 mode;
+      * Sequence([Split(known pattern), ByteLevel(use_regex=false)]) →
+        the pattern decides (Llama-3 checkpoints ship exactly this shape).
+    Returns (mode, add_prefix_space); raises ValueError otherwise.
+    """
+    pres = (pre.get("pretokenizers", []) if pre.get("type") == "Sequence"
+            else [pre])
+    byte_levels = [p for p in pres if p.get("type") == "ByteLevel"]
+    if not byte_levels:
+        raise ValueError("only ByteLevel pre-tokenization is supported")
+    aps = bool(byte_levels[0].get("add_prefix_space", False))
+    splits = [p for p in pres if p.get("type") == "Split"]
+    others = [p for p in pres if p.get("type") not in ("ByteLevel", "Split")]
+    if others:
+        raise ValueError(
+            f"unsupported pre-tokenizers: {[p.get('type') for p in others]}")
+    if splits:
+        if len(splits) > 1 or byte_levels[0].get("use_regex", True):
+            raise ValueError("unsupported Split/ByteLevel combination")
+        pattern = splits[0].get("pattern", {})
+        pattern = pattern.get("Regex") if isinstance(pattern, dict) else None
+        if pattern == _LLAMA3_PATTERN:
+            return _LLAMA3_MODE, aps
+        if pattern == _GPT2_PATTERN:
+            return _GPT2_MODE, aps
+        raise ValueError(f"unrecognized split pattern {pattern!r}")
+    if byte_levels[0].get("use_regex", True) is False:
+        raise ValueError("ByteLevel without a split regex is unsupported")
+    return _GPT2_MODE, aps
+
+
+_bitsets_cache: Optional[tuple] = None
+
+
+def _unicode_bitsets() -> tuple:
+    """(letter_bits, number_bits) — 1 bit per codepoint, \\p{L} and \\p{N}
+    per unicodedata. Built once per process (~1 s), cached to disk beside
+    the shared library so later processes mmap-read it."""
+    global _bitsets_cache
+    if _bitsets_cache is not None:
+        return _bitsets_cache
+    import unicodedata
+    cache = os.path.join(
+        _BUILD_DIR, f"unicode_bits_{unicodedata.unidata_version}.bin")
+    if os.path.exists(cache):
+        with open(cache, "rb") as fh:
+            blob = fh.read()
+        if len(blob) == 2 * _BITS_LEN:
+            _bitsets_cache = (blob[:_BITS_LEN], blob[_BITS_LEN:])
+            return _bitsets_cache
+    letters = bytearray(_BITS_LEN)
+    numbers = bytearray(_BITS_LEN)
+    for cp in range(_MAX_CP):
+        cat = unicodedata.category(chr(cp))
+        if cat[0] == "L":
+            letters[cp >> 3] |= 1 << (cp & 7)
+        elif cat[0] == "N":
+            numbers[cp >> 3] |= 1 << (cp & 7)
+    try:
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        with open(cache, "wb") as fh:
+            fh.write(bytes(letters) + bytes(numbers))
+    except OSError:
+        pass
+    _bitsets_cache = (bytes(letters), bytes(numbers))
+    return _bitsets_cache
+
+
+class NativeBPETokenizer:
+    """Byte-level BPE over the native core; `Tokenizer` protocol."""
+
+    def __init__(self, path: str) -> None:
+        lib = load_native_lib()
+        if lib is None:
+            raise RuntimeError("native tokenizer library unavailable")
+        self._lib = lib
+        with open(path, "r", encoding="utf-8") as fh:
+            spec = json.load(fh)
+        model = spec.get("model", {})
+        if model.get("type") != "BPE":
+            raise ValueError(f"unsupported tokenizer model {model.get('type')!r}")
+        pre = spec.get("pre_tokenizer") or {}
+        self._mode, self._add_prefix_space = _detect_pre_tokenizer(pre)
+
+        vocab: Dict[str, int] = model["vocab"]
+        self.vocab_size = max(vocab.values()) + 1
+
+        # added/special tokens: handled Python-side (split before encode,
+        # skipped in decode)
+        self._special_ids: Dict[str, int] = {}
+        for tok in spec.get("added_tokens", []):
+            self._special_ids[tok["content"]] = tok["id"]
+            self.vocab_size = max(self.vocab_size, tok["id"] + 1)
+        self._id_is_special = set(self._special_ids.values())
+        self._special_re = (re.compile("|".join(
+            re.escape(s) for s in sorted(self._special_ids, key=len,
+                                         reverse=True)))
+            if self._special_ids else None)
+
+        self.bos_id = self._pick("<|begin_of_text|>", "<s>", "<bos>",
+                                 "<|endoftext|>")
+        self.eos_id = self._pick("<|eot_id|>", "</s>", "<eos>",
+                                 "<|end_of_text|>", "<|endoftext|>")
+        self.pad_id = self.eos_id
+
+        # --- flatten vocab to raw-byte strings for the native core -------
+        tok_bytes = [b""] * self.vocab_size
+        for tok, tid in vocab.items():
+            raw = _token_to_bytes(tok)
+            if raw is not None:
+                tok_bytes[tid] = raw
+        lens = (ctypes.c_int32 * self.vocab_size)(
+            *(len(b) for b in tok_bytes))
+        blob = b"".join(tok_bytes)
+        blob_arr = (ctypes.c_uint8 * max(len(blob), 1)).from_buffer_copy(
+            blob or b"\0")
+
+        # --- merges resolved to id triples --------------------------------
+        merges = model.get("merges", [])
+        keys, merged = [], []
+        for rule in merges:
+            a, b = rule.split(" ", 1) if isinstance(rule, str) else rule
+            ia, ib, iab = vocab.get(a), vocab.get(b), vocab.get(a + b)
+            if ia is None or ib is None or iab is None:
+                continue
+            keys.append((ia & 0xFFFFFFFF) << 32 | (ib & 0xFFFFFFFF))
+            merged.append(iab)
+        n_merges = len(keys)
+        keys_arr = (ctypes.c_uint64 * max(n_merges, 1))(*(keys or [0]))
+        merged_arr = (ctypes.c_int32 * max(n_merges, 1))(*(merged or [0]))
+
+        # --- initial id per byte ------------------------------------------
+        byte_init = []
+        for b in range(256):
+            tid = vocab.get(_B2U[b])
+            if tid is None:
+                raise ValueError(f"vocab lacks single-byte token for {b:#x}")
+            byte_init.append(tid)
+        init_arr = (ctypes.c_int32 * 256)(*byte_init)
+
+        letters, numbers = _unicode_bitsets()
+        lbits = (ctypes.c_uint8 * _BITS_LEN).from_buffer_copy(letters)
+        nbits = (ctypes.c_uint8 * _BITS_LEN).from_buffer_copy(numbers)
+
+        self._handle = lib.bpe_create(
+            self.vocab_size, lens, blob_arr, n_merges, keys_arr, merged_arr,
+            init_arr, lbits, nbits, _BITS_LEN, self._mode)
+        if not self._handle:
+            raise RuntimeError("bpe_create failed")
+
+    def _pick(self, *names: str) -> int:
+        for n in names:
+            if n in self._special_ids:
+                return self._special_ids[n]
+        return 0
+
+    def __del__(self) -> None:
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.bpe_free(handle)
+            self._handle = None
+
+    # ------------------------------------------------------------- protocol
+
+    def _encode_plain(self, text: str) -> List[int]:
+        if not text:
+            return []
+        if self._add_prefix_space and not text.startswith(" "):
+            text = " " + text
+        data = text.encode("utf-8")
+        cap = len(data) + 8
+        out = (ctypes.c_int32 * cap)()
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        n = self._lib.bpe_encode(self._handle, buf, len(data), out, cap)
+        if n > cap:   # can't happen (ids <= bytes) but honor the contract
+            out = (ctypes.c_int32 * n)()
+            n = self._lib.bpe_encode(self._handle, buf, len(data), out, n)
+        return list(out[:n])
+
+    def encode(self, text: str, add_bos: bool = False) -> List[int]:
+        ids: List[int] = [self.bos_id] if add_bos else []
+        if self._special_re is None:
+            ids += self._encode_plain(text)
+            return ids
+        pos = 0
+        for m in self._special_re.finditer(text):
+            ids += self._encode_plain(text[pos:m.start()])
+            ids.append(self._special_ids[m.group()])
+            pos = m.end()
+        ids += self._encode_plain(text[pos:])
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        plain = [i for i in ids if i not in self._id_is_special]
+        if not plain:
+            return ""
+        arr = (ctypes.c_int32 * len(plain))(*plain)
+        cap = 8 * len(plain)
+        out = (ctypes.c_uint8 * cap)()
+        n = self._lib.bpe_decode(self._handle, arr, len(plain), out, cap)
+        if n > cap:
+            out = (ctypes.c_uint8 * n)()
+            n = self._lib.bpe_decode(self._handle, arr, len(plain), out, n)
+        return bytes(out[:n]).decode("utf-8", errors="replace")
+
+    def apply_chat_template(self, messages: Sequence[dict]) -> List[int]:
+        # Llama-3 instruct convention (mirrors HFTokenizer)
+        ids: List[int] = [self.bos_id]
+        for m in messages:
+            ids += self.encode(f"<|start_header_id|>{m.get('role', 'user')}"
+                               f"<|end_header_id|>\n\n{m.get('content', '')}"
+                               f"<|eot_id|>")
+        ids += self.encode("<|start_header_id|>assistant<|end_header_id|>\n\n")
+        return ids

@@ -134,10 +134,20 @@ class IncrementalDetokenizer:
 
 
 def get_tokenizer(checkpoint_dir: str = "") -> Tokenizer:
-    """HF tokenizer if the checkpoint ships one, else the byte fallback."""
+    """Native BPE core if it builds for this vocab, else the HF wrapper,
+    else the byte fallback (engine/native_tokenizer.py for the split)."""
     if checkpoint_dir:
         p = os.path.join(checkpoint_dir, "tokenizer.json")
         if os.path.exists(p):
+            try:
+                from generativeaiexamples_tpu.engine.native_tokenizer import (
+                    NativeBPETokenizer)
+                return NativeBPETokenizer(p)
+            except Exception as exc:  # unsupported shape / no toolchain
+                import logging
+                logging.getLogger(__name__).info(
+                    "native tokenizer unavailable (%s); using Python path",
+                    exc)
             return HFTokenizer(p)
         import logging
         logging.getLogger(__name__).warning(

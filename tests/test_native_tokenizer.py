@@ -1,0 +1,178 @@
+"""Native C++ BPE core (native/bpe_tokenizer.cpp) vs the Python `tokenizers`
+reference implementation: byte-exact encode/decode parity on a trained
+ByteLevel BPE vocabulary, special-token handling, and the get_tokenizer
+preference order."""
+
+import json
+import os
+
+import pytest
+
+from generativeaiexamples_tpu.engine.native_tokenizer import (
+    NativeBPETokenizer, load_native_lib)
+from generativeaiexamples_tpu.engine.tokenizer import HFTokenizer, get_tokenizer
+
+tokenizers = pytest.importorskip("tokenizers")
+
+CORPUS = [
+    "The quick brown fox jumps over the lazy dog.",
+    "I'll say it's done — they've gone; we'd better not.",
+    "Pi is 3.14159 and 2^10 = 1024, about 1,000.",
+    "naïve café über Zürich — ⚡ emoji ☃ snow",
+    "  leading spaces   and\ttabs\nand\r\nnewlines   ",
+    "def f(x):\n    return x * 2  # comment",
+    "MixedCASE WORDS and lowercase and UPPER",
+    "日本語のテキストと中文文本 mixed with English",
+]
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    """Train a small byte-level BPE with the reference library and write a
+    tokenizer.json including added special tokens."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, decoders, trainers
+
+    tok = Tokenizer(models.BPE())
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=600, special_tokens=["<|begin_of_text|>", "<|eot_id|>",
+                                        "<|start_header_id|>",
+                                        "<|end_header_id|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet())
+    tok.train_from_iterator(CORPUS * 30, trainer)
+    path = str(tmp_path_factory.mktemp("tok") / "tokenizer.json")
+    tok.save(path)
+    return path, tok
+
+
+@pytest.fixture(scope="module")
+def native(trained):
+    path, _ = trained
+    if load_native_lib() is None:
+        pytest.skip("native library unavailable (no g++?)")
+    return NativeBPETokenizer(path)
+
+
+def test_encode_parity_with_reference(trained, native):
+    _, ref = trained
+    for text in CORPUS + ["", " ", "a", "  ", "…—…", "'", "''s", "x'll y'd"]:
+        expect = ref.encode(text, add_special_tokens=False).ids
+        got = native.encode(text)
+        assert got == expect, (text, got, expect)
+
+
+def test_decode_roundtrip(trained, native):
+    _, ref = trained
+    for text in CORPUS:
+        ids = native.encode(text)
+        assert native.decode(ids) == text
+        assert native.decode(ids) == ref.decode(ids, skip_special_tokens=True)
+
+
+def test_specials_split_and_skipped(native):
+    ids = native.encode("<|start_header_id|>user<|end_header_id|>\n\nhi")
+    assert native._special_ids["<|start_header_id|>"] in ids
+    assert native._special_ids["<|end_header_id|>"] in ids
+    assert native.decode(ids) == "user\n\nhi"
+
+
+def test_chat_template_matches_hf_wrapper(trained, native):
+    path, _ = trained
+    msgs = [{"role": "system", "content": "be brief"},
+            {"role": "user", "content": "what's 2+2?"}]
+    assert native.apply_chat_template(msgs) == \
+        HFTokenizer(path).apply_chat_template(msgs)
+
+
+def test_get_tokenizer_prefers_native(trained):
+    path, _ = trained
+    tok = get_tokenizer(os.path.dirname(path))
+    assert isinstance(tok, NativeBPETokenizer)
+
+
+def test_long_document_encode(trained, native):
+    """Ingest-scale input (the splitter's hot path) stays byte-exact."""
+    _, ref = trained
+    doc = "\n\n".join(CORPUS) * 50          # ~20 KB
+    assert native.encode(doc) == ref.encode(doc,
+                                            add_special_tokens=False).ids
+
+
+def test_unknown_model_type_raises(tmp_path):
+    p = tmp_path / "tokenizer.json"
+    p.write_text(json.dumps({"model": {"type": "WordPiece", "vocab": {}}}))
+    with pytest.raises(ValueError, match="unsupported"):
+        NativeBPETokenizer(str(p))
+
+
+# ------------------------------------------------------ llama-3 split mode
+
+@pytest.fixture(scope="module")
+def trained_llama3(tmp_path_factory):
+    """Train with the exact Llama-3 pre-tokenizer shape:
+    Sequence([Split(llama-3 regex), ByteLevel(use_regex=False)])."""
+    from tokenizers import (Regex, Tokenizer, models, pre_tokenizers,
+                            decoders, trainers)
+    from generativeaiexamples_tpu.engine.native_tokenizer import (
+        _LLAMA3_PATTERN)
+
+    tok = Tokenizer(models.BPE())
+    tok.pre_tokenizer = pre_tokenizers.Sequence([
+        pre_tokenizers.Split(Regex(_LLAMA3_PATTERN), behavior="isolated"),
+        pre_tokenizers.ByteLevel(add_prefix_space=False, use_regex=False),
+    ])
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=600, special_tokens=["<|begin_of_text|>", "<|eot_id|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet())
+    tok.train_from_iterator(CORPUS * 30, trainer)
+    path = str(tmp_path_factory.mktemp("tok3") / "tokenizer.json")
+    tok.save(path)
+    return path, tok
+
+
+def test_llama3_mode_encode_parity(trained_llama3):
+    path, ref = trained_llama3
+    if load_native_lib() is None:
+        pytest.skip("native library unavailable")
+    nat = NativeBPETokenizer(path)
+    assert nat._mode == 1
+    cases = CORPUS + [
+        "1234567 digits split by threes 99 1000000",
+        "I'LL SHOUT'S case-insensitive 'RE contractions",
+        "punct!!!\n\nwith newlines\r\n  \n mixed   runs",
+        "tab\tbefore word and nbsp",
+        "", " ", "\n", "  \n  ", "a\nb", "... \n",
+    ]
+    for text in cases:
+        expect = ref.encode(text, add_special_tokens=False).ids
+        got = nat.encode(text)
+        assert got == expect, (text, got, expect)
+        assert nat.decode(got) == ref.decode(got, skip_special_tokens=True)
+
+
+def test_unrecognized_split_pattern_raises(tmp_path, trained_llama3):
+    path, _ = trained_llama3
+    spec = json.load(open(path))
+    spec["pre_tokenizer"]["pretokenizers"][0]["pattern"]["Regex"] = r"\w+"
+    p = tmp_path / "tokenizer.json"
+    p.write_text(json.dumps(spec))
+    with pytest.raises(ValueError, match="unrecognized split pattern"):
+        NativeBPETokenizer(str(p))
+
+
+def test_long_run_piece_stays_fast(trained):
+    """A 100 KB punctuation divider forms ONE pre-tokenization piece; the
+    heap-based merge must chew through it in well under a second (the old
+    quadratic scan took minutes — an ingest-thread DoS)."""
+    import time
+    path, ref = trained
+    if load_native_lib() is None:
+        pytest.skip("native library unavailable")
+    nat = NativeBPETokenizer(path)
+    divider = "=" * 100_000
+    t0 = time.perf_counter()
+    got = nat.encode(divider)
+    assert time.perf_counter() - t0 < 1.0
+    assert got == ref.encode(divider, add_special_tokens=False).ids
