@@ -149,7 +149,7 @@ def prefill_chunk(params: llama.Params, cfg: llama.LlamaConfig,
     chunk_pages = jax.lax.dynamic_slice(page_row, (start_pos // ps,), (n_cp,))
     cache_positions = jnp.arange(T, dtype=jnp.int32)[None]          # (1, T)
 
-    use_pallas = (cfg.attn_impl == "pallas"
+    use_pallas = (cfg.attn_impl == "pallas" and cfg.sliding_window == 0
                   and pallas_ops.prefill_supported(C, T, HD))
 
     def attn_and_update(q, k, v, k_pool, v_pool, idx):
@@ -169,7 +169,8 @@ def prefill_chunk(params: llama.Params, cfg: llama.LlamaConfig,
             ctx = mha_prefill(
                 q, k_dense, v_dense, q_positions=positions,
                 kv_positions=cache_positions,
-                kv_mask=cache_positions < valid_through[:, None], causal=True)
+                kv_mask=cache_positions < valid_through[:, None], causal=True,
+                window=cfg.sliding_window)
         return ctx, new_k, new_v
 
     h, k_stack, v_stack = llama.scan_blocks_inplace(
@@ -213,7 +214,7 @@ def decode_step(params: llama.Params, cfg: llama.LlamaConfig,
                      jnp.int32(0))
     offs = cache.lengths % ps
 
-    use_pallas = (cfg.attn_impl == "pallas"
+    use_pallas = (cfg.attn_impl == "pallas" and cfg.sliding_window == 0
                   and pallas_ops.paged_decode_supported(ps, HD))
 
     def attn_and_update(q, k, v, k_pool, v_pool, idx):
@@ -234,7 +235,8 @@ def decode_step(params: llama.Params, cfg: llama.LlamaConfig,
                 B, T, KV, HD)
             v_dense = new_v[idx * num_pages + page_table].reshape(
                 B, T, KV, HD)
-            ctx = mha_decode(q, k_dense, v_dense, new_lengths)
+            ctx = mha_decode(q, k_dense, v_dense, new_lengths,
+                             window=cfg.sliding_window)
         return ctx, new_k, new_v
 
     h, k_stack, v_stack = llama.scan_blocks_inplace(

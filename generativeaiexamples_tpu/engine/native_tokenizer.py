@@ -46,8 +46,12 @@ _BITS_LEN = _MAX_CP // 8
 
 def _build_lib() -> bool:
     os.makedirs(_BUILD_DIR, exist_ok=True)
+    # compile to a per-process temp path and rename into place: concurrent
+    # first-use builds (multiple server/ingest processes) must never dlopen
+    # a half-linked .so from a shared output path
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC_PATH,
-           "-o", _LIB_PATH]
+           "-o", tmp]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=120)
@@ -56,6 +60,11 @@ def _build_lib() -> bool:
         return False
     if proc.returncode != 0:
         logger.warning("native tokenizer build failed:\n%s", proc.stderr)
+        return False
+    try:
+        os.replace(tmp, _LIB_PATH)
+    except OSError as exc:
+        logger.warning("native tokenizer install failed: %s", exc)
         return False
     return True
 

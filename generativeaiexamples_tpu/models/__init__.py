@@ -2,9 +2,9 @@
 reference serves through external containers (SURVEY §2.5).
 
 Decoder LMs (replace NIM LLM containers): Llama-3 family (`llama`), Gemma
-(`gemma`) — pure-function forward passes over parameter pytrees, layers
-stacked + `lax.scan`-ed for compile time, logical-axis annotations for mesh
-sharding.
+(`gemma`), StarCoder2 (`starcoder2`) — pure-function forward passes over
+parameter pytrees, layers stacked + `lax.scan`-ed for compile time,
+logical-axis annotations for mesh sharding.
 
 Encoders (replace NeMo Retriever NIMs): e5-class bi-encoder and cross-encoder
 reranker (`bert`), CLIP-style vision tower (`clip`).

@@ -39,7 +39,8 @@ import jax.numpy as jnp
 from generativeaiexamples_tpu.ops import pallas as pallas_ops
 from generativeaiexamples_tpu.ops import quant
 from generativeaiexamples_tpu.ops.attention import mha_decode, mha_prefill
-from generativeaiexamples_tpu.ops.layers import apply_rope, glu, rms_norm, rotary_embedding
+from generativeaiexamples_tpu.ops.layers import (
+    activate, apply_rope, glu, layer_norm, rms_norm, rotary_embedding)
 
 Params = Dict[str, Any]
 
@@ -61,6 +62,13 @@ class LlamaConfig:
     # gating, and an embedding-output multiplier (gemma scales by sqrt(dim))
     hidden_act: str = "silu"
     embed_scale: float = 1.0
+    # StarCoder2-family knobs (models/starcoder2.py): LayerNorm with affine
+    # bias instead of RMSNorm, biased projections, an ungated c_fc→act→c_proj
+    # MLP, and sliding-window attention (0 = full causal)
+    norm: str = "rms"        # "rms" | "layernorm"
+    use_bias: bool = False
+    mlp: str = "glu"         # "glu" | "plain"
+    sliding_window: int = 0
     # "xla" | "pallas": inference attention backend. Pallas kernels
     # (ops/pallas/attention.py) need head-axis-unsharded layouts; callers
     # that shard heads over a tensor axis must keep "xla" (or wrap the
@@ -103,21 +111,34 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
     def normal(key, shape, fan_in):
         return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(dt)
 
+    layers = {
+        "attn_norm": jnp.ones((L, D), dt),
+        "wq": normal(keys[1], (L, D, H * HD), D),
+        "wk": normal(keys[2], (L, D, KV * HD), D),
+        "wv": normal(keys[3], (L, D, KV * HD), D),
+        "wo": normal(keys[4], (L, H * HD, D), H * HD),
+        "mlp_norm": jnp.ones((L, D), dt),
+        "w_up": normal(keys[6], (L, D, F), D),
+        "w_down": normal(keys[7], (L, F, D), F),
+    }
+    if cfg.mlp == "glu":
+        layers["w_gate"] = normal(keys[5], (L, D, F), D)
+    if cfg.use_bias:
+        for name, width in (("wq", H * HD), ("wk", KV * HD), ("wv", KV * HD),
+                            ("wo", D), ("w_up", F), ("w_down", D)):
+            layers[name + "_b"] = jnp.zeros((L, width), dt)
+        if cfg.mlp == "glu":
+            layers["w_gate_b"] = jnp.zeros((L, F), dt)
+    if cfg.norm == "layernorm":
+        layers["attn_norm_b"] = jnp.zeros((L, D), dt)
+        layers["mlp_norm_b"] = jnp.zeros((L, D), dt)
     params: Params = {
         "embed": normal(keys[0], (cfg.vocab_size, D), D),
-        "layers": {
-            "attn_norm": jnp.ones((L, D), dt),
-            "wq": normal(keys[1], (L, D, H * HD), D),
-            "wk": normal(keys[2], (L, D, KV * HD), D),
-            "wv": normal(keys[3], (L, D, KV * HD), D),
-            "wo": normal(keys[4], (L, H * HD, D), H * HD),
-            "mlp_norm": jnp.ones((L, D), dt),
-            "w_gate": normal(keys[5], (L, D, F), D),
-            "w_up": normal(keys[6], (L, D, F), D),
-            "w_down": normal(keys[7], (L, F, D), F),
-        },
+        "layers": layers,
         "final_norm": jnp.ones((D,), dt),
     }
+    if cfg.norm == "layernorm":
+        params["final_norm_b"] = jnp.zeros((D,), dt)
     if not cfg.tie_embeddings:
         params["lm_head"] = normal(keys[8], (D, cfg.vocab_size), D)
     return params
@@ -128,21 +149,35 @@ def logical_axes(cfg: LlamaConfig) -> Params:
     # The embed table uses distinct logical axes from the unembed: token
     # gather from a vocab-sharded table is ambiguous for the partitioner, so
     # rules keep vocab_table replicated and shard the feature dim instead.
+    layers = {
+        "attn_norm": (None, "embed"),
+        "wq": (None, "embed", "heads"),
+        "wk": (None, "embed", "kv_heads"),
+        "wv": (None, "embed", "kv_heads"),
+        "wo": (None, "heads", "embed"),
+        "mlp_norm": (None, "embed"),
+        "w_up": (None, "embed", "mlp"),
+        "w_down": (None, "mlp", "embed"),
+    }
+    if cfg.mlp == "glu":
+        layers["w_gate"] = (None, "embed", "mlp")
+    if cfg.use_bias:
+        # biases shard with their projection's OUTPUT axis
+        layers.update({"wq_b": (None, "heads"), "wk_b": (None, "kv_heads"),
+                       "wv_b": (None, "kv_heads"), "wo_b": (None, "embed"),
+                       "w_up_b": (None, "mlp"), "w_down_b": (None, "embed")})
+        if cfg.mlp == "glu":
+            layers["w_gate_b"] = (None, "mlp")
+    if cfg.norm == "layernorm":
+        layers["attn_norm_b"] = (None, "embed")
+        layers["mlp_norm_b"] = (None, "embed")
     ax: Params = {
         "embed": ("vocab_table", "embed_table"),
-        "layers": {
-            "attn_norm": (None, "embed"),
-            "wq": (None, "embed", "heads"),
-            "wk": (None, "embed", "kv_heads"),
-            "wv": (None, "embed", "kv_heads"),
-            "wo": (None, "heads", "embed"),
-            "mlp_norm": (None, "embed"),
-            "w_gate": (None, "embed", "mlp"),
-            "w_up": (None, "embed", "mlp"),
-            "w_down": (None, "mlp", "embed"),
-        },
+        "layers": layers,
         "final_norm": ("embed",),
     }
+    if cfg.norm == "layernorm":
+        ax["final_norm_b"] = ("embed",)
     if not cfg.tie_embeddings:
         ax["lm_head"] = ("embed", "vocab")
     return ax
@@ -203,6 +238,22 @@ def _maybe_lora(x: jnp.ndarray, base_out: jnp.ndarray, adapters: Optional[Params
     return base_out + (x @ a.astype(x.dtype)) @ b.astype(x.dtype)
 
 
+def _norm(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
+          name: str) -> jnp.ndarray:
+    if cfg.norm == "layernorm":
+        return layer_norm(x, layer[name], layer[name + "_b"], cfg.norm_eps)
+    return rms_norm(x, layer[name], cfg.norm_eps)
+
+
+def _proj(cfg: LlamaConfig, x: jnp.ndarray, layer: Params, name: str,
+          adapters: Optional[Params]) -> jnp.ndarray:
+    """x @ W (+ b) with the quant seam and optional LoRA update."""
+    y = quant.matmul(x, layer[name])
+    if cfg.use_bias:
+        y = y + layer[name + "_b"].astype(y.dtype)
+    return _maybe_lora(x, y, adapters, name)
+
+
 def _block(cfg: LlamaConfig, h: jnp.ndarray, layer: Params,
            cos: jnp.ndarray, sin: jnp.ndarray,
            attn_fn, adapters: Optional[Params]) -> jnp.ndarray:
@@ -211,26 +262,28 @@ def _block(cfg: LlamaConfig, h: jnp.ndarray, layer: Params,
     B, S, D = h.shape
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
-    mm = quant.matmul  # one matmul seam serves bf16 and int8 weights alike
-    x = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
-    q = _maybe_lora(x, mm(x, layer["wq"]), adapters, "wq").reshape(B, S, H, HD)
-    k = _maybe_lora(x, mm(x, layer["wk"]), adapters, "wk").reshape(B, S, KV, HD)
-    v = _maybe_lora(x, mm(x, layer["wv"]), adapters, "wv").reshape(B, S, KV, HD)
+    x = _norm(cfg, h, layer, "attn_norm")
+    q = _proj(cfg, x, layer, "wq", adapters).reshape(B, S, H, HD)
+    k = _proj(cfg, x, layer, "wk", adapters).reshape(B, S, KV, HD)
+    v = _proj(cfg, x, layer, "wv", adapters).reshape(B, S, KV, HD)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     ctx = attn_fn(q, k, v).reshape(B, S, H * HD)
-    h = h + _maybe_lora(ctx, mm(ctx, layer["wo"]), adapters, "wo")
+    h = h + _proj(cfg, ctx, layer, "wo", adapters)
 
-    x = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
-    gate = _maybe_lora(x, mm(x, layer["w_gate"]), adapters, "w_gate")
-    up = _maybe_lora(x, mm(x, layer["w_up"]), adapters, "w_up")
-    act = glu(gate, up, cfg.hidden_act)
-    h = h + _maybe_lora(act, mm(act, layer["w_down"]), adapters, "w_down")
+    x = _norm(cfg, h, layer, "mlp_norm")
+    if cfg.mlp == "glu":
+        gate = _proj(cfg, x, layer, "w_gate", adapters)
+        up = _proj(cfg, x, layer, "w_up", adapters)
+        act = glu(gate, up, cfg.hidden_act)
+    else:   # plain c_fc -> act -> c_proj (StarCoder2)
+        act = activate(_proj(cfg, x, layer, "w_up", adapters), cfg.hidden_act)
+    h = h + _proj(cfg, act, layer, "w_down", adapters)
     return h
 
 
 def _unembed(cfg: LlamaConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
-    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    h = _norm(cfg, h, params, "final_norm")
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     if isinstance(head, quant.QTensor):
         return quant.matmul(h, head).astype(jnp.float32)
@@ -261,7 +314,7 @@ def forward(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
 
     attn = attn_fn if attn_fn is not None else partial(
         mha_prefill, q_positions=positions, kv_positions=positions,
-        kv_mask=attn_mask, causal=True)
+        kv_mask=attn_mask, causal=True, window=cfg.sliding_window)
 
     def body(h, xs):
         layer, ad = xs
@@ -292,6 +345,10 @@ def forward_seq_parallel(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
     from generativeaiexamples_tpu.parallel.ring_attention import (
         sequence_parallel_attention)
 
+    if cfg.sliding_window:
+        raise NotImplementedError(
+            "sequence-parallel attention is full-causal; sliding-window "
+            "models use the chunked-prefill path instead")
     B, S = tokens.shape
     kv_lens = (attn_mask.sum(-1).astype(jnp.int32) if attn_mask is not None
                else jnp.full((B,), S, jnp.int32))
@@ -410,7 +467,7 @@ def prefill(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
     cache_positions = jnp.arange(T, dtype=jnp.int32)[None]
     kv_valid_through = (start_pos + seq_lens)
 
-    use_pallas = (cfg.attn_impl == "pallas"
+    use_pallas = (cfg.attn_impl == "pallas" and cfg.sliding_window == 0
                   and pallas_ops.prefill_supported(S, T, cfg.head_dim))
 
     def attn(q, k_new, v_new):
@@ -421,7 +478,8 @@ def prefill(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
         kv_mask = cache_positions < kv_valid_through[:, None]
         return mha_prefill(q, k_new, v_new, q_positions=positions,
                            kv_positions=jnp.broadcast_to(cache_positions, (B, T)),
-                           kv_mask=kv_mask, causal=True)
+                           kv_mask=kv_mask, causal=True,
+                           window=cfg.sliding_window)
 
     h, k_stack, v_stack = _scan_cached_blocks(
         cfg, h, params, cache, cos, sin, start_pos, attn, adapters)
@@ -448,14 +506,17 @@ def decode_step(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
     cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
     new_lengths = cache.lengths + 1
 
-    use_pallas = (cfg.attn_impl == "pallas"
+    use_pallas = (cfg.attn_impl == "pallas" and cfg.sliding_window == 0
                   and pallas_ops.decode_supported(T, cfg.head_dim))
-    attn = (pallas_ops.ragged_decode if use_pallas else mha_decode)
+    if use_pallas:
+        attn = lambda q, k_new, v_new: pallas_ops.ragged_decode(
+            q, k_new, v_new, new_lengths)
+    else:
+        attn = lambda q, k_new, v_new: mha_decode(
+            q, k_new, v_new, new_lengths, window=cfg.sliding_window)
 
     h, k_stack, v_stack = _scan_cached_blocks(
-        cfg, h, params, cache, cos, sin, cache.lengths,
-        lambda q, k_new, v_new: attn(q, k_new, v_new, new_lengths),
-        adapters)
+        cfg, h, params, cache, cos, sin, cache.lengths, attn, adapters)
     logits = _unembed(cfg, params, h)[:, 0]
     return logits, KVCache(k=k_stack, v=v_stack, lengths=new_lengths)
 

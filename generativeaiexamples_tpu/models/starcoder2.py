@@ -1,0 +1,103 @@
+"""StarCoder2-family configs + HF weight import.
+
+Parity target: the reference's code-model fine-tuning recipes
+(ref: finetuning/StarCoder2/{lora,inference}.ipynb — LoRA on StarCoder2 in
+a NeMo container, then TRT-LLM export) and the code-LLM serving they imply.
+Like Gemma (models/gemma.py), the architecture is expressed as
+`models.llama.LlamaConfig` knobs, so serving (paged engine, int8 quant),
+LoRA/SFT training, and the mesh sharding rules all work unchanged:
+
+  * ``norm="layernorm"`` — classic LayerNorm with affine bias (not RMSNorm);
+  * ``use_bias=True``    — biased q/k/v/o and MLP projections;
+  * ``mlp="plain"``      — ungated c_fc → gelu_tanh → c_proj (w_up/w_down);
+  * ``sliding_window``   — 4096-token windowed attention (masked in the XLA
+    attention paths; the pallas kernels are full-causal and auto-gate off).
+
+Weight import maps HF `Starcoder2ForCausalLM` state dicts (torch, CPU) into
+the stacked-layer layout, transposing torch's (out, in) Linears.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.models.llama import LlamaConfig
+
+Params = Dict[str, Any]
+
+
+def starcoder2_3b() -> LlamaConfig:
+    return LlamaConfig(
+        vocab_size=49152, dim=3072, n_layers=30, n_heads=24, n_kv_heads=2,
+        hidden_dim=12288, head_dim=128, rope_theta=999999.4420358813,
+        norm_eps=1e-5, tie_embeddings=True, hidden_act="gelu_tanh",
+        norm="layernorm", use_bias=True, mlp="plain", sliding_window=4096)
+
+
+def starcoder2_7b() -> LlamaConfig:
+    return LlamaConfig(
+        vocab_size=49152, dim=4608, n_layers=32, n_heads=36, n_kv_heads=4,
+        hidden_dim=18432, head_dim=128, rope_theta=1e6, norm_eps=1e-5,
+        tie_embeddings=True, hidden_act="gelu_tanh", norm="layernorm",
+        use_bias=True, mlp="plain", sliding_window=4096)
+
+
+def tiny(vocab_size: int = 256) -> LlamaConfig:
+    """Test-scale StarCoder2-shaped config (fake backend, SURVEY §4)."""
+    return LlamaConfig(
+        vocab_size=vocab_size, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        hidden_dim=128, head_dim=16, rope_theta=10000.0,
+        tie_embeddings=True, dtype="float32", hidden_act="gelu_tanh",
+        norm="layernorm", use_bias=True, mlp="plain", sliding_window=16)
+
+
+def params_from_hf(state_dict: Dict[str, Any], cfg: LlamaConfig) -> Params:
+    """Map a HF `Starcoder2ForCausalLM.state_dict()` into the stacked
+    layout (mirrors llama.params_from_hf; extra bias/norm-bias tensors)."""
+    import numpy as np
+
+    def t(name):
+        w = state_dict[name]
+        arr = w.detach().cpu().numpy() if hasattr(w, "detach") else np.asarray(w)
+        return jnp.asarray(arr, cfg.jdtype)
+
+    def lin(name):  # torch Linear: (out, in) -> (in, out)
+        return t(name).T
+
+    names = ("attn_norm", "attn_norm_b", "wq", "wq_b", "wk", "wk_b",
+             "wv", "wv_b", "wo", "wo_b", "mlp_norm", "mlp_norm_b",
+             "w_up", "w_up_b", "w_down", "w_down_b")
+    layers: Dict[str, list] = {k: [] for k in names}
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        layers["attn_norm"].append(t(p + "input_layernorm.weight"))
+        layers["attn_norm_b"].append(t(p + "input_layernorm.bias"))
+        layers["wq"].append(lin(p + "self_attn.q_proj.weight"))
+        layers["wq_b"].append(t(p + "self_attn.q_proj.bias"))
+        layers["wk"].append(lin(p + "self_attn.k_proj.weight"))
+        layers["wk_b"].append(t(p + "self_attn.k_proj.bias"))
+        layers["wv"].append(lin(p + "self_attn.v_proj.weight"))
+        layers["wv_b"].append(t(p + "self_attn.v_proj.bias"))
+        layers["wo"].append(lin(p + "self_attn.o_proj.weight"))
+        layers["wo_b"].append(t(p + "self_attn.o_proj.bias"))
+        layers["mlp_norm"].append(t(p + "post_attention_layernorm.weight"))
+        layers["mlp_norm_b"].append(t(p + "post_attention_layernorm.bias"))
+        layers["w_up"].append(lin(p + "mlp.c_fc.weight"))
+        layers["w_up_b"].append(t(p + "mlp.c_fc.bias"))
+        layers["w_down"].append(lin(p + "mlp.c_proj.weight"))
+        layers["w_down_b"].append(t(p + "mlp.c_proj.bias"))
+
+    params: Params = {
+        "embed": t("model.embed_tokens.weight"),
+        "layers": {k: jnp.stack(v) for k, v in layers.items()},
+        "final_norm": t("model.norm.weight"),
+        "final_norm_b": t("model.norm.bias"),
+    }
+    if not cfg.tie_embeddings:
+        key = "lm_head.weight"
+        params["lm_head"] = (t(key).T if key in state_dict
+                             else t("model.embed_tokens.weight").T)
+    return params

@@ -23,13 +23,15 @@ def mha_prefill(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                 q_positions: Optional[jnp.ndarray] = None,
                 kv_positions: Optional[jnp.ndarray] = None,
                 kv_mask: Optional[jnp.ndarray] = None,
-                causal: bool = True) -> jnp.ndarray:
+                causal: bool = True, window: int = 0) -> jnp.ndarray:
     """Full-sequence attention.
 
     q: (B, S, n_heads, hd); k,v: (B, T, n_kv, hd) with n_heads % n_kv == 0.
     q_positions/kv_positions: (B, S)/(B, T) absolute positions for causal
     masking when q is a suffix of the kv sequence (chunked prefill).
     kv_mask: (B, T) validity mask for right-padded kv.
+    window: sliding-window size (0 = full attention): a query at position p
+    attends to kv positions in (p - window, p] (StarCoder2-family).
     Returns (B, S, n_heads, hd).
     """
     B, S, H, D = q.shape
@@ -41,12 +43,17 @@ def mha_prefill(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     scores = jnp.einsum("bskgd,btkd->bkgst", qg.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
     mask = jnp.ones((B, 1, 1, S, T), dtype=bool)
-    if causal:
+    if causal or window:
         qp = q_positions if q_positions is not None else jnp.broadcast_to(
             jnp.arange(S)[None, :], (B, S))
         kp = kv_positions if kv_positions is not None else jnp.broadcast_to(
             jnp.arange(T)[None, :], (B, T))
-        mask = mask & (kp[:, None, None, None, :] <= qp[:, None, None, :, None])
+        if causal:
+            mask = mask & (kp[:, None, None, None, :]
+                           <= qp[:, None, None, :, None])
+        if window:
+            mask = mask & (kp[:, None, None, None, :]
+                           > qp[:, None, None, :, None] - window)
     if kv_mask is not None:
         mask = mask & kv_mask[:, None, None, None, :]
     scores = jnp.where(mask, scores, NEG_INF)
@@ -57,12 +64,13 @@ def mha_prefill(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 
 def mha_decode(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
-               lengths: jnp.ndarray) -> jnp.ndarray:
+               lengths: jnp.ndarray, window: int = 0) -> jnp.ndarray:
     """Single-token decode against a dense KV cache.
 
     q: (B, 1, n_heads, hd); k_cache,v_cache: (B, max_seq, n_kv, hd);
     lengths: (B,) number of valid cache entries (including the new token).
-    Returns (B, 1, n_heads, hd).
+    window: sliding-window size (0 = full): only the last ``window`` cache
+    entries participate. Returns (B, 1, n_heads, hd).
     """
     B, _, H, D = q.shape
     T, KV = k_cache.shape[1], k_cache.shape[2]
@@ -72,6 +80,8 @@ def mha_decode(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
     scores = jnp.einsum("bkgd,btkd->bkgt", qg.astype(jnp.float32),
                         k_cache.astype(jnp.float32)) * scale
     valid = jnp.arange(T)[None, :] < lengths[:, None]          # (B, T)
+    if window:
+        valid = valid & (jnp.arange(T)[None, :] >= lengths[:, None] - window)
     scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)

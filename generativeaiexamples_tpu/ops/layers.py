@@ -22,6 +22,27 @@ def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndar
     return (normed * weight.astype(jnp.float32)).astype(dtype)
 
 
+def layer_norm(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray,
+               eps: float = 1e-5) -> jnp.ndarray:
+    """Classic LayerNorm with affine weight+bias (StarCoder2-family blocks);
+    moments in f32, returns x.dtype."""
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(axis=-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(axis=-1, keepdims=True)
+    normed = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def activate(x: jnp.ndarray, act: str) -> jnp.ndarray:
+    """Ungated activation by name (plain MLPs: StarCoder2 c_fc→act→c_proj)."""
+    if act == "silu":
+        return silu(x)
+    if act == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    raise ValueError(f"unknown activation {act!r}")
+
+
 def silu(x: jnp.ndarray) -> jnp.ndarray:
     xf = x.astype(jnp.float32)
     return (xf * (1.0 / (1.0 + jnp.exp(-xf)))).astype(x.dtype)

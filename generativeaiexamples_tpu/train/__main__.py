@@ -20,7 +20,7 @@ import logging
 
 import jax
 
-from generativeaiexamples_tpu.models import gemma, llama
+from generativeaiexamples_tpu.models import gemma, llama, starcoder2
 from generativeaiexamples_tpu.train import checkpoints, data as data_lib, recipes
 from generativeaiexamples_tpu.train.trainer import Trainer
 
@@ -32,8 +32,11 @@ MODEL_CONFIGS = {
     "gemma-2b": gemma.gemma_2b,
     "gemma-7b": gemma.gemma_7b,
     "codegemma-7b": gemma.codegemma_7b,
+    "starcoder2-3b": starcoder2.starcoder2_3b,
+    "starcoder2-7b": starcoder2.starcoder2_7b,
     "tiny": llama.LlamaConfig.tiny,
     "tiny-gemma": gemma.tiny,
+    "tiny-starcoder2": starcoder2.tiny,
 }
 
 

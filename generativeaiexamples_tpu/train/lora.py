@@ -77,6 +77,13 @@ class LoraConfig:
 def init_adapters(rng: jax.Array, model_cfg: llama.LlamaConfig,
                   cfg: LoraConfig, dtype: Any = jnp.float32) -> Params:
     """Adapter pytree {target: {"a": (L, in, r), "b": (L, r, out)}}."""
+    if model_cfg.mlp != "glu" and "w_gate" in cfg.targets:
+        # fail at startup, not at merge time after the full training run:
+        # plain-MLP models (StarCoder2) have no w_gate to fold the adapter
+        # into (use the lora_starcoder2 recipe's target set)
+        raise ValueError(
+            f"LoRA target 'w_gate' does not exist in a mlp={model_cfg.mlp!r} "
+            "model; drop it from targets")
     L = model_cfg.n_layers
     scale = cfg.alpha / cfg.rank
     keys = jax.random.split(rng, len(cfg.targets))

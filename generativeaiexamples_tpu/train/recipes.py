@@ -54,6 +54,19 @@ RECIPES: Dict[str, TrainConfig] = {
                                               "w_gate", "w_up", "w_down")),
         micro_batch_size=1, global_batch_size=8, max_steps=50,
         learning_rate=2e-4, seq_len=2048),
+    # StarCoder2/lora.ipynb with the actual starcoder2 architecture
+    # (--model starcoder2-3b / tiny-starcoder2): plain-MLP targets only
+    "lora_starcoder2": TrainConfig(
+        mode="lora", lora=LoraConfig(rank=16, alpha=32.0,
+                                     targets=("wq", "wk", "wv", "wo",
+                                              "w_up", "w_down")),
+        micro_batch_size=1, global_batch_size=8, max_steps=50,
+        learning_rate=2e-4, seq_len=2048),
+    # finetuning/NeMo/slm: small-LM pretraining from scratch (full params,
+    # higher LR, longer schedule) then SFT via the other recipes
+    "slm_pretrain": TrainConfig(
+        mode="full", micro_batch_size=4, global_batch_size=32,
+        max_steps=1000, warmup_steps=100, learning_rate=3e-4, seq_len=1024),
     # test/demo-scale recipe (the suite's fast path)
     "demo": TrainConfig(
         mode="lora", lora=LoraConfig(rank=4, alpha=8.0),
