@@ -13,6 +13,14 @@ Each module registers a `BaseExample` with the server registry:
                        (ref advanced_rag/multimodal_rag)
   agentic_rag          self-corrective graph: grade→rewrite→regenerate
                        (ref notebooks/langchain/agentic_rag_with_nemo_retriever_nim.ipynb)
+  knowledge_graph_rag  LLM triple extraction → NetworkX graph → graph+dense RAG
+                       (ref community/knowledge_graph_rag)
+  text_to_sql          Vanna-style retrieval-augmented SQL over sqlite,
+                       read-only authorizer (ref asset_lifecycle vanna_util.py)
+  router_rag           route queries across KB / web seam / direct LLM
+                       (ref community/routing-multisource-rag/workflow.py)
+  bash_agent           allowlisted bash computer-use agent loop
+                       (ref nemotron/LLM/bash_computer_use_agent)
 
 All chains share `ChainContext` (engine + encoders + stores) so one process
 serves any example — the compose-file indirection of the reference collapses

@@ -29,6 +29,9 @@ _KNOWN = {
     "structured_data_rag": "generativeaiexamples_tpu.chains.structured_data",
     "multimodal_rag": "generativeaiexamples_tpu.chains.multimodal",
     "agentic_rag": "generativeaiexamples_tpu.chains.agentic_rag",
+    "knowledge_graph_rag": "generativeaiexamples_tpu.chains.knowledge_graph_rag",
+    "text_to_sql": "generativeaiexamples_tpu.chains.text_to_sql",
+    "router_rag": "generativeaiexamples_tpu.chains.router_rag",
 }
 
 

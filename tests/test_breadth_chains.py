@@ -1,0 +1,315 @@
+"""Breadth chains: knowledge-graph RAG, text-to-SQL, router RAG, streaming
+ingest pipeline, bash computer-use agent — capability ports of the
+reference's community/app layers (SURVEY §2.6). A scripted FakeLLM plays
+the model so control flow is deterministic; embeddings run on the real
+tiny TPU encoder."""
+
+import asyncio
+import json
+import os
+import sqlite3
+
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.chains.context import ChainContext
+from generativeaiexamples_tpu.core.config import get_config
+from generativeaiexamples_tpu.encoders.embedder import Embedder
+
+
+class FakeLLM:
+    def __init__(self, responses):
+        self.responses = list(responses)
+        self.calls = []
+
+    def chat(self, messages, **settings):
+        self.calls.append(messages)
+        text = self.responses.pop(0) if self.responses else "default answer"
+        mid = max(1, len(text) // 2)
+        yield text[:mid]
+        yield text[mid:]
+
+
+@pytest.fixture(scope="module")
+def embedder():
+    return Embedder()
+
+
+def make_ctx(responses, embedder):
+    return ChainContext(config=get_config(), llm=FakeLLM(responses),
+                        embedder=embedder)
+
+
+def write_doc(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+# ------------------------------------------------------- knowledge graph rag
+
+TRIPLES_REPLY = ("[('Nvidia', 'Company', 'Introduce', 'H100', 'Product'), "
+                 "('Nvidia', 'Company', 'Operate_In', 'Santa Clara', "
+                 "'Place'), ('BadRel', 'X', 'NotARelation', 'Y', 'Z')]")
+
+
+def test_kg_ingest_builds_graph_and_answers(tmp_path, embedder):
+    from generativeaiexamples_tpu.chains.knowledge_graph_rag import (
+        KnowledgeGraphRAG, parse_triples)
+
+    assert len(parse_triples(TRIPLES_REPLY)) == 2   # invalid relation dropped
+    assert parse_triples("no list here") == []
+
+    gpath = str(tmp_path / "kg.graphml")
+    ctx = make_ctx([TRIPLES_REPLY, "Nvidia introduced the H100."], embedder)
+    kg = KnowledgeGraphRAG(context=ctx, graph_path=gpath)
+    doc = write_doc(tmp_path, "news.txt",
+                    "Nvidia introduced the H100 GPU in Santa Clara.")
+    kg.ingest_docs(doc, "news.txt")
+    assert kg.graph.number_of_edges() == 2
+    assert os.path.exists(gpath)          # graphml persisted (KG_GRAPHML_PATH)
+
+    # lexical entity linking → graph context lines
+    lines = kg.graph_context("What did Nvidia introduce?")
+    assert any("Introduce" in l and "H100" in l for l in lines)
+
+    answer = "".join(kg.rag_chain("What did Nvidia introduce?", []))
+    assert answer == "Nvidia introduced the H100."
+    system = ctx.llm.calls[-1][0]["content"]
+    assert "H100" in system               # triples reached the prompt
+
+    # reload from graphml
+    kg2 = KnowledgeGraphRAG(context=make_ctx([], embedder), graph_path=gpath)
+    assert kg2.graph.number_of_edges() == 2
+
+    # a second relation between the same pair coexists (MultiDiGraph) and
+    # keeps per-source attribution
+    kg.graph.add_edge("Nvidia", "H100", relation="Produce", source="b.txt")
+    rels = {d["relation"] for _, _, d in kg.graph.edges("Nvidia", data=True)
+            if True}
+    assert {"Introduce", "Produce"} <= rels
+    kg.delete_documents(["b.txt"])
+    assert any(d["relation"] == "Introduce"
+               for _, _, d in kg.graph.edges(data=True))
+
+    # deleting the source prunes its edges and isolated nodes
+    kg.delete_documents(["news.txt"])
+    assert kg.graph.number_of_edges() == 0
+    assert kg.graph.number_of_nodes() == 0
+
+
+# --------------------------------------------------------------- text to sql
+
+@pytest.fixture()
+def sql_db(tmp_path):
+    path = str(tmp_path / "shop.db")
+    conn = sqlite3.connect(path)
+    conn.execute("CREATE TABLE orders (id INTEGER PRIMARY KEY, "
+                 "customer TEXT, total REAL)")
+    conn.executemany("INSERT INTO orders (customer, total) VALUES (?, ?)",
+                     [("ada", 10.0), ("bob", 32.5), ("ada", 7.5)])
+    conn.commit()
+    conn.close()
+    return path
+
+
+def test_text_to_sql_generates_and_runs(sql_db, embedder):
+    from generativeaiexamples_tpu.chains.text_to_sql import TextToSQL
+
+    sql = "SELECT customer, SUM(total) AS spend FROM orders GROUP BY customer"
+    # ask() consumes one SQL generation; rag_chain() generates again then
+    # summarizes — three scripted turns total
+    ctx = make_ctx([sql, sql, "Ada spent 17.5 in total."], embedder)
+    t2s = TextToSQL(context=ctx, db_path=sql_db)
+    assert t2s.auto_train_schema() >= 1      # DDL from sqlite_master
+    t2s.train(question="total per customer",
+              sql="SELECT customer, SUM(total) FROM orders GROUP BY customer")
+    t2s.train(documentation="The orders table records each purchase.")
+
+    result = t2s.ask("How much did each customer spend?")
+    assert result["columns"] == ["customer", "spend"]
+    assert ("ada", 17.5) in result["rows"]
+    # the retrieval-augmented prompt carried the schema
+    sys_prompt = ctx.llm.calls[0][0]["content"]
+    assert "CREATE TABLE orders" in sys_prompt
+
+    answer = "".join(t2s.rag_chain("How much did each customer spend?", []))
+    assert "17.5" in answer
+
+
+def test_text_to_sql_blocks_writes(sql_db, embedder):
+    from generativeaiexamples_tpu.chains.text_to_sql import (
+        TextToSQL, extract_sql)
+
+    t2s = TextToSQL(context=make_ctx([], embedder), db_path=sql_db)
+    with pytest.raises(sqlite3.Error):
+        t2s.run_sql("DROP TABLE orders")
+    with pytest.raises(sqlite3.Error):
+        t2s.run_sql("INSERT INTO orders (customer, total) VALUES ('x', 1)")
+    # table still intact
+    cols, rows = t2s.run_sql("SELECT COUNT(*) AS n FROM orders")
+    assert rows == [(3,)]
+
+    assert extract_sql("```sql\nSELECT 1;\n```") == "SELECT 1"
+    assert extract_sql("Here you go: SELECT a FROM b; -- done") == \
+        "SELECT a FROM b"
+    assert extract_sql("I cannot write that query") == ""
+    # semicolon inside a string literal must not truncate the statement
+    assert extract_sql("SELECT * FROM t WHERE c = 'a;b';") == \
+        "SELECT * FROM t WHERE c = 'a;b'"
+
+
+def test_text_to_sql_error_surfaces_politely(sql_db, embedder):
+    from generativeaiexamples_tpu.chains.text_to_sql import TextToSQL
+
+    ctx = make_ctx(["DELETE FROM orders"], embedder)   # hostile generation
+    t2s = TextToSQL(context=ctx, db_path=sql_db)
+    answer = "".join(t2s.rag_chain("wipe it", []))
+    assert "could not answer" in answer
+
+
+# ---------------------------------------------------------------- router rag
+
+def test_router_routes_and_synthesizes(tmp_path, embedder):
+    from generativeaiexamples_tpu.chains.router_rag import (
+        RouterRAG, WebSearchClient, parse_route)
+
+    assert parse_route("garbage") == {"sources": ["kb"], "rewritten": ""}
+    assert parse_route('{"sources": ["direct"], "rewritten": "x"}'
+                       )["sources"] == ["direct"]
+
+    class FakeWeb(WebSearchClient):
+        def search(self, query, max_results=3):
+            return [{"snippet": "TPU v5e has 197 TFLOP/s bf16 peak.",
+                     "url": "https://example.com/tpu"}]
+
+    ctx = make_ctx(
+        ['{"sources": ["kb", "web"], "rewritten": "tpu v5e peak flops"}',
+         "Per [web], the v5e peaks at 197 TFLOP/s."], embedder)
+    router = RouterRAG(context=ctx, web_client=FakeWeb())
+    doc = write_doc(tmp_path, "notes.txt",
+                    "Our cluster uses TPU v5e accelerators for serving.")
+    router.ingest_docs(doc, "notes.txt")
+
+    answer = "".join(router.rag_chain("What is the v5e peak?", []))
+    assert "197" in answer
+    system = ctx.llm.calls[-1][0]["content"]
+    assert "[web]" in system and "[kb]" in system   # both branches fused
+
+
+def test_router_direct_route_skips_retrieval(embedder):
+    from generativeaiexamples_tpu.chains.router_rag import RouterRAG
+
+    ctx = make_ctx(['{"sources": ["direct"], "rewritten": ""}',
+                    "Hello to you too!"], embedder)
+    router = RouterRAG(context=ctx)
+    answer = "".join(router.rag_chain("hi there", []))
+    assert answer == "Hello to you too!"
+    # no retrieval context in the final call
+    assert all(m.get("role") != "system" for m in ctx.llm.calls[-1])
+
+
+# ----------------------------------------------------------- streaming ingest
+
+def test_streaming_ingest_pipeline(tmp_path, embedder):
+    from generativeaiexamples_tpu.retrieval.streaming_ingest import (
+        StreamingIngestor, file_source, jsonl_source)
+
+    ctx = make_ctx([], embedder)
+    for i in range(3):
+        write_doc(tmp_path, f"doc{i}.txt",
+                  f"document number {i} about tpu serving. " * 30)
+    jl = tmp_path / "feed.jsonl"
+    jl.write_text("\n".join(
+        [json.dumps({"content": "kafka-style record about embeddings",
+                     "source": "topic:42", "collection": "feed"}),
+         "not json at all",
+         json.dumps({"content": "", "source": "empty"}),
+         json.dumps({"content": "second record on retrieval", })]))
+
+    ing = StreamingIngestor(embedder, ctx.store, ctx.splitter(),
+                            embed_batch=4, queue_depth=8)
+    stats = ing.run_sync([
+        file_source([str(tmp_path / "doc*.txt")]),
+        jsonl_source(str(jl), collection="feed"),
+    ])
+    assert stats.items == 5                  # 3 files + 2 valid records
+    assert stats.stored == stats.chunks > 0
+    assert stats.errors == 0
+
+    # a broken source must not lose the other sources' work or leak stages
+    ing2 = StreamingIngestor(embedder, ctx.store, ctx.splitter(),
+                             embed_batch=4)
+    stats2 = ing2.run_sync([
+        jsonl_source(str(tmp_path / "missing.jsonl")),
+        file_source([str(tmp_path / "doc0.txt")], collection="second"),
+    ])
+    assert stats2.errors == 1 and stats2.stored > 0
+    # resource tagging: jsonl records landed in their collection
+    hits = ctx.store("feed").search(
+        embedder.embed_queries(["kafka record"])[0], top_k=2)
+    assert hits
+    srcs = ctx.store("default").list_sources()
+    assert any("doc0.txt" in s for s in srcs)
+
+
+# ----------------------------------------------------------------- bash agent
+
+def test_bash_tool_allowlist_and_injection_guards(tmp_path):
+    from generativeaiexamples_tpu.chains.bash_agent import BashTool
+
+    tool = BashTool(root_dir=str(tmp_path))
+    (tmp_path / "hello.txt").write_text("hi from the sandbox")
+
+    out = tool.exec_bash_command("cat hello.txt")
+    assert out["stdout"].strip() == "hi from the sandbox"
+
+    assert "error" in tool.exec_bash_command("rm hello.txt")      # not allowed
+    assert "error" in tool.exec_bash_command("echo `whoami`")     # backtick
+    assert "error" in tool.exec_bash_command("echo $HOME")        # variable
+    assert "error" in tool.exec_bash_command("echo hi > f.txt")   # redirect
+    assert "error" in tool.exec_bash_command("ls && rm -rf /")    # compound
+    assert "error" in tool.exec_bash_command("ls & rm -rf /")     # background
+    assert "error" in tool.exec_bash_command("cat 'unclosed")     # unparseable
+
+    # cd tracks cwd without a shell
+    os.mkdir(tmp_path / "sub")
+    assert tool.exec_bash_command("cd sub")["cwd"].endswith("sub")
+    assert "error" in tool.exec_bash_command("cd nope")
+
+
+def test_bash_agent_loop_runs_tool_and_answers(tmp_path):
+    from generativeaiexamples_tpu.chains.bash_agent import BashAgent, BashTool
+
+    (tmp_path / "data.txt").write_text("alpha\nbeta\ngamma\n")
+    llm = FakeLLM([
+        json.dumps({"tool": "exec_bash_command", "cmd": "cat data.txt"}),
+        "The file has three lines: alpha, beta, gamma.",
+    ])
+    agent = BashAgent(llm, tool=BashTool(root_dir=str(tmp_path)),
+                      confirm=lambda cmd: True)
+    answer, transcript = agent.run("what is in data.txt?")
+    assert "three lines" in answer
+    assert transcript[0]["cmd"] == "cat data.txt"
+    assert "alpha" in transcript[0]["stdout"]
+    # tool result was fed back to the model
+    assert "alpha" in llm.calls[1][-1]["content"]
+
+
+def test_bash_agent_denies_by_default():
+    from generativeaiexamples_tpu.chains.bash_agent import BashAgent
+
+    llm = FakeLLM([
+        json.dumps({"tool": "exec_bash_command", "cmd": "ls"}),
+        "I was not allowed to run the command.",
+    ])
+    agent = BashAgent(llm)                      # no confirm policy
+    answer, transcript = agent.run("list files")
+    assert transcript[0]["error"] == "Execution declined by policy."
+
+
+def test_registry_knows_new_examples():
+    from generativeaiexamples_tpu.server import registry
+
+    for name in ("knowledge_graph_rag", "text_to_sql", "router_rag"):
+        assert name in registry._KNOWN
