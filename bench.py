@@ -136,9 +136,15 @@ def main() -> None:
         for _ in sched.iter_text(req):
             pass
 
-    # -- latency phase: load = slots, no queueing --------------------------
-    lat_reqs = [make_req(n) for n in lat_prompts]
-    _run_load(sched, lat_reqs)
+    # -- latency phase: load = slots, no queueing. Run it three times and
+    # report the median phase's p50: a single phase's TTFT swings ~2x on a
+    # remote-attached chip (measured 0.73-1.25 s for identical configs,
+    # pure tunnel jitter), and the driver runs this file exactly once.
+    lat_runs = []
+    for _ in range(3):
+        lat_reqs = [make_req(n) for n in lat_prompts]
+        _run_load(sched, lat_reqs)
+        lat_runs.append(lat_reqs)
 
     # -- throughput phase: 2x oversubscribed -------------------------------
     steps0 = REGISTRY.counter("decode_steps").value
@@ -147,15 +153,19 @@ def main() -> None:
     wall = _run_load(sched, thr_reqs)
     sched.stop()
 
-    errors = [r.error for r in lat_reqs + thr_reqs if r.error]
+    lat_all = [r for reqs in lat_runs for r in reqs]
+    errors = [r.error for r in lat_all + thr_reqs if r.error]
     if errors:
         print(json.dumps({"metric": "serving_bench_FAILED", "value": -1,
                           "unit": "error", "vs_baseline": 0,
                           "errors": errors[:3]}))
         sys.exit(1)
 
-    ttfts = sorted(r.first_token_at - r.submitted_at for r in lat_reqs)
-    ttft_p50 = statistics.median(ttfts)
+    phase_p50s = sorted(
+        statistics.median(r.first_token_at - r.submitted_at for r in reqs)
+        for reqs in lat_runs)
+    ttft_p50 = phase_p50s[len(phase_p50s) // 2]
+    ttfts = sorted(r.first_token_at - r.submitted_at for r in lat_all)
     gen_tokens = sum(r.completion_tokens for r in thr_reqs)
     prompt_tokens = sum(len(r.prompt_ids) for r in thr_reqs)
     decode_steps = REGISTRY.counter("decode_steps").value - steps0
@@ -192,6 +202,7 @@ def main() -> None:
         "unit": "s",
         "vs_baseline": round(TTFT_TARGET_S / ttft_p50, 3),
         "ttft_max_s": round(ttfts[-1], 4),
+        "ttft_p50_per_phase": [round(p, 4) for p in phase_p50s],
         "gen_tok_s_2x_load": round(tok_s, 1),
         "decode_steps": int(decode_steps),
         "batch_occupancy": round(occupancy, 3),

@@ -36,6 +36,14 @@ DEFAULT_ALLOWED = ("ls", "pwd", "cat", "head", "tail", "wc", "grep", "find",
                    "echo", "date", "whoami", "du", "df", "file", "stat",
                    "uname", "cd")
 
+# Flags that turn an allowlisted command into a write/exec primitive —
+# `find -delete` passes every other guard yet wipes the tree; `-exec`
+# escapes the allowlist entirely. Checked across ALL tokens of a command.
+DENIED_TOKENS = frozenset({
+    "-delete", "-exec", "-execdir", "-ok", "-okdir",
+    "-fprint", "-fprint0", "-fprintf", "-fls",     # find's file writers
+})
+
 SYSTEM_PROMPT = """\
 You are a careful computer-use assistant operating a bash shell.
 To run a command, reply with ONLY this JSON (no other text):
@@ -89,6 +97,14 @@ class BashTool:
         for word in self._split_commands(cmd):
             if word not in self.allowed_commands:
                 return {"error": f"Command {word!r} is not in the allowlist."}
+        try:
+            all_tokens = shlex.split(cmd)
+        except ValueError:
+            return {"error": "Unparseable command."}
+        denied = DENIED_TOKENS.intersection(all_tokens)
+        if denied:
+            return {"error": f"Flag {sorted(denied)[0]!r} is not allowed "
+                             "(write/exec primitive)."}
         # `cd` updates tracked cwd instead of spawning a shell
         tokens = shlex.split(cmd)
         if tokens[0] == "cd":

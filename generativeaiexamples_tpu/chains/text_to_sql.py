@@ -23,6 +23,7 @@ import re
 import sqlite3
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
+from generativeaiexamples_tpu.chains.basic_rag import _sampling
 from generativeaiexamples_tpu.chains.context import ChainContext, get_context
 from generativeaiexamples_tpu.core.tracing import chain_instrumentation
 from generativeaiexamples_tpu.retrieval.store import Document
@@ -210,8 +211,7 @@ class TextToSQL(BaseExample):
                   **llm_settings: Any) -> Iterator[str]:
         yield from self.ctx.llm.chat(
             list(chat_history) + [{"role": "user", "content": query}],
-            max_tokens=int(llm_settings.get("max_tokens", 256)),
-            temperature=float(llm_settings.get("temperature", 0.2)))
+            **_sampling(llm_settings))
 
     @chain_instrumentation
     def rag_chain(self, query: str, chat_history: Sequence[Dict[str, str]],
@@ -226,10 +226,10 @@ class TextToSQL(BaseExample):
         summary = SUMMARY_PROMPT.format(
             question=query, sql=result["sql"], columns=result["columns"],
             rows=result["rows"][:10], n=min(10, len(result["rows"])))
+        settings = _sampling(llm_settings)
+        settings["temperature"] = 0.0     # factual summarization stays greedy
         yield from self.ctx.llm.chat(
-            [{"role": "user", "content": summary}],
-            max_tokens=int(llm_settings.get("max_tokens", 256)),
-            temperature=0.0)
+            [{"role": "user", "content": summary}], **settings)
 
     def ingest_docs(self, filepath: str, filename: str) -> None:
         """Uploaded files become documentation training data."""

@@ -36,10 +36,14 @@ _STOP = object()
 
 @dataclasses.dataclass
 class SourceItem:
-    """One unit of raw content entering the pipeline."""
+    """One unit of raw content entering the pipeline. Sources report their
+    per-item failures as data (``error`` set, empty content) so the central
+    stats see every dropped document — a source generator has no other
+    channel to the ingestor's accounting."""
     content: str
     source: str                      # provenance label (filename, url, topic)
     collection: str = "default"      # resource tag (vdb_resource_tagging)
+    error: str = ""                  # non-empty = failed item (counted, skipped)
 
 
 @dataclasses.dataclass
@@ -64,6 +68,8 @@ async def file_source(paths: Sequence[str],
                 text = await asyncio.to_thread(load_document, path)
             except Exception as exc:
                 logger.warning("source %s failed: %s", path, exc)
+                yield SourceItem(content="", source=path,
+                                 collection=collection, error=str(exc))
                 continue
             if text.strip():
                 yield SourceItem(content=text, source=path,
@@ -85,8 +91,10 @@ async def jsonl_source(path: str, content_key: str = "content",
             continue
         try:
             obj = json.loads(line)
-        except json.JSONDecodeError:
+        except json.JSONDecodeError as exc:
             logger.warning("%s:%d not valid json; skipped", path, i + 1)
+            yield SourceItem(content="", source=f"{path}:{i + 1}",
+                             collection=collection, error=str(exc))
             continue
         content = str(obj.get(content_key, ""))
         if content.strip():
@@ -116,7 +124,9 @@ class StreamingIngestor:
 
     async def run(self, sources: Sequence[AsyncIterator[SourceItem]]
                   ) -> IngestStats:
-        """Run all sources to exhaustion through the staged pipeline."""
+        """Run all sources to exhaustion through the staged pipeline.
+        Stats are per-run (a reused ingestor starts from zero each time)."""
+        self.stats = IngestStats()
         t0 = time.perf_counter()
         chunk_q: asyncio.Queue = asyncio.Queue(self.queue_depth)
         embed_q: asyncio.Queue = asyncio.Queue(self.queue_depth)
@@ -126,6 +136,9 @@ class StreamingIngestor:
             # pipeline down with it — count it and let the others drain
             try:
                 async for item in src:
+                    if item.error:
+                        self.stats.errors += 1
+                        continue
                     self.stats.items += 1
                     await chunk_q.put(item)
             except Exception as exc:
@@ -175,8 +188,16 @@ class StreamingIngestor:
                             for i in idxs]
                     sel = (embs[idxs] if isinstance(embs, np.ndarray)
                            else np.stack([np.asarray(embs[i]) for i in idxs]))
-                    await asyncio.to_thread(
-                        self.store_factory(coll).add, docs, sel)
+                    # a failing store (dim mismatch, disk full, dead remote)
+                    # must not kill the stage: under backpressure a dead
+                    # consumer deadlocks every upstream put()
+                    try:
+                        await asyncio.to_thread(
+                            self.store_factory(coll).add, docs, sel)
+                    except Exception as exc:
+                        self.stats.errors += len(idxs)
+                        logger.warning("store %s add failed: %s", coll, exc)
+                        continue
                     self.stats.stored += len(idxs)
                 batch.clear()
 

@@ -235,16 +235,15 @@ def test_streaming_ingest_pipeline(tmp_path, embedder):
     ])
     assert stats.items == 5                  # 3 files + 2 valid records
     assert stats.stored == stats.chunks > 0
-    assert stats.errors == 0
+    assert stats.errors == 1                 # the bad jsonl line is counted
 
-    # a broken source must not lose the other sources' work or leak stages
-    ing2 = StreamingIngestor(embedder, ctx.store, ctx.splitter(),
-                             embed_batch=4)
-    stats2 = ing2.run_sync([
+    # a broken source must not lose the other sources' work or leak stages,
+    # and a REUSED ingestor's stats start from zero (no double counting)
+    stats2 = ing.run_sync([
         jsonl_source(str(tmp_path / "missing.jsonl")),
         file_source([str(tmp_path / "doc0.txt")], collection="second"),
     ])
-    assert stats2.errors == 1 and stats2.stored > 0
+    assert stats2.errors == 1 and stats2.items == 1 and stats2.stored > 0
     # resource tagging: jsonl records landed in their collection
     hits = ctx.store("feed").search(
         embedder.embed_queries(["kafka record"])[0], top_k=2)
@@ -271,6 +270,10 @@ def test_bash_tool_allowlist_and_injection_guards(tmp_path):
     assert "error" in tool.exec_bash_command("ls && rm -rf /")    # compound
     assert "error" in tool.exec_bash_command("ls & rm -rf /")     # background
     assert "error" in tool.exec_bash_command("cat 'unclosed")     # unparseable
+    # allowlisted lead word with a write/exec flag must still be blocked
+    assert "error" in tool.exec_bash_command("find . -delete")
+    assert "error" in tool.exec_bash_command("find . -exec rm {} +")
+    assert "stdout" in tool.exec_bash_command("find . -name hello.txt")
 
     # cd tracks cwd without a shell
     os.mkdir(tmp_path / "sub")
