@@ -106,8 +106,8 @@ class BashTool:
             return {"error": f"Flag {sorted(denied)[0]!r} is not allowed "
                              "(write/exec primitive)."}
         # `cd` updates tracked cwd instead of spawning a shell
-        tokens = shlex.split(cmd)
-        if tokens[0] == "cd":
+        tokens = all_tokens
+        if tokens and tokens[0] == "cd":
             target = os.path.abspath(os.path.join(
                 self.cwd, tokens[1] if len(tokens) > 1 else "."))
             if not os.path.isdir(target):
@@ -131,13 +131,10 @@ class BashTool:
 def parse_tool_call(text: str) -> Optional[str]:
     """Extract a {"tool": "exec_bash_command", "cmd": ...} call; None means
     the reply is a final answer."""
-    match = re.search(r"\{.*\}", text, re.DOTALL)
-    if not match:
-        return None
-    try:
-        obj = json.loads(match.group())
-    except json.JSONDecodeError:
-        return None
+    from generativeaiexamples_tpu.chains.query_decomposition import (
+        extract_json)
+
+    obj = extract_json(text)
     if (isinstance(obj, dict) and obj.get("tool") == "exec_bash_command"
             and isinstance(obj.get("cmd"), str)):
         return obj["cmd"]

@@ -67,17 +67,19 @@ class WebSearchClient:
 
 def parse_route(text: str) -> Dict[str, Any]:
     """Defensive parse of the routing JSON; degrade to the KB route."""
-    match = re.search(r"\{.*\}", text, re.DOTALL)
-    if match:
+    from generativeaiexamples_tpu.chains.query_decomposition import (
+        extract_json)
+
+    obj = extract_json(text)
+    if isinstance(obj, dict):
         try:
-            obj = json.loads(match.group())
             sources = [s for s in obj.get("sources", [])
                        if s in ("kb", "web", "direct")]
-            if sources:
-                return {"sources": sources,
-                        "rewritten": str(obj.get("rewritten", "")).strip()}
-        except (json.JSONDecodeError, AttributeError, TypeError):
-            pass
+        except TypeError:
+            sources = []
+        if sources:
+            return {"sources": sources,
+                    "rewritten": str(obj.get("rewritten", "")).strip()}
     return {"sources": ["kb"], "rewritten": ""}
 
 
