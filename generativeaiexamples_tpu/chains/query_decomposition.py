@@ -48,14 +48,30 @@ _OPS = {
 
 
 def extract_json(text: str) -> Optional[Dict[str, Any]]:
-    """First balanced JSON object in `text` (models wrap JSON in prose)."""
+    """First balanced JSON object in `text` (models wrap JSON in prose).
+
+    The brace counter is string-aware: braces inside string values (e.g. a
+    bash agent's ``{"cmd": "grep '}' src.c"}``) must not close the scan."""
     start = text.find("{")
     while start != -1:
         depth = 0
+        in_string = False
+        escaped = False
         for i in range(start, len(text)):
-            if text[i] == "{":
+            ch = text[i]
+            if in_string:
+                if escaped:
+                    escaped = False
+                elif ch == "\\":
+                    escaped = True
+                elif ch == '"':
+                    in_string = False
+                continue
+            if ch == '"':
+                in_string = True
+            elif ch == "{":
                 depth += 1
-            elif text[i] == "}":
+            elif ch == "}":
                 depth -= 1
                 if depth == 0:
                     try:

@@ -17,9 +17,7 @@ output degrades to the KB route (never a dead end).
 
 from __future__ import annotations
 
-import json
 import logging
-import re
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 from generativeaiexamples_tpu.chains.basic_rag import _sampling, trim_context

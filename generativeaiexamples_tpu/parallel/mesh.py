@@ -82,3 +82,113 @@ def parse_mesh_shape(spec: str, axes: Tuple[str, ...] = INFER_AXES) -> MeshConfi
     if len(dims) != len(axes):
         raise ValueError(f"mesh spec {spec!r} has {len(dims)} dims for axes {axes}")
     return MeshConfig(axes=axes, shape=dims)
+
+
+# ---------------------------------------------------------------------------
+# Multi-host / multi-slice (DCN) support
+# ---------------------------------------------------------------------------
+
+def initialize_distributed(coordinator_address: str = "",
+                           num_processes: int = 0,
+                           process_id: int = -1) -> bool:
+    """Bring up the multi-host JAX runtime (the NCCL-world replacement for
+    cross-host serving/training — SURVEY §5.8: XLA collectives over ICI
+    within a slice and DCN across slices replace NCCL entirely).
+
+    On TPU pods `jax.distributed.initialize()` self-discovers everything;
+    elsewhere the coordinator triple comes from the arguments or the
+    standard env vars (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID). A single-process run (nothing configured) is a no-op
+    returning False, so the same entrypoints serve laptop and pod.
+    """
+    import os
+
+    coordinator_address = (coordinator_address
+                           or os.environ.get("JAX_COORDINATOR_ADDRESS", ""))
+    on_tpu_pod = (os.environ.get("TPU_WORKER_HOSTNAMES")
+                  or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))
+    if not coordinator_address and not on_tpu_pod:
+        return False
+    kwargs = {}
+    if coordinator_address:
+        num_processes = (num_processes or
+                         int(os.environ.get("JAX_NUM_PROCESSES", "0")))
+        process_id = (process_id if process_id >= 0 else
+                      int(os.environ.get("JAX_PROCESS_ID", "-1")))
+        if num_processes < 1 or process_id < 0:
+            # defaulting to a world of size 1 would turn a half-configured
+            # N-host launch into N silent independent replicas
+            raise ValueError(
+                "JAX_COORDINATOR_ADDRESS is set but JAX_NUM_PROCESSES / "
+                "JAX_PROCESS_ID are not — a multi-host launch must state "
+                "its world size explicitly")
+        kwargs = {"coordinator_address": coordinator_address,
+                  "num_processes": num_processes, "process_id": process_id}
+    jax.distributed.initialize(**kwargs)
+    return True
+
+
+def _default_slice_id(device) -> int:
+    """Which DCN island a device belongs to: TPU slices expose
+    ``slice_index``; everything else degrades to the owning process."""
+    sid = getattr(device, "slice_index", None)
+    return sid if sid is not None else device.process_index
+
+
+def create_hybrid_mesh(axes: Tuple[str, ...],
+                       ici_shape: Tuple[int, ...],
+                       dcn_shape: Tuple[int, ...],
+                       devices: Optional[Sequence[jax.Device]] = None,
+                       slice_id_fn=None) -> Mesh:
+    """Mesh spanning multiple ICI slices joined by DCN.
+
+    Per mesh axis ``i`` the global extent is ``dcn_shape[i] *
+    ici_shape[i]`` with DCN-major ordering, so a collective along an axis
+    whose ``dcn_shape`` entry is 1 NEVER crosses the data-center network —
+    the scaling-book recipe: put ``data`` (one gradient all-reduce per
+    step) across DCN, keep ``tensor``/``seq``/``fsdp`` (per-layer
+    activation collectives) inside a slice. Rule tables (sharding.py) work
+    unchanged: axis names don't change, only the device placement does.
+
+    ``slice_id_fn`` exists for CPU-simulated tests (virtual devices carry
+    no slice_index); production uses the devices' own topology metadata.
+    """
+    if len(axes) != len(ici_shape) or len(axes) != len(dcn_shape):
+        raise ValueError(f"axes {axes} vs ici {ici_shape} / dcn {dcn_shape} "
+                         "rank mismatch")
+    devices = list(devices if devices is not None else jax.devices())
+    auto = (jax.sharding.AxisType.Auto,) * len(axes)
+    if slice_id_fn is None:
+        # real hardware: prefer mesh_utils' topology-aware construction
+        # (intra-slice ICI adjacency), same DCN-major axis semantics; fall
+        # through to the explicit grouping only when devices lack topology
+        # metadata (CPU simulation) or the shapes don't match its model
+        try:
+            from jax.experimental import mesh_utils
+
+            arr = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices)
+            return Mesh(arr, axes, axis_types=auto)
+        except Exception:
+            pass
+    slice_id_fn = slice_id_fn or _default_slice_id
+    slices: dict = {}
+    for d in devices:
+        slices.setdefault(slice_id_fn(d), []).append(d)
+    n_slices = math.prod(dcn_shape)
+    per_slice = math.prod(ici_shape)
+    if len(slices) != n_slices:
+        raise ValueError(f"dcn shape {dcn_shape} needs {n_slices} slices, "
+                         f"devices form {len(slices)}")
+    sizes = {len(v) for v in slices.values()}
+    if sizes != {per_slice}:
+        raise ValueError(f"ici shape {ici_shape} needs {per_slice} devices "
+                         f"per slice, slices have {sorted(sizes)}")
+    # (*dcn_shape, *ici_shape) with slices DCN-major, then interleave the
+    # per-axis (dcn_i, ici_i) dim pairs and fuse them
+    ordered = [d for sid in sorted(slices) for d in slices[sid]]
+    arr = np.asarray(ordered, dtype=object).reshape(*dcn_shape, *ici_shape)
+    n = len(axes)
+    arr = arr.transpose(*(p for i in range(n) for p in (i, n + i)))
+    arr = arr.reshape(tuple(dcn_shape[i] * ici_shape[i] for i in range(n)))
+    return Mesh(arr, axes, axis_types=auto)

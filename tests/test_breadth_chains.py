@@ -299,6 +299,20 @@ def test_bash_agent_loop_runs_tool_and_answers(tmp_path):
     assert "alpha" in llm.calls[1][-1]["content"]
 
 
+def test_parse_tool_call_braces_inside_strings():
+    """A command containing braces (grep '}' / awk '{print}') must parse —
+    the balanced-brace scan is string-aware."""
+    from generativeaiexamples_tpu.chains.bash_agent import parse_tool_call
+
+    assert parse_tool_call(
+        '{"tool": "exec_bash_command", "cmd": "grep \'}\' src.c"}'
+    ) == "grep '}' src.c"
+    assert parse_tool_call(
+        'Sure: {"tool": "exec_bash_command", "cmd": "echo {a}"} done'
+    ) == "echo {a}"
+    assert parse_tool_call("no json here") is None
+
+
 def test_bash_agent_denies_by_default():
     from generativeaiexamples_tpu.chains.bash_agent import BashAgent
 
