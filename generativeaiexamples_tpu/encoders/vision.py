@@ -250,3 +250,95 @@ class MultimodalIndex:
         qvec = self.embedder.embed_texts([query])[0]
         return self.store.search(qvec, top_k=top_k,
                                  score_threshold=score_threshold)
+
+
+class FewShotClassifier:
+    """Few-shot image classification over the vision tower's embedding
+    space (parity: the NV-DINOv2 few-shot workflow, ref
+    vision_workflows/README.md:39-41 — label a handful of examples per
+    class, classify by embedding similarity; no training loop).
+
+    Prototype mode averages each class's (normalized) example embeddings —
+    one matmul per batch of queries against the class matrix, so the whole
+    classifier is a single TPU GEMM. A kNN mode keeps every example for
+    irregular class shapes.
+    """
+
+    def __init__(self, embedder: Optional[ImageEmbedder] = None,
+                 mode: str = "prototype", k: int = 5) -> None:
+        if mode not in ("prototype", "knn"):
+            raise ValueError(f"unknown mode {mode!r}")
+        self.embedder = embedder or ImageEmbedder()
+        self.mode = mode
+        self.k = k
+        self._examples: List[Tuple[str, np.ndarray]] = []
+        self._matrix_cache = None   # (labels, matrix[, example labels])
+
+    def add_examples(self, label: str, images: Sequence[bytes]) -> int:
+        emb = self.embedder.embed_images(images)
+        kept = 0
+        for row in emb:
+            if row.any():
+                self._examples.append((label, row / np.linalg.norm(row)))
+                kept += 1
+        if kept:
+            self._matrix_cache = None
+        return kept
+
+    def _matrices(self):
+        """Stacked class/example matrices, rebuilt only when examples
+        change — classify() stays one GEMM per batch, not a per-request
+        Python reduction over the example list."""
+        if self._matrix_cache is None:
+            labels = self.labels
+            if self.mode == "prototype":
+                protos = np.stack([
+                    np.mean([e for l, e in self._examples if l == lab],
+                            axis=0)
+                    for lab in labels])
+                protos = protos / np.clip(
+                    np.linalg.norm(protos, axis=1, keepdims=True), 1e-9,
+                    None)
+                self._matrix_cache = (labels, protos)
+            else:
+                ex_mat = np.stack([e for _, e in self._examples])
+                ex_lab = [l for l, _ in self._examples]
+                self._matrix_cache = (labels, ex_mat, ex_lab)
+        return self._matrix_cache
+
+    @property
+    def labels(self) -> List[str]:
+        return sorted({l for l, _ in self._examples})
+
+    def classify(self, images: Sequence[bytes]
+                 ) -> List[Tuple[str, float]]:
+        """(label, confidence) per image; confidence is the winning cosine
+        (prototype) or the winning class's mean top-k cosine (knn)."""
+        if not self._examples:
+            raise ValueError("no labeled examples added")
+        q = self.embedder.embed_images(images)
+        # undecodable images embed to zero; label them "" rather than
+        # silently winning the alphabetically-first class at cosine 0
+        valid = np.asarray([bool(row.any()) for row in q])
+        q = q / np.clip(np.linalg.norm(q, axis=1, keepdims=True), 1e-9, None)
+        if self.mode == "prototype":
+            labels, protos = self._matrices()
+            sims = q @ protos.T                       # (B, n_classes)
+            best = np.argmax(sims, axis=1)
+            return [(labels[b], float(sims[i, b])) if valid[i] else ("", 0.0)
+                    for i, b in enumerate(best)]
+        labels, ex_mat, ex_lab = self._matrices()
+        sims = q @ ex_mat.T                           # (B, n_examples)
+        out = []
+        for i, row in enumerate(sims):
+            if not valid[i]:
+                out.append(("", 0.0))
+                continue
+            scores = {}
+            for lab in labels:
+                lab_sims = sorted((row[j] for j in range(len(ex_lab))
+                                   if ex_lab[j] == lab), reverse=True)
+                scores[lab] = float(np.mean(lab_sims[: self.k]))
+            best = max(scores, key=scores.get)
+            out.append((best, scores[best]))
+        return out

@@ -1,0 +1,91 @@
+"""Device-side profiling hooks — jax.profiler traces as the TPU analogue of
+the reference's span-based observability (SURVEY §5.1: "same OTel span model
+in the serving layer + jax.profiler traces (Perfetto/TensorBoard) for
+device-side profiling").
+
+Spans (observability/otel.py) explain *where a request spent time* across
+the pipeline; these traces explain *what the chip did* during that time —
+XLA op timelines, HBM pressure, fusion boundaries. Two entry points:
+
+  * `profile_trace(log_dir)` — context manager around any region (a bench
+    phase, one engine dispatch, an ingest batch); writes a TensorBoard/
+    Perfetto-loadable trace directory.
+  * `start_server(port)` — the live sampling endpoint TensorBoard's profile
+    plugin connects to (`localhost:<port>`), for profiling a serving
+    process under real load without code changes.
+
+Both are thin but load-bearing: they gate every use behind availability
+checks so CPU-only test environments and stripped jax builds degrade to
+no-ops with a log line instead of crashing the serving path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import time
+from typing import Iterator, Optional
+
+logger = logging.getLogger(__name__)
+
+_server_started = False
+
+
+def start_server(port: int = 9012) -> bool:
+    """Start jax's profiler server once per process; TensorBoard's profile
+    plugin (or `xprof`) captures from it on demand."""
+    global _server_started
+    if _server_started:
+        return True
+    try:
+        import jax
+
+        jax.profiler.start_server(port)
+    except Exception as exc:  # stripped builds / port in use
+        logger.warning("profiler server unavailable: %s", exc)
+        return False
+    _server_started = True
+    logger.info("jax profiler server listening on localhost:%d", port)
+    return True
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str, host_tracer_level: int = 2
+                  ) -> Iterator[Optional[str]]:
+    """Trace the enclosed region into ``log_dir`` (TensorBoard: point the
+    profile plugin at it; Perfetto: load the .trace.json.gz inside).
+
+    Yields the concrete trace directory (timestamped, one per entry) or
+    None when tracing is unavailable — callers never need their own guard.
+    """
+    try:
+        import jax
+
+        run_dir = os.path.join(log_dir, time.strftime("trace_%Y%m%d_%H%M%S"))
+        jax.profiler.start_trace(run_dir,
+                                 create_perfetto_trace=False)
+    except Exception as exc:
+        logger.warning("profiler trace unavailable: %s", exc)
+        yield None
+        return
+    try:
+        yield run_dir
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception as exc:
+            logger.warning("profiler stop failed: %s", exc)
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named sub-region inside an active trace (shows up as a track event
+    on the device timeline) — the device-side sibling of an OTel span."""
+    try:
+        import jax
+
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    except Exception:
+        yield

@@ -26,6 +26,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+_distributed_initialized = False
+
 INFER_AXES: Tuple[str, ...] = ("data", "tensor")
 TRAIN_AXES: Tuple[str, ...] = ("data", "fsdp", "tensor")
 LONGCTX_AXES: Tuple[str, ...] = ("data", "seq", "tensor")
@@ -100,9 +102,14 @@ def initialize_distributed(coordinator_address: str = "",
     standard env vars (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
     JAX_PROCESS_ID). A single-process run (nothing configured) is a no-op
     returning False, so the same entrypoints serve laptop and pod.
+    Idempotent: a second call (two entrypoints bootstrapping the same
+    process) returns True instead of tripping jax's only-once guard.
     """
     import os
 
+    global _distributed_initialized
+    if _distributed_initialized:
+        return True
     coordinator_address = (coordinator_address
                            or os.environ.get("JAX_COORDINATOR_ADDRESS", ""))
     on_tpu_pod = (os.environ.get("TPU_WORKER_HOSTNAMES")
@@ -125,6 +132,7 @@ def initialize_distributed(coordinator_address: str = "",
         kwargs = {"coordinator_address": coordinator_address,
                   "num_processes": num_processes, "process_id": process_id}
     jax.distributed.initialize(**kwargs)
+    _distributed_initialized = True
     return True
 
 
@@ -169,8 +177,14 @@ def create_hybrid_mesh(axes: Tuple[str, ...],
             arr = mesh_utils.create_hybrid_device_mesh(
                 ici_shape, dcn_shape, devices=devices)
             return Mesh(arr, axes, axis_types=auto)
-        except Exception:
-            pass
+        except Exception as exc:
+            # the fallback grouping is correct but topology-unaware
+            # (intra-slice order = enumeration order); on a real pod that
+            # costs ICI hops, so the degradation must be visible
+            import logging
+            logging.getLogger(__name__).warning(
+                "mesh_utils hybrid construction unavailable (%s); using "
+                "slice-grouped fallback placement", exc)
     slice_id_fn = slice_id_fn or _default_slice_id
     slices: dict = {}
     for d in devices:
