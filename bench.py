@@ -87,6 +87,45 @@ def _run_load(sched, reqs) -> float:
     return time.perf_counter() - t0
 
 
+def _measure_lora_tok_s(on_tpu: bool) -> float:
+    """A few timed LoRA steps (frozen base + adapters, the train/trainer.py
+    path): tokens consumed per second on this chip. Kept small — one
+    compile + 3 timed steps — so the driver's bench stays bounded."""
+    import numpy as np
+
+    from generativeaiexamples_tpu.train import data as data_lib
+    from generativeaiexamples_tpu.train.lora import LoraConfig
+    from generativeaiexamples_tpu.train.trainer import TrainConfig, Trainer
+
+    if on_tpu:
+        model_cfg = llama.LlamaConfig(
+            vocab_size=32000, dim=2048, n_layers=24, n_heads=16,
+            n_kv_heads=8, hidden_dim=5632, head_dim=128,
+            tie_embeddings=True, dtype="bfloat16")   # ~1.7B-class
+        tcfg = TrainConfig(mode="lora", lora=LoraConfig(rank=8),
+                           micro_batch_size=2, global_batch_size=4,
+                           max_steps=4, warmup_steps=1, seq_len=512)
+    else:
+        model_cfg = llama.LlamaConfig.tiny()
+        tcfg = TrainConfig(mode="lora", lora=LoraConfig(rank=4),
+                           micro_batch_size=2, global_batch_size=4,
+                           max_steps=4, warmup_steps=1, seq_len=64)
+    params = llama.init_params(jax.random.PRNGKey(1), model_cfg)
+    trainer = Trainer(model_cfg, tcfg, params)
+    rng = np.random.RandomState(0)
+    batch = data_lib.Batch(
+        tokens=rng.randint(1, model_cfg.vocab_size,
+                           (tcfg.global_batch_size, tcfg.seq_len + 1)
+                           ).astype(np.int32),
+        loss_mask=np.ones((tcfg.global_batch_size, tcfg.seq_len + 1),
+                          np.float32))
+    trainer.fit([batch])                     # compile + 1 step
+    t0 = time.perf_counter()
+    trainer.fit([batch] * 3)
+    wall = time.perf_counter() - t0
+    return 3 * tcfg.global_batch_size * tcfg.seq_len / wall
+
+
 def main() -> None:
     import os
     on_tpu = jax.default_backend() == "tpu"
@@ -114,6 +153,11 @@ def main() -> None:
         lat_prompts = [24] * 4
         thr_prompts = [24] * 6 + [70] * 2
         max_tokens, warm_lens = 8, (24, 70)
+
+    # -- LoRA fine-tuning throughput (BASELINE's second metric: tok/s/chip)
+    # measured BEFORE the engine exists so trainer buffers are freed before
+    # the serving phases allocate the KV pool.
+    lora_tok_s = _measure_lora_tok_s(on_tpu)
 
     tok = ByteTokenizer()
     params = llama.init_params(jax.random.PRNGKey(0), model_cfg)
@@ -208,6 +252,7 @@ def main() -> None:
         "batch_occupancy": round(occupancy, 3),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "hbm_weight_read_util": round(bw_util, 4) if bw_util is not None else None,
+        "lora_tok_s_chip": round(lora_tok_s, 1),
         "device": str(jax.devices()[0]),
     }))
 

@@ -60,8 +60,32 @@ def _chain_chunk(rid: str, content: str, finish_reason: Optional[str] = None) ->
 
 
 class ChainServer:
-    def __init__(self, example: BaseExample) -> None:
+    def __init__(self, example: BaseExample, guardrails=None) -> None:
         self.example = example
+        # opt-in colang-style rails (server/guardrails.py): built from
+        # APP_GUARDRAILS_CONFIG when the caller didn't inject their own
+        self.guardrails = guardrails
+        if self.guardrails is None:
+            import os
+
+            rails_path = os.environ.get("APP_GUARDRAILS_CONFIG", "")
+            if rails_path:
+                from generativeaiexamples_tpu.server.guardrails import (
+                    from_config)
+
+                ctx = getattr(example, "ctx", None)
+                if ctx is not None:
+                    scrub = os.environ.get("APP_GUARDRAILS_SCRUB", "")
+                    self.guardrails = from_config(
+                        rails_path, ctx.embedder, ctx.llm,
+                        enable_fact_check=os.environ.get(
+                            "APP_GUARDRAILS_FACT_CHECK", "").lower()
+                            in ("1", "true", "yes"),
+                        scrub_patterns=[p for p in scrub.split("||") if p])
+                else:
+                    logger.warning(
+                        "APP_GUARDRAILS_CONFIG set but the example has no "
+                        "ctx (embedder/llm); rails disabled")
         self.app = web.Application(client_max_size=128 * 1024 * 1024)
         self.app.add_routes([
             web.get("/health", health_handler),
@@ -108,8 +132,26 @@ class ChainServer:
         await resp.prepare(request)
 
         def guarded():
+            # runs on the StreamDrain reader thread: rails' device work
+            # (intent embedding) must not block the event loop, and a rails
+            # failure must yield the canned error inside a valid SSE stream
             try:
+                if self.guardrails is not None:
+                    canned = self.guardrails.check_input(query)
+                    if canned is not None:
+                        REGISTRY.counter("guardrails_input_blocks").inc()
+                        yield canned
+                        return
                 chain = (self.example.rag_chain if use_kb else self.example.llm_chain)
+                if (self.guardrails is not None
+                        and self.guardrails.has_output_rails):
+                    # output rails (fact-check / scrub) need the complete
+                    # answer: buffer, check, emit once — rails trade
+                    # streaming latency for verification by design
+                    answer = "".join(chain(query, history, **settings))
+                    context = self._rails_context(query) if use_kb else ""
+                    yield self.guardrails.check_output(answer, context, query)
+                    return
                 yield from chain(query, history, **settings)
             except Exception:  # canned error message (ref :380-392)
                 logger.exception("generation failed")
@@ -128,6 +170,20 @@ class ChainServer:
         await resp.write_eof()
         REGISTRY.histogram("e2e_latency_s").observe(time.perf_counter() - t_start)
         return resp
+
+    def _rails_context(self, query: str) -> str:
+        """Retrieved evidence for the fact-check rail (the oran app passes
+        its own retrieval results as [[CONTEXT]]); examples without
+        document_search fact-check against nothing (rail skips)."""
+        search = getattr(self.example, "document_search", None)
+        if search is None or self.guardrails.fact_check is None:
+            return ""
+        try:
+            hits = search(query)
+            return "\n\n".join(str(h.get("content", "")) for h in hits)
+        except Exception:
+            logger.exception("rails context retrieval failed")
+            return ""
 
     # -------------------------------------------------------------- search
 

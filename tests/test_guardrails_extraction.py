@@ -1,0 +1,269 @@
+"""Guardrails (colang flows, intent/regex/fact-check rails, server wiring)
+and structured text extraction (schema validation + retry)."""
+
+import json
+
+import pytest
+
+from generativeaiexamples_tpu.server import guardrails as gr
+
+
+class FakeLLM:
+    def __init__(self, responses):
+        self.responses = list(responses)
+        self.calls = []
+
+    def chat(self, messages, **settings):
+        self.calls.append(messages)
+        yield self.responses.pop(0) if self.responses else "default"
+
+
+@pytest.fixture(scope="module")
+def embedder():
+    from generativeaiexamples_tpu.encoders.embedder import Embedder
+    return Embedder()
+
+
+FLOWS_CO = '''
+define user greet
+  "Hello"
+  "Hi there"
+
+define bot greet
+  "Hello. I'm the Guardrails Bot. How can I help you?"
+
+define user ask about user sensitive data
+  "Can you hack into someone's email account?"
+  "How do I get my friend's photos without permission?"
+
+define bot refuse to respond about user sensitive data
+  "Apologies, but I can't help with that. It's important to respect privacy."
+
+define flow
+  user greet
+  bot greet
+
+define flow
+  user ask about user sensitive data
+  bot refuse to respond about user sensitive data
+'''
+
+
+def test_parse_colang_reference_format():
+    flows = gr.parse_colang(FLOWS_CO)
+    assert len(flows) == 2
+    by_intent = {f.intent: f for f in flows}
+    assert by_intent["greet"].examples == ["Hello", "Hi there"]
+    assert "respect privacy" in \
+        by_intent["ask about user sensitive data"].response
+    assert gr.parse_colang("# nothing here\n") == []
+
+
+def test_intent_rail_matches_by_embedding(embedder):
+    flows = gr.parse_colang(FLOWS_CO)
+    # the tiny random encoder scores everything ~0.98+; 0.995 separates
+    # exact-utterance matches (1.0) from off-topic queries. Real e5-class
+    # encoders use the production default (~0.75).
+    rail = gr.IntentRail(flows, embedder, threshold=0.995)
+    # an exact example utterance always clears the bar
+    hit = rail.check("Can you hack into someone's email account?")
+    assert hit is not None and "privacy" in hit
+    # something far from every example does not
+    assert rail.check(
+        "Compare the HBM bandwidth of the v5e and v5p accelerator chips "
+        "for mixed precision serving workloads") is None
+
+
+def test_regex_rail_blocks_and_scrubs():
+    rail = gr.RegexRail([r"\b\d{3}-\d{2}-\d{4}\b"], refusal="No SSNs please.")
+    assert rail.check("my ssn is 123-45-6789") == "No SSNs please."
+    assert rail.check("no pii here") is None
+    assert rail.scrub("ssn 123-45-6789 ok") == "ssn [redacted] ok"
+
+
+def test_fact_check_rail_verdicts():
+    rail = gr.FactCheckRail(FakeLLM(["TRUE — supported by the context."]))
+    out = rail.check("The pump uses 24V.", "The pump operates on 24V DC.",
+                     "What voltage?")
+    assert out == "The pump uses 24V."
+
+    rail = gr.FactCheckRail(FakeLLM(["FALSE — the context says 24V."]))
+    out = rail.check("The pump uses 48V.", "The pump operates on 24V DC.",
+                     "What voltage?")
+    assert out.startswith(gr.FactCheckRail.WARNING)
+    assert "48V" in out
+
+    # no context -> fact-check is skipped, answer untouched
+    llm = FakeLLM([])
+    assert gr.FactCheckRail(llm).check("hi", "", "q") == "hi"
+    assert llm.calls == []
+
+
+def test_guardrails_pipeline(embedder):
+    flows = gr.parse_colang(FLOWS_CO)
+    rails = gr.Guardrails(
+        input_rails=[gr.IntentRail(flows, embedder, threshold=0.995),
+                     gr.RegexRail([r"credit card number"],
+                                  refusal="I can't collect card numbers.")],
+        output_scrub=gr.RegexRail([r"\b\d{3}-\d{2}-\d{4}\b"]))
+    assert rails.check_input("Hello") is not None
+    assert rails.check_input("what is my credit card number") == \
+        "I can't collect card numbers."
+    assert rails.check_input("summarize the uploaded manual") is None
+    assert rails.check_output("ssn is 123-45-6789") == "ssn is [redacted]"
+
+
+def test_from_config_opt_in(tmp_path, embedder):
+    assert gr.from_config("", embedder, FakeLLM([])) is None
+    p = tmp_path / "flows.co"
+    p.write_text(FLOWS_CO)
+    rails = gr.from_config(str(p), embedder, FakeLLM([]), threshold=0.995)
+    assert rails is not None
+    assert rails.check_input("Hi there") is not None
+
+
+def test_server_input_rail_blocks_generation(embedder, tmp_path):
+    """The chain server returns the canned reply and never runs the chain
+    when an input rail fires."""
+    from generativeaiexamples_tpu.chains.context import ChainContext
+    from generativeaiexamples_tpu.core.config import get_config
+    from generativeaiexamples_tpu.server.api import ChainServer
+
+    class BoomExample:
+        class ctx:
+            pass
+
+        def rag_chain(self, query, history, **kw):
+            raise AssertionError("chain must not run when a rail fires")
+        llm_chain = rag_chain
+
+    flows = gr.parse_colang(FLOWS_CO)
+    rails = gr.Guardrails(
+        input_rails=[gr.IntentRail(flows, embedder, threshold=0.995)])
+    server = ChainServer(BoomExample(), guardrails=rails)
+    body = _drive_generate(server, "Hello")
+    assert "Guardrails Bot" in body
+    assert "[DONE]" in body
+
+
+def _drive_generate(server, content):
+    import asyncio
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def drive():
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            resp = await client.post("/generate", json={
+                "messages": [{"role": "user", "content": content}]})
+            body = await resp.text()
+        finally:
+            await client.close()
+        return body
+
+    # asyncio.run: a fresh loop per drive — get_event_loop() picks up a
+    # closed loop when other async tests ran earlier in the session
+    return asyncio.run(drive())
+
+
+def test_server_output_rails_buffer_and_apply(embedder):
+    """With output rails active the server buffers the stream, fact-checks
+    against the example's own retrieval, and scrubs the result."""
+    from generativeaiexamples_tpu.server.api import ChainServer
+
+    class Example:
+        def rag_chain(self, query, history, **kw):
+            yield "The pump uses 48V; "
+            yield "serial 123-45-6789."
+        llm_chain = rag_chain
+
+        def document_search(self, query, top_k=4):
+            return [{"content": "The pump operates on 24V DC."}]
+
+    fact_llm = FakeLLM(["FALSE — context says 24V."])
+    rails = gr.Guardrails(
+        output_scrub=gr.RegexRail([r"\b\d{3}-\d{2}-\d{4}\b"]),
+        fact_check=gr.FactCheckRail(fact_llm))
+    server = ChainServer(Example(), guardrails=rails)
+    body = _drive_generate(server, "What voltage does the pump use?")
+    assert "fact-check could not verify" in body
+    assert "[redacted]" in body and "123-45-6789" not in body
+    # the fact-check judge saw the example's retrieved evidence
+    assert "24V DC" in fact_llm.calls[0][-1]["content"]
+
+
+def test_server_rails_failure_yields_canned_error(embedder):
+    """An embedder crash inside the input rail must produce the canned
+    error chunk inside a well-formed SSE stream, not a truncated one."""
+    from generativeaiexamples_tpu.server.api import ChainServer
+
+    class BoomRail:
+        def check(self, query):
+            raise RuntimeError("device exploded")
+
+    server = ChainServer(object(), guardrails=gr.Guardrails(
+        input_rails=[BoomRail()]))
+    body = _drive_generate(server, "anything")
+    assert "Error from chain server" in body
+    assert "[DONE]" in body
+
+
+# ------------------------------------------------------------- extraction
+
+def test_structured_extraction_happy_path():
+    from generativeaiexamples_tpu.chains.extraction import (
+        Field, StructuredExtractor)
+
+    fields = [Field("device", "string", "device name"),
+              Field("voltage", "number"),
+              Field("certified", "boolean", required=False),
+              Field("ports", "list", required=False)]
+    llm = FakeLLM([json.dumps({"device": "PumpX", "voltage": 24,
+                               "certified": True, "ports": ["a", "b"]})])
+    out = StructuredExtractor(llm).extract(
+        "PumpX runs at 24V, certified, ports a and b", fields)
+    assert out == {"device": "PumpX", "voltage": 24, "certified": True,
+                   "ports": ["a", "b"]}
+    # the schema reached the prompt
+    assert '"voltage": number (required)' in llm.calls[0][0]["content"]
+
+
+def test_structured_extraction_retries_with_feedback():
+    from generativeaiexamples_tpu.chains.extraction import (
+        Field, StructuredExtractor)
+
+    fields = [Field("voltage", "number")]
+    llm = FakeLLM(['{"voltage": "twenty-four"}',     # wrong type
+                   '{"voltage": 24}'])
+    out = StructuredExtractor(llm).extract("text", fields)
+    assert out == {"voltage": 24}
+    retry_msg = llm.calls[1][-1]["content"]
+    assert "must be number" in retry_msg
+
+    llm = FakeLLM(["no json", "still no json", "nope"])
+    with pytest.raises(ValueError, match="extraction failed"):
+        StructuredExtractor(llm, max_retries=2).extract("text", fields)
+
+    # a later no-JSON attempt must not report the earlier attempt's stale
+    # type error
+    llm = FakeLLM(['{"voltage": "x"}', "prose only", "prose again"])
+    with pytest.raises(ValueError, match="no JSON object"):
+        StructuredExtractor(llm, max_retries=2).extract("text", fields)
+
+
+def test_structured_extraction_batch_isolates_failures():
+    from generativeaiexamples_tpu.chains.extraction import (
+        Field, StructuredExtractor)
+
+    fields = [Field("n", "number")]
+    llm = FakeLLM(['{"n": 1}', "bad", "bad", "bad", '{"n": 3}'])
+    out = StructuredExtractor(llm, max_retries=2).extract_many(
+        ["a", "b", "c"], fields)
+    assert out == [{"n": 1}, None, {"n": 3}]
+
+
+def test_field_type_validation():
+    from generativeaiexamples_tpu.chains.extraction import Field
+
+    with pytest.raises(ValueError, match="unknown field type"):
+        Field("x", "integer")
