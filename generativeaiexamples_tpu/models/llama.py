@@ -67,8 +67,13 @@ class LlamaConfig:
     # MLP, and sliding-window attention (0 = full causal)
     norm: str = "rms"        # "rms" | "layernorm"
     use_bias: bool = False
-    mlp: str = "glu"         # "glu" | "plain"
+    mlp: str = "glu"         # "glu" | "plain" | "moe" (ops/moe.py)
     sliding_window: int = 0
+    # MoE knobs (mlp="moe"): top-k routed GLU experts sharded over the
+    # mesh's "expert" axis; aux load-balance loss via forward(return_aux=)
+    n_experts: int = 8
+    n_experts_per_tok: int = 2
+    capacity_factor: float = 1.25
     # "xla" | "pallas": inference attention backend. Pallas kernels
     # (ops/pallas/attention.py) need head-axis-unsharded layouts; callers
     # that shard heads over a tensor axis must keep "xla" (or wrap the
@@ -118,11 +123,20 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
         "wv": normal(keys[3], (L, D, KV * HD), D),
         "wo": normal(keys[4], (L, H * HD, D), H * HD),
         "mlp_norm": jnp.ones((L, D), dt),
-        "w_up": normal(keys[6], (L, D, F), D),
-        "w_down": normal(keys[7], (L, F, D), F),
     }
-    if cfg.mlp == "glu":
-        layers["w_gate"] = normal(keys[5], (L, D, F), D)
+    if cfg.mlp == "moe":
+        if cfg.use_bias:
+            raise ValueError("mlp='moe' does not support use_bias")
+        E = cfg.n_experts
+        layers["w_router"] = normal(keys[9], (L, D, E), D)
+        layers["w_gate"] = normal(keys[5], (L, E, D, F), D)
+        layers["w_up"] = normal(keys[6], (L, E, D, F), D)
+        layers["w_down"] = normal(keys[7], (L, E, F, D), F)
+    else:
+        layers["w_up"] = normal(keys[6], (L, D, F), D)
+        layers["w_down"] = normal(keys[7], (L, F, D), F)
+        if cfg.mlp == "glu":
+            layers["w_gate"] = normal(keys[5], (L, D, F), D)
     if cfg.use_bias:
         for name, width in (("wq", H * HD), ("wk", KV * HD), ("wv", KV * HD),
                             ("wo", D), ("w_up", F), ("w_down", D)):
@@ -156,11 +170,17 @@ def logical_axes(cfg: LlamaConfig) -> Params:
         "wv": (None, "embed", "kv_heads"),
         "wo": (None, "heads", "embed"),
         "mlp_norm": (None, "embed"),
-        "w_up": (None, "embed", "mlp"),
-        "w_down": (None, "mlp", "embed"),
     }
-    if cfg.mlp == "glu":
-        layers["w_gate"] = (None, "embed", "mlp")
+    if cfg.mlp == "moe":
+        layers["w_router"] = (None, "embed", None)
+        layers["w_gate"] = (None, "expert", "embed", "mlp")
+        layers["w_up"] = (None, "expert", "embed", "mlp")
+        layers["w_down"] = (None, "expert", "mlp", "embed")
+    else:
+        layers["w_up"] = (None, "embed", "mlp")
+        layers["w_down"] = (None, "mlp", "embed")
+        if cfg.mlp == "glu":
+            layers["w_gate"] = (None, "embed", "mlp")
     if cfg.use_bias:
         # biases shard with their projection's OUTPUT axis
         layers.update({"wq_b": (None, "heads"), "wk_b": (None, "kv_heads"),
@@ -256,9 +276,12 @@ def _proj(cfg: LlamaConfig, x: jnp.ndarray, layer: Params, name: str,
 
 def _block(cfg: LlamaConfig, h: jnp.ndarray, layer: Params,
            cos: jnp.ndarray, sin: jnp.ndarray,
-           attn_fn, adapters: Optional[Params]) -> jnp.ndarray:
+           attn_fn, adapters: Optional[Params]
+           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """One transformer block; `attn_fn(q, k, v) -> ctx` abstracts prefill vs
-    decode vs paged attention so the same block serves all paths."""
+    decode vs paged attention so the same block serves all paths. Returns
+    (h, aux): aux is the MoE load-balance loss contribution (0 for dense
+    MLPs), summed across layers by the scan carriers."""
     B, S, D = h.shape
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
@@ -272,14 +295,24 @@ def _block(cfg: LlamaConfig, h: jnp.ndarray, layer: Params,
     h = h + _proj(cfg, ctx, layer, "wo", adapters)
 
     x = _norm(cfg, h, layer, "mlp_norm")
+    aux = jnp.float32(0.0)
+    if cfg.mlp == "moe":
+        from generativeaiexamples_tpu.ops.moe import moe_mlp
+
+        moe_out, aux = moe_mlp(
+            {k_: layer[k_] for k_ in ("w_router", "w_gate", "w_up",
+                                      "w_down")},
+            x, k=cfg.n_experts_per_tok,
+            capacity_factor=cfg.capacity_factor,
+            hidden_act=cfg.hidden_act)
+        return h + moe_out, aux
     if cfg.mlp == "glu":
         gate = _proj(cfg, x, layer, "w_gate", adapters)
         up = _proj(cfg, x, layer, "w_up", adapters)
         act = glu(gate, up, cfg.hidden_act)
     else:   # plain c_fc -> act -> c_proj (StarCoder2)
         act = activate(_proj(cfg, x, layer, "w_up", adapters), cfg.hidden_act)
-    h = h + _proj(cfg, act, layer, "w_down", adapters)
-    return h
+    return h + _proj(cfg, act, layer, "w_down", adapters), aux
 
 
 def _unembed(cfg: LlamaConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
@@ -294,13 +327,15 @@ def forward(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
             positions: Optional[jnp.ndarray] = None,
             attn_mask: Optional[jnp.ndarray] = None,
             adapters: Optional[Params] = None,
-            attn_fn=None) -> jnp.ndarray:
+            attn_fn=None, return_aux: bool = False):
     """Full-sequence causal LM: tokens (B, S) → logits (B, S, vocab) f32.
 
     Training/scoring path (no cache). `attn_mask` (B, S) marks valid tokens
     for right-padded batches. ``attn_fn(q, k, v) -> ctx`` overrides the
     attention implementation (e.g. sequence-parallel ring attention); the
-    default is full-sequence `mha_prefill`.
+    default is full-sequence `mha_prefill`. ``return_aux=True`` additionally
+    returns the layer-mean MoE load-balance loss (0 for dense models) —
+    the trainer adds it to the LM loss.
     """
     B, S = tokens.shape
     if attn_fn is not None and attn_mask is not None:
@@ -316,14 +351,20 @@ def forward(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
         mha_prefill, q_positions=positions, kv_positions=positions,
         kv_mask=attn_mask, causal=True, window=cfg.sliding_window)
 
-    def body(h, xs):
+    def body(carry, xs):
+        h, aux = carry
         layer, ad = xs
-        return _block(cfg, h, layer, cos, sin, attn, ad), None
+        h, layer_aux = _block(cfg, h, layer, cos, sin, attn, ad)
+        return (h, aux + layer_aux), None
 
     # {} is a leafless pytree: scan carries it through unchanged, and
     # _maybe_lora sees an empty adapter dict — one code path either way.
-    h, _ = jax.lax.scan(body, h, (params["layers"], adapters or {}))
-    return _unembed(cfg, params, h)
+    (h, aux), _ = jax.lax.scan(
+        body, (h, jnp.float32(0.0)), (params["layers"], adapters or {}))
+    logits = _unembed(cfg, params, h)
+    if return_aux:
+        return logits, aux / cfg.n_layers
+    return logits
 
 
 def forward_seq_parallel(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
@@ -378,7 +419,7 @@ def scan_blocks(cfg: LlamaConfig, h: jnp.ndarray, params: Params,
             ctx, store["k"], store["v"] = attn_and_update(q, k, v, k_l, v_l)
             return ctx
 
-        h = _block(cfg, h, layer, cos, sin, attn, ad)
+        h, _ = _block(cfg, h, layer, cos, sin, attn, ad)  # aux unused serving
         return h, (store["k"], store["v"])
 
     h, (k_stack, v_stack) = jax.lax.scan(
@@ -411,7 +452,7 @@ def scan_blocks_inplace(cfg: LlamaConfig, h: jnp.ndarray, params: Params,
                 q, k, v, k_pool, v_pool, idx)
             return ctx
 
-        h = _block(cfg, h, layer, cos, sin, attn, ad)
+        h, _ = _block(cfg, h, layer, cos, sin, attn, ad)  # aux unused serving
         return (h, store["k"], store["v"], idx + 1), None
 
     (h, k_pool, v_pool, _), _ = jax.lax.scan(

@@ -1,0 +1,133 @@
+"""Mixture-of-experts MLP with expert parallelism (the mesh's "expert" axis).
+
+The reference exposes MoE only as hosted Mixtral endpoints (no in-tree MoE
+code anywhere); the NeMo knob surface it ships stops at TP/PP
+(ref finetuning/Gemma/lora.ipynb cell 26). This module supplies the
+TPU-first counterpart so the framework's parallelism story covers
+dp/fsdp/tp/sp/ep: GShard/Switch-style top-k routing expressed entirely as
+einsums over a dispatch tensor, with the expert dimension sharded over the
+mesh's "expert" axis — XLA inserts the all_to_all-equivalent collectives
+from the shardings, per the scaling-book recipe (annotate, don't
+hand-schedule).
+
+Shapes (N = B*S tokens, E experts, C capacity, D model, F hidden):
+
+    router logits  (N, E)   = x @ w_router
+    top-k gates    (N, E)   renormalized over the chosen experts
+    dispatch       (N, E, C) one-hot (token n -> slot c of expert e)
+    expert input   (E, C, D) = einsum('nec,nd->ecd', dispatch, x)
+    expert MLP     (E, C, D) -> (E, C, D) (per-expert w_up/w_down, GLU)
+    combine        (N, D)   = einsum('nec,ecd->nd', dispatch*gates, out)
+
+Tokens beyond an expert's capacity are dropped for that expert (classic
+Switch semantics) — the residual connection carries them through, and the
+load-balance auxiliary loss (Switch §2.2: E * mean(fraction) ·
+mean(router_prob)) pushes the router toward uniform load so drops stay
+rare. ``capacity_factor`` trades padding FLOPs for drop rate.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from generativeaiexamples_tpu.ops.layers import glu
+
+Params = Dict[str, Any]
+
+
+def init_moe_params(rng: jax.Array, dim: int, hidden_dim: int,
+                    n_experts: int, dtype=jnp.float32) -> Params:
+    """Router + per-expert GLU MLP weights (leading expert axis)."""
+    import math
+
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+
+    def normal(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                / math.sqrt(fan_in)).astype(dtype)
+
+    return {
+        "w_router": normal(k1, (dim, n_experts), dim),
+        "w_gate": normal(k2, (n_experts, dim, hidden_dim), dim),
+        "w_up": normal(k3, (n_experts, dim, hidden_dim), dim),
+        "w_down": normal(k4, (n_experts, hidden_dim, dim), hidden_dim),
+    }
+
+
+def moe_logical_axes() -> Params:
+    """Sharding annotations: experts over the "expert" axis, hidden over
+    "mlp" (composable with TP inside each expert)."""
+    return {
+        "w_router": ("embed", None),
+        "w_gate": ("expert", "embed", "mlp"),
+        "w_up": ("expert", "embed", "mlp"),
+        "w_down": ("expert", "mlp", "embed"),
+    }
+
+
+def capacity(n_tokens: int, n_experts: int, k: int,
+             capacity_factor: float) -> int:
+    """Per-expert token slots; multiple of 8 keeps the (E, C, D) blocks
+    MXU-tileable."""
+    c = int(capacity_factor * k * n_tokens / n_experts) + 1
+    return max(8, -(-c // 8) * 8)
+
+
+def moe_mlp(params: Params, x: jnp.ndarray, k: int = 2,
+            capacity_factor: float = 1.25, hidden_act: str = "silu",
+            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """MoE GLU MLP over tokens x (..., D) → (out (..., D), aux_loss scalar).
+
+    All routing/dispatch math is static-shaped (top_k + one_hot + cumsum)
+    so the whole block jits once regardless of routing decisions.
+    """
+    orig_shape = x.shape
+    D = orig_shape[-1]
+    xf = x.reshape(-1, D)
+    N = xf.shape[0]
+    E = params["w_router"].shape[-1]
+    C = capacity(N, E, k, capacity_factor)
+
+    # --- routing (f32 for a stable softmax) ------------------------------
+    logits = xf.astype(jnp.float32) @ params["w_router"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                  # (N, E)
+    gate_vals, expert_ix = jax.lax.top_k(probs, k)           # (N, k)
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9)              # renormalize
+
+    # load-balance aux loss (Switch): fraction of tokens FIRST-routed to
+    # each expert x mean router prob, scaled by E
+    first_choice = jax.nn.one_hot(expert_ix[:, 0], E)        # (N, E)
+    aux = E * jnp.mean(first_choice.mean(0) * probs.mean(0))
+
+    # --- dispatch tensor -------------------------------------------------
+    # slot of token n in expert e = number of earlier (token, choice) pairs
+    # routed to e; priority is (choice rank, token order)
+    choice_oh = jax.nn.one_hot(expert_ix, E)                 # (N, k, E)
+    flat = choice_oh.transpose(1, 0, 2).reshape(k * N, E)    # rank-major
+    pos = jnp.cumsum(flat, axis=0) - flat                    # (kN, E) slots
+    pos = pos.reshape(k, N, E).transpose(1, 0, 2)            # (N, k, E)
+    slot = (pos * choice_oh).sum(-1).astype(jnp.int32)       # (N, k)
+    keep = slot < C                                          # capacity gate
+    gate_vals = gate_vals * keep
+
+    # one_hot already zeroes out-of-range (dropped) slots
+    slot_oh = jax.nn.one_hot(slot, C)                        # (N, k, C)
+    # (N, E, C): token n occupies slot c of expert e
+    dispatch = jnp.einsum("nke,nkc->nec", choice_oh, slot_oh)
+    combine = jnp.einsum("nke,nkc,nk->nec", choice_oh, slot_oh, gate_vals)
+
+    # --- expert compute --------------------------------------------------
+    dt = x.dtype
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(dt), xf)  # (E,C,D)
+    gate_h = jnp.einsum("ecd,edf->ecf", expert_in, params["w_gate"].astype(dt))
+    up_h = jnp.einsum("ecd,edf->ecf", expert_in, params["w_up"].astype(dt))
+    act = glu(gate_h, up_h, hidden_act)
+    expert_out = jnp.einsum("ecf,efd->ecd", act, params["w_down"].astype(dt))
+
+    out = jnp.einsum("nec,ecd->nd", combine.astype(jnp.float32),
+                     expert_out.astype(jnp.float32))
+    return out.reshape(orig_shape).astype(dt), aux

@@ -129,7 +129,10 @@ def quantize_params(params: Params, donate: bool = False) -> Params:
     out = dict(params)
     layers = dict(params["layers"])
     for name, axis in _LAYER_AXES.items():
-        if name in layers:
+        # MoE layouts stack an expert axis (L, E, in, out): the stacked-axis
+        # table below doesn't apply — leave expert weights high-precision
+        # (router stays f32 regardless; see ops/moe.py)
+        if name in layers and layers[name].ndim == 3:
             layers[name] = quantize(layers[name], axis=axis, donate=donate)
     out["layers"] = layers
     # embed rows are gathered, so scales are per-row; a tied unembed

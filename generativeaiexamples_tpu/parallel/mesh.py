@@ -31,6 +31,7 @@ _distributed_initialized = False
 INFER_AXES: Tuple[str, ...] = ("data", "tensor")
 TRAIN_AXES: Tuple[str, ...] = ("data", "fsdp", "tensor")
 LONGCTX_AXES: Tuple[str, ...] = ("data", "seq", "tensor")
+MOE_AXES: Tuple[str, ...] = ("data", "expert", "tensor")
 
 
 @dataclass(frozen=True)

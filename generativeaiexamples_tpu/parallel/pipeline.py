@@ -1,0 +1,166 @@
+"""Pipeline parallelism — GPipe-style microbatch schedule over a "stage" axis.
+
+The reference's pipeline parallelism is a NeMo/Megatron config knob
+(``pipeline_model_parallel_size``, ref finetuning/Gemma/lora.ipynb cell 26)
+executed by an external container over NCCL point-to-point sends. The
+TPU-native counterpart: layers are stage-sharded over a mesh axis and
+activations flow stage-to-stage via ``ppermute`` inside one ``shard_map``
+— a single SPMD program, no host-side stage orchestration, differentiable
+end to end (autodiff reverses the schedule for the backward pass, so a
+pipelined train step is just ``jax.grad`` over this forward).
+
+Schedule (classic GPipe): with S stages and M microbatches, the loop runs
+``M + S - 1`` ticks. At tick t, stage 0 injects microbatch t (while t < M),
+every stage runs its local layer chunk on what it received, and the last
+stage banks its output for microbatch ``t - (S - 1)``. The bubble fraction
+is (S-1)/(M+S-1) — callers pick M ≥ S for sane utilization.
+
+Scope: the dense decoder block stack (mlp glu/plain). Everything outside
+the blocks (embedding, final norm, unembed) runs outside the shard_map on
+replicated parameters, so only the deep per-layer weights are
+stage-sharded — exactly the memory that motivates PP.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.ops.attention import mha_prefill
+from generativeaiexamples_tpu.ops.layers import rotary_embedding
+
+Params = Dict[str, Any]
+
+PIPELINE_AXES: Tuple[str, ...] = ("data", "stage")
+
+
+def stage_params(params: Params, n_stages: int) -> Params:
+    """Reshape every stacked layer leaf (L, ...) → (S, L/S, ...) so the
+    leading axis can shard over "stage"."""
+    L = params["layers"]["wq"].shape[0]
+    if L % n_stages:
+        raise ValueError(f"n_layers ({L}) must divide by n_stages "
+                         f"({n_stages})")
+    staged = jax.tree.map(
+        lambda w: w.reshape(n_stages, L // n_stages, *w.shape[1:]),
+        params["layers"])
+    out = dict(params)
+    out["layers"] = staged
+    return out
+
+
+def place_staged_params(params: Params, cfg: llama.LlamaConfig,
+                        mesh: Mesh, n_stages: int) -> Params:
+    """Device-put: staged layer stacks sharded over "stage" (leading axis),
+    embedding/norm/unembed replicated."""
+    staged = stage_params(params, n_stages)
+    out = {}
+    for name, leaf in staged.items():
+        if name == "layers":
+            out["layers"] = jax.tree.map(
+                lambda w: jax.device_put(
+                    w, NamedSharding(mesh, P("stage"))),
+                leaf)
+        else:
+            out[name] = jax.device_put(leaf, NamedSharding(mesh, P()))
+    return out
+
+
+def _run_stage(cfg: llama.LlamaConfig, layers_local: Params,
+               x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray
+               ) -> jnp.ndarray:
+    """Run this stage's (L/S)-layer chunk (full causal attention)."""
+    attn = partial(mha_prefill, causal=True, window=cfg.sliding_window)
+
+    def body(h, layer):
+        h, _ = llama._block(cfg, h, layer, cos, sin, attn, {})
+        return h, None
+
+    x, _ = jax.lax.scan(body, x, layers_local)
+    return x
+
+
+def pipelined_forward(params: Params, cfg: llama.LlamaConfig,
+                      tokens: jnp.ndarray, mesh: Mesh,
+                      n_microbatches: int = 0) -> jnp.ndarray:
+    """Causal-LM logits with the block stack pipelined over mesh["stage"].
+
+    ``params`` must come from :func:`place_staged_params`. tokens (B, S);
+    B must divide by (data-axis size x n_microbatches). Default
+    n_microbatches = 2 x stages (bubble ≤ 1/3).
+    """
+    if cfg.mlp == "moe":
+        raise NotImplementedError("pipeline over MoE blocks: route experts "
+                                  "with the expert axis instead")
+    S_stages = int(mesh.shape["stage"])
+    B, S = tokens.shape
+    per_shard = B // int(mesh.shape.get("data", 1))
+    if n_microbatches:
+        M = n_microbatches
+    else:
+        # largest divisor of the per-shard batch ≤ 2x stages (bubble ≤ 1/3
+        # when the batch allows it, graceful otherwise)
+        M = max(m for m in range(1, min(2 * S_stages, per_shard) + 1)
+                if per_shard % m == 0)
+
+    h = llama.embed_tokens(params, cfg, tokens)              # (B, S, D)
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None],
+                                 (B, S))
+    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
+
+    data = int(mesh.shape.get("data", 1))
+    if (B // data) % M:
+        raise ValueError(f"per-data-shard batch ({B // data}) must divide "
+                         f"by n_microbatches ({M})")
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(P("stage"), P("data"), P("data"), P("data")),
+             out_specs=P("data"), check_vma=False)
+    def run(layers_stage, h_local, cos_local, sin_local):
+        # layers_stage leaves: (1, L/S, ...) → (L/S, ...)
+        layers_local = jax.tree.map(lambda w: w[0], layers_stage)
+        stage = jax.lax.axis_index("stage")
+        b = h_local.shape[0] // M                     # microbatch rows
+        mb = h_local.reshape(M, b, *h_local.shape[1:])
+        cos_mb = cos_local.reshape(M, b, *cos_local.shape[1:])
+        sin_mb = sin_local.reshape(M, b, *sin_local.shape[1:])
+        state = jnp.zeros_like(mb[0])                 # in-flight activation
+        out = jnp.zeros_like(mb)
+
+        def tick(carry, t):
+            state, out = carry
+            # receive from the previous stage (one-hop ring shift)
+            received = jax.lax.ppermute(
+                state, "stage",
+                perm=[(i, (i + 1) % S_stages) for i in range(S_stages)])
+            inject = mb[jnp.minimum(t, M - 1)]
+            x = jnp.where(stage == 0, inject, received)
+            # positions are microbatch-dependent: stage s processes
+            # microbatch (t - s) at tick t
+            m_ix = jnp.clip(t - stage, 0, M - 1)
+            x = _run_stage(cfg, layers_local, x, cos_mb[m_ix], sin_mb[m_ix])
+            # last stage banks microbatch t-(S-1)
+            o_ix = t - (S_stages - 1)
+            bank = ((stage == S_stages - 1) & (o_ix >= 0))
+            out = jax.lax.cond(
+                bank,
+                lambda o: o.at[jnp.clip(o_ix, 0, M - 1)].set(x),
+                lambda o: o, out)
+            return (x, out), None
+
+        (_, out), _ = jax.lax.scan(tick, (state, out),
+                                   jnp.arange(M + S_stages - 1))
+        # only the last stage holds real outputs; share them along the ring
+        out = jax.lax.psum(
+            jnp.where(stage == S_stages - 1, out, jnp.zeros_like(out)),
+            "stage")
+        return out.reshape(h_local.shape)
+
+    h = run(params["layers"], h, cos, sin)
+    return llama._unembed(cfg, params, h)

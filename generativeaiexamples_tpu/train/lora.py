@@ -84,6 +84,15 @@ def init_adapters(rng: jax.Array, model_cfg: llama.LlamaConfig,
         raise ValueError(
             f"LoRA target 'w_gate' does not exist in a mlp={model_cfg.mlp!r} "
             "model; drop it from targets")
+    moe_mlp_targets = {"w_gate", "w_up", "w_down"} & set(cfg.targets)
+    if model_cfg.mlp == "moe" and moe_mlp_targets:
+        # the MoE block bypasses _proj for its expert MLP: dense-shaped
+        # adapters on these names would train nothing (zero grads) and
+        # corrupt the 4-D expert weights at merge time
+        raise ValueError(
+            f"LoRA MLP targets {sorted(moe_mlp_targets)} are not supported "
+            "on mlp='moe' models; restrict targets to attention "
+            "projections (wq/wk/wv/wo)")
     L = model_cfg.n_layers
     scale = cfg.alpha / cfg.rank
     keys = jax.random.split(rng, len(cfg.targets))

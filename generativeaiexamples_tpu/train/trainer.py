@@ -66,17 +66,28 @@ class TrainConfig:
         return self.global_batch_size // self.micro_batch_size
 
 
+MOE_AUX_WEIGHT = 0.01   # Switch-style load-balance loss coefficient
+
+
 def causal_lm_loss(model_cfg: llama.LlamaConfig, params: Params,
                    tokens: jnp.ndarray, loss_mask: jnp.ndarray,
                    adapters: Optional[Params] = None) -> jnp.ndarray:
     """Masked next-token cross-entropy. tokens/loss_mask: (B, S+1); loss over
-    predicting tokens[:,1:] from tokens[:,:-1], masked by loss_mask[:,1:]."""
-    logits = llama.forward(params, model_cfg, tokens[:, :-1], adapters=adapters)
+    predicting tokens[:,1:] from tokens[:,:-1], masked by loss_mask[:,1:].
+    MoE models add the router load-balance auxiliary loss."""
+    aux = 0.0
+    if model_cfg.mlp == "moe":
+        logits, aux = llama.forward(params, model_cfg, tokens[:, :-1],
+                                    adapters=adapters, return_aux=True)
+    else:
+        logits = llama.forward(params, model_cfg, tokens[:, :-1],
+                               adapters=adapters)
     targets = tokens[:, 1:]
     mask = loss_mask[:, 1:]
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return ((nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+            + MOE_AUX_WEIGHT * aux)
 
 
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
