@@ -82,15 +82,52 @@ def clip_describer() -> ImageDescriber:
     return captioner.describe
 
 
+def local_vlm_describer(checkpoint_dir: str) -> ImageDescriber:
+    """Caption with the in-tree LLaVA-architecture VLM (models/vlm.py):
+    a HF Llava checkpoint directory (safetensors/bin + tokenizer.json)
+    generates captions fully on-device — the strongest in-tree backend
+    behind the multimodal_invoke seam."""
+    from generativeaiexamples_tpu.engine.tokenizer import get_tokenizer
+    from generativeaiexamples_tpu.models import vlm as vlm_lib
+
+    cfg, params = vlm_lib.load_checkpoint(checkpoint_dir)
+    tok = get_tokenizer(checkpoint_dir)
+
+    def describe(image_bytes: bytes, metadata: Dict[str, str]) -> str:
+        from generativeaiexamples_tpu.encoders.vision import (
+            _MEAN, _STD, _decode_image)
+
+        arr = _decode_image(image_bytes, cfg.clip.image_size)
+        if arr is None:
+            return stub_describer(image_bytes, metadata)
+        # the tower was trained behind CLIPImageProcessor normalization —
+        # raw [0,1] pixels are ~2σ out of distribution
+        arr = (arr - _MEAN) / _STD
+        prompt = vlm_lib.build_prompt(
+            cfg, tok.encode("Describe this image concisely, including any "
+                            "chart or graph content.\n"),
+            bos_id=tok.bos_id)
+        import jax.numpy as jnp
+
+        out = vlm_lib.generate(params, cfg, jnp.asarray(arr[None]),
+                               prompt, max_tokens=96, eos_id=tok.eos_id)
+        return tok.decode(out).strip() or stub_describer(image_bytes,
+                                                         metadata)
+    return describe
+
+
 def get_describer() -> ImageDescriber:
-    """Priority: served VLM endpoint > in-tree CLIP tower (when a real
-    checkpoint is configured, or explicitly requested) > structural stub.
-    A random-weight CLIP would caption noise, so the tower is only the
-    default once APP_VISION_CHECKPOINT_DIR points at real weights."""
+    """Priority: served VLM endpoint > in-tree LLaVA VLM (when a Llava
+    checkpoint dir is configured) > in-tree CLIP tower (real CLIP
+    checkpoint or explicit opt-in) > structural stub. Random-weight models
+    would caption noise, so each model backend requires its checkpoint."""
     url = os.environ.get("APP_VLM_SERVER_URL", "")
     if url:
         model = os.environ.get("APP_VLM_MODEL_NAME", "vlm")
         return remote_vlm_describer(url, model)
+    vlm_dir = os.environ.get("APP_VLM_CHECKPOINT_DIR", "")
+    if vlm_dir:
+        return local_vlm_describer(vlm_dir)
     if (os.environ.get("APP_VISION_CHECKPOINT_DIR")
             or os.environ.get("APP_VISION_CAPTIONER") == "clip"):
         return clip_describer()

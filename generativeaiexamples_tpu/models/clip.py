@@ -214,6 +214,17 @@ def encode_image(params: Params, cfg: ClipConfig,
     3p²)·(3p², D) GEMM — stride==kernel convolution expressed MXU-natively.
     """
     v = params["vision"]
+    h = _patchify_embed(cfg, v, pixels)
+    h = _encoder(cfg, h, v, cfg.vision_heads, causal=False)
+    pooled = _layer_norm(h[:, 0], v["post_ln_w"], v["post_ln_b"],
+                         cfg.norm_eps)
+    return pooled @ v["proj"]
+
+
+def _patchify_embed(cfg: ClipConfig, v: Params,
+                    pixels: jnp.ndarray) -> jnp.ndarray:
+    """Shared vision preamble: unfold+matmul patch embedding, CLS prepend,
+    positional embeddings, pre-LN → (B, n_patches+1, vision_dim)."""
     B = pixels.shape[0]
     p = cfg.patch_size
     g = cfg.image_size // p
@@ -222,11 +233,25 @@ def encode_image(params: Params, cfg: ClipConfig,
     h = x.astype(cfg.jdtype) @ v["patch_embed"]
     cls = jnp.broadcast_to(v["class_embed"], (B, 1, cfg.vision_dim))
     h = jnp.concatenate([cls, h], axis=1) + v["pos_embed"][None]
-    h = _layer_norm(h, v["pre_ln_w"], v["pre_ln_b"], cfg.norm_eps)
-    h = _encoder(cfg, h, v, cfg.vision_heads, causal=False)
-    pooled = _layer_norm(h[:, 0], v["post_ln_w"], v["post_ln_b"],
-                         cfg.norm_eps)
-    return pooled @ v["proj"]
+    return _layer_norm(h, v["pre_ln_w"], v["pre_ln_b"], cfg.norm_eps)
+
+
+def encode_image_features(params: Params, cfg: ClipConfig,
+                          pixels: jnp.ndarray,
+                          drop_last_layers: int = 1,
+                          keep_cls: bool = False) -> jnp.ndarray:
+    """Per-patch hidden states for VLM conditioning (models/vlm.py):
+    pixels (B, H, W, 3) → (B, n_patches[+1], vision_dim) taken BEFORE the
+    last ``drop_last_layers`` encoder blocks. ``keep_cls`` retains the CLS
+    row (LLaVA vision_feature_select_strategy "full"); the default drops
+    it ("default" strategy, vision_feature_layer=-2 ↔ drop_last_layers=1)."""
+    v = params["vision"]
+    h = _patchify_embed(cfg, v, pixels)
+    keep = cfg.vision_layers - drop_last_layers
+    truncated = dict(v)
+    truncated["layers"] = jax.tree.map(lambda w: w[:keep], v["layers"])
+    h = _encoder(cfg, h, truncated, cfg.vision_heads, causal=False)
+    return h if keep_cls else h[:, 1:, :]
 
 
 def encode_text(params: Params, cfg: ClipConfig, tokens: jnp.ndarray,
@@ -261,10 +286,7 @@ def similarity(params: Params, image_emb: jnp.ndarray,
 # HuggingFace weight import (CLIPModel.state_dict())
 # ---------------------------------------------------------------------------
 
-def params_from_hf(state_dict: Dict[str, Any], cfg: ClipConfig) -> Params:
-    """Map a HF `CLIPModel.state_dict()` (torch tensors or ndarrays) into
-    this layout. Linear weights transpose (torch keeps (out, in)); per-layer
-    q/k/v projections concatenate into the stacked wqkv."""
+def _hf_importers(state_dict: Dict[str, Any], cfg: ClipConfig):
     import numpy as np
 
     def t(name):
@@ -301,25 +323,44 @@ def params_from_hf(state_dict: Dict[str, Any], cfg: ClipConfig) -> Params:
             acc["b_down"].append(t(p + "mlp.fc2.bias"))
         return {k: jnp.stack(v) for k, v in acc.items()}
 
+    return t, lin, tower
+
+
+def vision_params_from_hf(state_dict: Dict[str, Any], cfg: ClipConfig,
+                          with_projection: bool = True) -> Params:
+    """Vision tower only (VLM checkpoints ship no CLIP text tower — ref
+    Llava's vision_tower.* keys). ``with_projection=False`` fills the
+    unused joint-space projection with an identity-free zero stub so
+    `encode_image_features` consumers pay no text-tower memory."""
+    t, lin, tower = _hf_importers(state_dict, cfg)
     # HF conv patch embed: (D, 3, p, p) → unfold layout (p*p*3, D) matching
     # encode_image's (row-major patch pixels, channel minor) flattening
     conv = state_dict["vision_model.embeddings.patch_embedding.weight"]
     conv = conv.detach().cpu().numpy() if hasattr(conv, "detach") else conv
     patch = jnp.asarray(conv, cfg.jdtype).transpose(2, 3, 1, 0).reshape(
         cfg.patch_size * cfg.patch_size * 3, cfg.vision_dim)
-
+    proj = (lin("visual_projection.weight") if with_projection
+            else jnp.zeros((cfg.vision_dim, cfg.projection_dim), cfg.jdtype))
     return {
-        "vision": {
-            "patch_embed": patch,
-            "class_embed": t("vision_model.embeddings.class_embedding"),
-            "pos_embed": t("vision_model.embeddings.position_embedding.weight"),
-            "pre_ln_w": t("vision_model.pre_layrnorm.weight"),
-            "pre_ln_b": t("vision_model.pre_layrnorm.bias"),
-            "layers": tower("vision_model", cfg.vision_layers),
-            "post_ln_w": t("vision_model.post_layernorm.weight"),
-            "post_ln_b": t("vision_model.post_layernorm.bias"),
-            "proj": lin("visual_projection.weight"),
-        },
+        "patch_embed": patch,
+        "class_embed": t("vision_model.embeddings.class_embedding"),
+        "pos_embed": t("vision_model.embeddings.position_embedding.weight"),
+        "pre_ln_w": t("vision_model.pre_layrnorm.weight"),
+        "pre_ln_b": t("vision_model.pre_layrnorm.bias"),
+        "layers": tower("vision_model", cfg.vision_layers),
+        "post_ln_w": t("vision_model.post_layernorm.weight"),
+        "post_ln_b": t("vision_model.post_layernorm.bias"),
+        "proj": proj,
+    }
+
+
+def params_from_hf(state_dict: Dict[str, Any], cfg: ClipConfig) -> Params:
+    """Map a HF `CLIPModel.state_dict()` (torch tensors or ndarrays) into
+    this layout. Linear weights transpose (torch keeps (out, in)); per-layer
+    q/k/v projections concatenate into the stacked wqkv."""
+    t, lin, tower = _hf_importers(state_dict, cfg)
+    return {
+        "vision": vision_params_from_hf(state_dict, cfg),
         "text": {
             "tok_embed": t("text_model.embeddings.token_embedding.weight"),
             "pos_embed": t("text_model.embeddings.position_embedding.weight"),

@@ -327,8 +327,13 @@ def forward(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
             positions: Optional[jnp.ndarray] = None,
             attn_mask: Optional[jnp.ndarray] = None,
             adapters: Optional[Params] = None,
-            attn_fn=None, return_aux: bool = False):
+            attn_fn=None, return_aux: bool = False,
+            input_embeds: Optional[jnp.ndarray] = None):
     """Full-sequence causal LM: tokens (B, S) → logits (B, S, vocab) f32.
+
+    ``input_embeds`` (B, S, D) replaces the token-embedding lookup — the
+    VLM path (models/vlm.py) splices image patch features into the
+    sequence before calling in; ``tokens`` still supplies shapes/positions.
 
     Training/scoring path (no cache). `attn_mask` (B, S) marks valid tokens
     for right-padded batches. ``attn_fn(q, k, v) -> ctx`` overrides the
@@ -344,7 +349,8 @@ def forward(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
             "into attn_fn (e.g. sequence_parallel_attention's kv_lens)")
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
-    h = embed_tokens(params, cfg, tokens)
+    h = (input_embeds if input_embeds is not None
+         else embed_tokens(params, cfg, tokens))
     cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
 
     attn = attn_fn if attn_fn is not None else partial(

@@ -1,0 +1,283 @@
+"""LLaVA-architecture vision-language model: CLIP tower → projector → llama.
+
+The reference's VLM capability is a hosted endpoint (`multimodal_invoke`,
+ref RAG/examples/advanced_rag/multimodal_rag/llm/llm_client.py:48, and the
+Nemotron Nano VLM notebook, ref nemotron/VLM/llama_3.1_nemotron_nano_VL_8B).
+This is the in-tree TPU-native family behind the same seam: patch features
+from the CLIP vision tower (penultimate layer, CLS dropped —
+vision_feature_layer=-2 / "default"), a two-layer GELU projector into the
+decoder's embedding space, and the llama block stack consuming a sequence
+whose ``<image>`` token positions were replaced by the projected patch
+embeddings (HF Llava's masked-scatter semantics, so checkpoints import
+and parity-test directly against `LlavaForConditionalGeneration`).
+
+All three sub-models are the existing functional implementations —
+`models/clip.py` and `models/llama.py` — so mesh sharding rules and the
+family knobs compose; `generate` is a plain greedy loop over `forward`
+(capability/eval path; engine-paged VLM serving would splice features at
+prefill, which the chunked prefill already supports via input embeds).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from generativeaiexamples_tpu.models import clip as clip_lib
+from generativeaiexamples_tpu.models import llama as llama_lib
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class VlmConfig:
+    clip: clip_lib.ClipConfig
+    llm: llama_lib.LlamaConfig
+    image_token_id: int = 32000
+    vision_feature_drop: int = 1    # take hidden states before the last N
+    vision_feature_select: str = "default"   # "default" (drop CLS) | "full"
+    projector_hidden: int = 0       # 0 = llm dim
+
+    @staticmethod
+    def tiny(vocab_size: int = 256) -> "VlmConfig":
+        return VlmConfig(clip=clip_lib.ClipConfig.tiny(),
+                         llm=llama_lib.LlamaConfig.tiny(vocab_size),
+                         image_token_id=vocab_size - 1)
+
+    @property
+    def n_image_tokens(self) -> int:
+        return self.clip.n_patches + (
+            1 if self.vision_feature_select == "full" else 0)
+
+
+def init_params(rng: jax.Array, cfg: VlmConfig) -> Params:
+    import math
+
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+    D_in, D_out = cfg.clip.vision_dim, cfg.llm.dim
+    hidden = cfg.projector_hidden or D_out
+    dt = cfg.llm.jdtype
+
+    def normal(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                / math.sqrt(fan_in)).astype(dt)
+
+    return {
+        "clip": clip_lib.init_params(k1, cfg.clip),
+        "projector": {
+            "w1": normal(k2, (D_in, hidden), D_in),
+            "b1": jnp.zeros((hidden,), dt),
+            "w2": normal(k3, (hidden, D_out), hidden),
+            "b2": jnp.zeros((D_out,), dt),
+        },
+        "llm": llama_lib.init_params(k4, cfg.llm),
+    }
+
+
+def image_features(params: Params, cfg: VlmConfig,
+                   pixels: jnp.ndarray) -> jnp.ndarray:
+    """pixels (B, H, W, 3) → projected patch embeddings (B, N, llm_dim)."""
+    feats = clip_lib.encode_image_features(
+        params["clip"], cfg.clip, pixels,
+        drop_last_layers=cfg.vision_feature_drop,
+        keep_cls=cfg.vision_feature_select == "full")
+    p = params["projector"]
+    h = feats.astype(p["w1"].dtype) @ p["w1"] + p["b1"]
+    h = jax.nn.gelu(h, approximate=False)
+    return h @ p["w2"] + p["b2"]
+
+
+def splice_images(params: Params, cfg: VlmConfig, tokens: jnp.ndarray,
+                  feats: jnp.ndarray) -> jnp.ndarray:
+    """Token embeddings with ``<image>`` positions replaced by patch
+    features in order (HF masked_scatter semantics). tokens (B, S) must
+    contain exactly ``n_image_tokens`` image tokens per row."""
+    embeds = llama_lib.embed_tokens(params["llm"], cfg.llm, tokens)
+    B, S, D = embeds.shape
+    is_img = tokens == cfg.image_token_id                     # (B, S)
+    # k-th image token in a row receives feats[row, k]
+    ordinal = jnp.cumsum(is_img, axis=1) - 1                  # (B, S)
+    gathered = jnp.take_along_axis(
+        feats.astype(embeds.dtype),
+        jnp.clip(ordinal, 0, feats.shape[1] - 1)[..., None], axis=1)
+    return jnp.where(is_img[..., None], gathered, embeds)
+
+
+def forward(params: Params, cfg: VlmConfig, pixels: jnp.ndarray,
+            tokens: jnp.ndarray) -> jnp.ndarray:
+    """Causal-LM logits (B, S, vocab) over text conditioned on images."""
+    feats = image_features(params, cfg, pixels)
+    embeds = splice_images(params, cfg, tokens, feats)
+    return llama_lib.forward(params["llm"], cfg.llm, tokens,
+                             input_embeds=embeds)
+
+
+def build_prompt(cfg: VlmConfig, text_ids, bos_id: Optional[int] = None
+                 ) -> list:
+    """[bos] + <image>*N + text — the single-image LLaVA layout with the
+    image token pre-expanded to its patch count."""
+    ids = [bos_id] if bos_id is not None else []
+    ids += [cfg.image_token_id] * cfg.n_image_tokens
+    return ids + list(text_ids)
+
+
+def generate(params: Params, cfg: VlmConfig, pixels: jnp.ndarray,
+             prompt_ids, max_tokens: int = 32,
+             eos_id: Optional[int] = None) -> list:
+    """Greedy continuation (capability/eval path: full re-forward per step;
+    throughput serving goes through the paged engine with spliced prefill
+    embeds)."""
+    feats = image_features(params, cfg, pixels)
+    seq = list(prompt_ids)
+    out = []
+    for _ in range(max_tokens):
+        toks = jnp.asarray([seq], jnp.int32)
+        embeds = splice_images(params, cfg, toks, feats)
+        logits = llama_lib.forward(params["llm"], cfg.llm, toks,
+                                   input_embeds=embeds)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        if eos_id is not None and nxt == eos_id:
+            break
+        seq.append(nxt)
+        out.append(nxt)
+    return out
+
+
+def params_from_hf(state_dict: Dict[str, Any], cfg: VlmConfig) -> Params:
+    """Map a HF `LlavaForConditionalGeneration.state_dict()` into this
+    layout: vision tower via the clip vision-only importer
+    (prefix-stripped; Llava ships no CLIP text tower and no visual
+    projection), the multi-modal projector's two linears, language model
+    via the llama importer."""
+    import numpy as np
+
+    def sub(prefix: str) -> Dict[str, Any]:
+        return {k[len(prefix):]: v for k, v in state_dict.items()
+                if k.startswith(prefix)}
+
+    vision_sd = sub("model.vision_tower.")
+    if not vision_sd:
+        vision_sd = sub("vision_tower.")
+    clip_params = {"vision": clip_lib.vision_params_from_hf(
+        vision_sd, cfg.clip, with_projection=False)}
+
+    proj = sub("model.multi_modal_projector.")
+    if not proj:
+        proj = sub("multi_modal_projector.")
+
+    def lin(d, name):
+        w = d[name]
+        arr = w.detach().cpu().numpy() if hasattr(w, "detach") else np.asarray(w)
+        return jnp.asarray(arr, cfg.llm.jdtype).T
+
+    def vec(d, name):
+        w = d[name]
+        arr = w.detach().cpu().numpy() if hasattr(w, "detach") else np.asarray(w)
+        return jnp.asarray(arr, cfg.llm.jdtype)
+
+    llm_sd = sub("model.language_model.")
+    if llm_sd:
+        # newer HF layout: model.language_model.* + top-level lm_head
+        llm_sd = {f"model.{k}": v for k, v in llm_sd.items()}
+        if "lm_head.weight" in state_dict:
+            llm_sd["lm_head.weight"] = state_dict["lm_head.weight"]
+    else:
+        llm_sd = sub("language_model.")
+
+    return {
+        "clip": clip_params,
+        "projector": {
+            "w1": lin(proj, "linear_1.weight"),
+            "b1": vec(proj, "linear_1.bias"),
+            "w2": lin(proj, "linear_2.weight"),
+            "b2": vec(proj, "linear_2.bias"),
+        },
+        "llm": llama_lib.params_from_hf(llm_sd, cfg.llm),
+    }
+
+
+def config_from_hf(hf_cfg) -> VlmConfig:
+    """VlmConfig from a HF `LlavaConfig` (or its dict)."""
+    if isinstance(hf_cfg, dict):
+        v, t = hf_cfg["vision_config"], hf_cfg["text_config"]
+        get_v = v.get
+        get_t = t.get
+        image_token = hf_cfg.get("image_token_index", 32000)
+        feature_layer = int(hf_cfg.get("vision_feature_layer", -2))
+        select = str(hf_cfg.get("vision_feature_select_strategy", "default"))
+    else:
+        v, t = hf_cfg.vision_config, hf_cfg.text_config
+        get_v = lambda k, d=None: getattr(v, k, d)
+        get_t = lambda k, d=None: getattr(t, k, d)
+        image_token = getattr(hf_cfg, "image_token_index", 32000)
+        feature_layer = int(getattr(hf_cfg, "vision_feature_layer", -2))
+        select = str(getattr(hf_cfg, "vision_feature_select_strategy",
+                             "default"))
+    clip_cfg = clip_lib.ClipConfig(
+        image_size=get_v("image_size"), patch_size=get_v("patch_size"),
+        vision_dim=get_v("hidden_size"),
+        vision_layers=get_v("num_hidden_layers"),
+        vision_heads=get_v("num_attention_heads"),
+        projection_dim=get_v("projection_dim", 512))
+    head_dim = get_t("head_dim") or (get_t("hidden_size")
+                                     // get_t("num_attention_heads"))
+    llm_cfg = llama_lib.LlamaConfig(
+        vocab_size=get_t("vocab_size"), dim=get_t("hidden_size"),
+        n_layers=get_t("num_hidden_layers"),
+        n_heads=get_t("num_attention_heads"),
+        n_kv_heads=get_t("num_key_value_heads",
+                         get_t("num_attention_heads")),
+        hidden_dim=get_t("intermediate_size"), head_dim=head_dim,
+        rope_theta=float(get_t("rope_theta", 10000.0)),
+        norm_eps=float(get_t("rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(get_t("tie_word_embeddings", False)),
+        dtype="bfloat16")
+    # HF indexes the hidden_states list (length L+1, entry i = after block
+    # i): -2 → drop 1 trailing block, -1 → drop 0, positive p → drop L - p
+    L = clip_cfg.vision_layers
+    drop = (-feature_layer - 1) if feature_layer < 0 else (L - feature_layer)
+    if not 0 <= drop <= L:
+        raise ValueError(f"vision_feature_layer {feature_layer} out of "
+                         f"range for {L} blocks")
+    if select not in ("default", "full"):
+        raise ValueError(f"unsupported vision_feature_select_strategy "
+                         f"{select!r}")
+    return VlmConfig(clip=clip_cfg, llm=llm_cfg,
+                     image_token_id=image_token,
+                     vision_feature_drop=drop,
+                     vision_feature_select=select)
+
+
+def load_checkpoint(checkpoint_dir: str) -> Tuple[VlmConfig, Params]:
+    """Load a local HF Llava checkpoint directory (config.json +
+    safetensors/bin shards) into (VlmConfig, params)."""
+    import glob as globlib
+    import json
+    import os
+
+    with open(os.path.join(checkpoint_dir, "config.json")) as fh:
+        cfg = config_from_hf(json.load(fh))
+    state: Dict[str, Any] = {}
+    shards = sorted(globlib.glob(os.path.join(checkpoint_dir,
+                                              "*.safetensors")))
+    if shards:
+        from safetensors import safe_open
+
+        for shard in shards:
+            with safe_open(shard, framework="np") as f:
+                for key in f.keys():
+                    state[key] = f.get_tensor(key)
+    else:
+        import torch
+
+        for shard in sorted(globlib.glob(
+                os.path.join(checkpoint_dir, "pytorch_model*.bin"))):
+            state.update(torch.load(shard, map_location="cpu",
+                                    weights_only=True))
+    if not state:
+        raise FileNotFoundError(
+            f"no safetensors/bin weights under {checkpoint_dir}")
+    return cfg, params_from_hf(state, cfg)
