@@ -7,9 +7,11 @@ advanced_rag/multimodal_rag/chains.py: ingest accepts only pdf/pptx/png
 multimodal_invoke:48); retrieval then augments the prompt with the text
 and image descriptions (chains.py rag_chain)).
 
-The VLM is a seam: `ImageDescriber`. Three backends, picked by
-`get_describer`: a remote OpenAI-compatible VLM endpoint
-(APP_VLM_SERVER_URL), the in-tree CLIP vision tower's zero-shot captioner
+The VLM is a seam: `ImageDescriber`. Four backends, picked by
+`get_describer` in priority order: a remote OpenAI-compatible VLM endpoint
+(APP_VLM_SERVER_URL), the in-tree LLaVA-architecture VLM generating
+captions on-device (models/vlm.py, when APP_VLM_CHECKPOINT_DIR points at a
+HF Llava checkpoint), the CLIP vision tower's zero-shot captioner
 (encoders/vision.ClipCaptioner, when APP_VISION_CHECKPOINT_DIR supplies
 real weights or APP_VISION_CAPTIONER=clip), and a deterministic
 structural-stats stub so the pipeline is fully self-contained.

@@ -1,0 +1,56 @@
+"""Numerics debug modes — the §5.2 story XLA leaves to us.
+
+The reference has no sanitizers (SURVEY §5.2: no TSAN/ASAN, nothing —
+XLA removes most data-race surface here, so what remains is *numerics*):
+
+  * ``APP_DEBUG_NANS=1``  — jax's debug_nans: any NaN produced under jit
+    raises at the producing op instead of surfacing 40 layers later as a
+    garbage logit (the float analogue of a sanitizer trap);
+  * ``APP_DEBUG_DETERMINISM=1`` — forces XLA's deterministic op lowering
+    and pins the Python hash seed check, so a failing run replays bit-
+    identically (deterministic-seed test paths per SURVEY §5.2).
+
+`install()` is called by the serving/training entrypoints before any jax
+computation; it is a no-op unless a mode is requested, costs nothing in
+production, and logs what it armed so a slowdown is never a mystery
+(debug_nans disables donation/async dispatch — dev-only by design).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+_installed = False
+
+
+def _flag(name: str) -> bool:
+    return os.environ.get(name, "").strip().lower() in ("1", "true", "yes")
+
+
+def install() -> None:
+    """Arm the requested debug modes (idempotent; call before jax work)."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    if _flag("APP_DEBUG_NANS"):
+        import jax
+
+        jax.config.update("jax_debug_nans", True)
+        logger.warning("APP_DEBUG_NANS armed: NaNs raise at the producing "
+                       "op; dispatch is synchronous (dev mode)")
+    if _flag("APP_DEBUG_DETERMINISM"):
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_gpu_deterministic_ops" not in flags:
+            # harmless on TPU (ignored), load-bearing on GPU dev boxes
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_gpu_deterministic_ops=true").strip()
+        if "PYTHONHASHSEED" not in os.environ:
+            logger.warning("APP_DEBUG_DETERMINISM set but PYTHONHASHSEED "
+                           "is not — dict iteration order may still vary "
+                           "across restarts")
+        logger.warning("APP_DEBUG_DETERMINISM armed: deterministic XLA "
+                       "lowering requested")

@@ -67,6 +67,8 @@ def build_scheduler(tiny: bool = False) -> tuple:
 
 
 def main() -> None:
+    from generativeaiexamples_tpu.core.debug import install as _debug_install
+    _debug_install()
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--tiny", action="store_true", help="serve the tiny test model")
     parser.add_argument("--host", default="0.0.0.0")

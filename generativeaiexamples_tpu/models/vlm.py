@@ -138,7 +138,11 @@ def generate(params: Params, cfg: VlmConfig, pixels: jnp.ndarray,
         embeds = splice_images(params, cfg, toks, feats)
         logits = llama_lib.forward(params["llm"], cfg.llm, toks,
                                    input_embeds=embeds)
-        nxt = int(jnp.argmax(logits[0, -1]))
+        # the image placeholder must never be GENERATED: its lm_head row is
+        # untrained, and appending it would make the next step's splice
+        # overwrite a text position with a patch feature
+        step_logits = logits[0, -1].at[cfg.image_token_id].set(-jnp.inf)
+        nxt = int(jnp.argmax(step_logits))
         if eos_id is not None and nxt == eos_id:
             break
         seq.append(nxt)
@@ -216,23 +220,30 @@ def config_from_hf(hf_cfg) -> VlmConfig:
         feature_layer = int(getattr(hf_cfg, "vision_feature_layer", -2))
         select = str(getattr(hf_cfg, "vision_feature_select_strategy",
                              "default"))
+    # HF serializes nested sub-configs as DIFFS against their class
+    # defaults (llava-1.5-7b-hf's text_config omits hidden_size entirely)
+    # — every lookup must fall back to the HF CLIPVisionConfig/LlamaConfig
+    # default, not None
     clip_cfg = clip_lib.ClipConfig(
-        image_size=get_v("image_size"), patch_size=get_v("patch_size"),
-        vision_dim=get_v("hidden_size"),
-        vision_layers=get_v("num_hidden_layers"),
-        vision_heads=get_v("num_attention_heads"),
-        projection_dim=get_v("projection_dim", 512))
-    head_dim = get_t("head_dim") or (get_t("hidden_size")
-                                     // get_t("num_attention_heads"))
+        image_size=get_v("image_size", 224) or 224,
+        patch_size=get_v("patch_size", 32) or 32,
+        vision_dim=get_v("hidden_size", 768) or 768,
+        vision_layers=get_v("num_hidden_layers", 12) or 12,
+        vision_heads=get_v("num_attention_heads", 12) or 12,
+        projection_dim=get_v("projection_dim", 512) or 512)
+    dim = get_t("hidden_size", 4096) or 4096
+    n_heads = get_t("num_attention_heads", 32) or 32
+    head_dim = get_t("head_dim") or dim // n_heads
     llm_cfg = llama_lib.LlamaConfig(
-        vocab_size=get_t("vocab_size"), dim=get_t("hidden_size"),
-        n_layers=get_t("num_hidden_layers"),
-        n_heads=get_t("num_attention_heads"),
-        n_kv_heads=get_t("num_key_value_heads",
-                         get_t("num_attention_heads")),
-        hidden_dim=get_t("intermediate_size"), head_dim=head_dim,
-        rope_theta=float(get_t("rope_theta", 10000.0)),
-        norm_eps=float(get_t("rms_norm_eps", 1e-5)),
+        vocab_size=get_t("vocab_size", 32000) or 32000,
+        dim=dim,
+        n_layers=get_t("num_hidden_layers", 32) or 32,
+        n_heads=n_heads,
+        n_kv_heads=get_t("num_key_value_heads") or n_heads,
+        hidden_dim=get_t("intermediate_size", 11008) or 11008,
+        head_dim=head_dim,
+        rope_theta=float(get_t("rope_theta", 10000.0) or 10000.0),
+        norm_eps=float(get_t("rms_norm_eps", 1e-6) or 1e-6),
         tie_embeddings=bool(get_t("tie_word_embeddings", False)),
         dtype="bfloat16")
     # HF indexes the hidden_states list (length L+1, entry i = after block

@@ -15,6 +15,8 @@ import os
 
 
 def main() -> None:
+    from generativeaiexamples_tpu.core.debug import install as _debug_install
+    _debug_install()
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--example", default=None, help="chain to serve")
     parser.add_argument("--host", default="0.0.0.0")

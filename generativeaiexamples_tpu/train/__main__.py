@@ -41,6 +41,8 @@ MODEL_CONFIGS = {
 
 
 def main(argv=None) -> None:
+    from generativeaiexamples_tpu.core.debug import install as _debug_install
+    _debug_install()
     ap = argparse.ArgumentParser("generativeaiexamples_tpu.train")
     ap.add_argument("--recipe", default="lora_pubmedqa",
                     choices=sorted(recipes.RECIPES))

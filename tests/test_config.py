@@ -68,3 +68,31 @@ def test_help_lists_every_env_var():
     assert "APP_RETRIEVER_TOP_K" in text
     assert "APP_ENGINE_MAX_SEQ_LEN" in text
     assert "APP_VECTOR_STORE_NAME" in text
+
+
+def test_debug_modes_install(monkeypatch, caplog):
+    """APP_DEBUG_NANS / APP_DEBUG_DETERMINISM arm jax debug modes once and
+    are a silent no-op when unset (core/debug.py, SURVEY §5.2)."""
+    import importlib
+    import logging
+
+    import jax
+
+    from generativeaiexamples_tpu.core import debug
+
+    importlib.reload(debug)
+    monkeypatch.delenv("APP_DEBUG_NANS", raising=False)
+    monkeypatch.delenv("APP_DEBUG_DETERMINISM", raising=False)
+    debug.install()
+    assert not jax.config.jax_debug_nans
+
+    importlib.reload(debug)
+    monkeypatch.setenv("APP_DEBUG_NANS", "1")
+    with caplog.at_level(logging.WARNING):
+        debug.install()
+    try:
+        assert jax.config.jax_debug_nans
+        assert "APP_DEBUG_NANS armed" in caplog.text
+        debug.install()   # idempotent
+    finally:
+        jax.config.update("jax_debug_nans", False)

@@ -136,6 +136,22 @@ def test_config_from_hf_feature_layer_and_strategy():
         {**base, "vision_feature_select_strategy": "full"})
     assert full.n_image_tokens == full.clip.n_patches + 1
 
+    # HF serializes sub-configs as diffs: a llava-1.5-style config whose
+    # text_config carries only the non-default fields must fall back to
+    # the HF LlamaConfig/CLIPVisionConfig defaults, not crash
+    sparse = vlm.config_from_hf({
+        "vision_config": {},   # all CLIPVisionConfig defaults
+        "text_config": {"vocab_size": 32064, "rms_norm_eps": 1e-5,
+                        "max_position_embeddings": 4096},
+        "image_token_index": 32000})
+    assert sparse.llm.dim == 4096 and sparse.llm.n_layers == 32
+    assert sparse.llm.n_heads == 32 and sparse.llm.hidden_dim == 11008
+    assert sparse.clip.vision_dim == 768 and sparse.clip.patch_size == 32
+    # omitted rms_norm_eps means the HF default 1e-6, not 1e-5
+    omitted = vlm.config_from_hf({
+        "vision_config": {}, "text_config": {}, "image_token_index": 32000})
+    assert omitted.llm.norm_eps == 1e-6
+
 
 def test_splice_places_features_at_image_tokens():
     cfg = vlm.VlmConfig.tiny()
