@@ -200,6 +200,7 @@ class EngineCore:
             donate = "off" if os.environ.get("PALLAS_AXON_POOL_IPS") else "on"
         dn = (0,) if donate == "on" else ()
         self._chunk_fn = jax.jit(self._chunk_impl, donate_argnums=dn)
+        self._long_fn = jax.jit(self._prefill_long_impl, donate_argnums=dn)
         self._chunk_last_fn = jax.jit(self._chunk_last_impl,
                                       donate_argnums=dn)
         self._decode_fn = jax.jit(self._decode_impl, donate_argnums=dn,
@@ -280,6 +281,61 @@ class EngineCore:
             state, self.params, self.adapters, jnp.asarray(padded),
             jnp.asarray(page_row, jnp.int32), jnp.int32(slot),
             jnp.int32(start_pos), jnp.int32(n))
+
+    # ---------------------------------------------- long-context prefill
+
+    @property
+    def supports_long_prefill(self) -> bool:
+        """Sequence-parallel whole-prompt prefill needs a mesh with a
+        "seq" axis (the LONGCTX configuration)."""
+        return (self.mesh is not None and "seq" in self.mesh.axis_names
+                and int(self.mesh.shape["seq"]) > 1
+                and self.model_cfg.sliding_window == 0)
+
+    def prefill_long(self, state: DecodeState, prompt_ids, page_row,
+                     slot: int) -> Tuple[DecodeState, jax.Array]:
+        """Whole-prompt ring-attention prefill into the slot's pages —
+        §5.7 long-context serving: one pass over the full prompt with the
+        sequence sharded over mesh["seq"] instead of prefill_chunk-sized
+        slices (kv_cache.prefill_seq_parallel). The caller allocates pages
+        exactly as for chunked prefill; returns (state, last-position
+        logits (V,)) ready for `sample` + `activate`."""
+        if not self.supports_long_prefill:
+            raise ValueError("prefill_long needs a mesh with a 'seq' axis "
+                             "and a full-causal model")
+        n = len(prompt_ids)
+        seq_n = int(self.mesh.shape["seq"])
+        import math as _math
+
+        # power-of-two bucket ladder over the alignment unit: without it
+        # every distinct rounded prompt length is a fresh XLA compile on
+        # the serving path (the chunked path buckets for the same reason)
+        align = _math.lcm(self.page_size, seq_n)
+        # cap: largest align-multiple that fits the block-table row (the
+        # ring needs S % seq == 0 AND the page write S % page == 0)
+        cap = (self.max_pages_per_slot * self.page_size // align) * align
+        S = align
+        while S < n:
+            S *= 2
+        S = min(S, cap)
+        if S < n:
+            raise ValueError(f"prompt of {n} tokens exceeds the long-"
+                             f"prefill capacity ({cap} aligned tokens)")
+        padded = np.zeros((1, S), np.int32)
+        padded[0, :n] = prompt_ids
+        toks = jax.device_put(
+            jnp.asarray(padded),
+            NamedSharding(self.mesh, P("data", "seq")))
+        return self._long_fn(state, self.params, self.adapters, toks,
+                             jnp.asarray(page_row, jnp.int32),
+                             jnp.int32(slot), jnp.int32(n))
+
+    def _prefill_long_impl(self, state: DecodeState, params, adapters,
+                           tokens, page_row, slot, n_tokens):
+        logits, cache = kv_cache.prefill_seq_parallel(
+            params, self.model_cfg, tokens, state.cache, page_row, slot,
+            n_tokens, self.num_pages, self.mesh, adapters=adapters)
+        return dataclasses.replace(state, cache=cache), logits[0]
 
     def _sample_impl(self, logits, rng, temperature, top_k, top_p):
         return sample_logits_dynamic(rng, logits[None], temperature[None],

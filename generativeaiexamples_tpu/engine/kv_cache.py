@@ -244,3 +244,47 @@ def decode_step(params: llama.Params, cfg: llama.LlamaConfig,
         adapters)
     logits = llama._unembed(cfg, params, h)[:, 0]
     return logits, PagedKVCache(k=k_stack, v=v_stack, lengths=new_lengths)
+
+
+def prefill_seq_parallel(params: llama.Params, cfg: llama.LlamaConfig,
+                         tokens: jnp.ndarray, cache: PagedKVCache,
+                         page_row: jnp.ndarray, slot: jnp.ndarray,
+                         n_tokens: jnp.ndarray, num_pages: int, mesh,
+                         adapters: Optional[llama.Params] = None,
+                         impl: str = "ring",
+                         ) -> Tuple[jnp.ndarray, PagedKVCache]:
+    """Whole-prompt sequence-parallel prefill for one slot: ring attention
+    over mesh["seq"] computes the prompt in one pass (llama.
+    prefill_seq_parallel) and the collected per-layer K/V scatter into the
+    slot's pages — the long-context serving path where a single chunked
+    pass would be wall-clock-bound on one chip's attention.
+
+    tokens: (1, S) right-padded, S page-aligned AND divisible by the seq
+    axis; page_row: (max_pages,) block-table row; n_tokens: scalar valid
+    length. Returns (last-valid-position logits (1, V), cache with
+    lengths[slot] = n_tokens). Rows past n_tokens hold garbage K/V inside
+    the covered pages — decode masks by length, exactly as with chunked
+    prefill padding.
+    """
+    _, S = tokens.shape
+    ps = cache.page_size
+    if S % ps != 0:
+        raise ValueError(f"padded prompt length {S} must be page-aligned "
+                         f"(page={ps})")
+    L, KV, HD = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+    n_p = S // ps
+
+    logits, k_stack, v_stack = llama.prefill_seq_parallel(
+        params, cfg, tokens, mesh, seq_lens=n_tokens[None],
+        adapters=adapters, impl=impl)
+    # (L, 1, S, KV, HD) → page blocks (L * n_p, ps, KV*HD) in pool layout
+    k_pages = k_stack[:, 0].reshape(L, n_p, ps, KV * HD)
+    v_pages = v_stack[:, 0].reshape(L, n_p, ps, KV * HD)
+    rows = (jnp.arange(L, dtype=jnp.int32)[:, None] * num_pages
+            + page_row[None, :n_p]).reshape(-1)
+    new_k = cache.k.at[rows].set(
+        k_pages.reshape(L * n_p, ps, KV * HD).astype(cache.k.dtype))
+    new_v = cache.v.at[rows].set(
+        v_pages.reshape(L * n_p, ps, KV * HD).astype(cache.v.dtype))
+    lengths = cache.lengths.at[slot].set(n_tokens)
+    return logits, PagedKVCache(k=new_k, v=new_v, lengths=lengths)

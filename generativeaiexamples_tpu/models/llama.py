@@ -404,6 +404,50 @@ def forward_seq_parallel(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
     return forward(params, cfg, tokens, adapters=adapters, attn_fn=attn)
 
 
+def prefill_seq_parallel(params: Params, cfg: LlamaConfig,
+                         tokens: jnp.ndarray, mesh,
+                         seq_lens: Optional[jnp.ndarray] = None,
+                         adapters: Optional[Params] = None,
+                         impl: str = "ring"):
+    """Long-prompt prefill with the sequence dim sharded over mesh["seq"]:
+    attention runs as ring attention while the per-layer K/V are COLLECTED
+    for the serving cache — this is what turns §5.7 sequence parallelism
+    into a serving capability (engine.prefill_long writes the result into
+    the paged pool; ref has no counterpart — its long-context story is
+    trimming retrieval to 1,500 tokens, utils.py:103).
+
+    tokens: (B, S) right-padded, S divisible by the seq-axis size; callers
+    place them with P("data", "seq"). Returns (last-position logits (B, V),
+    k_stack, v_stack (L, B, S, kv_heads, head_dim) — seq-sharded like the
+    activations).
+    """
+    from generativeaiexamples_tpu.parallel.ring_attention import (
+        sequence_parallel_attention)
+
+    if cfg.sliding_window:
+        raise NotImplementedError(
+            "sequence-parallel prefill is full-causal; sliding-window "
+            "models use chunked prefill")
+    B, S = tokens.shape
+    if seq_lens is None:
+        seq_lens = jnp.full((B,), S, jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+    h = embed_tokens(params, cfg, tokens)
+    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
+    attn = partial(sequence_parallel_attention, mesh=mesh, impl=impl,
+                   kv_lens=seq_lens, causal=True)
+
+    def attn_and_update(q, k, v, _k, _v):
+        return attn(q, k, v), k, v      # stash this layer's K/V via scan
+
+    dummy = jnp.zeros((cfg.n_layers, 1), cfg.jdtype)
+    h, k_stack, v_stack = scan_blocks(cfg, h, params, (dummy, dummy),
+                                      cos, sin, attn_and_update, adapters)
+    h_last = jnp.take_along_axis(
+        h, (seq_lens - 1)[:, None, None].astype(jnp.int32), axis=1)
+    return _unembed(cfg, params, h_last)[:, 0], k_stack, v_stack
+
+
 def scan_blocks(cfg: LlamaConfig, h: jnp.ndarray, params: Params,
                 kv_layers: Tuple[jnp.ndarray, jnp.ndarray],
                 cos: jnp.ndarray, sin: jnp.ndarray, attn_and_update,
