@@ -32,7 +32,19 @@ def build_scheduler(tiny: bool = False) -> tuple:
         params = llama.init_params(jax.random.PRNGKey(5), model_cfg)
         model_name = "tiny-llama-test"
     else:
-        model_cfg = llama.LlamaConfig.llama3_8b()
+        from generativeaiexamples_tpu.models import model_configs
+
+        # the served ARCHITECTURE follows APP_ENGINE_MODEL_FAMILY (same
+        # names as the train CLI, so a fine-tuned checkpoint serves under
+        # the family it trained under); APP_LLM_MODEL_NAME remains the
+        # cosmetic OpenAI model id and never selects weights
+        configs = model_configs()
+        family = cfg.engine.model_family
+        if family not in configs:
+            raise SystemExit(
+                f"unknown APP_ENGINE_MODEL_FAMILY {family!r}; "
+                f"valid: {sorted(configs)}")
+        model_cfg = configs[family]()
         tokenizer = get_tokenizer(cfg.engine.checkpoint_dir)
         if cfg.engine.checkpoint_dir:
             from generativeaiexamples_tpu.train.checkpoints import load_params

@@ -201,6 +201,8 @@ class EngineCore:
         dn = (0,) if donate == "on" else ()
         self._chunk_fn = jax.jit(self._chunk_impl, donate_argnums=dn)
         self._long_fn = jax.jit(self._prefill_long_impl, donate_argnums=dn)
+        self._long_last_fn = jax.jit(self._prefill_long_last_impl,
+                                     donate_argnums=dn)
         self._chunk_last_fn = jax.jit(self._chunk_last_impl,
                                       donate_argnums=dn)
         self._decode_fn = jax.jit(self._decode_impl, donate_argnums=dn,
@@ -303,26 +305,7 @@ class EngineCore:
         if not self.supports_long_prefill:
             raise ValueError("prefill_long needs a mesh with a 'seq' axis "
                              "and a full-causal model")
-        n = len(prompt_ids)
-        seq_n = int(self.mesh.shape["seq"])
-        import math as _math
-
-        # power-of-two bucket ladder over the alignment unit: without it
-        # every distinct rounded prompt length is a fresh XLA compile on
-        # the serving path (the chunked path buckets for the same reason)
-        align = _math.lcm(self.page_size, seq_n)
-        # cap: largest align-multiple that fits the block-table row (the
-        # ring needs S % seq == 0 AND the page write S % page == 0)
-        cap = (self.max_pages_per_slot * self.page_size // align) * align
-        S = align
-        while S < n:
-            S *= 2
-        S = min(S, cap)
-        if S < n:
-            raise ValueError(f"prompt of {n} tokens exceeds the long-"
-                             f"prefill capacity ({cap} aligned tokens)")
-        padded = np.zeros((1, S), np.int32)
-        padded[0, :n] = prompt_ids
+        padded, n = self._pad_long(prompt_ids)
         toks = jax.device_put(
             jnp.asarray(padded),
             NamedSharding(self.mesh, P("data", "seq")))
@@ -337,6 +320,58 @@ class EngineCore:
             n_tokens, self.num_pages, self.mesh, adapters=adapters)
         return dataclasses.replace(state, cache=cache), logits[0]
 
+    def _pad_long(self, prompt_ids) -> Tuple[np.ndarray, int]:
+        n = len(prompt_ids)
+        seq_n = int(self.mesh.shape["seq"])
+        import math as _math
+
+        # power-of-two bucket ladder over the alignment unit: without it
+        # every distinct rounded prompt length is a fresh XLA compile on
+        # the serving path (the chunked path buckets for the same reason);
+        # cap: largest align-multiple that fits the block-table row (the
+        # ring needs S % seq == 0 AND the page write S % page == 0)
+        align = _math.lcm(self.page_size, seq_n)
+        cap = (self.max_pages_per_slot * self.page_size // align) * align
+        S = align
+        while S < n:
+            S *= 2
+        S = min(S, cap)
+        if S < n:
+            raise ValueError(f"prompt of {n} tokens exceeds the long-"
+                             f"prefill capacity ({cap} aligned tokens)")
+        padded = np.zeros((1, S), np.int32)
+        padded[0, :n] = prompt_ids
+        return padded, n
+
+    def prefill_long_last(self, state: DecodeState, prompt_ids, page_row,
+                          slot: int, generated: int, max_gen: int,
+                          temperature: float, top_k: int, top_p: float
+                          ) -> Tuple[DecodeState, jax.Array]:
+        """Whole-prompt sequence-parallel prefill FUSED with first-token
+        sampling and slot activation (the scheduler's long-prompt
+        admission path — same no-host-round-trip contract as
+        `prefill_chunk_last`)."""
+        if not self.supports_long_prefill:
+            raise ValueError("prefill_long needs a mesh with a 'seq' axis "
+                             "and a full-causal model")
+        padded, n = self._pad_long(prompt_ids)
+        toks = jax.device_put(
+            jnp.asarray(padded), NamedSharding(self.mesh, P("data", "seq")))
+        return self._long_last_fn(
+            state, self.params, self.adapters, toks,
+            jnp.asarray(page_row, jnp.int32), jnp.int32(slot),
+            jnp.int32(n), jnp.int32(generated), jnp.int32(max_gen),
+            jnp.float32(temperature), jnp.int32(top_k), jnp.float32(top_p))
+
+    def _prefill_long_last_impl(self, state: DecodeState, params, adapters,
+                                tokens, page_row, slot, n_tokens, generated,
+                                max_gen, temperature, top_k, top_p):
+        logits, cache = kv_cache.prefill_seq_parallel(
+            params, self.model_cfg, tokens, state.cache, page_row, slot,
+            n_tokens, self.num_pages, self.mesh, adapters=adapters)
+        return self._activate_sampled(state, cache, logits, slot, generated,
+                                      max_gen, temperature, top_k, top_p)
+
     def _sample_impl(self, logits, rng, temperature, top_k, top_p):
         return sample_logits_dynamic(rng, logits[None], temperature[None],
                                      top_k[None], top_p[None])[0]
@@ -348,22 +383,17 @@ class EngineCore:
                               jnp.int32(top_k), jnp.float32(top_p))
         return int(jax.device_get(tok))
 
-    def _chunk_last_impl(self, state: DecodeState, params, adapters, tokens,
-                         page_row, slot, start_pos, chunk_len, generated,
-                         max_gen, temperature, top_k, top_p
-                         ) -> Tuple[DecodeState, jnp.ndarray]:
-        """Final chunk fused with first-token sampling and slot activation —
-        admission never blocks on a host round-trip; the first token's value
-        reaches the host batched into the next decode sync."""
-        logits, cache = kv_cache.prefill_chunk(
-            params, self.model_cfg, tokens, state.cache, page_row, slot,
-            start_pos, chunk_len, self.num_pages, adapters=adapters)
+    def _activate_sampled(self, state: DecodeState, cache, logits, slot,
+                          generated, max_gen, temperature, top_k, top_p
+                          ) -> Tuple[DecodeState, jnp.ndarray]:
+        """Shared tail of the fused prefill programs: sample the first token
+        from last-position logits and activate the slot, all on-device.
+        An immediate eos or an exhausted budget leaves the slot inactive
+        (the host resolves the outcome from the returned token at the next
+        decode sync)."""
         rng, sub = jax.random.split(state.rng)
         tok = sample_logits_dynamic(sub, logits, temperature[None],
                                     top_k[None], top_p[None])[0]
-        # activation is decided on-device: an immediate eos or an exhausted
-        # budget leaves the slot inactive (the host resolves the outcome from
-        # the returned token at the next sync)
         alive = (tok != self.eos_id) & (generated < max_gen)
         upd = lambda arr, val: arr.at[slot].set(val)
         new_state = dataclasses.replace(
@@ -379,6 +409,19 @@ class EngineCore:
             rng=rng,
         )
         return new_state, tok
+
+    def _chunk_last_impl(self, state: DecodeState, params, adapters, tokens,
+                         page_row, slot, start_pos, chunk_len, generated,
+                         max_gen, temperature, top_k, top_p
+                         ) -> Tuple[DecodeState, jnp.ndarray]:
+        """Final chunk fused with first-token sampling and slot activation —
+        admission never blocks on a host round-trip; the first token's value
+        reaches the host batched into the next decode sync."""
+        logits, cache = kv_cache.prefill_chunk(
+            params, self.model_cfg, tokens, state.cache, page_row, slot,
+            start_pos, chunk_len, self.num_pages, adapters=adapters)
+        return self._activate_sampled(state, cache, logits, slot, generated,
+                                      max_gen, temperature, top_k, top_p)
 
     def prefill_chunk_last(self, state: DecodeState, chunk_ids, page_row,
                            slot: int, start_pos: int, generated: int,

@@ -297,10 +297,32 @@ class Scheduler:
     # -- prefill ------------------------------------------------------------
 
     def _prefill_step(self) -> None:
-        """Run ONE chunk of the oldest admission (interleaves with decode)."""
+        """Run ONE chunk of the oldest admission (interleaves with decode).
+
+        On a mesh with a "seq" axis and ``long_prefill != off``, multi-chunk
+        prompts instead take ONE sequence-parallel ring-attention pass
+        (engine.prefill_long_last): decode does not interleave during it,
+        but the pass runs seq-axis-times faster than the chunk loop — the
+        §5.7 long-context serving trade."""
         job = self._prefilling[0]
         req = job.request
         start = job.prefilled
+        if (start == 0 and len(job.ids) > self.core.chunk
+                and self.core.cfg.long_prefill != "off"
+                and self.core.supports_long_prefill):
+            job.prefill_started = time.perf_counter()
+            self._prefilling.popleft()
+            REGISTRY.counter("prefill_long_passes").inc()
+            self._state, _ = self.core.prefill_long_last(
+                self._state, job.ids, self._table[job.slot], job.slot,
+                generated=len(job.gen_ids) + 1, max_gen=req.max_tokens,
+                temperature=req.temperature, top_k=req.top_k,
+                top_p=req.top_p)
+            job.prefilled = len(job.ids)
+            job.total_len = job.prefilled
+            job.first_pending = True
+            self._slots[job.slot] = job
+            return
         remaining = len(job.ids) - start
         chunk_ids = job.ids[start:start + min(remaining, self.core.chunk)]
         if start == 0:

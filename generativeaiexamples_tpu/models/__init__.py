@@ -9,3 +9,23 @@ logical-axis annotations for mesh sharding.
 Encoders (replace NeMo Retriever NIMs): e5-class bi-encoder and cross-encoder
 reranker (`bert`), CLIP-style vision tower (`clip`).
 """
+
+
+def model_configs():
+    """Name → config factory for every decoder family (shared by the train
+    CLI and the serving engine, so a fine-tuned checkpoint serves under the
+    same name it trained under)."""
+    from generativeaiexamples_tpu.models import gemma, llama, starcoder2
+
+    return {
+        "llama3-8b": llama.LlamaConfig.llama3_8b,
+        "llama3-70b": llama.LlamaConfig.llama3_70b,
+        "gemma-2b": gemma.gemma_2b,
+        "gemma-7b": gemma.gemma_7b,
+        "codegemma-7b": gemma.codegemma_7b,
+        "starcoder2-3b": starcoder2.starcoder2_3b,
+        "starcoder2-7b": starcoder2.starcoder2_7b,
+        "tiny": llama.LlamaConfig.tiny,
+        "tiny-gemma": gemma.tiny,
+        "tiny-starcoder2": starcoder2.tiny,
+    }

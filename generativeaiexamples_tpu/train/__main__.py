@@ -20,24 +20,13 @@ import logging
 
 import jax
 
-from generativeaiexamples_tpu.models import gemma, llama, starcoder2
+from generativeaiexamples_tpu.models import llama, model_configs
 from generativeaiexamples_tpu.train import checkpoints, data as data_lib, recipes
 from generativeaiexamples_tpu.train.trainer import Trainer
 
 log = logging.getLogger(__name__)
 
-MODEL_CONFIGS = {
-    "llama3-8b": llama.LlamaConfig.llama3_8b,
-    "llama3-70b": llama.LlamaConfig.llama3_70b,
-    "gemma-2b": gemma.gemma_2b,
-    "gemma-7b": gemma.gemma_7b,
-    "codegemma-7b": gemma.codegemma_7b,
-    "starcoder2-3b": starcoder2.starcoder2_3b,
-    "starcoder2-7b": starcoder2.starcoder2_7b,
-    "tiny": llama.LlamaConfig.tiny,
-    "tiny-gemma": gemma.tiny,
-    "tiny-starcoder2": starcoder2.tiny,
-}
+MODEL_CONFIGS = model_configs()
 
 
 def main(argv=None) -> None:

@@ -411,3 +411,28 @@ def test_engine_tensor_parallel_matches_single_device(engine):
     mesh8 = pmesh.create_mesh(
         pmesh.MeshConfig(axes=pmesh.INFER_AXES, shape=(4, 2)))
     assert run(mesh8) == base
+
+
+def test_build_scheduler_serves_configured_family(monkeypatch):
+    """APP_ENGINE_MODEL_FAMILY picks the served architecture through the
+    shared registry (a gemma fine-tune serves under the family it trained
+    under) while APP_LLM_MODEL_NAME stays a cosmetic label; unknown
+    families fail with the valid list instead of silently serving an 8B
+    llama shape."""
+    from generativeaiexamples_tpu.core import config as config_mod
+    from generativeaiexamples_tpu.engine.__main__ import build_scheduler
+
+    monkeypatch.setenv("APP_ENGINE_MODEL_FAMILY", "tiny-gemma")
+    monkeypatch.setenv("APP_LLM_MODEL_NAME", "prod-display-label")
+    config_mod.get_config.cache_clear()
+    try:
+        sched, name = build_scheduler(tiny=False)
+        assert name == "prod-display-label"     # label, not a registry key
+        assert sched.core.model_cfg.hidden_act == "gelu_tanh"   # gemma knob
+
+        monkeypatch.setenv("APP_ENGINE_MODEL_FAMILY", "not-a-model")
+        config_mod.get_config.cache_clear()
+        with pytest.raises(SystemExit, match="valid"):
+            build_scheduler(tiny=False)
+    finally:
+        config_mod.get_config.cache_clear()

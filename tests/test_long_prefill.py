@@ -84,6 +84,42 @@ def test_engine_prefill_long_then_decode_matches_dense(longctx):
     assert got == expect
 
 
+def test_scheduler_routes_long_prompts_through_ring_prefill(longctx):
+    """On a LONGCTX mesh the scheduler's admission takes the one-pass
+    sequence-parallel route for multi-chunk prompts, and the streamed
+    output still matches the dense model's greedy continuation."""
+    from generativeaiexamples_tpu.core.metrics import REGISTRY
+    from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
+    from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+
+    cfg, params, core = longctx
+    tok = ByteTokenizer()
+    prompt = tok.encode("long context serving over the ring " * 4,
+                        add_bos=True)
+    assert len(prompt) > core.chunk        # multi-chunk → long route
+
+    seq = list(prompt)
+    for _ in range(6):
+        logits = llama.forward(params, cfg, jnp.asarray([seq], jnp.int32))
+        seq.append(int(jnp.argmax(logits[0, -1])))
+    expect = tok.decode(seq[len(prompt):])
+
+    before = REGISTRY.counter("prefill_long_passes").value
+    sched = Scheduler(core, tok)
+    req = Request(prompt_ids=list(prompt), max_tokens=6, temperature=0.0)
+    sched.submit(req)
+    while sched._tick():
+        pass
+    assert req.error is None
+    assert REGISTRY.counter("prefill_long_passes").value == before + 1
+    parts = []
+    while not req.out_queue.empty():
+        item = req.out_queue.get_nowait()
+        if isinstance(item, str):
+            parts.append(item)
+    assert "".join(parts) == expect
+
+
 def test_prefill_long_requires_seq_axis():
     cfg = llama.LlamaConfig.tiny(vocab_size=300)
     params = llama.init_params(jax.random.PRNGKey(5), cfg)
