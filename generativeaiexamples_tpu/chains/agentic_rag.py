@@ -20,6 +20,7 @@ import logging
 from typing import Any, Dict, Iterator, List, Sequence
 
 from generativeaiexamples_tpu.chains.basic_rag import _sampling, trim_context
+from generativeaiexamples_tpu.server import guardrails
 from generativeaiexamples_tpu.chains.context import ChainContext, get_context
 from generativeaiexamples_tpu.chains.loaders import load_document
 from generativeaiexamples_tpu.chains.query_decomposition import extract_json
@@ -155,6 +156,7 @@ class AgenticRAG(BaseExample):
             context_text = trim_context(
                 [d.content for d in docs], self.ctx.embedder.tokenizer,
                 rcfg.max_context_tokens)
+            guardrails.record_context(context_text)
             generation = self._generate(question, context_text,
                                         **llm_settings)
             grounded = self._grade(

@@ -22,6 +22,7 @@ from generativeaiexamples_tpu.chains.context import ChainContext, get_context
 from generativeaiexamples_tpu.chains.loaders import load_document
 from generativeaiexamples_tpu.core.tracing import chain_instrumentation
 from generativeaiexamples_tpu.retrieval.store import Document
+from generativeaiexamples_tpu.server import guardrails
 from generativeaiexamples_tpu.server.base import BaseExample
 from generativeaiexamples_tpu.server.registry import register_example
 
@@ -90,6 +91,7 @@ class BasicRAG(BaseExample):
         context_text = trim_context([d.content for d, _ in hits],
                                     self.ctx.embedder.tokenizer,
                                     rcfg.max_context_tokens)
+        guardrails.record_context(context_text)
         system = self.ctx.prompts["rag_template"].format(context=context_text)
         messages = ([{"role": "system", "content": system}]
                     + list(chat_history) + [{"role": "user", "content": query}])

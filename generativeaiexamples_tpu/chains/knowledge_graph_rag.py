@@ -28,6 +28,7 @@ import re
 from typing import Any, Dict, Iterator, List, Sequence, Tuple
 
 from generativeaiexamples_tpu.chains.basic_rag import _sampling, trim_context
+from generativeaiexamples_tpu.server import guardrails
 from generativeaiexamples_tpu.chains.context import ChainContext, get_context
 from generativeaiexamples_tpu.chains.loaders import load_document
 from generativeaiexamples_tpu.core.tracing import chain_instrumentation
@@ -211,6 +212,7 @@ class KnowledgeGraphRAG(BaseExample):
             score_threshold=self.ctx.config.retriever.score_threshold)
         context = trim_context([d.content for d, _ in hits],
                                self.ctx.embedder.tokenizer, 1500)
+        guardrails.record_context(context)
         system = ANSWER_PROMPT.format(
             triples="\n".join(triples) if triples else "(none found)",
             context=context or "(no passages retrieved)")

@@ -21,6 +21,7 @@ import logging
 from typing import Any, Dict, Iterator, List, Sequence
 
 from generativeaiexamples_tpu.chains.basic_rag import _sampling, trim_context
+from generativeaiexamples_tpu.server import guardrails
 from generativeaiexamples_tpu.chains.context import ChainContext, get_context
 from generativeaiexamples_tpu.chains.loaders import load_document
 from generativeaiexamples_tpu.core.tracing import chain_instrumentation
@@ -119,9 +120,11 @@ class MultiTurnRAG(BaseExample):
         # the combined prompt never exceeds max_context_tokens
         history_text = trim_context(history, tok, budget // 2)
         context_budget = budget - len(tok.encode(history_text))
+        context_text = trim_context(context, tok, context_budget)
+        guardrails.record_context(context_text)
         system = self.ctx.prompts["multi_turn_rag_template"].format(
             history=history_text or "(none)",
-            context=trim_context(context, tok, context_budget) or "(none)")
+            context=context_text or "(none)")
         messages = [{"role": "system", "content": system},
                     {"role": "user", "content": query}]
 

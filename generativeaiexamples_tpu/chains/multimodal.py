@@ -25,6 +25,7 @@ import os
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
 from generativeaiexamples_tpu.chains.basic_rag import _sampling, trim_context
+from generativeaiexamples_tpu.server import guardrails
 from generativeaiexamples_tpu.chains.context import ChainContext, get_context
 from generativeaiexamples_tpu.chains.multimodal_parsers import (
     Element, image_summary, parse_multimodal)
@@ -210,6 +211,7 @@ class MultimodalRAG(BaseExample):
             chunks.append(prefix + d.content)
         context_text = trim_context(chunks, self.ctx.embedder.tokenizer,
                                     rcfg.max_context_tokens)
+        guardrails.record_context(context_text)
         system = self.ctx.prompts["multimodal_rag_template"].format(
             context=context_text)
         messages = [{"role": "system", "content": system},

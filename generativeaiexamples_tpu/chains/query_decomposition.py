@@ -28,6 +28,7 @@ from generativeaiexamples_tpu.chains.context import ChainContext, get_context
 from generativeaiexamples_tpu.chains.loaders import load_document
 from generativeaiexamples_tpu.core.tracing import chain_instrumentation
 from generativeaiexamples_tpu.retrieval.store import Document
+from generativeaiexamples_tpu.server import guardrails
 from generativeaiexamples_tpu.server.base import BaseExample
 from generativeaiexamples_tpu.server.registry import register_example
 
@@ -231,10 +232,15 @@ class QueryDecompositionRAG(BaseExample):
                 break
 
         parts = [f"Question: {question}\n", "Sub Questions and Answers"]
+        qa_lines = []
         for q, a in zip(ledger.question_trace, ledger.answer_trace):
-            parts.append(f"Sub Question: {q}")
-            parts.append(f"Sub Answer: {a}")
+            qa_lines.append(f"Sub Question: {q}")
+            qa_lines.append(f"Sub Answer: {a}")
+        parts.extend(qa_lines)
         parts.append("\nFinal Answer: ")
+        # the final answer is generated from this sub-Q/A evidence — the
+        # fact-check rail must judge against it, not a fresh retrieval
+        guardrails.record_context("\n".join(qa_lines))
         return "\n".join(parts)
 
     # ----------------------------------------------------------- generation

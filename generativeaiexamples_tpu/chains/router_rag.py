@@ -21,6 +21,7 @@ import logging
 from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 from generativeaiexamples_tpu.chains.basic_rag import _sampling, trim_context
+from generativeaiexamples_tpu.server import guardrails
 from generativeaiexamples_tpu.chains.context import ChainContext, get_context
 from generativeaiexamples_tpu.chains.loaders import load_document
 from generativeaiexamples_tpu.core.tracing import chain_instrumentation
@@ -147,6 +148,7 @@ class RouterRAG(BaseExample):
             return
         parts = self._gather(query, decision)
         context = trim_context(parts, self.ctx.embedder.tokenizer, 1500)
+        guardrails.record_context(context)
         messages = ([{"role": "system",
                       "content": SYNTH_PROMPT.format(
                           context=context or "(no sources returned results)")}]

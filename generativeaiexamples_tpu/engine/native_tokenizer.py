@@ -151,6 +151,17 @@ _GPT2_PATTERN = (r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+"
                  r"| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+")
 
 
+def _normalizer_is_noop(norm: dict) -> bool:
+    """True only for normalizer configs that provably change nothing: an
+    empty Sequence, or a Sequence of empty Sequences. Real normalizers
+    (NFC/NFD/Replace/...) must make the caller raise so get_tokenizer falls
+    back to HFTokenizer, which applies them."""
+    if norm.get("type") == "Sequence":
+        return all(_normalizer_is_noop(n)
+                   for n in norm.get("normalizers", []) or [])
+    return False
+
+
 def _detect_pre_tokenizer(pre: dict) -> tuple:
     """Map a tokenizer.json pre_tokenizer config onto a native scanner mode.
 
@@ -236,6 +247,13 @@ class NativeBPETokenizer:
         model = spec.get("model", {})
         if model.get("type") != "BPE":
             raise ValueError(f"unsupported tokenizer model {model.get('type')!r}")
+        norm = spec.get("normalizer")
+        if norm is not None and not _normalizer_is_noop(norm):
+            # Qwen-style configs pair ByteLevel BPE with an NFC normalizer;
+            # encoding without it would silently diverge from HF ids, so
+            # refuse and let get_tokenizer fall back to HFTokenizer
+            raise ValueError(
+                f"unsupported normalizer {norm.get('type')!r}")
         pre = spec.get("pre_tokenizer") or {}
         self._mode, self._add_prefix_space = _detect_pre_tokenizer(pre)
 

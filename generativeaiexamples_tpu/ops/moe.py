@@ -21,9 +21,10 @@ Shapes (N = B*S tokens, E experts, C capacity, D model, F hidden):
 
 Tokens beyond an expert's capacity are dropped for that expert (classic
 Switch semantics) — the residual connection carries them through, and the
-load-balance auxiliary loss (Switch §2.2: E * mean(fraction) ·
-mean(router_prob)) pushes the router toward uniform load so drops stay
-rare. ``capacity_factor`` trades padding FLOPs for drop rate.
+load-balance auxiliary loss (Switch Eq.4: E * sum_i fraction_i ·
+mean_router_prob_i, ~1.0 at uniform routing) pushes the router toward
+uniform load so drops stay rare. ``capacity_factor`` trades padding FLOPs
+for drop rate.
 """
 
 from __future__ import annotations
@@ -98,10 +99,12 @@ def moe_mlp(params: Params, x: jnp.ndarray, k: int = 2,
     gate_vals = gate_vals / jnp.maximum(
         gate_vals.sum(-1, keepdims=True), 1e-9)              # renormalize
 
-    # load-balance aux loss (Switch): fraction of tokens FIRST-routed to
-    # each expert x mean router prob, scaled by E
+    # load-balance aux loss (Switch Eq.4): E * sum_i f_i * P_i, where f_i is
+    # the fraction of tokens FIRST-routed to expert i and P_i the mean router
+    # prob — ~1.0 at uniform routing regardless of E, so a tuned coefficient
+    # transfers across expert counts
     first_choice = jax.nn.one_hot(expert_ix[:, 0], E)        # (N, E)
-    aux = E * jnp.mean(first_choice.mean(0) * probs.mean(0))
+    aux = E * jnp.sum(first_choice.mean(0) * probs.mean(0))
 
     # --- dispatch tensor -------------------------------------------------
     # slot of token n in expert e = number of earlier (token, choice) pairs

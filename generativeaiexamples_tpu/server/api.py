@@ -35,6 +35,7 @@ from aiohttp import web
 from generativeaiexamples_tpu.core.metrics import REGISTRY
 from generativeaiexamples_tpu.core.tracing import instrumentation_wrapper
 from generativeaiexamples_tpu.server.base import BaseExample
+from generativeaiexamples_tpu.server import guardrails as guardrails_mod
 from generativeaiexamples_tpu.server.common import (
     MAX_TOKENS_CAP, StreamDrain, health_handler, metrics_handler,
 )
@@ -148,8 +149,14 @@ class ChainServer:
                     # output rails (fact-check / scrub) need the complete
                     # answer: buffer, check, emit once — rails trade
                     # streaming latency for verification by design
+                    guardrails_mod.take_context()  # clear any stale record
                     answer = "".join(chain(query, history, **settings))
-                    context = self._rails_context(query) if use_kb else ""
+                    # fact-check against the context the chain actually
+                    # prompted with; re-retrieve only for chains that don't
+                    # record one
+                    context = guardrails_mod.take_context() if use_kb else ""
+                    if context is None:
+                        context = self._rails_context(query)
                     yield self.guardrails.check_output(answer, context, query)
                     return
                 yield from chain(query, history, **settings)

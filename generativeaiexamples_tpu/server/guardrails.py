@@ -28,6 +28,7 @@ before (`from_config` returns None).
 
 from __future__ import annotations
 
+import contextvars
 import dataclasses
 import logging
 import re
@@ -36,6 +37,26 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 logger = logging.getLogger(__name__)
+
+# Retrieval context handoff: chains record the exact context they prompted
+# with so the fact-check rail judges the answer against what the model
+# actually saw, instead of re-running retrieval (which doubles embedder/
+# store work and can fetch different chunks). Context-local, so concurrent
+# requests on different threads never see each other's context.
+_retrieved_context: contextvars.ContextVar[Optional[str]] = (
+    contextvars.ContextVar("rails_retrieved_context", default=None))
+
+
+def record_context(text: str) -> None:
+    """Called by chains right after building their retrieval context."""
+    _retrieved_context.set(text)
+
+
+def take_context() -> Optional[str]:
+    """Return and clear the recorded context (None if no chain recorded)."""
+    text = _retrieved_context.get()
+    _retrieved_context.set(None)
+    return text
 
 
 @dataclasses.dataclass

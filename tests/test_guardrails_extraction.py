@@ -192,6 +192,29 @@ def test_server_output_rails_buffer_and_apply(embedder):
     assert "24V DC" in fact_llm.calls[0][-1]["content"]
 
 
+def test_server_output_rails_use_recorded_context(embedder):
+    """A chain that records its retrieval context hands exactly that text to
+    the fact-check rail; the server must not re-run document_search."""
+    from generativeaiexamples_tpu.server.api import ChainServer
+
+    class Example:
+        def rag_chain(self, query, history, **kw):
+            gr.record_context("The pump operates on 48V AC.")
+            yield "The pump uses 48V."
+        llm_chain = rag_chain
+
+        def document_search(self, query, top_k=4):
+            raise AssertionError(
+                "rails must reuse the chain's context, not re-retrieve")
+
+    fact_llm = FakeLLM(["TRUE"])
+    rails = gr.Guardrails(fact_check=gr.FactCheckRail(fact_llm))
+    server = ChainServer(Example(), guardrails=rails)
+    body = _drive_generate(server, "What voltage does the pump use?")
+    assert "48V" in body
+    assert "48V AC" in fact_llm.calls[0][-1]["content"]
+
+
 def test_server_rails_failure_yields_canned_error(embedder):
     """An embedder crash inside the input rail must produce the canned
     error chunk inside a well-formed SSE stream, not a truncated one."""

@@ -106,6 +106,24 @@ def test_unknown_model_type_raises(tmp_path):
         NativeBPETokenizer(str(p))
 
 
+def test_normalizer_raises_so_hf_fallback_applies_it(trained, tmp_path):
+    """Qwen-style configs pair ByteLevel BPE with an NFC normalizer; the
+    native core doesn't normalize, so it must refuse rather than silently
+    encode different ids than HF. An empty Sequence normalizer is a no-op
+    and stays accepted."""
+    path, _ = trained
+    spec = json.loads(open(path).read())
+    spec["normalizer"] = {"type": "NFC"}
+    p = tmp_path / "tokenizer.json"
+    p.write_text(json.dumps(spec))
+    with pytest.raises(ValueError, match="normalizer"):
+        NativeBPETokenizer(str(p))
+
+    spec["normalizer"] = {"type": "Sequence", "normalizers": []}
+    p.write_text(json.dumps(spec))
+    NativeBPETokenizer(str(p))  # no-op shape: accepted
+
+
 # ------------------------------------------------------ llama-3 split mode
 
 @pytest.fixture(scope="module")
