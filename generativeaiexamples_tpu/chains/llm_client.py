@@ -40,6 +40,24 @@ class LocalLLM:
         if req.error:
             raise RuntimeError(f"LLM request failed: {req.error}")
 
+    def chat_tools(self, messages: Sequence[Dict], tools: Sequence[Dict],
+                   tool_choice="auto", **sampling) -> Dict:
+        """One tool-capable turn → an OpenAI-shaped assistant message:
+        {"role": "assistant", "content": str|None, "tool_calls": [...]?}.
+        Same prompt-render/parse mechanics as the /v1 server
+        (engine/tools.py), minus the HTTP."""
+        from generativeaiexamples_tpu.engine import tools as tools_mod
+
+        msgs = tools_mod.normalize_messages(messages)
+        if tools and tool_choice != "none":
+            msgs = tools_mod.inject_tool_prompt(msgs, tools, tool_choice)
+        text = "".join(self.chat(msgs, **sampling))
+        calls = (tools_mod.parse_tool_calls(text, tools)
+                 if tools and tool_choice != "none" else None)
+        if calls:
+            return {"role": "assistant", "content": None, "tool_calls": calls}
+        return {"role": "assistant", "content": text}
+
 
 class RemoteLLM:
     """OpenAI-compatible /v1 client (the reference's server_url path)."""
@@ -76,6 +94,23 @@ class RemoteLLM:
                 content = delta.get("content")
                 if content:
                     yield content
+
+    def chat_tools(self, messages: Sequence[Dict], tools: Sequence[Dict],
+                   tool_choice="auto", **sampling) -> Dict:
+        """One tool-capable turn against the remote /v1 server; returns the
+        assistant message (with `tool_calls` when the model called one)."""
+        import httpx
+
+        payload = {"model": self.model, "messages": list(messages),
+                   "stream": False, **sampling}
+        if tools:
+            payload["tools"] = list(tools)
+            payload["tool_choice"] = tool_choice
+        resp = httpx.post(f"{self.base_url}/v1/chat/completions",
+                          json=payload, timeout=120.0)
+        resp.raise_for_status()
+        data = resp.json()
+        return data["choices"][0]["message"]
 
 
 @lru_cache(maxsize=1)

@@ -49,37 +49,34 @@ _OPS = {
 
 
 def extract_json(text: str) -> Optional[Dict[str, Any]]:
-    """First balanced JSON object in `text` (models wrap JSON in prose).
+    """First balanced JSON OBJECT in `text` (models wrap JSON in prose).
 
-    The brace counter is string-aware: braces inside string values (e.g. a
-    bash agent's ``{"cmd": "grep '}' src.c"}``) must not close the scan."""
-    start = text.find("{")
-    while start != -1:
-        depth = 0
-        in_string = False
-        escaped = False
-        for i in range(start, len(text)):
-            ch = text[i]
-            if in_string:
-                if escaped:
-                    escaped = False
-                elif ch == "\\":
-                    escaped = True
-                elif ch == '"':
-                    in_string = False
-                continue
-            if ch == '"':
-                in_string = True
-            elif ch == "{":
-                depth += 1
-            elif ch == "}":
-                depth -= 1
-                if depth == 0:
-                    try:
-                        return json.loads(text[start:i + 1])
-                    except json.JSONDecodeError:
-                        break
-        start = text.find("{", start + 1)
+    Delegates to the serving layer's string-aware scanner
+    (engine/tools.py:extract_json_value — one scanner to keep
+    bug-compatible, there and here), skipping over non-object values:
+    chains expect a dict."""
+    from generativeaiexamples_tpu.engine.tools import extract_json_value
+
+    def first_dict(value):
+        if isinstance(value, dict):
+            return value
+        if isinstance(value, list):   # models wrap the object in an array
+            for v in value:
+                d = first_dict(v)
+                if d is not None:
+                    return d
+        return None
+
+    pos = 0
+    while pos < len(text):
+        found = extract_json_value(text[pos:])
+        if found is None:
+            return None
+        value, (_, end) = found
+        d = first_dict(value)
+        if d is not None:
+            return d
+        pos += end
     return None
 
 

@@ -1,0 +1,148 @@
+"""VLM streaming alerts — watch a frame stream for user-defined conditions.
+
+Capability parity with the reference's vision alerting workflow
+(ref: vision_workflows/README.md — "VLM Alerts: send frames + an alert
+prompt to the VLM NIM; it answers whether the alert condition is present,
+and transitions fire notifications"; community variants stream RTSP into
+the same loop).
+
+TPU-first mechanics: per-frame yes/no VLM chat would waste the chip on
+1-image batches, so the default detector scores frames with the CLIP
+towers — alert condition vs. its negation as a zero-shot text pair, one
+batched GEMM for a whole window of frames — and only ESCALATES frames
+that cross the trigger to the (expensive) VLM captioner for the alert
+message. Hysteresis + cooldown turn per-frame scores into clean events:
+an alert fires on sustained presence, clears on sustained absence, and
+cannot machine-gun notifications.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class AlertRule:
+    """One watched condition, phrased as the positive/negative text pair
+    CLIP scores against (the zero-shot trick the NV-CLIP workflow uses)."""
+    name: str
+    condition: str                  # e.g. "a fire is burning"
+    negation: str = ""              # default: "no {condition}"
+    threshold: float = 0.6          # P(condition) to count a frame as hot
+    trigger_frames: int = 2         # consecutive hot frames to raise
+    clear_frames: int = 4           # consecutive cold frames to clear
+    cooldown_s: float = 10.0        # min seconds between raises
+
+    def __post_init__(self) -> None:
+        if not self.negation:
+            self.negation = f"no {self.condition}"
+
+
+@dataclasses.dataclass
+class AlertEvent:
+    rule: str
+    kind: str                       # "raised" | "cleared"
+    frame_index: int
+    score: float
+    message: str = ""
+    at: float = 0.0
+
+
+class _RuleState:
+    def __init__(self) -> None:
+        self.active = False
+        self.hot = 0
+        self.cold = 0
+        self.last_raise = -1e18
+
+
+class AlertMonitor:
+    """Scores frames against every rule in one batched pass and emits
+    raise/clear events with hysteresis."""
+
+    def __init__(self, rules: Sequence[AlertRule], embedder=None,
+                 describe: Optional[Callable[[bytes, str], str]] = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        from generativeaiexamples_tpu.encoders.vision import ImageEmbedder
+
+        if not rules:
+            raise ValueError("AlertMonitor needs at least one rule")
+        self.rules = list(rules)
+        self.embedder = embedder if embedder is not None else ImageEmbedder()
+        # escalation hook: alert frame -> human-readable message (a VLM
+        # captioner; optional because raising alone is the core workflow)
+        self.describe = describe
+        self.clock = clock
+        self._states = {r.name: _RuleState() for r in self.rules}
+        texts = [t for r in self.rules for t in (r.condition, r.negation)]
+        tvecs = np.asarray(self.embedder.embed_texts(texts))
+        self._pos = tvecs[0::2]               # (R, D)
+        self._neg = tvecs[1::2]
+        self._frame_index = 0
+
+    # ----------------------------------------------------------- scoring
+
+    def score_frames(self, frames: Sequence[bytes]) -> np.ndarray:
+        """(F, R) P(condition) per frame per rule: softmax over the
+        condition/negation pair of CLIP logits — one GEMM per window."""
+        ivecs = np.asarray(self.embedder.embed_images(frames))   # (F, D)
+        pos = ivecs @ self._pos.T                                # (F, R)
+        neg = ivecs @ self._neg.T
+        # CLIP-style temperature sharpens the pairwise softmax
+        scale = 100.0
+        return 1.0 / (1.0 + np.exp(-scale * (pos - neg) / 2.0))
+
+    # ------------------------------------------------------------ events
+
+    def process(self, frames: Sequence[bytes]) -> List[AlertEvent]:
+        """Feed a window of frames; returns the events they caused."""
+        if not frames:
+            return []
+        scores = self.score_frames(frames)
+        events: List[AlertEvent] = []
+        for f, frame in enumerate(frames):
+            idx = self._frame_index
+            self._frame_index += 1
+            now = self.clock()
+            for r, rule in enumerate(self.rules):
+                st = self._states[rule.name]
+                p = float(scores[f, r])
+                if p >= rule.threshold:
+                    st.hot += 1
+                    st.cold = 0
+                else:
+                    st.cold += 1
+                    st.hot = 0
+                if (not st.active and st.hot >= rule.trigger_frames
+                        and now - st.last_raise >= rule.cooldown_s):
+                    st.active = True
+                    st.last_raise = now
+                    message = ""
+                    if self.describe is not None:
+                        try:
+                            message = self.describe(frame, rule.condition)
+                        except Exception:
+                            logger.exception("alert describe failed")
+                    events.append(AlertEvent(rule=rule.name, kind="raised",
+                                             frame_index=idx, score=p,
+                                             message=message, at=now))
+                elif st.active and st.cold >= rule.clear_frames:
+                    st.active = False
+                    events.append(AlertEvent(rule=rule.name, kind="cleared",
+                                             frame_index=idx, score=p,
+                                             at=now))
+        return events
+
+    def watch(self, stream: Iterable[Sequence[bytes]]
+              ) -> Iterator[AlertEvent]:
+        """Drive an iterator of frame windows (e.g. a video tap yielding a
+        window per second) and yield events as they fire."""
+        for window in stream:
+            yield from self.process(window)

@@ -4,7 +4,11 @@ API parity with the surface the reference's clients consume
 (`ChatNVIDIA(base_url=...)` speaks OpenAI `/v1`; ref RAG/src/chain_server/
 utils.py:366-399 and docker-compose-nim-ms.yaml:2-28):
 
-  * POST /v1/chat/completions   — messages → chat template → streamed or whole
+  * POST /v1/chat/completions   — messages → chat template → streamed or whole;
+                                  `tools`/`tool_choice` → `tool_calls`,
+                                  `response_format` json modes (engine/tools.py —
+                                  the NIM tool-calling surface the reference's
+                                  agent notebooks consume)
   * POST /v1/completions        — raw prompt completion
   * GET  /v1/models             — served model card
   * GET  /health                — liveness (compose healthcheck parity,
@@ -13,7 +17,9 @@ utils.py:366-399 and docker-compose-nim-ms.yaml:2-28):
 
 Streaming uses `text/event-stream` with `data: {chunk}\n\n` frames and a
 final `data: [DONE]`, matching the OpenAI SSE contract the reference's
-LangChain clients parse.
+LangChain clients parse. Tool-call and JSON-mode requests buffer the
+generation before replying (the output's shape isn't known until it is
+parsed); plain chat streams token deltas as before.
 """
 
 from __future__ import annotations
@@ -21,10 +27,11 @@ from __future__ import annotations
 import json
 import time
 import uuid
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from aiohttp import web
 
+from generativeaiexamples_tpu.engine import tools as tools_mod
 from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
 from generativeaiexamples_tpu.server.common import (
     MAX_TOKENS_CAP, StreamDrain, health_handler, metrics_handler, sse_done,
@@ -83,8 +90,35 @@ class ModelServer:
         if not messages:
             raise web.HTTPBadRequest(text=json.dumps(
                 {"error": "messages must be non-empty"}))
+        thinking = body.get("thinking")
+        if thinking is not None:
+            # nemotron detailed-thinking toggle (ref: nemotron/
+            # llama_3.3_nemotron_super_49B/README.md — the model family is
+            # steered by a literal "detailed thinking on|off" system line)
+            messages = ([{"role": "system",
+                          "content": "detailed thinking "
+                                     + ("on" if thinking else "off")}]
+                        + list(messages))
+        tools = body.get("tools") or []
+        tool_choice = body.get("tool_choice", "auto" if tools else "none")
+        response_format = body.get("response_format") or {}
+        json_mode = response_format.get("type") in ("json_object", "json_schema")
+        name = tools_mod.forced_name(tool_choice)
+        if name and name not in tools_mod.tool_names(tools):
+            raise web.HTTPBadRequest(text=json.dumps(
+                {"error": f"tool_choice names unknown tool {name!r}"}))
+        messages = tools_mod.normalize_messages(messages)
+        use_tools = bool(tools) and tool_choice != "none"
+        if use_tools:
+            messages = tools_mod.inject_tool_prompt(messages, tools, tool_choice)
+        if json_mode:
+            # with tools, the JSON constraint scopes to non-tool replies
+            messages = tools_mod.inject_json_prompt(
+                messages, response_format, with_tools=use_tools)
         prompt_ids = self.scheduler.tokenizer.apply_chat_template(messages)
-        return await self._run(request, body, prompt_ids, chat=True)
+        return await self._run(request, body, prompt_ids, chat=True,
+                               tools=tools if use_tools else [],
+                               json_mode=json_mode)
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
         body = await request.json()
@@ -95,7 +129,9 @@ class ModelServer:
     # --------------------------------------------------------------- serving
 
     async def _run(self, request: web.Request, body: Dict[str, Any],
-                   prompt_ids, chat: bool) -> web.StreamResponse:
+                   prompt_ids, chat: bool,
+                   tools: Optional[List[Dict[str, Any]]] = None,
+                   json_mode: bool = False) -> web.StreamResponse:
         sampling = self._parse_sampling(body)
         req = Request(prompt_ids=list(prompt_ids), **sampling)
         rid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
@@ -103,13 +139,32 @@ class ModelServer:
         self.scheduler.submit(req)
         drain = StreamDrain(self.scheduler.iter_text(req))
 
-        if not stream:
+        if not stream or tools or json_mode:
+            # tool/JSON requests buffer even under stream=True: whether the
+            # output is a tool call is only known once it parses
             text = await drain.join_text()
             if req.error:
-                raise web.HTTPServiceUnavailable(text=json.dumps({"error": req.error}))
-            choice: Dict[str, Any] = {"index": 0, "finish_reason": "stop"}
+                if not stream:
+                    raise web.HTTPServiceUnavailable(
+                        text=json.dumps({"error": req.error}))
+                return await self._stream_error(request, rid, req.error)
+            tool_calls = (tools_mod.parse_tool_calls(text, tools)
+                          if tools else None)
+            if json_mode and not tool_calls:
+                found = tools_mod.extract_json_value(text)
+                if found is not None:
+                    text = json.dumps(found[0])
+            finish = "tool_calls" if tool_calls else "stop"
+            message: Dict[str, Any] = {"role": "assistant",
+                                       "content": None if tool_calls else text}
+            if tool_calls:
+                message["tool_calls"] = tool_calls
+            if stream:
+                return await self._stream_buffered(request, rid, message,
+                                                   finish)
+            choice: Dict[str, Any] = {"index": 0, "finish_reason": finish}
             if chat:
-                choice["message"] = {"role": "assistant", "content": text}
+                choice["message"] = message
             else:
                 choice["text"] = text
             return web.json_response({
@@ -121,12 +176,7 @@ class ModelServer:
                           "total_tokens": len(prompt_ids) + req.completion_tokens},
             })
 
-        resp = web.StreamResponse(headers={
-            "Content-Type": "text/event-stream",
-            "Cache-Control": "no-cache",
-            "Connection": "keep-alive",
-        })
-        await resp.prepare(request)
+        resp = await self._sse_response(request)
         if chat:
             await sse_write(resp, _chunk(self.model_name, rid, {"role": "assistant"}))
         async for delta in drain:
@@ -138,6 +188,46 @@ class ModelServer:
         final = json.loads(_chunk(self.model_name, rid, {}, finish))
         if req.error:
             final["error"] = req.error
+        await sse_write(resp, json.dumps(final))
+        await sse_done(resp)
+        return resp
+
+    @staticmethod
+    async def _sse_response(request: web.Request) -> web.StreamResponse:
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "Connection": "keep-alive",
+        })
+        await resp.prepare(request)
+        return resp
+
+    async def _stream_buffered(self, request: web.Request, rid: str,
+                               message: Dict[str, Any],
+                               finish: str) -> web.StreamResponse:
+        """Replay a buffered tool/JSON result as a conforming SSE stream:
+        role chunk, one delta carrying the whole content / tool_calls
+        (OpenAI clients accumulate deltas, so a single full delta decodes
+        identically), then the finish chunk."""
+        resp = await self._sse_response(request)
+        await sse_write(resp, _chunk(self.model_name, rid, {"role": "assistant"}))
+        delta: Dict[str, Any] = {}
+        if message.get("tool_calls"):
+            delta["tool_calls"] = [
+                {"index": i, **call}
+                for i, call in enumerate(message["tool_calls"])]
+        else:
+            delta["content"] = message.get("content") or ""
+        await sse_write(resp, _chunk(self.model_name, rid, delta))
+        await sse_write(resp, _chunk(self.model_name, rid, {}, finish))
+        await sse_done(resp)
+        return resp
+
+    async def _stream_error(self, request: web.Request, rid: str,
+                            error: str) -> web.StreamResponse:
+        resp = await self._sse_response(request)
+        final = json.loads(_chunk(self.model_name, rid, {}, "error"))
+        final["error"] = error
         await sse_write(resp, json.dumps(final))
         await sse_done(resp)
         return resp

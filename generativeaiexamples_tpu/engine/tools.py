@@ -1,0 +1,230 @@
+"""OpenAI tool calling + JSON mode for the serving layer.
+
+Capability parity with the NIM tool-calling surface the reference's agent
+notebooks consume (`tools` / `tool_choice` / `tool_calls` /
+`response_format`; ref: RAG/notebooks/langchain/
+Agent_use_tools_leveraging_NVIDIA_AI_endpoints.ipynb and
+NIM_tool_call_HumanInTheLoop_MultiAgents.ipynb bind tools through the
+OpenAI schema and read `message.tool_calls` back).
+
+Mechanism: tools are rendered into the system prompt as JSON schemas with
+a strict output contract (the llama-3 style of tool use — the template
+teaches the model to answer with a single JSON object when it wants a
+tool), and the generated text is parsed back into structured
+`tool_calls`. Parsing is deliberately forgiving about the shapes tuned
+models actually emit ({"name","arguments"} | {"name","parameters"} |
+{"tool_calls":[...]} | a bare list), but strict about unknown tool names
+— a hallucinated tool comes back as plain content, never as a bogus call.
+
+JSON mode (`response_format={"type":"json_object"}`) rides the same
+prompt+extract path: the first balanced JSON value in the output is the
+response. Token-level grammar masking is intentionally NOT done here: the
+engine fuses 8 decode steps per dispatch (the throughput design point,
+scheduler.py), and a per-token host round trip to mask logits would undo
+exactly that; the extract-or-retry loop lives one level up
+(chains/extraction.py) where retries are cheap.
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+TOOL_PROMPT = """\
+You have access to the following tools. To call a tool, respond with ONLY \
+a JSON object of the form {{"tool_calls": [{{"name": "<tool name>", \
+"arguments": {{...}}}}]}} and nothing else. Call a tool only when it helps \
+answer the request; otherwise reply normally in plain text.
+
+Tools:
+{tools}"""
+
+TOOL_REQUIRED = ("\nYou MUST call one of the tools — a plain-text reply is "
+                 "not acceptable for this request.")
+TOOL_NAMED = ("\nYou MUST call the tool named {name!r} — no other tool and "
+              "no plain-text reply.")
+
+JSON_PROMPT = ("Respond with ONLY a single valid JSON object — no prose, "
+               "no code fences.")
+JSON_SCHEMA_PROMPT = ("Respond with ONLY a single valid JSON object matching "
+                      "this JSON schema — no prose, no code fences:\n{schema}")
+JSON_WITH_TOOLS_PREFIX = ("When you are NOT calling a tool, your reply must "
+                          "follow this rule: ")
+
+
+def _tool_lines(tools: Sequence[Dict[str, Any]]) -> str:
+    lines = []
+    for t in tools:
+        fn = t.get("function", t)
+        lines.append(json.dumps({
+            "name": fn.get("name", ""),
+            "description": fn.get("description", ""),
+            "parameters": fn.get("parameters", {}),
+        }))
+    return "\n".join(lines)
+
+
+def tool_names(tools: Sequence[Dict[str, Any]]) -> List[str]:
+    return [t.get("function", t).get("name", "") for t in tools]
+
+
+def forced_name(tool_choice) -> Optional[str]:
+    """The tool name a {"type":"function","function":{"name":...}} choice
+    pins, else None."""
+    if isinstance(tool_choice, dict):
+        return tool_choice.get("function", {}).get("name")
+    return None
+
+
+def inject_tool_prompt(messages: Sequence[Dict[str, Any]],
+                       tools: Sequence[Dict[str, Any]],
+                       tool_choice) -> List[Dict[str, Any]]:
+    """Prepend/extend the system message with the tool contract."""
+    text = TOOL_PROMPT.format(tools=_tool_lines(tools))
+    if tool_choice == "required":
+        text += TOOL_REQUIRED
+    name = forced_name(tool_choice)
+    if name:
+        text += TOOL_NAMED.format(name=name)
+    return _with_system_suffix(messages, text)
+
+
+def inject_json_prompt(messages: Sequence[Dict[str, Any]],
+                       response_format: Dict[str, Any],
+                       with_tools: bool = False) -> List[Dict[str, Any]]:
+    """``with_tools`` scopes the constraint to non-tool-call replies so the
+    two output contracts (tool_calls JSON vs. content JSON) don't clash."""
+    if response_format.get("type") == "json_schema":
+        schema = response_format.get("json_schema", {}).get("schema", {})
+        text = JSON_SCHEMA_PROMPT.format(schema=json.dumps(schema))
+    else:
+        text = JSON_PROMPT
+    if with_tools:
+        text = JSON_WITH_TOOLS_PREFIX + text
+    return _with_system_suffix(messages, text)
+
+
+def _with_system_suffix(messages: Sequence[Dict[str, Any]],
+                        suffix: str) -> List[Dict[str, Any]]:
+    out = [dict(m) for m in messages]
+    for m in out:
+        if m.get("role") == "system":
+            m["content"] = f"{m.get('content', '')}\n\n{suffix}"
+            return out
+    return [{"role": "system", "content": suffix}] + out
+
+
+def normalize_messages(messages: Sequence[Dict[str, Any]]
+                       ) -> List[Dict[str, Any]]:
+    """Render OpenAI tool-protocol messages into template-friendly text:
+    an assistant turn carrying `tool_calls` becomes its JSON contract form
+    (so the model sees its own past calls the way it was taught to emit
+    them), and `role:"tool"` results keep their role with the tool name
+    prefixed."""
+    out: List[Dict[str, Any]] = []
+    for m in messages:
+        role = m.get("role", "user")
+        if role == "assistant" and m.get("tool_calls"):
+            calls = [{"name": c.get("function", {}).get("name", ""),
+                      "arguments": _parse_args(
+                          c.get("function", {}).get("arguments"))}
+                     for c in m["tool_calls"]]
+            out.append({"role": "assistant",
+                        "content": json.dumps({"tool_calls": calls})})
+        elif role == "tool":
+            name = m.get("name", "")
+            prefix = f"[{name}] " if name else ""
+            out.append({"role": "tool",
+                        "content": f"{prefix}{m.get('content', '')}"})
+        else:
+            out.append({"role": role, "content": m.get("content", "") or ""})
+    return out
+
+
+def _parse_args(arguments) -> Dict[str, Any]:
+    if isinstance(arguments, dict):
+        return arguments
+    if isinstance(arguments, str):
+        try:
+            parsed = json.loads(arguments)
+            return parsed if isinstance(parsed, dict) else {"value": parsed}
+        except ValueError:
+            return {"raw": arguments}
+    return {}
+
+
+# ---------------------------------------------------------------- parsing
+
+def extract_json_value(text: str) -> Optional[Tuple[Any, Tuple[int, int]]]:
+    """First balanced JSON object/array in ``text`` → (value, (start, end)).
+
+    A bracket scanner (string/escape aware) finds candidate spans; only
+    spans that json-parse count, so ``{"a": 1} trailing prose`` and fenced
+    ```json blocks both work without regex fragility."""
+    for start, opener in ((i, c) for i, c in enumerate(text) if c in "{["):
+        closer = "}" if opener == "{" else "]"
+        depth = 0
+        in_str = False
+        escape = False
+        for j in range(start, len(text)):
+            c = text[j]
+            if escape:
+                escape = False
+            elif c == "\\":
+                escape = in_str
+            elif c == '"':
+                in_str = not in_str
+            elif not in_str:
+                if c in "{[":
+                    depth += 1
+                elif c in "]}":
+                    depth -= 1
+                    if depth == 0:
+                        if c != closer:
+                            break  # mismatched nesting; try the next start
+                        try:
+                            return (json.loads(text[start:j + 1]),
+                                    (start, j + 1))
+                        except ValueError:
+                            break
+        # unbalanced from this start; try the next opener
+    return None
+
+
+def parse_tool_calls(text: str, tools: Sequence[Dict[str, Any]]
+                     ) -> Optional[List[Dict[str, Any]]]:
+    """Structured tool calls in ``text``, or None when it is plain content.
+
+    Returns the OpenAI wire shape: [{"id", "type": "function",
+    "function": {"name", "arguments": <json string>}}]."""
+    found = extract_json_value(text)
+    if found is None:
+        return None
+    value, _ = found
+    if isinstance(value, dict) and isinstance(value.get("tool_calls"), list):
+        raw_calls = value["tool_calls"]
+    elif isinstance(value, dict) and "name" in value and (
+            "arguments" in value or "parameters" in value):
+        raw_calls = [value]
+    elif isinstance(value, list) and value and all(
+            isinstance(v, dict) and "name" in v for v in value):
+        raw_calls = value
+    else:
+        return None
+    known = set(tool_names(tools))
+    calls = []
+    for rc in raw_calls:
+        if not isinstance(rc, dict):
+            return None
+        name = rc.get("name")
+        if name not in known:
+            return None   # hallucinated tool: treat the text as content
+        args = rc.get("arguments", rc.get("parameters", {}))
+        calls.append({
+            "id": f"call_{uuid.uuid4().hex[:12]}",
+            "type": "function",
+            "function": {"name": name,
+                         "arguments": json.dumps(_parse_args(args))},
+        })
+    return calls or None

@@ -1,0 +1,222 @@
+"""Schema-driven synthetic datasets + PII-safe synthesis.
+
+Capability parity with the reference's data-design stack
+(ref: nemo/NeMo-Data-Designer/*.ipynb — declare a dataset as typed columns:
+category samplers with weights, numeric ranges, templated strings, and
+LLM-generated text columns that reference earlier columns; generate N rows;
+ref: nemo/NeMo-Safe-Synthesizer/*.ipynb — detect and replace PII so the
+synthesized data is safe to share, with consistent surrogates so joins
+survive).
+
+The managed microservices become two in-tree pieces:
+
+  * :class:`DataDesigner` — column specs resolved in dependency order, one
+    deterministic RNG per run; LLM columns batch through the in-proc chat
+    seam and depend on any earlier columns via {placeholders}.
+  * :class:`PIIScrubber` — pattern detectors (email, phone, SSN, credit
+    card, IP, dates-of-birth markers) with CONSISTENT surrogate
+    replacement: the same original value maps to the same fake across the
+    whole dataset (the Safe-Synthesizer property that keeps referential
+    integrity), and surrogates are format-preserving where it matters.
+
+Rows are plain dicts; `to_jsonl` writes the interchange format the SDG /
+fine-tuning pipelines (evaluation/sdg.py, train/*_ft.py) consume — the
+flywheel's data inlet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import random
+import re
+import string
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+# ------------------------------------------------------------- column specs
+
+@dataclasses.dataclass
+class CategoryColumn:
+    """Weighted categorical sampler (Data-Designer 'category' column)."""
+    name: str
+    values: Sequence[Any]
+    weights: Optional[Sequence[float]] = None
+
+    def sample(self, rng: random.Random, row: Dict[str, Any]) -> Any:
+        return rng.choices(list(self.values),
+                           weights=self.weights, k=1)[0]
+
+
+@dataclasses.dataclass
+class IntColumn:
+    name: str
+    low: int
+    high: int            # inclusive
+
+    def sample(self, rng: random.Random, row: Dict[str, Any]) -> int:
+        return rng.randint(self.low, self.high)
+
+
+@dataclasses.dataclass
+class FloatColumn:
+    name: str
+    low: float
+    high: float
+    ndigits: int = 2
+
+    def sample(self, rng: random.Random, row: Dict[str, Any]) -> float:
+        return round(rng.uniform(self.low, self.high), self.ndigits)
+
+
+@dataclasses.dataclass
+class TemplateColumn:
+    """str.format over earlier columns (Data-Designer 'expression')."""
+    name: str
+    template: str
+
+    def sample(self, rng: random.Random, row: Dict[str, Any]) -> str:
+        return self.template.format(**row)
+
+
+@dataclasses.dataclass
+class LambdaColumn:
+    """Arbitrary python over the partial row (escape hatch)."""
+    name: str
+    fn: Callable[[random.Random, Dict[str, Any]], Any]
+
+    def sample(self, rng: random.Random, row: Dict[str, Any]) -> Any:
+        return self.fn(rng, row)
+
+
+@dataclasses.dataclass
+class LLMColumn:
+    """LLM-generated text column; the prompt may reference earlier columns.
+    (Data-Designer 'llm-text' column over the in-proc chat seam.)"""
+    name: str
+    prompt: str
+    llm: Any = None                  # chat(messages, **kw) -> Iterator[str]
+    max_tokens: int = 128
+    temperature: float = 0.8
+
+    def sample(self, rng: random.Random, row: Dict[str, Any]) -> str:
+        if self.llm is None:
+            raise ValueError(f"LLMColumn {self.name!r} needs an llm")
+        prompt = self.prompt.format(**row)
+        return "".join(self.llm.chat(
+            [{"role": "user", "content": prompt}],
+            max_tokens=self.max_tokens,
+            temperature=self.temperature)).strip()
+
+
+class DataDesigner:
+    """Generate rows column-by-column in declaration order (each column
+    sees the columns declared before it, the Data-Designer dependency
+    model)."""
+
+    def __init__(self, columns: Sequence[Any], seed: int = 0) -> None:
+        names = [c.name for c in columns]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate column names in {names}")
+        self.columns = list(columns)
+        self.seed = seed
+
+    def generate(self, n: int,
+                 scrubber: Optional["PIIScrubber"] = None
+                 ) -> List[Dict[str, Any]]:
+        rng = random.Random(self.seed)
+        rows = []
+        for _ in range(n):
+            row: Dict[str, Any] = {}
+            for col in self.columns:
+                try:
+                    row[col.name] = col.sample(rng, row)
+                except KeyError as exc:
+                    raise ValueError(
+                        f"column {col.name!r} references {exc} before it "
+                        f"is defined — order columns by dependency") from exc
+            rows.append(row)
+        if scrubber is not None:
+            rows = scrubber.scrub_rows(rows)
+        return rows
+
+
+def to_jsonl(rows: Sequence[Dict[str, Any]], path: str) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        for row in rows:
+            fh.write(json.dumps(row) + "\n")
+
+
+# ------------------------------------------------------------ PII scrubbing
+
+_PII_PATTERNS = (
+    ("email", re.compile(r"\b[\w.+-]+@[\w-]+\.[\w.-]+\b")),
+    ("ssn", re.compile(r"\b\d{3}-\d{2}-\d{4}\b")),
+    ("credit_card", re.compile(r"\b(?:\d[ -]?){13,16}\b")),
+    ("phone", re.compile(r"\b(?:\+?\d{1,2}[ .-]?)?(?:\(\d{3}\)|\d{3})"
+                         r"[ .-]?\d{3}[ .-]?\d{4}\b")),
+    ("ip", re.compile(r"\b(?:\d{1,3}\.){3}\d{1,3}\b")),
+)
+
+
+class PIIScrubber:
+    """Detect PII and replace with consistent, format-plausible surrogates.
+
+    The same original value always maps to the same surrogate (seeded by a
+    keyed hash), so identities stay joinable across rows/columns after
+    scrubbing — the Safe-Synthesizer consistency property. Detection is
+    pattern-based; `extra_patterns` adds deployment-specific detectors
+    (employee ids, MRNs, ...)."""
+
+    def __init__(self, seed: int = 0,
+                 extra_patterns: Sequence = ()) -> None:
+        self.seed = seed
+        self.patterns = list(_PII_PATTERNS) + [
+            (name, re.compile(p) if isinstance(p, str) else p)
+            for name, p in extra_patterns]
+        self.stats: Dict[str, int] = {}
+
+    def _rng_for(self, kind: str, value: str) -> random.Random:
+        digest = hashlib.sha256(
+            f"{self.seed}:{kind}:{value}".encode()).digest()
+        return random.Random(int.from_bytes(digest[:8], "big"))
+
+    def _surrogate(self, kind: str, value: str) -> str:
+        rng = self._rng_for(kind, value)
+        if kind == "email":
+            user = "".join(rng.choices(string.ascii_lowercase, k=8))
+            return f"{user}@example.com"
+        if kind == "ssn":
+            # 900-999 area numbers are never issued: visibly synthetic
+            return (f"9{rng.randint(0, 99):02d}-{rng.randint(10, 99)}-"
+                    f"{rng.randint(1000, 9999)}")
+        if kind == "credit_card":
+            return "4000-" + "-".join(
+                f"{rng.randint(0, 9999):04d}" for _ in range(3))
+        if kind == "phone":
+            return f"555-{rng.randint(200, 999)}-{rng.randint(1000, 9999)}"
+        if kind == "ip":
+            return f"203.0.113.{rng.randint(1, 254)}"   # TEST-NET-3
+        token = "".join(rng.choices(string.ascii_uppercase, k=6))
+        return f"[{kind}:{token}]"
+
+    def scrub_text(self, text: str) -> str:
+        for kind, pattern in self.patterns:
+            def repl(m, kind=kind):
+                self.stats[kind] = self.stats.get(kind, 0) + 1
+                return self._surrogate(kind, m.group(0))
+
+            text = pattern.sub(repl, text)
+        return text
+
+    def scrub_rows(self, rows: Sequence[Dict[str, Any]]
+                   ) -> List[Dict[str, Any]]:
+        out = []
+        for row in rows:
+            out.append({k: self.scrub_text(v) if isinstance(v, str) else v
+                        for k, v in row.items()})
+        return out

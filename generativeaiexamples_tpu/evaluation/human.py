@@ -1,0 +1,240 @@
+"""Human evaluation flow — task files, rating collection, agreement stats.
+
+Capability parity with the reference's human-evaluation workflow
+(ref: nemo/HumanEvaluation/*.ipynb — export model outputs into a labeling
+tool, collect per-item ratings and pairwise preferences from human raters,
+aggregate into quality numbers next to the machine eval). The Label Studio
+dependency is replaced by plain JSONL task/rating files (any labeling tool
+— or a spreadsheet — can round-trip them) plus an in-terminal rating loop,
+and the aggregation adds the statistic the reference leaves implicit:
+inter-rater agreement (Cohen's kappa), without which a human-eval mean is
+an anecdote.
+
+Flow:  build_tasks(answers) → tasks.jsonl → raters produce ratings.jsonl
+(one row per (task, rater)) → aggregate(tasks, ratings) → report dict.
+Pairwise A/B tasks randomize side order (position-bias control) and the
+aggregate un-shuffles before computing win rates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+from collections import defaultdict
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+DEFAULT_RUBRIC = ("helpfulness", "groundedness", "fluency")
+RATING_SCALE = (1, 5)
+
+
+@dataclasses.dataclass
+class HumanTask:
+    """One unit of human work: rate a single answer against the rubric, or
+    pick between two answers (system comparison)."""
+
+    task_id: str
+    question: str
+    answer_a: str
+    answer_b: str = ""                   # non-empty => pairwise task
+    system_a: str = "a"                  # which system produced side A
+    system_b: str = ""
+    context: str = ""                    # retrieval evidence, if any
+    rubric: Sequence[str] = DEFAULT_RUBRIC
+
+    @property
+    def pairwise(self) -> bool:
+        return bool(self.answer_b)
+
+
+def build_tasks(rows: Sequence[Dict[str, Any]],
+                rubric: Sequence[str] = DEFAULT_RUBRIC,
+                seed: int = 0) -> List[HumanTask]:
+    """Rows: {"question", "answer", "context"?} for single-answer rating,
+    or {"question", "answers": {system: answer}, "context"?} for pairwise —
+    two systems per task, sides shuffled per item."""
+    rng = random.Random(seed)
+    tasks: List[HumanTask] = []
+    for i, row in enumerate(rows):
+        tid = f"task-{i:04d}"
+        if "answers" in row:
+            systems = sorted(row["answers"])
+            if len(systems) != 2:
+                raise ValueError(f"pairwise rows need exactly 2 systems, "
+                                 f"got {systems}")
+            a, b = systems
+            if rng.random() < 0.5:
+                a, b = b, a              # position-bias control
+            tasks.append(HumanTask(
+                task_id=tid, question=row["question"],
+                answer_a=row["answers"][a], answer_b=row["answers"][b],
+                system_a=a, system_b=b,
+                context=row.get("context", ""), rubric=tuple(rubric)))
+        else:
+            tasks.append(HumanTask(
+                task_id=tid, question=row["question"],
+                answer_a=row["answer"], context=row.get("context", ""),
+                rubric=tuple(rubric)))
+    return tasks
+
+
+def write_tasks(tasks: Sequence[HumanTask], path: str) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        for t in tasks:
+            fh.write(json.dumps(dataclasses.asdict(t)) + "\n")
+
+
+def read_tasks(path: str) -> List[HumanTask]:
+    out = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            if line.strip():
+                d = json.loads(line)
+                d["rubric"] = tuple(d.get("rubric", DEFAULT_RUBRIC))
+                out.append(HumanTask(**d))
+    return out
+
+
+def write_ratings(ratings: Iterable[Dict[str, Any]], path: str) -> None:
+    with open(path, "a", encoding="utf-8") as fh:
+        for r in ratings:
+            fh.write(json.dumps(r) + "\n")
+
+
+def read_ratings(path: str) -> List[Dict[str, Any]]:
+    out = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            if line.strip():
+                out.append(json.loads(line))
+    return out
+
+
+# ------------------------------------------------------------- aggregate
+
+def cohen_kappa(labels_a: Sequence, labels_b: Sequence) -> float:
+    """Agreement between two raters over the same items, chance-corrected.
+    Returns 1.0 on perfect agreement, ~0 at chance level."""
+    if len(labels_a) != len(labels_b) or not labels_a:
+        raise ValueError("need two equal, non-empty label sequences")
+    n = len(labels_a)
+    values = sorted(set(labels_a) | set(labels_b))
+    po = sum(1 for x, y in zip(labels_a, labels_b) if x == y) / n
+    pe = sum((labels_a.count(v) / n) * (labels_b.count(v) / n)
+             for v in values)
+    if pe >= 1.0:
+        return 1.0
+    return (po - pe) / (1.0 - pe)
+
+
+def aggregate(tasks: Sequence[HumanTask],
+              ratings: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Rating rows:
+      single:   {"task_id", "rater", "scores": {criterion: 1..5}}
+      pairwise: {"task_id", "rater", "preferred": "a"|"b"|"tie"}
+    Returns mean rubric scores, per-system win rates (side-unshuffled),
+    coverage, and mean pairwise Cohen's kappa between rater pairs."""
+    by_id = {t.task_id: t for t in tasks}
+    rubric_scores: Dict[str, List[float]] = defaultdict(list)
+    wins: Dict[str, float] = defaultdict(float)
+    n_pairwise = 0
+    prefs_by_rater: Dict[str, Dict[str, str]] = defaultdict(dict)
+    rated_tasks = set()
+
+    for r in ratings:
+        task = by_id.get(r.get("task_id", ""))
+        if task is None:
+            raise ValueError(f"rating for unknown task {r.get('task_id')!r}")
+        rated_tasks.add(task.task_id)
+        if task.pairwise:
+            pref = r.get("preferred")
+            if pref not in ("a", "b", "tie"):
+                raise ValueError(f"bad preference {pref!r} for "
+                                 f"{task.task_id}")
+            n_pairwise += 1
+            wins.setdefault(task.system_a, 0.0)
+            wins.setdefault(task.system_b, 0.0)
+            prefs_by_rater[str(r.get("rater", ""))][task.task_id] = pref
+            if pref == "tie":
+                wins[task.system_a] += 0.5
+                wins[task.system_b] += 0.5
+            else:
+                wins[task.system_a if pref == "a" else task.system_b] += 1.0
+        else:
+            for crit, score in (r.get("scores") or {}).items():
+                if crit not in task.rubric:
+                    raise ValueError(f"unknown criterion {crit!r} for "
+                                     f"{task.task_id}")
+                score = float(score)
+                if not RATING_SCALE[0] <= score <= RATING_SCALE[1]:
+                    raise ValueError(f"score {score} outside "
+                                     f"{RATING_SCALE} for {task.task_id}")
+                rubric_scores[crit].append(score)
+
+    kappas = []
+    raters = sorted(prefs_by_rater)
+    for i in range(len(raters)):
+        for j in range(i + 1, len(raters)):
+            shared = sorted(set(prefs_by_rater[raters[i]])
+                            & set(prefs_by_rater[raters[j]]))
+            if len(shared) >= 2:
+                kappas.append(cohen_kappa(
+                    [prefs_by_rater[raters[i]][t] for t in shared],
+                    [prefs_by_rater[raters[j]][t] for t in shared]))
+
+    return {
+        "n_tasks": len(tasks),
+        "n_rated": len(rated_tasks),
+        "coverage": len(rated_tasks) / len(tasks) if tasks else 0.0,
+        "rubric_means": {c: sum(v) / len(v)
+                         for c, v in sorted(rubric_scores.items())},
+        "win_rates": ({s: w / n_pairwise for s, w in sorted(wins.items())}
+                      if n_pairwise else {}),
+        "inter_rater_kappa": (sum(kappas) / len(kappas)
+                              if kappas else None),
+    }
+
+
+# ----------------------------------------------------------- terminal UI
+
+def rate_interactive(tasks: Sequence[HumanTask], rater: str,
+                     out_path: str, input_fn=input,
+                     print_fn=print) -> int:
+    """Minimal in-terminal rating loop (the in-tree stand-in for the
+    labeling tool): walks tasks, appends rating rows to ``out_path``.
+    Returns the number of ratings recorded; 'q' quits early."""
+    done = 0
+    for task in tasks:
+        print_fn(f"\n=== {task.task_id} ===\nQ: {task.question}")
+        if task.context:
+            print_fn(f"[context] {task.context[:500]}")
+        if task.pairwise:
+            print_fn(f"A: {task.answer_a}\nB: {task.answer_b}")
+            ans = input_fn("prefer [a/b/tie/q]: ").strip().lower()
+            if ans == "q":
+                break
+            if ans not in ("a", "b", "tie"):
+                print_fn("skipped")
+                continue
+            write_ratings([{"task_id": task.task_id, "rater": rater,
+                            "preferred": ans}], out_path)
+        else:
+            print_fn(f"A: {task.answer_a}")
+            scores = {}
+            quit_now = False
+            for crit in task.rubric:
+                ans = input_fn(f"{crit} [1-5/q]: ").strip().lower()
+                if ans == "q":
+                    quit_now = True
+                    break
+                try:
+                    scores[crit] = int(ans)
+                except ValueError:
+                    continue
+            if quit_now:
+                break
+            if scores:
+                write_ratings([{"task_id": task.task_id, "rater": rater,
+                                "scores": scores}], out_path)
+        done += 1
+    return done

@@ -139,9 +139,13 @@ def initialize_distributed(coordinator_address: str = "",
 
 def _default_slice_id(device) -> int:
     """Which DCN island a device belongs to: TPU slices expose
-    ``slice_index``; everything else degrades to the owning process."""
+    ``slice_index``; everything else degrades to the owning process.
+    CPU backends report a constant slice_index even across processes (the
+    multi-process CPU test rig), so only TPUs trust it."""
     sid = getattr(device, "slice_index", None)
-    return sid if sid is not None else device.process_index
+    if sid is None or device.platform != "tpu":
+        return device.process_index
+    return sid
 
 
 def create_hybrid_mesh(axes: Tuple[str, ...],

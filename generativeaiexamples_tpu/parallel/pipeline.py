@@ -15,10 +15,12 @@ every stage runs its local layer chunk on what it received, and the last
 stage banks its output for microbatch ``t - (S - 1)``. The bubble fraction
 is (S-1)/(M+S-1) — callers pick M ≥ S for sane utilization.
 
-Scope: the dense decoder block stack (mlp glu/plain). Everything outside
-the blocks (embedding, final norm, unembed) runs outside the shard_map on
-replicated parameters, so only the deep per-layer weights are
-stage-sharded — exactly the memory that motivates PP.
+Scope: the decoder block stack — dense (mlp glu/plain) AND MoE blocks
+(experts stay stage-local; only the scalar load-balance aux crosses
+stages). Everything outside the blocks (embedding, final norm, unembed)
+runs outside the shard_map on replicated parameters, so only the deep
+per-layer weights are stage-sharded — exactly the memory that motivates
+PP.
 """
 
 from __future__ import annotations
@@ -74,30 +76,35 @@ def place_staged_params(params: Params, cfg: llama.LlamaConfig,
 
 def _run_stage(cfg: llama.LlamaConfig, layers_local: Params,
                x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray
-               ) -> jnp.ndarray:
-    """Run this stage's (L/S)-layer chunk (full causal attention)."""
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Run this stage's (L/S)-layer chunk (full causal attention).
+    Returns (x, aux) — aux is this chunk's summed MoE load-balance loss
+    (0 for dense blocks), so MoE models pipeline like dense ones: experts
+    stay stage-local (the routing einsums need no collectives) and only
+    the scalar aux crosses stages, via the final psum."""
     attn = partial(mha_prefill, causal=True, window=cfg.sliding_window)
 
-    def body(h, layer):
-        h, _ = llama._block(cfg, h, layer, cos, sin, attn, {})
-        return h, None
+    def body(carry, layer):
+        h, aux = carry
+        h, layer_aux = llama._block(cfg, h, layer, cos, sin, attn, {})
+        return (h, aux + layer_aux), None
 
-    x, _ = jax.lax.scan(body, x, layers_local)
-    return x
+    (x, aux), _ = jax.lax.scan(body, (x, jnp.float32(0.0)), layers_local)
+    return x, aux
 
 
 def pipelined_forward(params: Params, cfg: llama.LlamaConfig,
                       tokens: jnp.ndarray, mesh: Mesh,
-                      n_microbatches: int = 0) -> jnp.ndarray:
+                      n_microbatches: int = 0,
+                      return_aux: bool = False):
     """Causal-LM logits with the block stack pipelined over mesh["stage"].
 
     ``params`` must come from :func:`place_staged_params`. tokens (B, S);
     B must divide by (data-axis size x n_microbatches). Default
-    n_microbatches = 2 x stages (bubble ≤ 1/3).
+    n_microbatches = 2 x stages (bubble ≤ 1/3). MoE blocks compose:
+    experts are stage-local, and ``return_aux=True`` returns
+    (logits, load-balance aux) on the same scale as llama.forward's.
     """
-    if cfg.mlp == "moe":
-        raise NotImplementedError("pipeline over MoE blocks: route experts "
-                                  "with the expert axis instead")
     S_stages = int(mesh.shape["stage"])
     B, S = tokens.shape
     per_shard = B // int(mesh.shape.get("data", 1))
@@ -121,7 +128,7 @@ def pipelined_forward(params: Params, cfg: llama.LlamaConfig,
 
     @partial(jax.shard_map, mesh=mesh,
              in_specs=(P("stage"), P("data"), P("data"), P("data")),
-             out_specs=P("data"), check_vma=False)
+             out_specs=(P("data"), P()), check_vma=False)
     def run(layers_stage, h_local, cos_local, sin_local):
         # layers_stage leaves: (1, L/S, ...) → (L/S, ...)
         layers_local = jax.tree.map(lambda w: w[0], layers_stage)
@@ -134,7 +141,7 @@ def pipelined_forward(params: Params, cfg: llama.LlamaConfig,
         out = jnp.zeros_like(mb)
 
         def tick(carry, t):
-            state, out = carry
+            state, out, aux = carry
             # receive from the previous stage (one-hop ring shift)
             received = jax.lax.ppermute(
                 state, "stage",
@@ -144,7 +151,12 @@ def pipelined_forward(params: Params, cfg: llama.LlamaConfig,
             # positions are microbatch-dependent: stage s processes
             # microbatch (t - s) at tick t
             m_ix = jnp.clip(t - stage, 0, M - 1)
-            x = _run_stage(cfg, layers_local, x, cos_mb[m_ix], sin_mb[m_ix])
+            x, tick_aux = _run_stage(cfg, layers_local, x,
+                                     cos_mb[m_ix], sin_mb[m_ix])
+            # bubble ticks run on zero/garbage activations: their router
+            # statistics must not leak into the load-balance loss
+            valid = (t >= stage) & (t - stage <= M - 1)
+            aux = aux + jnp.where(valid, tick_aux, 0.0)
             # last stage banks microbatch t-(S-1)
             o_ix = t - (S_stages - 1)
             bank = ((stage == S_stages - 1) & (o_ix >= 0))
@@ -152,15 +164,25 @@ def pipelined_forward(params: Params, cfg: llama.LlamaConfig,
                 bank,
                 lambda o: o.at[jnp.clip(o_ix, 0, M - 1)].set(x),
                 lambda o: o, out)
-            return (x, out), None
+            return (x, out, aux), None
 
-        (_, out), _ = jax.lax.scan(tick, (state, out),
-                                   jnp.arange(M + S_stages - 1))
-        # only the last stage holds real outputs; share them along the ring
+        (_, out, aux), _ = jax.lax.scan(tick, (state, out, jnp.float32(0.0)),
+                                        jnp.arange(M + S_stages - 1))
+        # only the last stage holds real outputs; share them along the ring.
+        # aux: each stage owns its layers' contribution — sum over stages,
+        # then normalize to llama.forward's per-layer-per-batch scale
+        # (each of M microbatches crossed all n_layers once)
         out = jax.lax.psum(
             jnp.where(stage == S_stages - 1, out, jnp.zeros_like(out)),
             "stage")
-        return out.reshape(h_local.shape)
+        aux = jax.lax.psum(aux, "stage") / (cfg.n_layers * M)
+        # every data shard computed its own aux; average over "data" so the
+        # returned scalar is replicated (out_specs P() asserts that)
+        aux = jax.lax.pmean(aux, "data")
+        return out.reshape(h_local.shape), aux
 
-    h = run(params["layers"], h, cos, sin)
-    return llama._unembed(cfg, params, h)
+    h, aux = run(params["layers"], h, cos, sin)
+    logits = llama._unembed(cfg, params, h)
+    if return_aux:
+        return logits, aux
+    return logits

@@ -19,6 +19,7 @@ playground is where traces begin).
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 import os
@@ -35,9 +36,15 @@ STATIC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "static")
 
 
 class PlaygroundServer:
-    def __init__(self, chain_url: str, model_name: str = "tpu-llm") -> None:
+    def __init__(self, chain_url: str, model_name: str = "tpu-llm",
+                 speech=None) -> None:
+        from generativeaiexamples_tpu.speech.clients import get_speech
+
         self.chain_url = chain_url.rstrip("/")
         self.model_name = model_name
+        # voice loop (ref speech playground: record → ASR → converse → TTS);
+        # DisabledSpeech unless APP_SPEECH_SERVER_URL is configured
+        self.speech = speech if speech is not None else get_speech()
         self.app = web.Application(client_max_size=128 * 1024 * 1024)
         self.app.add_routes([
             web.get("/", self.index),
@@ -48,6 +55,9 @@ class PlaygroundServer:
             web.get("/api/documents", self.get_documents),
             web.post("/api/documents", self.upload_document),
             web.delete("/api/documents", self.delete_document),
+            web.post("/api/transcribe", self.transcribe),
+            web.get("/api/transcribe/stream", self.transcribe_stream),
+            web.post("/api/speak", self.speak),
             web.static("/static", STATIC_DIR),
         ])
         self.app.cleanup_ctx.append(self._client_ctx)
@@ -73,7 +83,85 @@ class PlaygroundServer:
 
     async def config(self, request: web.Request) -> web.Response:
         return web.json_response({"model_name": self.model_name,
-                                  "chain_url": self.chain_url})
+                                  "chain_url": self.chain_url,
+                                  "speech": self.speech.available()})
+
+    # ---------------------------------------------------------------- speech
+
+    async def transcribe(self, request: web.Request) -> web.Response:
+        """Whole-clip transcription: audio bytes in → {"text"} out (the
+        record-button path; ref asr_utils.py transcribe of the captured
+        buffer)."""
+        if not self.speech.available():
+            return web.json_response({"error": "speech disabled"}, status=501)
+        audio = await request.read()
+        if not audio:
+            return web.json_response({"error": "empty audio"}, status=422)
+        language = request.query.get("language", "en-US")
+        try:
+            with self._span("ui.transcribe"):
+                text = await asyncio.to_thread(
+                    self.speech.transcribe, audio, language)
+        except Exception as exc:
+            logger.exception("transcription failed")
+            return web.json_response({"error": str(exc)}, status=502)
+        return web.json_response({"text": text})
+
+    async def transcribe_stream(self, request: web.Request) -> web.WebSocketResponse:
+        """Streaming ASR websocket: binary frames = audio chunks, text
+        frame "end" = finalize. Sends {"partial"} transcripts as they
+        resolve and one {"final"} (ref asr_utils.py:117
+        transcribe_streaming's interim/final contract)."""
+        from generativeaiexamples_tpu.speech.clients import (
+            StreamingTranscriber)
+
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        if not self.speech.available():
+            await ws.send_json({"error": "speech disabled"})
+            await ws.close()
+            return ws
+        transcriber = StreamingTranscriber(
+            self.speech, language=request.query.get("language", "en-US"))
+        try:
+            async for msg in ws:
+                if msg.type == aiohttp.WSMsgType.BINARY:
+                    partial = await asyncio.to_thread(
+                        transcriber.feed, msg.data)
+                    if partial is not None:
+                        await ws.send_json({"partial": partial})
+                elif msg.type == aiohttp.WSMsgType.TEXT:
+                    if msg.data == "end":
+                        final = await asyncio.to_thread(transcriber.finalize)
+                        await ws.send_json({"final": final})
+                        break
+        except Exception as exc:
+            logger.exception("streaming transcription failed")
+            try:
+                await ws.send_json({"error": str(exc)})
+            except Exception:
+                pass   # client already gone; the close below is best-effort
+        await ws.close()
+        return ws
+
+    async def speak(self, request: web.Request) -> web.Response:
+        """TTS: {"text", "voice"?} → audio bytes (the speak-response path;
+        ref tts_utils.py:83)."""
+        if not self.speech.available():
+            return web.json_response({"error": "speech disabled"}, status=501)
+        body = await request.json()
+        text = str(body.get("text", "")).strip()
+        if not text:
+            return web.json_response({"error": "text required"}, status=422)
+        try:
+            with self._span("ui.speak"):
+                audio = await asyncio.to_thread(
+                    self.speech.synthesize, text,
+                    str(body.get("voice", "default")))
+        except Exception as exc:
+            logger.exception("synthesis failed")
+            return web.json_response({"error": str(exc)}, status=502)
+        return web.Response(body=audio, content_type="audio/wav")
 
     # ----------------------------------------------------------------- proxy
 

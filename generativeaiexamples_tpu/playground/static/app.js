@@ -111,6 +111,7 @@ $("chat-form").onsubmit = async (ev) => {
     const answer = await streamGenerate(question);
     state.history.push({ role: "user", content: question });
     state.history.push({ role: "assistant", content: answer });
+    speakText(answer);
   } catch (e) {
     addBubble("assistant", "Error: " + e);
   } finally {
@@ -188,7 +189,112 @@ $("upload-form").onsubmit = async (ev) => {
   refreshFiles();
 };
 
+// --------------------------------------------------------------- speech
+// Voice loop parity with the reference speech playground (record -> ASR ->
+// converse -> TTS, ref rag_playground/speech/{asr_utils,tts_utils}.py):
+// hold the mic button to stream audio chunks over the /api/transcribe/stream
+// websocket (live partial transcripts land in the input box); release to
+// finalize and submit. "Speak replies" plays each answer via /api/speak.
+const speech = { recorder: null, ws: null, wantStop: false };
+
+function micSupported() {
+  return navigator.mediaDevices && window.MediaRecorder;
+}
+
+async function startRecording() {
+  const stream = await navigator.mediaDevices.getUserMedia({ audio: true });
+  const proto = location.protocol === "https:" ? "wss" : "ws";
+  const ws = new WebSocket(`${proto}://${location.host}/api/transcribe/stream`);
+  ws.onmessage = (ev) => {
+    try {
+      const msg = JSON.parse(ev.data);
+      if (msg.partial !== undefined) $("msg").value = msg.partial;
+      if (msg.final !== undefined) {
+        $("msg").value = msg.final;
+        if (msg.final.trim()) $("chat-form").requestSubmit();
+      }
+      if (msg.error) $("msg").placeholder = "ASR error: " + msg.error;
+    } catch (e) { /* non-JSON frame */ }
+  };
+  const recorder = new MediaRecorder(stream);
+  // chunks recorded before the ws finishes connecting are buffered, not
+  // dropped — otherwise the first words of the utterance never reach ASR
+  const queue = [];
+  let ended = false;
+  const flush = () => {
+    while (queue.length) ws.send(queue.shift());
+    if (ended) ws.send("end");
+  };
+  ws.onopen = flush;
+  let chain = Promise.resolve();   // keeps chunk order across async decodes
+  recorder.ondataavailable = (ev) => {
+    if (!ev.data.size) return;
+    chain = chain.then(async () => {
+      queue.push(await ev.data.arrayBuffer());
+      if (ws.readyState === WebSocket.OPEN) flush();
+    });
+  };
+  recorder.onstop = () => {
+    chain = chain.then(() => {
+      ended = true;
+      if (ws.readyState === WebSocket.OPEN) flush();
+    });
+    stream.getTracks().forEach((t) => t.stop());
+  };
+  recorder.start(500);            // 500 ms chunks stream while talking
+  speech.recorder = recorder;
+  speech.ws = ws;
+  // released while the permission prompt was up: stop immediately —
+  // the mic must never stay live past the button release
+  if (speech.wantStop) stopRecording();
+}
+
+function stopRecording() {
+  speech.wantStop = true;
+  if (speech.recorder && speech.recorder.state !== "inactive")
+    speech.recorder.stop();
+  $("mic").classList.remove("recording");
+}
+
+async function speakText(text) {
+  if (!$("speak-replies").checked || !text) return;
+  try {
+    const resp = await fetch("/api/speak", {
+      method: "POST",
+      headers: { "Content-Type": "application/json" },
+      body: JSON.stringify({ text }),
+    });
+    if (!resp.ok) return;
+    const url = URL.createObjectURL(await resp.blob());
+    const audio = new Audio(url);
+    audio.onended = () => URL.revokeObjectURL(url);
+    audio.play().catch(() => {});
+  } catch (e) { /* TTS is best-effort */ }
+}
+
+function initSpeech(enabled) {
+  if (!enabled || !micSupported()) return;
+  $("mic").classList.remove("hidden");
+  $("speak-wrap").classList.remove("hidden");
+  const mic = $("mic");
+  // pointer events cover mouse AND touch (touch devices fire no mouseup
+  // on hold-release: the mic would stay live forever with mouse handlers)
+  mic.onpointerdown = (ev) => {
+    ev.preventDefault();
+    speech.wantStop = false;
+    mic.classList.add("recording");
+    startRecording().catch((e) => {
+      mic.classList.remove("recording");
+      $("msg").placeholder = "mic error: " + e;
+    });
+  };
+  mic.onpointerup = stopRecording;
+  mic.onpointercancel = stopRecording;
+  mic.onmouseleave = stopRecording;
+}
+
 // ----------------------------------------------------------------- init
 fetch("/api/config").then((r) => r.json()).then((cfg) => {
   $("model-name").textContent = cfg.model_name || "";
+  initSpeech(!!cfg.speech);
 }).catch(() => {});

@@ -202,10 +202,134 @@ class PgVectorStore:
             return int(cur.fetchone()[0])
 
 
+class _EsRest:
+    """Minimal Elasticsearch REST client (urllib; no vendored driver)."""
+
+    def __init__(self, url: str) -> None:
+        self.url = (url or "http://localhost:9200").rstrip("/")
+
+    def request(self, method: str, path: str, body=None) -> Dict:
+        import urllib.error
+        import urllib.request
+
+        if isinstance(body, str):            # NDJSON (_bulk)
+            data = body.encode()
+            ctype = "application/x-ndjson"
+        else:
+            data = json.dumps(body).encode() if body is not None else None
+            ctype = "application/json"
+        req = urllib.request.Request(
+            f"{self.url}{path}", method=method, data=data,
+            headers={"Content-Type": ctype})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as exc:
+            # surface the ES error BODY (e.g. resource_already_exists_
+            # exception) — HTTPError's str() is just "HTTP Error 400"
+            detail = exc.read().decode("utf-8", "replace")[:500]
+            raise RuntimeError(
+                f"elasticsearch {method} {path} -> {exc.code}: "
+                f"{detail}") from exc
+        return json.loads(payload) if payload else {}
+
+
+class ElasticsearchStore:
+    """Elasticsearch dense-vector kNN adapter (ref: RAG/examples/
+    local_deploy/docker-compose-vectordb.yaml:86-104 runs elasticsearch as a
+    first-class store next to Milvus/pgvector).
+
+    One index per collection: dense_vector (cosine) + content/source/
+    metadata fields; search is the ES 8 top-level ``knn`` query; deletes go
+    through ``_delete_by_query`` on the source keyword. The wire surface is
+    a single ``request(method, path, body)`` callable, so tests inject an
+    in-memory fake and deployments get the real REST endpoint with zero
+    extra dependencies."""
+
+    def __init__(self, dim: int, url: str = "http://localhost:9200",
+                 name: str = "default", client: Any = None) -> None:
+        self.dim = dim
+        self.index = f"gaie_{name}".lower()
+        self.client = client if client is not None else _EsRest(url)
+        try:
+            self.client.request("PUT", f"/{self.index}", {
+                "mappings": {"properties": {
+                    "embedding": {"type": "dense_vector", "dims": dim,
+                                  "index": True, "similarity": "cosine"},
+                    "content": {"type": "text"},
+                    "source": {"type": "keyword"},
+                    "metadata": {"type": "object", "enabled": False},
+                }}})
+        except Exception as exc:
+            # idempotent reconnect (Milvus/pgvector adapters' semantics):
+            # an existing index is fine, anything else is a real failure
+            if "resource_already_exists" not in str(exc):
+                raise
+
+    def add(self, docs: Sequence[Document], embeddings: np.ndarray) -> List[str]:
+        ids = []
+        lines = []
+        for doc, vec in zip(docs, np.asarray(embeddings, np.float32)):
+            pk = uuid.uuid4().hex
+            ids.append(pk)
+            lines.append(json.dumps({"index": {"_id": pk}}))
+            lines.append(json.dumps({
+                "embedding": vec.tolist(), "content": doc.content,
+                "source": str(doc.metadata.get("source", "")),
+                "metadata": doc.metadata}))
+        if lines:
+            # one _bulk round trip for the whole batch, not one per chunk
+            self.client.request("POST", f"/{self.index}/_bulk",
+                                "\n".join(lines) + "\n")
+            self.client.request("POST", f"/{self.index}/_refresh")
+        return ids
+
+    def search(self, query_embedding: np.ndarray, top_k: int = 4,
+               score_threshold: float = 0.0
+               ) -> List[Tuple[Document, float]]:
+        res = self.client.request("POST", f"/{self.index}/_search", {
+            "knn": {"field": "embedding",
+                    "query_vector": np.asarray(query_embedding,
+                                               np.float32).tolist(),
+                    "k": top_k, "num_candidates": max(top_k * 10, 100)},
+            "_source": ["content", "metadata"], "size": top_k})
+        hits = []
+        for h in res.get("hits", {}).get("hits", []):
+            # ES kNN cosine score is (1 + cos) / 2, already in [0, 1] —
+            # the same range the in-proc store reports
+            score = float(h.get("_score", 0.0))
+            if score < score_threshold:
+                continue
+            src = h.get("_source", {})
+            hits.append((Document(content=src.get("content", ""),
+                                  metadata=dict(src.get("metadata") or {})),
+                         score))
+        return hits
+
+    def list_sources(self) -> List[str]:
+        res = self.client.request("POST", f"/{self.index}/_search", {
+            "size": 0, "aggs": {"sources": {
+                "terms": {"field": "source", "size": 10000}}}})
+        buckets = (res.get("aggregations", {}).get("sources", {})
+                   .get("buckets", []))
+        return sorted(b["key"] for b in buckets if b.get("key"))
+
+    def delete_by_source(self, sources: Sequence[str]) -> int:
+        res = self.client.request(
+            "POST", f"/{self.index}/_delete_by_query?refresh=true",
+            {"query": {"terms": {"source": [str(s) for s in sources]}}})
+        return int(res.get("deleted", 0))
+
+    def __len__(self) -> int:
+        res = self.client.request("GET", f"/{self.index}/_count")
+        return int(res.get("count", 0))
+
+
 def make_store(dim: int, config, name: str = "default",
                client: Any = None):
-    """Backend dispatch on VectorStoreConfig.name (ref utils.py:220-250):
-    "tpu" (default, in-proc device-resident) | "milvus" | "pgvector"."""
+    """Backend dispatch on VectorStoreConfig.name (ref utils.py:220-250 +
+    the elasticsearch compose service): "tpu" (default, in-proc
+    device-resident) | "milvus" | "pgvector" | "elasticsearch"."""
     backend = (config.name or "tpu").lower()
     if backend in ("tpu", "inproc", "default"):
         from generativeaiexamples_tpu.retrieval.store import VectorStore
@@ -217,5 +341,8 @@ def make_store(dim: int, config, name: str = "default",
         return MilvusStore(dim=dim, url=config.url, name=name, client=client)
     if backend == "pgvector":
         return PgVectorStore(dim=dim, url=config.url, name=name, conn=client)
+    if backend in ("elasticsearch", "es"):
+        return ElasticsearchStore(dim=dim, url=config.url, name=name,
+                                  client=client)
     raise ValueError(f"unknown vector store backend {config.name!r} "
-                     f"(expected tpu|milvus|pgvector)")
+                     f"(expected tpu|milvus|pgvector|elasticsearch)")

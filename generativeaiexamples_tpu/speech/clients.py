@@ -1,4 +1,10 @@
-"""ASR/TTS client seams + the HTTP implementation and explicit opt-out."""
+"""ASR/TTS clients: HTTP implementation, streaming transcriber, opt-out.
+
+Consumed by the playground's voice loop (playground/app.py: record →
+/api/transcribe → converse → /api/speak, plus the /api/transcribe/stream
+websocket driving :class:`StreamingTranscriber`) — the same record/speak
+flow the reference's speech playground runs over Riva
+(ref: RAG/src/rag_playground/speech/{asr_utils,tts_utils}.py)."""
 
 from __future__ import annotations
 
@@ -77,6 +83,48 @@ class HTTPSpeechClient:
             timeout=self.timeout_s)
         resp.raise_for_status()
         return resp.content
+
+
+class StreamingTranscriber:
+    """Chunked streaming ASR over a batch transcription client.
+
+    Protocol parity with the reference's streaming recognizer (ref:
+    RAG/src/rag_playground/speech/asr_utils.py:117-167
+    `transcribe_streaming` — mic chunks stream in, interim transcripts
+    stream out, a final transcript lands at the end). Riva's server-side
+    streaming API is replaced by bounded re-transcription of the
+    accumulated audio: a partial is produced at most once per
+    ``interval_bytes`` of new audio, so the ASR cost stays O(n^2 / interval)
+    worst case with small constants instead of per-chunk. Partials are
+    FULL transcripts so far (Riva semantics — the consumer replaces, not
+    appends).
+    """
+
+    def __init__(self, asr: ASRClient, language: str = "en-US",
+                 interval_bytes: int = 64000) -> None:
+        if not asr.available():
+            raise RuntimeError(_SETUP_HINT)
+        self.asr = asr
+        self.language = language
+        self.interval_bytes = interval_bytes
+        self._chunks: List[bytes] = []
+        self._since_partial = 0
+
+    def feed(self, chunk: bytes) -> Optional[str]:
+        """Add an audio chunk; returns a fresh partial transcript when one
+        is due, else None."""
+        self._chunks.append(chunk)
+        self._since_partial += len(chunk)
+        if self._since_partial < self.interval_bytes:
+            return None
+        self._since_partial = 0
+        return self.asr.transcribe(b"".join(self._chunks), self.language)
+
+    def finalize(self) -> str:
+        """Final transcript over all audio fed so far."""
+        if not self._chunks:
+            return ""
+        return self.asr.transcribe(b"".join(self._chunks), self.language)
 
 
 def get_speech(url: Optional[str] = None):

@@ -1,0 +1,273 @@
+"""Tool-calling fine-tuning flywheel: synthesize traces → LoRA → measure.
+
+Capability parity with the reference's tool-calling data flywheel
+(ref: nemo/data-flywheel/tool-calling/*.ipynb — harvest/synthesize
+tool-call conversations, fine-tune with the NeMo Customizer, score
+function-name and argument accuracy with the Evaluator service). Here the
+whole loop is in-tree and TPU-native, mirroring the embedder flywheel
+(train/embedder_ft.py): synthesize → LoRA with the existing trainer →
+call-accuracy before/after as a printed fact.
+
+Traces use exactly the serving-side tool contract (engine/tools.py renders
+the prompt; parse_tool_calls scores the output), so a model tuned here is
+tuned for what `/v1/chat/completions` will actually ask of it —
+train/serve symmetry, the same property the embedder flywheel keeps with
+its QUERY_PREFIX/PASSAGE_PREFIX.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import random
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from generativeaiexamples_tpu.engine import tools as tools_mod
+from generativeaiexamples_tpu.train.data import Batch
+
+logger = logging.getLogger(__name__)
+
+# A compact tool catalog with templated invocations: enough surface (string
+# / number / enum args, multi-arg calls, no-tool distractors) to teach and
+# to measure the contract.
+CATALOG: List[Dict[str, Any]] = [
+    {"spec": {"type": "function", "function": {
+        "name": "get_weather",
+        "description": "Current weather for a city.",
+        "parameters": {"type": "object", "properties": {
+            "city": {"type": "string"}}, "required": ["city"]}}},
+     "queries": [("What's the weather in {city}?", {"city": ["Oslo", "Lima",
+                  "Osaka", "Quito", "Turin", "Perth", "Dakar", "Hanoi"]}),
+                 ("Is it raining in {city} right now?", {"city": ["Bergen",
+                  "Seattle", "Mumbai", "Leeds"]})]},
+    {"spec": {"type": "function", "function": {
+        "name": "calculator",
+        "description": "Evaluate an arithmetic expression.",
+        "parameters": {"type": "object", "properties": {
+            "expression": {"type": "string"}}, "required": ["expression"]}}},
+     "queries": [("What is {a} times {b}?", {"a": ["12", "7", "31", "54"],
+                                             "b": ["9", "17", "23", "3"]},
+                  lambda v: {"expression": f"{v['a']}*{v['b']}"}),
+                 ("Compute {a} plus {b}.", {"a": ["101", "44"],
+                                            "b": ["76", "19"]},
+                  lambda v: {"expression": f"{v['a']}+{v['b']}"})]},
+    {"spec": {"type": "function", "function": {
+        "name": "search_documents",
+        "description": "Search the knowledge base.",
+        "parameters": {"type": "object", "properties": {
+            "query": {"type": "string"},
+            "top_k": {"type": "integer"}}, "required": ["query"]}}},
+     "queries": [("Find docs about {topic}.", {"topic": ["pump torque",
+                  "ICI wiring", "coolant specs", "safety interlocks"]},
+                  lambda v: {"query": v["topic"], "top_k": 4})]},
+]
+
+# no-tool distractors: the model must answer in plain text
+PLAIN_QUERIES = [
+    ("Say hello.", "Hello!"),
+    ("What does TPU stand for?", "Tensor Processing Unit."),
+    ("Thanks for the help!", "You're welcome!"),
+    ("Write the word 'ready'.", "ready"),
+]
+
+
+def catalog_specs(catalog: Sequence[Dict] = CATALOG) -> List[Dict]:
+    return [entry["spec"] for entry in catalog]
+
+
+def generate_traces(n: int, seed: int = 0,
+                    catalog: Sequence[Dict] = CATALOG,
+                    plain_fraction: float = 0.25) -> List[Dict[str, Any]]:
+    """Synthesize tool-call conversations.
+
+    Each trace: {"query", "tool" (name or None), "arguments", "target"}
+    where target is the canonical assistant output under the serving
+    contract — the {"tool_calls": [...]} JSON, or the plain answer."""
+    rng = random.Random(seed)
+    traces: List[Dict[str, Any]] = []
+    for _ in range(n):
+        if rng.random() < plain_fraction:
+            query, answer = rng.choice(PLAIN_QUERIES)
+            traces.append({"query": query, "tool": None, "arguments": None,
+                           "target": answer})
+            continue
+        entry = rng.choice(list(catalog))
+        q = rng.choice(entry["queries"])
+        template, slots, builder = (q if len(q) == 3 else (*q, None))
+        values = {k: rng.choice(v) for k, v in slots.items()}
+        args = builder(values) if builder else dict(values)
+        name = entry["spec"]["function"]["name"]
+        target = json.dumps({"tool_calls": [
+            {"name": name, "arguments": args}]})
+        traces.append({"query": template.format(**values), "tool": name,
+                       "arguments": args, "target": target})
+    return traces
+
+
+# ------------------------------------------------------------------- data
+
+def trace_batches(traces: Sequence[Dict], tokenizer, *, batch_size: int,
+                  seq_len: int, epochs: int = 1, seed: int = 0,
+                  catalog: Sequence[Dict] = CATALOG) -> Iterator[Batch]:
+    """Fixed-shape SFT batches: prompt = the SAME chat template + tool
+    system prompt the server renders, completion = the canonical target
+    (loss only on the completion + EOS, mirroring train/data.py)."""
+    specs = catalog_specs(catalog)
+    encoded = []
+    dropped = 0
+    for t in traces:
+        messages = tools_mod.inject_tool_prompt(
+            [{"role": "user", "content": t["query"]}], specs, "auto")
+        prompt_ids = tokenizer.apply_chat_template(messages)
+        comp_ids = tokenizer.encode(t["target"]) + [tokenizer.eos_id]
+        ids = (list(prompt_ids) + comp_ids)[: seq_len + 1]
+        mask = ([0] * len(prompt_ids) + [1] * len(comp_ids))[: seq_len + 1]
+        if not any(mask):
+            dropped += 1   # prompt alone filled the window: nothing to learn
+            continue
+        encoded.append((ids, mask))
+    if dropped:
+        logger.warning("trace_batches: dropped %d/%d traces whose tool "
+                       "prompt left no room for the completion at "
+                       "seq_len=%d", dropped, len(traces), seq_len)
+    if not encoded:
+        raise ValueError(f"every trace's prompt exceeds seq_len={seq_len}; "
+                         "raise seq_len or shrink the tool catalog")
+    rng = random.Random(seed)
+    order = list(range(len(encoded)))
+    for _ in range(epochs):
+        rng.shuffle(order)
+        for i in range(0, len(order), batch_size):
+            idx = order[i:i + batch_size]
+            while len(idx) < batch_size:      # wrap-fill the tail
+                idx = idx + idx[: batch_size - len(idx)]
+            tokens = np.zeros((batch_size, seq_len + 1), np.int32)
+            mask = np.zeros((batch_size, seq_len + 1), np.float32)
+            for r, j in enumerate(idx):
+                ids, m = encoded[j]
+                tokens[r, :len(ids)] = ids
+                mask[r, :len(m)] = m
+            yield Batch(tokens=tokens, loss_mask=mask)
+
+
+# ------------------------------------------------------------------- eval
+
+def call_accuracy(generate: Callable[[List[Dict]], str],
+                  traces: Sequence[Dict],
+                  catalog: Sequence[Dict] = CATALOG) -> float:
+    """Fraction of traces where the model's output parses to EXACTLY the
+    expected call (function name AND arguments; for no-tool traces, to no
+    call at all) — the Evaluator-service scoring of the reference flywheel
+    reduced to its two hard criteria."""
+    if not traces:
+        return 0.0
+    specs = catalog_specs(catalog)
+    hits = 0
+    for t in traces:
+        messages = tools_mod.inject_tool_prompt(
+            [{"role": "user", "content": t["query"]}], specs, "auto")
+        text = generate(messages)
+        calls = tools_mod.parse_tool_calls(text, specs)
+        if t["tool"] is None:
+            hits += calls is None
+            continue
+        if not calls or len(calls) != 1:
+            continue
+        fn = calls[0]["function"]
+        if (fn["name"] == t["tool"]
+                and json.loads(fn["arguments"]) == t["arguments"]):
+            hits += 1
+    return hits / len(traces)
+
+
+def scheduler_generate(scheduler, max_tokens: int = 96
+                       ) -> Callable[[List[Dict]], str]:
+    """A `generate` callable over the serving scheduler (greedy)."""
+    def gen(messages: List[Dict]) -> str:
+        ids = scheduler.tokenizer.apply_chat_template(messages)
+        return scheduler.generate(ids, max_tokens=max_tokens,
+                                  temperature=0.0)
+    return gen
+
+
+# ---------------------------------------------------------------- flywheel
+
+@dataclasses.dataclass(frozen=True)
+class ToolcallFTConfig:
+    n_train: int = 256
+    n_eval: int = 64
+    seq_len: int = 768      # must hold the rendered tool prompt + target
+    batch_size: int = 8
+    epochs: int = 4
+    lora_rank: int = 8
+    learning_rate: float = 1e-4
+    seed: int = 0
+
+
+def run_flywheel(model_cfg, params, tokenizer,
+                 cfg: ToolcallFTConfig = ToolcallFTConfig(),
+                 eval_generate: Optional[Callable] = None,
+                 catalog: Sequence[Dict] = CATALOG) -> Dict[str, Any]:
+    """The full loop: synthesize → LoRA-tune → merge → score before/after.
+
+    Returns {"losses", "accuracy_before", "accuracy_after",
+    "merged_params"}. ``eval_generate(params) -> generate-callable`` lets
+    callers choose the eval harness (default: a fresh tiny serving
+    scheduler per side, greedy)."""
+    import jax
+
+    from generativeaiexamples_tpu.train.lora import LoraConfig
+    from generativeaiexamples_tpu.train.trainer import TrainConfig, Trainer
+
+    train = generate_traces(cfg.n_train, seed=cfg.seed, catalog=catalog)
+    heldout = generate_traces(cfg.n_eval, seed=cfg.seed + 1, catalog=catalog)
+
+    def _measure(p) -> float:
+        if eval_generate is not None:
+            return call_accuracy(eval_generate(p), heldout, catalog=catalog)
+        # default harness: a throwaway serving scheduler per side, STOPPED
+        # after scoring (its KV pool + driver thread must not outlive the
+        # measurement — two leaked pools per flywheel run would eventually
+        # OOM the chip)
+        from generativeaiexamples_tpu.core.config import EngineConfig
+        from generativeaiexamples_tpu.engine.engine import EngineCore
+        from generativeaiexamples_tpu.engine.scheduler import Scheduler
+        core = EngineCore(model_cfg,
+                          EngineConfig(max_batch_size=4,
+                                       max_seq_len=cfg.seq_len + 128,
+                                       page_size=16, prefill_chunk=64),
+                          jax.tree.map(lambda x: x, p),
+                          eos_id=tokenizer.eos_id)
+        sched = Scheduler(core, tokenizer)
+        sched.start()
+        try:
+            return call_accuracy(scheduler_generate(sched), heldout,
+                                 catalog=catalog)
+        finally:
+            sched.stop()
+
+    acc_before = _measure(params)
+
+    tcfg = TrainConfig(mode="lora", lora=LoraConfig(rank=cfg.lora_rank),
+                       micro_batch_size=cfg.batch_size,
+                       global_batch_size=cfg.batch_size,
+                       max_steps=10**9, warmup_steps=8,
+                       seq_len=cfg.seq_len,
+                       learning_rate=cfg.learning_rate)
+    trainer = Trainer(model_cfg, tcfg, params)
+    losses: List[float] = []
+    trainer.fit(trace_batches(
+        train, tokenizer, batch_size=cfg.batch_size, seq_len=cfg.seq_len,
+        epochs=cfg.epochs, seed=cfg.seed, catalog=catalog),
+        on_step=lambda _i, m: losses.append(m["loss"]))
+    merged = trainer.merged_params()
+    acc_after = _measure(merged)
+    logger.info("tool-call flywheel: accuracy %.3f -> %.3f (loss %.3f -> "
+                "%.3f)", acc_before, acc_after,
+                losses[0] if losses else 0.0,
+                losses[-1] if losses else 0.0)
+    return {"losses": losses, "accuracy_before": acc_before,
+            "accuracy_after": acc_after, "merged_params": merged}

@@ -1,0 +1,110 @@
+"""Worker half of the two-process jax.distributed CPU test.
+
+Run as:  python tests/_distributed_worker.py <process_id> <coordinator_port>
+
+Exercises SURVEY §5.8's multi-host path end to end on the only hardware
+available here (two CPU processes, 4 virtual devices each): bootstrap via
+parallel.mesh.initialize_distributed, build the hybrid DCN x ICI mesh with
+the data axis crossing processes, run one sharded TRAIN step and one paged
+engine DECODE step as single SPMD programs over the global mesh, and check
+cross-process agreement of the results. Prints DISTRIBUTED_OK on success.
+"""
+
+import os
+import sys
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+from generativeaiexamples_tpu.parallel import mesh as pmesh  # noqa: E402
+
+assert pmesh.initialize_distributed(f"127.0.0.1:{port}", 2, pid), \
+    "initialize_distributed returned False with explicit coordinator args"
+assert pmesh.initialize_distributed() is True, "second call must be a no-op"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+from jax.experimental import multihost_utils  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from generativeaiexamples_tpu.engine import kv_cache  # noqa: E402
+from generativeaiexamples_tpu.engine.kv_cache import PagedKVCache  # noqa: E402
+from generativeaiexamples_tpu.models import llama  # noqa: E402
+from generativeaiexamples_tpu.parallel import sharding as psh  # noqa: E402
+from generativeaiexamples_tpu.train.trainer import causal_lm_loss  # noqa: E402
+
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8 and jax.local_device_count() == 4
+
+# data crosses the DCN (the two processes), tensor stays intra-"slice"
+mesh = pmesh.create_hybrid_mesh(("data", "tensor"),
+                                ici_shape=(1, 4), dcn_shape=(2, 1))
+assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+    "data": 2, "tensor": 4}
+
+cfg = llama.LlamaConfig.tiny()
+params = llama.init_params(jax.random.PRNGKey(0), cfg)
+params = psh.shard_params(params, llama.logical_axes(cfg),
+                          psh.TRAIN_RULES, mesh)
+
+# ---- one train step: global batch sharded over the cross-process axis
+B, S = 8, 16
+rng = np.random.RandomState(100 + pid)
+local_tokens = rng.randint(1, cfg.vocab_size, (B // 2, S + 1)).astype(np.int32)
+tok_sharding = NamedSharding(mesh, P("data", None))
+tokens = jax.make_array_from_process_local_data(tok_sharding, local_tokens)
+mask = jax.make_array_from_process_local_data(
+    tok_sharding, np.ones((B // 2, S + 1), np.float32))
+
+opt = optax.sgd(1e-2)
+opt_state = jax.jit(opt.init)(params)
+
+
+@jax.jit
+def train_step(p, o, t, m):
+    loss, grads = jax.value_and_grad(
+        lambda q: causal_lm_loss(cfg, q, t, m))(p)
+    updates, o = opt.update(grads, o, p)
+    return optax.apply_updates(p, updates), o, loss
+
+
+params2, opt_state, loss = train_step(params, opt_state, tokens, mask)
+loss = float(loss)
+assert np.isfinite(loss) and loss > 0.0, loss
+# the SPMD program must yield the SAME loss on both processes
+losses = np.asarray(multihost_utils.process_allgather(jnp.float32(loss)))
+assert np.allclose(losses, losses[0]), losses
+changed = jax.tree.leaves(jax.tree.map(
+    lambda a, b: bool(jnp.any(a != b)), params, params2))
+assert any(changed), "train step changed no parameters"
+
+# ---- one paged engine decode step under the same global mesh
+inf_params = psh.shard_params(
+    jax.tree.map(np.asarray, llama.init_params(jax.random.PRNGKey(1), cfg)),
+    llama.logical_axes(cfg), psh.INFERENCE_RULES, mesh)
+batch, pages, page = 4, 9, 8
+cache = PagedKVCache.create(
+    cfg, batch, pages, page,
+    kv_sharding=NamedSharding(mesh, P(None, None, "tensor")),
+    aux_sharding=NamedSharding(mesh, P()))
+rep = NamedSharding(mesh, P())
+toks = jax.device_put(jnp.full((batch,), 7, jnp.int32), rep)
+active = jax.device_put(jnp.ones((batch,), bool), rep)
+table = jax.device_put(
+    jnp.tile(jnp.arange(1, 3, dtype=jnp.int32)[None], (batch, 1)), rep)
+
+logits, cache = jax.jit(
+    lambda p, t, c, pt, a: kv_cache.decode_step(p, cfg, t, c, pt, a, pages)
+)(inf_params, toks, cache, table, active)
+sampled = np.asarray(jnp.argmax(logits, axis=-1))  # replicated → host-local
+gathered = np.asarray(multihost_utils.process_allgather(sampled))
+assert (gathered[0] == gathered[-1]).all(), gathered
+assert int(np.asarray(cache.lengths)[0]) == 1
+
+print("DISTRIBUTED_OK", flush=True)

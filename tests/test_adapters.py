@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 from generativeaiexamples_tpu.retrieval.adapters import (
-    MilvusStore, PgVectorStore, make_store)
+    ElasticsearchStore, MilvusStore, PgVectorStore, make_store)
 from generativeaiexamples_tpu.retrieval.store import Document, VectorStore
 
 
@@ -134,6 +134,58 @@ class FakePgConn:
         pass
 
 
+class FakeEsClient:
+    """Enough of the ES REST surface for the adapter: index create, doc
+    put, kNN search (cosine, ES-normalized (1+cos)/2 scores), terms
+    aggregation, delete_by_query, count."""
+
+    def __init__(self):
+        self.docs = {}
+        self.created = False
+
+    def request(self, method, path, body=None):
+        if method == "PUT" and path.count("/") == 1:
+            if self.created:
+                raise RuntimeError(
+                    "resource_already_exists_exception: index exists")
+            self.created = True
+            return {"acknowledged": True}
+        if path.endswith("/_bulk"):
+            lines = [json.loads(l) for l in body.strip().split("\n")]
+            for action, doc in zip(lines[0::2], lines[1::2]):
+                self.docs[action["index"]["_id"]] = doc
+            return {"errors": False}
+        if path.endswith("/_refresh"):
+            return {}
+        if path.endswith("/_count"):
+            return {"count": len(self.docs)}
+        if "/_delete_by_query" in path:
+            targets = set(body["query"]["terms"]["source"])
+            doomed = [k for k, d in self.docs.items()
+                      if d["source"] in targets]
+            for k in doomed:
+                del self.docs[k]
+            return {"deleted": len(doomed)}
+        if path.endswith("/_search") and "aggs" in (body or {}):
+            sources = sorted({d["source"] for d in self.docs.values()
+                              if d["source"]})
+            return {"aggregations": {"sources": {
+                "buckets": [{"key": s, "doc_count": 1} for s in sources]}}}
+        if path.endswith("/_search"):
+            q = np.asarray(body["knn"]["query_vector"])
+            qn = q / np.linalg.norm(q)
+            scored = []
+            for d in self.docs.values():
+                v = np.asarray(d["embedding"])
+                cos = float(v / np.linalg.norm(v) @ qn)
+                scored.append({"_score": (1 + cos) / 2,
+                               "_source": {"content": d["content"],
+                                           "metadata": d["metadata"]}})
+            scored.sort(key=lambda h: -h["_score"])
+            return {"hits": {"hits": scored[: body["knn"]["k"]]}}
+        raise AssertionError(f"unexpected ES call {method} {path}")
+
+
 # ----------------------------------------------------------------- tests
 
 def _docs():
@@ -152,6 +204,7 @@ def _vecs():
 @pytest.mark.parametrize("factory", [
     lambda: MilvusStore(dim=4, name="t", client=FakeMilvusClient()),
     lambda: PgVectorStore(dim=4, name="t", conn=FakePgConn()),
+    lambda: ElasticsearchStore(dim=4, name="t", client=FakeEsClient()),
 ])
 def test_adapter_contract(factory):
     """add/search/list/delete/len behave like the in-proc store."""
@@ -186,5 +239,11 @@ def test_make_store_dispatch():
     pg = make_store(4, VectorStoreConfig(name="pgvector"), name="x",
                     client=FakePgConn())
     assert isinstance(pg, PgVectorStore)
+    es = make_store(4, VectorStoreConfig(name="elasticsearch"), name="x",
+                    client=FakeEsClient())
+    assert isinstance(es, ElasticsearchStore)
+    # reconnecting to an existing index is idempotent, not a crash
+    es2 = ElasticsearchStore(dim=4, name="x", client=es.client)
+    assert isinstance(es2, ElasticsearchStore)
     with pytest.raises(ValueError):
         make_store(4, VectorStoreConfig(name="chroma"))

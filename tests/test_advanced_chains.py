@@ -153,6 +153,12 @@ def test_extract_json_robust():
     assert extract_json('noise {"a": 1} trailing') == {"a": 1}
     assert extract_json("no json here") is None
     assert extract_json('{"bad": } {"good": [1, 2]}') == {"good": [1, 2]}
+    # objects nested in a top-level array (common LLM output shape)
+    assert extract_json('[{"score": 5}]') == {"score": 5}
+    assert extract_json('[1, 2] then {"a": 3}') == {"a": 3}
+    # string-embedded braces must not close the scan
+    assert extract_json('{"cmd": "grep \'}\' src.c"}') == {
+        "cmd": "grep '}' src.c"}
 
 
 # ------------------------------------------------------- structured data

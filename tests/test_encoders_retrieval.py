@@ -137,6 +137,28 @@ def test_ivf_matches_exact_for_easy_queries():
     assert agree >= 8  # self-queries: probed cell contains the vector
 
 
+def test_ivf_incremental_add_and_delete_mask():
+    """Adds after the first build assign to existing centroids (no retrain
+    below the 2x threshold) and are findable; deleted rows never surface
+    even without a rebuild."""
+    dim = 32
+    ivf = VectorStore(dim=dim, index_type="ivf", nlist=8, nprobe=8)
+    emb = _random_embeddings(512, dim, seed=5)
+    ivf.add([Document(content=f"a{i}", metadata={"source": "a.txt"})
+             for i in range(512)], emb)
+    ivf.search(emb[0], top_k=1)          # triggers training build
+    trained_n = ivf._ivf_trained_n
+    extra = _random_embeddings(100, dim, seed=6)
+    ivf.add([Document(content=f"b{i}", metadata={"source": "b.txt"})
+             for i in range(100)], extra)
+    hits = ivf.search(extra[42], top_k=1)
+    assert hits and hits[0][0].content == "b42"
+    assert ivf._ivf_trained_n == trained_n  # assign-only, no retrain
+    ivf.delete_by_source(["b.txt"])
+    hits = ivf.search(extra[42], top_k=5)
+    assert all(h[0].metadata["source"] == "a.txt" for h in hits)
+
+
 # ----------------------------------------------------------- bm25/splitter
 
 def test_bm25_ranks_matching_docs():

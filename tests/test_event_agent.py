@@ -1,0 +1,125 @@
+"""Event-driven agent loop: concurrency cap, retry/dead-letter, CVE handler."""
+
+import json
+import threading
+
+from generativeaiexamples_tpu.chains.event_agent import (
+    Event, EventDrivenAgent, jsonl_event_source, list_source,
+    make_cve_triage_handler)
+
+
+def test_events_processed_with_bounded_concurrency():
+    peak = {"now": 0, "max": 0}
+    lock = threading.Lock()
+    gate = threading.Event()
+
+    def handler(event):
+        with lock:
+            peak["now"] += 1
+            peak["max"] = max(peak["max"], peak["now"])
+        gate.wait(timeout=0.2)   # overlap the workers
+        with lock:
+            peak["now"] -= 1
+        return f"done {event.key}"
+
+    agent = EventDrivenAgent(handler, max_concurrency=2)
+    events = [Event(key=f"e{i}") for i in range(6)]
+    threading.Timer(0.05, gate.set).start()
+    stats = agent.run_sync(list_source(events))
+    assert stats == {"processed": 6, "succeeded": 6, "failed": 0,
+                     "dead_letter": 0}
+    assert peak["max"] <= 2
+    assert sorted(r.key for r in agent.results) == sorted(e.key for e in events)
+
+
+def test_retry_then_dead_letter_and_sink():
+    calls = {"n": 0}
+    seen = []
+
+    def flaky(event):
+        calls["n"] += 1
+        if event.key == "bad":
+            raise RuntimeError("boom")
+        return "ok"
+
+    agent = EventDrivenAgent(flaky, result_sink=seen.append,
+                             max_retries=2, retry_delay_s=0.01)
+    stats = agent.run_sync(list_source([Event(key="good"),
+                                        Event(key="bad")]))
+    assert stats["succeeded"] == 1 and stats["failed"] == 1
+    assert stats["dead_letter"] == 1
+    assert agent.dead_letter[0].key == "bad"
+    assert agent.dead_letter[0].attempt == 3      # initial + 2 retries
+    bad = next(r for r in seen if r.key == "bad")
+    assert not bad.ok and "boom" in bad.error and bad.attempts == 3
+    good = next(r for r in seen if r.key == "good")
+    assert good.ok and good.output == "ok"
+
+
+def test_jsonl_event_source(tmp_path):
+    p = tmp_path / "events.jsonl"
+    p.write_text('{"id": "CVE-2026-1", "summary": "rce in pump fw"}\n'
+                 '{"id": "CVE-2026-2", "summary": "dos in valve ui"}\n')
+    agent = EventDrivenAgent(lambda e: e.payload["summary"])
+    stats = agent.run_sync(jsonl_event_source(str(p)))
+    assert stats["processed"] == 2
+    outs = {r.key: r.output for r in agent.results}
+    assert outs["CVE-2026-1"] == "rce in pump fw"
+
+
+class _FakeLLM:
+    def __init__(self, replies):
+        self.replies = list(replies)
+        self.prompts = []
+
+    def chat(self, messages, **kw):
+        self.prompts.append(messages[-1]["content"])
+        yield self.replies.pop(0)
+
+
+class _FakeEmbedder:
+    def embed_queries(self, texts):
+        import numpy as np
+        return np.ones((len(texts), 4), np.float32)
+
+
+class _Ctx:
+    def __init__(self, llm, docs):
+        self.llm = llm
+        self.embedder = _FakeEmbedder()
+        self._docs = docs
+
+    def store(self, collection):
+        docs = self._docs
+
+        class S:
+            def search(self, qvec, top_k=4):
+                return [(d, 0.9) for d in docs[:top_k]]
+        return S()
+
+
+def test_cve_triage_handler_structured_verdict():
+    from generativeaiexamples_tpu.retrieval.store import Document
+
+    llm = _FakeLLM(['Assessment: {"cve": "CVE-2026-1", "affected": true, '
+                    '"severity": "high", "justification": "pump fw 2.1 '
+                    'deployed fleet-wide"}'])
+    ctx = _Ctx(llm, [Document(content="We run pump firmware 2.1 on all "
+                              "sites.")])
+    handler = make_cve_triage_handler(ctx)
+    out = handler(Event(key="CVE-2026-1",
+                        payload={"summary": "rce in pump firmware 2.x"}))
+    verdict = json.loads(out)
+    assert verdict["affected"] is True and verdict["severity"] == "high"
+    # retrieval context reached the analysis prompt
+    assert "pump firmware 2.1" in llm.prompts[0]
+
+
+def test_cve_triage_handler_rejects_unstructured():
+    import pytest
+
+    llm = _FakeLLM(["I think it's probably fine."])
+    ctx = _Ctx(llm, [])
+    handler = make_cve_triage_handler(ctx)
+    with pytest.raises(ValueError, match="JSON verdict"):
+        handler(Event(key="CVE-2026-9", payload={}))

@@ -1,0 +1,105 @@
+"""Human evaluation flow: task building, rating round-trip, aggregation."""
+
+import pytest
+
+from generativeaiexamples_tpu.evaluation import human as he
+
+
+def _pairwise_rows():
+    return [{"question": f"q{i}",
+             "answers": {"baseline": f"base answer {i}",
+                         "tuned": f"tuned answer {i}"}}
+            for i in range(8)]
+
+
+def test_build_tasks_shuffles_sides_but_keeps_systems():
+    tasks = he.build_tasks(_pairwise_rows(), seed=1)
+    assert all(t.pairwise for t in tasks)
+    # both orderings occur (position-bias control)...
+    orders = {(t.system_a, t.system_b) for t in tasks}
+    assert len(orders) == 2
+    # ...and each side's text matches its system
+    for t in tasks:
+        i = int(t.question[1:])
+        expect = {"baseline": f"base answer {i}", "tuned": f"tuned answer {i}"}
+        assert t.answer_a == expect[t.system_a]
+        assert t.answer_b == expect[t.system_b]
+
+
+def test_tasks_roundtrip_and_single_rating_aggregate(tmp_path):
+    rows = [{"question": "q0", "answer": "a0", "context": "ctx"},
+            {"question": "q1", "answer": "a1"}]
+    tasks = he.build_tasks(rows)
+    p = tmp_path / "tasks.jsonl"
+    he.write_tasks(tasks, str(p))
+    loaded = he.read_tasks(str(p))
+    assert loaded == tasks
+
+    rpath = str(tmp_path / "ratings.jsonl")
+    he.write_ratings([
+        {"task_id": "task-0000", "rater": "r1",
+         "scores": {"helpfulness": 4, "groundedness": 5}},
+        {"task_id": "task-0001", "rater": "r1",
+         "scores": {"helpfulness": 2}},
+    ], rpath)
+    report = he.aggregate(loaded, he.read_ratings(rpath))
+    assert report["n_rated"] == 2 and report["coverage"] == 1.0
+    assert report["rubric_means"]["helpfulness"] == 3.0
+    assert report["rubric_means"]["groundedness"] == 5.0
+    assert report["win_rates"] == {}
+
+
+def test_pairwise_aggregate_unshuffles_sides():
+    tasks = he.build_tasks(_pairwise_rows(), seed=1)
+    # every rater always prefers the TUNED system, wherever it sits
+    ratings = []
+    for rater in ("r1", "r2"):
+        for t in tasks:
+            pref = "a" if t.system_a == "tuned" else "b"
+            ratings.append({"task_id": t.task_id, "rater": rater,
+                            "preferred": pref})
+    report = he.aggregate(tasks, ratings)
+    assert report["win_rates"]["tuned"] == 1.0
+    assert report["win_rates"]["baseline"] == 0.0
+    assert report["inter_rater_kappa"] == 1.0
+
+
+def test_kappa_at_chance_is_low():
+    a = ["a", "a", "b", "b"] * 5
+    b = ["a", "b", "a", "b"] * 5
+    assert abs(he.cohen_kappa(a, b)) < 0.2
+    assert he.cohen_kappa(a, a) == 1.0
+
+
+def test_aggregate_validates():
+    tasks = he.build_tasks([{"question": "q", "answer": "a"}])
+    with pytest.raises(ValueError, match="unknown task"):
+        he.aggregate(tasks, [{"task_id": "nope", "rater": "r",
+                              "scores": {"helpfulness": 3}}])
+    with pytest.raises(ValueError, match="unknown criterion"):
+        he.aggregate(tasks, [{"task_id": "task-0000", "rater": "r",
+                              "scores": {"vibes": 3}}])
+    with pytest.raises(ValueError, match="outside"):
+        he.aggregate(tasks, [{"task_id": "task-0000", "rater": "r",
+                              "scores": {"helpfulness": 9}}])
+
+
+def test_rate_interactive_records_and_quits(tmp_path):
+    tasks = he.build_tasks(
+        [{"question": "q0", "answer": "a0"}] + _pairwise_rows()[:1])
+    out = str(tmp_path / "r.jsonl")
+    answers = iter(["4", "5", "3", "a"])      # rubric x3, then preference
+    n = he.rate_interactive(tasks, "r1", out,
+                            input_fn=lambda _: next(answers),
+                            print_fn=lambda *_: None)
+    assert n == 2
+    rows = he.read_ratings(out)
+    assert rows[0]["scores"] == {"helpfulness": 4, "groundedness": 5,
+                                 "fluency": 3}
+    assert rows[1]["preferred"] == "a"
+
+    answers = iter(["q"])
+    n = he.rate_interactive(tasks, "r2", out,
+                            input_fn=lambda _: next(answers),
+                            print_fn=lambda *_: None)
+    assert n == 0

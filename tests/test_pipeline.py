@@ -77,8 +77,22 @@ def test_pipelined_forward_validates_microbatches(setup):
         pp.pipelined_forward(staged, cfg, toks, mesh, n_microbatches=3)
 
 
-def test_pipeline_rejects_moe(setup):
-    cfg, _, mesh, staged, toks = setup
-    moe_cfg = dataclasses.replace(cfg, mlp="moe")
-    with pytest.raises(NotImplementedError):
-        pp.pipelined_forward(staged, moe_cfg, toks, mesh)
+def test_pipeline_composes_with_moe(setup):
+    """MoE blocks pipeline like dense ones (experts stage-local): logits
+    AND the load-balance aux match the unpipelined forward, including the
+    bubble-tick gating that keeps garbage activations out of the router
+    statistics."""
+    _, _, mesh, _, toks = setup
+    cfg = dataclasses.replace(
+        llama.LlamaConfig.tiny(), n_layers=4, mlp="moe", n_experts=4,
+        n_experts_per_tok=2, capacity_factor=8.0)
+    params = llama.init_params(jax.random.PRNGKey(2), cfg)
+    base, base_aux = llama.forward(params, cfg, toks, return_aux=True)
+    staged = pp.place_staged_params(params, cfg, mesh, n_stages=4)
+    for m in (2, 4):
+        out, aux = pp.pipelined_forward(staged, cfg, toks, mesh,
+                                        n_microbatches=m, return_aux=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(base),
+                                   atol=2e-4, rtol=2e-4)
+        np.testing.assert_allclose(float(aux), float(base_aux),
+                                   atol=1e-4, rtol=1e-4)

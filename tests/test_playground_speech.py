@@ -1,0 +1,127 @@
+"""Playground voice loop: /api/transcribe, /api/speak, streaming ASR ws."""
+
+import asyncio
+import json
+
+import pytest
+
+from generativeaiexamples_tpu.playground.app import PlaygroundServer
+from generativeaiexamples_tpu.speech.clients import (
+    DisabledSpeech, StreamingTranscriber)
+
+
+class FakeSpeech:
+    """Deterministic ASR/TTS: transcribes byte length, synthesizes WAV tag."""
+
+    def __init__(self):
+        self.transcribed = []
+
+    def available(self):
+        return True
+
+    def transcribe(self, audio, language="en-US"):
+        self.transcribed.append(len(audio))
+        return f"heard {len(audio)} bytes in {language}"
+
+    def synthesize(self, text, voice="default"):
+        return f"WAV:{voice}:{text}".encode()
+
+
+def _drive(server, fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def run():
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            return await fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(run())
+
+
+def test_config_reports_speech_flag():
+    on = PlaygroundServer("http://c", speech=FakeSpeech())
+    off = PlaygroundServer("http://c", speech=DisabledSpeech())
+
+    async def get_cfg(client):
+        return await (await client.get("/api/config")).json()
+
+    assert _drive(on, get_cfg)["speech"] is True
+    assert _drive(off, get_cfg)["speech"] is False
+
+
+def test_transcribe_endpoint():
+    server = PlaygroundServer("http://c", speech=FakeSpeech())
+
+    async def post(client):
+        resp = await client.post("/api/transcribe?language=nb-NO",
+                                 data=b"\x00" * 320)
+        return resp.status, await resp.json()
+
+    status, data = _drive(server, post)
+    assert status == 200
+    assert data["text"] == "heard 320 bytes in nb-NO"
+
+
+def test_transcribe_validates_and_gates():
+    server = PlaygroundServer("http://c", speech=FakeSpeech())
+
+    async def empty(client):
+        return (await client.post("/api/transcribe", data=b"")).status
+
+    assert _drive(server, empty) == 422
+
+    disabled = PlaygroundServer("http://c", speech=DisabledSpeech())
+
+    async def gated(client):
+        return (await client.post("/api/transcribe", data=b"x")).status
+
+    assert _drive(disabled, gated) == 501
+
+
+def test_speak_endpoint_roundtrip():
+    server = PlaygroundServer("http://c", speech=FakeSpeech())
+
+    async def post(client):
+        resp = await client.post("/api/speak",
+                                 json={"text": "hello", "voice": "nova"})
+        return resp.status, resp.content_type, await resp.read()
+
+    status, ctype, body = _drive(server, post)
+    assert status == 200 and ctype == "audio/wav"
+    assert body == b"WAV:nova:hello"
+
+
+def test_streaming_ws_partials_and_final():
+    fake = FakeSpeech()
+    server = PlaygroundServer("http://c", speech=fake)
+
+    async def ws_flow(client):
+        ws = await client.ws_connect("/api/transcribe/stream")
+        messages = []
+        # interval_bytes default 64000: two 40k chunks => one partial
+        await ws.send_bytes(b"\x01" * 40000)
+        await ws.send_bytes(b"\x01" * 40000)
+        messages.append(json.loads((await ws.receive()).data))
+        await ws.send_str("end")
+        messages.append(json.loads((await ws.receive()).data))
+        await ws.close()
+        return messages
+
+    partial, final = _drive(server, ws_flow)
+    assert partial == {"partial": "heard 80000 bytes in en-US"}
+    assert final == {"final": "heard 80000 bytes in en-US"}
+
+
+def test_streaming_transcriber_bounds_asr_calls():
+    fake = FakeSpeech()
+    st = StreamingTranscriber(fake, interval_bytes=100)
+    outs = [st.feed(b"x" * 40) for _ in range(6)]   # 240 bytes total
+    partials = [o for o in outs if o is not None]
+    assert len(partials) == 2                        # at 120 and 240 bytes
+    assert st.finalize() == "heard 240 bytes in en-US"
+    assert len(fake.transcribed) == 3                # 2 partials + final
+    with pytest.raises(RuntimeError):
+        StreamingTranscriber(DisabledSpeech())

@@ -1,0 +1,425 @@
+"""Tool calling end-to-end: parsing, /v1 surface, agent loop, HITL resume."""
+
+import asyncio
+import json
+
+import pytest
+
+from generativeaiexamples_tpu.engine import tools as tools_mod
+from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+
+WEATHER_TOOL = {"type": "function", "function": {
+    "name": "get_weather",
+    "description": "Current weather for a city.",
+    "parameters": {"type": "object",
+                   "properties": {"city": {"type": "string"}},
+                   "required": ["city"]}}}
+CALC_TOOL = {"type": "function", "function": {
+    "name": "calculator",
+    "description": "Evaluate an arithmetic expression.",
+    "parameters": {"type": "object",
+                   "properties": {"expression": {"type": "string"}}}}}
+
+
+# ------------------------------------------------------------ tools module
+
+def test_extract_json_value_variants():
+    f = tools_mod.extract_json_value
+    assert f('{"a": 1}')[0] == {"a": 1}
+    assert f('prose before {"a": [1, 2]} prose after')[0] == {"a": [1, 2]}
+    assert f('```json\n{"a": "with } brace in string"}\n```')[0] == {
+        "a": "with } brace in string"}
+    assert f("[1, 2, 3] trailing")[0] == [1, 2, 3]
+    assert f("no json here") is None
+    assert f('{"unterminated": ') is None
+    # a broken candidate must not hide a later valid one
+    assert f('{oops} then {"ok": true}')[0] == {"ok": True}
+
+
+def test_parse_tool_calls_shapes():
+    tools = [WEATHER_TOOL, CALC_TOOL]
+    for text in (
+        '{"tool_calls": [{"name": "get_weather", "arguments": {"city": "Oslo"}}]}',
+        '{"name": "get_weather", "arguments": {"city": "Oslo"}}',
+        '{"name": "get_weather", "parameters": {"city": "Oslo"}}',
+        'Sure! {"tool_calls": [{"name": "get_weather", "arguments": {"city": "Oslo"}}]}',
+    ):
+        calls = tools_mod.parse_tool_calls(text, tools)
+        assert calls and calls[0]["function"]["name"] == "get_weather"
+        assert json.loads(calls[0]["function"]["arguments"]) == {"city": "Oslo"}
+        assert calls[0]["id"].startswith("call_")
+
+
+def test_parse_tool_calls_rejects_hallucinated_and_plain():
+    tools = [WEATHER_TOOL]
+    assert tools_mod.parse_tool_calls("It is sunny in Oslo.", tools) is None
+    assert tools_mod.parse_tool_calls(
+        '{"name": "rm_rf", "arguments": {}}', tools) is None
+    assert tools_mod.parse_tool_calls('{"random": "json"}', tools) is None
+
+
+def test_inject_tool_prompt_modes():
+    msgs = [{"role": "user", "content": "hi"}]
+    out = tools_mod.inject_tool_prompt(msgs, [WEATHER_TOOL], "auto")
+    assert out[0]["role"] == "system" and "get_weather" in out[0]["content"]
+    out = tools_mod.inject_tool_prompt(msgs, [WEATHER_TOOL], "required")
+    assert "MUST call one of the tools" in out[0]["content"]
+    out = tools_mod.inject_tool_prompt(
+        msgs, [WEATHER_TOOL],
+        {"type": "function", "function": {"name": "get_weather"}})
+    assert "'get_weather'" in out[0]["content"]
+    # existing system message is extended, not duplicated
+    sys_msgs = [{"role": "system", "content": "base"}] + msgs
+    out = tools_mod.inject_tool_prompt(sys_msgs, [WEATHER_TOOL], "auto")
+    assert len([m for m in out if m["role"] == "system"]) == 1
+    assert out[0]["content"].startswith("base")
+
+
+def test_normalize_messages_tool_protocol():
+    msgs = [
+        {"role": "user", "content": "weather?"},
+        {"role": "assistant", "tool_calls": [
+            {"id": "call_1", "type": "function",
+             "function": {"name": "get_weather",
+                          "arguments": '{"city": "Oslo"}'}}]},
+        {"role": "tool", "tool_call_id": "call_1", "name": "get_weather",
+         "content": "12C, rain"},
+    ]
+    out = tools_mod.normalize_messages(msgs)
+    assert json.loads(out[1]["content"])["tool_calls"][0]["name"] == "get_weather"
+    assert out[2]["role"] == "tool" and "12C, rain" in out[2]["content"]
+    assert "[get_weather]" in out[2]["content"]
+
+
+# ------------------------------------------------------------- fake engine
+
+class FakeScheduler:
+    """Scripted scheduler: pops one canned output text per submit."""
+
+    def __init__(self, outputs):
+        self.tokenizer = ByteTokenizer()
+        self.outputs = list(outputs)
+        self.prompts = []
+
+    def submit(self, req):
+        self.prompts.append(self.tokenizer.decode(req.prompt_ids))
+        req._out = self.outputs.pop(0)
+        return req
+
+    def iter_text(self, req):
+        yield req._out
+
+
+def _post(server, path, body):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def drive():
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            resp = await client.post(path, json=body)
+            if resp.content_type == "application/json":
+                return resp.status, await resp.json()
+            return resp.status, await resp.text()
+        finally:
+            await client.close()
+
+    return asyncio.run(drive())
+
+
+# --------------------------------------------------------------- /v1 surface
+
+def test_server_tool_call_roundtrip():
+    from generativeaiexamples_tpu.engine.server import ModelServer
+
+    sched = FakeScheduler(
+        ['{"tool_calls": [{"name": "get_weather", '
+         '"arguments": {"city": "Oslo"}}]}'])
+    server = ModelServer(sched, "tpu-llama")
+    status, data = _post(server, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "Weather in Oslo?"}],
+        "tools": [WEATHER_TOOL]})
+    assert status == 200
+    choice = data["choices"][0]
+    assert choice["finish_reason"] == "tool_calls"
+    call = choice["message"]["tool_calls"][0]
+    assert call["function"]["name"] == "get_weather"
+    assert json.loads(call["function"]["arguments"]) == {"city": "Oslo"}
+    assert choice["message"]["content"] is None
+    # the tool contract was rendered into the prompt
+    assert "get_weather" in sched.prompts[0]
+
+
+def test_server_tool_call_plain_answer_passthrough():
+    from generativeaiexamples_tpu.engine.server import ModelServer
+
+    sched = FakeScheduler(["It is sunny."])
+    server = ModelServer(sched, "m")
+    status, data = _post(server, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "Weather?"}],
+        "tools": [WEATHER_TOOL]})
+    choice = data["choices"][0]
+    assert choice["finish_reason"] == "stop"
+    assert choice["message"]["content"] == "It is sunny."
+    assert "tool_calls" not in choice["message"]
+
+
+def test_server_tool_choice_none_disables_tools():
+    from generativeaiexamples_tpu.engine.server import ModelServer
+
+    sched = FakeScheduler(["plain"])
+    server = ModelServer(sched, "m")
+    status, data = _post(server, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "q"}],
+        "tools": [WEATHER_TOOL], "tool_choice": "none"})
+    assert data["choices"][0]["finish_reason"] == "stop"
+    assert "get_weather" not in sched.prompts[0]
+
+
+def test_server_tool_choice_unknown_name_rejected():
+    from generativeaiexamples_tpu.engine.server import ModelServer
+
+    sched = FakeScheduler([])
+    server = ModelServer(sched, "m")
+    status, _ = _post(server, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "q"}],
+        "tools": [WEATHER_TOOL],
+        "tool_choice": {"type": "function", "function": {"name": "nope"}}})
+    assert status == 400
+
+
+def test_server_json_mode_extracts_object():
+    from generativeaiexamples_tpu.engine.server import ModelServer
+
+    sched = FakeScheduler(['Here you go: {"answer": 42} hope that helps'])
+    server = ModelServer(sched, "m")
+    status, data = _post(server, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "q"}],
+        "response_format": {"type": "json_object"}})
+    content = data["choices"][0]["message"]["content"]
+    assert json.loads(content) == {"answer": 42}
+    # the JSON instruction reached the prompt
+    assert "JSON" in sched.prompts[0]
+
+
+def test_server_json_mode_composes_with_tools():
+    """tools + response_format together: a non-tool reply still honors the
+    JSON constraint; a tool call wins over extraction."""
+    from generativeaiexamples_tpu.engine.server import ModelServer
+
+    sched = FakeScheduler(['Sure: {"temp_c": 12} as requested'])
+    server = ModelServer(sched, "m")
+    _, data = _post(server, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "q"}],
+        "tools": [WEATHER_TOOL],
+        "response_format": {"type": "json_object"}})
+    choice = data["choices"][0]
+    assert choice["finish_reason"] == "stop"
+    assert json.loads(choice["message"]["content"]) == {"temp_c": 12}
+    assert "NOT calling a tool" in sched.prompts[0]
+
+    sched = FakeScheduler(
+        ['{"tool_calls": [{"name": "get_weather", '
+         '"arguments": {"city": "Oslo"}}]}'])
+    server = ModelServer(sched, "m")
+    _, data = _post(server, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "q"}],
+        "tools": [WEATHER_TOOL],
+        "response_format": {"type": "json_object"}})
+    assert data["choices"][0]["finish_reason"] == "tool_calls"
+
+
+def test_server_streamed_tool_call_chunks():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from generativeaiexamples_tpu.engine.server import ModelServer
+
+    sched = FakeScheduler(
+        ['{"tool_calls": [{"name": "get_weather", '
+         '"arguments": {"city": "Oslo"}}]}'])
+    server = ModelServer(sched, "m")
+
+    async def drive():
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            resp = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "Weather?"}],
+                "tools": [WEATHER_TOOL], "stream": True})
+            return await resp.text()
+        finally:
+            await client.close()
+
+    body = asyncio.run(drive())
+    chunks = [json.loads(line[len("data: "):])
+              for line in body.splitlines()
+              if line.startswith("data: ") and "[DONE]" not in line]
+    deltas = [c["choices"][0]["delta"] for c in chunks]
+    tool_deltas = [d for d in deltas if "tool_calls" in d]
+    assert tool_deltas and tool_deltas[0]["tool_calls"][0]["index"] == 0
+    assert tool_deltas[0]["tool_calls"][0]["function"]["name"] == "get_weather"
+    finishes = [c["choices"][0]["finish_reason"] for c in chunks]
+    assert "tool_calls" in finishes
+    assert body.rstrip().endswith("data: [DONE]")
+
+
+def test_server_detailed_thinking_toggle():
+    from generativeaiexamples_tpu.engine.server import ModelServer
+
+    for flag, expect in ((True, "detailed thinking on"),
+                         (False, "detailed thinking off")):
+        sched = FakeScheduler(["ok"])
+        server = ModelServer(sched, "m")
+        _post(server, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "q"}],
+            "thinking": flag})
+        assert expect in sched.prompts[0]
+    sched = FakeScheduler(["ok"])
+    server = ModelServer(sched, "m")
+    _post(server, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "q"}]})
+    assert "detailed thinking" not in sched.prompts[0]
+
+
+# ---------------------------------------------------------------- tool agent
+
+class FakeToolLLM:
+    """chat_tools seam with scripted assistant messages."""
+
+    def __init__(self, messages):
+        self.outputs = list(messages)
+        self.seen = []
+
+    def chat_tools(self, messages, tools, tool_choice="auto", **kw):
+        self.seen.append([dict(m) for m in messages])
+        return self.outputs.pop(0)
+
+
+def _call(name, args, cid="call_1"):
+    return {"id": cid, "type": "function",
+            "function": {"name": name, "arguments": json.dumps(args)}}
+
+
+def test_tool_agent_loop_executes_and_answers():
+    from generativeaiexamples_tpu.chains.tool_agent import Tool, ToolAgent
+
+    calc = Tool(name="calculator", description="math",
+                parameters={"type": "object"},
+                fn=lambda expression="": str(eval(expression, {"__builtins__": {}})))
+    llm = FakeToolLLM([
+        {"role": "assistant", "content": None,
+         "tool_calls": [_call("calculator", {"expression": "6*7"})]},
+        {"role": "assistant", "content": "The answer is 42."},
+    ])
+    agent = ToolAgent(llm, [calc])
+    events = list(agent.run("what is 6*7?"))
+    kinds = [e["type"] for e in events]
+    assert kinds == ["tool_call", "tool_result", "final"]
+    assert events[1]["content"] == "42"
+    assert events[2]["content"] == "The answer is 42."
+    # the tool result was fed back as a tool-role message
+    assert any(m.get("role") == "tool" and m.get("content") == "42"
+               for m in llm.seen[1])
+
+
+def test_tool_agent_tool_error_feeds_back():
+    from generativeaiexamples_tpu.chains.tool_agent import Tool, ToolAgent
+
+    def boom(**kw):
+        raise RuntimeError("no such city")
+
+    weather = Tool(name="get_weather", description="w",
+                   parameters={"type": "object"}, fn=boom)
+    llm = FakeToolLLM([
+        {"role": "assistant", "content": None,
+         "tool_calls": [_call("get_weather", {"city": "Atlantis"})]},
+        {"role": "assistant", "content": "I could not find it."},
+    ])
+    events = list(ToolAgent(llm, [weather]).run("weather in Atlantis?"))
+    results = [e for e in events if e["type"] == "tool_result"]
+    assert "error: no such city" in results[0]["content"]
+    assert events[-1]["type"] == "final"
+
+
+def test_tool_agent_hitl_interrupt_and_approve():
+    from generativeaiexamples_tpu.chains.tool_agent import (
+        PendingApproval, Tool, ToolAgent)
+
+    executed = []
+    deploy = Tool(name="deploy", description="ship it",
+                  parameters={"type": "object"},
+                  fn=lambda env="": executed.append(env) or f"deployed to {env}",
+                  requires_approval=True)
+    llm = FakeToolLLM([
+        {"role": "assistant", "content": None,
+         "tool_calls": [_call("deploy", {"env": "prod"})]},
+        {"role": "assistant", "content": "Deployed."},
+    ])
+    agent = ToolAgent(llm, [deploy])
+    events = list(agent.run("deploy to prod"))
+    assert events[-1]["type"] == "approval_request"
+    assert executed == []          # NOTHING ran before the verdict
+    pending = events[-1]["pending"]
+    # the wait can cross a process boundary
+    pending = PendingApproval.from_json(pending.to_json())
+    resumed = list(agent.resume(pending, approved=True))
+    assert executed == ["prod"]
+    assert [e["type"] for e in resumed] == ["tool_call", "tool_result", "final"]
+    assert resumed[1]["content"] == "deployed to prod"
+
+
+def test_tool_agent_hitl_deny_feeds_refusal():
+    from generativeaiexamples_tpu.chains.tool_agent import Tool, ToolAgent
+
+    executed = []
+    deploy = Tool(name="deploy", description="ship",
+                  parameters={"type": "object"},
+                  fn=lambda **kw: executed.append(1),
+                  requires_approval=True)
+    llm = FakeToolLLM([
+        {"role": "assistant", "content": None,
+         "tool_calls": [_call("deploy", {"env": "prod"})]},
+        {"role": "assistant", "content": "Understood, not deploying."},
+    ])
+    agent = ToolAgent(llm, [deploy])
+    events = list(agent.run("deploy"))
+    pending = events[-1]["pending"]
+    resumed = list(agent.resume(pending, approved=False,
+                                feedback="not during the freeze"))
+    assert executed == []
+    assert resumed[-1]["content"] == "Understood, not deploying."
+    # the refusal (with feedback) went back to the model
+    fed = [m for m in llm.seen[1] if m.get("role") == "tool"]
+    assert fed and "not during the freeze" in fed[0]["content"]
+
+
+def test_tool_agent_step_budget():
+    from generativeaiexamples_tpu.chains.tool_agent import Tool, ToolAgent
+
+    ping = Tool(name="ping", description="p", parameters={"type": "object"},
+                fn=lambda **kw: "pong")
+    llm = FakeToolLLM([
+        {"role": "assistant", "content": None,
+         "tool_calls": [_call("ping", {})]}
+        for _ in range(10)])
+    agent = ToolAgent(llm, [ping], max_steps=3)
+    events = list(agent.run("loop forever"))
+    assert events[-1]["type"] == "final" and events[-1].get("exhausted")
+
+
+# ------------------------------------------------------------- local client
+
+def test_local_llm_chat_tools_parses(monkeypatch):
+    from generativeaiexamples_tpu.chains.llm_client import LocalLLM
+
+    sched = FakeScheduler(
+        ['{"tool_calls": [{"name": "calculator", '
+         '"arguments": {"expression": "1+1"}}]}'])
+    msg = LocalLLM(sched).chat_tools(
+        [{"role": "user", "content": "1+1?"}], [CALC_TOOL])
+    assert msg["tool_calls"][0]["function"]["name"] == "calculator"
+    assert msg["content"] is None
+    sched = FakeScheduler(["two"])
+    msg = LocalLLM(sched).chat_tools(
+        [{"role": "user", "content": "1+1?"}], [CALC_TOOL])
+    assert msg == {"role": "assistant", "content": "two"}

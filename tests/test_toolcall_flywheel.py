@@ -1,0 +1,102 @@
+"""Tool-calling fine-tuning flywheel: traces, batches, accuracy, e2e loop."""
+
+import json
+
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.engine import tools as tools_mod
+from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+from generativeaiexamples_tpu.train import toolcall_ft as fw
+
+
+def test_generate_traces_mix_and_determinism():
+    traces = fw.generate_traces(64, seed=3)
+    assert traces == fw.generate_traces(64, seed=3)
+    tool_traces = [t for t in traces if t["tool"]]
+    plain = [t for t in traces if t["tool"] is None]
+    assert tool_traces and plain
+    for t in tool_traces:
+        target = json.loads(t["target"])
+        assert target["tool_calls"][0]["name"] == t["tool"]
+        assert target["tool_calls"][0]["arguments"] == t["arguments"]
+        # the canonical target parses under the serving-side parser
+        calls = tools_mod.parse_tool_calls(t["target"], fw.catalog_specs())
+        assert calls and calls[0]["function"]["name"] == t["tool"]
+
+
+def test_trace_batches_mask_covers_completion_only():
+    tok = ByteTokenizer()
+    traces = fw.generate_traces(8, seed=0)
+    batch = next(fw.trace_batches(traces, tok, batch_size=4, seq_len=1280))
+    assert batch.tokens.shape == (4, 1281)
+    assert batch.loss_mask.shape == (4, 1281)
+    for r in range(4):
+        m = batch.loss_mask[r]
+        on = np.flatnonzero(m)
+        assert len(on) > 0
+        # supervised region is one contiguous run (completion + eos)
+        assert np.all(np.diff(on) == 1)
+        # it decodes back to the target (+ eos)
+        ids = batch.tokens[r, on].tolist()
+        assert tok.eos_id in ids
+
+
+def test_trace_batches_rejects_oversized_prompts():
+    tok = ByteTokenizer()
+    traces = fw.generate_traces(4, seed=0)
+    with pytest.raises(ValueError, match="seq_len"):
+        next(fw.trace_batches(traces, tok, batch_size=2, seq_len=64))
+
+
+def test_call_accuracy_scoring():
+    traces = [
+        {"query": "weather in Oslo?", "tool": "get_weather",
+         "arguments": {"city": "Oslo"}, "target": ""},
+        {"query": "hello", "tool": None, "arguments": None, "target": ""},
+    ]
+
+    def perfect(messages):
+        text = messages[-1]["content"]
+        if "weather" in text:
+            return json.dumps({"tool_calls": [
+                {"name": "get_weather", "arguments": {"city": "Oslo"}}]})
+        return "Hello!"
+
+    def wrong_args(messages):
+        if "weather" not in messages[-1]["content"]:
+            return "Hello!"
+        return json.dumps({"tool_calls": [
+            {"name": "get_weather", "arguments": {"city": "Lima"}}]})
+
+    def always_calls(messages):
+        return json.dumps({"tool_calls": [
+            {"name": "get_weather", "arguments": {"city": "Oslo"}}]})
+
+    assert fw.call_accuracy(perfect, traces) == 1.0
+    assert fw.call_accuracy(wrong_args, traces) == 0.5   # plain one scored 1
+    assert fw.call_accuracy(always_calls, traces) == 0.5  # over-calling penalized
+
+
+@pytest.mark.slow
+def test_flywheel_end_to_end_tiny():
+    """The loop runs end-to-end on a tiny model: loss drops, accuracies are
+    measured by actually serving the base and merged params."""
+    import jax
+
+    from generativeaiexamples_tpu.models import llama
+
+    model_cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    params = llama.init_params(jax.random.PRNGKey(0), model_cfg)
+    tok = ByteTokenizer()
+    out = fw.run_flywheel(
+        model_cfg, params, tok,
+        fw.ToolcallFTConfig(n_train=16, n_eval=4, seq_len=640,
+                            batch_size=4, epochs=3, lora_rank=4,
+                            learning_rate=3e-3),
+        catalog=fw.CATALOG[:1])   # one tool: a byte-level prompt that fits
+    assert out["losses"], "training ran"
+    assert out["losses"][-1] < out["losses"][0], "loss must decrease"
+    assert 0.0 <= out["accuracy_before"] <= 1.0
+    assert 0.0 <= out["accuracy_after"] <= 1.0
+    assert out["merged_params"] is not None

@@ -1,0 +1,114 @@
+"""VLM streaming alerts: zero-shot scoring, hysteresis, cooldown, escalation."""
+
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.encoders.vlm_alerts import (
+    AlertEvent, AlertMonitor, AlertRule)
+
+
+class FakeEmbedder:
+    """Texts map to fixed axes; frames are byte tags choosing an axis."""
+
+    def embed_texts(self, texts):
+        vecs = []
+        for t in texts:
+            v = np.zeros(4, np.float32)
+            v[0 if "fire" in t and "no" not in t else 1] = 1.0
+            vecs.append(v)
+        return np.stack(vecs)
+
+    def embed_images(self, frames):
+        vecs = []
+        for f in frames:
+            v = np.zeros(4, np.float32)
+            v[0 if f == b"hot" else 1] = 1.0
+            vecs.append(v)
+        return np.stack(vecs)
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def monitor_factory():
+    def make(describe=None, **rule_kw):
+        clock = Clock()
+        rule = AlertRule(name="fire", condition="a fire is burning",
+                         trigger_frames=2, clear_frames=2, cooldown_s=10.0,
+                         **rule_kw)
+        mon = AlertMonitor([rule], embedder=FakeEmbedder(),
+                           describe=describe, clock=clock)
+        return mon, clock
+    return make
+
+
+def test_default_negation_and_scores(monitor_factory):
+    mon, _ = monitor_factory()
+    assert mon.rules[0].negation == "no a fire is burning"
+    scores = mon.score_frames([b"hot", b"cold"])
+    assert scores.shape == (2, 1)
+    assert scores[0, 0] > 0.9 and scores[1, 0] < 0.1
+
+
+def test_hysteresis_raise_and_clear(monitor_factory):
+    mon, _ = monitor_factory()
+    # one hot frame: below trigger_frames, no event
+    assert mon.process([b"hot"]) == []
+    # second consecutive hot frame raises
+    events = mon.process([b"hot"])
+    assert [e.kind for e in events] == ["raised"]
+    assert events[0].rule == "fire" and events[0].frame_index == 1
+    # one cold frame: not enough to clear
+    assert mon.process([b"cold"]) == []
+    # second cold frame clears
+    events = mon.process([b"cold"])
+    assert [e.kind for e in events] == ["cleared"]
+
+
+def test_cooldown_blocks_rapid_re_raise(monitor_factory):
+    mon, clock = monitor_factory()
+    assert [e.kind for e in mon.process([b"hot", b"hot"])] == ["raised"]
+    mon.process([b"cold", b"cold"])               # cleared
+    # immediately hot again, but cooldown_s=10 not elapsed
+    assert mon.process([b"hot", b"hot"]) == []
+    clock.t = 11.0
+    assert [e.kind for e in mon.process([b"hot", b"hot"])] == ["raised"]
+
+
+def test_escalation_describe_on_raise(monitor_factory):
+    calls = []
+
+    def describe(frame, condition):
+        calls.append((frame, condition))
+        return "flames visible near the pump"
+
+    mon, _ = monitor_factory(describe=describe)
+    events = mon.process([b"hot", b"hot"])
+    assert events[0].message == "flames visible near the pump"
+    assert calls == [(b"hot", "a fire is burning")]
+    # describe is NOT called for frames that don't raise
+    mon.process([b"hot"])
+    assert len(calls) == 1
+
+
+def test_watch_streams_windows(monitor_factory):
+    mon, _ = monitor_factory()
+    windows = [[b"cold"], [b"hot", b"hot"], [b"cold", b"cold"]]
+    kinds = [e.kind for e in mon.watch(iter(windows))]
+    assert kinds == ["raised", "cleared"]
+
+
+def test_describe_failure_does_not_block_alert(monitor_factory):
+    def broken(frame, condition):
+        raise RuntimeError("vlm down")
+
+    mon, _ = monitor_factory(describe=broken)
+    events = mon.process([b"hot", b"hot"])
+    assert [e.kind for e in events] == ["raised"]
+    assert events[0].message == ""
