@@ -210,6 +210,7 @@ class EngineConfig:
     num_pages: int = configfield("num_pages", default=0, help_txt="Physical KV pages in the pool (bounds HBM by live tokens); 0 = full slot capacity.")
     prefill_chunk: int = configfield("prefill_chunk", default=512, help_txt="Chunked-prefill bucket size.")
     decode_steps_per_dispatch: int = configfield("decode_steps_per_dispatch", default=8, help_txt="Decode steps fused into one device dispatch (lax.scan); amortizes host sync latency. Must be a power of two (each distinct step count is a separate compile).")
+    prefill_hold_chunks: int = configfield("prefill_hold_chunks", default=16, help_txt="While admissions are prefilling into a batch under half full, hold decode dispatches for up to this many prefill chunks per ramp episode (each decode dispatch at low fill burns a full host round trip on few tokens). 0 disables holding; decode always resumes once the budget is spent, bounding any streamer stall.")
     donate_buffers: str = configfield("donate_buffers", default="auto", help_txt="Donate the KV pool through dispatches: on | off | auto (off on remote-attached chips, where the client blocks ~RTT per donated dispatch; costs a transient 2x pool copy when off).")
     dtype: str = configfield("dtype", default="bfloat16", help_txt="Activation/weight dtype.")
     quant: str = configfield("quant", default="none", help_txt="Weight quantization: none | int8 (per-channel weight-only; halves weight HBM reads — the decode bottleneck — and fits 8B-class weights on one v5e chip).")

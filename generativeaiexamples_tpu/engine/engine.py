@@ -199,6 +199,10 @@ class EngineCore:
             import os
             donate = "off" if os.environ.get("PALLAS_AXON_POOL_IPS") else "on"
         dn = (0,) if donate == "on" else ()
+        # callers that keep handles into the state (the scheduler's batched
+        # first-token fetch) must copy them before the next dispatch
+        # deletes the donated buffers
+        self.donates_state = bool(dn)
         self._chunk_fn = jax.jit(self._chunk_impl, donate_argnums=dn)
         self._long_fn = jax.jit(self._prefill_long_impl, donate_argnums=dn)
         self._long_last_fn = jax.jit(self._prefill_long_last_impl,

@@ -5,7 +5,8 @@ TRT-LLM's inflight batcher (ref: NIM container, docker-compose-nim-ms.yaml:2-28)
 One driver thread owns the device; each tick it
 
   1. **admits** pending requests: allocates a slot and the prompt's KV pages
-     (FIFO — a request that doesn't fit blocks later ones, no starvation);
+     (FIFO with bounded-bypass skip-ahead — later prompts that fit may pass
+     a page-blocked head a limited number of times, see _admit);
   2. runs **one prefill chunk** of the oldest admission — chunked prefill
      interleaves with decode, so active slots never stall for a whole prompt
      and arbitrarily long prompts are processed without truncation;
@@ -37,6 +38,7 @@ from dataclasses import dataclass, field
 from typing import Deque, Dict, Iterator, List, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from generativeaiexamples_tpu.core.metrics import REGISTRY
@@ -49,10 +51,15 @@ logger = logging.getLogger(__name__)
 _STOP = object()
 
 
-def _fetch(arr) -> np.ndarray:
-    """Device→host fetch, run on the fetcher thread (releases the GIL during
-    the transfer, so it overlaps the driver thread's dispatching)."""
-    return np.asarray(jax.device_get(arr))
+def _fetch(arr, metric: str = "fetch_rtt_s") -> np.ndarray:
+    """Device→host fetch, run on a fetcher thread (releases the GIL during
+    the transfer, so it overlaps the driver thread's dispatching).
+    ``metric`` keeps the packed-decode transfers (what pipeline-depth
+    tuning reads) and the tiny first-token scalars in separate histograms."""
+    t0 = time.perf_counter()
+    out = np.asarray(jax.device_get(arr))
+    REGISTRY.histogram(metric).observe(time.perf_counter() - t0)
+    return out
 
 
 @dataclass
@@ -89,11 +96,17 @@ class _Job:
     total_len: int = 0            # host mirror of cache lengths[slot]
     gen_ids: List[int] = field(default_factory=list)   # generated so far
     admit_seq: int = 0            # admission order (preemption picks max)
+    bypass_count: int = 0         # times skipped over while at the head
     prefill_started: float = 0.0  # wall clock of this prompt's first chunk
     # set when the fused final chunk has sampled this job's first token
-    # on-device; resolved (and cleared) at the next decode sync via
+    # on-device; resolved (and cleared) by whichever lands first — the
+    # scheduler's batched state.tokens fetch or the next decode sync's
     # out["input_tokens"]
     first_pending: bool = False
+    first_batched: bool = False   # included in an in-flight batched fetch
+    first_inflight: bool = False  # already snapshotted into a decode dispatch
+    first_epoch: int = 0          # bumps per (re-)prefill: stale fetches
+                                  # of a preempted+re-admitted job no-op
 
 
 class Scheduler:
@@ -112,16 +125,31 @@ class Scheduler:
         self._table_dev: Optional[jax.Array] = None
         self._inflight: Deque[tuple] = deque()   # dispatched, not yet synced
         self._pending_steps = 0                  # decode steps in flight
-        # Dispatches kept in flight: results stream back on the fetcher
-        # thread while the driver keeps dispatching — on a remote-attached
-        # chip (~135 ms round trip) this is what keeps decode from being
-        # round-trip-bound. Staleness cost: done slots are reused (and first
-        # tokens resolve) up to depth dispatches late, so depth trades a
-        # little TTFT for transfer overlap.
-        self._pipeline_depth = 2
-        self._fetcher = ThreadPoolExecutor(max_workers=1,
+        # Dispatches kept in flight: results stream back on fetcher threads
+        # while the driver keeps dispatching — on a remote-attached chip
+        # (~100 ms round trip, measured) this is what keeps decode from
+        # being round-trip-bound. Depth ~= RTT / device-time-per-dispatch
+        # (~30 ms for 8 fused steps on a 3B int8 model) so the device never
+        # drains while a result is on the wire. Staleness cost: done slots
+        # are reused (and first tokens resolve) up to depth dispatches
+        # late — the eager drain in _tick claws most of that back.
+        self._pipeline_depth = 4
+        # one worker per in-flight dispatch: a single fetcher serializes the
+        # ~100 ms RTTs and caps the whole engine at ~10 dispatches/s
+        # (measured round 3 — THE round-2 throughput bottleneck); each
+        # worker's device_get releases the GIL, so transfers overlap.
+        self._fetcher = ThreadPoolExecutor(max_workers=self._pipeline_depth + 1,
                                            thread_name_prefix="kv-fetch")
         self._admit_counter = 0
+        self._holding = False      # inside a prefill-priority ramp episode
+        self._hold_left = 0        # chunk budget remaining in the episode
+        # batched first-token fetches in flight: [(future, pairs)]. Several
+        # ride concurrently (one per admission burst) — a single serialized
+        # fetch would resolve the whole ramp's first tokens only after the
+        # LAST prefill chunk executes on device (~the full ramp, measured
+        # +1 s of p50 TTFT at a 20-slot burst).
+        self._first_fetches: List[tuple] = []
+        self._first_fetch_depth = 4
         self._state: DecodeState = core.init_state()
         self._running = False
         self._thread: Optional[threading.Thread] = None
@@ -208,6 +236,7 @@ class Scheduler:
         self._table[:] = 0
         self._table_dev = None
         self._inflight.clear()
+        self._first_fetches = []
         self._pending_steps = 0
 
     def _release(self, job: _Job) -> None:
@@ -243,18 +272,57 @@ class Scheduler:
 
     # -- admission ----------------------------------------------------------
 
+    _ADMIT_SCAN = 32     # pending jobs considered per admission pass
+    _BYPASS_MAX = 8      # admissions allowed past a page-blocked head
+
     def _admit(self) -> None:
-        """Move pending jobs into the prefilling set while slots+pages last."""
+        """Move pending jobs into the prefilling set while slots+pages last.
+
+        FIFO with bounded-bypass skip-ahead: the queue head is admitted the
+        moment its pages are free — always first. While the head's pages
+        are NOT yet free, later pending jobs that DO fit may be admitted
+        out of order (never taking the last free slot), so small prompts
+        stop convoying behind a big one (the round-2 TTFT tail: a 3x p50
+        max from head-of-line blocking) and the batch stays full. Each
+        bypass is counted against the blocked head; past _BYPASS_MAX the
+        queue reverts to strict FIFO until the head admits, so a stream of
+        small prompts cannot starve the big one."""
         while self._free:
             with self._lock:
-                if not self._pending:
-                    return
-                job = self._pending[0]
-            n = len(job.ids)
-            need = self.core.pages_for(n)
-            if n + 1 >= self.core.max_seq or need > self.core.num_pages - 1:
+                cands = list(self._pending)[: self._ADMIT_SCAN]
+            if not cands:
+                return
+            chosen: Optional[_Job] = None
+            oversized: Optional[_Job] = None
+            head = cands[0]
+            for pos, job in enumerate(cands):
+                n = len(job.ids)
+                need = self.core.pages_for(n)
+                if (n + 1 >= self.core.max_seq
+                        or need > self.core.num_pages - 1):
+                    oversized = job
+                    break
+                if pos == 0:
+                    if self._alloc.available >= need:
+                        chosen = job
+                        break
+                    if head.bypass_count >= self._BYPASS_MAX:
+                        return   # head's turn is overdue: strict FIFO now
+                elif (len(self._free) >= 2
+                        and self._alloc.available >= need):
+                    chosen = job
+                    head.bypass_count += 1
+                    REGISTRY.counter("admission_skips").inc()
+                    break
+            if oversized is not None:
+                job = oversized
                 with self._lock:
-                    self._pending.popleft()
+                    try:
+                        self._pending.remove(job)
+                    except ValueError:
+                        continue   # raced with a re-queue; rescan
+                n = len(job.ids)
+                need = self.core.pages_for(n)
                 if job.gen_ids:
                     # a preempted resume that has outgrown capacity: end it
                     # cleanly at its current length (mirrors the engine's
@@ -265,7 +333,7 @@ class Scheduler:
                     self._finish(job)
                 else:
                     # could never be served — fail loudly rather than hang
-                    # the FIFO head forever (the API also caps prompts,
+                    # in the queue forever (the API also caps prompts,
                     # ref server.py:61-66)
                     self._fail(job, f"prompt of {n} tokens needs {need} KV "
                                     f"pages and {n + 1} cache positions "
@@ -274,11 +342,18 @@ class Scheduler:
                                     f"{self.core.max_seq - 1} positions "
                                     f"(max prompt {self.core.max_seq - 2})")
                 continue
-            pages = self._alloc.alloc(need)
+            if chosen is None:
+                return  # head waits for pages; no admissible surplus job
+            job = chosen
+            pages = self._alloc.alloc(self.core.pages_for(len(job.ids)))
             if pages is None:
-                return  # FIFO head-of-line: wait for pages to free up
+                return   # lost the surplus since the scan; retry next tick
             with self._lock:
-                self._pending.popleft()
+                try:
+                    self._pending.remove(job)
+                except ValueError:
+                    self._alloc.free(pages)
+                    continue
             slot = self._free.pop()
             job.slot = slot
             job.pages = pages
@@ -304,6 +379,14 @@ class Scheduler:
         (engine.prefill_long_last): decode does not interleave during it,
         but the pass runs seq-axis-times faster than the chunk loop — the
         §5.7 long-context serving trade."""
+        t0 = time.perf_counter()
+        try:
+            self._prefill_step_inner()
+        finally:
+            REGISTRY.histogram("prefill_issue_s").observe(
+                time.perf_counter() - t0)
+
+    def _prefill_step_inner(self) -> None:
         job = self._prefilling[0]
         req = job.request
         start = job.prefilled
@@ -313,14 +396,14 @@ class Scheduler:
             job.prefill_started = time.perf_counter()
             self._prefilling.popleft()
             REGISTRY.counter("prefill_long_passes").inc()
-            self._state, _ = self.core.prefill_long_last(
+            self._state, tok = self.core.prefill_long_last(
                 self._state, job.ids, self._table[job.slot], job.slot,
                 generated=len(job.gen_ids) + 1, max_gen=req.max_tokens,
                 temperature=req.temperature, top_k=req.top_k,
                 top_p=req.top_p)
             job.prefilled = len(job.ids)
             job.total_len = job.prefilled
-            job.first_pending = True
+            self._mark_first_pending(job, tok)
             self._slots[job.slot] = job
             return
         remaining = len(job.ids) - start
@@ -338,18 +421,60 @@ class Scheduler:
 
         # Final chunk: sampling + activation are FUSED into the chunk program
         # (engine._chunk_last_impl) — admission never blocks on a host round
-        # trip. The first token's value arrives with the next decode sync
-        # (out["input_tokens"]), where TTFT is stamped.
+        # trip. The first token's VALUE comes back via an async scalar
+        # fetch (TTFT stamps when it lands), with the next decode sync's
+        # out["input_tokens"] as the fallback resolver.
         self._prefilling.popleft()
         already = len(job.gen_ids)
-        self._state, _ = self.core.prefill_chunk_last(
+        self._state, tok = self.core.prefill_chunk_last(
             self._state, chunk_ids, self._table[job.slot], job.slot, start,
             generated=already + 1, max_gen=req.max_tokens,
             temperature=req.temperature, top_k=req.top_k, top_p=req.top_p)
         job.prefilled += len(chunk_ids)
         job.total_len = job.prefilled
-        job.first_pending = True
+        self._mark_first_pending(job, tok)
         self._slots[job.slot] = job
+
+    def _mark_first_pending(self, job: _Job, tok) -> None:
+        """Flag the fused first token for resolution. The value comes back
+        via the next BATCHED state.tokens fetch (_maybe_fetch_firsts): one
+        (B,) transfer resolves every pending admission — per-request
+        scalar fetches measured ~100 ms EACH on the serialized tunnel
+        channel, turning a 20-request burst into ~2 s of queued TTFT."""
+        del tok   # value rides state.tokens; fetching it per-job is slower
+        job.first_pending = True
+        job.first_inflight = False
+        job.first_batched = False
+        job.first_epoch += 1
+
+    def _resolve_first(self, job: _Job, first: int, now: float) -> None:
+        """Emit + stamp a job's fused first token — called by whichever
+        lands first, the direct scalar fetch or a decode sync (idempotent
+        via first_pending). The job must be active in its slot."""
+        if not job.first_pending:
+            return
+        job.first_pending = False
+        job.first_batched = False
+        req = job.request
+        if req.first_token_at is None:         # not a preemption resume
+            req.first_token_at = now
+            REGISTRY.histogram("ttft_s").observe(now - req.submitted_at)
+        # whole-prompt prefill latency, first chunk dispatched → first
+        # token value on the host (an upper bound that includes the
+        # fetch RTT; every dispatch is async, so there is no tighter
+        # host-observable event)
+        if job.prefill_started:
+            REGISTRY.histogram("prefill_s").observe(now - job.prefill_started)
+            job.prefill_started = 0.0
+        already = len(job.gen_ids)
+        if first == self.core.eos_id:
+            del self._slots[job.slot]
+            self._finish(job)
+            return
+        self._emit_token(job, first)
+        if already + 1 >= req.max_tokens:
+            del self._slots[job.slot]
+            self._finish(job)
 
     def _emit_token(self, job: _Job, tok: int) -> None:
         job.gen_ids.append(tok)
@@ -443,6 +568,8 @@ class Scheduler:
         job.prefill_started = 0.0   # the resume's re-prefill is a fresh sample
         # an unsynced first token is recomputed by the resume's re-prefill
         job.first_pending = False
+        job.first_batched = False
+        job.first_inflight = False
         with self._lock:
             self._pending.appendleft(job)
         REGISTRY.counter("preemptions").inc()
@@ -451,11 +578,15 @@ class Scheduler:
 
     @property
     def _steps(self) -> int:
-        """Fused decode steps per dispatch: full depth when no admission is
-        in flight; halved while prefilling so chunk interleave (and thus
-        TTFT of queued prompts) stays reasonably fine-grained."""
-        k = max(1, self.core.cfg.decode_steps_per_dispatch)
-        return max(1, k // 2) if self._prefilling else k
+        """Fused decode steps per dispatch. Always the full configured
+        depth: round 2 halved this while a prefill was in flight (finer
+        chunk interleave), which under sustained load meant HALF the
+        tokens per ~100 ms dispatch round trip almost all of the time —
+        measured as the difference between ~500 and ~900+ tok/s at 2x
+        load. Queued prompts still interleave between dispatches; the
+        device-side wait behind a full pipeline is ~depth x 30 ms, a
+        small TTFT cost next to that throughput cliff."""
+        return max(1, self.core.cfg.decode_steps_per_dispatch)
 
     def _dispatch_decode(self) -> None:
         """Issue one K-step decode dispatch without waiting for its result
@@ -468,14 +599,22 @@ class Scheduler:
         steps = self._grow_pages(self._steps)
         if not self._slots:
             return
-        fresh = [(s, j) for s, j in self._slots.items() if j.first_pending]
+        fresh = [(s, j) for s, j in self._slots.items()
+                 if j.first_pending and not j.first_inflight]
         for _, j in fresh:
-            j.first_pending = False
+            j.first_inflight = True   # only the first dispatch resolves it
+        t0 = time.perf_counter()
         self._state, out = self.core.decode(self._state, self._table_device(),
                                             steps)
-        # hand the result to the fetcher thread NOW: device→host round trips
-        # (~135 ms over a remote-attached chip) then overlap with further
-        # dispatching instead of serializing into the driver loop
+        REGISTRY.histogram("decode_issue_s").observe(time.perf_counter() - t0)
+        REGISTRY.histogram("decode_batch_fill").observe(
+            len(self._slots) / self.core.batch)
+        # hand the result to a fetcher thread NOW: the device→host round
+        # trip (~100 ms over a remote-attached chip) overlaps further
+        # dispatching instead of serializing into the driver loop. (Round 3
+        # also tried pairing two dispatches' outputs into one transfer —
+        # fewer round trips, but tokens then land a dispatch later, slot
+        # turnover slows, and measured throughput was net WORSE.)
         packed = self._fetcher.submit(_fetch, out["packed"])
         # snapshot slot→job at dispatch time: a slot freed and reused while
         # this dispatch is in flight must not leak the old job's tokens into
@@ -489,34 +628,15 @@ class Scheduler:
         steps, packed, fresh, active_map = self._inflight.popleft()
         self._pending_steps -= steps
         # one transfer per dispatch, already in flight on the fetcher thread
+        t0 = time.perf_counter()
         out = unpack_decode_out(packed.result())
+        REGISTRY.histogram("sync_wait_s").observe(time.perf_counter() - t0)
         now = time.perf_counter()
         REGISTRY.counter("tokens_generated").inc(int(out["emitted"].sum()))
         for slot, job in fresh:
             if self._slots.get(slot) is not job:
                 continue  # preempted while in flight; resume re-samples
-            req = job.request
-            first = int(out["input_tokens"][0, slot])
-            if req.first_token_at is None:         # not a preemption resume
-                req.first_token_at = now
-                REGISTRY.histogram("ttft_s").observe(now - req.submitted_at)
-            # whole-prompt prefill latency, first chunk dispatched → first
-            # token value on the host (an upper bound that includes the
-            # pipeline's resolution lag; every dispatch is async, so there
-            # is no tighter host-observable event)
-            if job.prefill_started:
-                REGISTRY.histogram("prefill_s").observe(
-                    now - job.prefill_started)
-                job.prefill_started = 0.0
-            already = len(job.gen_ids)
-            if first == self.core.eos_id:
-                del self._slots[slot]
-                self._finish(job)
-                continue
-            self._emit_token(job, first)
-            if already + 1 >= req.max_tokens:
-                del self._slots[slot]
-                self._finish(job)
+            self._resolve_first(job, int(out["input_tokens"][0, slot]), now)
         for slot, job in active_map.items():
             if self._slots.get(slot) is not job:
                 continue  # finished or preempted since this dispatch
@@ -534,24 +654,87 @@ class Scheduler:
 
     def _tick(self) -> bool:
         """One scheduling round; returns False when fully idle."""
-        self._admit()
         worked = False
+        # eager drain: any dispatch whose result already landed on the host
+        # resolves NOW — first tokens stamp and done slots free without
+        # waiting for the pipeline-depth backpressure point
+        while self._inflight and self._inflight[0][1].done():
+            self._process_decode()
+            worked = True
+        # landed batched first-token fetches resolve without waiting for a
+        # decode sync — the TTFT path while decode is held during ramps
+        landed = [ff for ff in self._first_fetches if ff[0].done()]
+        if landed:
+            # complement by identity, NOT a second done() scan — a fetch
+            # completing between two scans would fall into neither list
+            # and its jobs' first tokens would never resolve
+            landed_ids = {id(ff) for ff in landed}
+            self._first_fetches = [ff for ff in self._first_fetches
+                                   if id(ff) not in landed_ids]
+            now = time.perf_counter()
+            for fut, pairs in landed:
+                tokens_host = fut.result()
+                for slot, job, epoch in pairs:
+                    # identity AND epoch: the job may have been preempted
+                    # and RE-admitted into the same slot while this fetch
+                    # was in flight — its first token is a fresh sample,
+                    # not the one this snapshot carries
+                    if (self._slots.get(slot) is job
+                            and job.first_epoch == epoch):
+                        self._resolve_first(job, int(tokens_host[slot]), now)
+            worked = True
+        self._admit()
+        # Prefill-priority ramp: while admissions are prefilling into a
+        # batch under half full, decode dispatches are HELD — each one at
+        # low fill burns a full ~100 ms fetch round trip on a trickle of
+        # tokens (the round-2 occupancy sink). The hold is budgeted per
+        # episode (cfg.prefill_hold_chunks) so a monster prompt can stall
+        # active streamers only boundedly; held slots' first tokens
+        # already rode their fused final chunks, so TTFT is untouched.
+        ramp = (bool(self._prefilling)
+                and len(self._slots) < self.core.batch // 2)
+        if ramp and not self._holding:
+            self._holding = True
+            self._hold_left = self.core.cfg.prefill_hold_chunks
+        elif not ramp:
+            self._holding = False
         if self._prefilling:
-            # prefill-priority rampup: while the decode batch is underfilled,
-            # burn several chunks per tick (each dispatch pays a fixed
-            # round-trip cost on remote-attached chips — batching admissions
-            # is what gets queued requests their first token sooner)
-            burst = 4 if len(self._slots) < self.core.batch // 2 else 1
+            # several chunks per tick while slots sit empty (issue cost is
+            # ~1-4 ms; filling slots buys occupancy and queued requests'
+            # first tokens), one chunk per tick once the batch is full
+            burst = 8 if len(self._slots) < self.core.batch else 1
             for _ in range(burst):
                 if not self._prefilling:
                     break
                 self._prefill_step()
+                if self._holding:
+                    self._hold_left -= 1
             worked = True
-        if self._slots:
+        # batched first-token fetch: one (B,) transfer covers every job
+        # activated since the last one. Submitted BEFORE the decode
+        # dispatch, while state.tokens still holds those jobs' first
+        # tokens (decode would advance them; such jobs resolve via the
+        # decode sync instead — first_inflight gates the overlap).
+        waiting = [(j.slot, j, j.first_epoch) for j in self._slots.values()
+                   if j.first_pending and not j.first_inflight
+                   and not j.first_batched]
+        if waiting and len(self._first_fetches) < self._first_fetch_depth:
+            toks = self._state.tokens
+            if self.core.donates_state:
+                # the next dispatch DONATES the state: fetching the live
+                # handle races buffer deletion ("Array has been deleted").
+                # A tiny on-device copy is independent of the donation.
+                toks = jnp.copy(toks)
+            fut = self._fetcher.submit(_fetch, toks, "first_fetch_rtt_s")
+            for _, j, _e in waiting:
+                j.first_batched = True
+            self._first_fetches.append((fut, waiting))
+        hold = self._holding and self._hold_left > 0 and bool(self._prefilling)
+        if self._slots and not hold:
             self._dispatch_decode()
             worked = True
-        # keep at most one dispatch in flight beyond the one just issued;
-        # drain fully once nothing is left to dispatch
+        # backpressure: bound dispatches in flight; drain fully once
+        # nothing is left to dispatch
         while (len(self._inflight) > self._pipeline_depth
                or (self._inflight and not self._slots)):
             self._process_decode()

@@ -307,14 +307,13 @@ def test_scheduler_rejects_over_capacity_prompt(engine):
 
 
 def test_scheduler_decode_interleaves_with_chunked_prefill(engine):
-    """Active slots keep decoding between the chunks of a long admission
-    (dispatches are pipelined, so progress is asserted at the dispatch
-    level: decode steps are issued while the long prompt is mid-prefill)."""
+    """With prefill-priority holding disabled, active slots keep decoding
+    between the chunks of a long admission (dispatches are pipelined, so
+    progress is asserted at the dispatch level: decode steps are issued
+    while the long prompt is mid-prefill)."""
     from generativeaiexamples_tpu.core.metrics import REGISTRY
     _, tok, cfg, params = engine
-    core = EngineCore(cfg, EngineConfig(max_batch_size=4, max_seq_len=256,
-                                        prefill_chunk=32, page_size=16),
-                      params, eos_id=tok.eos_id)
+    core = EngineCore(cfg, _ecfg_interleave(0), params, eos_id=tok.eos_id)
     sched = Scheduler(core, tok)   # not started: we drive ticks by hand
     short = Request(prompt_ids=tok.encode("hi", add_bos=True), max_tokens=40,
                     temperature=0.0)
@@ -324,7 +323,7 @@ def test_scheduler_decode_interleaves_with_chunked_prefill(engine):
     steps_before = REGISTRY.counter("decode_steps").value
 
     long = Request(prompt_ids=tok.encode("n" * 200, add_bos=True),
-                   max_tokens=4, temperature=0.0)   # 7 chunks > one burst
+                   max_tokens=4, temperature=0.0)   # 13 chunks > one burst
     sched.submit(long)
     sched._tick()                  # a chunk burst of `long` + decode dispatch
     assert sched._prefilling, "long prompt must still be mid-prefill"
@@ -335,6 +334,147 @@ def test_scheduler_decode_interleaves_with_chunked_prefill(engine):
     assert short.error is None and long.error is None
     assert short.completion_tokens == 40
     assert long.completion_tokens == 4
+
+
+def _ecfg_interleave(hold_chunks: int):
+    return EngineConfig(max_batch_size=4, max_seq_len=256, prefill_chunk=16,
+                        page_size=16, prefill_hold_chunks=hold_chunks)
+
+
+def test_scheduler_prefill_hold_is_bounded(engine):
+    """Prefill-priority holding defers decode while the batch is underfilled
+    — but only up to its chunk budget; decode always resumes while a long
+    admission is still prefilling once the budget is spent."""
+    from generativeaiexamples_tpu.core.metrics import REGISTRY
+    _, tok, cfg, params = engine
+
+    def setup(hold_chunks):
+        core = EngineCore(cfg, _ecfg_interleave(hold_chunks), params,
+                          eos_id=tok.eos_id)
+        sched = Scheduler(core, tok)
+        short = Request(prompt_ids=tok.encode("hi", add_bos=True),
+                        max_tokens=40, temperature=0.0)
+        sched.submit(short)
+        sched._tick()
+        assert sched._slots
+        long = Request(prompt_ids=tok.encode("n" * 200, add_bos=True),
+                       max_tokens=4, temperature=0.0)   # 13 chunks
+        sched.submit(long)
+        return sched, short, long
+
+    # budget > one burst: the first ramp tick holds decode entirely
+    sched, short, long = setup(hold_chunks=16)
+    before = REGISTRY.counter("decode_steps").value
+    sched._tick()
+    assert sched._prefilling, "long prompt still mid-prefill"
+    assert REGISTRY.counter("decode_steps").value == before, \
+        "decode should be held during the budgeted ramp"
+    while sched._tick():
+        pass
+    assert short.completion_tokens == 40 and long.completion_tokens == 4
+
+    # budget <= one burst: the bound binds — decode resumes the same tick
+    # even though the admission is still prefilling
+    sched, short, long = setup(hold_chunks=8)
+    before = REGISTRY.counter("decode_steps").value
+    sched._tick()
+    assert sched._prefilling, "long prompt still mid-prefill"
+    assert REGISTRY.counter("decode_steps").value > before, \
+        "spent budget must not keep holding decode"
+    while sched._tick():
+        pass
+    assert short.completion_tokens == 40 and long.completion_tokens == 4
+
+
+def test_first_token_fetch_survives_donated_state(engine):
+    """The batched first-token fetch must not read a state buffer the next
+    decode dispatch DONATES (regression: 'Array has been deleted' crashed
+    the driver under concurrent load with donate_buffers=on). A deferred
+    fetcher forces the worst ordering: every fetch runs only after later
+    dispatches consumed the state."""
+    import concurrent.futures
+
+    class DeferredExecutor:
+        def __init__(self):
+            self.calls = []
+
+        def submit(self, fn, *args):
+            fut = concurrent.futures.Future()
+            self.calls.append((fut, fn, args))
+            return fut
+
+        def run_all(self):
+            calls, self.calls = self.calls, []
+            for fut, fn, args in calls:
+                try:
+                    fut.set_result(fn(*args))
+                except BaseException as exc:   # surfaces into .result()
+                    fut.set_exception(exc)
+
+        def shutdown(self, wait=True):
+            pass
+
+    _, tok, cfg, params = engine
+    ecfg = EngineConfig(max_batch_size=4, max_seq_len=128, page_size=16,
+                        prefill_chunk=32, donate_buffers="on")
+    core = EngineCore(cfg, ecfg, params, eos_id=tok.eos_id)
+    assert core.donates_state
+    sched = Scheduler(core, tok)
+    sched._fetcher = DeferredExecutor()
+    reqs = [Request(prompt_ids=tok.encode(f"req {i}", add_bos=True),
+                    max_tokens=6, temperature=0.0) for i in range(3)]
+    for r in reqs:
+        sched.submit(r)
+    for _ in range(200):
+        sched._tick()               # dispatches donate the state eagerly
+        sched._fetcher.run_all()    # fetches run strictly AFTER
+        if all(r.out_queue.qsize() or r.completion_tokens >= r.max_tokens
+               for r in reqs) and not sched._slots and not sched._inflight:
+            break
+    sched._fetcher.run_all()
+    while sched._tick():
+        sched._fetcher.run_all()
+    for r in reqs:
+        assert r.error is None, r.error
+        assert r.completion_tokens == 6
+
+
+def test_admission_skip_ahead_bypasses_blocked_head(engine):
+    """A small prompt that fits must not convoy behind a page-blocked big
+    prompt at the queue head (bounded-bypass skip-ahead) — and the big one
+    still completes once pages free."""
+    from generativeaiexamples_tpu.core.metrics import REGISTRY
+    _, tok, cfg, params = engine
+    ecfg = EngineConfig(max_batch_size=4, max_seq_len=128, page_size=8,
+                        prefill_chunk=16, num_pages=12,   # 11 usable pages
+                        prefill_hold_chunks=0)
+    core = EngineCore(cfg, ecfg, params, eos_id=tok.eos_id)
+    sched = Scheduler(core, tok)   # driven by hand
+    holder = Request(prompt_ids=tok.encode("x" * 40), max_tokens=16,
+                     temperature=0.0)           # 6 pages + growth
+    sched.submit(holder)
+    sched._tick()
+    assert sched._slots, "holder should be decoding"
+
+    big = Request(prompt_ids=tok.encode("y" * 55), max_tokens=4,
+                  temperature=0.0)              # 7 pages: blocked for now
+    small = Request(prompt_ids=tok.encode("hi"), max_tokens=4,
+                    temperature=0.0)            # 1 page: fits immediately
+    sched.submit(big)
+    sched.submit(small)
+    skips0 = REGISTRY.counter("admission_skips").value
+    sched._tick()
+    active = list(sched._prefilling) + list(sched._slots.values())
+    assert any(j.request is small for j in active), \
+        "small prompt must bypass the page-blocked head"
+    assert all(j.request is not big for j in active), \
+        "big prompt cannot fit yet"
+    assert REGISTRY.counter("admission_skips").value == skips0 + 1
+    while sched._tick():
+        pass
+    for r in (holder, big, small):
+        assert r.error is None
+        assert r.completion_tokens == r.max_tokens
 
 
 def test_scheduler_preempts_and_resumes_under_page_pressure(engine):
