@@ -103,11 +103,10 @@ class EngineCore:
         tp = int(mesh.shape.get("tensor", 1)) if mesh is not None else 1
         attn = engine_cfg.attention
         if attn == "auto":
-            # The pallas kernels assume head-axis-unsharded layouts; with TP
-            # over heads the XLA path (shardable by the partitioner) is used
-            # until the kernels grow a shard_map wrapper.
-            attn = ("pallas" if jax.default_backend() == "tpu" and tp == 1
-                    else "xla")
+            # pallas on TPU regardless of TP degree: under tensor
+            # parallelism the kernels run per-shard through shard_map
+            # wrappers (engine/kv_cache.py), attending local head slices
+            attn = "pallas" if jax.default_backend() == "tpu" else "xla"
         if attn != model_cfg.attn_impl:
             model_cfg = dataclasses.replace(model_cfg, attn_impl=attn)
         if tp > 1:
@@ -267,7 +266,8 @@ class EngineCore:
         # captured 6 GB pytree would be baked into the lowered program
         logits, cache = kv_cache.prefill_chunk(
             params, self.model_cfg, tokens, state.cache, page_row, slot,
-            start_pos, chunk_len, self.num_pages, adapters=adapters)
+            start_pos, chunk_len, self.num_pages, adapters=adapters,
+            mesh=self.mesh)
         return dataclasses.replace(state, cache=cache), logits[0]
 
     def prefill_chunk(self, state: DecodeState, chunk_ids, page_row, slot: int,
@@ -423,7 +423,8 @@ class EngineCore:
         reaches the host batched into the next decode sync."""
         logits, cache = kv_cache.prefill_chunk(
             params, self.model_cfg, tokens, state.cache, page_row, slot,
-            start_pos, chunk_len, self.num_pages, adapters=adapters)
+            start_pos, chunk_len, self.num_pages, adapters=adapters,
+            mesh=self.mesh)
         return self._activate_sampled(state, cache, logits, slot, generated,
                                       max_gen, temperature, top_k, top_p)
 
@@ -486,7 +487,8 @@ class EngineCore:
         def step(state, _):
             logits, cache = kv_cache.decode_step(
                 params, self.model_cfg, state.tokens, state.cache,
-                page_table, state.active, self.num_pages, adapters=adapters)
+                page_table, state.active, self.num_pages, adapters=adapters,
+                mesh=self.mesh)
             rng, sub = jax.random.split(state.rng)
             # inactive slots' stale temperatures must not defeat the
             # all-greedy fast path inside the sampler
