@@ -701,8 +701,11 @@ class Scheduler:
         if self._prefilling:
             # several chunks per tick while slots sit empty (issue cost is
             # ~1-4 ms; filling slots buys occupancy and queued requests'
-            # first tokens), one chunk per tick once the batch is full
-            burst = 8 if len(self._slots) < self.core.batch else 1
+            # first tokens), one chunk per tick once the batch is full.
+            # 4/tick, not more: each tick's activations share one batched
+            # first-token fetch, so the burst size is the TTFT resolution
+            # granularity of an admission ramp
+            burst = 4 if len(self._slots) < self.core.batch else 1
             for _ in range(burst):
                 if not self._prefilling:
                     break

@@ -375,7 +375,7 @@ def test_scheduler_prefill_hold_is_bounded(engine):
 
     # budget <= one burst: the bound binds — decode resumes the same tick
     # even though the admission is still prefilling
-    sched, short, long = setup(hold_chunks=8)
+    sched, short, long = setup(hold_chunks=4)
     before = REGISTRY.counter("decode_steps").value
     sched._tick()
     assert sched._prefilling, "long prompt still mid-prefill"
