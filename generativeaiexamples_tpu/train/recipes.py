@@ -42,7 +42,7 @@ RECIPES: Dict[str, TrainConfig] = {
     "lora_pubmedqa": TrainConfig(
         mode="lora", lora=LoraConfig(rank=8, alpha=16.0),
         micro_batch_size=1, global_batch_size=8, max_steps=50,
-        learning_rate=1e-4, seq_len=1024),
+        learning_rate=1e-4, seq_len=1024, steps_per_dispatch=5),
     # Gemma/sft.ipynb: full-parameter SFT (multi-chip FSDP)
     "sft_full": TrainConfig(
         mode="full", micro_batch_size=1, global_batch_size=8, max_steps=50,
@@ -66,7 +66,8 @@ RECIPES: Dict[str, TrainConfig] = {
     # higher LR, longer schedule) then SFT via the other recipes
     "slm_pretrain": TrainConfig(
         mode="full", micro_batch_size=4, global_batch_size=32,
-        max_steps=1000, warmup_steps=100, learning_rate=3e-4, seq_len=1024),
+        max_steps=1000, warmup_steps=100, learning_rate=3e-4, seq_len=1024,
+        steps_per_dispatch=8, checkpoint_every=200),
     # test/demo-scale recipe (the suite's fast path)
     "demo": TrainConfig(
         mode="lora", lora=LoraConfig(rank=4, alpha=8.0),

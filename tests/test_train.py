@@ -151,6 +151,32 @@ def test_full_sft_training_descends(tiny):
     assert losses[-1] < losses[0], losses
 
 
+def test_pipelined_fit_loss_parity(tiny):
+    """dispatch_ahead>0 (the round-4 throughput fix) must not change the
+    math: losses per step are identical to the synchronous loop, every
+    step's on_step fires exactly once and in order."""
+    cfg, params = tiny
+    base = dict(mode="lora", lora=lora_lib.LoraConfig(rank=4),
+                micro_batch_size=2, global_batch_size=4, max_steps=7,
+                warmup_steps=2, learning_rate=5e-3, seq_len=16)
+    batches = _toy_batches(TrainConfig(**base), cfg.vocab_size, 7, seed=3)
+
+    runs = {}
+    # (dispatch_ahead, steps_per_dispatch): sync loop, pipelined, and fused
+    # multi-step dispatch (7 steps at spd=4 → one K=4 and one K=3 program)
+    for key in ((0, 1), (4, 1), (4, 4)):
+        tcfg = TrainConfig(**base, dispatch_ahead=key[0],
+                           steps_per_dispatch=key[1])
+        trainer = Trainer(cfg, tcfg, params)
+        seen = []
+        trainer.fit(batches, on_step=lambda s, m: seen.append((s, m["loss"])))
+        runs[key] = seen
+    for key in ((4, 1), (4, 4)):
+        assert [s for s, _ in runs[key]] == list(range(1, 8)), key
+        np.testing.assert_allclose([l for _, l in runs[(0, 1)]],
+                                   [l for _, l in runs[key]], rtol=0, atol=0)
+
+
 def test_checkpoint_resume_roundtrip(tiny, tmp_path):
     cfg, params = tiny
     tcfg = TrainConfig(mode="lora", lora=lora_lib.LoraConfig(rank=2),
