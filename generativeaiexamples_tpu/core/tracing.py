@@ -18,6 +18,11 @@ from typing import Any, Callable
 
 from generativeaiexamples_tpu.observability import otel
 
+# honor APP_TRACING_EXPORTER at process start (console | jsonl | otlp |
+# memory); a no-op when unset — the reference's compose files likewise pick
+# the exporter via OTEL_EXPORTER_OTLP_ENDPOINT env wiring
+otel.configure_from_env()
+
 tracer = otel.get_tracer("generativeaiexamples_tpu")
 
 

@@ -129,8 +129,186 @@ class JsonlSpanExporter(SpanExporter):
                 fh.write(line + "\n")
 
 
+class OTLPHTTPSpanExporter(SpanExporter):
+    """OTLP/HTTP JSON exporter: spans land in any OTLP collector (Jaeger
+    all-in-one, otel-collector, Tempo) at ``<endpoint>/v1/traces``.
+
+    In-tree replacement for the reference's OTLP-gRPC → collector pipeline
+    (ref: RAG/src/chain_server/tracing.py:36-59 exporter setup;
+    RAG/tools/observability/configs/otel-collector-config.yaml) with the
+    collector's PROCESSING folded in, since there is no collector sidecar
+    to do it here:
+
+      * health-probe spans never reach the wire (the Tracer's tail filter,
+        = the collector's tail_sampling drop, config lines 10-20);
+      * collection/document ids in ``http.target`` / ``http.url`` are
+        anonymized to ``{collection_id}``/``{document_id}`` placeholders
+        (= the collector's transform replace_patterns, lines 21-43).
+
+    Spans batch on a background thread (flush every ``batch_size`` spans or
+    ``flush_interval_s``); export() never blocks the traced request path.
+    A dead collector drops batches with one warning, not one per span.
+    """
+
+    _ANON = [
+        (r"/collections/[\w-]+/documents/[\w-]+",
+         "/collections/{collection_id}/documents/{document_id}"),
+        (r"/collections/[\w-]+/search", "/collections/{collection_id}/search"),
+        (r"/collections/[\w-]+$", "/collections/{collection_id}"),
+    ]
+
+    def __init__(self, endpoint: str = "http://localhost:4318",
+                 service_name: str = "generativeaiexamples-tpu",
+                 batch_size: int = 32, flush_interval_s: float = 2.0,
+                 anonymize: bool = True) -> None:
+        import queue as _queue
+        self._url = endpoint.rstrip("/") + "/v1/traces"
+        self._service = service_name
+        self._anonymize = anonymize
+        self._batch_size = batch_size
+        self._interval = flush_interval_s
+        self._q: "_queue.Queue" = _queue.Queue()
+        self._warned = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="otlp-export")
+        self._thread.start()
+
+    def export(self, span: Span) -> None:
+        self._q.put(span)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2 * self._interval + 5)
+
+    # -- wire encoding -----------------------------------------------------
+
+    @staticmethod
+    def _value(v: Any) -> Dict[str, Any]:
+        if isinstance(v, bool):
+            return {"boolValue": v}
+        if isinstance(v, int):
+            return {"intValue": str(v)}
+        if isinstance(v, float):
+            return {"doubleValue": v}
+        return {"stringValue": str(v)}
+
+    @classmethod
+    def _attrs(cls, mapping: Mapping[str, Any]) -> List[Dict[str, Any]]:
+        return [{"key": k, "value": cls._value(v)} for k, v in mapping.items()]
+
+    def _scrub(self, attrs: Dict[str, Any]) -> Dict[str, Any]:
+        if not self._anonymize:
+            return attrs
+        import re
+        out = dict(attrs)
+        for key in ("http.target", "http.url", "http.path"):
+            val = out.get(key)
+            if isinstance(val, str):
+                for pat, repl in self._ANON:
+                    val = re.sub(pat, repl, val)
+                out[key] = val
+        return out
+
+    def _encode(self, spans: List[Span]) -> bytes:
+        wire = []
+        for s in spans:
+            enc = {
+                "traceId": s.trace_id,
+                "spanId": s.span_id,
+                "name": s.name,
+                "kind": 1,
+                "startTimeUnixNano": str(s.start_ns),
+                "endTimeUnixNano": str(s.end_ns),
+                "attributes": self._attrs(self._scrub(s.attributes)),
+                "events": [{"timeUnixNano": str(e["time_ns"]),
+                            "name": e["name"],
+                            "attributes": self._attrs(e["attributes"])}
+                           for e in s.events],
+                "status": {"code": 2 if s.status == "ERROR" else 1},
+            }
+            if s.parent_id:
+                enc["parentSpanId"] = s.parent_id
+            wire.append(enc)
+        return json.dumps({"resourceSpans": [{
+            "resource": {"attributes": self._attrs(
+                {"service.name": self._service})},
+            "scopeSpans": [{"scope": {"name": "generativeaiexamples_tpu"},
+                            "spans": wire}],
+        }]}).encode()
+
+    # -- background flush --------------------------------------------------
+
+    def _loop(self) -> None:
+        import queue as _queue
+        batch: List[Span] = []
+        deadline = time.monotonic() + self._interval
+        while True:
+            timeout = max(0.05, deadline - time.monotonic())
+            try:
+                batch.append(self._q.get(timeout=timeout))
+            except _queue.Empty:
+                pass
+            flush_now = (len(batch) >= self._batch_size
+                         or time.monotonic() >= deadline
+                         or self._stop.is_set())
+            if flush_now and batch:
+                self._post(batch)
+                batch = []
+            if time.monotonic() >= deadline:
+                deadline = time.monotonic() + self._interval
+            if self._stop.is_set() and self._q.empty() and not batch:
+                return
+
+    def _post(self, batch: List[Span]) -> None:
+        import urllib.error
+        import urllib.request
+        req = urllib.request.Request(
+            self._url, data=self._encode(batch),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=5):
+                pass
+            self._warned = False
+        except (urllib.error.URLError, OSError) as exc:
+            if not self._warned:   # one warning per outage, not per batch
+                import logging
+                logging.getLogger(__name__).warning(
+                    "OTLP export to %s failed (%s); dropping spans until "
+                    "the collector returns", self._url, exc)
+                self._warned = True
+
+
 _exporter: SpanExporter = ConsoleSpanExporter()
 _drop_name_substrings = ("/health",)  # ref: otel-collector-config.yaml tail_sampling lines 10-20
+
+
+def configure_from_env() -> Optional[SpanExporter]:
+    """Pick the exporter from env, mirroring the reference's compose wiring
+    (ref: docker-compose.yaml OTEL_EXPORTER_OTLP_ENDPOINT):
+
+      APP_TRACING_EXPORTER = console | jsonl | otlp | memory
+      APP_TRACING_OTLP_ENDPOINT (default http://localhost:4318)
+      APP_TRACING_JSONL_PATH (default traces.jsonl)
+    """
+    kind = os.environ.get("APP_TRACING_EXPORTER", "").strip().lower()
+    if not kind:
+        return None
+    if kind == "otlp":
+        exp: SpanExporter = OTLPHTTPSpanExporter(
+            endpoint=os.environ.get("APP_TRACING_OTLP_ENDPOINT",
+                                    "http://localhost:4318"),
+            service_name=os.environ.get("APP_TRACING_SERVICE",
+                                        "generativeaiexamples-tpu"))
+    elif kind == "jsonl":
+        exp = JsonlSpanExporter(os.environ.get("APP_TRACING_JSONL_PATH",
+                                               "traces.jsonl"))
+    elif kind == "memory":
+        exp = InMemorySpanExporter()
+    else:
+        exp = ConsoleSpanExporter()
+    set_exporter(exp)
+    return exp
 
 
 def set_exporter(exporter: SpanExporter) -> None:
