@@ -134,6 +134,49 @@ def _measure_lora_tok_s(on_tpu: bool) -> float:
     return timed_steps * tcfg.global_batch_size * tcfg.seq_len / wall
 
 
+def _measure_encoders(on_tpu: bool) -> tuple:
+    """Embedder docs/s + reranker pairs/s — the 40→4 rerank funnel shape of
+    the multi-turn chain (chains/multi_turn_rag.py; ref NIMs,
+    docker-compose-nim-ms.yaml:30-81). Timed batches use the e5-class /
+    cross-encoder defaults on realistic passage lengths; both paths warm
+    their (batch, length) buckets first so compiles stay untimed.
+    Returns (docs_per_s, pairs_per_s)."""
+    from generativeaiexamples_tpu.encoders.embedder import Embedder
+    from generativeaiexamples_tpu.encoders.reranker import Reranker
+    from generativeaiexamples_tpu.models import bert
+
+    if on_tpu:
+        # e5-base-class shape in bf16 (the TPU serving dtype; the f32
+        # default is for HF numerical-parity tests)
+        cfg = bert.BertConfig(dtype="bfloat16")
+        n_docs, n_queries = 256, 8
+    else:
+        cfg = bert.BertConfig.tiny()
+        n_docs, n_queries = 16, 2
+    passage = ("The auxiliary pump assembly requires inspection every "
+               "400 hours and operates at 24 volts nominal; refer to "
+               "maintenance manual section 7 for torque values. ") * 3
+    docs = [f"{passage} unit {i}" for i in range(n_docs)]
+    query = "What voltage does the auxiliary pump assembly use?"
+
+    # batch 64: each dispatch costs ~90 ms of tunnel overhead + ~100 ms
+    # fetch regardless of size, so fewer/fatter batches dominate docs/s
+    emb = Embedder(cfg=cfg, max_batch=64)
+    emb.embed_documents(docs[: emb.max_batch])          # warm the bucket
+    t0 = time.perf_counter()
+    emb.embed_documents(docs)
+    docs_per_s = len(docs) / (time.perf_counter() - t0)
+
+    rer = Reranker(cfg=cfg)
+    funnel = docs[:40]                                   # the 40→4 funnel
+    rer.rerank(query, funnel, top_n=4)                   # warm
+    t0 = time.perf_counter()
+    for _ in range(n_queries):
+        rer.rerank(query, funnel, top_n=4)
+    pairs_per_s = n_queries * len(funnel) / (time.perf_counter() - t0)
+    return docs_per_s, pairs_per_s
+
+
 def _measure_rag_e2e(sched, n_clients: int, rounds: int,
                      max_tokens: int, max_context_tokens: int) -> tuple:
     """BASELINE's first target: RAG end-to-end req/s — the REAL chain-server
@@ -268,10 +311,19 @@ def main() -> None:
         # prefill ramp short enough for sub-second p50 TTFT (batch 20
         # measured +9% tok/s but ~1.15 s p50 — the wrong trade against
         # BASELINE's <1 s north star).
+        # Round-4 serving point (measured on the tunneled v5e): grouped
+        # prefill (up to 4 chunks/dispatch — the slot-refill and ramp
+        # bottleneck was per-dispatch overhead, ~90 ms regardless of size),
+        # pipeline depth 2 (faster done-slot turnover; the engine is
+        # device-bound now, ~15 ms/decode step), hold 32 (the ramp's
+        # half-batch condition self-limits it, so active streamers are
+        # still protected). Adaptive steps (decode_steps_max=16) measured
+        # NET NEGATIVE here — the dispatch rate drops ~proportionally when
+        # device-bound and TTFT rises — so it stays off in the bench.
         ecfg = EngineConfig(max_batch_size=16, max_seq_len=1536,
                             page_size=128, prefill_chunk=512,
                             decode_steps_per_dispatch=8,
-                            prefill_hold_chunks=16, quant=quant)
+                            prefill_hold_chunks=32, quant=quant)
         lat_prompts = [480] * 12 + [1200] * 4          # = slot count
         thr_prompts = [480] * 20 + [1200] * 6 + [96] * 6   # 2x slots
         max_tokens, warm_lens = 96, (128, 480, 1200)
@@ -290,10 +342,17 @@ def main() -> None:
     # the serving phases allocate the KV pool.
     lora_tok_s = _measure_lora_tok_s(on_tpu)
 
+    # -- encoder services (the multi-turn chain's 40→4 funnel hot path) ----
+    emb_docs_s, rerank_pairs_s = _measure_encoders(on_tpu)
+
     tok = ByteTokenizer()
     params = llama.init_params(jax.random.PRNGKey(0), model_cfg)
     n_params = sum(x.size for x in jax.tree.leaves(params))
     core = EngineCore(model_cfg, ecfg, params, eos_id=tok.eos_id)
+    # compile the full serving grid (grouped-prefill buckets x decode
+    # depths) against a throwaway state BEFORE the scheduler allocates the
+    # real pool — nothing compiles inside the timed phases
+    core.warmup()
     sched = Scheduler(core, tok)
     sched.start()
 
@@ -301,9 +360,8 @@ def main() -> None:
         ids = [32 + (i * 7) % 90 for i in range(n_prompt)]
         return Request(prompt_ids=ids, max_tokens=max_tokens, temperature=0.0)
 
-    # warmup: compile every prefill bucket, the chunk path, and the decode
-    # step program (concurrent submission exercises prefill/decode
-    # interleave so nothing compiles inside the timed phases)
+    # warm the end-to-end request path (prefill/decode interleave, sampler,
+    # detokenizer) — programs are already compiled by core.warmup()
     warm = [make_req(n) for n in warm_lens] + [make_req(warm_lens[0])]
     for req in warm:
         sched.submit(req)
@@ -401,6 +459,8 @@ def main() -> None:
         "mfu": round(mfu, 4) if mfu is not None else None,
         "hbm_weight_read_util": round(bw_util, 4) if bw_util is not None else None,
         "lora_tok_s_chip": round(lora_tok_s, 1),
+        "embed_docs_s": round(emb_docs_s, 1),
+        "rerank_pairs_s": round(rerank_pairs_s, 1),
         "device": str(jax.devices()[0]),
     }))
 

@@ -75,6 +75,15 @@ def build_scheduler(tiny: bool = False) -> tuple:
         logging.info("serving over mesh %s", dict(mesh.shape))
     core = EngineCore(model_cfg, cfg.engine, params, eos_id=tokenizer.eos_id,
                       mesh=mesh)
+    if not tiny:
+        # compile the whole serving program grid before the first request —
+        # lazy compiles (~20-40 s each over a tunneled chip) would stall
+        # live traffic (the scheduler creates the real state afterwards);
+        # tokenizer included so the constrained-decoding variants warm too
+        logging.info("compiling serving programs (grouped prefill buckets "
+                     "%s, decode depths, grammar variants)...",
+                     core.group_buckets)
+        core.warmup(tokenizer=tokenizer)
     return Scheduler(core, tokenizer), model_name
 
 

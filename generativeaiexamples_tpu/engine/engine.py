@@ -56,6 +56,30 @@ from generativeaiexamples_tpu.ops.sampling import sample_logits_dynamic
 _PACKED_FIELDS = ("sampled", "emitted", "done", "hit_eos", "input_tokens")
 
 
+@dataclasses.dataclass(frozen=True)
+class PrefillItem:
+    """One prompt's next chunk, for a grouped prefill dispatch.
+
+    ``is_last`` items get the fused sampling + slot-activation tail
+    (the per-prompt analogue of `prefill_chunk_last`); mid-prompt items
+    only write KV + lengths. ``gram_state`` is the flat constrained-
+    decoding DFA state the fused first token samples under (0 = request
+    is unconstrained; resumes carry the state walked over the tokens
+    already emitted)."""
+
+    chunk_ids: Any                # sequence of token ids (<= prefill_chunk)
+    page_row: Any                 # (max_pages_per_slot,) int32
+    slot: int
+    start_pos: int
+    is_last: bool = False
+    generated: int = 0            # tokens produced incl. the fused one
+    max_gen: int = 0
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    gram_state: int = 0
+
+
 def unpack_decode_out(packed) -> Dict[str, Any]:
     """Split a host-fetched ``out["packed"]`` block back into named arrays."""
     return {k: packed[i] for i, k in enumerate(_PACKED_FIELDS)}
@@ -75,11 +99,12 @@ class DecodeState:
     top_k: jnp.ndarray        # (B,) i32
     top_p: jnp.ndarray        # (B,) f32
     rng: jnp.ndarray          # PRNG key
+    gram_state: jnp.ndarray   # (B,) i32 — flat DFA state; 0 = unconstrained
 
     def tree_flatten(self):
         return ((self.cache, self.tokens, self.active, self.generated,
                  self.max_gen, self.temperature, self.top_k, self.top_p,
-                 self.rng), None)
+                 self.rng, self.gram_state), None)
 
     @classmethod
     def tree_unflatten(cls, _, c):
@@ -141,6 +166,11 @@ class EngineCore:
             # than silently round the operator's setting down
             raise ValueError(
                 f"decode_steps_per_dispatch ({k}) must be a power of two")
+        km = engine_cfg.decode_steps_max
+        if km and (km < k or km & (km - 1)):
+            raise ValueError(
+                f"decode_steps_max ({km}) must be a power of two >= "
+                f"decode_steps_per_dispatch ({k})")
         self.max_pages_per_slot = -(-self.max_seq // self.page_size)
         # total physical pages: 0 = full slot capacity (+ null page 0)
         self.num_pages = (engine_cfg.num_pages or
@@ -202,14 +232,37 @@ class EngineCore:
         # first-token fetch) must copy them before the next dispatch
         # deletes the donated buffers
         self.donates_state = bool(dn)
+        # grouped-prefill size buckets (compile one program per bucket);
+        # group padding entries are dropped on-device (OOB slot id)
+        gmax = max(1, engine_cfg.prefill_group)
+        gb, g = [], 1
+        while g < gmax:
+            gb.append(g)
+            g *= 2
+        gb.append(gmax)
+        self.group_buckets = tuple(gb)
         self._chunk_fn = jax.jit(self._chunk_impl, donate_argnums=dn)
+        self._group_fn = jax.jit(self._group_impl, donate_argnums=dn,
+                                 static_argnums=(21,))
+        # constrained-decoding grammar registry: up to GRAM_SLOTS byte-DFAs
+        # live in one flat device table; flat state g*GRAM_STATES+s, flat
+        # state 0 = the shared reject sink (engine/grammar.py). Built lazily
+        # on the first grammared request.
+        self._grammars: Dict[str, int] = {}        # key -> grammar slot
+        self._gram_starts: Dict[str, int] = {}     # key -> flat start state
+        self._gram_dfas: Dict[str, Any] = {}       # key -> host ByteDFA
+        self._gram_table = None                    # (GRAM_SLOTS*STATES, 256)
+        self._gram_accept = None
+        self._gram_dist = None
+        self._tok_bytes = None                     # (V, L) int32
+        self._tok_lens = None
         self._long_fn = jax.jit(self._prefill_long_impl, donate_argnums=dn)
         self._long_last_fn = jax.jit(self._prefill_long_last_impl,
                                      donate_argnums=dn)
         self._chunk_last_fn = jax.jit(self._chunk_last_impl,
                                       donate_argnums=dn)
         self._decode_fn = jax.jit(self._decode_impl, donate_argnums=dn,
-                                  static_argnums=(4,))
+                                  static_argnums=(9, 10))
         self._activate_fn = jax.jit(self._activate_impl, donate_argnums=dn)
         self._release_fn = jax.jit(self._release_impl, donate_argnums=dn)
         self._sample_fn = jax.jit(self._sample_impl)
@@ -234,11 +287,13 @@ class EngineCore:
             top_k=jnp.zeros((B,), jnp.int32),
             top_p=jnp.ones((B,), jnp.float32),
             rng=rng if rng is not None else jax.random.PRNGKey(0),
+            gram_state=jnp.zeros((B,), jnp.int32),
         )
         if self.mesh is not None:
             rest = jax.device_put(
                 (state.tokens, state.active, state.generated, state.max_gen,
-                 state.temperature, state.top_k, state.top_p, state.rng),
+                 state.temperature, state.top_k, state.top_p, state.rng,
+                 state.gram_state),
                 self._replicated)
             state = DecodeState(cache, *rest)
         return state
@@ -411,6 +466,10 @@ class EngineCore:
             top_k=upd(state.top_k, top_k),
             top_p=upd(state.top_p, top_p),
             rng=rng,
+            # activation always clears the DFA state: a slot vacated by a
+            # grammared request must not leak its grammar onto the next
+            # occupant (this path — single/long prefill — is unconstrained)
+            gram_state=upd(state.gram_state, jnp.int32(0)),
         )
         return new_state, tok
 
@@ -445,6 +504,284 @@ class EngineCore:
             jnp.int32(max_gen), jnp.float32(temperature), jnp.int32(top_k),
             jnp.float32(top_p))
 
+    # ------------------------------------------------------- grouped prefill
+
+    # grammar stack geometry: GRAM_SLOTS schemas resident at once, each up
+    # to GRAM_STATES DFA states (json_value's depth-3 automaton is ~3.8k;
+    # schema/tool grammars are typically tens to hundreds)
+    GRAM_SLOTS = 4
+    GRAM_STATES = 4096
+
+    def _group_impl(self, state: DecodeState, params, adapters, tokens,
+                    page_rows, slots, len_slots, start_pos, chunk_len,
+                    is_last, generated, max_gen, temperature, top_k, top_p,
+                    gram_states, gram_table, gram_accept, gram_dist,
+                    tok_bytes, tok_lens, use_grammar: bool
+                    ) -> Tuple[DecodeState, jnp.ndarray]:
+        """G chunks in ONE dispatch; ``is_last`` rows additionally run the
+        fused first-token sample + slot activation (the group generalization
+        of `_chunk_last_impl`). Padding rows carry slot == B (out of range):
+        every scatter for them is dropped on-device. ``len_slots`` is the
+        lengths-scatter dedup of ``slots`` (see kv_cache.prefill_chunks).
+        With ``use_grammar`` (static), the fused first token samples under
+        each row's DFA state and the advanced state is scattered into
+        DecodeState.gram_state — constrained decoding from token one."""
+        logits, cache = kv_cache.prefill_chunks(
+            params, self.model_cfg, tokens, state.cache, page_rows,
+            len_slots, start_pos, chunk_len, self.num_pages,
+            adapters=adapters, mesh=self.mesh)
+        rng, sub = jax.random.split(state.rng)
+        if use_grammar:
+            from generativeaiexamples_tpu.ops.sampling import (
+                grammar_advance, grammar_mask)
+            logits = grammar_mask(logits, gram_states, max_gen - generated,
+                                  self.eos_id, gram_table, gram_accept,
+                                  gram_dist, tok_bytes, tok_lens)
+        toks = sample_logits_dynamic(sub, logits, temperature, top_k, top_p)
+        alive = is_last & (toks != self.eos_id) & (generated < max_gen)
+        # mid-chunk rows must not disturb slot state: retarget their
+        # scatters out of range so they drop alongside the padding rows
+        act_slots = jnp.where(is_last, slots, jnp.int32(self.batch))
+        upd = lambda arr, val: arr.at[act_slots].set(val, mode="drop")
+        new_state = dataclasses.replace(
+            state,
+            cache=cache,
+            tokens=upd(state.tokens, toks),
+            active=upd(state.active, alive),
+            generated=upd(state.generated, generated),
+            max_gen=upd(state.max_gen, max_gen),
+            temperature=upd(state.temperature, temperature),
+            top_k=upd(state.top_k, top_k),
+            top_p=upd(state.top_p, top_p),
+            rng=rng,
+        )
+        if use_grammar:
+            nxt = grammar_advance(gram_states, toks, gram_table, tok_bytes,
+                                  tok_lens)
+        else:
+            # still scatter: activation must CLEAR a previous occupant's
+            # DFA state (gram_states is all zeros in this program variant)
+            nxt = gram_states
+        new_state = dataclasses.replace(
+            new_state, gram_state=upd(state.gram_state, nxt))
+        return new_state, toks
+
+    def prefill_group(self, state: DecodeState, items: "list[PrefillItem]"
+                      ) -> Tuple[DecodeState, jax.Array]:
+        """Run up to ``prefill_group`` prefill chunks in a single dispatch —
+        across distinct slots and/or CONSECUTIVE chunks of one prompt (rows
+        of the same slot must appear in ascending start_pos order). Chunks
+        are padded to the full prefill_chunk bucket and the group to its
+        size bucket, so the program count stays at len(group_buckets).
+        Returns (state, (G,) sampled tokens — valid only for is_last rows)."""
+        G = next(b for b in self.group_buckets if len(items) <= b)
+        C = self.chunk
+        maxp = self.max_pages_per_slot
+        tokens = np.zeros((G, C), np.int32)
+        page_rows = np.zeros((G, maxp), np.int32)
+        slots = np.full((G,), self.batch, np.int32)      # padding = OOB
+        start_pos = np.zeros((G,), np.int32)
+        chunk_len = np.zeros((G,), np.int32)
+        is_last = np.zeros((G,), bool)
+        generated = np.zeros((G,), np.int32)
+        max_gen = np.zeros((G,), np.int32)
+        temperature = np.ones((G,), np.float32)
+        top_k = np.zeros((G,), np.int32)
+        top_p = np.ones((G,), np.float32)
+        for i, it in enumerate(items):
+            n = len(it.chunk_ids)
+            if n > C:
+                raise ValueError(f"chunk of {n} tokens exceeds "
+                                 f"prefill_chunk ({C})")
+            tokens[i, :n] = it.chunk_ids
+            page_rows[i] = it.page_row
+            slots[i] = it.slot
+            start_pos[i] = it.start_pos
+            chunk_len[i] = n
+            is_last[i] = it.is_last
+            generated[i] = it.generated
+            max_gen[i] = it.max_gen
+            temperature[i] = it.temperature
+            top_k[i] = it.top_k
+            top_p[i] = it.top_p
+        # lengths-scatter dedup: only a slot's highest-start_pos row keeps
+        # its true id (duplicate-index scatters are nondeterministic)
+        len_slots = slots.copy()
+        newest: Dict[int, int] = {}
+        for i, it in enumerate(items):
+            newest[it.slot] = i
+        for i in range(len(items)):
+            if newest.get(int(slots[i])) != i:
+                len_slots[i] = self.batch
+        gram_states = np.zeros((G,), np.int32)
+        for i, it in enumerate(items):
+            gram_states[i] = it.gram_state
+        use_grammar = bool(gram_states.any())
+        return self._group_fn(
+            state, self.params, self.adapters, jnp.asarray(tokens),
+            jnp.asarray(page_rows), jnp.asarray(slots),
+            jnp.asarray(len_slots), jnp.asarray(start_pos),
+            jnp.asarray(chunk_len), jnp.asarray(is_last),
+            jnp.asarray(generated), jnp.asarray(max_gen),
+            jnp.asarray(temperature), jnp.asarray(top_k),
+            jnp.asarray(top_p), jnp.asarray(gram_states),
+            *self._gram_args(use_grammar), use_grammar)
+
+    # -------------------------------------------- constrained decoding (DFA)
+
+    def _gram_args(self, use_grammar: bool) -> tuple:
+        """(table, accept, tok_bytes, tok_lens) device args for a grammared
+        program; tiny dummies when unconstrained (shapes stay constant, so
+        the unconstrained program never recompiles)."""
+        if use_grammar:
+            return (self._gram_table, self._gram_accept, self._gram_dist,
+                    self._tok_bytes, self._tok_lens)
+        z = jnp.zeros((1, 256), jnp.int32)
+        return (z, jnp.zeros((1,), bool), jnp.zeros((1,), jnp.int32),
+                jnp.zeros((1, 1), jnp.int32), jnp.zeros((1,), jnp.int32))
+
+    def ensure_token_bytes(self, tokenizer) -> None:
+        """Build + upload the vocab byte table once (grammar prerequisite).
+        Padded to the MODEL vocab: ids past the tokenizer vocab (padding
+        rows of the embedding) are permanently masked under a grammar."""
+        if self._tok_bytes is not None:
+            return
+        from generativeaiexamples_tpu.engine import grammar as grammar_mod
+        tb, tl = grammar_mod.token_byte_table(tokenizer)
+        V = self.model_cfg.vocab_size     # the logits' vocab axis, exactly
+        if V > tb.shape[0]:
+            pad = V - tb.shape[0]
+            tb = np.concatenate([tb, np.zeros((pad, tb.shape[1]), np.int32)])
+            tl = np.concatenate([tl, np.full((pad,), -1, np.int32)])
+        elif V < tb.shape[0]:             # tokenizer ids past the model head
+            tb, tl = tb[:V], tl[:V]
+        self._tok_bytes = jnp.asarray(tb)
+        self._tok_lens = jnp.asarray(tl)
+
+    def register_grammar(self, grammar, active_keys=()) -> int:
+        """Install a compiled grammar (engine/grammar.py Grammar) into the
+        flat device stack; returns its FLAT START STATE (what PrefillItem
+        carries). ``active_keys`` are grammars of in-flight requests —
+        NEVER evicted (their slots' DFA states index into the stack).
+        Raises UnsupportedSchema when the grammar exceeds the stack
+        geometry or all slots are pinned by active grammars (the caller
+        falls back to prompt+parse for this request)."""
+        from generativeaiexamples_tpu.engine import grammar as grammar_mod
+        if grammar.key in self._grammars:
+            return self._gram_starts[grammar.key]
+        dfa = grammar.dfa
+        S = self.GRAM_STATES
+        if dfa.n_states > S:
+            raise grammar_mod.UnsupportedSchema(
+                f"grammar needs {dfa.n_states} DFA states; engine stack "
+                f"holds {S} per slot")
+        if len(self._grammars) >= self.GRAM_SLOTS:
+            evictable = [k for k in self._grammars if k not in active_keys]
+            if not evictable:
+                raise grammar_mod.UnsupportedSchema(
+                    f"all {self.GRAM_SLOTS} grammar slots pinned by active "
+                    f"requests")
+            victim = evictable[0]
+            del self._grammars[victim]
+            del self._gram_starts[victim]
+            del self._gram_dfas[victim]
+        g = next(i for i in range(self.GRAM_SLOTS)
+                 if i not in self._grammars.values())
+        from generativeaiexamples_tpu.engine.grammar import DIST_INF
+        if self._gram_table is None:
+            table = np.zeros((self.GRAM_SLOTS * S, 256), np.int32)
+            accept = np.zeros((self.GRAM_SLOTS * S,), bool)
+            dist = np.full((self.GRAM_SLOTS * S,), DIST_INF, np.int32)
+        else:
+            # np.asarray over a jax array is a read-only view; these rows
+            # are about to be written
+            table = np.array(self._gram_table)
+            accept = np.array(self._gram_accept)
+            dist = np.array(self._gram_dist)
+        # remap local states: local 0 (reject) → flat 0; local s → g*S + s
+        local = dfa.table
+        flat = np.where(local > 0, g * S + local, 0).astype(np.int32)
+        table[g * S: g * S + dfa.n_states] = flat
+        table[g * S] = 0                       # unreachable row, keep clean
+        accept[g * S: g * S + dfa.n_states] = dfa.accept
+        dist[g * S: g * S + dfa.n_states] = dfa.dist
+        self._gram_table = jnp.asarray(table)
+        self._gram_accept = jnp.asarray(accept)
+        self._gram_dist = jnp.asarray(dist)
+        self._grammars[grammar.key] = g
+        self._gram_starts[grammar.key] = g * S + dfa.start
+        self._gram_dfas[grammar.key] = dfa
+        return self._gram_starts[grammar.key]
+
+    def walk_grammar(self, grammar, token_ids, active_keys=(),
+                     prefix: bytes = b"") -> int:
+        """Host-side walk of output already emitted (preemption resumes /
+        cross-worker failover continuations): flat state after consuming
+        ``prefix`` bytes then ``token_ids`` from the grammar's start.
+        Returns 0 (unconstrained) if the walk rejects — e.g. a prefix
+        emitted by a worker that was NOT constrained."""
+        start = self.register_grammar(grammar, active_keys)
+        dfa = self._gram_dfas[grammar.key]
+        g = self._grammars[grammar.key]
+        tb = np.asarray(self._tok_bytes)
+        tl = np.asarray(self._tok_lens)
+        s = start - g * self.GRAM_STATES
+        for b in prefix:
+            s = int(dfa.table[s, int(b)])
+            if s == 0:
+                return 0
+        for t in token_ids:
+            n = int(tl[t])
+            if n <= 0:
+                return 0
+            for b in tb[t, :n]:
+                s = int(dfa.table[s, int(b)])
+                if s == 0:
+                    return 0
+        return g * self.GRAM_STATES + s
+
+    def warmup(self, steps_list: Optional[Tuple[int, ...]] = None,
+               tokenizer=None) -> None:
+        """Compile the serving program grid against a throwaway state BEFORE
+        real traffic: every grouped-prefill bucket and every decode depth.
+        First compiles over a tunneled chip run ~20-40 s EACH — paying them
+        lazily mid-serving stalls live requests (and a bench would measure
+        compile, not serving). With ``tokenizer``, the CONSTRAINED-decoding
+        program variants (use_grammar=True — separate compiles; the grammar
+        tables have static shapes, so one tiny grammar warms them all) are
+        compiled too."""
+        if steps_list is None:
+            # every power of two the adaptive scheduler can pick
+            base = self.cfg.decode_steps_per_dispatch
+            cap = max(self.cfg.decode_steps_max, base)
+            steps_list, s = [], base
+            while s <= cap:
+                steps_list.append(s)
+                s *= 2
+        gram_start = 0
+        if tokenizer is not None:
+            from generativeaiexamples_tpu.engine import grammar as grammar_mod
+            self.ensure_token_bytes(tokenizer)
+            gram_start = self.register_grammar(
+                grammar_mod.Grammar.from_schema({"type": "boolean"}))
+        state = self.init_state()
+        table = self.put_table(
+            np.zeros((self.batch, self.max_pages_per_slot), np.int32))
+        for gs in ((0, gram_start) if gram_start else (0,)):
+            for g in self.group_buckets:
+                items = [PrefillItem(
+                    chunk_ids=[1] * min(4, self.chunk), page_row=np.zeros(
+                        (self.max_pages_per_slot,), np.int32),
+                    slot=self.batch, start_pos=0, is_last=True, generated=1,
+                    max_gen=0, gram_state=gs)
+                    for _ in range(g)]  # OOB slots: compiles, writes nothing
+                state, _ = self.prefill_group(state, items)
+            for steps in steps_list:
+                state, out = self.decode(state, table, steps,
+                                         use_grammar=bool(gs))
+        jax.block_until_ready(out["packed"])
+        # the throwaway pool frees here; callers init the real state after
+
     # --------------------------------------------------------- slot lifecycle
 
     def _activate_impl(self, state: DecodeState, slot, token, generated,
@@ -459,6 +796,7 @@ class EngineCore:
             temperature=upd(state.temperature, temperature),
             top_k=upd(state.top_k, top_k),
             top_p=upd(state.top_p, top_p),
+            gram_state=upd(state.gram_state, jnp.int32(0)),  # no leakage
         )
 
     def activate(self, state: DecodeState, slot: int, token: int,
@@ -483,13 +821,25 @@ class EngineCore:
     # ----------------------------------------------------------------- decode
 
     def _decode_impl(self, state: DecodeState, params, adapters, page_table,
-                     steps: int) -> Tuple[DecodeState, Dict[str, Any]]:
+                     gram_table, gram_accept, gram_dist, tok_bytes, tok_lens,
+                     steps: int, use_grammar: bool
+                     ) -> Tuple[DecodeState, Dict[str, Any]]:
         def step(state, _):
             logits, cache = kv_cache.decode_step(
                 params, self.model_cfg, state.tokens, state.cache,
                 page_table, state.active, self.num_pages, adapters=adapters,
                 mesh=self.mesh)
             rng, sub = jax.random.split(state.rng)
+            if use_grammar:
+                # constrained decoding INSIDE the fused step: byte-DFA
+                # walk masks disallowed tokens, state advances with the
+                # sample — no host round trip, fusion intact
+                from generativeaiexamples_tpu.ops.sampling import (
+                    grammar_advance, grammar_mask)
+                logits = grammar_mask(
+                    logits, state.gram_state,
+                    state.max_gen - state.generated - 1, self.eos_id,
+                    gram_table, gram_accept, gram_dist, tok_bytes, tok_lens)
             # inactive slots' stale temperatures must not defeat the
             # all-greedy fast path inside the sampler
             live_temp = jnp.where(state.active, state.temperature, 0.0)
@@ -512,6 +862,13 @@ class EngineCore:
                 generated=generated,
                 rng=rng,
             )
+            if use_grammar:
+                adv = grammar_advance(state.gram_state, sampled, gram_table,
+                                      tok_bytes, tok_lens)
+                new_state = dataclasses.replace(
+                    new_state,
+                    gram_state=jnp.where(state.active, adv,
+                                         state.gram_state))
             out = {"sampled": sampled, "emitted": state.active, "done": done,
                    "hit_eos": hit_eos, "input_tokens": state.tokens}
             return new_state, out
@@ -529,10 +886,14 @@ class EngineCore:
         return state, outs
 
     def decode(self, state: DecodeState, page_table: jax.Array,
-               steps: int = 1) -> Tuple[DecodeState, Dict[str, Any]]:
+               steps: int = 1, use_grammar: bool = False
+               ) -> Tuple[DecodeState, Dict[str, Any]]:
         """Run ``steps`` fused decode steps over all slots; ``page_table``
         from `put_table`. Out arrays are stacked (steps, B); ``input_tokens``
         carries each step's input so a just-activated slot's first token (not
-        host-synced at admission) is recoverable from the same sync."""
+        host-synced at admission) is recoverable from the same sync.
+        ``use_grammar`` (compiled separately) applies constrained-decoding
+        masks for slots whose gram_state > 0."""
         return self._decode_fn(state, self.params, self.adapters, page_table,
-                               steps)
+                               *self._gram_args(use_grammar), steps,
+                               use_grammar)

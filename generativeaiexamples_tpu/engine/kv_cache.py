@@ -212,6 +212,108 @@ def prefill_chunk(params: llama.Params, cfg: llama.LlamaConfig,
     return logits, PagedKVCache(k=k_stack, v=v_stack, lengths=new_lengths)
 
 
+def prefill_chunks(params: llama.Params, cfg: llama.LlamaConfig,
+                   tokens: jnp.ndarray, cache: PagedKVCache,
+                   page_rows: jnp.ndarray, slots: jnp.ndarray,
+                   start_pos: jnp.ndarray, chunk_len: jnp.ndarray,
+                   num_pages: int,
+                   adapters: Optional[llama.Params] = None,
+                   mesh=None,
+                   ) -> Tuple[jnp.ndarray, PagedKVCache]:
+    """One chunk each for G DISTINCT slots, in a single pass.
+
+    The grouped generalization of :func:`prefill_chunk`: admission ramps and
+    slot refills batch several prompts' chunks into one dispatch, amortizing
+    the per-dispatch overhead that dominates a remote-attached chip (measured
+    ~90 ms/dispatch regardless of size) — the reference's inflight batcher
+    gets the same effect from enqueueing prefills into its execution batch.
+
+    tokens: (G, C) right-padded chunks, C page-aligned; page_rows: (G,
+    max_pages) block-table rows; slots: (G,) — used ONLY for the lengths
+    scatter: entries carrying an out-of-range slot id (== batch size) drop
+    it, which serves both group-bucket PADDING rows (whose page writes, via
+    all-zero page_rows, land on the null page 0) and de-duplication when a
+    group carries several consecutive chunks of the same prompt (scatter
+    with duplicate indices is nondeterministic — the caller keeps the true
+    slot id only on the row with the highest start_pos).
+
+    Consecutive chunks of ONE prompt may share a group: each layer scatters
+    every row's K/V into the pool BEFORE any row's attention gather, so a
+    later chunk's attention (masked to valid_through = start_pos +
+    chunk_len) reads the earlier chunks' pages written in this same
+    program. start_pos / chunk_len: (G,). Returns logits at each chunk's
+    last valid position (G, V) and the updated cache.
+    """
+    G, C = tokens.shape
+    ps = cache.page_size
+    if C % ps != 0:
+        raise ValueError(f"chunk size {C} must be page-aligned (page={ps})")
+    n_cp = C // ps
+    maxp = page_rows.shape[1]
+    T = maxp * ps
+    KV, HD = cfg.n_kv_heads, cfg.head_dim
+
+    positions = start_pos[:, None] + jnp.arange(C, dtype=jnp.int32)[None]
+    h = llama.embed_tokens(params, cfg, tokens)
+    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
+    valid_through = start_pos + chunk_len                           # (G,)
+    chunk_pages = jax.vmap(
+        lambda row, sp: jax.lax.dynamic_slice(row, (sp // ps,), (n_cp,)))(
+        page_rows, start_pos)                                       # (G, n_cp)
+    cache_positions = jnp.broadcast_to(
+        jnp.arange(T, dtype=jnp.int32)[None], (G, T))
+
+    use_pallas = (cfg.attn_impl == "pallas" and cfg.sliding_window == 0
+                  and pallas_ops.prefill_supported(C, T, HD))
+    tp = _tp_degree(mesh)
+    if use_pallas and tp > 1:
+        _sharded_flash = partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(P(None, None, "tensor", None),
+                      P(None, None, "tensor", None),
+                      P(None, None, "tensor", None), P(None), P(None)),
+            out_specs=P(None, None, "tensor", None), check_vma=False)(
+            lambda q_, k_, v_, sp_, vt_: pallas_ops.flash_prefill(
+                q_, k_, v_, start_pos=sp_, kv_valid_through=vt_))
+
+    def attn_and_update(q, k, v, k_pool, v_pool, idx):
+        flat_pages = (idx * num_pages + chunk_pages).reshape(-1)  # (G*n_cp,)
+        # duplicate indices only occur among padding entries (all page 0 —
+        # the null page); real groups hold disjoint pages
+        new_k = k_pool.at[flat_pages].set(
+            k.astype(k_pool.dtype).reshape(G * n_cp, ps, KV * HD))
+        new_v = v_pool.at[flat_pages].set(
+            v.astype(v_pool.dtype).reshape(G * n_cp, ps, KV * HD))
+        flat_rows = idx * num_pages + page_rows                   # (G, maxp)
+        k_dense = new_k[flat_rows].reshape(G, T, KV, HD)
+        v_dense = new_v[flat_rows].reshape(G, T, KV, HD)
+        if use_pallas:
+            if tp > 1:
+                ctx = _sharded_flash(q, k_dense, v_dense, start_pos,
+                                     valid_through)
+            else:
+                ctx = pallas_ops.flash_prefill(
+                    q, k_dense, v_dense, start_pos=start_pos,
+                    kv_valid_through=valid_through)
+        else:
+            ctx = mha_prefill(
+                q, k_dense, v_dense, q_positions=positions,
+                kv_positions=cache_positions,
+                kv_mask=cache_positions < valid_through[:, None], causal=True,
+                window=cfg.sliding_window)
+        return ctx, new_k, new_v
+
+    h, k_stack, v_stack = llama.scan_blocks_inplace(
+        cfg, h, params, (cache.k, cache.v), cos, sin, attn_and_update,
+        adapters)
+    last_ix = jnp.maximum(chunk_len - 1, 0)[:, None, None]        # (G, 1, 1)
+    h_last = jnp.take_along_axis(h, last_ix.astype(jnp.int32), axis=1)
+    logits = llama._unembed(cfg, params, h_last)[:, 0]            # (G, V)
+    new_lengths = cache.lengths.at[slots].set(start_pos + chunk_len,
+                                              mode="drop")
+    return logits, PagedKVCache(k=k_stack, v=v_stack, lengths=new_lengths)
+
+
 def decode_step(params: llama.Params, cfg: llama.LlamaConfig,
                 tokens: jnp.ndarray, cache: PagedKVCache,
                 page_table: jnp.ndarray, write_mask: jnp.ndarray,

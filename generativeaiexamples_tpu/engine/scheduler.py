@@ -69,6 +69,15 @@ class Request:
     temperature: float = 0.7
     top_k: int = 0
     top_p: float = 1.0
+    # compiled constrained-decoding grammar (engine/grammar.py Grammar) or
+    # None; on an engine without free grammar slots the request silently
+    # degrades to unconstrained (prompt+parse still applies upstream).
+    # grammar_prefix: output text ALREADY emitted for this generation by
+    # another worker (failover continuation) — the DFA starts from the
+    # state reached after walking it, so the constrained suffix composes
+    # into one valid document.
+    grammar: Optional[object] = None
+    grammar_prefix: str = ""
     request_id: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
     # filled by the scheduler:
     out_queue: "queue.Queue" = field(default_factory=queue.Queue)
@@ -107,6 +116,7 @@ class _Job:
     first_inflight: bool = False  # already snapshotted into a decode dispatch
     first_epoch: int = 0          # bumps per (re-)prefill: stale fetches
                                   # of a preempted+re-admitted job no-op
+    gram_on: bool = False         # constrained decoding active for the slot
 
 
 class Scheduler:
@@ -128,12 +138,12 @@ class Scheduler:
         # Dispatches kept in flight: results stream back on fetcher threads
         # while the driver keeps dispatching — on a remote-attached chip
         # (~100 ms round trip, measured) this is what keeps decode from
-        # being round-trip-bound. Depth ~= RTT / device-time-per-dispatch
-        # (~30 ms for 8 fused steps on a 3B int8 model) so the device never
-        # drains while a result is on the wire. Staleness cost: done slots
-        # are reused (and first tokens resolve) up to depth dispatches
-        # late — the eager drain in _tick claws most of that back.
-        self._pipeline_depth = 4
+        # being round-trip-bound. Staleness cost: done slots are reused
+        # (and first tokens resolve) up to depth dispatches late — round 4
+        # measured depth 2 strictly better than 4 once grouped prefill made
+        # refills cheap (occupancy 0.79 vs 0.70, +10% tok/s): the engine is
+        # device-bound now, so extra depth only delays slot turnover.
+        self._pipeline_depth = max(1, core.cfg.pipeline_depth)
         # one worker per in-flight dispatch: a single fetcher serializes the
         # ~100 ms RTTs and caps the whole engine at ~10 dispatches/s
         # (measured round 3 — THE round-2 throughput bottleneck); each
@@ -371,8 +381,13 @@ class Scheduler:
 
     # -- prefill ------------------------------------------------------------
 
-    def _prefill_step(self) -> None:
-        """Run ONE chunk of the oldest admission (interleaves with decode).
+    def _prefill_step(self) -> int:
+        """Run one GROUPED prefill dispatch: up to cfg.prefill_group jobs'
+        next chunks batched into one program (engine.prefill_group) — the
+        per-dispatch overhead of a remote-attached chip (~90 ms regardless
+        of size, measured) made serial per-prompt chunks THE admission-ramp
+        and slot-refill bottleneck at round 3 (occupancy 0.70). Returns the
+        number of chunks consumed (the hold budget's unit).
 
         On a mesh with a "seq" axis and ``long_prefill != off``, multi-chunk
         prompts instead take ONE sequence-parallel ring-attention pass
@@ -381,16 +396,17 @@ class Scheduler:
         §5.7 long-context serving trade."""
         t0 = time.perf_counter()
         try:
-            self._prefill_step_inner()
+            return self._prefill_step_inner()
         finally:
             REGISTRY.histogram("prefill_issue_s").observe(
                 time.perf_counter() - t0)
 
-    def _prefill_step_inner(self) -> None:
+    def _prefill_step_inner(self) -> int:
+        from generativeaiexamples_tpu.engine.engine import PrefillItem
+
         job = self._prefilling[0]
         req = job.request
-        start = job.prefilled
-        if (start == 0 and len(job.ids) > self.core.chunk
+        if (job.prefilled == 0 and len(job.ids) > self.core.chunk
                 and self.core.cfg.long_prefill != "off"
                 and self.core.supports_long_prefill):
             job.prefill_started = time.perf_counter()
@@ -405,35 +421,78 @@ class Scheduler:
             job.total_len = job.prefilled
             self._mark_first_pending(job, tok)
             self._slots[job.slot] = job
-            return
-        remaining = len(job.ids) - start
-        chunk_ids = job.ids[start:start + min(remaining, self.core.chunk)]
-        if start == 0:
-            job.prefill_started = time.perf_counter()
-        REGISTRY.counter("prefill_chunks").inc()
-        if job.prefilled + len(chunk_ids) < len(job.ids):
-            self._state, _ = self.core.prefill_chunk(
-                self._state, chunk_ids, self._table[job.slot], job.slot,
-                start)
-            job.prefilled += len(chunk_ids)
-            job.total_len = job.prefilled
-            return  # mid-prompt; decode interleaves before the next chunk
+            return 1
 
-        # Final chunk: sampling + activation are FUSED into the chunk program
-        # (engine._chunk_last_impl) — admission never blocks on a host round
-        # trip. The first token's VALUE comes back via an async scalar
-        # fetch (TTFT stamps when it lands), with the next decode sync's
-        # out["input_tokens"] as the fallback resolver.
-        self._prefilling.popleft()
-        already = len(job.gen_ids)
-        self._state, tok = self.core.prefill_chunk_last(
-            self._state, chunk_ids, self._table[job.slot], job.slot, start,
-            generated=already + 1, max_gen=req.max_tokens,
-            temperature=req.temperature, top_k=req.top_k, top_p=req.top_p)
-        job.prefilled += len(chunk_ids)
-        job.total_len = job.prefilled
-        self._mark_first_pending(job, tok)
-        self._slots[job.slot] = job
+        # Build a group of up to prefill_group CHUNKS, head job first —
+        # consecutive chunks of one prompt may share the dispatch (each
+        # layer's scatters precede every row's attention gather, so chunk
+        # j+1 reads chunk j's pages written in the same program): a long
+        # prompt prefills group-times fewer dispatches deep.
+        budget = max(1, self.core.cfg.prefill_group)
+        items: List[PrefillItem] = []
+        finals: List[_Job] = []
+        for job in list(self._prefilling):
+            if len(items) >= budget:
+                break
+            req = job.request
+            start = job.prefilled
+            if start == 0:
+                job.prefill_started = time.perf_counter()
+            while len(items) < budget and start < len(job.ids):
+                chunk_ids = job.ids[start:start + self.core.chunk]
+                last = start + len(chunk_ids) >= len(job.ids)
+                # Final chunks fuse sampling + activation into the group
+                # program (engine._group_impl) — admission never blocks on
+                # a host round trip. The first token's VALUE comes back via
+                # the batched state.tokens fetch, with the next decode
+                # sync's out["input_tokens"] as the fallback resolver.
+                gram_state = self._gram_state_for(job) if last else 0
+                items.append(PrefillItem(
+                    chunk_ids=chunk_ids, page_row=self._table[job.slot],
+                    slot=job.slot, start_pos=start, is_last=last,
+                    generated=len(job.gen_ids) + 1, max_gen=req.max_tokens,
+                    temperature=req.temperature, top_k=req.top_k,
+                    top_p=req.top_p, gram_state=gram_state))
+                start += len(chunk_ids)
+                if last:
+                    finals.append(job)
+            job.prefilled = start
+            job.total_len = start
+        REGISTRY.counter("prefill_chunks").inc(len(items))
+        self._state, _toks = self.core.prefill_group(self._state, items)
+        for job in finals:
+            self._prefilling.remove(job)
+            self._mark_first_pending(job, None)
+            self._slots[job.slot] = job
+        return len(items)
+
+    def _gram_state_for(self, job: _Job) -> int:
+        """Flat DFA start state for a grammared job's fused first token
+        (0 = unconstrained). Resumes re-walk the tokens already emitted.
+        Registration failure (unsupported schema, grammar slots pinned)
+        degrades to unconstrained — the serving layer's prompt+parse path
+        still applies, so the guarantee is strictly additive."""
+        grammar = job.request.grammar
+        if grammar is None:
+            return 0
+        try:
+            self.core.ensure_token_bytes(self.tokenizer)
+            active = {j.request.grammar.key
+                      for j in list(self._slots.values()) + list(self._prefilling)
+                      if j.request.grammar is not None}
+            prefix = job.request.grammar_prefix.encode("utf-8")
+            if job.gen_ids or prefix:
+                state = self.core.walk_grammar(grammar, job.gen_ids, active,
+                                               prefix=prefix)
+            else:
+                state = self.core.register_grammar(grammar, active)
+            job.gram_on = state > 0
+            return state
+        except Exception as exc:
+            logger.warning("constrained decoding disabled for %s: %s",
+                           job.request.request_id, exc)
+            job.gram_on = False
+            return 0
 
     def _mark_first_pending(self, job: _Job, tok) -> None:
         """Flag the fused first token for resolution. The value comes back
@@ -578,15 +637,33 @@ class Scheduler:
 
     @property
     def _steps(self) -> int:
-        """Fused decode steps per dispatch. Always the full configured
+        """Fused decode steps per dispatch. At least the full configured
         depth: round 2 halved this while a prefill was in flight (finer
         chunk interleave), which under sustained load meant HALF the
         tokens per ~100 ms dispatch round trip almost all of the time —
         measured as the difference between ~500 and ~900+ tok/s at 2x
         load. Queued prompts still interleave between dispatches; the
         device-side wait behind a full pipeline is ~depth x 30 ms, a
-        small TTFT cost next to that throughput cliff."""
-        return max(1, self.core.cfg.decode_steps_per_dispatch)
+        small TTFT cost next to that throughput cliff.
+
+        When ``decode_steps_max`` is set, dispatches DEEPEN while every
+        active slot still has the generation budget to use every fused
+        step (minimum remaining budget net of steps already in flight —
+        budget-floored, so deepening never wastes end-of-request steps):
+        the serialized result-fetch channel (~10/s) is the throughput
+        ceiling, and a deeper dispatch moves up to 2x the tokens through
+        the same fetch. Gated on a half-full batch so ramp-time admissions
+        keep the fine-grained interleave."""
+        base = max(1, self.core.cfg.decode_steps_per_dispatch)
+        cap = self.core.cfg.decode_steps_max or base
+        if cap <= base or len(self._slots) < self.core.batch // 2:
+            return base
+        rem = min(j.request.max_tokens - len(j.gen_ids)
+                  for j in self._slots.values()) - self._pending_steps
+        steps = base
+        while steps * 2 <= min(cap, rem):
+            steps *= 2
+        return steps
 
     def _dispatch_decode(self) -> None:
         """Issue one K-step decode dispatch without waiting for its result
@@ -604,8 +681,9 @@ class Scheduler:
         for _, j in fresh:
             j.first_inflight = True   # only the first dispatch resolves it
         t0 = time.perf_counter()
+        use_grammar = any(j.gram_on for j in self._slots.values())
         self._state, out = self.core.decode(self._state, self._table_device(),
-                                            steps)
+                                            steps, use_grammar)
         REGISTRY.histogram("decode_issue_s").observe(time.perf_counter() - t0)
         REGISTRY.histogram("decode_batch_fill").observe(
             len(self._slots) / self.core.batch)
@@ -699,29 +777,31 @@ class Scheduler:
         elif not ramp:
             self._holding = False
         if self._prefilling:
-            # several chunks per tick while slots sit empty (issue cost is
-            # ~1-4 ms; filling slots buys occupancy and queued requests'
-            # first tokens), one chunk per tick once the batch is full.
-            # 4/tick, not more: each tick's activations share one batched
-            # first-token fetch, so the burst size is the TTFT resolution
-            # granularity of an admission ramp
-            burst = 4 if len(self._slots) < self.core.batch else 1
-            for _ in range(burst):
-                if not self._prefilling:
-                    break
-                self._prefill_step()
-                if self._holding:
-                    self._hold_left -= 1
+            # ONE grouped dispatch per tick: up to prefill_group jobs' chunks
+            # ride a single program (same device-seconds as serial chunks,
+            # 1/G the dispatch overhead, G-at-once slot activation). Each
+            # tick's activations share one batched first-token fetch, so the
+            # group size is also the TTFT resolution granularity of a ramp.
+            consumed = self._prefill_step()
+            if self._holding:
+                self._hold_left -= consumed
             worked = True
         # batched first-token fetch: one (B,) transfer covers every job
         # activated since the last one. Submitted BEFORE the decode
         # dispatch, while state.tokens still holds those jobs' first
         # tokens (decode would advance them; such jobs resolve via the
         # decode sync instead — first_inflight gates the overlap).
+        # …but ONLY while decode is held or the pipeline is shallow: the
+        # fetch channel is serialized (~10/s), and when dispatches are
+        # queued deep a first token resolves via the next decode sync
+        # anyway — dedicated first fetches there just steal result-fetch
+        # slots (measured as a lower dispatch rate at round 4)
+        hold = self._holding and self._hold_left > 0 and bool(self._prefilling)
         waiting = [(j.slot, j, j.first_epoch) for j in self._slots.values()
                    if j.first_pending and not j.first_inflight
                    and not j.first_batched]
-        if waiting and len(self._first_fetches) < self._first_fetch_depth:
+        if (waiting and (hold or len(self._inflight) <= 1)
+                and len(self._first_fetches) < self._first_fetch_depth):
             toks = self._state.tokens
             if self.core.donates_state:
                 # the next dispatch DONATES the state: fetching the live
@@ -732,7 +812,6 @@ class Scheduler:
             for _, j, _e in waiting:
                 j.first_batched = True
             self._first_fetches.append((fut, waiting))
-        hold = self._holding and self._hold_left > 0 and bool(self._prefilling)
         if self._slots and not hold:
             self._dispatch_decode()
             worked = True

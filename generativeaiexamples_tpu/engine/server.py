@@ -24,19 +24,44 @@ parsed); plain chat streams token deltas as before.
 
 from __future__ import annotations
 
+import functools
 import json
+import logging
 import time
 import uuid
 from typing import Any, Dict, List, Optional
 
 from aiohttp import web
 
+from generativeaiexamples_tpu.engine import grammar as grammar_mod
 from generativeaiexamples_tpu.engine import tools as tools_mod
 from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
 from generativeaiexamples_tpu.server.common import (
     MAX_TOKENS_CAP, StreamDrain, health_handler, metrics_handler, sse_done,
     sse_write,
 )
+
+
+@functools.lru_cache(maxsize=64)
+def _grammar_for(kind: str, payload: str) -> Optional[object]:
+    """Compile-once cache of constrained-decoding grammars (engine/
+    grammar.py): schemas and tool sets repeat across requests, DFA
+    compilation doesn't need to. Returns None for unsupported schemas —
+    the request then runs prompt+parse only, as before round 4."""
+    try:
+        if kind == "schema":
+            return grammar_mod.Grammar.from_schema(json.loads(payload))
+        if kind == "json":
+            return grammar_mod.Grammar.json_value()
+        if kind == "tools":
+            spec = json.loads(payload)
+            return grammar_mod.Grammar.for_tools(spec["tools"],
+                                                 forced=spec["forced"])
+    except grammar_mod.UnsupportedSchema as exc:
+        logging.getLogger(__name__).info(
+            "schema outside the DFA-regular subset (%s); serving with "
+            "prompt+parse only", exc)
+    return None
 
 
 def _chunk(model: str, rid: str, delta: Dict[str, Any],
@@ -115,10 +140,40 @@ class ModelServer:
             # with tools, the JSON constraint scopes to non-tool replies
             messages = tools_mod.inject_json_prompt(
                 messages, response_format, with_tools=use_tools)
+        # On-device constrained decoding whenever the output contract is
+        # unambiguous: a forced/required tool call, or JSON mode without
+        # tools (tool_choice "auto" may legally answer in prose, so it
+        # stays prompt+parse). The prompt contract is ALWAYS also injected
+        # — the mask guarantees validity, the prompt guides content.
+        grammar = None
+        if use_tools and (tool_choice == "required" or name):
+            grammar = _grammar_for("tools", json.dumps(
+                {"tools": tools, "forced": name}))
+        elif json_mode and not use_tools:
+            if response_format.get("type") == "json_schema":
+                schema = response_format.get("json_schema", {}).get(
+                    "schema", {})
+                # NOT sort_keys: property order is part of the enforced
+                # language (fixed-order members) and must match the order
+                # the prompt shows the model
+                grammar = _grammar_for("schema", json.dumps(schema))
+            else:
+                grammar = _grammar_for("json", "")
         prompt_ids = self.scheduler.tokenizer.apply_chat_template(messages)
+        cont = str(body.get("continue_text") or "")
+        if cont:
+            # mid-stream failover resume (server/failover.py): continue an
+            # assistant turn already partially streamed by ANOTHER engine
+            # worker — the template renders here, the emitted prefix
+            # appends after it, generation proceeds from that context
+            # (the same prompt+generated resume shape the scheduler uses
+            # for preemptions). An active grammar resumes from the state
+            # reached after the prefix (Request.grammar_prefix).
+            prompt_ids = prompt_ids + self.scheduler.tokenizer.encode(cont)
         return await self._run(request, body, prompt_ids, chat=True,
                                tools=tools if use_tools else [],
-                               json_mode=json_mode)
+                               json_mode=json_mode, grammar=grammar,
+                               grammar_prefix=cont)
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
         body = await request.json()
@@ -131,17 +186,33 @@ class ModelServer:
     async def _run(self, request: web.Request, body: Dict[str, Any],
                    prompt_ids, chat: bool,
                    tools: Optional[List[Dict[str, Any]]] = None,
-                   json_mode: bool = False) -> web.StreamResponse:
+                   json_mode: bool = False,
+                   grammar: Optional[object] = None,
+                   grammar_prefix: str = "") -> web.StreamResponse:
         sampling = self._parse_sampling(body)
-        req = Request(prompt_ids=list(prompt_ids), **sampling)
+        req = Request(prompt_ids=list(prompt_ids), grammar=grammar,
+                      grammar_prefix=grammar_prefix, **sampling)
         rid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
         stream = bool(body.get("stream", False))
         self.scheduler.submit(req)
         drain = StreamDrain(self.scheduler.iter_text(req))
 
-        if not stream or tools or json_mode:
-            # tool/JSON requests buffer even under stream=True: whether the
-            # output is a tool call is only known once it parses
+        if stream and tools and not json_mode:
+            # OpenAI-semantics incremental tool_calls deltas: commit to a
+            # call as soon as the envelope prefix parses, then stream the
+            # argument text in fragments (tools_mod.ToolCallStreamer) —
+            # long argument generations no longer sit silent
+            return await self._stream_tools(request, rid, req, drain, tools)
+        if stream and json_mode and grammar is not None and not tools:
+            # the token-level grammar GUARANTEES valid JSON, so json-mode
+            # output can stream as plain content deltas — no buffer-and-
+            # extract needed (and failover resumes stay byte-exact)
+            pass
+        elif not stream or tools or json_mode:
+            # JSON-mode requests WITHOUT a grammar (and non-streamed
+            # tools) still buffer: the extracted JSON value is rewritten
+            # canonically, so the output shape isn't known until the
+            # generation parses
             text = await drain.join_text()
             if req.error:
                 if not stream:
@@ -185,6 +256,40 @@ class ModelServer:
         # the error rides inside a schema-shaped chunk so conforming clients
         # (chunk["choices"][0]) keep parsing
         finish = "error" if req.error else "stop"
+        final = json.loads(_chunk(self.model_name, rid, {}, finish))
+        if req.error:
+            final["error"] = req.error
+        await sse_write(resp, json.dumps(final))
+        await sse_done(resp)
+        return resp
+
+    async def _stream_tools(self, request: web.Request, rid: str, req,
+                            drain: StreamDrain,
+                            tools: List[Dict[str, Any]]) -> web.StreamResponse:
+        resp = await self._sse_response(request)
+        await sse_write(resp, _chunk(self.model_name, rid,
+                                     {"role": "assistant"}))
+        streamer = tools_mod.ToolCallStreamer(tools)
+
+        async def emit(events) -> None:
+            for ev in events:
+                if ev[0] == "content":
+                    delta: Dict[str, Any] = {"content": ev[1]}
+                elif ev[0] == "tool_start":
+                    delta = {"tool_calls": [{
+                        "index": ev[1], "id": f"call_{uuid.uuid4().hex[:12]}",
+                        "type": "function",
+                        "function": {"name": ev[2], "arguments": ""}}]}
+                else:   # tool_args
+                    delta = {"tool_calls": [{
+                        "index": ev[1], "function": {"arguments": ev[2]}}]}
+                await sse_write(resp, _chunk(self.model_name, rid, delta))
+
+        async for text in drain:
+            await emit(streamer.feed(text))
+        await emit(streamer.finish())
+        finish = ("error" if req.error
+                  else "tool_calls" if streamer.committed else "stop")
         final = json.loads(_chunk(self.model_name, rid, {}, finish))
         if req.error:
             final["error"] = req.error

@@ -28,6 +28,7 @@ exactly that; the extract-or-retry loop lives one level up
 from __future__ import annotations
 
 import json
+import re
 import uuid
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -190,6 +191,254 @@ def extract_json_value(text: str) -> Optional[Tuple[Any, Tuple[int, int]]]:
                             break
         # unbalanced from this start; try the next opener
     return None
+
+
+def _value_end(text: str, i: int) -> int:
+    """Index one past the JSON value starting at ``i`` (after any leading
+    whitespace), or -1 while it is still incomplete. String/escape aware."""
+    while i < len(text) and text[i].isspace():
+        i += 1
+    if i >= len(text):
+        return -1
+    c = text[i]
+    if c in "{[":
+        depth = 0
+        in_str = escape = False
+        for j in range(i, len(text)):
+            ch = text[j]
+            if escape:
+                escape = False
+            elif ch == "\\":
+                escape = in_str
+            elif ch == '"':
+                in_str = not in_str
+            elif not in_str:
+                if ch in "{[":
+                    depth += 1
+                elif ch in "]}":
+                    depth -= 1
+                    if depth == 0:
+                        return j + 1
+        return -1
+    if c == '"':
+        escape = False
+        for j in range(i + 1, len(text)):
+            if escape:
+                escape = False
+            elif text[j] == "\\":
+                escape = True
+            elif text[j] == '"':
+                return j + 1
+        return -1
+    m = re.match(r"[^\s,\]}]+", text[i:])
+    if m is None:
+        return i        # no value at all (e.g. "arguments":}) — empty span
+    end = i + m.end()
+    return end if end < len(text) else -1   # a primitive may still grow
+
+
+_WRAP_RE = re.compile(r'\{\s*"tool_calls"\s*:\s*\[\s*')
+_ELEM_RE = re.compile(
+    r'\{\s*"name"\s*:\s*"([^"\\]+)"\s*,\s*"(?:arguments|parameters)"\s*:')
+_LIST_RE = re.compile(r"\[\s*")
+
+
+class ToolCallStreamer:
+    """Incremental tool-call detection for SSE streaming.
+
+    The buffered path (server._run) holds the WHOLE generation before
+    answering a `stream=true` tools request; OpenAI semantics instead
+    stream `tool_calls` deltas — name first, then the argument text in
+    fragments — so long argument generations are visible as they decode
+    (round-3 weakness: seconds of silence). This feeds on text deltas and
+    COMMITS to a call as soon as the envelope prefix is unambiguous
+    ({"tool_calls": [{"name": <known tool>, "arguments": …); from there
+    the raw argument value streams out in fragments (clients concatenate
+    and json-parse, the OpenAI wire contract). Unknown tool names or
+    non-envelope JSON are released as plain content once balanced —
+    matching parse_tool_calls' strictness.
+
+    Events from feed()/finish():
+      ("content", text) | ("tool_start", index, name) |
+      ("tool_args", index, fragment)
+    """
+
+    def __init__(self, tools: Sequence[Dict[str, Any]]) -> None:
+        self._known = set(tool_names(tools))
+        self._buf = ""
+        self._pos = 0            # next unconsumed char
+        self._state = "scan"     # scan | held | args | between | done
+        self._mode = ""          # wrap | list | bare (valid once committed)
+        self._open = 0           # index of the held candidate's opener
+        self._args_start = 0
+        self._emit_to = 0        # args chars already emitted
+        self.calls = 0           # committed tool calls (index = calls - 1)
+
+    @property
+    def committed(self) -> bool:
+        return self.calls > 0
+
+    def feed(self, delta: str) -> List[tuple]:
+        self._buf += delta
+        events: List[tuple] = []
+        progressed = True
+        while progressed:
+            progressed = False
+            before = (self._state, self._pos, self._emit_to, self.calls)
+            handler = getattr(self, "_st_" + self._state)
+            handler(events)
+            progressed = before != (self._state, self._pos, self._emit_to,
+                                    self.calls)
+        return events
+
+    def finish(self) -> List[tuple]:
+        events: List[tuple] = []
+        if self._state in ("scan", "held"):
+            tail = self._buf[self._pos:]
+            if tail:
+                events.append(("content", tail))
+        elif self._state == "args" and len(self._buf) > self._emit_to:
+            # truncated generation mid-arguments: flush best-effort
+            events.append(("tool_args", self.calls - 1,
+                           self._buf[self._emit_to:]))
+        self._pos = len(self._buf)
+        return events
+
+    # -- states ------------------------------------------------------------
+
+    def _st_scan(self, events: List[tuple]) -> None:
+        nxt = min((i for i in (self._buf.find("{", self._pos),
+                               self._buf.find("[", self._pos)) if i >= 0),
+                  default=-1)
+        if nxt < 0:
+            # hold back a trailing partial char? deltas are whole chars;
+            # emit everything before any opener
+            if self._pos < len(self._buf):
+                events.append(("content", self._buf[self._pos:]))
+                self._pos = len(self._buf)
+            return
+        if nxt > self._pos:
+            events.append(("content", self._buf[self._pos:nxt]))
+        self._pos = self._open = nxt
+        self._state = "held"
+
+    def _try_commit(self) -> Optional[str]:
+        """Match an envelope prefix at the held opener. Returns the matched
+        mode, "dead" when the candidate can never be an envelope, or None
+        while undecided (needs more text)."""
+        rest = self._buf[self._open:]
+        for mode, pre in (("wrap", _WRAP_RE), ("list", _LIST_RE),
+                          ("bare", None)):
+            off = 0
+            if pre is not None:
+                m = pre.match(rest)
+                if not m:
+                    continue
+                off = m.end()
+                if mode == "list" and not rest[off:off + 1] == "{":
+                    if rest[off:off + 1]:
+                        continue            # a list, but not of objects
+                    return None             # may still grow into one
+            em = _ELEM_RE.match(rest[off:])
+            if em:
+                if em.group(1) not in self._known:
+                    return "dead"           # hallucinated tool → content
+                self._mode = mode
+                self._elem_name = em.group(1)
+                self._args_start = self._open + off + em.end()
+                return mode
+        return None
+
+    # whitespace-stripped envelope heads a held candidate must stay
+    # prefix-compatible with; divergence means it can NEVER commit
+    _HEADS = ('{"tool_calls":[{"name":"', '{"name":"', '[{"name":"')
+
+    def _could_still_commit(self) -> bool:
+        text = re.sub(r"\s+", "", self._buf[self._open:])
+        for head in self._HEADS:
+            n = min(len(head), len(text))
+            if text[:n] == head[:n]:
+                return True
+        return False
+
+    def _st_held(self, events: List[tuple]) -> None:
+        got = self._try_commit()
+        if got is not None and got != "dead":
+            self.calls += 1
+            events.append(("tool_start", self.calls - 1, self._elem_name))
+            self._emit_to = self._args_start
+            self._state = "args"
+            return
+        if got == "dead" or not self._could_still_commit():
+            # never an envelope (hallucinated name, or prose like 'if (x) {'
+            # whose '{' balances late or never): release the opener and
+            # rescan from the next char — ordinary streamed content must
+            # not go silent waiting for a balance that may never come
+            events.append(("content", self._buf[self._pos:self._open + 1]))
+            self._pos = self._open + 1
+            self._state = "scan"
+            return
+        # still prefix-compatible with an envelope (a bounded region — the
+        # commit regex needs only the head + tool name): hold; a candidate
+        # that BALANCES while still compatible (e.g. {"name":"x"} with no
+        # arguments) is plain JSON content
+        end = _value_end(self._buf, self._open)
+        if end < 0:
+            return
+        events.append(("content", self._buf[self._pos:end]))
+        self._pos = end
+        self._state = "scan"
+
+    def _st_args(self, events: List[tuple]) -> None:
+        # skip whitespace before the value so fragment streaming can key on
+        # the value's first character (dropped from fragments — the
+        # concatenation stays valid JSON)
+        while (self._args_start < len(self._buf)
+               and self._buf[self._args_start].isspace()):
+            self._args_start += 1
+        if self._emit_to < self._args_start:
+            self._emit_to = self._args_start
+        end = _value_end(self._buf, self._args_start)
+        if end < 0:
+            # structured values are prefix-safe to stream; primitives wait
+            head = self._buf[self._args_start:self._args_start + 1]
+            if head in '{["' and len(self._buf) > self._emit_to:
+                events.append(("tool_args", self.calls - 1,
+                               self._buf[self._emit_to:]))
+                self._emit_to = len(self._buf)
+            return
+        if end > self._emit_to:
+            events.append(("tool_args", self.calls - 1,
+                           self._buf[self._emit_to:end]))
+        self._pos = self._emit_to = end
+        self._state = "between"
+
+    def _st_between(self, events: List[tuple]) -> None:
+        """After an argument value: either another element follows (wrap/
+        list modes) or the envelope closes; trailing text is swallowed
+        (the buffered path likewise reports content=None for calls)."""
+        rest = self._buf[self._pos:]
+        if self._mode in ("wrap", "list"):
+            m = re.match(r"\s*\}\s*,\s*", rest)
+            if m:
+                em = _ELEM_RE.match(rest[m.end():])
+                if em:
+                    if em.group(1) not in self._known:
+                        self._state = "done"    # partial envelope: stop
+                        return
+                    self.calls += 1
+                    events.append(("tool_start", self.calls - 1, em.group(1)))
+                    self._args_start = self._pos + m.end() + em.end()
+                    self._emit_to = self._args_start
+                    self._state = "args"
+                return
+        if re.match(r"\s*\}\s*\]\s*\}" if self._mode == "wrap" else
+                    r"\s*\}\s*\]" if self._mode == "list" else r"\s*\}",
+                    rest):
+            self._state = "done"
+
+    def _st_done(self, events: List[tuple]) -> None:
+        self._pos = len(self._buf)
 
 
 def parse_tool_calls(text: str, tools: Sequence[Dict[str, Any]]

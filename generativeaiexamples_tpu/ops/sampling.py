@@ -57,6 +57,66 @@ def sample_logits(rng: jax.Array, logits: jnp.ndarray,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def grammar_mask(logits: jnp.ndarray, gram_state: jnp.ndarray,
+                 budget_left: jnp.ndarray, eos_id: int, table: jnp.ndarray,
+                 accept: jnp.ndarray, dist: jnp.ndarray,
+                 tok_bytes: jnp.ndarray, tok_lens: jnp.ndarray
+                 ) -> jnp.ndarray:
+    """Constrained-decoding logit mask, evaluated ON DEVICE inside the fused
+    decode step (engine/grammar.py designs the automaton; this is its
+    runtime). For every vocab token, walk its byte string through the
+    byte-level DFA from each slot's current state: tokens whose walk hits
+    the reject sink (state 0) are masked to -inf. EOS is allowed exactly at
+    accepting states (and is the ONLY option at a dead end, which is how a
+    completed JSON value terminates generation).
+
+    gram_state: (B,) int32 — current DFA state per slot; <= 0 disables
+    masking for that slot (unconstrained requests share the program).
+    budget_left: (B,) int32 — generation budget remaining AFTER the token
+    being sampled; tokens whose post-walk state cannot reach an accept
+    state within it (dist, fewest bytes ≥ fewest single-byte tokens) are
+    masked, so a constrained generation COMPLETES inside max_tokens
+    instead of truncating mid-JSON (a greedy adversarial model would
+    otherwise repeat one digit until the budget dies).
+    table: (S, 256) int32; accept: (S,) bool; dist: (S,) int32;
+    tok_bytes: (V, L) int32; tok_lens: (V,) int32 (-1 = token never
+    allowed under a grammar). Cost: L chained (B, V) gathers — bytes, not
+    a (S, V) dense table, so a 128k vocab costs ~MBs of traffic per step
+    instead of a GB-scale table.
+    """
+    B, V = logits.shape
+    L = tok_bytes.shape[1]
+    active = (gram_state > 0)[:, None]                      # (B, 1)
+    st = jnp.broadcast_to(jnp.maximum(gram_state, 0)[:, None], (B, V))
+    for l in range(L):
+        b = tok_bytes[None, :, l]                           # (1, V)
+        nxt = table[st, jnp.broadcast_to(b, (B, V))]
+        st = jnp.where(tok_lens[None, :] > l, nxt, st)
+    ok = (st != 0) & (tok_lens[None, :] > 0)                # (B, V)
+    ok &= dist[st] <= budget_left[:, None]
+    # EOS exactly at accept states; fail-safe: a state with NO allowed
+    # token (shouldn't happen with a byte-complete vocab) unmasks EOS
+    # rather than leaving an all -inf row
+    ok_eos = accept[jnp.maximum(gram_state, 0)] | ~ok.any(axis=-1)
+    ok = ok.at[:, eos_id].set(ok_eos)
+    return jnp.where(active & ~ok, -jnp.inf, logits)
+
+
+def grammar_advance(gram_state: jnp.ndarray, sampled: jnp.ndarray,
+                    table: jnp.ndarray, tok_bytes: jnp.ndarray,
+                    tok_lens: jnp.ndarray) -> jnp.ndarray:
+    """Next DFA state per slot after emitting ``sampled`` (B,) — the walk of
+    just the sampled token's bytes. Unconstrained slots (state <= 0) stay
+    put."""
+    st = jnp.maximum(gram_state, 0)
+    bts = tok_bytes[sampled]                                # (B, L)
+    lens = tok_lens[sampled]                                # (B,)
+    for l in range(tok_bytes.shape[1]):
+        nxt = table[st, bts[:, l]]
+        st = jnp.where(lens > l, nxt, st)
+    return jnp.where(gram_state > 0, st, gram_state)
+
+
 def sample_logits_dynamic(rng: jax.Array, logits: jnp.ndarray,
                           temperature: jnp.ndarray, top_k: jnp.ndarray,
                           top_p: jnp.ndarray) -> jnp.ndarray:

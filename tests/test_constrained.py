@@ -1,0 +1,265 @@
+"""Constrained decoding: schema-valid output from an ADVERSARIAL model.
+
+VERDICT r3 item 3's acceptance test: random schemas × a random-weight tiny
+model (arbitrary logits — the hardest case for prompt-based JSON) must
+ALWAYS stream parseable, schema-valid output, because the byte-DFA logit
+mask (engine/grammar.py + ops/sampling.py) makes invalid tokens
+unsamplable on-device. Covers the grammar mask math against the host DFA,
+the engine end-to-end (fused prefill sampling + multi-step decode under
+page pressure and mixed constrained/unconstrained slots), and the /v1
+``response_format.json_schema`` surface.
+"""
+
+import json
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.core.config import EngineConfig
+from generativeaiexamples_tpu.engine import grammar as grammar_mod
+from generativeaiexamples_tpu.engine.engine import EngineCore
+from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
+from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.ops import sampling as sampling_ops
+
+
+# ------------------------------------------------------- schema generation
+
+def random_schema(rng: random.Random, depth: int = 2) -> dict:
+    """Random schema in the supported subset. Strings/arrays are bounded so
+    the DFA language is finite — an adversarial sampler then always reaches
+    an accept state within the token budget."""
+    kinds = ["string", "integer", "boolean", "enum"]
+    if depth > 0:
+        kinds += ["object", "array"]
+    k = rng.choice(kinds)
+    if k == "string":
+        return {"type": "string", "maxLength": rng.randint(1, 4)}
+    if k == "integer":
+        return {"type": "integer"}
+    if k == "boolean":
+        return {"type": "boolean"}
+    if k == "enum":
+        n = rng.randint(1, 3)
+        return {"enum": rng.sample(
+            ["alpha", "beta", "gamma", 7, -2, True, None], n)}
+    if k == "array":
+        return {"type": "array", "items": random_schema(rng, depth - 1),
+                "minItems": rng.randint(0, 1), "maxItems": rng.randint(1, 3)}
+    props, req = {}, []
+    for i in range(rng.randint(1, 3)):
+        name = f"k{i}"
+        props[name] = random_schema(rng, depth - 1)
+        if rng.random() < 0.7:
+            req.append(name)
+    return {"type": "object", "properties": props, "required": req}
+
+
+def validates(value, schema) -> bool:
+    """Mini-validator for the supported subset (jsonschema isn't a baked-in
+    dep; the grammar compiler is what's under test, so an independent
+    checker matters)."""
+    if "const" in schema:
+        return value == schema["const"]
+    if "enum" in schema:
+        return any(value == v and type(value) == type(v)
+                   for v in schema["enum"])
+    t = schema.get("type")
+    if t == "string":
+        return (isinstance(value, str)
+                and len(value) >= schema.get("minLength", 0)
+                and len(value) <= schema.get("maxLength", 10**9))
+    if t == "integer":
+        return isinstance(value, int) and not isinstance(value, bool)
+    if t == "number":
+        return isinstance(value, (int, float)) and not isinstance(value, bool)
+    if t == "boolean":
+        return isinstance(value, bool)
+    if t == "null":
+        return value is None
+    if t == "array":
+        if not isinstance(value, list):
+            return False
+        if len(value) < schema.get("minItems", 0):
+            return False
+        if len(value) > schema.get("maxItems", 10**9):
+            return False
+        items = schema.get("items")
+        return all(validates(v, items) for v in value) if items else True
+    if t == "object" or "properties" in schema:
+        if not isinstance(value, dict):
+            return False
+        props = schema.get("properties", {})
+        required = schema.get("required", list(props))
+        if any(r not in value for r in required):
+            return False
+        return all(k in props and validates(v, props[k])
+                   for k, v in value.items())
+    return True
+
+
+# ------------------------------------------------------------- mask math
+
+def test_grammar_mask_matches_host_dfa():
+    """Device mask == brute-force host DFA over every token, from several
+    live states, including EOS-at-accept and the reject sink."""
+    tok = ByteTokenizer()
+    schema = {"type": "object",
+              "properties": {"a": {"type": "integer"},
+                             "b": {"enum": ["x", "yz"]}},
+              "required": ["a", "b"]}
+    gr = grammar_mod.Grammar.from_schema(schema)
+    dfa = gr.dfa
+    tb, tl = grammar_mod.token_byte_table(tok)
+
+    # walk a known-valid prefix byte by byte to collect live states
+    prefix = b'{"a": -12, "b": "'
+    states, s = [dfa.start], dfa.start
+    for b in prefix:
+        s = int(dfa.table[s, b])
+        assert s != 0
+        states.append(s)
+
+    table_j = jnp.asarray(dfa.table)
+    accept_j = jnp.asarray(dfa.accept)
+    dist_j = jnp.asarray(dfa.dist)
+    tb_j, tl_j = jnp.asarray(tb), jnp.asarray(tl)
+    for s in states + [0]:
+        logits = jnp.zeros((1, tok.vocab_size), jnp.float32)
+        masked = sampling_ops.grammar_mask(
+            logits, jnp.asarray([s], jnp.int32),
+            jnp.asarray([10**6], jnp.int32), tok.eos_id, table_j,
+            accept_j, dist_j, tb_j, tl_j)
+        got_ok = np.asarray(masked[0]) > -np.inf
+        for t in range(tok.vocab_size):
+            if t == tok.eos_id:
+                want = bool(dfa.accept[s]) if s > 0 else True
+            elif s <= 0:
+                want = True                       # unconstrained slot
+            elif tl[t] <= 0:
+                want = False
+            else:
+                st = s
+                for b in tb[t, : tl[t]]:
+                    st = int(dfa.table[st, int(b)])
+                want = st != 0
+            assert got_ok[t] == want, (s, t)
+
+
+# ------------------------------------------------- engine property test
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    params = llama.init_params(jax.random.PRNGKey(99), cfg)   # adversarial
+    tok = ByteTokenizer()
+    # max_seq 512: the /v1 test's schema-injected system prompt is ~280
+    # byte tokens before the grammar-constrained answer even starts
+    ecfg = EngineConfig(max_batch_size=4, max_seq_len=512, prefill_chunk=32,
+                        page_size=16)
+    core = EngineCore(cfg, ecfg, params, eos_id=tok.eos_id)
+    return core, tok
+
+
+N_SCHEMAS = 12
+
+
+def test_random_schemas_always_yield_valid_json(tiny_engine):
+    core, tok = tiny_engine
+    sched = Scheduler(core, tok)
+    sched.start()
+    try:
+        rng = random.Random(0xBEEF)
+        reqs = []
+        for i in range(N_SCHEMAS):
+            schema = random_schema(rng)
+            gr = grammar_mod.Grammar.from_schema(schema)
+            # mixed sampling modes; mixed with an UNconstrained request so
+            # the grammared decode path runs alongside plain slots
+            temp = rng.choice([0.0, 1.0, 1.3])
+            reqs.append((schema, sched.submit(Request(
+                prompt_ids=tok.encode(f"emit json #{i}:", add_bos=True),
+                max_tokens=192, temperature=temp, grammar=gr))))
+            if i % 3 == 0:
+                sched.submit(Request(prompt_ids=tok.encode("free text"),
+                                     max_tokens=8, temperature=1.0))
+        for schema, req in reqs:
+            text = "".join(sched.iter_text(req))
+            assert req.error is None
+            value = json.loads(text)            # ALWAYS parseable
+            assert validates(value, schema), (schema, text)
+    finally:
+        sched.stop()
+
+
+def test_constrained_survives_preemption(tiny_engine):
+    """Preempt/resume must re-walk the grammar state: a resumed constrained
+    stream still completes as valid JSON."""
+    core_cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    params = llama.init_params(jax.random.PRNGKey(7), core_cfg)
+    tok = ByteTokenizer()
+    # page-starved pool → preemption storms (as in the fuzz suite)
+    ecfg = EngineConfig(max_batch_size=4, max_seq_len=256, prefill_chunk=32,
+                        page_size=16, num_pages=18)
+    core = EngineCore(core_cfg, ecfg, params, eos_id=tok.eos_id)
+    sched = Scheduler(core, tok)
+    sched.start()
+    try:
+        schema = {"type": "array", "items": {"type": "integer"},
+                  "minItems": 1, "maxItems": 8}
+        gr = grammar_mod.Grammar.from_schema(schema)
+        reqs = [sched.submit(Request(
+            prompt_ids=tok.encode("x" * n, add_bos=True), max_tokens=64,
+            temperature=1.0, grammar=gr)) for n in (90, 70, 50, 40, 30)]
+        texts = ["".join(sched.iter_text(r)) for r in reqs]
+        from generativeaiexamples_tpu.core.metrics import REGISTRY
+        for r, text in zip(reqs, texts):
+            assert r.error is None
+            assert validates(json.loads(text), schema), text
+    finally:
+        sched.stop()
+
+
+# ----------------------------------------------------------- /v1 surface
+
+def test_server_json_schema_constrained_roundtrip(tiny_engine):
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from generativeaiexamples_tpu.engine.server import ModelServer
+
+    core, tok = tiny_engine
+    sched = Scheduler(core, tok)
+    sched.start()
+    try:
+        server = ModelServer(sched, "tiny")
+        schema = {"type": "object",
+                  "properties": {"answer": {"enum": ["yes", "no"]},
+                                 "score": {"type": "integer"}},
+                  "required": ["answer", "score"]}
+
+        async def drive():
+            client = TestClient(TestServer(server.app))
+            await client.start_server()
+            try:
+                resp = await client.post("/v1/chat/completions", json={
+                    "messages": [{"role": "user", "content": "verdict?"}],
+                    "temperature": 1.0, "max_tokens": 128,
+                    "response_format": {
+                        "type": "json_schema",
+                        "json_schema": {"name": "verdict",
+                                        "schema": schema}}})
+                return await resp.json()
+            finally:
+                await client.close()
+
+        data = asyncio.run(drive())
+        content = data["choices"][0]["message"]["content"]
+        assert validates(json.loads(content), schema), content
+    finally:
+        sched.stop()

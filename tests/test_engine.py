@@ -158,6 +158,77 @@ def test_engine_slots_are_independent(engine):
     assert got2 == want2
 
 
+def test_grouped_prefill_matches_serial(engine):
+    """One grouped dispatch (engine.prefill_group: mixed mid/final chunks,
+    bucket padding rows) must yield the same first tokens and greedy
+    continuations as the serial per-prompt chunk path, without disturbing
+    other slots' state."""
+    from generativeaiexamples_tpu.engine.engine import PrefillItem
+
+    core, tok, cfg, params = engine
+    p1 = tok.encode("hello world", add_bos=True)      # single final chunk
+    p2 = tok.encode("abcd" * 20, add_bos=True)        # 81 ids → 3 chunks
+    assert len(p1) <= core.chunk < len(p2)
+
+    def serial():
+        state = core.init_state()
+        alloc = core.new_allocator()
+        table = np.zeros((core.batch, core.max_pages_per_slot), np.int32)
+        toks = {}
+        for slot, p in ((0, p1), (3, p2)):
+            state, logits = _prefill_into(core, state, table, alloc, slot, p)
+            f = core.sample(logits, jax.random.PRNGKey(0), 0.0, 0, 1.0)
+            state = core.activate(state, slot, f, 1, 6, 0.0, 0, 1.0)
+            toks[slot] = [f]
+        for _ in range(4):
+            state, out = core.decode(state, core.put_table(table))
+            for slot in toks:
+                toks[slot].append(int(out["sampled"][0, slot]))
+        return toks
+
+    def grouped():
+        state = core.init_state()
+        alloc = core.new_allocator()
+        table = np.zeros((core.batch, core.max_pages_per_slot), np.int32)
+        jobs = {0: p1, 3: p2}
+        done = {}
+        prefilled = {s: 0 for s in jobs}
+        for slot, p in jobs.items():
+            pages = alloc.alloc(core.pages_for(len(p)))
+            table[slot, :len(pages)] = pages
+        # scheduler-style packing: consecutive chunks of one prompt share
+        # the dispatch (p1 final + all 3 chunks of p2 ride ONE program)
+        while len(done) < len(jobs):
+            items, rows = [], []
+            for slot, p in jobs.items():
+                while slot not in done and len(items) < 4:
+                    start = prefilled[slot]
+                    chunk = p[start:start + core.chunk]
+                    last = start + len(chunk) >= len(p)
+                    items.append(PrefillItem(
+                        chunk_ids=chunk, page_row=table[slot], slot=slot,
+                        start_pos=start, is_last=last, generated=1,
+                        max_gen=6, temperature=0.0, top_k=0, top_p=1.0))
+                    rows.append(slot)
+                    prefilled[slot] += len(chunk)
+                    if last:
+                        done[slot] = None
+            state, toks = core.prefill_group(state, items)
+            for i, it in enumerate(items):
+                if it.is_last:
+                    done[rows[i]] = [int(toks[i])]
+        # untouched slots stay inert
+        st = np.asarray(state.active)
+        assert not st[1] and not st[2]
+        for _ in range(4):
+            state, out = core.decode(state, core.put_table(table))
+            for slot in done:
+                done[slot].append(int(out["sampled"][0, slot]))
+        return done
+
+    assert grouped() == serial()
+
+
 def test_engine_budget_and_slot_reuse(engine):
     core, tok, cfg, params = engine
     prompt = tok.encode("xy", add_bos=True)

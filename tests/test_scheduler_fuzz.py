@@ -1,0 +1,358 @@
+"""Scheduler fuzz/stress: randomized interleavings against hard invariants.
+
+The continuous-batching scheduler's concurrency machinery — epoch guards,
+skip-ahead bypass counting, preempt/re-admit races, in-flight snapshot
+identity checks (engine/scheduler.py) — is the most fragile code in the
+repo; the scenario tests in test_engine.py pin each mechanism individually
+but nothing adversarial runs them TOGETHER. This fuzz harness does, the way
+the reference relies on TRT-LLM's own batcher stress suites for its NIM
+container (SURVEY §4: the reference tests at the chain level and trusts the
+serving layer's upstream tests; in-tree serving means in-tree stress).
+
+Design:
+
+  * A **deterministic fake paged core** (pure numpy) implements the
+    EngineCore surface the scheduler drives. It mirrors REAL paged
+    semantics: prefill writes token values into physical pages via the
+    block-table row, decode reads each slot's full context back THROUGH
+    the page table and emits ``f(context)``. Any scheduler bookkeeping bug
+    — a page freed early and reused, a stale table row, a length desync, a
+    cross-slot leak — corrupts the context sum and the emitted stream
+    diverges from the solo oracle.
+  * **Seeded episodes** submit random workloads (prompts spanning page
+    boundaries, tiny page pools forcing preemption storms, over-capacity
+    prompts, random arrival times) and drive ``Scheduler._tick()`` on the
+    test thread — interleavings are reproducible from the seed while the
+    fetcher threads still race result futures (random fetch delays).
+  * **Invariants** checked every episode: every request terminates exactly
+    once (STOP delivered, never both error and success), every successful
+    stream equals its solo-run oracle token-for-token (no cross-stream
+    leaks, no lost/duplicated tokens), and after drain the page allocator
+    and slot pool are fully conserved.
+  * **Shrinking**: a failing episode is re-run with one request removed at
+    a time until minimal, and the assertion reports the seed + surviving
+    workload for replay.
+"""
+
+from __future__ import annotations
+
+import queue
+import time
+from dataclasses import dataclass, field
+from types import SimpleNamespace
+from typing import Dict, List, Optional
+
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.engine import scheduler as sched_mod
+from generativeaiexamples_tpu.engine.kv_cache import PageAllocator
+from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler, _STOP
+from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+
+EOS = 3
+VOCAB = 260
+
+
+def _next_token(context: List[int]) -> int:
+    """Deterministic 'model': next token from the FULL context. EOS appears
+    on a deterministic schedule so budget-exhaustion and eos paths both get
+    exercised."""
+    s = (sum(context) * 31 + len(context) * 7) & 0xFFFF
+    if s % 13 == 0:
+        return EOS
+    return 32 + s % (VOCAB - 64)
+
+
+def oracle(prompt: List[int], max_tokens: int, max_seq: int) -> List[int]:
+    """Solo-run reference: what a correct engine must stream for a prompt.
+    Generation ends at eos, the token budget, or cache capacity (the engine
+    retires a slot when its context reaches max_seq - 1; the capacity-step
+    token itself is still emitted, the eos token never is)."""
+    ctx = list(prompt)
+    out: List[int] = []
+    cap = max(0, max_seq - len(prompt))          # 1 fused + (max_seq-1-n) decode
+    while len(out) < min(max_tokens, cap):
+        t = _next_token(ctx)
+        if t == EOS:
+            break
+        out.append(t)
+        ctx.append(t)
+    return out
+
+
+@dataclass
+class _FakeState:
+    pool: np.ndarray              # (num_pages, page_size) written token values
+    lengths: np.ndarray           # (B,)
+    tokens: np.ndarray            # (B,) last sampled token
+    active: np.ndarray            # (B,) bool
+    generated: np.ndarray         # (B,)
+    max_gen: np.ndarray           # (B,)
+
+
+class FakeCore:
+    """Pure-numpy stand-in for EngineCore with REAL paged-read semantics."""
+
+    def __init__(self, batch=4, max_seq=64, page_size=8, num_pages=0,
+                 chunk=16, steps=4, steps_max=0, group=4):
+        self.batch, self.max_seq = batch, max_seq
+        self.page_size, self.chunk = page_size, chunk
+        self.max_pages_per_slot = -(-max_seq // page_size)
+        self.num_pages = num_pages or batch * self.max_pages_per_slot + 1
+        self.eos_id = EOS
+        self.donates_state = False
+        self.supports_long_prefill = False
+        self.cfg = SimpleNamespace(
+            decode_steps_per_dispatch=steps, decode_steps_max=steps_max,
+            prefill_group=group, long_prefill="off", prefill_hold_chunks=8,
+            pipeline_depth=2)
+        self.group_buckets = (1, 2, 4)
+
+    def init_state(self) -> _FakeState:
+        B = self.batch
+        return _FakeState(
+            pool=np.zeros((self.num_pages, self.page_size), np.int32),
+            lengths=np.zeros((B,), np.int32), tokens=np.zeros((B,), np.int32),
+            active=np.zeros((B,), bool), generated=np.zeros((B,), np.int32),
+            max_gen=np.zeros((B,), np.int32))
+
+    def new_allocator(self) -> PageAllocator:
+        return PageAllocator(self.num_pages)
+
+    def pages_for(self, n: int) -> int:
+        return n // self.page_size + 1
+
+    def put_table(self, table: np.ndarray) -> np.ndarray:
+        return np.array(table, np.int32)      # snapshot, like a device copy
+
+    def _read_context(self, st: _FakeState, row: np.ndarray, n: int) -> List[int]:
+        ps = self.page_size
+        out = []
+        for i in range(n):
+            out.append(int(st.pool[row[i // ps], i % ps]))
+        return out
+
+    @staticmethod
+    def _clone(st: _FakeState) -> _FakeState:
+        """Functional update, like real jax dispatches: handles the
+        scheduler kept into an OLD state (the batched first-token fetch of
+        state.tokens) must stay stable snapshots."""
+        return _FakeState(*(a.copy() for a in (
+            st.pool, st.lengths, st.tokens, st.active, st.generated,
+            st.max_gen)))
+
+    def release(self, st: _FakeState, slot: int) -> _FakeState:
+        st = self._clone(st)
+        st.active[slot] = False
+        return st
+
+    def prefill_group(self, st: _FakeState, items) -> tuple:
+        st = self._clone(st)
+        toks = np.zeros((len(items),), np.int32)
+        for i, it in enumerate(items):
+            ps = self.page_size
+            row = np.asarray(it.page_row)
+            for j, t in enumerate(it.chunk_ids):
+                pos = it.start_pos + j
+                st.pool[row[pos // ps], pos % ps] = t
+            n = it.start_pos + len(it.chunk_ids)
+            st.lengths[it.slot] = n
+            if it.is_last:
+                ctx = self._read_context(st, row, n)
+                tok = _next_token(ctx)
+                toks[i] = tok
+                alive = (tok != EOS) and (it.generated < it.max_gen)
+                st.tokens[it.slot] = tok
+                st.active[it.slot] = alive
+                st.generated[it.slot] = it.generated
+                st.max_gen[it.slot] = it.max_gen
+        return st, toks
+
+    def decode(self, st: _FakeState, table: np.ndarray, steps: int = 1,
+               use_grammar: bool = False) -> tuple:
+        st = self._clone(st)
+        B, ps = self.batch, self.page_size
+        out = np.zeros((5, steps, B), np.int32)
+        for k in range(steps):
+            for b in range(B):
+                out[4, k, b] = st.tokens[b]              # input_tokens
+                if not st.active[b]:
+                    continue
+                out[1, k, b] = 1                          # emitted
+                n = int(st.lengths[b])
+                # write the input token at position n (through the table,
+                # like the real engine), then read the WHOLE context back
+                st.pool[table[b, n // ps], n % ps] = st.tokens[b]
+                st.lengths[b] = n + 1
+                ctx = self._read_context(st, table[b], n + 1)
+                tok = _next_token(ctx)
+                out[0, k, b] = tok                        # sampled
+                st.generated[b] += 1
+                hit_eos = tok == EOS
+                done = (hit_eos or st.generated[b] >= st.max_gen[b]
+                        or st.lengths[b] >= self.max_seq - 1)
+                out[2, k, b] = int(done)
+                out[3, k, b] = int(hit_eos)
+                if done:
+                    st.active[b] = False
+                else:
+                    st.tokens[b] = tok
+        return st, {"packed": out, "emitted": out[1]}
+
+
+@dataclass(frozen=True)
+class _Spec:
+    """One request's workload parameters."""
+    prompt_len: int
+    max_tokens: int
+    arrival_tick: int
+
+
+def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict) -> Optional[str]:
+    """Run one scheduled episode; returns an error description or None."""
+    rng = np.random.RandomState(seed)
+    core = FakeCore(**core_kw)
+    tok = ByteTokenizer()
+    sched = Scheduler(core, tok)
+
+    # inject fetch-delay jitter: futures land at random times relative to
+    # the driver's ticks, racing the eager-drain and first-fetch paths
+    orig_fetch = sched_mod._fetch
+
+    def jittery_fetch(arr, metric="fetch_rtt_s"):
+        time.sleep(float(rng.choice([0, 0, 0.0002, 0.001])))
+        return orig_fetch(arr, metric)
+
+    sched_mod._fetch = jittery_fetch
+    try:
+        reqs = []
+        for sp in specs:
+            prompt = [32 + (i * 11) % 150 for i in range(sp.prompt_len)]
+            reqs.append((Request(prompt_ids=prompt, max_tokens=sp.max_tokens,
+                                 temperature=0.0), sp))
+        pending = sorted(range(len(reqs)), key=lambda i: reqs[i][1].arrival_tick)
+        tick = 0
+        idle = 0
+        while True:
+            while pending and reqs[pending[0]][1].arrival_tick <= tick:
+                sched.submit(reqs[pending.pop(0)][0])
+            worked = sched._tick()
+            tick += 1
+            if tick > 20000:
+                return f"livelock: >{tick} ticks"
+            if not worked and not pending:
+                idle += 1
+                if idle > 50:   # in-flight futures may still need to land
+                    break
+                time.sleep(0.0005)
+            else:
+                idle = 0
+
+        # -- invariants ----------------------------------------------------
+        for i, (req, sp) in enumerate(reqs):
+            # termination: exactly one STOP, nothing after it
+            items = []
+            try:
+                while True:
+                    items.append(req.out_queue.get_nowait())
+            except queue.Empty:
+                pass
+            if items.count(_STOP) != 1 or items[-1] is not _STOP:
+                return (f"req {i}: STOP delivered {items.count(_STOP)} times "
+                        f"(items={len(items)})")
+            cap = core.max_seq - 2
+            if sp.prompt_len > cap:
+                if not req.error:
+                    return f"req {i}: oversized prompt not failed"
+                continue
+            if req.error:
+                return f"req {i}: unexpected error {req.error!r}"
+            want = oracle(reqs[i][0].prompt_ids, sp.max_tokens, core.max_seq)
+            # token-level oracle: detokenize the emitted text back to ids
+            got_text = "".join(s for s in items if s is not _STOP)
+            want_text = tok.decode(want)
+            if got_text != want_text:
+                return (f"req {i}: stream diverged from solo oracle "
+                        f"(prompt_len={sp.prompt_len} max={sp.max_tokens}, "
+                        f"got {len(got_text)} chars, want {len(want_text)})")
+            if req.completion_tokens != len(want):
+                return (f"req {i}: completion_tokens={req.completion_tokens} "
+                        f"want {len(want)}")
+        # conservation: all pages and slots returned
+        if sched._alloc.available != core.num_pages - 1:
+            return (f"page leak: {sched._alloc.available} free of "
+                    f"{core.num_pages - 1}")
+        if sorted(sched._free) != list(range(core.batch)):
+            return f"slot leak: free={sorted(sched._free)}"
+        if sched._slots or sched._prefilling or sched._pending:
+            return "jobs left in scheduler after drain"
+        return None
+    finally:
+        sched_mod._fetch = orig_fetch
+        sched._fetcher.shutdown(wait=False)
+
+
+def _gen_specs(rng: np.random.RandomState, core_kw: Dict) -> List[_Spec]:
+    n = int(rng.randint(1, 9))
+    max_seq = core_kw["max_seq"]
+    specs = []
+    for _ in range(n):
+        r = rng.rand()
+        if r < 0.1:
+            plen = int(rng.randint(max_seq - 1, max_seq + 20))  # over capacity
+        elif r < 0.5:
+            plen = int(rng.randint(1, core_kw["page_size"] * 2 + 2))
+        else:
+            plen = int(rng.randint(1, max_seq - 2))
+        specs.append(_Spec(prompt_len=plen,
+                           max_tokens=int(rng.randint(1, 24)),
+                           arrival_tick=int(rng.randint(0, 12))))
+    return specs
+
+
+def _core_kw(rng: np.random.RandomState) -> Dict:
+    # small pools force preemption storms; varied depths exercise the
+    # adaptive-steps and grow-pages interactions
+    return dict(
+        batch=int(rng.choice([2, 3, 4])),
+        max_seq=64, page_size=8,
+        num_pages=int(rng.choice([0, 9, 13, 17])),
+        chunk=16,
+        steps=int(rng.choice([2, 4])),
+        steps_max=int(rng.choice([0, 8])),
+        group=int(rng.choice([1, 2, 4])))
+
+
+def _shrink(seed: int, specs: List[_Spec], core_kw: Dict, err: str) -> str:
+    """Greedy one-at-a-time removal: report the minimal failing workload."""
+    changed = True
+    while changed and len(specs) > 1:
+        changed = False
+        for i in range(len(specs)):
+            cand = specs[:i] + specs[i + 1:]
+            if _run_episode(seed, cand, core_kw):
+                specs, changed = cand, True
+                break
+    final = _run_episode(seed, specs, core_kw) or err
+    return (f"{final}\n  seed={seed} core={core_kw}\n  minimal workload: "
+            + "\n  ".join(map(repr, specs)))
+
+
+EPISODES = 1000
+
+
+def test_scheduler_fuzz_invariants():
+    master = np.random.RandomState(0xC0FFEE)
+    t0 = time.perf_counter()
+    for ep in range(EPISODES):
+        seed = int(master.randint(0, 2**31))
+        rng = np.random.RandomState(seed)
+        core_kw = _core_kw(rng)
+        specs = _gen_specs(rng, core_kw)
+        err = _run_episode(seed, specs, core_kw)
+        if err:
+            pytest.fail(f"episode {ep}: " + _shrink(seed, specs, core_kw, err))
+    elapsed = time.perf_counter() - t0
+    # the harness itself must stay fast enough for CI (<60 s target)
+    assert elapsed < 120, f"fuzz run too slow for CI: {elapsed:.0f}s"

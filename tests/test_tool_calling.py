@@ -94,12 +94,15 @@ def test_normalize_messages_tool_protocol():
 # ------------------------------------------------------------- fake engine
 
 class FakeScheduler:
-    """Scripted scheduler: pops one canned output text per submit."""
+    """Scripted scheduler: pops one canned output text per submit.
+    ``chunk`` > 0 streams the canned text in chunk-sized deltas (the
+    incremental tool-call streaming path)."""
 
-    def __init__(self, outputs):
+    def __init__(self, outputs, chunk=0):
         self.tokenizer = ByteTokenizer()
         self.outputs = list(outputs)
         self.prompts = []
+        self.chunk = chunk
 
     def submit(self, req):
         self.prompts.append(self.tokenizer.decode(req.prompt_ids))
@@ -107,7 +110,11 @@ class FakeScheduler:
         return req
 
     def iter_text(self, req):
-        yield req._out
+        if not self.chunk:
+            yield req._out
+            return
+        for i in range(0, len(req._out), self.chunk):
+            yield req._out[i:i + self.chunk]
 
 
 def _post(server, path, body):
@@ -261,6 +268,114 @@ def test_server_streamed_tool_call_chunks():
     finishes = [c["choices"][0]["finish_reason"] for c in chunks]
     assert "tool_calls" in finishes
     assert body.rstrip().endswith("data: [DONE]")
+
+
+def test_tool_call_streamer_incremental_fragments():
+    """The streamer commits on the envelope prefix and then relays raw
+    argument text in MULTIPLE fragments that concatenate to valid JSON."""
+    from generativeaiexamples_tpu.engine.tools import ToolCallStreamer
+
+    text = ('{"tool_calls": [{"name": "get_weather", "arguments": '
+            '{"city": "Oslo", "units": "metric", "days": 3}}]}')
+    st = ToolCallStreamer([WEATHER_TOOL])
+    events = []
+    for i in range(0, len(text), 7):
+        events += st.feed(text[i:i + 7])
+    events += st.finish()
+    starts = [e for e in events if e[0] == "tool_start"]
+    frags = [e for e in events if e[0] == "tool_args"]
+    assert [e[0] for e in events if e[0] == "content"] == []
+    assert len(starts) == 1 and starts[0][2] == "get_weather"
+    assert len(frags) > 3, "arguments must stream in fragments"
+    assert json.loads("".join(f[2] for f in frags)) == {
+        "city": "Oslo", "units": "metric", "days": 3}
+    # name arrives before most of the argument text was even fed
+    commit_at = events.index(starts[0])
+    assert commit_at < len(events) - 3
+
+
+def test_tool_call_streamer_variants():
+    from generativeaiexamples_tpu.engine.tools import ToolCallStreamer
+
+    def run(text, chunk=5):
+        st = ToolCallStreamer([WEATHER_TOOL])
+        ev = []
+        for i in range(0, len(text), chunk):
+            ev += st.feed(text[i:i + chunk])
+        ev += st.finish()
+        return ev
+
+    # plain prose: all content, nothing committed
+    ev = run("It is sunny in Oslo today.")
+    assert all(e[0] == "content" for e in ev)
+    assert "".join(e[1] for e in ev) == "It is sunny in Oslo today."
+
+    # bare {"name": ...} form commits
+    ev = run('{"name": "get_weather", "parameters": {"city": "A"}}')
+    assert [e for e in ev if e[0] == "tool_start"]
+    args = "".join(e[2] for e in ev if e[0] == "tool_args")
+    assert json.loads(args) == {"city": "A"}
+
+    # hallucinated tool name → released as plain content
+    text = '{"tool_calls": [{"name": "nope", "arguments": {}}]}'
+    ev = run(text)
+    assert not [e for e in ev if e[0] == "tool_start"]
+    assert "".join(e[1] for e in ev if e[0] == "content") == text
+
+    # prose, then JSON content (not an envelope) → all content
+    text = 'Answer: {"temp": 12} done'
+    ev = run(text)
+    assert "".join(e[1] for e in ev if e[0] == "content") == text
+
+    # two calls in one envelope → two indices
+    text = ('{"tool_calls": [{"name": "get_weather", "arguments": '
+            '{"city": "A"}}, {"name": "get_weather", "arguments": '
+            '{"city": "B"}}]}')
+    ev = run(text)
+    starts = [e for e in ev if e[0] == "tool_start"]
+    assert [s[1] for s in starts] == [0, 1]
+    a0 = "".join(e[2] for e in ev if e[0] == "tool_args" and e[1] == 0)
+    a1 = "".join(e[2] for e in ev if e[0] == "tool_args" and e[1] == 1)
+    assert json.loads(a0) == {"city": "A"} and json.loads(a1) == {"city": "B"}
+
+
+def test_server_streamed_tool_call_incremental_deltas():
+    """OpenAI-client view: stream=true with tools yields a name delta first,
+    then several argument-fragment deltas (round-3 weakness 7: the whole
+    generation used to buffer)."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from generativeaiexamples_tpu.engine.server import ModelServer
+
+    text = ('{"tool_calls": [{"name": "get_weather", "arguments": '
+            '{"city": "Oslo", "units": "metric"}}]}')
+    sched = FakeScheduler([text], chunk=6)
+    server = ModelServer(sched, "m")
+
+    async def drive():
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            resp = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "Weather?"}],
+                "tools": [WEATHER_TOOL], "stream": True})
+            return await resp.text()
+        finally:
+            await client.close()
+
+    body = asyncio.run(drive())
+    chunks = [json.loads(line[len("data: "):])
+              for line in body.splitlines()
+              if line.startswith("data: ") and "[DONE]" not in line]
+    deltas = [c["choices"][0]["delta"] for c in chunks]
+    tool_deltas = [d["tool_calls"][0] for d in deltas if "tool_calls" in d]
+    assert tool_deltas[0]["function"]["name"] == "get_weather"
+    assert tool_deltas[0]["id"].startswith("call_")
+    arg_frags = [d["function"]["arguments"] for d in tool_deltas[1:]]
+    assert len(arg_frags) > 2, "arguments must arrive in several deltas"
+    assert json.loads("".join(arg_frags)) == {"city": "Oslo",
+                                              "units": "metric"}
+    assert [c["choices"][0]["finish_reason"] for c in chunks][-1] == "tool_calls"
 
 
 def test_server_detailed_thinking_toggle():
