@@ -129,8 +129,14 @@ def _default_scheduler():
 
 def get_llm(scheduler=None):
     """The factory chains call (ref utils.py:366): remote when
-    APP_LLM_SERVER_URL is set, local TPU engine otherwise."""
+    APP_LLM_SERVER_URL is set (a comma-separated list selects the
+    health-tracked failover pool with mid-stream resume,
+    server/failover.py), local TPU engine otherwise."""
     cfg = get_config()
     if cfg.llm.server_url:
-        return RemoteLLM(cfg.llm.server_url, cfg.llm.model_name)
+        urls = [u.strip() for u in cfg.llm.server_url.split(",") if u.strip()]
+        if len(urls) > 1:
+            from generativeaiexamples_tpu.server.failover import FailoverLLM
+            return FailoverLLM(urls, cfg.llm.model_name)
+        return RemoteLLM(urls[0], cfg.llm.model_name)
     return LocalLLM(scheduler if scheduler is not None else _default_scheduler())

@@ -1,0 +1,182 @@
+"""Multi-worker serving resilience: health-tracked failover with mid-stream
+resume.
+
+Closes SURVEY §5.3's multi-host gap (the round-3 partial): the reference
+leans on compose healthchecks + `restart: always` + generous client retries
+(ref: RAG/examples/local_deploy/docker-compose-nim-ms.yaml:23-28,
+docker-compose-vectordb.yaml:90,108) — a worker death still kills every
+in-flight generation. Here the chain-server side heals mid-stream:
+
+  * ``FailoverLLM`` speaks OpenAI ``/v1`` to a POOL of engine workers
+    (e.g. one per TPU slice host). A request streams from one worker; if
+    the connection dies or the stream reports an engine error, the client
+    RESUBMITS to a surviving worker carrying the text already emitted
+    (``continue_text`` — the engine renders template + prefix and decodes
+    onward, the same prompt+generated resume shape its own scheduler uses
+    for preemptions, engine/server.py). The consumer's iterator never
+    notices: no duplicate text, no dropped stream.
+  * Failed workers are circuit-broken for a cooldown and re-admitted only
+    after ``/health`` passes — meanwhile deploy/supervisor.py restarts the
+    dead process (its §5.3 role), so the pool self-heals.
+
+The pool is selected by APP_LLM_SERVER_URL containing a comma-separated
+URL list (chains/llm_client.py get_llm) — zero changes to any chain.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+from typing import Dict, Iterator, List, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+class _Worker:
+    def __init__(self, url: str) -> None:
+        self.url = url.rstrip("/")
+        self.down_until = 0.0
+
+    def healthy(self, timeout: float = 2.0) -> bool:
+        try:
+            with urllib.request.urlopen(f"{self.url}/health",
+                                        timeout=timeout) as resp:
+                return 200 <= resp.status < 300
+        except Exception:
+            return False
+
+
+class FailoverLLM:
+    """Drop-in for RemoteLLM (chains/llm_client.py) over several workers."""
+
+    def __init__(self, urls: Sequence[str], model: str,
+                 cooldown_s: float = 10.0, max_attempts: int = 4) -> None:
+        if not urls:
+            raise ValueError("FailoverLLM needs at least one worker URL")
+        self._workers = [_Worker(u) for u in urls]
+        self.model = model
+        self.cooldown_s = cooldown_s
+        self.max_attempts = max_attempts
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- selection
+
+    def _candidates(self) -> List[_Worker]:
+        """Round-robin order, circuit-broken workers last (re-probed —
+        the supervisor may have restarted them)."""
+        with self._lock:
+            self._rr += 1
+            order = (self._workers[self._rr % len(self._workers):]
+                     + self._workers[: self._rr % len(self._workers)])
+        now = time.monotonic()
+        up = [w for w in order if w.down_until <= now]
+        recovering = [w for w in order if w.down_until > now]
+        return up + recovering
+
+    def _mark_down(self, w: _Worker) -> None:
+        w.down_until = time.monotonic() + self.cooldown_s
+        logger.warning("engine worker %s marked down for %.0fs", w.url,
+                       self.cooldown_s)
+
+    # --------------------------------------------------------------- serving
+
+    def chat(self, messages: Sequence[Dict[str, str]], max_tokens: int = 256,
+             temperature: float = 0.7, top_p: float = 1.0,
+             top_k: int = 0, response_format: Dict = None) -> Iterator[str]:
+        """Streaming chat that survives worker death mid-generation.
+        ``response_format`` rides through to the engine — under a
+        json_schema grammar the resumed stream is byte-exact (the engine
+        walks the grammar over the continuation prefix)."""
+        import httpx
+
+        emitted: List[str] = []
+        last_err: Exception = RuntimeError("no engine worker available")
+        for attempt in range(self.max_attempts):
+            cands = self._candidates()
+            w = cands[0]
+            if w.down_until > time.monotonic() and not w.healthy():
+                last_err = RuntimeError(f"{w.url} unhealthy")
+                continue
+            payload = {"model": self.model, "messages": list(messages),
+                       "max_tokens": max_tokens, "temperature": temperature,
+                       "top_p": top_p, "top_k": top_k, "stream": True}
+            if response_format:
+                payload["response_format"] = dict(response_format)
+            if emitted:
+                payload["continue_text"] = "".join(emitted)
+                logger.info("resuming stream on %s at %d chars", w.url,
+                            len(payload["continue_text"]))
+            try:
+                with httpx.stream("POST", f"{w.url}/v1/chat/completions",
+                                  json=payload, timeout=120.0) as resp:
+                    if resp.status_code >= 500:
+                        raise httpx.TransportError(
+                            f"HTTP {resp.status_code}")
+                    resp.raise_for_status()   # 4xx: deterministic — raise
+                    truncated = True
+                    for line in resp.iter_lines():
+                        if not line.startswith("data: "):
+                            continue
+                        data = line[len("data: "):]
+                        if data.strip() == "[DONE]":
+                            truncated = False
+                            break
+                        chunk = json.loads(data)
+                        choices = chunk.get("choices") or [{}]
+                        if (chunk.get("error")
+                                or choices[0].get("finish_reason") == "error"):
+                            # the engine is ALIVE and reporting a request-
+                            # level failure: retrying the same payload is
+                            # pointless and would circuit-break a healthy
+                            # worker — surface it
+                            raise RuntimeError(
+                                f"engine error: {chunk.get('error')}")
+                        content = choices[0].get("delta", {}).get("content")
+                        if content:
+                            emitted.append(content)
+                            yield content
+                    if not truncated:
+                        return                          # clean completion
+                # stream ended without [DONE]: the worker died mid-reply —
+                # mark it down and resume on a survivor
+                raise httpx.TransportError(f"{w.url} stream truncated")
+            except (httpx.TransportError, httpx.StreamError,
+                    json.JSONDecodeError, ConnectionError, OSError) as exc:
+                last_err = exc
+                self._mark_down(w)
+        raise RuntimeError(
+            f"LLM request failed across {self.max_attempts} attempts: "
+            f"{last_err}")
+
+    def chat_tools(self, messages: Sequence[Dict], tools: Sequence[Dict],
+                   tool_choice="auto", **sampling) -> Dict:
+        """Non-streamed tool turn: whole-request retry across the pool."""
+        import httpx
+
+        payload = {"model": self.model, "messages": list(messages),
+                   "stream": False, **sampling}
+        if tools:
+            payload["tools"] = list(tools)
+            payload["tool_choice"] = tool_choice
+        last_err: Exception = RuntimeError("no engine worker available")
+        for _ in range(self.max_attempts):
+            w = self._candidates()[0]
+            if w.down_until > time.monotonic() and not w.healthy():
+                last_err = RuntimeError(f"{w.url} unhealthy")
+                continue
+            try:
+                resp = httpx.post(f"{w.url}/v1/chat/completions",
+                                  json=payload, timeout=120.0)
+                if resp.status_code >= 500:
+                    raise httpx.TransportError(f"HTTP {resp.status_code}")
+                resp.raise_for_status()       # 4xx: deterministic — raise
+                return resp.json()["choices"][0]["message"]
+            except (httpx.TransportError, httpx.StreamError,
+                    json.JSONDecodeError, ConnectionError, OSError) as exc:
+                last_err = exc
+                self._mark_down(w)
+        raise RuntimeError(f"tool request failed across the pool: {last_err}")
