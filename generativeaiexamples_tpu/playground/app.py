@@ -147,7 +147,11 @@ class PlaygroundServer:
     async def speak(self, request: web.Request) -> web.Response:
         """TTS: {"text", "voice"?} → audio bytes (the speak-response path;
         ref tts_utils.py:83)."""
-        if not self.speech.available():
+        tts_ok = getattr(self.speech, "tts_available",
+                         self.speech.available)()
+        if not tts_ok:
+            # ASR-only stacks (in-tree whisper without an HTTP TTS URL)
+            # degrade the speak path cleanly, same contract as DisabledSpeech
             return web.json_response({"error": "speech disabled"}, status=501)
         body = await request.json()
         text = str(body.get("text", "")).strip()

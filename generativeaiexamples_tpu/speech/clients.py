@@ -128,9 +128,20 @@ class StreamingTranscriber:
 
 
 def get_speech(url: Optional[str] = None):
-    """Factory: HTTPSpeechClient when configured, DisabledSpeech otherwise."""
+    """Factory. Priority: in-tree whisper ASR when APP_SPEECH_LOCAL_ASR is
+    set ("tiny" or a HF whisper checkpoint dir — zero external services;
+    TTS composes from the HTTP client when a URL is also set), else the
+    HTTP client when APP_SPEECH_SERVER_URL is set, else the documented
+    opt-out."""
     url = url if url is not None else os.environ.get(
         "APP_SPEECH_SERVER_URL", "")
+    local = os.environ.get("APP_SPEECH_LOCAL_ASR", "")
+    if local:
+        from generativeaiexamples_tpu.speech.local_asr import (
+            LocalWhisperASR, SpeechStack)
+        tts = HTTPSpeechClient(url, model=os.environ.get(
+            "APP_SPEECH_MODEL_NAME", "whisper-1")) if url else None
+        return SpeechStack(LocalWhisperASR(local), tts)
     if url:
         return HTTPSpeechClient(url, model=os.environ.get(
             "APP_SPEECH_MODEL_NAME", "whisper-1"))

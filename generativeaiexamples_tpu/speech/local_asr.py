@@ -1,0 +1,171 @@
+"""In-tree whisper ASR backend for the speech seam (speech/clients.py).
+
+The voice loop previously required an external OpenAI-audio HTTP service
+(the round-3 gap); with ``APP_SPEECH_LOCAL_ASR`` set the transcription leg
+runs the in-tree JAX whisper model (models/whisper.py) instead — zero
+external services, same ``ASRClient`` protocol, so the playground and the
+streaming transcriber are untouched.
+
+  APP_SPEECH_LOCAL_ASR=tiny          random-init test-scale model (demo/CI)
+  APP_SPEECH_LOCAL_ASR=/path/to/dir  HuggingFace whisper checkpoint dir
+                                     (config.json + pytorch_model.bin or
+                                     model.safetensors [+ tokenizer.json])
+
+TTS stays on the HTTP client (or disabled) — SURVEY §2.5 allows the
+client/stub posture there; transcription is the capability the voice loop
+demos end-to-end.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def _config_from_hf(config: dict, gen_config: Optional[dict] = None):
+    """WhisperConfig from HF config.json (+ generation_config.json when
+    present — the authoritative source for the decoder-prompt special
+    tokens; language/task ids differ across en-only and v3 checkpoints)."""
+    from generativeaiexamples_tpu.models.whisper import WhisperConfig
+    gen = gen_config or {}
+    lang_to_id = gen.get("lang_to_id") or {}
+    task_to_id = gen.get("task_to_id") or {}
+    kw = {}
+    if "no_timestamps_token_id" in gen:
+        kw["no_timestamps"] = gen["no_timestamps_token_id"]
+    if "<|en|>" in lang_to_id:
+        kw["lang_en"] = lang_to_id["<|en|>"]
+    if "transcribe" in task_to_id:
+        kw["task_transcribe"] = task_to_id["transcribe"]
+    if not gen:
+        logger.warning(
+            "no generation_config.json: assuming whisper-multilingual "
+            "special-token ids (wrong for .en / v3 checkpoints)")
+    return WhisperConfig(
+        vocab_size=config.get("vocab_size", 51865),
+        d_model=config.get("d_model", 384),
+        n_heads=config.get("encoder_attention_heads", 6),
+        enc_layers=config.get("encoder_layers", 4),
+        dec_layers=config.get("decoder_layers", 4),
+        n_mels=config.get("num_mel_bins", 80),
+        n_audio_frames=2 * config.get("max_source_positions", 1500),
+        n_text_ctx=config.get("max_target_positions", 448),
+        sot=config.get("decoder_start_token_id", 50258),
+        eot=config.get("eos_token_id", 50257), **kw)
+
+
+class LocalWhisperASR:
+    """ASRClient over models/whisper.py; weights load lazily on first use."""
+
+    def __init__(self, source: str = "tiny") -> None:
+        import threading
+        self.source = source
+        self._loaded = False
+        self._load_lock = threading.Lock()   # concurrent first requests
+        self._params = None
+        self._cfg = None
+        self._tok = None
+
+    def _load(self) -> None:
+        if self._loaded:
+            return
+        with self._load_lock:
+            if self._loaded:
+                return
+            self._load_inner()
+            self._loaded = True
+            logger.info("local whisper ASR ready (%s)", self.source)
+
+    def _load_inner(self) -> None:
+        import jax
+
+        from generativeaiexamples_tpu.models import whisper
+        if self.source == "tiny":
+            self._cfg = whisper.WhisperConfig.tiny_random()
+            self._params = whisper.init_params(jax.random.PRNGKey(11),
+                                               self._cfg)
+        else:
+            gen_cfg = None
+            gc_path = os.path.join(self.source, "generation_config.json")
+            if os.path.exists(gc_path):
+                with open(gc_path) as f:
+                    gen_cfg = json.load(f)
+            with open(os.path.join(self.source, "config.json")) as f:
+                self._cfg = _config_from_hf(json.load(f), gen_cfg)
+            st_path = os.path.join(self.source, "model.safetensors")
+            pt_path = os.path.join(self.source, "pytorch_model.bin")
+            if os.path.exists(st_path):
+                from safetensors.numpy import load_file
+                sd = load_file(st_path)
+            else:
+                import torch
+                sd = {k: v.numpy()
+                      for k, v in torch.load(pt_path, map_location="cpu",
+                                             weights_only=True).items()}
+            sd = {k[len("model."):] if k.startswith("model.model.") else k: v
+                  for k, v in sd.items()}
+            if not any(k.startswith("model.") for k in sd):
+                sd = {f"model.{k}": v for k, v in sd.items()}
+            self._params = whisper.params_from_hf(sd, self._cfg)
+            tok_path = os.path.join(self.source, "tokenizer.json")
+            if os.path.exists(tok_path):
+                from tokenizers import Tokenizer
+                self._tok = Tokenizer.from_file(tok_path)
+
+    # ----------------------------------------------------------- ASRClient
+
+    def available(self) -> bool:
+        return True
+
+    def languages(self) -> List[str]:
+        return ["en-US"]
+
+    def transcribe(self, audio: bytes, language: str = "en-US") -> str:
+        from generativeaiexamples_tpu.models import whisper
+        self._load()
+        pcm = whisper.decode_wav(audio, self._cfg.sample_rate)
+        if len(pcm) == 0:
+            return ""
+        ids = whisper.transcribe_ids(self._params, self._cfg, pcm)
+        if self._tok is not None:
+            return self._tok.decode(ids).strip()
+        # no tokenizer file (test-scale model): deterministic readable form
+        return " ".join(str(i) for i in ids)
+
+    def synthesize(self, text: str, voice: str = "default") -> bytes:
+        raise RuntimeError("local ASR backend has no TTS; set "
+                           "APP_SPEECH_SERVER_URL for synthesis")
+
+
+class SpeechStack:
+    """Compose a local ASR with an (optional) HTTP TTS behind one client."""
+
+    def __init__(self, asr, tts: Optional[object] = None) -> None:
+        self.asr = asr
+        self.tts = tts
+
+    def available(self) -> bool:
+        return self.asr.available()
+
+    def tts_available(self) -> bool:
+        """Separate probe so the playground's speak path can degrade to its
+        clean 501 when the stack is ASR-only."""
+        return self.tts is not None and self.tts.available()
+
+    def languages(self) -> List[str]:
+        return self.asr.languages()
+
+    def transcribe(self, audio: bytes, language: str = "en-US") -> str:
+        return self.asr.transcribe(audio, language)
+
+    def synthesize(self, text: str, voice: str = "default") -> bytes:
+        if self.tts is None:
+            raise RuntimeError("TTS disabled: set APP_SPEECH_SERVER_URL "
+                               "for an audio endpoint with /v1/audio/speech")
+        return self.tts.synthesize(text, voice)
