@@ -27,12 +27,13 @@ class LocalLLM:
 
     def chat(self, messages: Sequence[Dict[str, str]], max_tokens: int = 256,
              temperature: float = 0.7, top_p: float = 1.0,
-             top_k: int = 0) -> Iterator[str]:
+             top_k: int = 0, grammar=None) -> Iterator[str]:
         from generativeaiexamples_tpu.engine.scheduler import Request
 
         prompt_ids = self.scheduler.tokenizer.apply_chat_template(list(messages))
         req = Request(prompt_ids=prompt_ids, max_tokens=max_tokens,
-                      temperature=temperature, top_p=top_p, top_k=top_k)
+                      temperature=temperature, top_p=top_p, top_k=top_k,
+                      grammar=grammar)
         self.scheduler.submit(req)
         yield from self.scheduler.iter_text(req)
         # the scheduler rejects e.g. over-capacity prompts per-request
@@ -45,13 +46,31 @@ class LocalLLM:
         """One tool-capable turn → an OpenAI-shaped assistant message:
         {"role": "assistant", "content": str|None, "tool_calls": [...]?}.
         Same prompt-render/parse mechanics as the /v1 server
-        (engine/tools.py), minus the HTTP."""
+        (engine/tools.py), minus the HTTP. A forced/required tool_choice
+        additionally applies the on-device tool-envelope grammar
+        (engine/grammar.py) — the call is token-level guaranteed to parse,
+        which is what the tool-calling fine-tune flywheel scores against."""
         from generativeaiexamples_tpu.engine import tools as tools_mod
 
         msgs = tools_mod.normalize_messages(messages)
+        grammar = None
         if tools and tool_choice != "none":
+            name = tools_mod.forced_name(tool_choice)
+            if name and name not in tools_mod.tool_names(tools):
+                # mirror the /v1 server's 400: a typo'd forced name must
+                # fail loudly, not run unconstrained toward a nonexistent
+                # tool (engine/server.py's chat_completions guard)
+                raise ValueError(f"tool_choice names unknown tool {name!r}")
             msgs = tools_mod.inject_tool_prompt(msgs, tools, tool_choice)
-        text = "".join(self.chat(msgs, **sampling))
+            if tool_choice == "required" or name:
+                from generativeaiexamples_tpu.engine import (
+                    grammar as grammar_mod)
+                try:
+                    grammar = grammar_mod.Grammar.for_tools_cached(
+                        tools, forced=name)
+                except grammar_mod.UnsupportedSchema:
+                    grammar = None              # prompt+parse fallback
+        text = "".join(self.chat(msgs, grammar=grammar, **sampling))
         calls = (tools_mod.parse_tool_calls(text, tools)
                  if tools and tool_choice != "none" else None)
         if calls:

@@ -212,7 +212,7 @@ class EngineConfig:
     decode_steps_per_dispatch: int = configfield("decode_steps_per_dispatch", default=8, help_txt="Decode steps fused into one device dispatch (lax.scan); amortizes host sync latency. Must be a power of two (each distinct step count is a separate compile).")
     decode_steps_max: int = configfield("decode_steps_max", default=0, help_txt="Adaptive upper bound on fused decode steps: when the batch is at least half full and every active slot has the budget, dispatches deepen up to this many steps (power of two; 0 = always use decode_steps_per_dispatch). Pays when dispatch round trips bound throughput; a device-bound engine is better off at the base depth (measured round 4).")
     pipeline_depth: int = configfield("pipeline_depth", default=2, help_txt="Decode dispatches kept in flight ahead of result processing. Deeper hides more host-device sync latency but delays done-slot detection by depth x fetch time, costing batch occupancy; 2 measured best on a remote-attached chip once grouped prefill removed the ramp bottleneck (round 4).")
-    prefill_group: int = configfield("prefill_group", default=4, help_txt="Max prompts whose prefill chunks are batched into ONE dispatch (group sizes bucketed to powers of two; each bucket is a separate compile). Amortizes per-dispatch overhead during admission ramps and slot refills.")
+    prefill_group: int = configfield("prefill_group", default=8, help_txt="Max prompts whose prefill chunks are batched into ONE dispatch (group sizes bucketed to powers of two; each bucket is a separate compile). Amortizes per-dispatch overhead during admission ramps and slot refills.")
     prefill_hold_chunks: int = configfield("prefill_hold_chunks", default=16, help_txt="While admissions are prefilling into a batch under half full, hold decode dispatches for up to this many prefill chunks per ramp episode (each decode dispatch at low fill burns a full host round trip on few tokens). 0 disables holding; decode always resumes once the budget is spent, bounding any streamer stall.")
     donate_buffers: str = configfield("donate_buffers", default="auto", help_txt="Donate the KV pool through dispatches: on | off | auto (off on remote-attached chips, where the client blocks ~RTT per donated dispatch; costs a transient 2x pool copy when off).")
     dtype: str = configfield("dtype", default="bfloat16", help_txt="Activation/weight dtype.")

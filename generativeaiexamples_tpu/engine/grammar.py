@@ -423,6 +423,16 @@ class Grammar:
                        key="json_value")
 
     @staticmethod
+    def for_tools_cached(tools: Sequence[Dict[str, Any]],
+                         forced: Optional[str] = None) -> "Grammar":
+        """Compile-once variant: tool sets repeat across agent-loop turns,
+        DFA determinization doesn't need to (the /v1 server caches for the
+        same reason)."""
+        return _cached_tools_grammar(
+            json.dumps({"tools": list(tools), "forced": forced},
+                       sort_keys=False))
+
+    @staticmethod
     def for_tools(tools: Sequence[Dict[str, Any]],
                   forced: Optional[str] = None) -> "Grammar":
         """The tool-call envelope: {"tool_calls": [{"name": <tool>,
@@ -446,3 +456,12 @@ class Grammar:
         key = "tools:" + json.dumps([t.get("function", t).get("name")
                                      for t in tools]) + f":{forced}"
         return Grammar(dfa=compile_dfa(env), key=key)
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=64)
+def _cached_tools_grammar(spec_json: str) -> "Grammar":
+    spec = json.loads(spec_json)
+    return Grammar.for_tools(spec["tools"], forced=spec["forced"])

@@ -17,9 +17,11 @@ utils.py:366-399 and docker-compose-nim-ms.yaml:2-28):
 
 Streaming uses `text/event-stream` with `data: {chunk}\n\n` frames and a
 final `data: [DONE]`, matching the OpenAI SSE contract the reference's
-LangChain clients parse. Tool-call and JSON-mode requests buffer the
-generation before replying (the output's shape isn't known until it is
-parsed); plain chat streams token deltas as before.
+LangChain clients parse. Tool requests stream incremental `tool_calls`
+deltas (name first, then argument fragments — tools.ToolCallStreamer);
+grammar-constrained JSON mode streams plain content deltas (validity is
+token-level guaranteed, engine/grammar.py); only un-grammared JSON mode
+still buffers for its extract-and-rewrite step.
 """
 
 from __future__ import annotations

@@ -17,12 +17,15 @@ models actually emit ({"name","arguments"} | {"name","parameters"} |
 — a hallucinated tool comes back as plain content, never as a bogus call.
 
 JSON mode (`response_format={"type":"json_object"}`) rides the same
-prompt+extract path: the first balanced JSON value in the output is the
-response. Token-level grammar masking is intentionally NOT done here: the
-engine fuses 8 decode steps per dispatch (the throughput design point,
-scheduler.py), and a per-token host round trip to mask logits would undo
-exactly that; the extract-or-retry loop lives one level up
-(chains/extraction.py) where retries are cheap.
+prompt+extract path. Since round 4 the prompt contract is additionally
+ENFORCED at the token level when the output shape is unambiguous
+(json_schema / forced tools): engine/grammar.py compiles the schema to a
+byte-level DFA whose logit mask runs INSIDE the fused decode step — no
+per-token host round trip, so the multi-step dispatch fusion
+(scheduler.py's throughput design point) survives. The prompt+parse
+machinery here remains the portable fallback (unsupported schemas,
+tool_choice "auto" where prose is legal) and the wire-shape parser either
+way.
 """
 
 from __future__ import annotations
