@@ -276,7 +276,8 @@ class EngineCore:
         cache = PagedKVCache.create(self.model_cfg, B, self.num_pages,
                                     self.page_size,
                                     kv_sharding=self._kv_sharding,
-                                    aux_sharding=self._replicated)
+                                    aux_sharding=self._replicated,
+                                    kv_quant=self.cfg.kv_quant)
         state = DecodeState(
             cache=cache,
             tokens=jnp.zeros((B,), jnp.int32),
@@ -856,7 +857,7 @@ class EngineCore:
                                 state.cache.lengths)
             new_state = dataclasses.replace(
                 state,
-                cache=PagedKVCache(k=cache.k, v=cache.v, lengths=lengths),
+                cache=dataclasses.replace(cache, lengths=lengths),
                 tokens=jnp.where(state.active, sampled, state.tokens),
                 active=active,
                 generated=generated,

@@ -63,14 +63,25 @@ class PagedKVCache:
     (profiled at ~2 s per 8-step dispatch on a 3B model before this layout).
     All access is by computed row index: pallas index maps for attention
     reads, scatters for token writes. ``lengths``: (B,) live rows per slot.
+
+    With ``kv_quant="int8"`` the pool stores int8 with per-token-per-head
+    symmetric scales ``k_s``/``v_s`` (L*P, page_size, KV) — the TRT-LLM
+    KV-cache-quantization capability brought in-tree. It HALVES the pool's
+    HBM footprint (longer contexts / more slots per chip, ~3% scale
+    overhead); note it is a CAPACITY knob, not a speed knob, on v5e today:
+    the narrow (page, KV) scale DMAs cost the paged kernel more than the
+    halved KV bytes save (measured round 4 — docs/performance.md). The
+    kernel dequantizes per head in VMEM. ``k_s is None`` ⇔ bf16 pool.
     """
 
     k: jnp.ndarray
     v: jnp.ndarray
     lengths: jnp.ndarray
+    k_s: Optional[jnp.ndarray] = None
+    v_s: Optional[jnp.ndarray] = None
 
     def tree_flatten(self):
-        return (self.k, self.v, self.lengths), None
+        return (self.k, self.v, self.lengths, self.k_s, self.v_s), None
 
     @classmethod
     def tree_unflatten(cls, _, children):
@@ -80,18 +91,82 @@ class PagedKVCache:
     def page_size(self) -> int:
         return self.k.shape[1]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_s is not None
+
     @staticmethod
     def create(cfg: llama.LlamaConfig, batch: int, num_pages: int,
                page_size: int, kv_sharding=None,
-               aux_sharding=None) -> "PagedKVCache":
+               aux_sharding=None, kv_quant: str = "none") -> "PagedKVCache":
         """Allocate the pool; shardings (if given) apply at creation so the
         multi-GB k/v buffers are never materialized on a single chip."""
         shape = (cfg.n_layers * num_pages, page_size,
                  cfg.n_kv_heads * cfg.head_dim)
+        if kv_quant == "int8":
+            s_shape = shape[:2] + (cfg.n_kv_heads,)
+            return PagedKVCache(
+                k=jnp.zeros(shape, jnp.int8, device=kv_sharding),
+                v=jnp.zeros(shape, jnp.int8, device=kv_sharding),
+                lengths=jnp.zeros((batch,), jnp.int32, device=aux_sharding),
+                k_s=jnp.zeros(s_shape, jnp.float32, device=kv_sharding),
+                v_s=jnp.zeros(s_shape, jnp.float32, device=kv_sharding))
+        if kv_quant not in ("none", ""):
+            raise ValueError(f"unknown kv_quant {kv_quant!r}")
         return PagedKVCache(
             k=jnp.zeros(shape, cfg.jdtype, device=kv_sharding),
             v=jnp.zeros(shape, cfg.jdtype, device=kv_sharding),
             lengths=jnp.zeros((batch,), jnp.int32, device=aux_sharding))
+
+
+def _kv_quantize(x: jnp.ndarray, KV: int, HD: int):
+    """(…, KV*HD) → int8 values + (…, KV) per-token-per-head scales."""
+    shaped = x.reshape(x.shape[:-1] + (KV, HD)).astype(jnp.float32)
+    s = jnp.max(jnp.abs(shaped), axis=-1) / 127.0
+    safe = jnp.maximum(s, 1e-10)
+    q = jnp.clip(jnp.round(shaped / safe[..., None]), -127, 127)
+    return q.astype(jnp.int8).reshape(x.shape), s
+
+
+def _kv_dequant_dense(q: jnp.ndarray, s: jnp.ndarray, KV: int, HD: int,
+                      dtype) -> jnp.ndarray:
+    """(B, T, KV*HD) int8 + (B, T, KV) scales → (B, T, KV, HD) dense."""
+    B, T = q.shape[:2]
+    return (q.reshape(B, T, KV, HD).astype(jnp.float32)
+            * s[..., None]).astype(dtype)
+
+
+def _write_pages_dense(pools, flat_pages, flat_rows, k, v, G, C, n_cp, ps,
+                       T, KV, HD, dtype):
+    """Shared prefill page write + dense attention view, both pool modes.
+
+    k, v: (G, C, KV, HD) new chunk KV; flat_pages: (G*n_cp,) physical rows
+    to scatter whole pages into; flat_rows: (G, maxp) rows to gather the
+    dense (G, T, KV, HD) attention view back out. Quantizes per token/head
+    when the pools carry scales. Returns (k_dense, v_dense, pools')."""
+    if len(pools) == 4:
+        k_pool, v_pool, ks_pool, vs_pool = pools
+        kq, ks = _kv_quantize(k.reshape(G, C, KV * HD), KV, HD)
+        vq, vs = _kv_quantize(v.reshape(G, C, KV * HD), KV, HD)
+        new_k = k_pool.at[flat_pages].set(kq.reshape(G * n_cp, ps, KV * HD))
+        new_v = v_pool.at[flat_pages].set(vq.reshape(G * n_cp, ps, KV * HD))
+        new_ks = ks_pool.at[flat_pages].set(ks.reshape(G * n_cp, ps, KV))
+        new_vs = vs_pool.at[flat_pages].set(vs.reshape(G * n_cp, ps, KV))
+        k_dense = _kv_dequant_dense(new_k[flat_rows].reshape(G, T, -1),
+                                    new_ks[flat_rows].reshape(G, T, KV),
+                                    KV, HD, dtype)
+        v_dense = _kv_dequant_dense(new_v[flat_rows].reshape(G, T, -1),
+                                    new_vs[flat_rows].reshape(G, T, KV),
+                                    KV, HD, dtype)
+        return k_dense, v_dense, (new_k, new_v, new_ks, new_vs)
+    k_pool, v_pool = pools
+    new_k = k_pool.at[flat_pages].set(
+        k.astype(k_pool.dtype).reshape(G * n_cp, ps, KV * HD))
+    new_v = v_pool.at[flat_pages].set(
+        v.astype(v_pool.dtype).reshape(G * n_cp, ps, KV * HD))
+    k_dense = new_k[flat_rows].reshape(G, T, KV, HD)
+    v_dense = new_v[flat_rows].reshape(G, T, KV, HD)
+    return k_dense, v_dense, (new_k, new_v)
 
 
 class PageAllocator:
@@ -177,15 +252,14 @@ def prefill_chunk(params: llama.Params, cfg: llama.LlamaConfig,
             lambda q_, k_, v_, sp_, vt_: pallas_ops.flash_prefill(
                 q_, k_, v_, start_pos=sp_, kv_valid_through=vt_))
 
-    def attn_and_update(q, k, v, k_pool, v_pool, idx):
+    quant = cache.quantized
+
+    def attn_and_update(q, k, v, pools, idx):
         flat_pages = idx * num_pages + chunk_pages
-        new_k = k_pool.at[flat_pages].set(
-            k.astype(k_pool.dtype).reshape(n_cp, ps, KV * HD))
-        new_v = v_pool.at[flat_pages].set(
-            v.astype(v_pool.dtype).reshape(n_cp, ps, KV * HD))
         flat_row = idx * num_pages + page_row
-        k_dense = new_k[flat_row].reshape(1, T, KV, HD)
-        v_dense = new_v[flat_row].reshape(1, T, KV, HD)
+        k_dense, v_dense, out_pools = _write_pages_dense(
+            pools, flat_pages, flat_row, k, v, 1, C, n_cp, ps, T, KV, HD,
+            h.dtype)
         if use_pallas:
             if tp > 1:
                 ctx = _sharded_flash(q, k_dense, v_dense, start_pos[None],
@@ -200,16 +274,19 @@ def prefill_chunk(params: llama.Params, cfg: llama.LlamaConfig,
                 kv_positions=cache_positions,
                 kv_mask=cache_positions < valid_through[:, None], causal=True,
                 window=cfg.sliding_window)
-        return ctx, new_k, new_v
+        return ctx, out_pools
 
-    h, k_stack, v_stack = llama.scan_blocks_inplace(
-        cfg, h, params, (cache.k, cache.v), cos, sin, attn_and_update,
-        adapters)
+    pools_in = ((cache.k, cache.v, cache.k_s, cache.v_s) if quant
+                else (cache.k, cache.v))
+    h, pools = llama.scan_blocks_inplace(
+        cfg, h, params, pools_in, cos, sin, attn_and_update, adapters)
     h_last = jnp.take_along_axis(
         h, (chunk_len - 1)[None, None, None].astype(jnp.int32), axis=1)
     logits = llama._unembed(cfg, params, h_last)[:, 0]               # (1, V)
     new_lengths = cache.lengths.at[slot].set(start_pos + chunk_len)
-    return logits, PagedKVCache(k=k_stack, v=v_stack, lengths=new_lengths)
+    return logits, PagedKVCache(k=pools[0], v=pools[1], lengths=new_lengths,
+                                k_s=pools[2] if quant else None,
+                                v_s=pools[3] if quant else None)
 
 
 def prefill_chunks(params: llama.Params, cfg: llama.LlamaConfig,
@@ -276,17 +353,16 @@ def prefill_chunks(params: llama.Params, cfg: llama.LlamaConfig,
             lambda q_, k_, v_, sp_, vt_: pallas_ops.flash_prefill(
                 q_, k_, v_, start_pos=sp_, kv_valid_through=vt_))
 
-    def attn_and_update(q, k, v, k_pool, v_pool, idx):
+    quant = cache.quantized
+
+    def attn_and_update(q, k, v, pools, idx):
         flat_pages = (idx * num_pages + chunk_pages).reshape(-1)  # (G*n_cp,)
         # duplicate indices only occur among padding entries (all page 0 —
         # the null page); real groups hold disjoint pages
-        new_k = k_pool.at[flat_pages].set(
-            k.astype(k_pool.dtype).reshape(G * n_cp, ps, KV * HD))
-        new_v = v_pool.at[flat_pages].set(
-            v.astype(v_pool.dtype).reshape(G * n_cp, ps, KV * HD))
         flat_rows = idx * num_pages + page_rows                   # (G, maxp)
-        k_dense = new_k[flat_rows].reshape(G, T, KV, HD)
-        v_dense = new_v[flat_rows].reshape(G, T, KV, HD)
+        k_dense, v_dense, out_pools = _write_pages_dense(
+            pools, flat_pages, flat_rows, k, v, G, C, n_cp, ps, T, KV, HD,
+            h.dtype)
         if use_pallas:
             if tp > 1:
                 ctx = _sharded_flash(q, k_dense, v_dense, start_pos,
@@ -301,17 +377,20 @@ def prefill_chunks(params: llama.Params, cfg: llama.LlamaConfig,
                 kv_positions=cache_positions,
                 kv_mask=cache_positions < valid_through[:, None], causal=True,
                 window=cfg.sliding_window)
-        return ctx, new_k, new_v
+        return ctx, out_pools
 
-    h, k_stack, v_stack = llama.scan_blocks_inplace(
-        cfg, h, params, (cache.k, cache.v), cos, sin, attn_and_update,
-        adapters)
+    pools_in = ((cache.k, cache.v, cache.k_s, cache.v_s) if quant
+                else (cache.k, cache.v))
+    h, pools = llama.scan_blocks_inplace(
+        cfg, h, params, pools_in, cos, sin, attn_and_update, adapters)
     last_ix = jnp.maximum(chunk_len - 1, 0)[:, None, None]        # (G, 1, 1)
     h_last = jnp.take_along_axis(h, last_ix.astype(jnp.int32), axis=1)
     logits = llama._unembed(cfg, params, h_last)[:, 0]            # (G, V)
     new_lengths = cache.lengths.at[slots].set(start_pos + chunk_len,
                                               mode="drop")
-    return logits, PagedKVCache(k=k_stack, v=v_stack, lengths=new_lengths)
+    return logits, PagedKVCache(k=pools[0], v=pools[1], lengths=new_lengths,
+                                k_s=pools[2] if quant else None,
+                                v_s=pools[3] if quant else None)
 
 
 def decode_step(params: llama.Params, cfg: llama.LlamaConfig,
@@ -354,48 +433,90 @@ def decode_step(params: llama.Params, cfg: llama.LlamaConfig,
         # shard DMAs only its own KV*HD/tp slice of every page (the pool
         # is laid out P(None, None, "tensor") by the engine), so the
         # flagship decode-bandwidth kernel runs in exactly the
-        # TP-sharded production config (round-2 weakness #3)
-        _sharded_paged = partial(
-            jax.shard_map, mesh=mesh,
-            in_specs=(P(None, None, "tensor", None),
-                      P(None, None, "tensor"), P(None, None, "tensor"),
-                      P(None, None), P(None), P()),
-            out_specs=P(None, None, "tensor", None), check_vma=False)(
-            lambda q_, kp_, vp_, pt_, ln_, ix_: pallas_ops.paged_decode(
-                q_, kp_, vp_, pt_, ln_, layer=ix_,
-                pages_per_layer=num_pages))
+        # TP-sharded production config (round-2 weakness #3). Quantized
+        # pools additionally shard the per-head scales over "tensor".
+        if cache.quantized:
+            _sharded_paged = partial(
+                jax.shard_map, mesh=mesh,
+                in_specs=(P(None, None, "tensor", None),
+                          P(None, None, "tensor"), P(None, None, "tensor"),
+                          P(None, None), P(None), P(),
+                          P(None, None, "tensor"), P(None, None, "tensor")),
+                out_specs=P(None, None, "tensor", None), check_vma=False)(
+                lambda q_, kp_, vp_, pt_, ln_, ix_, ks_, vs_:
+                pallas_ops.paged_decode(
+                    q_, kp_, vp_, pt_, ln_, layer=ix_,
+                    pages_per_layer=num_pages, k_scales=ks_, v_scales=vs_))
+        else:
+            _sharded_paged_raw = partial(
+                jax.shard_map, mesh=mesh,
+                in_specs=(P(None, None, "tensor", None),
+                          P(None, None, "tensor"), P(None, None, "tensor"),
+                          P(None, None), P(None), P()),
+                out_specs=P(None, None, "tensor", None), check_vma=False)(
+                lambda q_, kp_, vp_, pt_, ln_, ix_: pallas_ops.paged_decode(
+                    q_, kp_, vp_, pt_, ln_, layer=ix_,
+                    pages_per_layer=num_pages))
+            _sharded_paged = (lambda q_, kp_, vp_, pt_, ln_, ix_, ks_, vs_:
+                              _sharded_paged_raw(q_, kp_, vp_, pt_, ln_, ix_))
 
-    def attn_and_update(q, k, v, k_pool, v_pool, idx):
+    quant = cache.quantized
+
+    def attn_and_update(q, k, v, pools, idx):
         flat_rows = idx * num_pages + rows       # layer idx's pages
-        new_k = k_pool.at[flat_rows, offs].set(
-            k[:, 0].astype(k_pool.dtype).reshape(B, KV * HD))
-        new_v = v_pool.at[flat_rows, offs].set(
-            v[:, 0].astype(v_pool.dtype).reshape(B, KV * HD))
+        if quant:
+            k_pool, v_pool, ks_pool, vs_pool = pools
+            kq, ks = _kv_quantize(k[:, 0].reshape(B, KV * HD), KV, HD)
+            vq, vs = _kv_quantize(v[:, 0].reshape(B, KV * HD), KV, HD)
+            new_k = k_pool.at[flat_rows, offs].set(kq)
+            new_v = v_pool.at[flat_rows, offs].set(vq)
+            new_ks = ks_pool.at[flat_rows, offs].set(ks)
+            new_vs = vs_pool.at[flat_rows, offs].set(vs)
+            out_pools = (new_k, new_v, new_ks, new_vs)
+        else:
+            new_k = pools[0].at[flat_rows, offs].set(
+                k[:, 0].astype(pools[0].dtype).reshape(B, KV * HD))
+            new_v = pools[1].at[flat_rows, offs].set(
+                v[:, 0].astype(pools[1].dtype).reshape(B, KV * HD))
+            new_ks = new_vs = None
+            out_pools = (new_k, new_v)
         if use_pallas:
             # reads this layer's pages straight from the carried pool via
             # the block table + layer index — no dense gather, no slice,
-            # no reshape (any of which copies the multi-GB carry)
+            # no reshape (any of which copies the multi-GB carry); the
+            # quantized pool dequantizes per head inside the kernel
             if tp > 1:
                 ctx = _sharded_paged(q, new_k, new_v, page_table,
-                                     new_lengths, idx)
+                                     new_lengths, idx, new_ks, new_vs)
             else:
                 ctx = pallas_ops.paged_decode(q, new_k, new_v, page_table,
                                               new_lengths, layer=idx,
-                                              pages_per_layer=num_pages)
+                                              pages_per_layer=num_pages,
+                                              k_scales=new_ks,
+                                              v_scales=new_vs)
         else:
             k_dense = new_k[idx * num_pages + page_table].reshape(
-                B, T, KV, HD)
+                B, T, KV, HD) if not quant else _kv_dequant_dense(
+                new_k[idx * num_pages + page_table].reshape(B, T, -1),
+                new_ks[idx * num_pages + page_table].reshape(B, T, KV),
+                KV, HD, h.dtype)
             v_dense = new_v[idx * num_pages + page_table].reshape(
-                B, T, KV, HD)
+                B, T, KV, HD) if not quant else _kv_dequant_dense(
+                new_v[idx * num_pages + page_table].reshape(B, T, -1),
+                new_vs[idx * num_pages + page_table].reshape(B, T, KV),
+                KV, HD, h.dtype)
             ctx = mha_decode(q, k_dense, v_dense, new_lengths,
                              window=cfg.sliding_window)
-        return ctx, new_k, new_v
+        return ctx, out_pools
 
-    h, k_stack, v_stack = llama.scan_blocks_inplace(
-        cfg, h, params, (cache.k, cache.v), cos, sin, attn_and_update,
-        adapters)
+    pools_in = ((cache.k, cache.v, cache.k_s, cache.v_s) if quant
+                else (cache.k, cache.v))
+    h, pools = llama.scan_blocks_inplace(
+        cfg, h, params, pools_in, cos, sin, attn_and_update, adapters)
     logits = llama._unembed(cfg, params, h)[:, 0]
-    return logits, PagedKVCache(k=k_stack, v=v_stack, lengths=new_lengths)
+    return logits, PagedKVCache(k=pools[0], v=pools[1], lengths=new_lengths,
+                                k_s=pools[2] if quant else None,
+                                v_s=pools[3] if quant else None)
 
 
 def prefill_seq_parallel(params: llama.Params, cfg: llama.LlamaConfig,
@@ -434,9 +555,16 @@ def prefill_seq_parallel(params: llama.Params, cfg: llama.LlamaConfig,
     v_pages = v_stack[:, 0].reshape(L, n_p, ps, KV * HD)
     rows = (jnp.arange(L, dtype=jnp.int32)[:, None] * num_pages
             + page_row[None, :n_p]).reshape(-1)
+    lengths = cache.lengths.at[slot].set(n_tokens)
+    if cache.quantized:
+        kq, ks = _kv_quantize(k_pages.reshape(L * n_p, ps, KV * HD), KV, HD)
+        vq, vs = _kv_quantize(v_pages.reshape(L * n_p, ps, KV * HD), KV, HD)
+        return logits, PagedKVCache(
+            k=cache.k.at[rows].set(kq), v=cache.v.at[rows].set(vq),
+            lengths=lengths, k_s=cache.k_s.at[rows].set(ks),
+            v_s=cache.v_s.at[rows].set(vs))
     new_k = cache.k.at[rows].set(
         k_pages.reshape(L * n_p, ps, KV * HD).astype(cache.k.dtype))
     new_v = cache.v.at[rows].set(
         v_pages.reshape(L * n_p, ps, KV * HD).astype(cache.v.dtype))
-    lengths = cache.lengths.at[slot].set(n_tokens)
     return logits, PagedKVCache(k=new_k, v=new_v, lengths=lengths)

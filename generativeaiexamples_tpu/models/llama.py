@@ -495,36 +495,36 @@ def scan_blocks(cfg: LlamaConfig, h: jnp.ndarray, params: Params,
 
 
 def scan_blocks_inplace(cfg: LlamaConfig, h: jnp.ndarray, params: Params,
-                        kv_pool: Tuple[jnp.ndarray, jnp.ndarray],
+                        pools: Tuple[jnp.ndarray, ...],
                         cos: jnp.ndarray, sin: jnp.ndarray, attn_and_update,
                         adapters: Optional[Params]):
-    """Layer scan with the FULL KV pool as loop carry, updated in place.
+    """Layer scan with the FULL KV pool(s) as loop carry, updated in place.
 
     Unlike :func:`scan_blocks` (per-layer cache slices as scan inputs and
     freshly-stacked outputs — XLA copies the whole cache through the loop
-    every call, ~2x the cache size in HBM traffic per decode step), the pool
-    here is a while-loop carry: with the caller donating the buffers, XLA
-    aliases the carry and each layer's write is a true in-place scatter.
-    ``attn_and_update(q, k_chunk, v_chunk, k_pool, v_pool, layer_idx) ->
-    (ctx, k_pool', v_pool')`` owns the write and the (paged) attention read.
-    """
+    every call, ~2x the cache size in HBM traffic per decode step), the
+    pools ride as while-loop carries: with the caller donating the buffers,
+    XLA aliases the carry and each layer's write is a true in-place scatter.
+    ``pools`` is any tuple of pool arrays (k, v [, k_scales, v_scales] for
+    a quantized cache); ``attn_and_update(q, k_chunk, v_chunk, pools,
+    layer_idx) -> (ctx, pools')`` owns the writes and the (paged)
+    attention read. Returns (h, pools')."""
     def body(carry, xs):
-        h, k_pool, v_pool, idx = carry
+        h, pools, idx = carry
         layer, ad = xs
         store = {}
 
         def attn(q, k, v):
-            ctx, store["k"], store["v"] = attn_and_update(
-                q, k, v, k_pool, v_pool, idx)
+            ctx, store["pools"] = attn_and_update(q, k, v, pools, idx)
             return ctx
 
         h, _ = _block(cfg, h, layer, cos, sin, attn, ad)  # aux unused serving
-        return (h, store["k"], store["v"], idx + 1), None
+        return (h, store["pools"], idx + 1), None
 
-    (h, k_pool, v_pool, _), _ = jax.lax.scan(
-        body, (h, kv_pool[0], kv_pool[1], jnp.int32(0)),
+    (h, pools, _), _ = jax.lax.scan(
+        body, (h, tuple(pools), jnp.int32(0)),
         (params["layers"], adapters or {}))
-    return h, k_pool, v_pool
+    return h, pools
 
 
 def _scan_cached_blocks(cfg: LlamaConfig, h: jnp.ndarray, params: Params,

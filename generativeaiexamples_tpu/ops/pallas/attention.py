@@ -248,8 +248,16 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
 
 
 def _paged_decode_kernel(lens_ref, table_ref, layer_ref, q_ref, k_ref, v_ref,
-                         o_ref, acc_ref, m_ref, l_ref, *, ps: int,
-                         scale: float, KV: int, G: int, HD: int):
+                         *rest, ps: int, scale: float, KV: int, G: int,
+                         HD: int, quant: bool):
+    # rest = (ks_ref, vs_ref, o_ref, acc, m, l) when quant else (o_ref, …):
+    # a quantized pool carries int8 pages + (ps, KV) per-token-per-head
+    # scales; dequant happens here in VMEM, HBM only ever sees int8 bytes
+    if quant:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, acc_ref, m_ref, l_ref = rest
     # Grid (B, maxp): ONE grid step per (slot, logical page), all KV heads
     # processed in a static in-kernel loop — at serving shapes the per-page
     # work is tiny, so a (B, KV, pages) grid is overhead-bound (profiled at
@@ -279,8 +287,13 @@ def _paged_decode_kernel(lens_ref, table_ref, layer_ref, q_ref, k_ref, v_ref,
         t_mask = (ti * ps + jax.lax.broadcasted_iota(
             jnp.int32, (G, ps), 1)) < length
         for kv in range(KV):                       # static unroll over heads
+            k_head = k[:, kv * HD:(kv + 1) * HD]
+            v_head = v[:, kv * HD:(kv + 1) * HD]
+            if quant:                              # per-token dequant (VMEM)
+                k_head = k_head * ks_ref[0][:, kv:kv + 1]
+                v_head = v_head * vs_ref[0][:, kv:kv + 1]
             s = jax.lax.dot_general(
-                q[kv * G:(kv + 1) * G], k[:, kv * HD:(kv + 1) * HD],
+                q[kv * G:(kv + 1) * G], k_head,
                 (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale   # (G, ps)
             s = jnp.where(t_mask, s, NEG_INF)
@@ -295,7 +308,7 @@ def _paged_decode_kernel(lens_ref, table_ref, layer_ref, q_ref, k_ref, v_ref,
                 (G, l_ref.shape[1]))
             m_ref[rows, :] = jnp.broadcast_to(m_new, (G, m_ref.shape[1]))
             acc_ref[rows, :] = acc_ref[rows, :] * alpha + jax.lax.dot_general(
-                p, v[:, kv * HD:(kv + 1) * HD], (((1,), (0,)), ((), ())),
+                p, v_head, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
     @pl.when(ti == nt - 1)
@@ -308,6 +321,8 @@ def paged_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
                  page_table: jnp.ndarray, lengths: jnp.ndarray,
                  layer: Optional[jnp.ndarray] = None,
                  pages_per_layer: Optional[int] = None,
+                 k_scales: Optional[jnp.ndarray] = None,
+                 v_scales: Optional[jnp.ndarray] = None,
                  interpret: Optional[bool] = None) -> jnp.ndarray:
     """Single-token decode attention straight off the paged KV pool.
 
@@ -326,6 +341,12 @@ def paged_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
     engine/kv_cache.py moves ~2 extra copies of the cache per step), and
     pages past the slot's length clamp to a repeated index so their DMA is
     skipped entirely. Matches ``mha_decode`` on the gathered-dense view.
+
+    ``k_scales``/``v_scales`` (N, page, KV) switch the kernel to its int8
+    variant: pages hold int8, dequantized per token/head in VMEM (the
+    TRT-LLM kv-cache-quantization capability; a memory-capacity knob — the
+    narrow scale DMAs currently cost more time than the halved KV bytes
+    save on v5e, see docs/performance.md).
     """
     B, _, H, HD = q.shape
     N, ps, KVHD = k_pages.shape
@@ -335,6 +356,7 @@ def paged_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
         layer = jnp.zeros((), jnp.int32)
     maxp = page_table.shape[1]
     G = H // KV
+    quant = k_scales is not None
     if interpret is None:
         interpret = _interpret_default()
 
@@ -347,18 +369,26 @@ def paged_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
         lim = (jnp.maximum(lens[b], 1) - 1) // ps
         return (lyr[0] * P + table[b, jnp.minimum(ti, lim)], 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, KV * G, HD), q_map),
+        pl.BlockSpec((1, ps, KV * HD), kv_map),
+        pl.BlockSpec((1, ps, KV * HD), kv_map),
+    ]
+    args = [qg, k_pages, v_pages]
+    if quant:
+        in_specs += [pl.BlockSpec((1, ps, KV), kv_map),
+                     pl.BlockSpec((1, ps, KV), kv_map)]
+        args += [k_scales, v_scales]
+
     kernel = functools.partial(_paged_decode_kernel, ps=ps,
-                               scale=1.0 / (HD ** 0.5), KV=KV, G=G, HD=HD)
+                               scale=1.0 / (HD ** 0.5), KV=KV, G=G, HD=HD,
+                               quant=quant)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(B, maxp),
-            in_specs=[
-                pl.BlockSpec((1, KV * G, HD), q_map),
-                pl.BlockSpec((1, ps, KV * HD), kv_map),
-                pl.BlockSpec((1, ps, KV * HD), kv_map),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, KV * G, HD), q_map),
             scratch_shapes=[
                 pltpu.VMEM((KV * G, HD), jnp.float32),
@@ -369,7 +399,7 @@ def paged_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
         out_shape=jax.ShapeDtypeStruct((B, KV * G, HD), q.dtype),
         interpret=interpret,
     )(lengths.astype(jnp.int32), page_table.astype(jnp.int32),
-      jnp.reshape(layer, (1,)).astype(jnp.int32), qg, k_pages, v_pages)
+      jnp.reshape(layer, (1,)).astype(jnp.int32), *args)
     return out.reshape(B, 1, H, HD)
 
 
