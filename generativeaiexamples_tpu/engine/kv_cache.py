@@ -65,13 +65,14 @@ class PagedKVCache:
     reads, scatters for token writes. ``lengths``: (B,) live rows per slot.
 
     With ``kv_quant="int8"`` the pool stores int8 with per-token-per-head
-    symmetric scales ``k_s``/``v_s`` (L*P, page_size, KV) — the TRT-LLM
-    KV-cache-quantization capability brought in-tree. It HALVES the pool's
-    HBM footprint (longer contexts / more slots per chip, ~3% scale
-    overhead); note it is a CAPACITY knob, not a speed knob, on v5e today:
-    the narrow (page, KV) scale DMAs cost the paged kernel more than the
-    halved KV bytes save (measured round 4 — docs/performance.md). The
-    kernel dequantizes per head in VMEM. ``k_s is None`` ⇔ bf16 pool.
+    symmetric scales ``k_s``/``v_s`` (L*P, KV, page_size) — the TRT-LLM
+    KV-cache-quantization capability brought in-tree: half the pool's HBM
+    footprint AND measured +5% decode throughput on v5e (~3% scale
+    overhead). The scale layout keeps heads on axis 1 so a (KV, page)
+    block is a native f32 tile, and the paged kernel folds the dequant
+    past its dots (scores and probabilities are row-scaled; K/V elements
+    are never dequantized — docs/performance.md has the measured history).
+    ``k_s is None`` ⇔ bf16 pool.
     """
 
     k: jnp.ndarray
@@ -98,19 +99,27 @@ class PagedKVCache:
     @staticmethod
     def create(cfg: llama.LlamaConfig, batch: int, num_pages: int,
                page_size: int, kv_sharding=None,
-               aux_sharding=None, kv_quant: str = "none") -> "PagedKVCache":
+               aux_sharding=None, kv_quant: str = "none",
+               scale_sharding=None) -> "PagedKVCache":
         """Allocate the pool; shardings (if given) apply at creation so the
-        multi-GB k/v buffers are never materialized on a single chip."""
+        multi-GB k/v buffers are never materialized on a single chip.
+        ``scale_sharding`` places the (rows, KV, page) scale pools — their
+        HEAD axis is axis 1, unlike the kv pools' fused last axis."""
         shape = (cfg.n_layers * num_pages, page_size,
                  cfg.n_kv_heads * cfg.head_dim)
         if kv_quant == "int8":
-            s_shape = shape[:2] + (cfg.n_kv_heads,)
+            # scales are stored TRANSPOSED, (L*P, KV, page_size): a (KV, ps)
+            # block is a native (8, 128) f32 tile, where (ps, KV) blocks
+            # made degenerate 8-wide DMAs that cost more than the int8
+            # saved (measured round 4); the kernel row-scales scores and
+            # probabilities instead of dequantizing elements
+            s_shape = (shape[0], cfg.n_kv_heads, page_size)
             return PagedKVCache(
                 k=jnp.zeros(shape, jnp.int8, device=kv_sharding),
                 v=jnp.zeros(shape, jnp.int8, device=kv_sharding),
                 lengths=jnp.zeros((batch,), jnp.int32, device=aux_sharding),
-                k_s=jnp.zeros(s_shape, jnp.float32, device=kv_sharding),
-                v_s=jnp.zeros(s_shape, jnp.float32, device=kv_sharding))
+                k_s=jnp.zeros(s_shape, jnp.float32, device=scale_sharding),
+                v_s=jnp.zeros(s_shape, jnp.float32, device=scale_sharding))
         if kv_quant not in ("none", ""):
             raise ValueError(f"unknown kv_quant {kv_quant!r}")
         return PagedKVCache(
@@ -150,14 +159,17 @@ def _write_pages_dense(pools, flat_pages, flat_rows, k, v, G, C, n_cp, ps,
         vq, vs = _kv_quantize(v.reshape(G, C, KV * HD), KV, HD)
         new_k = k_pool.at[flat_pages].set(kq.reshape(G * n_cp, ps, KV * HD))
         new_v = v_pool.at[flat_pages].set(vq.reshape(G * n_cp, ps, KV * HD))
-        new_ks = ks_pool.at[flat_pages].set(ks.reshape(G * n_cp, ps, KV))
-        new_vs = vs_pool.at[flat_pages].set(vs.reshape(G * n_cp, ps, KV))
+        # pool layout is (rows, KV, ps): transpose the per-token scales in
+        sT = lambda s: (s.reshape(G, n_cp, ps, KV)
+                        .transpose(0, 1, 3, 2).reshape(G * n_cp, KV, ps))
+        new_ks = ks_pool.at[flat_pages].set(sT(ks))
+        new_vs = vs_pool.at[flat_pages].set(sT(vs))
+        dT = lambda sp: (sp[flat_rows].reshape(G, -1, KV, ps)
+                         .transpose(0, 1, 3, 2).reshape(G, T, KV))
         k_dense = _kv_dequant_dense(new_k[flat_rows].reshape(G, T, -1),
-                                    new_ks[flat_rows].reshape(G, T, KV),
-                                    KV, HD, dtype)
+                                    dT(new_ks), KV, HD, dtype)
         v_dense = _kv_dequant_dense(new_v[flat_rows].reshape(G, T, -1),
-                                    new_vs[flat_rows].reshape(G, T, KV),
-                                    KV, HD, dtype)
+                                    dT(new_vs), KV, HD, dtype)
         return k_dense, v_dense, (new_k, new_v, new_ks, new_vs)
     k_pool, v_pool = pools
     new_k = k_pool.at[flat_pages].set(
@@ -441,7 +453,8 @@ def decode_step(params: llama.Params, cfg: llama.LlamaConfig,
                 in_specs=(P(None, None, "tensor", None),
                           P(None, None, "tensor"), P(None, None, "tensor"),
                           P(None, None), P(None), P(),
-                          P(None, None, "tensor"), P(None, None, "tensor")),
+                          # scale pools are (rows, KV, page): heads on axis 1
+                          P(None, "tensor", None), P(None, "tensor", None)),
                 out_specs=P(None, None, "tensor", None), check_vma=False)(
                 lambda q_, kp_, vp_, pt_, ln_, ix_, ks_, vs_:
                 pallas_ops.paged_decode(
@@ -470,8 +483,9 @@ def decode_step(params: llama.Params, cfg: llama.LlamaConfig,
             vq, vs = _kv_quantize(v[:, 0].reshape(B, KV * HD), KV, HD)
             new_k = k_pool.at[flat_rows, offs].set(kq)
             new_v = v_pool.at[flat_rows, offs].set(vq)
-            new_ks = ks_pool.at[flat_rows, offs].set(ks)
-            new_vs = vs_pool.at[flat_rows, offs].set(vs)
+            # scale pool is (rows, KV, ps): one (B, KV) column write
+            new_ks = ks_pool.at[flat_rows, :, offs].set(ks)
+            new_vs = vs_pool.at[flat_rows, :, offs].set(vs)
             out_pools = (new_k, new_v, new_ks, new_vs)
         else:
             new_k = pools[0].at[flat_rows, offs].set(
@@ -484,7 +498,7 @@ def decode_step(params: llama.Params, cfg: llama.LlamaConfig,
             # reads this layer's pages straight from the carried pool via
             # the block table + layer index — no dense gather, no slice,
             # no reshape (any of which copies the multi-GB carry); the
-            # quantized pool dequantizes per head inside the kernel
+            # quantized pool's scales row-scale scores/probs in the kernel
             if tp > 1:
                 ctx = _sharded_paged(q, new_k, new_v, page_table,
                                      new_lengths, idx, new_ks, new_vs)
@@ -495,16 +509,17 @@ def decode_step(params: llama.Params, cfg: llama.LlamaConfig,
                                               k_scales=new_ks,
                                               v_scales=new_vs)
         else:
+            def sTd(sp):       # (B, maxp, KV, ps) pool gather → (B, T, KV)
+                return (sp[idx * num_pages + page_table]
+                        .transpose(0, 1, 3, 2).reshape(B, T, KV))
             k_dense = new_k[idx * num_pages + page_table].reshape(
                 B, T, KV, HD) if not quant else _kv_dequant_dense(
                 new_k[idx * num_pages + page_table].reshape(B, T, -1),
-                new_ks[idx * num_pages + page_table].reshape(B, T, KV),
-                KV, HD, h.dtype)
+                sTd(new_ks), KV, HD, h.dtype)
             v_dense = new_v[idx * num_pages + page_table].reshape(
                 B, T, KV, HD) if not quant else _kv_dequant_dense(
                 new_v[idx * num_pages + page_table].reshape(B, T, -1),
-                new_vs[idx * num_pages + page_table].reshape(B, T, KV),
-                KV, HD, h.dtype)
+                sTd(new_vs), KV, HD, h.dtype)
             ctx = mha_decode(q, k_dense, v_dense, new_lengths,
                              window=cfg.sliding_window)
         return ctx, out_pools
@@ -561,8 +576,9 @@ def prefill_seq_parallel(params: llama.Params, cfg: llama.LlamaConfig,
         vq, vs = _kv_quantize(v_pages.reshape(L * n_p, ps, KV * HD), KV, HD)
         return logits, PagedKVCache(
             k=cache.k.at[rows].set(kq), v=cache.v.at[rows].set(vq),
-            lengths=lengths, k_s=cache.k_s.at[rows].set(ks),
-            v_s=cache.v_s.at[rows].set(vs))
+            lengths=lengths,
+            k_s=cache.k_s.at[rows].set(ks.transpose(0, 2, 1)),
+            v_s=cache.v_s.at[rows].set(vs.transpose(0, 2, 1)))
     new_k = cache.k.at[rows].set(
         k_pages.reshape(L * n_p, ps, KV * HD).astype(cache.k.dtype))
     new_v = cache.v.at[rows].set(

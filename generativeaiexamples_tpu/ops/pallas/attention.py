@@ -251,8 +251,9 @@ def _paged_decode_kernel(lens_ref, table_ref, layer_ref, q_ref, k_ref, v_ref,
                          *rest, ps: int, scale: float, KV: int, G: int,
                          HD: int, quant: bool):
     # rest = (ks_ref, vs_ref, o_ref, acc, m, l) when quant else (o_ref, …):
-    # a quantized pool carries int8 pages + (ps, KV) per-token-per-head
-    # scales; dequant happens here in VMEM, HBM only ever sees int8 bytes
+    # a quantized pool carries int8 pages + (KV, ps) per-token-per-head
+    # scale tiles; the dequant folds past the dots (scores/probabilities
+    # row-scaled), so HBM only ever sees int8 KV bytes
     if quant:
         ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
     else:
@@ -289,13 +290,16 @@ def _paged_decode_kernel(lens_ref, table_ref, layer_ref, q_ref, k_ref, v_ref,
         for kv in range(KV):                       # static unroll over heads
             k_head = k[:, kv * HD:(kv + 1) * HD]
             v_head = v[:, kv * HD:(kv + 1) * HD]
-            if quant:                              # per-token dequant (VMEM)
-                k_head = k_head * ks_ref[0][:, kv:kv + 1]
-                v_head = v_head * vs_ref[0][:, kv:kv + 1]
             s = jax.lax.dot_general(
                 q[kv * G:(kv + 1) * G], k_head,
                 (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale   # (G, ps)
+            if quant:
+                # dequant folded past the dot: q·(k_t·s_t) = (q·k_t)·s_t —
+                # one (1, ps) row-scale of the score matrix instead of a
+                # per-element K dequant; scales are stored (KV, ps), a
+                # native f32 tile
+                s = s * ks_ref[0][kv:kv + 1, :]
             s = jnp.where(t_mask, s, NEG_INF)
             rows = slice(kv * G, (kv + 1) * G)
             m_prev = m_ref[rows, :1]
@@ -307,6 +311,10 @@ def _paged_decode_kernel(lens_ref, table_ref, layer_ref, q_ref, k_ref, v_ref,
                 alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True),
                 (G, l_ref.shape[1]))
             m_ref[rows, :] = jnp.broadcast_to(m_new, (G, m_ref.shape[1]))
+            if quant:
+                # Σ_t p_t·(v_t·s_t) = (p·s) @ v — row-scale p instead of
+                # dequantizing V
+                p = p * vs_ref[0][kv:kv + 1, :]
             acc_ref[rows, :] = acc_ref[rows, :] * alpha + jax.lax.dot_general(
                 p, v_head, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -342,11 +350,11 @@ def paged_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
     pages past the slot's length clamp to a repeated index so their DMA is
     skipped entirely. Matches ``mha_decode`` on the gathered-dense view.
 
-    ``k_scales``/``v_scales`` (N, page, KV) switch the kernel to its int8
-    variant: pages hold int8, dequantized per token/head in VMEM (the
-    TRT-LLM kv-cache-quantization capability; a memory-capacity knob — the
-    narrow scale DMAs currently cost more time than the halved KV bytes
-    save on v5e, see docs/performance.md).
+    ``k_scales``/``v_scales`` (N, KV, page) switch the kernel to its int8
+    variant: pages hold int8 with the dequant folded past the dots —
+    scores and probabilities are row-scaled by the per-token scales
+    ((KV, page) blocks are native f32 tiles), so no per-element dequant
+    runs in the kernel (the TRT-LLM kv-cache-quantization capability).
     """
     B, _, H, HD = q.shape
     N, ps, KVHD = k_pages.shape
@@ -376,8 +384,8 @@ def paged_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
     ]
     args = [qg, k_pages, v_pages]
     if quant:
-        in_specs += [pl.BlockSpec((1, ps, KV), kv_map),
-                     pl.BlockSpec((1, ps, KV), kv_map)]
+        in_specs += [pl.BlockSpec((1, KV, ps), kv_map),
+                     pl.BlockSpec((1, KV, ps), kv_map)]
         args += [k_scales, v_scales]
 
     kernel = functools.partial(_paged_decode_kernel, ps=ps,

@@ -49,6 +49,7 @@ def test_paged_decode_kernel_quant_matches_dense():
     vf = rng.randn(N, ps, KV * HD).astype(np.float32)
     kq, ks = kv_cache._kv_quantize(jnp.asarray(kf), KV, HD)
     vq, vs = kv_cache._kv_quantize(jnp.asarray(vf), KV, HD)
+    ksT, vsT = ks.transpose(0, 2, 1), vs.transpose(0, 2, 1)  # pool layout
     table = np.zeros((B, maxp), np.int32)
     pages = iter(range(1, N))
     for b in range(B):
@@ -58,7 +59,7 @@ def test_paged_decode_kernel_quant_matches_dense():
 
     out = pallas_ops.paged_decode(
         q, kq, vq, jnp.asarray(table), lengths,
-        k_scales=ks, v_scales=vs, interpret=True)
+        k_scales=ksT, v_scales=vsT, interpret=True)
 
     k_dense = kv_cache._kv_dequant_dense(
         kq[jnp.asarray(table)].reshape(B, maxp * ps, -1),
@@ -144,11 +145,51 @@ def test_engine_end_to_end_with_kv_quant():
         sched.stop()
 
 
+def test_kv_quant_under_tensor_parallel():
+    """The quantized pool under TP: KV pools shard their fused last axis,
+    the (rows, KV, page) scale pools shard their HEAD axis — a layout
+    mismatch here fails the per-shard pallas BlockSpec (the round-4 review
+    catch). The TP stream must equal the single-device stream (pallas
+    kernels in interpret mode on the CPU mesh)."""
+    from generativeaiexamples_tpu.parallel import mesh as pmesh
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    params = llama.init_params(jax.random.PRNGKey(5), cfg)
+    tok = ByteTokenizer()
+    prompt = tok.encode("sharded quantized pool must match single device",
+                        add_bos=True)
+    ecfg = EngineConfig(max_batch_size=4, max_seq_len=128, page_size=16,
+                        prefill_chunk=32, attention="pallas",
+                        kv_quant="int8")
+
+    def run(mesh):
+        core = EngineCore(cfg, ecfg, params, eos_id=tok.eos_id, mesh=mesh)
+        sched = Scheduler(core, tok)
+        req = Request(prompt_ids=list(prompt), max_tokens=10, temperature=0.0)
+        sched.submit(req)
+        while sched._tick():
+            pass
+        assert req.error is None, req.error
+        parts = []
+        while not req.out_queue.empty():
+            item = req.out_queue.get_nowait()
+            if isinstance(item, str):
+                parts.append(item)
+        return "".join(parts)
+
+    base = run(None)
+    assert base
+    mesh = pmesh.create_mesh(
+        pmesh.MeshConfig(axes=pmesh.INFER_AXES, shape=(1, 2)),
+        devices=jax.devices()[:2])
+    assert run(mesh) == base
+
+
 def test_cache_create_shapes_and_flags():
     cfg = llama.LlamaConfig.tiny()
     c = kv_cache.PagedKVCache.create(cfg, 2, 9, 16, kv_quant="int8")
     assert c.quantized and c.k.dtype == jnp.int8
-    assert c.k_s.shape == (cfg.n_layers * 9, 16, cfg.n_kv_heads)
+    assert c.k_s.shape == (cfg.n_layers * 9, cfg.n_kv_heads, 16)
     c2 = kv_cache.PagedKVCache.create(cfg, 2, 9, 16)
     assert not c2.quantized and c2.k_s is None
     with pytest.raises(ValueError):
