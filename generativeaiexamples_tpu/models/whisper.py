@@ -21,8 +21,9 @@ Design, TPU-first rather than a port of openai/whisper's torch code:
     state_dict (e.g. openai/whisper-tiny) onto the tree — numerical parity
     is pinned by tests/test_whisper.py against a randomly-initialized HF
     module, the same no-network pattern as models/vlm.py;
-  * greedy transcription pads the token prefix to power-of-two buckets so
-    decoding compiles a handful of programs, not one per length.
+  * greedy transcription runs ONE fixed-shape cached decode step program
+    (per-block self-attention KV cache + precomputed cross-attention K/V),
+    O(n) per utterance.
 """
 
 from __future__ import annotations
@@ -298,23 +299,88 @@ def decode_logits(params: Params, cfg: WhisperConfig, tokens: jnp.ndarray,
     return h @ params["tok_embed"].T
 
 
+def _xattn_kv(params: Params, cfg: WhisperConfig, enc_out: jnp.ndarray):
+    """Per-block cross-attention K/V over the encoder states — computed
+    once per utterance, reused by every decode step."""
+    # HF whisper cross-attention projects the RAW encoder states (the
+    # xattn_ln norms the DECODER hidden, applied to q in the step)
+    return [(_lin(enc_out, blk["xattn"]["k"]),
+             _lin(enc_out, blk["xattn"]["v"]))
+            for blk in params["dec_blocks"]]
+
+
+def _decode_step_cached(params, cfg: WhisperConfig, tok, pos, self_kv,
+                        cross_kv):
+    """One cached greedy-decode step: tok (B,), pos scalar, self_kv a list
+    of per-block (k, v) with shape (B, n_text_ctx, D); returns (logits
+    (B, V), self_kv'). Attention masks keys past ``pos``."""
+    B = tok.shape[0]
+    D, H, HD = cfg.d_model, cfg.n_heads, cfg.head_dim
+    h = params["tok_embed"][tok] + params["dec_pos"][pos]      # (B, D)
+    h = h[:, None]                                             # (B, 1, D)
+    new_kv = []
+    key_mask = (jnp.arange(cfg.n_text_ctx) <= pos)[None, None, None, :]
+    for blk, (ck, cv), (sk, sv) in zip(params["dec_blocks"], cross_kv,
+                                       self_kv):
+        x = _ln(h, blk["attn_ln"])
+        p = blk["attn"]
+        q = _lin(x, p["q"]).reshape(B, 1, H, HD) * (HD ** -0.5)
+        sk = jax.lax.dynamic_update_slice_in_dim(sk, _lin(x, p["k"]), pos, 1)
+        sv = jax.lax.dynamic_update_slice_in_dim(sv, _lin(x, p["v"]), pos, 1)
+        new_kv.append((sk, sv))
+        k = sk.reshape(B, cfg.n_text_ctx, H, HD)
+        v = sv.reshape(B, cfg.n_text_ctx, H, HD)
+        scores = jnp.einsum("bshd,bthd->bhst", q, k)
+        scores = jnp.where(key_mask, scores, -jnp.inf)
+        ctx = jnp.einsum("bhst,bthd->bshd", jax.nn.softmax(scores, -1), v)
+        h = h + _lin(ctx.reshape(B, 1, D), p["o"])
+        # cross attention over the precomputed encoder K/V
+        x = _ln(h, blk["xattn_ln"])
+        p = blk["xattn"]
+        q = _lin(x, p["q"]).reshape(B, 1, H, HD) * (HD ** -0.5)
+        Te = ck.shape[1]
+        kx = ck.reshape(B, Te, H, HD)
+        vx = cv.reshape(B, Te, H, HD)
+        scores = jnp.einsum("bshd,bthd->bhst", q, kx)
+        ctx = jnp.einsum("bhst,bthd->bshd", jax.nn.softmax(scores, -1), vx)
+        h = h + _lin(ctx.reshape(B, 1, D), p["o"])
+        x = _ln(h, blk["mlp_ln"])
+        h = h + _lin(jax.nn.gelu(_lin(x, blk["fc1"]), approximate=False),
+                     blk["fc2"])
+    h = _ln(h, params["dec_ln"])
+    return (h @ params["tok_embed"].T)[:, 0], new_kv
+
+
+_step_cached_jit = jax.jit(
+    lambda params, cfg, tok, pos, self_kv, cross_kv: _decode_step_cached(
+        params, cfg, tok, pos, self_kv, cross_kv),
+    static_argnums=1, donate_argnums=(4,))   # cache updates in place
+
+
 def transcribe_ids(params: Params, cfg: WhisperConfig, audio: np.ndarray,
                    max_tokens: int = 128) -> List[int]:
-    """Greedy transcription token ids (specials stripped). Token prefixes
-    pad to power-of-two buckets so the decoder compiles O(log n) programs."""
+    """Greedy transcription token ids (specials stripped). Decodes over a
+    per-block self-attention KV cache (one fixed-shape step program, O(n)
+    per utterance) with the cross-attention K/V precomputed once."""
     mel = jnp.asarray(log_mel(audio, cfg))[None]
     enc_out = _encode_jit(params, cfg, mel)
+    cross_kv = _xattn_kv(params, cfg, enc_out)
+    self_kv = [(jnp.zeros((1, cfg.n_text_ctx, cfg.d_model)),
+                jnp.zeros((1, cfg.n_text_ctx, cfg.d_model)))
+               for _ in params["dec_blocks"]]
     prompt = [cfg.sot, cfg.lang_en, cfg.task_transcribe, cfg.no_timestamps]
     ids = list(prompt)
     max_len = min(cfg.n_text_ctx, len(prompt) + max_tokens)
-    while len(ids) < max_len:
-        S = 8
-        while S < len(ids):
-            S *= 2
-        padded = np.zeros((1, min(S, cfg.n_text_ctx)), np.int32)
-        padded[0, :len(ids)] = ids
-        logits = _decode_jit(params, cfg, jnp.asarray(padded), enc_out)
-        nxt = int(jnp.argmax(logits[0, len(ids) - 1]))
+    for pos in range(max_len):
+        feeding = pos < len(prompt) - 1
+        if not feeding and len(ids) >= max_len:
+            break                        # a further step's token is unusable
+        logits, self_kv = _step_cached_jit(
+            params, cfg, jnp.asarray([ids[pos]], jnp.int32),
+            pos, self_kv, cross_kv)
+        if feeding:
+            continue                     # still feeding the prompt
+        nxt = int(jnp.argmax(logits[0]))
         if nxt == cfg.eot:
             break
         ids.append(nxt)

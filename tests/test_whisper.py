@@ -58,6 +58,33 @@ def test_transcribe_ids_deterministic_and_bounded():
     assert all(0 <= i < cfg.vocab_size for i in ids1)
 
 
+def test_cached_decode_matches_full_forward():
+    """The KV-cached greedy decode path must reproduce the full-forward
+    argmax sequence exactly (the cache is an optimization, not a model)."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg = whisper.WhisperConfig.tiny_random()
+    params = whisper.init_params(jax.random.PRNGKey(3), cfg)
+    audio = np.random.RandomState(1).randn(2400).astype(np.float32) * 0.2
+    got = whisper.transcribe_ids(params, cfg, audio, max_tokens=10)
+
+    # reference: naive re-forward per step (the pre-cache algorithm)
+    mel = jnp.asarray(whisper.log_mel(audio, cfg))[None]
+    enc = whisper.encode(params, cfg, mel)
+    ids = [cfg.sot, cfg.lang_en, cfg.task_transcribe, cfg.no_timestamps]
+    want = []
+    for _ in range(10):
+        logits = whisper.decode_logits(
+            params, cfg, jnp.asarray([ids], jnp.int32), enc)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        if nxt == cfg.eot:
+            break
+        want.append(nxt)
+        ids.append(nxt)
+    assert got == want
+
+
 def test_hf_whisper_parity():
     """Logits parity vs a random-init transformers whisper of the same
     tiny geometry (encoder AND decoder paths, no network)."""
