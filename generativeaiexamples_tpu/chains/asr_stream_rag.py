@@ -1,0 +1,169 @@
+"""Streaming ASR → RAG: live audio becomes a queryable knowledge base.
+
+In-tree counterpart of the community FM-ASR streaming RAG app (ref:
+community/fm-asr-streaming-rag/README.md — SDR/file-replay audio → Riva
+ASR NIM → transcripts into Milvus via the embedding NIM → RAG Q&A), built
+from pieces this framework already ships:
+
+  * audio arrives as PCM blocks (an SDR demodulator, a file replayer, or
+    the playground's mic stream — anything yielding bytes);
+  * transcription runs the speech seam (speech/clients.py): the in-tree
+    whisper model (zero external services) or an HTTP ASR endpoint;
+  * timestamped transcript SEGMENTS flow through the bounded streaming
+    ingest pipeline (retrieval/streaming_ingest.py: chunk → embed → store),
+    exactly like any other live document source;
+  * Q&A is the standard RAG chain over the live collection — ask about
+    what was just said on air.
+
+The reference needs five containers and two GPUs for this loop; here it is
+one process on the TPU stack.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, AsyncIterator, Dict, Iterator, Optional
+
+from generativeaiexamples_tpu.chains.basic_rag import BasicRAG
+from generativeaiexamples_tpu.chains.context import ChainContext
+from generativeaiexamples_tpu.retrieval.streaming_ingest import (
+    SourceItem, StreamingIngestor)
+from generativeaiexamples_tpu.server.registry import register_example
+
+logger = logging.getLogger(__name__)
+
+COLLECTION = "asr_stream"
+
+
+class TranscriptSegmenter:
+    """Turn a stream of PCM16 audio blocks into timestamped transcript
+    segments.
+
+    Audio accumulates until ``segment_seconds`` of samples arrived, then the
+    buffered window is transcribed as ONE unit and emitted with its
+    [t0, t1) span — the granularity documents enter the vector store at
+    (the reference chunks transcripts the same way before Milvus). Bounded
+    work: each flush transcribes only its own window, not the whole
+    history, so an endless broadcast costs O(1) memory and O(n) ASR."""
+
+    def __init__(self, asr, segment_seconds: float = 15.0,
+                 sample_rate: int = 16000, station: str = "stream",
+                 language: str = "en-US",
+                 collection: str = COLLECTION) -> None:
+        self.asr = asr
+        self.segment_bytes = int(segment_seconds * sample_rate) * 2
+        self.sample_rate = sample_rate
+        self.station = station
+        self.language = language
+        self.collection = collection
+        self._buf = bytearray()
+        self._consumed_bytes = 0       # audio-time bookkeeping
+
+    def _span(self, n_bytes: int) -> tuple:
+        t0 = self._consumed_bytes / (2 * self.sample_rate)
+        t1 = (self._consumed_bytes + n_bytes) / (2 * self.sample_rate)
+        return t0, t1
+
+    def _wav(self, data: bytes) -> bytes:
+        """Wrap the raw PCM window in a WAV header carrying the stream's
+        sample rate — the ASR contract is headered audio (headerless bytes
+        would be ASSUMED 16 kHz by the whisper backend, transcribing any
+        other rate as slowed/sped garbage with no visible failure)."""
+        import io
+        import wave
+        buf = io.BytesIO()
+        with wave.open(buf, "wb") as w:
+            w.setnchannels(1)
+            w.setsampwidth(2)
+            w.setframerate(self.sample_rate)
+            w.writeframes(data)
+        return buf.getvalue()
+
+    def _emit(self, data: bytes) -> Optional[SourceItem]:
+        t0, t1 = self._span(len(data))
+        self._consumed_bytes += len(data)
+        try:
+            text = self.asr.transcribe(self._wav(data),
+                                       self.language).strip()
+        except Exception as exc:       # a dead ASR must be visible in stats
+            return SourceItem(content="", source=self.station,
+                              collection=self.collection,
+                              error=f"asr failed at {t0:.1f}s: {exc}")
+        if not text:
+            return None                # silence window: nothing to index
+        return SourceItem(
+            content=f"[{self.station} {t0:.1f}s-{t1:.1f}s] {text}",
+            source=f"{self.station}@{t0:.1f}s", collection=self.collection)
+
+    def feed(self, block: bytes) -> Iterator[SourceItem]:
+        """Add an audio block; yields a segment per completed window."""
+        self._buf.extend(block)
+        while len(self._buf) >= self.segment_bytes:
+            window = bytes(self._buf[: self.segment_bytes])
+            del self._buf[: self.segment_bytes]
+            item = self._emit(window)
+            if item is not None:
+                yield item
+
+    def finalize(self) -> Iterator[SourceItem]:
+        """Flush the trailing partial window (end of broadcast/file)."""
+        if self._buf:
+            data = bytes(self._buf)
+            self._buf.clear()
+            item = self._emit(data)
+            if item is not None:
+                yield item
+
+
+async def asr_source(blocks: AsyncIterator[bytes], asr,
+                     segment_seconds: float = 15.0,
+                     sample_rate: int = 16000,
+                     station: str = "stream",
+                     collection: str = COLLECTION
+                     ) -> AsyncIterator[SourceItem]:
+    """Adapt an async stream of PCM16 blocks into streaming-ingest source
+    items via :class:`TranscriptSegmenter` (the shape
+    `StreamingIngestor.run` consumes alongside file/jsonl sources). The
+    ASR work runs off the event loop (asyncio.to_thread) so the chunk/
+    embed/store stages keep flowing during a window's transcription —
+    the same posture as every other stage in streaming_ingest.py."""
+    import asyncio
+
+    seg = TranscriptSegmenter(asr, segment_seconds=segment_seconds,
+                              sample_rate=sample_rate, station=station,
+                              collection=collection)
+    async for block in blocks:
+        for item in await asyncio.to_thread(lambda b=block: list(seg.feed(b))):
+            yield item
+    for item in await asyncio.to_thread(lambda: list(seg.finalize())):
+        yield item
+
+
+@register_example("asr_stream_rag")
+class ASRStreamRAG(BasicRAG):
+    """RAG over live transcripts: the standard retrieve→prompt→stream chain
+    pointed at the streaming-ASR collection. `ingest_stream` drives audio
+    sources through the bounded pipeline; `/generate` with
+    use_knowledge_base answers questions about what was broadcast."""
+
+    collection = COLLECTION
+
+    def __init__(self, context: ChainContext = None) -> None:
+        super().__init__(context)
+
+    def ingest_stream(self, blocks: AsyncIterator[bytes], asr,
+                      segment_seconds: float = 15.0,
+                      sample_rate: int = 16000,
+                      station: str = "stream"):
+        """Run one audio stream to exhaustion into the live collection;
+        returns IngestStats. Callable repeatedly (multiple stations →
+        multiple calls or one call per source list)."""
+        ingestor = StreamingIngestor(
+            embedder=self.ctx.embedder,
+            store_factory=self.ctx.store,
+            splitter=self.ctx.splitter())
+        src = asr_source(blocks, asr, segment_seconds=segment_seconds,
+                         sample_rate=sample_rate, station=station,
+                         collection=self.collection)
+        return ingestor.run_sync([src])

@@ -55,6 +55,10 @@ def trim_context(chunks: Sequence[str], tokenizer, budget: int) -> str:
 
 @register_example("basic_rag")
 class BasicRAG(BaseExample):
+    # subclasses point the same chain at their own collection
+    # (e.g. chains/asr_stream_rag.py's live-transcript store)
+    collection = COLLECTION
+
     def __init__(self, context: ChainContext = None) -> None:
         self.ctx = context or get_context()
 
@@ -69,7 +73,7 @@ class BasicRAG(BaseExample):
         docs = [Document(content=c, metadata={"source": filename})
                 for c in chunks]
         embeddings = self.ctx.embedder.embed_documents([d.content for d in docs])
-        self.ctx.store(COLLECTION).add(docs, embeddings)
+        self.ctx.store(self.collection).add(docs, embeddings)
         logger.info("ingested %s: %d chunks", filename, len(docs))
 
     # ----------------------------------------------------------- generation
@@ -86,7 +90,7 @@ class BasicRAG(BaseExample):
                   **llm_settings: Any) -> Iterator[str]:
         rcfg = self.ctx.config.retriever
         qvec = self.ctx.embedder.embed_queries([query])[0]
-        hits = self.ctx.store(COLLECTION).search(
+        hits = self.ctx.store(self.collection).search(
             qvec, top_k=rcfg.top_k, score_threshold=rcfg.score_threshold)
         context_text = trim_context([d.content for d, _ in hits],
                                     self.ctx.embedder.tokenizer,
@@ -101,7 +105,7 @@ class BasicRAG(BaseExample):
 
     def document_search(self, query: str, num_docs: int = 4) -> List[Dict[str, Any]]:
         qvec = self.ctx.embedder.embed_queries([query])[0]
-        hits = self.ctx.store(COLLECTION).search(
+        hits = self.ctx.store(self.collection).search(
             qvec, top_k=num_docs,
             score_threshold=self.ctx.config.retriever.score_threshold)
         return [{"source": str(d.metadata.get("source", "")),
@@ -109,7 +113,7 @@ class BasicRAG(BaseExample):
                 for d, score in hits]
 
     def get_documents(self) -> List[str]:
-        return self.ctx.store(COLLECTION).list_sources()
+        return self.ctx.store(self.collection).list_sources()
 
     def delete_documents(self, filenames: Sequence[str]) -> bool:
-        return self.ctx.store(COLLECTION).delete_by_source(filenames) > 0
+        return self.ctx.store(self.collection).delete_by_source(filenames) > 0

@@ -387,14 +387,11 @@ def transcribe_ids(params: Params, cfg: WhisperConfig, audio: np.ndarray,
     return ids[len(prompt):]
 
 
-# module-level jitted entry points (per-call jax.jit would recompile every
-# call); cfg is a frozen dataclass → hashable static arg
+# module-level jitted entry point (per-call jax.jit would recompile every
+# call); cfg is a frozen dataclass → hashable static arg. Full-forward
+# decode_logits stays unjitted — it is the parity/reference path only.
 _encode_jit = jax.jit(lambda params, cfg, mel: encode(params, cfg, mel),
                       static_argnums=1)
-_decode_jit = jax.jit(
-    lambda params, cfg, tokens, enc_out: decode_logits(params, cfg, tokens,
-                                                       enc_out),
-    static_argnums=1)
 
 
 # ---------------------------------------------------------------------------

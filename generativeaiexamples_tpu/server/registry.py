@@ -32,6 +32,7 @@ _KNOWN = {
     "knowledge_graph_rag": "generativeaiexamples_tpu.chains.knowledge_graph_rag",
     "text_to_sql": "generativeaiexamples_tpu.chains.text_to_sql",
     "router_rag": "generativeaiexamples_tpu.chains.router_rag",
+    "asr_stream_rag": "generativeaiexamples_tpu.chains.asr_stream_rag",
 }
 
 
