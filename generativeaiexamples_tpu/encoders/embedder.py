@@ -15,7 +15,7 @@ separate GPU container.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -68,14 +68,21 @@ class Embedder:
         return tokens, mask
 
     def _run(self, texts: Sequence[str]) -> np.ndarray:
-        out: List[np.ndarray] = []
+        # dispatch-ahead: issue every batch's program before fetching any
+        # result — device compute and the (serialized, ~100 ms each on a
+        # remote-attached chip) device→host transfers overlap instead of
+        # alternating
+        pending = []
         for i in range(0, len(texts), self.max_batch):
             chunk = texts[i:i + self.max_batch]
             tokens, mask = self._batchify(chunk)
-            vecs = self._embed(self.params, jnp.asarray(tokens), jnp.asarray(mask))
-            out.append(np.asarray(vecs)[: len(chunk)])
+            vecs = self._embed(self.params, jnp.asarray(tokens),
+                               jnp.asarray(mask))
+            pending.append((vecs, len(chunk)))
             REGISTRY.counter("embeddings_computed").inc(len(chunk))
-        return np.concatenate(out, axis=0) if out else np.zeros((0, self.dim))
+        out = [np.asarray(v)[:n] for v, n in pending]
+        return (np.concatenate(out, axis=0) if out
+                else np.zeros((0, self.dim), np.float32))
 
     def embed_queries(self, texts: Sequence[str]) -> np.ndarray:
         return self._run([QUERY_PREFIX + t for t in texts])

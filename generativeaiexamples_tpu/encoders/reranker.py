@@ -68,15 +68,18 @@ class Reranker:
         """Relevance scores (len(passages),) — one jitted batch per ≤max_batch."""
         if not passages:
             return np.zeros((0,), np.float32)
-        out: List[np.ndarray] = []
+        # dispatch-ahead across batches (see embedder._run): issue all
+        # programs, then fetch — hides the per-batch transfer round trip
+        pending = []
         for i in range(0, len(passages), self.max_batch):
             chunk = passages[i:i + self.max_batch]
             tokens, mask, types = self._pack(query, chunk)
             scores = self._score(self.params, jnp.asarray(tokens),
                                  jnp.asarray(mask), jnp.asarray(types))
-            out.append(np.asarray(scores)[: len(chunk)])
+            pending.append((scores, len(chunk)))
         REGISTRY.counter("pairs_reranked").inc(len(passages))
-        return np.concatenate(out, axis=0)
+        return np.concatenate([np.asarray(s_)[:n] for s_, n in pending],
+                              axis=0)
 
     def rerank(self, query: str, passages: Sequence[str],
                top_n: int = 4) -> List[Tuple[int, float]]:
