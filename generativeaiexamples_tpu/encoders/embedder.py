@@ -79,8 +79,11 @@ class Embedder:
             vecs = self._embed(self.params, jnp.asarray(tokens),
                                jnp.asarray(mask))
             pending.append((vecs, len(chunk)))
-            REGISTRY.counter("embeddings_computed").inc(len(chunk))
-        out = [np.asarray(v)[:n] for v, n in pending]
+        # count AFTER the fetch: a failed batch must not report embeddings
+        out = []
+        for v, n in pending:
+            out.append(np.asarray(v)[:n])
+            REGISTRY.counter("embeddings_computed").inc(n)
         return (np.concatenate(out, axis=0) if out
                 else np.zeros((0, self.dim), np.float32))
 

@@ -20,6 +20,8 @@ def model_configs():
     return {
         "llama3-8b": llama.LlamaConfig.llama3_8b,
         "llama3-70b": llama.LlamaConfig.llama3_70b,
+        "mixtral-8x7b": llama.LlamaConfig.mixtral_8x7b,
+        "tiny-moe": llama.LlamaConfig.tiny_moe,
         "gemma-2b": gemma.gemma_2b,
         "gemma-7b": gemma.gemma_7b,
         "codegemma-7b": gemma.codegemma_7b,

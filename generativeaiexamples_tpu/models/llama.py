@@ -29,7 +29,7 @@ deployments with local HF checkpoints.
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
@@ -88,6 +88,25 @@ class LlamaConfig:
     def llama3_70b() -> "LlamaConfig":
         return LlamaConfig(dim=8192, n_layers=80, n_heads=64, n_kv_heads=8,
                            hidden_dim=28672)
+
+    @staticmethod
+    def mixtral_8x7b() -> "LlamaConfig":
+        """Mixtral-class sparse MoE (top-2 of 8 GLU experts per token);
+        serves/trains through the same block — experts shard over the
+        "expert" mesh axis (ops/moe.py, parallel ep)."""
+        return LlamaConfig(vocab_size=32000, dim=4096, n_layers=32,
+                           n_heads=32, n_kv_heads=8, hidden_dim=14336,
+                           rope_theta=1e6, mlp="moe", n_experts=8,
+                           n_experts_per_tok=2)
+
+    @staticmethod
+    def tiny_moe(vocab_size: int = 300) -> "LlamaConfig":
+        """Test-scale sparse-MoE config: LlamaConfig.tiny (float32 —
+        deterministic greedy tests) with the MLP swapped for top-2-of-4
+        routed experts."""
+        return replace(LlamaConfig.tiny(vocab_size), mlp="moe",
+                       n_experts=4, n_experts_per_tok=2,
+                       capacity_factor=2.0)
 
     @staticmethod
     def tiny(vocab_size: int = 256) -> "LlamaConfig":
@@ -645,8 +664,11 @@ def params_from_hf(state_dict: Dict[str, Any], cfg: LlamaConfig) -> Params:
     def lin(name):  # torch Linear: (out, in) → (in, out)
         return t(name).T
 
+    moe = cfg.mlp == "moe"
+    mlp_keys = (("w_router", "w_gate", "w_up", "w_down") if moe
+                else ("w_gate", "w_up", "w_down"))
     layers = {k: [] for k in ("attn_norm", "wq", "wk", "wv", "wo",
-                              "mlp_norm", "w_gate", "w_up", "w_down")}
+                              "mlp_norm", *mlp_keys)}
     for i in range(cfg.n_layers):
         p = f"model.layers.{i}."
         layers["attn_norm"].append(t(p + "input_layernorm.weight"))
@@ -655,9 +677,25 @@ def params_from_hf(state_dict: Dict[str, Any], cfg: LlamaConfig) -> Params:
         layers["wv"].append(lin(p + "self_attn.v_proj.weight"))
         layers["wo"].append(lin(p + "self_attn.o_proj.weight"))
         layers["mlp_norm"].append(t(p + "post_attention_layernorm.weight"))
-        layers["w_gate"].append(lin(p + "mlp.gate_proj.weight"))
-        layers["w_up"].append(lin(p + "mlp.up_proj.weight"))
-        layers["w_down"].append(lin(p + "mlp.down_proj.weight"))
+        if moe:
+            # MixtralForCausalLM layout: block_sparse_moe.gate (router) +
+            # per-expert w1 (gate), w3 (up), w2 (down) → stacked on a
+            # leading expert axis (ops/moe.py layout)
+            b = p + "block_sparse_moe."
+            layers["w_router"].append(lin(b + "gate.weight"))
+            layers["w_gate"].append(jnp.stack(
+                [lin(f"{b}experts.{e}.w1.weight")
+                 for e in range(cfg.n_experts)]))
+            layers["w_up"].append(jnp.stack(
+                [lin(f"{b}experts.{e}.w3.weight")
+                 for e in range(cfg.n_experts)]))
+            layers["w_down"].append(jnp.stack(
+                [lin(f"{b}experts.{e}.w2.weight")
+                 for e in range(cfg.n_experts)]))
+        else:
+            layers["w_gate"].append(lin(p + "mlp.gate_proj.weight"))
+            layers["w_up"].append(lin(p + "mlp.up_proj.weight"))
+            layers["w_down"].append(lin(p + "mlp.down_proj.weight"))
 
     params: Params = {
         "embed": t("model.embed_tokens.weight"),

@@ -15,9 +15,8 @@ from generativeaiexamples_tpu.ops import moe
 
 
 def tiny_moe(vocab_size: int = 256) -> llama.LlamaConfig:
-    return dataclasses.replace(
-        llama.LlamaConfig.tiny(vocab_size),
-        mlp="moe", n_experts=4, n_experts_per_tok=2, capacity_factor=2.0)
+    """Canonical test-scale MoE config (models registry 'tiny-moe')."""
+    return llama.LlamaConfig.tiny_moe(vocab_size)
 
 
 def _dense_reference(params, x, k):
@@ -161,6 +160,40 @@ def test_moe_serves_through_the_paged_engine():
         if isinstance(item, str):
             parts.append(item)
     assert "".join(parts) == expect
+
+
+def test_hf_mixtral_parity():
+    """params_from_hf's MoE branch vs a random-init transformers
+    MixtralForCausalLM of the same tiny geometry (no network): logits must
+    match — pins the block_sparse_moe gate/w1/w3/w2 mapping and expert
+    stacking."""
+    torch = pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    hf_cfg = MixtralConfig(
+        vocab_size=256, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2, rope_theta=10000.0,
+        rms_norm_eps=1e-5, tie_word_embeddings=False,
+        router_jitter_noise=0.0)
+    torch.manual_seed(0)
+    hf = MixtralForCausalLM(hf_cfg).eval()
+
+    cfg = dataclasses.replace(
+        llama.LlamaConfig.tiny_moe(256), dim=32, n_heads=4, n_kv_heads=2,
+        head_dim=8, hidden_dim=64, tie_embeddings=False,
+        # ample capacity: HF routes EVERY token (no capacity drops), so the
+        # comparison must not drop either
+        capacity_factor=16.0)
+    params = llama.params_from_hf(hf.state_dict(), cfg)
+
+    toks = np.arange(1, 13, dtype=np.int64)[None] % 256
+    logits = llama.forward(params, cfg, jnp.asarray(toks, jnp.int32))
+    with torch.no_grad():
+        want = hf(input_ids=torch.tensor(toks)).logits.numpy()
+    np.testing.assert_allclose(np.asarray(logits), want, atol=3e-4,
+                               rtol=3e-3)
 
 
 def test_quantize_params_skips_expert_weights():
