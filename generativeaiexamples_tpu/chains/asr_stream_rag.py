@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Any, AsyncIterator, Dict, Iterator, Optional
+from typing import AsyncIterator, Iterator, Optional
 
 from generativeaiexamples_tpu.chains.basic_rag import BasicRAG
 from generativeaiexamples_tpu.chains.context import ChainContext

@@ -29,8 +29,7 @@ Design, TPU-first rather than a port of openai/whisper's torch code:
 from __future__ import annotations
 
 import dataclasses
-import math
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List
 
 import jax
 import jax.numpy as jnp

@@ -23,8 +23,6 @@ import logging
 import os
 from typing import List, Optional
 
-import numpy as np
-
 logger = logging.getLogger(__name__)
 
 

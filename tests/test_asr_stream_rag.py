@@ -9,7 +9,6 @@ ingest → vector store → the standard RAG chain.
 import asyncio
 
 import numpy as np
-import pytest
 
 from generativeaiexamples_tpu.chains.asr_stream_rag import (
     COLLECTION, ASRStreamRAG, TranscriptSegmenter, asr_source)

@@ -8,8 +8,6 @@ attention outputs, and (c) the full engine running greedy decode with the
 quantized pool across prefill, decode, grouped prefill, and slot reuse.
 """
 
-import dataclasses
-
 import jax
 import jax.numpy as jnp
 import numpy as np
