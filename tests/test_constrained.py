@@ -33,10 +33,15 @@ def random_schema(rng: random.Random, depth: int = 2) -> dict:
     """Random schema in the supported subset. Strings/arrays are bounded so
     the DFA language is finite — an adversarial sampler then always reaches
     an accept state within the token budget."""
-    kinds = ["string", "integer", "boolean", "enum"]
+    kinds = ["string", "integer", "boolean", "enum", "const"]
     if depth > 0:
-        kinds += ["object", "array"]
+        kinds += ["object", "array", "anyOf"]
     k = rng.choice(kinds)
+    if k == "const":
+        return {"const": rng.choice(["fixed", 0, True, None])}
+    if k == "anyOf":
+        return {"anyOf": [random_schema(rng, depth - 1)
+                          for _ in range(rng.randint(2, 4))]}
     if k == "string":
         return {"type": "string", "maxLength": rng.randint(1, 4)}
     if k == "integer":
@@ -64,7 +69,11 @@ def validates(value, schema) -> bool:
     dep; the grammar compiler is what's under test, so an independent
     checker matters)."""
     if "const" in schema:
-        return value == schema["const"]
+        return value == schema["const"] and \
+            type(value) == type(schema["const"])
+    if "anyOf" in schema or "oneOf" in schema:
+        options = schema.get("anyOf") or schema.get("oneOf")
+        return any(validates(value, o) for o in options)
     if "enum" in schema:
         return any(value == v and type(value) == type(v)
                    for v in schema["enum"])
