@@ -31,3 +31,8 @@ playground:
 # One-command stack: chain server + playground, health-gated (compose parity).
 up:
 	$(TEST_ENV) python -m generativeaiexamples_tpu.deploy up --tiny
+
+# Adversarial scheduler stress: 1000 seeded episodes against a fake paged
+# core with real page-table semantics (tests/test_scheduler_fuzz.py).
+fuzz:
+	$(TEST_ENV) python -m pytest tests/test_scheduler_fuzz.py -q
