@@ -31,6 +31,7 @@ additive.
 
 from __future__ import annotations
 
+import hashlib
 import json
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -110,6 +111,18 @@ def _string_ast(s: Dict[str, Any]) -> tuple:
     return seq(lit(b'"'), body, lit(b'"'))
 
 
+def _free_json_object(depth: int) -> tuple:
+    """Any JSON object (free-form keys and values), nesting bounded at
+    ``depth`` — the language of ``{"type": "object"}`` with no declared
+    properties."""
+    inner = _free_json(depth - 1)
+    key = seq(lit(b'"'), star(_STRING_CHAR), lit(b'"'))
+    member = seq(key, _WS, lit(b":"), _WS, inner)
+    return seq(lit(b"{"), _WS,
+               opt(seq(member, star(seq(lit(b","), _WS, member)))),
+               _WS, lit(b"}"))
+
+
 def _free_json(depth: int) -> tuple:
     """Any JSON value, nesting bounded at ``depth`` (a DFA cannot count)."""
     scalar = alt(seq(lit(b'"'), star(_STRING_CHAR), lit(b'"')),
@@ -120,12 +133,7 @@ def _free_json(depth: int) -> tuple:
     arr = seq(lit(b"["), _WS,
               opt(seq(inner, star(seq(lit(b","), _WS, inner)))),
               _WS, lit(b"]"))
-    key = seq(lit(b'"'), star(_STRING_CHAR), lit(b'"'))
-    member = seq(key, _WS, lit(b":"), _WS, inner)
-    obj = seq(lit(b"{"), _WS,
-              opt(seq(member, star(seq(lit(b","), _WS, member)))),
-              _WS, lit(b"}"))
-    return alt(scalar, arr, obj)
+    return alt(scalar, arr, _free_json_object(depth))
 
 
 _FREE_DEPTH = 3
@@ -174,9 +182,24 @@ def schema_ast(schema: Dict[str, Any], depth: int = 12) -> tuple:
     if t == "object" or (t is None and "properties" in schema):
         props = schema.get("properties")
         if not props:
-            return _free_json(_FREE_DEPTH) if t is None else seq(
-                lit(b"{"), _WS, lit(b"}"))
-        required = set(schema.get("required", list(props)))
+            # a bare {"type": "object"} admits ANY object (JSON Schema
+            # semantics) — only an explicit additionalProperties:false
+            # pins it to the empty object. A SCHEMA-valued
+            # additionalProperties would make the free-object language a
+            # superset of the schema's — refuse so the serving layer falls
+            # back to prompt+parse instead of guaranteeing invalid output.
+            ap = schema.get("additionalProperties")
+            if isinstance(ap, dict):
+                raise UnsupportedSchema(
+                    "additionalProperties with a value schema")
+            if ap is False:
+                return seq(lit(b"{"), _WS, lit(b"}"))
+            return (_free_json(_FREE_DEPTH) if t is None
+                    else _free_json_object(_FREE_DEPTH))
+        # JSON Schema semantics: absent "required" means NO property is
+        # required (the prompt contract still asks the model for all of
+        # them; the mask only guarantees validity)
+        required = set(schema.get("required", ()))
         members = []
         for name, sub in props.items():
             m = seq(lit(json.dumps(name).encode()), _WS, lit(b":"), _WS,
@@ -413,8 +436,11 @@ class Grammar:
 
     @staticmethod
     def from_schema(schema: Dict[str, Any]) -> "Grammar":
+        # NOT sort_keys: property order is part of the enforced language
+        # (fixed-order members), so schemas differing only in property
+        # order are different grammars and must not collide in engine caches
         return Grammar(dfa=compile_dfa(seq(schema_ast(schema), _WS)),
-                       key="schema:" + json.dumps(schema, sort_keys=True))
+                       key="schema:" + json.dumps(schema, sort_keys=False))
 
     @staticmethod
     def json_value() -> "Grammar":
@@ -453,8 +479,16 @@ class Grammar:
         env = seq(lit(b'{"tool_calls":'), _WS, lit(b"["), _WS,
                   one, star(seq(lit(b","), _WS, one)), _WS, lit(b"]"),
                   lit(b"}"), _WS)
-        key = "tools:" + json.dumps([t.get("function", t).get("name")
-                                     for t in tools]) + f":{forced}"
+        # the key must cover PARAMETER SCHEMAS, not just names — engines
+        # dedup grammars by key, and two tool sets with identical names but
+        # different parameters are different languages (NOT sort_keys:
+        # property order is part of the enforced language)
+        spec = json.dumps(
+            [[t.get("function", t).get("name"),
+              t.get("function", t).get("parameters")] for t in tools],
+            sort_keys=False)
+        digest = hashlib.sha256(spec.encode()).hexdigest()[:16]
+        key = f"tools:{digest}:{forced}"
         return Grammar(dfa=compile_dfa(env), key=key)
 
 

@@ -78,6 +78,13 @@ class Request:
     # into one valid document.
     grammar: Optional[object] = None
     grammar_prefix: str = ""
+    # set by the scheduler once the grammar-attachment decision is made
+    # (final prefill chunk): True = token-level enforcement active, False =
+    # degraded to unconstrained (slots pinned / unsupported), None = not
+    # yet decided. The serving layer MUST check this before promising
+    # token-level-valid output to a streaming client (engine/server.py
+    # falls back to its buffered extract path when it isn't True).
+    grammar_attached: Optional[bool] = None
     request_id: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
     # filled by the scheduler:
     out_queue: "queue.Queue" = field(default_factory=queue.Queue)
@@ -406,7 +413,12 @@ class Scheduler:
 
         job = self._prefilling[0]
         req = job.request
+        # Grammared requests stay on the chunked path: the long sequence-
+        # parallel program's activation tail clears gram_state (engine.py
+        # _activate_sampled), so taking it would silently drop token-level
+        # enforcement the serving layer promised the client.
         if (job.prefilled == 0 and len(job.ids) > self.core.chunk
+                and req.grammar is None
                 and self.core.cfg.long_prefill != "off"
                 and self.core.supports_long_prefill):
             job.prefill_started = time.perf_counter()
@@ -475,10 +487,17 @@ class Scheduler:
         grammar = job.request.grammar
         if grammar is None:
             return 0
+        job.request.grammar_attached = False   # until registration succeeds
         try:
             self.core.ensure_token_bytes(self.tokenizer)
+            # _pending counts too: a PREEMPTED job's grammar must stay
+            # pinned while it waits to resume — its client was already
+            # promised token-level enforcement, a fresh request can still
+            # fall back to prompt+parse
             active = {j.request.grammar.key
-                      for j in list(self._slots.values()) + list(self._prefilling)
+                      for j in (list(self._slots.values())
+                                + list(self._prefilling)
+                                + list(self._pending))
                       if j.request.grammar is not None}
             prefix = job.request.grammar_prefix.encode("utf-8")
             if job.gen_ids or prefix:
@@ -487,6 +506,7 @@ class Scheduler:
             else:
                 state = self.core.register_grammar(grammar, active)
             job.gram_on = state > 0
+            job.request.grammar_attached = job.gram_on
             return state
         except Exception as exc:
             logger.warning("constrained decoding disabled for %s: %s",

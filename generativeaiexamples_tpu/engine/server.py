@@ -207,10 +207,13 @@ class ModelServer:
             return await self._stream_tools(request, rid, req, drain, tools)
         if stream and json_mode and grammar is not None and not tools:
             # the token-level grammar GUARANTEES valid JSON, so json-mode
-            # output can stream as plain content deltas — no buffer-and-
-            # extract needed (and failover resumes stay byte-exact)
-            pass
-        elif not stream or tools or json_mode:
+            # output can stream as plain content deltas — but only when
+            # the grammar actually ATTACHED (slots can be pinned at
+            # admission); _stream_json peeks the first delta, checks
+            # req.grammar_attached, and falls back to the buffered
+            # extract path when enforcement degraded
+            return await self._stream_json(request, rid, req, drain)
+        if not stream or tools or json_mode:
             # JSON-mode requests WITHOUT a grammar (and non-streamed
             # tools) still buffer: the extracted JSON value is rewritten
             # canonically, so the output shape isn't known until the
@@ -261,6 +264,66 @@ class ModelServer:
         final = json.loads(_chunk(self.model_name, rid, {}, finish))
         if req.error:
             final["error"] = req.error
+        await sse_write(resp, json.dumps(final))
+        await sse_done(resp)
+        return resp
+
+    async def _stream_json(self, request: web.Request, rid: str, req,
+                           drain: StreamDrain) -> web.StreamResponse:
+        """Stream a grammar-constrained JSON-mode generation. Enforcement
+        can degrade at admission (all GRAM_SLOTS pinned, schema rejected at
+        registration) — the scheduler records the decision on
+        Request.grammar_attached by the time the first token exists, so
+        peek one delta, then either stream plain content deltas (grammar
+        active: validity is token-level guaranteed) or fall back to the
+        buffered extract-and-rewrite path clients were promised."""
+        # headers + role chunk go out BEFORE the first-token wait so
+        # client/proxy response timeouts see bytes during long prefills
+        resp = await self._sse_response(request)
+        await sse_write(resp, _chunk(self.model_name, rid,
+                                     {"role": "assistant"}))
+        it = drain.__aiter__()
+        try:
+            first = await it.__anext__()
+        except StopAsyncIteration:
+            first = None
+        error: Optional[str] = None
+        if req.grammar_attached and first is not None and not req.error:
+            await sse_write(resp, _chunk(self.model_name, rid,
+                                         {"content": first}))
+            async for delta in it:
+                if req.grammar_attached is False:
+                    # a preemption resume failed to re-attach the grammar
+                    # (slots pinned): everything from here is unconstrained
+                    # — stop emitting rather than pass it off as
+                    # token-level guaranteed; keep draining so the job
+                    # finishes cleanly
+                    error = ("constrained decoding lost on preemption "
+                             "resume; retry the request")
+                    continue
+                await sse_write(resp, _chunk(self.model_name, rid,
+                                             {"content": delta}))
+        else:
+            parts = [] if first is None else [first]
+            async for delta in it:
+                parts.append(delta)
+            if not req.error:
+                text = "".join(parts)
+                # a failover continuation's client already holds the
+                # stream prefix — rewriting the suffix alone would corrupt
+                # the composed document, so only standalone generations
+                # get the canonical extract-and-rewrite
+                if not req.grammar_prefix:
+                    found = tools_mod.extract_json_value(text)
+                    if found is not None:
+                        text = json.dumps(found[0])
+                await sse_write(resp, _chunk(self.model_name, rid,
+                                             {"content": text}))
+        error = req.error or error
+        finish = "error" if error else "stop"
+        final = json.loads(_chunk(self.model_name, rid, {}, finish))
+        if error:
+            final["error"] = error
         await sse_write(resp, json.dumps(final))
         await sse_done(resp)
         return resp

@@ -103,7 +103,7 @@ def validates(value, schema) -> bool:
         if not isinstance(value, dict):
             return False
         props = schema.get("properties", {})
-        required = schema.get("required", list(props))
+        required = schema.get("required", [])
         if any(r not in value for r in required):
             return False
         return all(k in props and validates(v, props[k])
@@ -270,5 +270,121 @@ def test_server_json_schema_constrained_roundtrip(tiny_engine):
         data = asyncio.run(drive())
         content = data["choices"][0]["message"]["content"]
         assert validates(json.loads(content), schema), content
+    finally:
+        sched.stop()
+
+
+# ----------------------------------------- round-5 grammar-semantics fixes
+
+def test_bare_object_schema_admits_any_object():
+    """{"type": "object"} with no properties is ANY object (JSON Schema
+    semantics), not the empty-object-only language — tools registered
+    without a parameters schema must not be token-masked to arguments:{}."""
+    g = grammar_mod.Grammar.from_schema({"type": "object"})
+    assert g.dfa.matches(b'{}')
+    assert g.dfa.matches(b'{"a": 1}')
+    assert g.dfa.matches(b'{"query": "x", "k": [1, 2]}')
+    assert not g.dfa.matches(b'[1]')
+    # explicit additionalProperties:false pins the empty object
+    g2 = grammar_mod.Grammar.from_schema(
+        {"type": "object", "additionalProperties": False})
+    assert g2.dfa.matches(b'{}')
+    assert not g2.dfa.matches(b'{"a": 1}')
+
+
+def test_schemaless_tool_accepts_real_arguments():
+    """for_tools with a parameter-less tool (defaults to {"type":"object"})
+    must admit non-empty argument objects."""
+    tools = [{"function": {"name": "search"}}]
+    g = grammar_mod.Grammar.for_tools(tools, forced="search")
+    doc = b'{"tool_calls": [{"name": "search", "arguments": {"q": "tpu"}}]}'
+    assert g.dfa.matches(doc)
+
+
+def test_required_absent_means_all_optional():
+    """Absent "required" = nothing required (spec semantics): the object
+    may omit any or all properties."""
+    schema = {"type": "object",
+              "properties": {"a": {"type": "integer"},
+                             "b": {"type": "boolean"}}}
+    g = grammar_mod.Grammar.from_schema(schema)
+    assert g.dfa.matches(b'{}')
+    assert g.dfa.matches(b'{"b": true}')
+    assert g.dfa.matches(b'{"a": 1, "b": false}')
+    # explicit required still enforced
+    g2 = grammar_mod.Grammar.from_schema({**schema, "required": ["a"]})
+    assert not g2.dfa.matches(b'{}')
+    assert not g2.dfa.matches(b'{"b": true}')
+    assert g2.dfa.matches(b'{"a": 1}')
+
+
+def test_for_tools_key_covers_parameter_schemas():
+    """Two tool sets with identical names but different parameter schemas
+    are different languages and must not collide in engine grammar caches."""
+    a = [{"function": {"name": "f", "parameters": {
+        "type": "object", "properties": {"x": {"type": "integer"}},
+        "required": ["x"]}}}]
+    b = [{"function": {"name": "f", "parameters": {
+        "type": "object", "properties": {"x": {"type": "string"}},
+        "required": ["x"]}}}]
+    ga, gb = (grammar_mod.Grammar.for_tools(a, forced="f"),
+              grammar_mod.Grammar.for_tools(b, forced="f"))
+    assert ga.key != gb.key
+    # same spec → same key (the cache still dedups)
+    assert ga.key == grammar_mod.Grammar.for_tools(list(a), forced="f").key
+
+
+def test_stream_falls_back_when_grammar_does_not_attach(tiny_engine, monkeypatch):
+    """A streaming json_schema client is promised valid JSON; when the
+    grammar cannot attach at admission (slots pinned / registration
+    failure) the server must fall back to the buffered extract path —
+    one replayed content delta — instead of streaming raw unconstrained
+    deltas (ADVICE r4, engine/server.py:208)."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from generativeaiexamples_tpu.engine.server import ModelServer
+
+    core, tok = tiny_engine
+
+    def refuse(*args, **kwargs):
+        raise grammar_mod.UnsupportedSchema("slots pinned (test)")
+
+    monkeypatch.setattr(core, "register_grammar", refuse)
+    sched = Scheduler(core, tok)
+    sched.start()
+    try:
+        server = ModelServer(sched, "tiny")
+        schema = {"type": "object",
+                  "properties": {"answer": {"enum": ["yes", "no"]}},
+                  "required": ["answer"]}
+
+        async def drive():
+            client = TestClient(TestServer(server.app))
+            await client.start_server()
+            try:
+                resp = await client.post("/v1/chat/completions", json={
+                    "messages": [{"role": "user", "content": "verdict?"}],
+                    "temperature": 1.0, "max_tokens": 32, "stream": True,
+                    "response_format": {
+                        "type": "json_schema",
+                        "json_schema": {"name": "verdict",
+                                        "schema": schema}}})
+                body = (await resp.read()).decode()
+            finally:
+                await client.close()
+            return body
+
+        body = asyncio.run(drive())
+        chunks = [json.loads(line[len("data: "):])
+                  for line in body.splitlines()
+                  if line.startswith("data: ") and "[DONE]" not in line]
+        content_deltas = [c for c in chunks
+                          if c["choices"][0]["delta"].get("content")
+                          is not None]
+        # buffered replay shape: exactly ONE content delta, not a raw
+        # token-by-token stream of unvalidated text
+        assert len(content_deltas) == 1, body
     finally:
         sched.stop()
