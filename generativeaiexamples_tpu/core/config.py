@@ -208,6 +208,7 @@ class EngineConfig:
     max_seq_len: int = configfield("max_seq_len", default=2048, help_txt="KV-cache length per slot.")
     page_size: int = configfield("page_size", default=128, help_txt="KV page granularity (tokens).")
     num_pages: int = configfield("num_pages", default=0, help_txt="Physical KV pages in the pool (bounds HBM by live tokens); 0 = full slot capacity.")
+    prefix_cache: str = configfield("prefix_cache", default="on", help_txt="Prefix caching over the paged KV pool: on | off. Hash-identified full prompt pages are shared across requests (refcounted, LRU-evicted under pool pressure), so repeated chat templates / system prompts / retrieved chunks skip re-prefill — the TRT-LLM prefix-reuse capability in-tree.")
     prefill_chunk: int = configfield("prefill_chunk", default=512, help_txt="Chunked-prefill bucket size.")
     decode_steps_per_dispatch: int = configfield("decode_steps_per_dispatch", default=8, help_txt="Decode steps fused into one device dispatch (lax.scan); amortizes host sync latency. Must be a power of two (each distinct step count is a separate compile).")
     decode_steps_max: int = configfield("decode_steps_max", default=0, help_txt="Adaptive upper bound on fused decode steps: when the batch is at least half full and every active slot has the budget, dispatches deepen up to this many steps (power of two; 0 = always use decode_steps_per_dispatch). Pays when dispatch round trips bound throughput; a device-bound engine is better off at the base depth (measured round 4).")

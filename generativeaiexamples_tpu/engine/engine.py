@@ -304,7 +304,14 @@ class EngineCore:
             state = DecodeState(cache, *rest)
         return state
 
-    def new_allocator(self) -> kv_cache.PageAllocator:
+    def new_allocator(self):
+        """Page allocator for the pool; with ``prefix_cache=on`` (default)
+        a refcounting CachingAllocator, so the scheduler shares identical
+        page-aligned prompt prefixes across requests."""
+        if getattr(self.cfg, "prefix_cache", "on") != "off":
+            from generativeaiexamples_tpu.engine.prefix_cache import (
+                CachingAllocator)
+            return CachingAllocator(self.num_pages, self.page_size)
         return kv_cache.PageAllocator(self.num_pages)
 
     def pages_for(self, n_tokens: int) -> int:

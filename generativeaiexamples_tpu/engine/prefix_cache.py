@@ -1,0 +1,199 @@
+"""Host-side prefix caching over the paged KV pool.
+
+The TPU-native counterpart of the shared-prompt KV reuse TRT-LLM performs
+inside the reference's NIM container (ref: the NIM serving layer,
+RAG/examples/local_deploy/docker-compose-nim-ms.yaml:2-28 — "paged
+attention" with prefix reuse): every RAG request re-sends the same chat
+template + system prompt, and repeated queries re-send the same retrieved
+chunks, so re-prefilling from token 0 wastes exactly the tokens the cache
+can skip.
+
+Design (page-granular, immutable, no copy-on-write):
+
+  * **Unit of sharing = one full physical page.** A page holds KV for a
+    page-aligned token span; its content is a pure function of the token
+    prefix up to its end (and the serving params/adapters), so a
+    **chain hash** ``h_i = H(h_{i-1}, tokens[i*ps:(i+1)*ps])`` identifies
+    it exactly. Only *fully covered* pages are ever shared: the page being
+    appended to by decode is always request-private, so shared pages are
+    immutable by construction and divergence needs no copy-on-write — a
+    diverging request simply stops matching the chain one page earlier.
+  * **Refcounts, not ownership.** Every allocated page carries a refcount
+    of live owners (one per request whose block-table row references it).
+    ``free`` decrements; a cached page at refcount 0 parks in an LRU of
+    *evictable* pages — still valid, resurrected by the next matching
+    admission — and is only reclaimed when the free list runs dry. A
+    never-inserted page at refcount 0 returns to the free list directly.
+  * **Write-before-share is dispatch-order.** The scheduler inserts a
+    page into the cache only after the dispatch that writes it has been
+    *issued*; the engine serializes dispatches on one device stream, so
+    any later admission's read executes after the write. (Insertion
+    happens at final-chunk dispatch for prompt pages and at
+    finish/preempt for generated-token pages — by then the writes have
+    not only been issued but fetched.)
+  * **Correct across resumes and turns.** KV for position t depends only
+    on tokens 0..t, so pages covering *generated* tokens hash and share
+    exactly like prompt pages — a preemption resume re-admits against its
+    own prior pages, and a multi-turn conversation's next request (whose
+    templated prompt embeds the previous turns verbatim) hits the pages
+    decode wrote.
+  * ``seed`` namespaces the chain (serving-params epoch / per-request
+    adapter id): KV depends on the weights that produced it, so requests
+    served under different adapters must never share pages.
+
+The scheduler caps how much of a match it uses (it must recompute at least
+the final token for logits, and keeps its chunk-bucket geometry inside the
+block-table row); the cache itself only answers "which pages hold this
+chain".
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict, deque
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+
+
+def chain_hashes(ids: Sequence[int], page_size: int, seed: int = 0
+                 ) -> List[bytes]:
+    """Chain hash per fully-covered page of ``ids``: h_i commits to every
+    token in pages 0..i, so equal h_i ⇔ equal token prefix. blake2b-128,
+    not Python's builtin hash: a collision would serve another request's
+    KV as this prompt's prefix, so the identity must hold against
+    adversarial prompts, not just accidental ones."""
+    out: List[bytes] = []
+    h = hashlib.blake2b(str(seed).encode(), digest_size=16).digest()
+    for i in range(len(ids) // page_size):
+        page = ids[i * page_size:(i + 1) * page_size]
+        buf = b"".join(int(t).to_bytes(4, "little", signed=True)
+                       for t in page)
+        h = hashlib.blake2b(h + buf, digest_size=16).digest()
+        out.append(h)
+    return out
+
+
+class CachingAllocator:
+    """Drop-in for :class:`kv_cache.PageAllocator` with prefix reuse.
+
+    API compatibility: ``alloc``/``free``/``available`` keep the free-list
+    semantics the scheduler already speaks (``free`` means "this owner is
+    done", not "scrub the page"). New surface: ``match`` + ``acquire`` for
+    admission-time reuse, ``insert`` to publish written pages.
+    """
+
+    def __init__(self, num_pages: int, page_size: int) -> None:
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._free: deque = deque(range(1, num_pages))
+        self._refs: Dict[int, int] = {}            # page -> live owners (>0)
+        self._hash_to_page: Dict[bytes, int] = {}
+        self._page_to_hash: Dict[int, bytes] = {}
+        self._lru: "OrderedDict[int, None]" = OrderedDict()  # ref==0, cached
+
+    # ------------------------------------------------------------ invariants
+
+    @property
+    def available(self) -> int:
+        """Pages an ``alloc`` could hand out right now (free + evictable)."""
+        return len(self._free) + len(self._lru)
+
+    @property
+    def cached_pages(self) -> int:
+        return len(self._hash_to_page)
+
+    def live_refs(self) -> int:
+        return sum(self._refs.values())
+
+    def can_serve(self, n: int, acquired: Sequence[int] = ()) -> bool:
+        """Could ``acquire(acquired)`` then ``alloc(n)`` succeed right now?
+        Acquiring an evictable page removes it from the LRU, so it stops
+        counting toward alloc headroom."""
+        in_lru = sum(1 for p in acquired if p in self._lru)
+        return len(self._free) + len(self._lru) - in_lru >= n
+
+    # ------------------------------------------------------------- alloc/free
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Pop n fresh pages (refcount 1 each), evicting LRU-cached pages
+        when the free list runs dry; None (and no change) if impossible."""
+        if n > len(self._free) + len(self._lru):
+            return None
+        out: List[int] = []
+        for _ in range(n):
+            if self._free:
+                p = self._free.popleft()
+            else:
+                p, _ = self._lru.popitem(last=False)   # oldest evictable
+                h = self._page_to_hash.pop(p)
+                del self._hash_to_page[h]
+                REGISTRY.counter("prefix_evictions").inc()
+            self._refs[p] = 1
+            out.append(p)
+        return out
+
+    def acquire(self, pages: Iterable[int]) -> None:
+        """Add an owner to each page (admission sharing a matched chain).
+        Atomic: validates every page before mutating, so a raise leaves no
+        half-taken refs for the caller's rescan path to leak."""
+        pages = list(pages)
+        for p in pages:
+            if self._refs.get(p, 0) == 0 and p not in self._lru:
+                raise ValueError(f"acquire of unallocated page {p}")
+        for p in pages:
+            r = self._refs.get(p, 0)
+            if r == 0:
+                del self._lru[p]
+            self._refs[p] = r + 1
+
+    def free(self, pages: Iterable[int]) -> None:
+        """Drop one owner per page; orphaned cached pages become evictable,
+        orphaned uncached pages return to the free list."""
+        for p in pages:
+            r = self._refs.get(p)
+            if r is None:
+                raise ValueError(f"freeing unowned page {p}")
+            if r > 1:
+                self._refs[p] = r - 1
+                continue
+            del self._refs[p]
+            if p in self._page_to_hash:
+                self._lru[p] = None
+                self._lru.move_to_end(p)
+            else:
+                self._free.append(p)
+
+    # ----------------------------------------------------------------- cache
+
+    def match(self, hashes: Sequence[int]) -> List[int]:
+        """Longest cached prefix of the chain → its pages (no ref taken;
+        call ``acquire`` on the slice actually used). A page matched here
+        can only disappear through ``alloc`` eviction, so acquire in the
+        same scheduler tick."""
+        pages: List[int] = []
+        for h in hashes:
+            p = self._hash_to_page.get(h)
+            if p is None:
+                break
+            pages.append(p)
+        return pages
+
+    def insert(self, hashes: Sequence[int], pages: Sequence[int]) -> None:
+        """Publish written pages under their chain hashes. Idempotent; a
+        hash already cached keeps its first page (the duplicate page stays
+        request-private and frees normally). Call only after the writing
+        dispatch has been issued."""
+        for h, p in zip(hashes, pages):
+            if h in self._hash_to_page:
+                continue
+            if p in self._page_to_hash:     # page re-used under a new chain
+                old = self._page_to_hash.pop(p)
+                self._hash_to_page.pop(old, None)
+            if self._refs.get(p, 0) == 0 and p not in self._lru:
+                raise ValueError(f"insert of unallocated page {p}")
+            self._hash_to_page[h] = p
+            self._page_to_hash[p] = h
+            REGISTRY.counter("prefix_inserted_pages").inc()

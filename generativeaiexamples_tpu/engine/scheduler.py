@@ -44,6 +44,7 @@ import numpy as np
 from generativeaiexamples_tpu.core.metrics import REGISTRY
 from generativeaiexamples_tpu.engine.engine import (
     DecodeState, EngineCore, unpack_decode_out)
+from generativeaiexamples_tpu.engine.prefix_cache import chain_hashes
 from generativeaiexamples_tpu.engine.tokenizer import IncrementalDetokenizer, Tokenizer
 
 logger = logging.getLogger(__name__)
@@ -113,6 +114,9 @@ class _Job:
     gen_ids: List[int] = field(default_factory=list)   # generated so far
     admit_seq: int = 0            # admission order (preemption picks max)
     bypass_count: int = 0         # times skipped over while at the head
+    shared: int = 0               # prefix-cache tokens skipped this admission
+    page_hashes: List[bytes] = field(default_factory=list)  # chain/full page
+    hashed_len: int = -1          # len(ids) the hashes were computed for
     prefill_started: float = 0.0  # wall clock of this prompt's first chunk
     # set when the fused final chunk has sampled this job's first token
     # on-device; resolved (and cleared) by whichever lands first — the
@@ -138,6 +142,11 @@ class Scheduler:
         self._slots: Dict[int, _Job] = {}        # decoding
         self._free: List[int] = list(range(core.batch))
         self._alloc = core.new_allocator()
+        # prefix caching (engine/prefix_cache.py): present iff the core's
+        # allocator speaks match/acquire/insert. seed namespaces the hash
+        # chain by the weights that produce KV (bumped per adapter set).
+        self._caching = hasattr(self._alloc, "match")
+        self._cache_seed = 0
         self._table = np.zeros((core.batch, core.max_pages_per_slot), np.int32)
         self._table_dev: Optional[jax.Array] = None
         self._inflight: Deque[tuple] = deque()   # dispatched, not yet synced
@@ -272,6 +281,10 @@ class Scheduler:
         if tail:
             job.request.out_queue.put(tail)
         job.request.out_queue.put(_STOP)
+        # decode-written pages join the prefix cache before release: a
+        # follow-up turn whose templated prompt embeds this conversation
+        # verbatim re-admits against them
+        self._cache_insert(job, with_generated=True)
         self._release(job)
         REGISTRY.counter("requests_completed").inc()
         REGISTRY.histogram("request_latency_s").observe(
@@ -292,6 +305,90 @@ class Scheduler:
     _ADMIT_SCAN = 32     # pending jobs considered per admission pass
     _BYPASS_MAX = 8      # admissions allowed past a page-blocked head
 
+    def _cap_shared(self, n: int, shared: int) -> int:
+        """Largest usable prefix-cache coverage for an n-token prompt.
+
+        Two geometry constraints cap a raw match: (a) at least the final
+        token must be recomputed (its logits seed the first sample), so
+        coverage stops at the last FULL page before position n-1; (b) the
+        chunk walk the prefill loop runs from ``shared`` must keep its
+        final padded bucket inside the block-table row — page-aligned (but
+        not chunk-aligned) starts can push the last bucket past max_seq,
+        whose clamped page slice would corrupt earlier pages. (b) is
+        re-established by stepping coverage down a page at a time; at any
+        chunk-multiple it holds by the max_seq %% chunk == 0 invariant."""
+        ps = self.core.page_size
+        chunk = self.core.chunk
+        row_tokens = self.core.max_pages_per_slot * ps
+        shared = min(shared, ((n - 1) // ps) * ps)
+        while shared > 0:
+            start = shared
+            while n - start > chunk:
+                start += chunk
+            bucket = next(b for b in self.core.buckets if (n - start) <= b)
+            if start + bucket <= row_tokens:
+                break
+            shared -= ps
+        return max(shared, 0)
+
+    def _plan_admission(self, job: _Job):
+        """(fresh_pages_needed, shared_tokens, hit_pages) for admitting the
+        job now. Long prompts that qualify for the sequence-parallel
+        prefill pass skip reuse unless the cache covers most of the prompt
+        — one ring pass beats re-chunking a nearly-uncovered prompt."""
+        n = len(job.ids)
+        if not self._caching:
+            return self.core.pages_for(n), 0, []
+        if job.hashed_len != n:
+            job.page_hashes = chain_hashes(job.ids, self.core.page_size,
+                                           seed=self._cache_seed)
+            job.hashed_len = n
+        hits = self._alloc.match(job.page_hashes)
+        shared = self._cap_shared(n, len(hits) * self.core.page_size)
+        if (shared and job.request.grammar is None
+                and self.core.cfg.long_prefill != "off"
+                and self.core.supports_long_prefill
+                and n - shared > 4 * self.core.chunk):
+            shared = 0
+        hits = hits[: shared // self.core.page_size]
+        return self.core.pages_for(n) - len(hits), shared, hits
+
+    def _can_alloc(self, need: int, hits) -> bool:
+        if self._caching:
+            return self._alloc.can_serve(need, hits)
+        return self._alloc.available >= need
+
+    def _cache_insert(self, job: _Job, with_generated: bool = False) -> None:
+        """Publish the job's fully-written pages to the prefix cache. Call
+        only once the writing dispatches have been ISSUED (the driver
+        thread's in-order stream makes any later reader safe): at
+        final-chunk dispatch for prompt pages; at finish/preempt also the
+        decode-written pages (minus the last generated token, whose KV may
+        never have been fed back)."""
+        if not self._caching or job.slot < 0 or not job.pages:
+            return
+        ids = job.ids
+        if with_generated:
+            if job.prefilled < len(job.ids):
+                # preempted mid-prefill: only the chunks already dispatched
+                # have content; pages past them are garbage
+                ids = job.ids[:job.prefilled]
+            else:
+                # decoding: every generated token except the last has been
+                # fed back (its KV write dispatched)
+                ids = list(job.request.prompt_ids) + list(job.gen_ids)
+                if job.gen_ids:
+                    ids = ids[:-1]
+                if (len(ids) // self.core.page_size
+                        > len(job.ids) // self.core.page_size):
+                    job.page_hashes = chain_hashes(
+                        ids, self.core.page_size, seed=self._cache_seed)
+                    job.hashed_len = -1   # differs from ids: force recompute
+        n_full = min(len(ids) // self.core.page_size, len(job.pages),
+                     len(job.page_hashes))
+        if n_full > 0:
+            self._alloc.insert(job.page_hashes[:n_full], job.pages[:n_full])
+
     def _admit(self) -> None:
         """Move pending jobs into the prefilling set while slots+pages last.
 
@@ -311,6 +408,7 @@ class Scheduler:
                 return
             chosen: Optional[_Job] = None
             oversized: Optional[_Job] = None
+            plan = None
             head = cands[0]
             for pos, job in enumerate(cands):
                 n = len(job.ids)
@@ -319,15 +417,16 @@ class Scheduler:
                         or need > self.core.num_pages - 1):
                     oversized = job
                     break
+                need, shared, hits = self._plan_admission(job)
                 if pos == 0:
-                    if self._alloc.available >= need:
-                        chosen = job
+                    if self._can_alloc(need, hits):
+                        chosen, plan = job, (need, shared, hits)
                         break
                     if head.bypass_count >= self._BYPASS_MAX:
                         return   # head's turn is overdue: strict FIFO now
                 elif (len(self._free) >= 2
-                        and self._alloc.available >= need):
-                    chosen = job
+                        and self._can_alloc(need, hits)):
+                    chosen, plan = job, (need, shared, hits)
                     head.bypass_count += 1
                     REGISTRY.counter("admission_skips").inc()
                     break
@@ -362,9 +461,18 @@ class Scheduler:
             if chosen is None:
                 return  # head waits for pages; no admissible surplus job
             job = chosen
-            pages = self._alloc.alloc(self.core.pages_for(len(job.ids)))
-            if pages is None:
+            need, shared, hits = plan
+            if hits:
+                try:
+                    self._alloc.acquire(hits)
+                except ValueError:
+                    continue   # matched pages evicted mid-pass; rescan
+            fresh = self._alloc.alloc(need)
+            if fresh is None:
+                if hits:
+                    self._alloc.free(hits)
                 return   # lost the surplus since the scan; retry next tick
+            pages = list(hits) + fresh
             with self._lock:
                 try:
                     self._pending.remove(job)
@@ -374,8 +482,13 @@ class Scheduler:
             slot = self._free.pop()
             job.slot = slot
             job.pages = pages
-            job.prefilled = 0
-            job.total_len = 0
+            job.prefilled = shared
+            job.total_len = shared
+            job.shared = shared
+            if self._caching:
+                if shared:
+                    REGISTRY.counter("prefix_hit_tokens").inc(shared)
+                REGISTRY.counter("prefix_prompt_tokens").inc(len(job.ids))
             if job.admit_seq == 0:
                 # resumes keep their original admission age, so preemption
                 # (youngest-first) cannot thrash an old request forever
@@ -431,6 +544,7 @@ class Scheduler:
                 top_p=req.top_p)
             job.prefilled = len(job.ids)
             job.total_len = job.prefilled
+            self._cache_insert(job)
             self._mark_first_pending(job, tok)
             self._slots[job.slot] = job
             return 1
@@ -448,7 +562,7 @@ class Scheduler:
                 break
             req = job.request
             start = job.prefilled
-            if start == 0:
+            if start == job.shared:
                 job.prefill_started = time.perf_counter()
             while len(items) < budget and start < len(job.ids):
                 chunk_ids = job.ids[start:start + self.core.chunk]
@@ -474,6 +588,8 @@ class Scheduler:
         self._state, _toks = self.core.prefill_group(self._state, items)
         for job in finals:
             self._prefilling.remove(job)
+            # prompt pages are now fully write-dispatched: publish them
+            self._cache_insert(job)
             self._mark_first_pending(job, None)
             self._slots[job.slot] = job
         return len(items)
@@ -640,6 +756,9 @@ class Scheduler:
         else:
             self._prefilling.remove(job)
         self._state = self.core.release(self._state, job.slot)
+        # cache what this slot already computed: the resume's re-prefill
+        # re-admits against these pages instead of recomputing from token 0
+        self._cache_insert(job, with_generated=True)
         self._release(job)
         job.ids = list(job.request.prompt_ids) + list(job.gen_ids)
         job.prefilled = 0

@@ -1,0 +1,240 @@
+"""Prefix caching: allocator semantics + end-to-end shared-prompt reuse.
+
+The capability TRT-LLM provides inside the reference's NIM container
+(prefix/KV reuse across requests; ref docker-compose-nim-ms.yaml:2-28)
+lives in-tree in engine/prefix_cache.py + the scheduler's admission
+planner. These tests pin:
+
+  * CachingAllocator bookkeeping: refcounts, LRU eviction order,
+    acquire/free conservation, insert idempotence.
+  * chain_hashes identity: equal prefixes alias, divergent pages don't,
+    seeds (adapter namespaces) never collide chains.
+  * End-to-end: a repeated prompt skips its full-page prefix (hit counters
+    rise) and still streams byte-identical text; divergent prompts sharing
+    a prefix stay independent; eviction under a tiny pool keeps outputs
+    exact; the coverage cap leaves the final token for logits.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.core.config import EngineConfig
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.engine.engine import EngineCore
+from generativeaiexamples_tpu.engine.prefix_cache import (
+    CachingAllocator, chain_hashes)
+from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
+from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+from generativeaiexamples_tpu.models import llama
+
+
+# ------------------------------------------------------------- chain hashes
+
+def test_chain_hashes_identity_and_divergence():
+    ids_a = list(range(40))
+    ids_b = list(range(40))
+    ids_b[12] = 999                       # diverge inside page 1
+    ha = chain_hashes(ids_a, 8)
+    hb = chain_hashes(ids_b, 8)
+    assert len(ha) == 5                   # full pages only
+    assert ha[0] == hb[0]                 # page 0 identical
+    assert ha[1] != hb[1]                 # divergence point
+    assert ha[2] != hb[2]                 # chained: stays diverged
+    assert chain_hashes(ids_a, 8) == ha   # deterministic
+    assert chain_hashes(ids_a[:39], 8) == ha[:4]  # partial page dropped
+
+
+def test_chain_hashes_seed_namespacing():
+    ids = list(range(16))
+    assert chain_hashes(ids, 8, seed=0) != chain_hashes(ids, 8, seed=1)
+
+
+# ---------------------------------------------------------------- allocator
+
+def test_allocator_refcount_and_lru_eviction():
+    a = CachingAllocator(num_pages=6, page_size=8)   # usable pages 1..5
+    p = a.alloc(3)
+    assert p is not None and len(p) == 3
+    assert a.available == 2
+    h = [101, 102, 103]
+    a.insert(h, p)
+    a.free(p)                             # cached → evictable, not free
+    assert a.available == 5               # all reclaimable
+    assert a.cached_pages == 3
+    # match + acquire resurrects from the LRU
+    assert a.match(h) == p
+    assert a.match([101, 999]) == p[:1]   # chain stops at first miss
+    a.acquire(p[:2])
+    assert a.available == 3               # two pages pinned again
+    # eviction takes the OLDEST unreferenced cached page first (p[2])
+    q = a.alloc(3)
+    assert set(q) & set(p) == {p[2]}
+    assert a.cached_pages == 2
+    a.free(q)
+    a.free(p[:2])
+    assert a.available == 5
+    assert a.live_refs() == 0
+
+
+def test_allocator_can_serve_accounts_for_acquired_lru_pages():
+    a = CachingAllocator(num_pages=4, page_size=8)   # usable 1..3
+    p = a.alloc(3)
+    a.insert([1, 2, 3], p)
+    a.free(p)
+    # all three pages are evictable; acquiring two leaves one for alloc
+    assert a.can_serve(1, p[:2])
+    assert not a.can_serve(2, p[:2])
+    a.acquire(p[:2])
+    assert a.alloc(2) is None             # and alloc agrees
+    got = a.alloc(1)
+    assert got == [p[2]]
+    a.free(got)
+    a.free(p[:2])
+
+
+def test_allocator_insert_idempotent_and_rebind():
+    a = CachingAllocator(num_pages=5, page_size=8)
+    p = a.alloc(2)
+    a.insert([7], [p[0]])
+    a.insert([7], [p[1]])                 # duplicate hash: first wins
+    assert a.match([7]) == [p[0]]
+    a.insert([8], [p[0]])                 # page rebound to a new chain
+    assert a.match([7]) == []
+    assert a.match([8]) == [p[0]]
+    a.free(p)
+
+
+def test_allocator_guards():
+    a = CachingAllocator(num_pages=4, page_size=8)
+    p = a.alloc(1)
+    with pytest.raises(ValueError):
+        a.free([p[0], p[0]])              # double free
+    with pytest.raises(ValueError):
+        a.acquire([3])                    # never allocated
+    with pytest.raises(ValueError):
+        CachingAllocator(num_pages=1, page_size=8)
+
+
+# ------------------------------------------------------------- end to end
+
+@pytest.fixture(scope="module")
+def served():
+    cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    params = llama.init_params(jax.random.PRNGKey(5), cfg)
+    tok = ByteTokenizer()
+    return cfg, params, tok
+
+
+def _core(served, **kw):
+    cfg, params, tok = served
+    ecfg = EngineConfig(max_batch_size=4, max_seq_len=128, page_size=8,
+                        prefill_chunk=16, **kw)
+    return EngineCore(cfg, ecfg, params, eos_id=tok.eos_id)
+
+
+def _run_all(sched, reqs):
+    for r in reqs:
+        sched.submit(r)
+    while sched._tick():
+        pass
+    out = []
+    for r in reqs:
+        assert r.error is None, r.error
+        parts = []
+        while not r.out_queue.empty():
+            item = r.out_queue.get_nowait()
+            if isinstance(item, str):
+                parts.append(item)
+        out.append("".join(parts))
+    return out
+
+
+def test_repeat_prompt_hits_cache_and_matches(served):
+    cfg, params, tok = served
+    core = _core(served)
+    sched = Scheduler(core, tok)
+    assert sched._caching
+    prompt = tok.encode("system: you are a helpful assistant. user: hello "
+                        "there, what is the answer?", add_bos=True)
+    assert len(prompt) > 3 * core.page_size
+    hit0 = REGISTRY.counter("prefix_hit_tokens").value
+    first = _run_all(sched, [Request(prompt_ids=list(prompt), max_tokens=8,
+                                     temperature=0.0)])[0]
+    assert REGISTRY.counter("prefix_hit_tokens").value == hit0  # cold
+    second = _run_all(sched, [Request(prompt_ids=list(prompt), max_tokens=8,
+                                      temperature=0.0)])[0]
+    hits = REGISTRY.counter("prefix_hit_tokens").value - hit0
+    # coverage: every full page except (at most) the one holding the final
+    # token; at least one chunk of prefill was skipped
+    assert hits >= core.page_size
+    assert hits % core.page_size == 0
+    assert second == first
+
+
+def test_divergent_prompts_share_prefix_but_not_output(served):
+    cfg, params, tok = served
+    shared = "common preamble shared by both requests padding padding. "
+    pa = tok.encode(shared + "question A?", add_bos=True)
+    pb = tok.encode(shared + "question B, a different one?", add_bos=True)
+
+    # oracle: each prompt served by a FRESH engine with caching off
+    def solo(p):
+        core = _core(served, prefix_cache="off")
+        sched = Scheduler(core, tok)
+        assert not sched._caching
+        return _run_all(sched, [Request(prompt_ids=list(p), max_tokens=8,
+                                        temperature=0.0)])[0]
+
+    want_a, want_b = solo(pa), solo(pb)
+    core = _core(served)
+    sched = Scheduler(core, tok)
+    got_a = _run_all(sched, [Request(prompt_ids=list(pa), max_tokens=8,
+                                     temperature=0.0)])[0]
+    hit0 = REGISTRY.counter("prefix_hit_tokens").value
+    got_b = _run_all(sched, [Request(prompt_ids=list(pb), max_tokens=8,
+                                     temperature=0.0)])[0]
+    assert REGISTRY.counter("prefix_hit_tokens").value > hit0  # prefix shared
+    assert (got_a, got_b) == (want_a, want_b)
+
+
+def test_eviction_under_page_pressure_stays_exact(served):
+    cfg, params, tok = served
+    prompts = [tok.encode(f"request number {i} with some padding text to "
+                          f"cross pages....", add_bos=True) for i in range(6)]
+
+    def run(**kw):
+        core = _core(served, **kw)
+        sched = Scheduler(core, tok)
+        return [_run_all(sched, [Request(prompt_ids=list(p), max_tokens=6,
+                                         temperature=0.0)])[0]
+                for p in prompts + prompts]   # repeats: hit-after-evict mix
+
+    ev0 = REGISTRY.counter("prefix_evictions").value
+    tight = run(num_pages=24)   # not enough for 12 prompts' pages: evicts
+    assert REGISTRY.counter("prefix_evictions").value > ev0
+    roomy = run(prefix_cache="off")
+    assert tight == roomy
+
+
+def test_cap_shared_geometry(served):
+    cfg, params, tok = served
+    core = _core(served)
+    sched = Scheduler(core, tok)
+    ps, chunk = core.page_size, core.chunk          # 8, 16
+    row = core.max_pages_per_slot * ps              # 128
+    # always leaves the final token uncovered
+    assert sched._cap_shared(ps, ps) == 0
+    assert sched._cap_shared(ps + 1, ps) == ps
+    assert sched._cap_shared(64, 64) == 56
+    # page-aligned (not chunk-aligned) start whose final bucket would
+    # overflow the block-table row steps down to a safe boundary
+    n = row - 2                                     # 126
+    for raw in range(0, n, ps):
+        shared = sched._cap_shared(n, raw)
+        assert shared <= raw and shared % ps == 0
+        start = shared
+        while n - start > chunk:
+            start += chunk
+        bucket = next(b for b in core.buckets if (n - start) <= b)
+        assert start + bucket <= row

@@ -95,7 +95,7 @@ class FakeCore:
     """Pure-numpy stand-in for EngineCore with REAL paged-read semantics."""
 
     def __init__(self, batch=4, max_seq=64, page_size=8, num_pages=0,
-                 chunk=16, steps=4, steps_max=0, group=4):
+                 chunk=16, steps=4, steps_max=0, group=4, prefix_cache=False):
         self.batch, self.max_seq = batch, max_seq
         self.page_size, self.chunk = page_size, chunk
         self.max_pages_per_slot = -(-max_seq // page_size)
@@ -103,11 +103,19 @@ class FakeCore:
         self.eos_id = EOS
         self.donates_state = False
         self.supports_long_prefill = False
+        self.prefix_cache = prefix_cache
         self.cfg = SimpleNamespace(
             decode_steps_per_dispatch=steps, decode_steps_max=steps_max,
             prefill_group=group, long_prefill="off", prefill_hold_chunks=8,
             pipeline_depth=2)
         self.group_buckets = (1, 2, 4)
+        # final-chunk bucket ladder (the prefix-cache coverage cap reads it)
+        buckets, b = [], page_size
+        while b < chunk:
+            buckets.append(b)
+            b *= 2
+        buckets.append(chunk)
+        self.buckets = tuple(buckets)
 
     def init_state(self) -> _FakeState:
         B = self.batch
@@ -117,7 +125,15 @@ class FakeCore:
             active=np.zeros((B,), bool), generated=np.zeros((B,), np.int32),
             max_gen=np.zeros((B,), np.int32))
 
-    def new_allocator(self) -> PageAllocator:
+    def new_allocator(self):
+        """Caching episodes run the REAL CachingAllocator against the fake
+        paged pool: a page shared wrongly (content not actually the matched
+        prefix) or evicted while referenced corrupts a stream's context sum
+        and diverges from the solo oracle."""
+        if self.prefix_cache:
+            from generativeaiexamples_tpu.engine.prefix_cache import (
+                CachingAllocator)
+            return CachingAllocator(self.num_pages, self.page_size)
         return PageAllocator(self.num_pages)
 
     def pages_for(self, n: int) -> int:
@@ -203,10 +219,14 @@ class FakeCore:
 
 @dataclass(frozen=True)
 class _Spec:
-    """One request's workload parameters."""
+    """One request's workload parameters. ``family`` picks the prompt
+    content stream: same-family prompts share their full common-length
+    prefix (the prefix-cache sharing workload), different families diverge
+    from token 0."""
     prompt_len: int
     max_tokens: int
     arrival_tick: int
+    family: int = 0
 
 
 def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict) -> Optional[str]:
@@ -228,7 +248,8 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict) -> Optional[str]:
     try:
         reqs = []
         for sp in specs:
-            prompt = [32 + (i * 11) % 150 for i in range(sp.prompt_len)]
+            prompt = [32 + (i * 11 + sp.family * 7) % 150
+                      for i in range(sp.prompt_len)]
             reqs.append((Request(prompt_ids=prompt, max_tokens=sp.max_tokens,
                                  temperature=0.0), sp))
         pending = sorted(range(len(reqs)), key=lambda i: reqs[i][1].arrival_tick)
@@ -279,10 +300,13 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict) -> Optional[str]:
             if req.completion_tokens != len(want):
                 return (f"req {i}: completion_tokens={req.completion_tokens} "
                         f"want {len(want)}")
-        # conservation: all pages and slots returned
+        # conservation: all pages and slots returned (cached evictable
+        # pages count — they are reclaimable on demand)
         if sched._alloc.available != core.num_pages - 1:
             return (f"page leak: {sched._alloc.available} free of "
                     f"{core.num_pages - 1}")
+        if core.prefix_cache and sched._alloc.live_refs() != 0:
+            return f"dangling page refs: {sched._alloc.live_refs()}"
         if sorted(sched._free) != list(range(core.batch)):
             return f"slot leak: free={sorted(sched._free)}"
         if sched._slots or sched._prefilling or sched._pending:
@@ -307,7 +331,8 @@ def _gen_specs(rng: np.random.RandomState, core_kw: Dict) -> List[_Spec]:
             plen = int(rng.randint(1, max_seq - 2))
         specs.append(_Spec(prompt_len=plen,
                            max_tokens=int(rng.randint(1, 24)),
-                           arrival_tick=int(rng.randint(0, 12))))
+                           arrival_tick=int(rng.randint(0, 12)),
+                           family=int(rng.randint(0, 3))))
     return specs
 
 
@@ -321,7 +346,8 @@ def _core_kw(rng: np.random.RandomState) -> Dict:
         chunk=16,
         steps=int(rng.choice([2, 4])),
         steps_max=int(rng.choice([0, 8])),
-        group=int(rng.choice([1, 2, 4])))
+        group=int(rng.choice([1, 2, 4])),
+        prefix_cache=bool(rng.rand() < 0.5))
 
 
 def _shrink(seed: int, specs: List[_Spec], core_kw: Dict, err: str) -> str:
