@@ -36,6 +36,7 @@ def _sampling(llm_settings: Dict[str, Any]) -> Dict[str, Any]:
         "max_tokens": int(llm_settings.get("max_tokens", 256)),
         "temperature": float(llm_settings.get("temperature", 0.2)),
         "top_p": float(llm_settings.get("top_p", 0.7)),
+        "stop": list(llm_settings.get("stop") or []),
     }
 
 

@@ -27,13 +27,14 @@ class LocalLLM:
 
     def chat(self, messages: Sequence[Dict[str, str]], max_tokens: int = 256,
              temperature: float = 0.7, top_p: float = 1.0,
-             top_k: int = 0, grammar=None) -> Iterator[str]:
+             top_k: int = 0, grammar=None,
+             stop: Optional[Sequence[str]] = None) -> Iterator[str]:
         from generativeaiexamples_tpu.engine.scheduler import Request
 
         prompt_ids = self.scheduler.tokenizer.apply_chat_template(list(messages))
         req = Request(prompt_ids=prompt_ids, max_tokens=max_tokens,
                       temperature=temperature, top_p=top_p, top_k=top_k,
-                      grammar=grammar)
+                      grammar=grammar, stop=list(stop or []))
         self.scheduler.submit(req)
         yield from self.scheduler.iter_text(req)
         # the scheduler rejects e.g. over-capacity prompts per-request
@@ -87,12 +88,15 @@ class RemoteLLM:
 
     def chat(self, messages: Sequence[Dict[str, str]], max_tokens: int = 256,
              temperature: float = 0.7, top_p: float = 1.0,
-             top_k: int = 0) -> Iterator[str]:
+             top_k: int = 0,
+             stop: Optional[Sequence[str]] = None) -> Iterator[str]:
         import httpx
 
         payload = {"model": self.model, "messages": list(messages),
                    "max_tokens": max_tokens, "temperature": temperature,
                    "top_p": top_p, "stream": True}
+        if stop:
+            payload["stop"] = list(stop)
         with httpx.stream("POST", f"{self.base_url}/v1/chat/completions",
                           json=payload, timeout=120.0) as resp:
             for line in resp.iter_lines():

@@ -52,8 +52,16 @@ from generativeaiexamples_tpu.models import llama
 from generativeaiexamples_tpu.ops.sampling import sample_logits_dynamic
 
 
-# order of the (5, steps, B) int32 "packed" decode output block
-_PACKED_FIELDS = ("sampled", "emitted", "done", "hit_eos", "input_tokens")
+# order of the (R, steps, B) int32 "packed" decode output block; _LP_FIELDS
+# rows carry float32 bits (bitcast, not cast) — unpack_decode_out restores
+# them to float arrays on the host
+_PACKED_FIELDS = ("sampled", "emitted", "done", "hit_eos", "input_tokens",
+                  "sampled_lp", "input_lp")
+_LP_FIELDS = frozenset({"sampled_lp", "input_lp"})
+# top-logprobs rows appended past the base block: TOP_LP ids then TOP_LP
+# bitcast logprobs (the OpenAI `top_logprobs` surface; 5 matches what
+# grading flows read, and one static K keeps the compile-variant count at 2)
+TOP_LP = 5
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,11 +86,29 @@ class PrefillItem:
     top_k: int = 0
     top_p: float = 1.0
     gram_state: int = 0
+    seed: int = 0                 # per-request sampling seed (PRNGKey base)
 
 
 def unpack_decode_out(packed) -> Dict[str, Any]:
-    """Split a host-fetched ``out["packed"]`` block back into named arrays."""
-    return {k: packed[i] for i, k in enumerate(_PACKED_FIELDS)}
+    """Split a host-fetched ``out["packed"]`` block back into named arrays.
+    Logprob rows are restored from their int32 bit patterns to float32;
+    trailing rows (present when the dispatch ran with top-logprobs) become
+    ``top_ids``/``top_lps`` of shape (TOP_LP, steps, B)."""
+    out = {k: packed[i] for i, k in enumerate(_PACKED_FIELDS)}
+    for k in _LP_FIELDS:
+        out[k] = np.ascontiguousarray(out[k]).view(np.float32)
+    base = len(_PACKED_FIELDS)
+    if packed.shape[0] > base:
+        out["top_ids"] = packed[base:base + TOP_LP]
+        out["top_lps"] = np.ascontiguousarray(
+            packed[base + TOP_LP:base + 2 * TOP_LP]).view(np.float32)
+    return out
+
+
+def bits_to_f32(x: int) -> float:
+    """Host-side scalar int32-bits → float32 (the batched first-token fetch
+    carries last_logprob bitcast alongside the token ids)."""
+    return float(np.int32(x).view(np.float32))
 
 
 @jax.tree_util.register_pytree_node_class
@@ -98,13 +124,18 @@ class DecodeState:
     temperature: jnp.ndarray  # (B,) f32
     top_k: jnp.ndarray        # (B,) i32
     top_p: jnp.ndarray        # (B,) f32
-    rng: jnp.ndarray          # PRNG key
+    # (B, 2) uint32 — PER-SLOT raw threefry keys (the request's seed), so a
+    # seeded request replays its exact token sequence regardless of batch
+    # composition or scheduler interleaving; the sampling key for generated
+    # token i is fold_in(rngs[b], i)
+    rngs: jnp.ndarray
     gram_state: jnp.ndarray   # (B,) i32 — flat DFA state; 0 = unconstrained
+    last_logprob: jnp.ndarray  # (B,) f32 — model logprob of tokens[b]
 
     def tree_flatten(self):
         return ((self.cache, self.tokens, self.active, self.generated,
                  self.max_gen, self.temperature, self.top_k, self.top_p,
-                 self.rng, self.gram_state), None)
+                 self.rngs, self.gram_state, self.last_logprob), None)
 
     @classmethod
     def tree_unflatten(cls, _, c):
@@ -247,7 +278,7 @@ class EngineCore:
         self.group_buckets = tuple(gb)
         self._chunk_fn = jax.jit(self._chunk_impl, donate_argnums=dn)
         self._group_fn = jax.jit(self._group_impl, donate_argnums=dn,
-                                 static_argnums=(21,))
+                                 static_argnums=(22,))
         # constrained-decoding grammar registry: up to GRAM_SLOTS byte-DFAs
         # live in one flat device table; flat state g*GRAM_STATES+s, flat
         # state 0 = the shared reject sink (engine/grammar.py). Built lazily
@@ -266,7 +297,7 @@ class EngineCore:
         self._chunk_last_fn = jax.jit(self._chunk_last_impl,
                                       donate_argnums=dn)
         self._decode_fn = jax.jit(self._decode_impl, donate_argnums=dn,
-                                  static_argnums=(9, 10))
+                                  static_argnums=(9, 10, 11))
         self._activate_fn = jax.jit(self._activate_impl, donate_argnums=dn)
         self._release_fn = jax.jit(self._release_impl, donate_argnums=dn)
         self._sample_fn = jax.jit(self._sample_impl)
@@ -283,6 +314,7 @@ class EngineCore:
                                     aux_sharding=self._replicated,
                                     kv_quant=self.cfg.kv_quant,
                                     scale_sharding=self._scale_sharding)
+        del rng   # per-slot keys are seeded at activation, not globally
         state = DecodeState(
             cache=cache,
             tokens=jnp.zeros((B,), jnp.int32),
@@ -292,14 +324,15 @@ class EngineCore:
             temperature=jnp.ones((B,), jnp.float32),
             top_k=jnp.zeros((B,), jnp.int32),
             top_p=jnp.ones((B,), jnp.float32),
-            rng=rng if rng is not None else jax.random.PRNGKey(0),
+            rngs=jnp.zeros((B, 2), jnp.uint32),
             gram_state=jnp.zeros((B,), jnp.int32),
+            last_logprob=jnp.zeros((B,), jnp.float32),
         )
         if self.mesh is not None:
             rest = jax.device_put(
                 (state.tokens, state.active, state.generated, state.max_gen,
-                 state.temperature, state.top_k, state.top_p, state.rng,
-                 state.gram_state),
+                 state.temperature, state.top_k, state.top_p, state.rngs,
+                 state.gram_state, state.last_logprob),
                 self._replicated)
             state = DecodeState(cache, *rest)
         return state
@@ -417,8 +450,8 @@ class EngineCore:
 
     def prefill_long_last(self, state: DecodeState, prompt_ids, page_row,
                           slot: int, generated: int, max_gen: int,
-                          temperature: float, top_k: int, top_p: float
-                          ) -> Tuple[DecodeState, jax.Array]:
+                          temperature: float, top_k: int, top_p: float,
+                          seed: int = 0) -> Tuple[DecodeState, jax.Array]:
         """Whole-prompt sequence-parallel prefill FUSED with first-token
         sampling and slot activation (the scheduler's long-prompt
         admission path — same no-host-round-trip contract as
@@ -433,16 +466,18 @@ class EngineCore:
             state, self.params, self.adapters, toks,
             jnp.asarray(page_row, jnp.int32), jnp.int32(slot),
             jnp.int32(n), jnp.int32(generated), jnp.int32(max_gen),
-            jnp.float32(temperature), jnp.int32(top_k), jnp.float32(top_p))
+            jnp.float32(temperature), jnp.int32(top_k), jnp.float32(top_p),
+            jnp.int32(seed))
 
     def _prefill_long_last_impl(self, state: DecodeState, params, adapters,
                                 tokens, page_row, slot, n_tokens, generated,
-                                max_gen, temperature, top_k, top_p):
+                                max_gen, temperature, top_k, top_p, seed):
         logits, cache = kv_cache.prefill_seq_parallel(
             params, self.model_cfg, tokens, state.cache, page_row, slot,
             n_tokens, self.num_pages, self.mesh, adapters=adapters)
         return self._activate_sampled(state, cache, logits, slot, generated,
-                                      max_gen, temperature, top_k, top_p)
+                                      max_gen, temperature, top_k, top_p,
+                                      seed)
 
     def _sample_impl(self, logits, rng, temperature, top_k, top_p):
         return sample_logits_dynamic(rng, logits[None], temperature[None],
@@ -456,16 +491,22 @@ class EngineCore:
         return int(jax.device_get(tok))
 
     def _activate_sampled(self, state: DecodeState, cache, logits, slot,
-                          generated, max_gen, temperature, top_k, top_p
-                          ) -> Tuple[DecodeState, jnp.ndarray]:
+                          generated, max_gen, temperature, top_k, top_p,
+                          seed) -> Tuple[DecodeState, jnp.ndarray]:
         """Shared tail of the fused prefill programs: sample the first token
         from last-position logits and activate the slot, all on-device.
         An immediate eos or an exhausted budget leaves the slot inactive
         (the host resolves the outcome from the returned token at the next
-        decode sync)."""
-        rng, sub = jax.random.split(state.rng)
-        tok = sample_logits_dynamic(sub, logits, temperature[None],
-                                    top_k[None], top_p[None])[0]
+        decode sync). ``seed`` becomes the slot's PRNG base key; the fused
+        token samples under fold_in(key, generated-1), continuing the
+        request's deterministic stream across preemption resumes."""
+        from generativeaiexamples_tpu.ops.sampling import (
+            sample_logits_per_slot, token_logprob)
+        base = jax.random.PRNGKey(seed)
+        sub = jax.random.fold_in(base, generated - 1)
+        tok = sample_logits_per_slot(sub[None], logits, temperature[None],
+                                     top_k[None], top_p[None])[0]
+        lp = token_logprob(logits, tok[None])[0]
         alive = (tok != self.eos_id) & (generated < max_gen)
         upd = lambda arr, val: arr.at[slot].set(val)
         new_state = dataclasses.replace(
@@ -478,17 +519,18 @@ class EngineCore:
             temperature=upd(state.temperature, temperature),
             top_k=upd(state.top_k, top_k),
             top_p=upd(state.top_p, top_p),
-            rng=rng,
+            rngs=upd(state.rngs, base),
             # activation always clears the DFA state: a slot vacated by a
             # grammared request must not leak its grammar onto the next
             # occupant (this path — single/long prefill — is unconstrained)
             gram_state=upd(state.gram_state, jnp.int32(0)),
+            last_logprob=upd(state.last_logprob, lp),
         )
         return new_state, tok
 
     def _chunk_last_impl(self, state: DecodeState, params, adapters, tokens,
                          page_row, slot, start_pos, chunk_len, generated,
-                         max_gen, temperature, top_k, top_p
+                         max_gen, temperature, top_k, top_p, seed
                          ) -> Tuple[DecodeState, jnp.ndarray]:
         """Final chunk fused with first-token sampling and slot activation —
         admission never blocks on a host round-trip; the first token's value
@@ -498,12 +540,14 @@ class EngineCore:
             start_pos, chunk_len, self.num_pages, adapters=adapters,
             mesh=self.mesh)
         return self._activate_sampled(state, cache, logits, slot, generated,
-                                      max_gen, temperature, top_k, top_p)
+                                      max_gen, temperature, top_k, top_p,
+                                      seed)
 
     def prefill_chunk_last(self, state: DecodeState, chunk_ids, page_row,
                            slot: int, start_pos: int, generated: int,
                            max_gen: int, temperature: float, top_k: int,
-                           top_p: float) -> Tuple[DecodeState, jax.Array]:
+                           top_p: float, seed: int = 0
+                           ) -> Tuple[DecodeState, jax.Array]:
         """Final-chunk host wrapper: returns (state, first-token device
         scalar). ``generated`` counts tokens produced including this one."""
         n = len(chunk_ids)
@@ -515,7 +559,7 @@ class EngineCore:
             jnp.asarray(page_row, jnp.int32), jnp.int32(slot),
             jnp.int32(start_pos), jnp.int32(n), jnp.int32(generated),
             jnp.int32(max_gen), jnp.float32(temperature), jnp.int32(top_k),
-            jnp.float32(top_p))
+            jnp.float32(top_p), jnp.int32(seed))
 
     # ------------------------------------------------------- grouped prefill
 
@@ -528,7 +572,7 @@ class EngineCore:
     def _group_impl(self, state: DecodeState, params, adapters, tokens,
                     page_rows, slots, len_slots, start_pos, chunk_len,
                     is_last, generated, max_gen, temperature, top_k, top_p,
-                    gram_states, gram_table, gram_accept, gram_dist,
+                    seeds, gram_states, gram_table, gram_accept, gram_dist,
                     tok_bytes, tok_lens, use_grammar: bool
                     ) -> Tuple[DecodeState, jnp.ndarray]:
         """G chunks in ONE dispatch; ``is_last`` rows additionally run the
@@ -539,18 +583,24 @@ class EngineCore:
         With ``use_grammar`` (static), the fused first token samples under
         each row's DFA state and the advanced state is scattered into
         DecodeState.gram_state — constrained decoding from token one."""
+        from generativeaiexamples_tpu.ops.sampling import (
+            sample_logits_per_slot, token_logprob)
         logits, cache = kv_cache.prefill_chunks(
             params, self.model_cfg, tokens, state.cache, page_rows,
             len_slots, start_pos, chunk_len, self.num_pages,
             adapters=adapters, mesh=self.mesh)
-        rng, sub = jax.random.split(state.rng)
+        raw = logits   # pre-mask: logprobs report the model distribution
         if use_grammar:
             from generativeaiexamples_tpu.ops.sampling import (
                 grammar_advance, grammar_mask)
             logits = grammar_mask(logits, gram_states, max_gen - generated,
                                   self.eos_id, gram_table, gram_accept,
                                   gram_dist, tok_bytes, tok_lens)
-        toks = sample_logits_dynamic(sub, logits, temperature, top_k, top_p)
+        bases = jax.vmap(jax.random.PRNGKey)(seeds)           # (G, 2)
+        subs = jax.vmap(jax.random.fold_in)(bases, generated - 1)
+        toks = sample_logits_per_slot(subs, logits, temperature, top_k,
+                                      top_p)
+        lps = token_logprob(raw, toks)
         alive = is_last & (toks != self.eos_id) & (generated < max_gen)
         # mid-chunk rows must not disturb slot state: retarget their
         # scatters out of range so they drop alongside the padding rows
@@ -566,7 +616,8 @@ class EngineCore:
             temperature=upd(state.temperature, temperature),
             top_k=upd(state.top_k, top_k),
             top_p=upd(state.top_p, top_p),
-            rng=rng,
+            rngs=upd(state.rngs, bases),
+            last_logprob=upd(state.last_logprob, lps),
         )
         if use_grammar:
             nxt = grammar_advance(gram_states, toks, gram_table, tok_bytes,
@@ -601,6 +652,7 @@ class EngineCore:
         temperature = np.ones((G,), np.float32)
         top_k = np.zeros((G,), np.int32)
         top_p = np.ones((G,), np.float32)
+        seeds = np.zeros((G,), np.int32)
         for i, it in enumerate(items):
             n = len(it.chunk_ids)
             if n > C:
@@ -617,6 +669,7 @@ class EngineCore:
             temperature[i] = it.temperature
             top_k[i] = it.top_k
             top_p[i] = it.top_p
+            seeds[i] = it.seed
         # lengths-scatter dedup: only a slot's highest-start_pos row keeps
         # its true id (duplicate-index scatters are nondeterministic)
         len_slots = slots.copy()
@@ -637,7 +690,8 @@ class EngineCore:
             jnp.asarray(chunk_len), jnp.asarray(is_last),
             jnp.asarray(generated), jnp.asarray(max_gen),
             jnp.asarray(temperature), jnp.asarray(top_k),
-            jnp.asarray(top_p), jnp.asarray(gram_states),
+            jnp.asarray(top_p), jnp.asarray(seeds),
+            jnp.asarray(gram_states),
             *self._gram_args(use_grammar), use_grammar)
 
     # -------------------------------------------- constrained decoding (DFA)
@@ -798,7 +852,8 @@ class EngineCore:
     # --------------------------------------------------------- slot lifecycle
 
     def _activate_impl(self, state: DecodeState, slot, token, generated,
-                       max_gen, temperature, top_k, top_p) -> DecodeState:
+                       max_gen, temperature, top_k, top_p, seed
+                       ) -> DecodeState:
         upd = lambda arr, val: arr.at[slot].set(val)
         return dataclasses.replace(
             state,
@@ -809,18 +864,20 @@ class EngineCore:
             temperature=upd(state.temperature, temperature),
             top_k=upd(state.top_k, top_k),
             top_p=upd(state.top_p, top_p),
+            rngs=upd(state.rngs, jax.random.PRNGKey(seed)),
             gram_state=upd(state.gram_state, jnp.int32(0)),  # no leakage
+            last_logprob=upd(state.last_logprob, jnp.float32(0.0)),
         )
 
     def activate(self, state: DecodeState, slot: int, token: int,
                  generated: int, max_gen: int, temperature: float, top_k: int,
-                 top_p: float) -> DecodeState:
+                 top_p: float, seed: int = 0) -> DecodeState:
         """Start decoding a prefilled slot (its lengths were set by the last
         chunk; ``generated`` counts tokens already produced, >=1)."""
         return self._activate_fn(
             state, jnp.int32(slot), jnp.int32(token), jnp.int32(generated),
             jnp.int32(max_gen), jnp.float32(temperature), jnp.int32(top_k),
-            jnp.float32(top_p))
+            jnp.float32(top_p), jnp.int32(seed))
 
     def _release_impl(self, state: DecodeState, slot) -> DecodeState:
         return dataclasses.replace(state,
@@ -835,14 +892,17 @@ class EngineCore:
 
     def _decode_impl(self, state: DecodeState, params, adapters, page_table,
                      gram_table, gram_accept, gram_dist, tok_bytes, tok_lens,
-                     steps: int, use_grammar: bool
+                     steps: int, use_grammar: bool, want_top: bool
                      ) -> Tuple[DecodeState, Dict[str, Any]]:
+        from generativeaiexamples_tpu.ops.sampling import (
+            sample_logits_per_slot, token_logprob)
+
         def step(state, _):
             logits, cache = kv_cache.decode_step(
                 params, self.model_cfg, state.tokens, state.cache,
                 page_table, state.active, self.num_pages, adapters=adapters,
                 mesh=self.mesh)
-            rng, sub = jax.random.split(state.rng)
+            raw = logits.astype(jnp.float32)   # logprobs: model distribution
             if use_grammar:
                 # constrained decoding INSIDE the fused step: byte-DFA
                 # walk masks disallowed tokens, state advances with the
@@ -856,8 +916,10 @@ class EngineCore:
             # inactive slots' stale temperatures must not defeat the
             # all-greedy fast path inside the sampler
             live_temp = jnp.where(state.active, state.temperature, 0.0)
-            sampled = sample_logits_dynamic(sub, logits, live_temp,
-                                            state.top_k, state.top_p)
+            keys = jax.vmap(jax.random.fold_in)(state.rngs, state.generated)
+            sampled = sample_logits_per_slot(keys, logits, live_temp,
+                                             state.top_k, state.top_p)
+            lp = token_logprob(raw, sampled)
             generated = state.generated + state.active.astype(jnp.int32)
             hit_eos = sampled == self.eos_id
             out_of_budget = generated >= state.max_gen
@@ -873,7 +935,7 @@ class EngineCore:
                 tokens=jnp.where(state.active, sampled, state.tokens),
                 active=active,
                 generated=generated,
-                rng=rng,
+                last_logprob=jnp.where(state.active, lp, state.last_logprob),
             )
             if use_grammar:
                 adv = grammar_advance(state.gram_state, sampled, gram_table,
@@ -883,7 +945,16 @@ class EngineCore:
                     gram_state=jnp.where(state.active, adv,
                                          state.gram_state))
             out = {"sampled": sampled, "emitted": state.active, "done": done,
-                   "hit_eos": hit_eos, "input_tokens": state.tokens}
+                   "hit_eos": hit_eos, "input_tokens": state.tokens,
+                   "sampled_lp": lp, "input_lp": state.last_logprob}
+            if want_top:
+                # top-TOP_LP alternatives per step (the OpenAI top_logprobs
+                # surface) — a separate compile variant, so the common path
+                # never pays the extra vocab sort
+                top_vals, top_ids = jax.lax.top_k(raw, TOP_LP)
+                lse = jax.nn.logsumexp(raw, axis=-1, keepdims=True)
+                out["top_ids"] = top_ids.astype(jnp.int32)     # (B, K)
+                out["top_lps"] = top_vals - lse                # (B, K)
             return new_state, out
 
         # K fused steps per dispatch: the host syncs once per K tokens/slot,
@@ -893,20 +964,30 @@ class EngineCore:
         state, outs = jax.lax.scan(step, state, None, length=steps)
         # one contiguous int32 block so the host fetches the whole dispatch
         # result in a single transfer (a pytree device_get pays one round
-        # trip PER LEAF — 5x the latency on a remote-attached chip)
-        outs["packed"] = jnp.stack(
-            [outs[k].astype(jnp.int32) for k in _PACKED_FIELDS])
+        # trip PER LEAF — 5x the latency on a remote-attached chip);
+        # float rows ride as raw bits (bitcast), not int casts
+        as_row = lambda k: (jax.lax.bitcast_convert_type(
+            outs[k], jnp.int32) if k in _LP_FIELDS
+            else outs[k].astype(jnp.int32))
+        rows = [as_row(k) for k in _PACKED_FIELDS]
+        if want_top:
+            rows += list(jnp.moveaxis(outs["top_ids"], -1, 0))
+            rows += list(jnp.moveaxis(jax.lax.bitcast_convert_type(
+                outs["top_lps"], jnp.int32), -1, 0))
+        outs["packed"] = jnp.stack(rows)
         return state, outs
 
     def decode(self, state: DecodeState, page_table: jax.Array,
-               steps: int = 1, use_grammar: bool = False
+               steps: int = 1, use_grammar: bool = False,
+               want_top: bool = False
                ) -> Tuple[DecodeState, Dict[str, Any]]:
         """Run ``steps`` fused decode steps over all slots; ``page_table``
         from `put_table`. Out arrays are stacked (steps, B); ``input_tokens``
         carries each step's input so a just-activated slot's first token (not
         host-synced at admission) is recoverable from the same sync.
         ``use_grammar`` (compiled separately) applies constrained-decoding
-        masks for slots whose gram_state > 0."""
+        masks for slots whose gram_state > 0; ``want_top`` (also a separate
+        compile) appends TOP_LP top-logprob rows to the packed block."""
         return self._decode_fn(state, self.params, self.adapters, page_table,
                                *self._gram_args(use_grammar), steps,
-                               use_grammar)
+                               use_grammar, want_top)

@@ -43,7 +43,7 @@ import numpy as np
 
 from generativeaiexamples_tpu.core.metrics import REGISTRY
 from generativeaiexamples_tpu.engine.engine import (
-    DecodeState, EngineCore, unpack_decode_out)
+    DecodeState, EngineCore, bits_to_f32, unpack_decode_out)
 from generativeaiexamples_tpu.engine.prefix_cache import chain_hashes
 from generativeaiexamples_tpu.engine.tokenizer import IncrementalDetokenizer, Tokenizer
 
@@ -63,6 +63,24 @@ def _fetch(arr, metric: str = "fetch_rtt_s") -> np.ndarray:
     return out
 
 
+def _stop_scan(stops, buf: str):
+    """Incremental stop-sequence matching over the detokenized stream.
+    Returns (emit, hold, stopped): ``emit`` is safe to stream now, ``hold``
+    is a trailing fragment that could still become a stop match (at most
+    max(len(stop))-1 chars), ``stopped`` means a stop string matched —
+    ``emit`` then ends just before it and the request must finish."""
+    idxs = [i for i in (buf.find(s) for s in stops) if i >= 0]
+    if idxs:
+        return buf[:min(idxs)], "", True
+    hold = 0
+    for s in stops:
+        for L in range(min(len(buf), len(s) - 1), 0, -1):
+            if buf.endswith(s[:L]):
+                hold = max(hold, L)
+                break
+    return buf[:len(buf) - hold], buf[len(buf) - hold:] if hold else "", False
+
+
 @dataclass
 class Request:
     prompt_ids: List[int]
@@ -70,6 +88,18 @@ class Request:
     temperature: float = 0.7
     top_k: int = 0
     top_p: float = 1.0
+    # OpenAI-contract sampling surface (ref docs/api_reference/
+    # openapi_schema.json:517-526 for `stop`): stop strings end the
+    # generation host-side (matched incrementally on the detokenized
+    # stream, never emitted); `seed` pins the slot's PRNG base key for
+    # batch-composition-independent determinism (None = random per
+    # request); `logprobs`/`top_logprobs` fill `logprob_data` with
+    # (token_id, logprob, [(alt_id, alt_logprob)] | None) per token.
+    stop: List[str] = field(default_factory=list)
+    seed: Optional[int] = None
+    logprobs: bool = False
+    top_logprobs: int = 0
+    logprob_data: List[tuple] = field(default_factory=list)
     # compiled constrained-decoding grammar (engine/grammar.py Grammar) or
     # None; on an engine without free grammar slots the request silently
     # degrades to unconstrained (prompt+parse still applies upstream).
@@ -128,6 +158,8 @@ class _Job:
     first_epoch: int = 0          # bumps per (re-)prefill: stale fetches
                                   # of a preempted+re-admitted job no-op
     gram_on: bool = False         # constrained decoding active for the slot
+    stop_buf: str = ""            # held-back text (possible stop prefix)
+    stopped: bool = False         # a stop sequence matched; tail suppressed
 
 
 class Scheduler:
@@ -213,6 +245,16 @@ class Scheduler:
 
     def submit(self, request: Request) -> Request:
         """Enqueue; stream deltas via `iter_text(request)`."""
+        if request.seed is None:
+            # unseeded requests still get a PER-REQUEST key, so concurrent
+            # streams never correlate and the effective seed is reportable
+            import random as _random
+            request.seed = _random.getrandbits(31)
+        else:
+            # OpenAI accepts 64-bit seeds; the device key is int32. Map
+            # deterministically instead of letting np.int32 raise mid-tick
+            # (which would fail every in-flight request via _fail_all)
+            request.seed = int(request.seed) & 0x7FFFFFFF
         job = _Job(request=request,
                    detok=IncrementalDetokenizer(self.tokenizer),
                    ids=list(request.prompt_ids))
@@ -278,8 +320,21 @@ class Scheduler:
 
     def _finish(self, job: _Job) -> None:
         tail = job.detok.flush()
-        if tail:
+        if job.stopped:
+            pass          # text at/after the stop match is never emitted
+        elif job.request.stop:
+            # natural end with holdback pending: the tail may still
+            # complete a stop match across the flush boundary; an unmatched
+            # hold is legitimate output and flushes too
+            emit, hold, hit = _stop_scan(job.request.stop,
+                                         job.stop_buf + tail)
+            if not hit:
+                emit += hold
+            if emit:
+                job.request.out_queue.put(emit)
+        elif tail:
             job.request.out_queue.put(tail)
+        job.stop_buf = ""
         job.request.out_queue.put(_STOP)
         # decode-written pages join the prefix cache before release: a
         # follow-up turn whose templated prompt embeds this conversation
@@ -541,7 +596,7 @@ class Scheduler:
                 self._state, job.ids, self._table[job.slot], job.slot,
                 generated=len(job.gen_ids) + 1, max_gen=req.max_tokens,
                 temperature=req.temperature, top_k=req.top_k,
-                top_p=req.top_p)
+                top_p=req.top_p, seed=req.seed or 0)
             job.prefilled = len(job.ids)
             job.total_len = job.prefilled
             self._cache_insert(job)
@@ -578,7 +633,8 @@ class Scheduler:
                     slot=job.slot, start_pos=start, is_last=last,
                     generated=len(job.gen_ids) + 1, max_gen=req.max_tokens,
                     temperature=req.temperature, top_k=req.top_k,
-                    top_p=req.top_p, gram_state=gram_state))
+                    top_p=req.top_p, gram_state=gram_state,
+                    seed=req.seed or 0))
                 start += len(chunk_ids)
                 if last:
                     finals.append(job)
@@ -642,7 +698,16 @@ class Scheduler:
         job.first_batched = False
         job.first_epoch += 1
 
-    def _resolve_first(self, job: _Job, first: int, now: float) -> None:
+    def _retire(self, job: _Job) -> None:
+        """Stop-sequence retirement: the device still thinks the slot is
+        generating, so deactivate it before finishing (in-flight results
+        for the slot are dropped by the identity check)."""
+        del self._slots[job.slot]
+        self._state = self.core.release(self._state, job.slot)
+        self._finish(job)
+
+    def _resolve_first(self, job: _Job, first: int, now: float,
+                       lp: Optional[float] = None) -> None:
         """Emit + stamp a job's fused first token — called by whichever
         lands first, the direct scalar fetch or a decode sync (idempotent
         via first_pending). The job must be active in its slot."""
@@ -666,18 +731,36 @@ class Scheduler:
             del self._slots[job.slot]
             self._finish(job)
             return
-        self._emit_token(job, first)
+        if self._emit_token(job, first, lp):
+            self._retire(job)
+            return
         if already + 1 >= req.max_tokens:
             del self._slots[job.slot]
             self._finish(job)
 
-    def _emit_token(self, job: _Job, tok: int) -> None:
+    def _emit_token(self, job: _Job, tok: int, lp: Optional[float] = None,
+                    top: Optional[list] = None) -> bool:
+        """Append a generated token: detokenize, scan stop sequences,
+        stream the emit-safe text. Returns True when a stop sequence
+        matched — the caller must retire the slot."""
         job.gen_ids.append(tok)
         job.request.completion_tokens += 1
         job.total_len += 1
+        req = job.request
+        if req.logprobs:
+            req.logprob_data.append((tok, lp, top))
         delta = job.detok.push(tok)
-        if delta:
-            job.request.out_queue.put(delta)
+        if req.stop:
+            emit, job.stop_buf, stopped = _stop_scan(req.stop,
+                                                     job.stop_buf + delta)
+            if emit:
+                req.out_queue.put(emit)
+            if stopped:
+                job.stopped = True
+                return True
+        elif delta:
+            req.out_queue.put(delta)
+        return False
 
     # -- decode -------------------------------------------------------------
 
@@ -821,8 +904,10 @@ class Scheduler:
             j.first_inflight = True   # only the first dispatch resolves it
         t0 = time.perf_counter()
         use_grammar = any(j.gram_on for j in self._slots.values())
+        want_top = any(j.request.logprobs and j.request.top_logprobs > 0
+                       for j in self._slots.values())
         self._state, out = self.core.decode(self._state, self._table_device(),
-                                            steps, use_grammar)
+                                            steps, use_grammar, want_top)
         REGISTRY.histogram("decode_issue_s").observe(time.perf_counter() - t0)
         REGISTRY.histogram("decode_batch_fill").observe(
             len(self._slots) / self.core.batch)
@@ -853,15 +938,27 @@ class Scheduler:
         for slot, job in fresh:
             if self._slots.get(slot) is not job:
                 continue  # preempted while in flight; resume re-samples
-            self._resolve_first(job, int(out["input_tokens"][0, slot]), now)
+            self._resolve_first(job, int(out["input_tokens"][0, slot]), now,
+                                float(out["input_lp"][0, slot]))
         for slot, job in active_map.items():
             if self._slots.get(slot) is not job:
                 continue  # finished or preempted since this dispatch
+            req = job.request
+            n_top = (min(req.top_logprobs, len(out.get("top_ids", ())))
+                     if req.logprobs else 0)
             for k in range(steps):
                 if not out["emitted"][k, slot]:
                     continue
                 if not (out["done"][k, slot] and out["hit_eos"][k, slot]):
-                    self._emit_token(job, int(out["sampled"][k, slot]))
+                    lp = (float(out["sampled_lp"][k, slot])
+                          if req.logprobs else None)
+                    top = ([(int(out["top_ids"][j, k, slot]),
+                             float(out["top_lps"][j, k, slot]))
+                            for j in range(n_top)] if n_top else None)
+                    if self._emit_token(job, int(out["sampled"][k, slot]),
+                                        lp, top):
+                        self._retire(job)
+                        break
                 if out["done"][k, slot]:
                     del self._slots[slot]
                     self._finish(job)
@@ -890,7 +987,7 @@ class Scheduler:
                                    if id(ff) not in landed_ids]
             now = time.perf_counter()
             for fut, pairs in landed:
-                tokens_host = fut.result()
+                snap_host = fut.result()      # (2, B): tokens, logprob bits
                 for slot, job, epoch in pairs:
                     # identity AND epoch: the job may have been preempted
                     # and RE-admitted into the same slot while this fetch
@@ -898,7 +995,9 @@ class Scheduler:
                     # not the one this snapshot carries
                     if (self._slots.get(slot) is job
                             and job.first_epoch == epoch):
-                        self._resolve_first(job, int(tokens_host[slot]), now)
+                        self._resolve_first(job, int(snap_host[0, slot]),
+                                            now,
+                                            bits_to_f32(snap_host[1, slot]))
             worked = True
         self._admit()
         # Prefill-priority ramp: while admissions are prefilling into a
@@ -941,13 +1040,14 @@ class Scheduler:
                    and not j.first_batched]
         if (waiting and (hold or len(self._inflight) <= 1)
                 and len(self._first_fetches) < self._first_fetch_depth):
-            toks = self._state.tokens
-            if self.core.donates_state:
-                # the next dispatch DONATES the state: fetching the live
-                # handle races buffer deletion ("Array has been deleted").
-                # A tiny on-device copy is independent of the donation.
-                toks = jnp.copy(toks)
-            fut = self._fetcher.submit(_fetch, toks, "first_fetch_rtt_s")
+            # one (2, B) snapshot: token ids + logprob bits. The stack is
+            # a fresh on-device buffer, so fetching it never races the
+            # next dispatch's donation of the state it reads from.
+            snap = jnp.stack([
+                self._state.tokens,
+                jax.lax.bitcast_convert_type(self._state.last_logprob,
+                                             jnp.int32)])
+            fut = self._fetcher.submit(_fetch, snap, "first_fetch_rtt_s")
             for _, j, _e in waiting:
                 j.first_batched = True
             self._first_fetches.append((fut, waiting))

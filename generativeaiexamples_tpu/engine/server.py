@@ -37,10 +37,11 @@ from aiohttp import web
 
 from generativeaiexamples_tpu.engine import grammar as grammar_mod
 from generativeaiexamples_tpu.engine import tools as tools_mod
+from generativeaiexamples_tpu.engine.engine import TOP_LP
 from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
 from generativeaiexamples_tpu.server.common import (
-    MAX_TOKENS_CAP, StreamDrain, health_handler, metrics_handler, sse_done,
-    sse_write,
+    MAX_TOKENS_CAP, StreamDrain, health_handler, metrics_handler, parse_stop,
+    sse_done, sse_write,
 )
 
 
@@ -67,13 +68,18 @@ def _grammar_for(kind: str, payload: str) -> Optional[object]:
 
 
 def _chunk(model: str, rid: str, delta: Dict[str, Any],
-           finish_reason: Optional[str] = None) -> str:
+           finish_reason: Optional[str] = None, index: int = 0,
+           logprobs: Optional[Dict[str, Any]] = None) -> str:
+    choice: Dict[str, Any] = {"index": index, "delta": delta,
+                              "finish_reason": finish_reason}
+    if logprobs is not None:
+        choice["logprobs"] = logprobs
     return json.dumps({
         "id": rid,
         "object": "chat.completion.chunk",
         "created": int(time.time()),
         "model": model,
-        "choices": [{"index": 0, "delta": delta, "finish_reason": finish_reason}],
+        "choices": [choice],
     })
 
 
@@ -104,12 +110,39 @@ class ModelServer:
             value = body.get(key)
             return default if value is None else cast(value)  # JSON null = default
 
+        top_lp = get("top_logprobs", 0, int)
         return {
             "max_tokens": min(get("max_tokens", 128, int), MAX_TOKENS_CAP),
             "temperature": get("temperature", 0.7, float),
             "top_p": get("top_p", 1.0, float),
             "top_k": get("top_k", 0, int),
+            "stop": parse_stop(body.get("stop")),
+            "seed": (int(body["seed"]) if body.get("seed") is not None
+                     else None),
+            "logprobs": bool(get("logprobs", False, bool) or top_lp),
+            "top_logprobs": max(0, min(top_lp, TOP_LP)),
         }
+
+    def _format_logprobs(self, req) -> Dict[str, Any]:
+        """OpenAI chat `logprobs` object from the scheduler's raw
+        (token_id, logprob, top) tuples. The first (fused-prefill) token's
+        top_logprobs lists only itself — its alternatives never leave the
+        device (documented engine limitation)."""
+        tok = self.scheduler.tokenizer
+        content = []
+        for tid, lp, top in req.logprob_data:
+            s = tok.decode([tid])
+            entry: Dict[str, Any] = {
+                "token": s, "logprob": lp,
+                "bytes": list(s.encode("utf-8"))}
+            if req.top_logprobs:
+                alts = top if top else ([(tid, lp)] if lp is not None else [])
+                entry["top_logprobs"] = [
+                    {"token": tok.decode([i]), "logprob": l,
+                     "bytes": list(tok.decode([i]).encode("utf-8"))}
+                    for i, l in alts[:req.top_logprobs]]
+            content.append(entry)
+        return {"content": content}
 
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
         body = await request.json()
@@ -192,11 +225,25 @@ class ModelServer:
                    grammar: Optional[object] = None,
                    grammar_prefix: str = "") -> web.StreamResponse:
         sampling = self._parse_sampling(body)
-        req = Request(prompt_ids=list(prompt_ids), grammar=grammar,
-                      grammar_prefix=grammar_prefix, **sampling)
+        n = max(1, min(int(body.get("n") or 1), 4))
+        if n > 1 and (tools or json_mode):
+            raise web.HTTPBadRequest(text=json.dumps(
+                {"error": "n > 1 is not supported with tools or "
+                          "response_format"}))
+
+        def make_req(i: int) -> Request:
+            kw = dict(sampling)
+            if i and kw["seed"] is not None:
+                kw["seed"] = kw["seed"] + i   # distinct, still reproducible
+            return Request(prompt_ids=list(prompt_ids), grammar=grammar,
+                           grammar_prefix=grammar_prefix, **kw)
+
+        reqs = [make_req(i) for i in range(n)]
+        req = reqs[0]
         rid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
         stream = bool(body.get("stream", False))
-        self.scheduler.submit(req)
+        for r in reqs:
+            self.scheduler.submit(r)
         drain = StreamDrain(self.scheduler.iter_text(req))
 
         if stream and tools and not json_mode:
@@ -238,33 +285,83 @@ class ModelServer:
             if stream:
                 return await self._stream_buffered(request, rid, message,
                                                    finish)
-            choice: Dict[str, Any] = {"index": 0, "finish_reason": finish}
-            if chat:
-                choice["message"] = message
-            else:
-                choice["text"] = text
-            return web.json_response({
+            texts = [text] + [
+                await StreamDrain(self.scheduler.iter_text(r)).join_text()
+                for r in reqs[1:]]
+            choices: List[Dict[str, Any]] = []
+            for i, (r, t) in enumerate(zip(reqs, texts)):
+                # a secondary choice's engine failure must not pass off its
+                # truncated text as a clean stop
+                fin = (finish if i == 0 else "stop") if not r.error else "error"
+                choice: Dict[str, Any] = {"index": i, "finish_reason": fin}
+                msg = message if i == 0 else {"role": "assistant",
+                                              "content": t}
+                if chat:
+                    choice["message"] = msg
+                else:
+                    choice["text"] = t if i else text
+                if r.logprobs:
+                    choice["logprobs"] = self._format_logprobs(r)
+                choices.append(choice)
+            done_toks = sum(r.completion_tokens for r in reqs)
+            payload = {
                 "id": rid, "object": "chat.completion" if chat else "text_completion",
                 "created": int(time.time()), "model": self.model_name,
-                "choices": [choice],
+                "choices": choices,
                 "usage": {"prompt_tokens": len(prompt_ids),
-                          "completion_tokens": req.completion_tokens,
-                          "total_tokens": len(prompt_ids) + req.completion_tokens},
-            })
+                          "completion_tokens": done_toks,
+                          "total_tokens": len(prompt_ids) + done_toks},
+            }
+            errs = [r.error for r in reqs if r.error]
+            if errs:
+                payload["error"] = "; ".join(errs)
+            return web.json_response(payload)
 
         resp = await self._sse_response(request)
         if chat:
-            await sse_write(resp, _chunk(self.model_name, rid, {"role": "assistant"}))
-        async for delta in drain:
-            await sse_write(resp, _chunk(self.model_name, rid, {"content": delta}))
+            for i in range(n):
+                await sse_write(resp, _chunk(self.model_name, rid,
+                                             {"role": "assistant"}, index=i))
+        if n == 1:
+            async for delta in drain:
+                await sse_write(resp, _chunk(self.model_name, rid,
+                                             {"content": delta}))
+        else:
+            # n-way merged stream: one pump per choice, deltas interleave
+            # with their choice index (the OpenAI multi-choice contract)
+            import asyncio
+            q: "asyncio.Queue" = asyncio.Queue()
+            drains = [drain] + [StreamDrain(self.scheduler.iter_text(r))
+                                for r in reqs[1:]]
+
+            async def pump(i: int, d: StreamDrain) -> None:
+                async for delta in d:
+                    await q.put((i, delta))
+                await q.put((i, None))
+
+            tasks = [asyncio.ensure_future(pump(i, d))
+                     for i, d in enumerate(drains)]
+            live = n
+            while live:
+                i, delta = await q.get()
+                if delta is None:
+                    live -= 1
+                    continue
+                await sse_write(resp, _chunk(self.model_name, rid,
+                                             {"content": delta}, index=i))
+            for t in tasks:
+                t.cancel()
         # an engine failure mid-stream must not masquerade as a clean stop;
         # the error rides inside a schema-shaped chunk so conforming clients
         # (chunk["choices"][0]) keep parsing
-        finish = "error" if req.error else "stop"
-        final = json.loads(_chunk(self.model_name, rid, {}, finish))
-        if req.error:
-            final["error"] = req.error
-        await sse_write(resp, json.dumps(final))
+        for i, r in enumerate(reqs):
+            finish = "error" if r.error else "stop"
+            lps = self._format_logprobs(r) if r.logprobs else None
+            final = json.loads(_chunk(self.model_name, rid, {}, finish,
+                                      index=i, logprobs=lps))
+            if r.error:
+                final["error"] = r.error
+            await sse_write(resp, json.dumps(final))
         await sse_done(resp)
         return resp
 

@@ -117,6 +117,71 @@ def grammar_advance(gram_state: jnp.ndarray, sampled: jnp.ndarray,
     return jnp.where(gram_state > 0, st, gram_state)
 
 
+def _mask_dynamic(lf: jnp.ndarray, temperature: jnp.ndarray,
+                  top_k: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
+    """Shared per-row temperature/top-k/top-p masking for the dynamic
+    samplers (one definition, two categorical-draw strategies).
+    lf: (B, V) float32 → scaled+masked logits ready for the draw."""
+    B, V = lf.shape
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+    scaled = lf / safe_t
+
+    # top-k: rank of each logit within its row (0 = largest)
+    ranks = jnp.argsort(jnp.argsort(scaled, axis=-1)[..., ::-1], axis=-1)
+    k_eff = jnp.where(top_k > 0, top_k, V)[:, None]
+    scaled = jnp.where(ranks < k_eff, scaled, -jnp.inf)
+
+    # top-p over the k-filtered distribution
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum_excl = jnp.roll(jnp.cumsum(probs, axis=-1), 1,
+                        axis=-1).at[..., 0].set(0.0)
+    keep = cum_excl < top_p[:, None]
+    keep = keep.at[..., 0].set(True)  # top_p=0 degrades to greedy
+    cutoff = jnp.where(keep, sorted_desc, jnp.inf).min(axis=-1, keepdims=True)
+    return jnp.where(scaled < cutoff, -jnp.inf, scaled)
+
+
+def token_logprob(logits: jnp.ndarray, sampled: jnp.ndarray) -> jnp.ndarray:
+    """Log-probability of each sampled token under the model distribution
+    (raw logits, temperature-free — what the OpenAI `logprobs` field
+    reports). logits: (B, V) any float dtype; sampled: (B,) int32 →
+    (B,) float32."""
+    lf = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(lf, axis=-1)
+    picked = jnp.take_along_axis(lf, sampled[:, None].astype(jnp.int32),
+                                 axis=-1)[:, 0]
+    return picked - lse
+
+
+def sample_logits_per_slot(keys: jnp.ndarray, logits: jnp.ndarray,
+                           temperature: jnp.ndarray, top_k: jnp.ndarray,
+                           top_p: jnp.ndarray) -> jnp.ndarray:
+    """Per-slot sampling with PER-SLOT PRNG keys — the `seed` surface of
+    the serving API. Each slot samples from its own key stream, so a
+    seeded request reproduces its exact token sequence regardless of what
+    else shares the batch or how the scheduler interleaved it (batch
+    composition changes neither the fold_in chain nor the per-row
+    categorical draw). Masking semantics are identical to
+    :func:`sample_logits_dynamic`.
+
+    keys: (B, 2) uint32 — legacy raw threefry keys, one per slot (already
+    folded with the token index by the caller); logits: (B, V);
+    temperature/top_k/top_p: (B,).
+    """
+    lf = logits.astype(jnp.float32)
+    greedy = jnp.argmax(lf, axis=-1).astype(jnp.int32)
+
+    def full_path(_):
+        scaled = _mask_dynamic(lf, temperature, top_k, top_p)
+        sampled = jax.vmap(
+            lambda k, row: jax.random.categorical(k, row))(keys, scaled)
+        return jnp.where(temperature > 0, sampled.astype(jnp.int32), greedy)
+
+    return jax.lax.cond(jnp.any(temperature > 0), full_path,
+                        lambda _: greedy, operand=None)
+
+
 def sample_logits_dynamic(rng: jax.Array, logits: jnp.ndarray,
                           temperature: jnp.ndarray, top_k: jnp.ndarray,
                           top_p: jnp.ndarray) -> jnp.ndarray:
@@ -131,30 +196,11 @@ def sample_logits_dynamic(rng: jax.Array, logits: jnp.ndarray,
     V=128k on v5e); when the whole batch is greedy — a common serving mix
     and every deterministic eval — a `lax.cond` skips straight to argmax.
     """
-    B, V = logits.shape
     lf = logits.astype(jnp.float32)
     greedy = jnp.argmax(lf, axis=-1).astype(jnp.int32)
 
     def full_path(_):
-        safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
-        scaled = lf / safe_t
-
-        # top-k: rank of each logit within its row (0 = largest)
-        ranks = jnp.argsort(jnp.argsort(scaled, axis=-1)[..., ::-1], axis=-1)
-        k_eff = jnp.where(top_k > 0, top_k, V)[:, None]
-        scaled = jnp.where(ranks < k_eff, scaled, -jnp.inf)
-
-        # top-p over the k-filtered distribution
-        sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
-        probs = jax.nn.softmax(sorted_desc, axis=-1)
-        cum_excl = jnp.roll(jnp.cumsum(probs, axis=-1), 1,
-                            axis=-1).at[..., 0].set(0.0)
-        keep = cum_excl < top_p[:, None]
-        keep = keep.at[..., 0].set(True)  # top_p=0 degrades to greedy
-        cutoff = jnp.where(keep, sorted_desc, jnp.inf).min(axis=-1,
-                                                           keepdims=True)
-        scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
-
+        scaled = _mask_dynamic(lf, temperature, top_k, top_p)
         sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
         return jnp.where(temperature > 0, sampled, greedy)
 

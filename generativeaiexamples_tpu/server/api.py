@@ -37,7 +37,7 @@ from generativeaiexamples_tpu.core.tracing import instrumentation_wrapper
 from generativeaiexamples_tpu.server.base import BaseExample
 from generativeaiexamples_tpu.server import guardrails as guardrails_mod
 from generativeaiexamples_tpu.server.common import (
-    MAX_TOKENS_CAP, StreamDrain, health_handler, metrics_handler,
+    MAX_TOKENS_CAP, StreamDrain, health_handler, metrics_handler, parse_stop,
 )
 
 logger = logging.getLogger(__name__)
@@ -123,6 +123,14 @@ class ChainServer:
             "top_p": setting("top_p", 0.7, float),
             "max_tokens": min(setting("max_tokens", 256, int), MAX_TOKENS_CAP),
         }
+        # `stop` is part of the published chain-server contract (ref
+        # docs/api_reference/openapi_schema.json:517-526): forwarded to the
+        # chain (engines abort generation early) AND enforced again on the
+        # outgoing stream, so chains that drop unknown settings still honor
+        # the contract (held-back partial matches never reach the client)
+        stop = parse_stop(body.get("stop"))
+        if stop:
+            settings["stop"] = stop
         REGISTRY.counter("generate_requests").inc()
         rid = uuid.uuid4().hex
 
@@ -166,12 +174,30 @@ class ChainServer:
                 yield ("Error from chain server. Please check chain-server "
                        "logs for more details.")
 
+        from generativeaiexamples_tpu.engine.scheduler import _stop_scan
         first = True
+        held = ""
+        hit = False
         async for item in StreamDrain(guarded()):
+            if stop:
+                item, held, hit = _stop_scan(stop, held + item)
+                if item:
+                    if first:
+                        REGISTRY.histogram("e2e_ttft_s").observe(
+                            time.perf_counter() - t_start)
+                        first = False
+                    await resp.write(
+                        f"data: {_chain_chunk(rid, item)}\n\n".encode())
+                if hit:
+                    break
+                continue
             if first:
                 REGISTRY.histogram("e2e_ttft_s").observe(time.perf_counter() - t_start)
                 first = False
             await resp.write(f"data: {_chain_chunk(rid, item)}\n\n".encode())
+        if held and not hit:
+            # trailing holdback that never completed a stop match
+            await resp.write(f"data: {_chain_chunk(rid, held)}\n\n".encode())
         await resp.write(f"data: {_chain_chunk(rid, '', 'stop')}\n\n".encode())
         await resp.write(b"data: [DONE]\n\n")
         await resp.write_eof()

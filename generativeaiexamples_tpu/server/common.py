@@ -20,6 +20,15 @@ from generativeaiexamples_tpu.core.metrics import REGISTRY
 MAX_TOKENS_CAP = 1024  # ref: RAG/src/chain_server/server.py:104-110
 
 
+def parse_stop(value) -> list:
+    """Normalize an OpenAI-contract `stop` field (string | list | null)
+    to at most 4 non-empty strings — one rule for both servers (ref
+    docs/api_reference/openapi_schema.json:517-526)."""
+    if isinstance(value, str):
+        value = [value]
+    return [str(s) for s in (value or []) if s][:4]
+
+
 async def health_handler(request: web.Request) -> web.Response:
     return web.json_response({"message": "Service is up."})
 

@@ -89,6 +89,7 @@ class _FakeState:
     active: np.ndarray            # (B,) bool
     generated: np.ndarray         # (B,)
     max_gen: np.ndarray           # (B,)
+    last_logprob: np.ndarray = None  # (B,) f32 (scheduler snapshot shape)
 
 
 class FakeCore:
@@ -123,7 +124,8 @@ class FakeCore:
             pool=np.zeros((self.num_pages, self.page_size), np.int32),
             lengths=np.zeros((B,), np.int32), tokens=np.zeros((B,), np.int32),
             active=np.zeros((B,), bool), generated=np.zeros((B,), np.int32),
-            max_gen=np.zeros((B,), np.int32))
+            max_gen=np.zeros((B,), np.int32),
+            last_logprob=np.zeros((B,), np.float32))
 
     def new_allocator(self):
         """Caching episodes run the REAL CachingAllocator against the fake
@@ -156,7 +158,7 @@ class FakeCore:
         state.tokens) must stay stable snapshots."""
         return _FakeState(*(a.copy() for a in (
             st.pool, st.lengths, st.tokens, st.active, st.generated,
-            st.max_gen)))
+            st.max_gen, st.last_logprob)))
 
     def release(self, st: _FakeState, slot: int) -> _FakeState:
         st = self._clone(st)
@@ -186,10 +188,12 @@ class FakeCore:
         return st, toks
 
     def decode(self, st: _FakeState, table: np.ndarray, steps: int = 1,
-               use_grammar: bool = False) -> tuple:
+               use_grammar: bool = False, want_top: bool = False) -> tuple:
         st = self._clone(st)
         B, ps = self.batch, self.page_size
-        out = np.zeros((5, steps, B), np.int32)
+        # 7 rows: the scheduler's unpack expects the logprob rows too
+        # (they carry 0.0 bits here — the fake model has no distribution)
+        out = np.zeros((7, steps, B), np.int32)
         for k in range(steps):
             for b in range(B):
                 out[4, k, b] = st.tokens[b]              # input_tokens

@@ -1,0 +1,295 @@
+"""The remaining OpenAI-contract sampling surface: stop / logprobs / seed / n.
+
+The reference's published chain-server contract includes `stop`
+(ref docs/api_reference/openapi_schema.json:517-526) and its NIM speaks the
+full OpenAI surface (logprobs, seed, n — ref docs/architecture.md:49-61).
+These tests pin the in-tree engine's implementation:
+
+  * _stop_scan: incremental matching with holdback (a stop string spanning
+    several streamed deltas is caught and never emitted).
+  * End-to-end stop: output truncates exactly before the match, the slot
+    and pages are reclaimed, completion short-circuits the budget.
+  * logprobs: per-token model logprobs match an independent full-sequence
+    forward pass; top_logprobs rank alternatives and include the sample.
+  * seed: identical seeds reproduce identical sampled text regardless of
+    what else shares the batch (per-slot PRNG keys — batch-composition
+    independence, stronger than the OpenAI best-effort contract).
+  * n: the /v1 server fans one prompt into n independent choices.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.core.config import EngineConfig
+from generativeaiexamples_tpu.engine.engine import EngineCore
+from generativeaiexamples_tpu.engine.scheduler import (
+    Request, Scheduler, _stop_scan)
+from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+from generativeaiexamples_tpu.models import llama
+
+
+# ----------------------------------------------------------------- scanner
+
+def test_stop_scan_immediate_and_earliest():
+    emit, hold, hit = _stop_scan(["YY", "X"], "abcXdefYY")
+    assert (emit, hold, hit) == ("abc", "", True)     # earliest match wins
+
+
+def test_stop_scan_holdback_across_deltas():
+    stops = ["STOP"]
+    emit1, hold1, hit1 = _stop_scan(stops, "hello ST")
+    assert (emit1, hit1) == ("hello ", False)
+    assert hold1 == "ST"                 # possible prefix, held back
+    emit2, hold2, hit2 = _stop_scan(stops, hold1 + "OP and more")
+    assert (emit2, hold2, hit2) == ("", "", True)
+    # a false alarm releases the held text
+    emit3, hold3, hit3 = _stop_scan(stops, "ST" + "ART")
+    assert (emit3, hold3, hit3) == ("START", "", False)
+
+
+# ------------------------------------------------------------- end to end
+
+@pytest.fixture(scope="module")
+def served():
+    cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    params = llama.init_params(jax.random.PRNGKey(5), cfg)
+    tok = ByteTokenizer()
+    ecfg = EngineConfig(max_batch_size=4, max_seq_len=128, page_size=8,
+                        prefill_chunk=16)
+    core = EngineCore(cfg, ecfg, params, eos_id=tok.eos_id)
+    return core, tok, cfg, params
+
+
+def _run_all(sched, reqs):
+    for r in reqs:
+        sched.submit(r)
+    while sched._tick():
+        pass
+    out = []
+    for r in reqs:
+        parts = []
+        while not r.out_queue.empty():
+            item = r.out_queue.get_nowait()
+            if isinstance(item, str):
+                parts.append(item)
+        out.append("".join(parts))
+    return out
+
+
+def test_stop_sequence_truncates_and_reclaims(served):
+    core, tok, cfg, params = served
+    sched = Scheduler(core, tok)
+    prompt = tok.encode("tell me everything", add_bos=True)
+    base = _run_all(sched, [Request(prompt_ids=list(prompt), max_tokens=24,
+                                    temperature=0.0)])[0]
+    assert len(base) > 6
+    s = base[4:7]          # substring from the middle: spans token bounds
+    want = base[:base.find(s)]
+    got_req = Request(prompt_ids=list(prompt), max_tokens=24,
+                      temperature=0.0, stop=[s])
+    got = _run_all(sched, [got_req])[0]
+    assert got == want
+    assert s not in got
+    # early finish: fewer tokens than the budget were generated, and the
+    # slot + pages returned to the pools
+    assert got_req.completion_tokens < 24
+    assert sorted(sched._free) == list(range(core.batch))
+    assert not sched._slots
+
+
+def test_stop_in_first_fused_token(served):
+    core, tok, cfg, params = served
+    sched = Scheduler(core, tok)
+    prompt = tok.encode("abc", add_bos=True)
+    base = _run_all(sched, [Request(prompt_ids=list(prompt), max_tokens=8,
+                                    temperature=0.0)])[0]
+    first_char = base[0]
+    got = _run_all(sched, [Request(prompt_ids=list(prompt), max_tokens=8,
+                                   temperature=0.0, stop=[first_char])])[0]
+    assert got == ""
+    assert sorted(sched._free) == list(range(core.batch))
+
+
+def test_unmatched_holdback_flushes_at_natural_finish(served):
+    """Text that is a PREFIX of a stop string but never completes it is
+    legitimate output: it must flush when generation ends naturally."""
+    core, tok, cfg, params = served
+    sched = Scheduler(core, tok)
+    prompt = tok.encode("hold back", add_bos=True)
+    base = _run_all(sched, [Request(prompt_ids=list(prompt), max_tokens=10,
+                                    temperature=0.0)])[0]
+    assert base
+    stop = base[-1] + "\x00IMPOSSIBLE"   # final char becomes held, no match
+    got = _run_all(sched, [Request(prompt_ids=list(prompt), max_tokens=10,
+                                   temperature=0.0, stop=[stop])])[0]
+    assert got == base
+
+
+def test_logprobs_match_forward_pass(served):
+    core, tok, cfg, params = served
+    sched = Scheduler(core, tok)
+    prompt = tok.encode("logprob check", add_bos=True)
+    req = Request(prompt_ids=list(prompt), max_tokens=6, temperature=0.0,
+                  logprobs=True)
+    _run_all(sched, [req])
+    assert len(req.logprob_data) == req.completion_tokens > 0
+    # oracle: one full-sequence forward pass over prompt + generated ids
+    ids = list(prompt) + [t for t, _, _ in req.logprob_data]
+    logits = llama.forward(params, cfg, jnp.asarray([ids]))
+    lsm = jax.nn.log_softmax(np.asarray(logits, np.float32), axis=-1)
+    for i, (tid, lp, top) in enumerate(req.logprob_data):
+        pos = len(prompt) - 1 + i     # logits at pos predict token pos+1
+        want = float(lsm[0, pos, tid])
+        assert lp == pytest.approx(want, abs=2e-2), f"token {i}"
+        assert lp <= 0.0
+        assert top is None            # top_logprobs not requested
+
+
+def test_top_logprobs_rank_alternatives(served):
+    core, tok, cfg, params = served
+    sched = Scheduler(core, tok)
+    prompt = tok.encode("alternatives", add_bos=True)
+    req = Request(prompt_ids=list(prompt), max_tokens=5, temperature=0.0,
+                  logprobs=True, top_logprobs=3)
+    _run_all(sched, [req])
+    assert len(req.logprob_data) == req.completion_tokens
+    # decode-step tokens carry ranked alternatives; the fused first token
+    # legitimately has none (engine limitation, server substitutes itself)
+    with_top = [d for d in req.logprob_data[1:] if d[2]]
+    assert with_top, "no decode-step tokens carried top_logprobs"
+    for tid, lp, top in with_top:
+        assert len(top) == 3
+        lps = [l for _, l in top]
+        assert lps == sorted(lps, reverse=True)
+        # greedy: the sampled token IS the top alternative
+        assert top[0][0] == tid
+        assert top[0][1] == pytest.approx(lp, abs=1e-5)
+
+
+def test_seed_reproducible_across_batch_compositions(served):
+    core, tok, cfg, params = served
+    sched = Scheduler(core, tok)
+    prompt = tok.encode("sample with temperature", add_bos=True)
+    kw = dict(max_tokens=12, temperature=1.0, seed=42)
+    solo = _run_all(sched, [Request(prompt_ids=list(prompt), **kw)])[0]
+    # same seed, but now three other requests share the batch
+    others = [Request(prompt_ids=tok.encode(f"noise {i}", add_bos=True),
+                      max_tokens=12, temperature=1.0)
+              for i in range(3)]
+    mixed = _run_all(sched, [Request(prompt_ids=list(prompt), **kw)]
+                     + others)[0]
+    assert mixed == solo
+    diff = _run_all(sched, [Request(prompt_ids=list(prompt), max_tokens=12,
+                                    temperature=1.0, seed=43)])[0]
+    assert diff != solo
+
+
+# ------------------------------------------------------------- /v1 server
+
+class _FakeSched:
+    """Canned-output scheduler for server-layer formatting tests."""
+
+    def __init__(self, outputs):
+        self.tokenizer = ByteTokenizer()
+        self.outputs = list(outputs)
+        self.reqs = []
+
+    def submit(self, req):
+        self.reqs.append(req)
+        req._out = self.outputs.pop(0)
+        return req
+
+    def iter_text(self, req):
+        yield req._out
+
+
+def _post(server, path, body):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def drive():
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            resp = await client.post(path, json=body)
+            if resp.content_type == "application/json":
+                return resp.status, await resp.json()
+            return resp.status, await resp.text()
+        finally:
+            await client.close()
+
+    return asyncio.run(drive())
+
+
+def test_server_parses_contract_params():
+    from generativeaiexamples_tpu.engine.server import ModelServer
+
+    sched = _FakeSched(["hello"])
+    server = ModelServer(sched, "m")
+    status, _ = _post(server, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "hi"}],
+        "stop": "###", "seed": 7, "logprobs": True, "top_logprobs": 2})
+    assert status == 200
+    req = sched.reqs[0]
+    assert req.stop == ["###"]
+    assert req.seed == 7
+    assert req.logprobs and req.top_logprobs == 2
+
+
+def test_server_n_choices_and_logprobs_shape():
+    from generativeaiexamples_tpu.engine.server import ModelServer
+
+    sched = _FakeSched(["first answer", "second answer"])
+    server = ModelServer(sched, "m")
+    status, body = _post(server, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "hi"}], "n": 2,
+        "logprobs": True})
+    assert status == 200
+    assert [c["index"] for c in body["choices"]] == [0, 1]
+    texts = {c["message"]["content"] for c in body["choices"]}
+    assert texts == {"first answer", "second answer"}
+    # distinct seeds were auto-assigned per choice
+    assert sched.reqs[0].seed != sched.reqs[1].seed or \
+        sched.reqs[0].seed is None
+    # logprobs object rides each choice (content list; fake emitted none)
+    assert "logprobs" in body["choices"][0]
+    # n with tools is rejected loudly
+    sched2 = _FakeSched(["x"])
+    server2 = ModelServer(sched2, "m")
+    status2, _ = _post(server2, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "hi"}], "n": 2,
+        "tools": [{"type": "function",
+                   "function": {"name": "f", "parameters": {}}}]})
+    assert status2 == 400
+
+
+def test_chain_server_generate_enforces_stop():
+    """The /generate contract: stop strings end the stream even when the
+    serving chain ignores the setting (API-layer enforcement net)."""
+    from generativeaiexamples_tpu.server.api import ChainServer
+
+    class _Example:
+        def llm_chain(self, query, history, **settings):
+            # a chain that DROPS unknown settings: streams past the stop
+            yield "alpha beta "
+            yield "STO"
+            yield "P gamma delta"
+
+        def rag_chain(self, query, history, **settings):
+            yield from self.llm_chain(query, history, **settings)
+
+    server = ChainServer(_Example())
+    status, text = _post(server, "/generate", {
+        "messages": [{"role": "user", "content": "q"}],
+        "use_knowledge_base": False, "stop": ["STOP"]})
+    assert status == 200
+    chunks = [c for c in text.split("\n\n") if c.startswith("data: ")]
+    payload = "".join(
+        __import__("json").loads(c[6:])["choices"][0]["message"]["content"]
+        for c in chunks if c != "data: [DONE]"
+        and __import__("json").loads(c[6:]).get("choices"))
+    assert "alpha beta " in payload
+    assert "STOP" not in payload and "gamma" not in payload
