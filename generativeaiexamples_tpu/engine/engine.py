@@ -131,11 +131,17 @@ class DecodeState:
     rngs: jnp.ndarray
     gram_state: jnp.ndarray   # (B,) i32 — flat DFA state; 0 = unconstrained
     last_logprob: jnp.ndarray  # (B,) f32 — model logprob of tokens[b]
+    # (B, max_seq) i32 — each slot's token at each absolute position, valid
+    # through index cache.lengths[b] INCLUSIVE (history[b, lengths[b]] is
+    # the token being fed next). Written by prefill chunks, activation, and
+    # decode appends; read by prompt-lookup drafting (ops/speculative.py).
+    history: jnp.ndarray
 
     def tree_flatten(self):
         return ((self.cache, self.tokens, self.active, self.generated,
                  self.max_gen, self.temperature, self.top_k, self.top_p,
-                 self.rngs, self.gram_state, self.last_logprob), None)
+                 self.rngs, self.gram_state, self.last_logprob,
+                 self.history), None)
 
     @classmethod
     def tree_unflatten(cls, _, c):
@@ -202,6 +208,16 @@ class EngineCore:
             raise ValueError(
                 f"decode_steps_max ({km}) must be a power of two >= "
                 f"decode_steps_per_dispatch ({k})")
+        # prompt-lookup speculative decoding: every decode step widens to
+        # 1 + spec_draft positions per slot (drafted from the slot's own
+        # history, verified in the same weight read)
+        if engine_cfg.spec_decode not in ("on", "off"):
+            raise ValueError(f"unknown spec_decode {engine_cfg.spec_decode!r}")
+        if engine_cfg.spec_draft < 0:
+            raise ValueError(f"spec_draft ({engine_cfg.spec_draft}) must be "
+                             ">= 0 (0 disables drafting)")
+        self.spec_width = (1 + engine_cfg.spec_draft
+                           if engine_cfg.spec_decode == "on" else 1)
         self.max_pages_per_slot = -(-self.max_seq // self.page_size)
         # total physical pages: 0 = full slot capacity (+ null page 0)
         self.num_pages = (engine_cfg.num_pages or
@@ -300,6 +316,8 @@ class EngineCore:
                                   static_argnums=(9, 10, 11))
         self._activate_fn = jax.jit(self._activate_impl, donate_argnums=dn)
         self._release_fn = jax.jit(self._release_impl, donate_argnums=dn)
+        self._seed_hist_fn = jax.jit(self._seed_history_impl,
+                                     donate_argnums=dn)
         self._sample_fn = jax.jit(self._sample_impl)
 
     # ------------------------------------------------------------------ state
@@ -327,12 +345,13 @@ class EngineCore:
             rngs=jnp.zeros((B, 2), jnp.uint32),
             gram_state=jnp.zeros((B,), jnp.int32),
             last_logprob=jnp.zeros((B,), jnp.float32),
+            history=jnp.zeros((B, self.max_seq), jnp.int32),
         )
         if self.mesh is not None:
             rest = jax.device_put(
                 (state.tokens, state.active, state.generated, state.max_gen,
                  state.temperature, state.top_k, state.top_p, state.rngs,
-                 state.gram_state, state.last_logprob),
+                 state.gram_state, state.last_logprob, state.history),
                 self._replicated)
             state = DecodeState(cache, *rest)
         return state
@@ -360,6 +379,15 @@ class EngineCore:
 
     # ---------------------------------------------------------------- prefill
 
+    def _hist_write_chunk(self, history, slot, tokens_row, start_pos,
+                          chunk_len):
+        """Record one chunk's tokens in the slot's history row (padding
+        columns drop out of bounds)."""
+        C = tokens_row.shape[0]
+        j = jnp.arange(C, dtype=jnp.int32)
+        cols = jnp.where(j < chunk_len, start_pos + j, self.max_seq)
+        return history.at[slot, cols].set(tokens_row, mode="drop")
+
     def _chunk_impl(self, state: DecodeState, params, adapters, tokens,
                     page_row, slot, start_pos, chunk_len
                     ) -> Tuple[DecodeState, jnp.ndarray]:
@@ -369,7 +397,9 @@ class EngineCore:
             params, self.model_cfg, tokens, state.cache, page_row, slot,
             start_pos, chunk_len, self.num_pages, adapters=adapters,
             mesh=self.mesh)
-        return dataclasses.replace(state, cache=cache), logits[0]
+        hist = self._hist_write_chunk(state.history, slot, tokens[0],
+                                      start_pos, chunk_len)
+        return dataclasses.replace(state, cache=cache, history=hist), logits[0]
 
     def prefill_chunk(self, state: DecodeState, chunk_ids, page_row, slot: int,
                       start_pos: int) -> Tuple[DecodeState, jax.Array]:
@@ -418,12 +448,24 @@ class EngineCore:
                              jnp.asarray(page_row, jnp.int32),
                              jnp.int32(slot), jnp.int32(n))
 
+    def _hist_write_long(self, history, slot, tokens):
+        """Whole padded prompt into the slot's row (padding past n_tokens
+        is garbage beyond the valid index — allowed by the invariant)."""
+        S = tokens.shape[1]
+        if S >= self.max_seq:
+            return history.at[slot, :].set(tokens[0, :self.max_seq])
+        return jax.lax.dynamic_update_slice(
+            history, tokens.astype(jnp.int32),
+            (slot, jnp.int32(0)))
+
     def _prefill_long_impl(self, state: DecodeState, params, adapters,
                            tokens, page_row, slot, n_tokens):
         logits, cache = kv_cache.prefill_seq_parallel(
             params, self.model_cfg, tokens, state.cache, page_row, slot,
             n_tokens, self.num_pages, self.mesh, adapters=adapters)
-        return dataclasses.replace(state, cache=cache), logits[0]
+        hist = self._hist_write_long(state.history, slot, tokens)
+        return (dataclasses.replace(state, cache=cache, history=hist),
+                logits[0])
 
     def _pad_long(self, prompt_ids) -> Tuple[np.ndarray, int]:
         n = len(prompt_ids)
@@ -475,6 +517,8 @@ class EngineCore:
         logits, cache = kv_cache.prefill_seq_parallel(
             params, self.model_cfg, tokens, state.cache, page_row, slot,
             n_tokens, self.num_pages, self.mesh, adapters=adapters)
+        state = dataclasses.replace(
+            state, history=self._hist_write_long(state.history, slot, tokens))
         return self._activate_sampled(state, cache, logits, slot, generated,
                                       max_gen, temperature, top_k, top_p,
                                       seed)
@@ -508,6 +552,11 @@ class EngineCore:
                                      top_k[None], top_p[None])[0]
         lp = token_logprob(logits, tok[None])[0]
         alive = (tok != self.eos_id) & (generated < max_gen)
+        # the fused token enters history at its position (= prompt length,
+        # which prefill just stored in lengths[slot])
+        hist = state.history.at[
+            slot, jnp.minimum(cache.lengths[slot],
+                              self.max_seq - 1)].set(tok)
         upd = lambda arr, val: arr.at[slot].set(val)
         new_state = dataclasses.replace(
             state,
@@ -525,6 +574,7 @@ class EngineCore:
             # occupant (this path — single/long prefill — is unconstrained)
             gram_state=upd(state.gram_state, jnp.int32(0)),
             last_logprob=upd(state.last_logprob, lp),
+            history=hist,
         )
         return new_state, tok
 
@@ -539,6 +589,9 @@ class EngineCore:
             params, self.model_cfg, tokens, state.cache, page_row, slot,
             start_pos, chunk_len, self.num_pages, adapters=adapters,
             mesh=self.mesh)
+        state = dataclasses.replace(
+            state, history=self._hist_write_chunk(
+                state.history, slot, tokens[0], start_pos, chunk_len))
         return self._activate_sampled(state, cache, logits, slot, generated,
                                       max_gen, temperature, top_k, top_p,
                                       seed)
@@ -606,6 +659,16 @@ class EngineCore:
         # scatters out of range so they drop alongside the padding rows
         act_slots = jnp.where(is_last, slots, jnp.int32(self.batch))
         upd = lambda arr, val: arr.at[act_slots].set(val, mode="drop")
+        # history: every row's chunk tokens, plus the fused first token at
+        # its position (= prompt length) for is_last rows
+        G, C = tokens.shape
+        j = jnp.arange(C, dtype=jnp.int32)[None]              # (1, C)
+        h_rows = jnp.broadcast_to(slots[:, None], (G, C))
+        h_cols = jnp.where(j < chunk_len[:, None],
+                           start_pos[:, None] + j, self.max_seq)
+        hist = state.history.at[h_rows, h_cols].set(tokens, mode="drop")
+        tok_col = jnp.minimum(start_pos + chunk_len, self.max_seq - 1)
+        hist = hist.at[act_slots, tok_col].set(toks, mode="drop")
         new_state = dataclasses.replace(
             state,
             cache=cache,
@@ -618,6 +681,7 @@ class EngineCore:
             top_p=upd(state.top_p, top_p),
             rngs=upd(state.rngs, bases),
             last_logprob=upd(state.last_logprob, lps),
+            history=hist,
         )
         if use_grammar:
             nxt = grammar_advance(gram_states, toks, gram_table, tok_bytes,
@@ -855,8 +919,12 @@ class EngineCore:
                        max_gen, temperature, top_k, top_p, seed
                        ) -> DecodeState:
         upd = lambda arr, val: arr.at[slot].set(val)
+        hist = state.history.at[
+            slot, jnp.minimum(state.cache.lengths[slot],
+                              self.max_seq - 1)].set(token)
         return dataclasses.replace(
             state,
+            history=hist,
             tokens=upd(state.tokens, token),
             active=upd(state.active, True),
             generated=upd(state.generated, generated),
@@ -879,6 +947,20 @@ class EngineCore:
             jnp.int32(max_gen), jnp.float32(temperature), jnp.int32(top_k),
             jnp.float32(top_p), jnp.int32(seed))
 
+    def _seed_history_impl(self, state: DecodeState, slot, ids
+                           ) -> DecodeState:
+        return dataclasses.replace(
+            state, history=state.history.at[slot].set(ids))
+
+    def seed_history(self, state: DecodeState, slot: int, ids) -> DecodeState:
+        """Host-side history seed for a slot whose prompt prefix was served
+        from the prefix cache: those chunks never flow through a prefill
+        dispatch, so the drafting history must be written explicitly (one
+        (max_seq,) transfer per cache-hit admission)."""
+        padded = np.zeros((self.max_seq,), np.int32)
+        padded[:len(ids)] = ids[:self.max_seq]
+        return self._seed_hist_fn(state, jnp.int32(slot), jnp.asarray(padded))
+
     def _release_impl(self, state: DecodeState, slot) -> DecodeState:
         return dataclasses.replace(state,
                                    active=state.active.at[slot].set(False))
@@ -896,8 +978,18 @@ class EngineCore:
                      ) -> Tuple[DecodeState, Dict[str, Any]]:
         from generativeaiexamples_tpu.ops.sampling import (
             sample_logits_per_slot, token_logprob)
+        W = self.spec_width
+        B = self.batch
+        batch_ix = jnp.arange(B, dtype=jnp.int32)
 
-        def step(state, _):
+        def hist_append(history, active, cols, vals):
+            """Append emitted tokens to history rows (inactive / OOB drop)."""
+            safe = jnp.where(active & (cols < self.max_seq), cols,
+                             self.max_seq)
+            return history.at[batch_ix if vals.ndim == 1 else
+                              batch_ix[:, None], safe].set(vals, mode="drop")
+
+        def step_narrow(state):
             logits, cache = kv_cache.decode_step(
                 params, self.model_cfg, state.tokens, state.cache,
                 page_table, state.active, self.num_pages, adapters=adapters,
@@ -936,6 +1028,8 @@ class EngineCore:
                 active=active,
                 generated=generated,
                 last_logprob=jnp.where(state.active, lp, state.last_logprob),
+                history=hist_append(state.history, state.active, lengths,
+                                    sampled),
             )
             if use_grammar:
                 adv = grammar_advance(state.gram_state, sampled, gram_table,
@@ -944,37 +1038,172 @@ class EngineCore:
                     new_state,
                     gram_state=jnp.where(state.active, adv,
                                          state.gram_state))
-            out = {"sampled": sampled, "emitted": state.active, "done": done,
-                   "hit_eos": hit_eos, "input_tokens": state.tokens,
-                   "sampled_lp": lp, "input_lp": state.last_logprob}
+            out = {"sampled": sampled[None], "emitted": state.active[None],
+                   "done": done[None], "hit_eos": hit_eos[None],
+                   "input_tokens": state.tokens[None],
+                   "sampled_lp": lp[None],
+                   "input_lp": state.last_logprob[None]}
             if want_top:
                 # top-TOP_LP alternatives per step (the OpenAI top_logprobs
                 # surface) — a separate compile variant, so the common path
                 # never pays the extra vocab sort
                 top_vals, top_ids = jax.lax.top_k(raw, TOP_LP)
                 lse = jax.nn.logsumexp(raw, axis=-1, keepdims=True)
-                out["top_ids"] = top_ids.astype(jnp.int32)     # (B, K)
-                out["top_lps"] = top_vals - lse                # (B, K)
+                out["top_ids"] = top_ids.astype(jnp.int32)[None]  # (1, B, K)
+                out["top_lps"] = (top_vals - lse)[None]
             return new_state, out
 
-        # K fused steps per dispatch: the host syncs once per K tokens/slot,
-        # which is what makes decode dispatch-latency-proof (SURVEY hard-part
-        # #3; essential over the tunneled single-chip dev setup, still a win
-        # on local PCIe/ICI-attached hosts). outs arrays are (K, B).
+        def step_wide(state):
+            # prompt-lookup speculative verify: draft W-1 tokens from the
+            # slot's own history, run ONE widened step over current+drafts,
+            # accept the longest prefix matching the per-position seeded
+            # samples. Decode is weight-read-bound, so the widened step
+            # costs ~one narrow step; accepted drafts are ~free tokens,
+            # and the emitted stream is token-identical to sequential
+            # decoding (exact-match acceptance under the request's keys).
+            from generativeaiexamples_tpu.ops.sampling import (
+                grammar_advance, grammar_mask)
+            from generativeaiexamples_tpu.ops.speculative import (
+                acceptance, draft_lookup)
+            L = state.cache.lengths
+            draft, dlen = draft_lookup(state.history, L, W - 1,
+                                       self.cfg.spec_ngram)
+            if use_grammar:
+                # constrained slots decode sequentially (the DFA advances
+                # one sampled token at a time); their drafts are voided
+                dlen = jnp.where(state.gram_state > 0, 0, dlen)
+            inputs = jnp.concatenate([state.tokens[:, None], draft], axis=1)
+            logits_w, cache = kv_cache.decode_step_wide(
+                params, self.model_cfg, inputs, state.cache, page_table,
+                state.active, self.num_pages, adapters=adapters,
+                mesh=self.mesh)
+            raw = logits_w.astype(jnp.float32)            # (B, W, V)
+            logits_s = raw
+            if use_grammar:
+                m0 = grammar_mask(
+                    logits_s[:, 0], state.gram_state,
+                    state.max_gen - state.generated - 1, self.eos_id,
+                    gram_table, gram_accept, gram_dist, tok_bytes, tok_lens)
+                logits_s = jnp.concatenate([m0[:, None], logits_s[:, 1:]],
+                                           axis=1)
+            live_temp = jnp.where(state.active, state.temperature, 0.0)
+            pos_w = jnp.arange(W, dtype=jnp.int32)[None]      # (1, W)
+            gen_i = state.generated[:, None] + pos_w          # (B, W)
+            keys = jax.vmap(jax.vmap(jax.random.fold_in, (None, 0)))(
+                state.rngs, gen_i)                            # (B, W, 2)
+            V = logits_s.shape[-1]
+            rep = lambda x: jnp.repeat(x, W, axis=0)
+            sampled = sample_logits_per_slot(
+                keys.reshape(B * W, 2), logits_s.reshape(B * W, V),
+                rep(live_temp), rep(state.top_k),
+                rep(state.top_p)).reshape(B, W)
+            lp = token_logprob(raw.reshape(B * W, V),
+                               sampled.reshape(B * W)).reshape(B, W)
+            e = acceptance(sampled, draft, dlen)              # (B,) 1..W
+            # accepted positions must have REAL pages behind their KV
+            # writes (the scheduler may not have grown the row that far
+            # yet — such rows landed on the null page): clamp to the
+            # leading covered span. Position L is always covered.
+            covered = page_table[
+                batch_ix[:, None],
+                jnp.minimum(L[:, None] + pos_w,
+                            self.max_seq - 1) // self.page_size] != 0
+            lead_cov = jnp.sum(jnp.cumprod(covered.astype(jnp.int32),
+                                           axis=1), axis=1)
+            e = jnp.minimum(e, jnp.maximum(lead_cov, 1))
+            # budget / capacity caps (active slots always afford >= 1)
+            e = jnp.minimum(e, jnp.maximum(state.max_gen - state.generated,
+                                           1))
+            e = jnp.minimum(e, jnp.maximum((self.max_seq - 1) - L, 1))
+            # eos inside the accepted window truncates it
+            is_eos = sampled == self.eos_id
+            first_eos = jnp.min(jnp.where(is_eos, pos_w,
+                                          jnp.int32(W)), axis=1)
+            e = jnp.minimum(e, first_eos + 1)
+            emitted_w = state.active[:, None] & (pos_w < e[:, None])
+            generated = state.generated + jnp.where(state.active, e, 0)
+            lengths = jnp.where(state.active, L + e, L)
+            last_ix = (e - 1)[:, None]
+            last_tok = jnp.take_along_axis(sampled, last_ix, axis=1)[:, 0]
+            last_lp = jnp.take_along_axis(lp, last_ix, axis=1)[:, 0]
+            last_eos = jnp.take_along_axis(is_eos, last_ix, axis=1)[:, 0]
+            out_of_budget = generated >= state.max_gen
+            out_of_cache = lengths >= self.max_seq - 1
+            done_slot = state.active & (last_eos | out_of_budget
+                                        | out_of_cache)
+            done_w = emitted_w & (pos_w == last_ix) & done_slot[:, None]
+            active = state.active & ~done_slot
+            new_state = dataclasses.replace(
+                state,
+                cache=dataclasses.replace(cache, lengths=lengths),
+                tokens=jnp.where(state.active, last_tok, state.tokens),
+                active=active,
+                generated=generated,
+                last_logprob=jnp.where(state.active, last_lp,
+                                       state.last_logprob),
+                history=hist_append(state.history, emitted_w,
+                                    L[:, None] + 1 + pos_w, sampled),
+            )
+            if use_grammar:
+                adv = grammar_advance(state.gram_state, sampled[:, 0],
+                                      gram_table, tok_bytes, tok_lens)
+                new_state = dataclasses.replace(
+                    new_state,
+                    gram_state=jnp.where(state.active, adv,
+                                         state.gram_state))
+            t = lambda x: jnp.transpose(x)                    # (B,W)→(W,B)
+            out = {"sampled": t(sampled), "emitted": t(emitted_w),
+                   "done": t(done_w), "hit_eos": t(is_eos),
+                   "input_tokens": t(inputs),
+                   "sampled_lp": t(lp),
+                   "input_lp": jnp.concatenate(
+                       [state.last_logprob[None],
+                        jnp.zeros((W - 1, B), jnp.float32)])}
+            if want_top:
+                top_vals, top_ids = jax.lax.top_k(raw, TOP_LP)  # (B, W, K)
+                lse = jax.nn.logsumexp(raw, axis=-1, keepdims=True)
+                out["top_ids"] = jnp.transpose(
+                    top_ids.astype(jnp.int32), (1, 0, 2))       # (W, B, K)
+                out["top_lps"] = jnp.transpose(top_vals - lse, (1, 0, 2))
+            return new_state, out
+
+        def step(state, _):
+            return step_wide(state) if W > 1 else step_narrow(state)
+
+        # K fused steps per dispatch: the host syncs once per K (or K·W
+        # with speculation) tokens/slot, which is what makes decode
+        # dispatch-latency-proof (SURVEY hard-part #3; essential over the
+        # tunneled single-chip dev setup, still a win on local PCIe/ICI-
+        # attached hosts). outs arrays are (K, W, B).
         state, outs = jax.lax.scan(step, state, None, length=steps)
         # one contiguous int32 block so the host fetches the whole dispatch
         # result in a single transfer (a pytree device_get pays one round
         # trip PER LEAF — 5x the latency on a remote-attached chip);
-        # float rows ride as raw bits (bitcast), not int casts
-        as_row = lambda k: (jax.lax.bitcast_convert_type(
-            outs[k], jnp.int32) if k in _LP_FIELDS
-            else outs[k].astype(jnp.int32))
+        # float rows ride as raw bits (bitcast), not int casts. Micro-rows
+        # are (step, position) pairs flattened in order.
+        R = steps * W
+
+        def as_row(k):
+            v = outs[k]
+            if k in _LP_FIELDS:
+                v = jax.lax.bitcast_convert_type(v, jnp.int32)
+            return v.astype(jnp.int32).reshape(R, B)
         rows = [as_row(k) for k in _PACKED_FIELDS]
         if want_top:
-            rows += list(jnp.moveaxis(outs["top_ids"], -1, 0))
-            rows += list(jnp.moveaxis(jax.lax.bitcast_convert_type(
-                outs["top_lps"], jnp.int32), -1, 0))
+            tid = jnp.moveaxis(outs["top_ids"], -1, 0)    # (K_top, K, W, B)
+            tlp = jnp.moveaxis(jax.lax.bitcast_convert_type(
+                outs["top_lps"], jnp.int32), -1, 0)
+            rows += [r.reshape(R, B) for r in tid]
+            rows += [r.reshape(R, B) for r in tlp]
         outs["packed"] = jnp.stack(rows)
+        # device-side convenience views share the packed micro-row layout
+        # ((steps·W, B) — identical to the pre-speculation (steps, B) when
+        # W == 1, which direct-decode callers and tests rely on)
+        for k in _PACKED_FIELDS:
+            outs[k] = outs[k].reshape(R, B)
+        if want_top:
+            outs["top_ids"] = outs["top_ids"].reshape(R, B, TOP_LP)
+            outs["top_lps"] = outs["top_lps"].reshape(R, B, TOP_LP)
         return state, outs
 
     def decode(self, state: DecodeState, page_table: jax.Array,

@@ -412,7 +412,9 @@ def decode_step(params: llama.Params, cfg: llama.LlamaConfig,
                 adapters: Optional[llama.Params] = None,
                 mesh=None,
                 ) -> Tuple[jnp.ndarray, PagedKVCache]:
-    """One paged decode step for every slot in the batch.
+    """One paged decode step for every slot in the batch — the Q == 1
+    case of :func:`decode_step_wide` (single implementation: TP shard_map
+    specs, quantized page writes, and the XLA fallback live there once).
 
     tokens: (B,) last sampled token per slot; page_table: (B, max_pages);
     write_mask: (B,) bool — slots allowed to append (inactive slots write to
@@ -420,40 +422,67 @@ def decode_step(params: llama.Params, cfg: llama.LlamaConfig,
     Returns logits (B, V) and the cache with ``lengths + 1`` (the engine
     restores lengths of inactive slots).
     """
-    B = tokens.shape[0]
+    logits, new_cache = decode_step_wide(
+        params, cfg, tokens[:, None], cache, page_table, write_mask,
+        num_pages, adapters=adapters, mesh=mesh)
+    return logits[:, 0], PagedKVCache(
+        k=new_cache.k, v=new_cache.v, lengths=cache.lengths + 1,
+        k_s=new_cache.k_s, v_s=new_cache.v_s)
+
+
+def decode_step_wide(params: llama.Params, cfg: llama.LlamaConfig,
+                     tokens: jnp.ndarray, cache: PagedKVCache,
+                     page_table: jnp.ndarray, write_mask: jnp.ndarray,
+                     num_pages: int,
+                     adapters: Optional[llama.Params] = None,
+                     mesh=None,
+                     ) -> Tuple[jnp.ndarray, PagedKVCache]:
+    """Q-token speculative-VERIFY decode step (ops/speculative.py drafts).
+
+    tokens: (B, Q) — each slot's current token followed by its Q-1 drafted
+    continuations, occupying positions lengths[b]..lengths[b]+Q-1. All Q
+    tokens' KV scatter into the slot's pages (rows past the block-table
+    capacity, and all rows of masked-out slots, land on the null page);
+    query qi attends positions < lengths[b]+qi+1 — per-query causal
+    offsets, otherwise identical to :func:`decode_step`. Returns logits
+    (B, Q, V) and the cache with ``lengths`` UNCHANGED: only the engine
+    knows how many drafts were accepted, so it advances lengths by the
+    accepted count (rejected positions' KV rows are dead until a future
+    step overwrites them — attention masks by length, so they are never
+    read). Q == 1 degenerates to exactly one normal decode step.
+    """
+    B, Q = tokens.shape
     ps = cache.page_size
     maxp = page_table.shape[1]
     T = maxp * ps
     KV, HD = cfg.n_kv_heads, cfg.head_dim
 
-    positions = cache.lengths[:, None]                               # (B, 1)
-    h = llama.embed_tokens(params, cfg, tokens[:, None])
+    L = cache.lengths                                        # (B,)
+    positions = L[:, None] + jnp.arange(Q, dtype=jnp.int32)[None]   # (B, Q)
+    h = llama.embed_tokens(params, cfg, tokens)
     cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
-    new_lengths = cache.lengths + 1
+    # rows valid for attention INCLUDE this step's Q writes. NOT clamped to
+    # the pool capacity: the pallas kernel reconstructs query positions as
+    # attn_len - Q + qi, so a clamp would shift every query's causal limit
+    # down near the context cap (its page-index map clamps DMAs safely on
+    # its own, and the XLA mask below only indexes real rows).
+    attn_len = L + Q
 
-    batch_ix = jnp.arange(B, dtype=jnp.int32)
-    rows = jnp.where(write_mask,
-                     page_table[batch_ix, cache.lengths // ps],
-                     jnp.int32(0))
-    offs = cache.lengths % ps
+    batch_ix = jnp.arange(B, dtype=jnp.int32)[:, None]
+    ok = write_mask[:, None] & (positions < T)
+    rows = jnp.where(ok, page_table[batch_ix, positions // ps], jnp.int32(0))
+    offs = positions % ps                                    # (B, Q)
 
     use_pallas = (cfg.attn_impl == "pallas" and cfg.sliding_window == 0
                   and pallas_ops.paged_decode_supported(ps, HD))
     tp = _tp_degree(mesh)
     if use_pallas and tp > 1:
-        # per-shard ragged decode over the kv-head-sharded pool: each
-        # shard DMAs only its own KV*HD/tp slice of every page (the pool
-        # is laid out P(None, None, "tensor") by the engine), so the
-        # flagship decode-bandwidth kernel runs in exactly the
-        # TP-sharded production config (round-2 weakness #3). Quantized
-        # pools additionally shard the per-head scales over "tensor".
         if cache.quantized:
             _sharded_paged = partial(
                 jax.shard_map, mesh=mesh,
                 in_specs=(P(None, None, "tensor", None),
                           P(None, None, "tensor"), P(None, None, "tensor"),
                           P(None, None), P(None), P(),
-                          # scale pools are (rows, KV, page): heads on axis 1
                           P(None, "tensor", None), P(None, "tensor", None)),
                 out_specs=P(None, None, "tensor", None), check_vma=False)(
                 lambda q_, kp_, vp_, pt_, ln_, ix_, ks_, vs_:
@@ -474,42 +503,39 @@ def decode_step(params: llama.Params, cfg: llama.LlamaConfig,
                               _sharded_paged_raw(q_, kp_, vp_, pt_, ln_, ix_))
 
     quant = cache.quantized
+    cache_positions = jnp.broadcast_to(
+        jnp.arange(T, dtype=jnp.int32)[None], (B, T))
 
     def attn_and_update(q, k, v, pools, idx):
-        flat_rows = idx * num_pages + rows       # layer idx's pages
+        flat_rows = idx * num_pages + rows                   # (B, Q)
         if quant:
             k_pool, v_pool, ks_pool, vs_pool = pools
-            kq, ks = _kv_quantize(k[:, 0].reshape(B, KV * HD), KV, HD)
-            vq, vs = _kv_quantize(v[:, 0].reshape(B, KV * HD), KV, HD)
+            kq, ks = _kv_quantize(k.reshape(B, Q, KV * HD), KV, HD)
+            vq, vs = _kv_quantize(v.reshape(B, Q, KV * HD), KV, HD)
             new_k = k_pool.at[flat_rows, offs].set(kq)
             new_v = v_pool.at[flat_rows, offs].set(vq)
-            # scale pool is (rows, KV, ps): one (B, KV) column write
             new_ks = ks_pool.at[flat_rows, :, offs].set(ks)
             new_vs = vs_pool.at[flat_rows, :, offs].set(vs)
             out_pools = (new_k, new_v, new_ks, new_vs)
         else:
             new_k = pools[0].at[flat_rows, offs].set(
-                k[:, 0].astype(pools[0].dtype).reshape(B, KV * HD))
+                k.astype(pools[0].dtype).reshape(B, Q, KV * HD))
             new_v = pools[1].at[flat_rows, offs].set(
-                v[:, 0].astype(pools[1].dtype).reshape(B, KV * HD))
+                v.astype(pools[1].dtype).reshape(B, Q, KV * HD))
             new_ks = new_vs = None
             out_pools = (new_k, new_v)
         if use_pallas:
-            # reads this layer's pages straight from the carried pool via
-            # the block table + layer index — no dense gather, no slice,
-            # no reshape (any of which copies the multi-GB carry); the
-            # quantized pool's scales row-scale scores/probs in the kernel
             if tp > 1:
                 ctx = _sharded_paged(q, new_k, new_v, page_table,
-                                     new_lengths, idx, new_ks, new_vs)
+                                     attn_len, idx, new_ks, new_vs)
             else:
                 ctx = pallas_ops.paged_decode(q, new_k, new_v, page_table,
-                                              new_lengths, layer=idx,
+                                              attn_len, layer=idx,
                                               pages_per_layer=num_pages,
                                               k_scales=new_ks,
                                               v_scales=new_vs)
         else:
-            def sTd(sp):       # (B, maxp, KV, ps) pool gather → (B, T, KV)
+            def sTd(sp):
                 return (sp[idx * num_pages + page_table]
                         .transpose(0, 1, 3, 2).reshape(B, T, KV))
             k_dense = new_k[idx * num_pages + page_table].reshape(
@@ -520,16 +546,19 @@ def decode_step(params: llama.Params, cfg: llama.LlamaConfig,
                 B, T, KV, HD) if not quant else _kv_dequant_dense(
                 new_v[idx * num_pages + page_table].reshape(B, T, -1),
                 sTd(new_vs), KV, HD, h.dtype)
-            ctx = mha_decode(q, k_dense, v_dense, new_lengths,
-                             window=cfg.sliding_window)
+            ctx = mha_prefill(
+                q, k_dense, v_dense, q_positions=positions,
+                kv_positions=cache_positions,
+                kv_mask=cache_positions < attn_len[:, None], causal=True,
+                window=cfg.sliding_window)
         return ctx, out_pools
 
     pools_in = ((cache.k, cache.v, cache.k_s, cache.v_s) if quant
                 else (cache.k, cache.v))
     h, pools = llama.scan_blocks_inplace(
         cfg, h, params, pools_in, cos, sin, attn_and_update, adapters)
-    logits = llama._unembed(cfg, params, h)[:, 0]
-    return logits, PagedKVCache(k=pools[0], v=pools[1], lengths=new_lengths,
+    logits = llama._unembed(cfg, params, h)                  # (B, Q, V)
+    return logits, PagedKVCache(k=pools[0], v=pools[1], lengths=cache.lengths,
                                 k_s=pools[2] if quant else None,
                                 v_s=pools[3] if quant else None)
 

@@ -179,6 +179,9 @@ class Scheduler:
         # chain by the weights that produce KV (bumped per adapter set).
         self._caching = hasattr(self._alloc, "match")
         self._cache_seed = 0
+        # speculative decoding widens every decode step to W positions per
+        # slot (page growth and in-flight accounting are in POSITIONS)
+        self._spec_w = getattr(core, "spec_width", 1)
         self._table = np.zeros((core.batch, core.max_pages_per_slot), np.int32)
         self._table_dev: Optional[jax.Array] = None
         self._inflight: Deque[tuple] = deque()   # dispatched, not yet synced
@@ -543,6 +546,12 @@ class Scheduler:
             if self._caching:
                 if shared:
                     REGISTRY.counter("prefix_hit_tokens").inc(shared)
+                    if self._spec_w > 1 and hasattr(self.core,
+                                                    "seed_history"):
+                        # cache-hit chunks skip prefill, so the drafting
+                        # history row must be seeded explicitly
+                        self._state = self.core.seed_history(
+                            self._state, slot, job.ids)
                 REGISTRY.counter("prefix_prompt_tokens").inc(len(job.ids))
             if job.admit_seq == 0:
                 # resumes keep their original admission age, so preemption
@@ -778,13 +787,19 @@ class Scheduler:
             while self._slots.get(slot) is job:
                 # total_len is the host view (updated only when a dispatch is
                 # processed); writes already in flight plus this dispatch's
-                # K steps land at indices up to total_len + pending + K - 1
-                # (ceiling: covers just-activated and mid-decode cases).
-                # Device-side out_of_cache keeps writes under max_seq,
-                # mirrored here by the table-row clamp.
+                # K steps land at indices up to total_len + pending +
+                # K·W - 1 (W = speculative width; ceiling: covers just-
+                # activated and mid-decode cases). Device-side out_of_cache
+                # keeps writes under max_seq, mirrored here by the
+                # table-row clamp; rows the grower could not cover land on
+                # the null page and the device clamps acceptance to the
+                # covered span, so a starved grow costs speculation, not
+                # correctness.
                 next_write = job.total_len + self._pending_steps
-                target = min(self.core.pages_for(next_write + steps - 1),
-                             self.core.max_pages_per_slot)
+                target = min(
+                    self.core.pages_for(next_write + steps * self._spec_w
+                                        - 1),
+                    self.core.max_pages_per_slot)
                 minimum = min(self.core.pages_for(next_write),
                               self.core.max_pages_per_slot)
                 if len(job.pages) >= target:
@@ -813,7 +828,8 @@ class Scheduler:
             if len(job.pages) < self.core.max_pages_per_slot:
                 next_write = job.total_len + self._pending_steps
                 covered = len(job.pages) * self.core.page_size - next_write
-                effective = max(1, min(effective, covered))
+                effective = max(1, min(effective,
+                                       covered // self._spec_w))
             # at full table capacity the device-side out_of_cache guard ends
             # the slot before it could outrun its row — no clamp needed
         # round down to a power of two: `steps` is a compile-time constant of
@@ -880,8 +896,9 @@ class Scheduler:
         cap = self.core.cfg.decode_steps_max or base
         if cap <= base or len(self._slots) < self.core.batch // 2:
             return base
-        rem = min(j.request.max_tokens - len(j.gen_ids)
-                  for j in self._slots.values()) - self._pending_steps
+        rem = (min(j.request.max_tokens - len(j.gen_ids)
+                   for j in self._slots.values())
+               - self._pending_steps) // self._spec_w
         steps = base
         while steps * 2 <= min(cap, rem):
             steps *= 2
@@ -920,21 +937,35 @@ class Scheduler:
         packed = self._fetcher.submit(_fetch, out["packed"])
         # snapshot slot→job at dispatch time: a slot freed and reused while
         # this dispatch is in flight must not leak the old job's tokens into
-        # the new job's stream (identity-checked at processing)
-        self._inflight.append((steps, packed, fresh, dict(self._slots)))
-        self._pending_steps += steps
+        # the new job's stream (identity-checked at processing).
+        # in-flight accounting is in POSITIONS (steps × speculative width)
+        self._inflight.append((steps * self._spec_w, packed, fresh,
+                               dict(self._slots)))
+        self._pending_steps += steps * self._spec_w
         REGISTRY.counter("decode_steps").inc(steps)
 
     def _process_decode(self) -> None:
-        """Sync + fan out the OLDEST in-flight dispatch (FIFO)."""
-        steps, packed, fresh, active_map = self._inflight.popleft()
-        self._pending_steps -= steps
+        """Sync + fan out the OLDEST in-flight dispatch (FIFO). Rows of the
+        packed block are (step, position) micro-steps; with speculation a
+        step can emit up to W accepted tokens."""
+        positions, packed, fresh, active_map = self._inflight.popleft()
+        self._pending_steps -= positions
         # one transfer per dispatch, already in flight on the fetcher thread
         t0 = time.perf_counter()
         out = unpack_decode_out(packed.result())
         REGISTRY.histogram("sync_wait_s").observe(time.perf_counter() - t0)
         now = time.perf_counter()
         REGISTRY.counter("tokens_generated").inc(int(out["emitted"].sum()))
+        if self._spec_w > 1:
+            # acceptance telemetry: tokens beyond one per (step, slot) are
+            # speculation wins
+            em = out["emitted"].reshape(-1, self._spec_w,
+                                        out["emitted"].shape[1])
+            per_step = em.sum(axis=1)
+            REGISTRY.counter("spec_bonus_tokens").inc(
+                int(np.maximum(per_step - 1, 0).sum()))
+            REGISTRY.counter("spec_base_steps").inc(
+                int((per_step > 0).sum()))
         for slot, job in fresh:
             if self._slots.get(slot) is not job:
                 continue  # preempted while in flight; resume re-samples
@@ -946,7 +977,7 @@ class Scheduler:
             req = job.request
             n_top = (min(req.top_logprobs, len(out.get("top_ids", ())))
                      if req.logprobs else 0)
-            for k in range(steps):
+            for k in range(out["sampled"].shape[0]):
                 if not out["emitted"][k, slot]:
                     continue
                 if not (out["done"][k, slot] and out["hit_eos"][k, slot]):

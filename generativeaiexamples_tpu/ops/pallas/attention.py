@@ -249,7 +249,7 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
 
 def _paged_decode_kernel(lens_ref, table_ref, layer_ref, q_ref, k_ref, v_ref,
                          *rest, ps: int, scale: float, KV: int, G: int,
-                         HD: int, quant: bool):
+                         HD: int, quant: bool, Q: int = 1):
     # rest = (ks_ref, vs_ref, o_ref, acc, m, l) when quant else (o_ref, …):
     # a quantized pool carries int8 pages + (KV, ps) per-token-per-head
     # scale tiles; the dequant folds past the dots (scores/probabilities
@@ -266,6 +266,12 @@ def _paged_decode_kernel(lens_ref, table_ref, layer_ref, q_ref, k_ref, v_ref,
     # model; this layout cuts the grid by KV x). ti is the LOGICAL page
     # index (position ti*ps + row); table_ref/layer_ref ride in SMEM for the
     # index maps alone.
+    #
+    # Q > 1 is the SPECULATIVE-VERIFY variant: the slot carries Q queries at
+    # consecutive positions length-Q .. length-1 (draft verification — the
+    # same page DMAs amortize over Q·G score rows, which also feeds the MXU
+    # fatter tiles). Query qi may attend keys at positions < length-Q+1+qi:
+    # per-query causal offsets, the only semantic difference from Q == 1.
     del table_ref, layer_ref
     b = pl.program_id(0)
     ti = pl.program_id(1)
@@ -279,21 +285,25 @@ def _paged_decode_kernel(lens_ref, table_ref, layer_ref, q_ref, k_ref, v_ref,
 
     length = lens_ref[b]
     lim = (jnp.maximum(length, 1) - 1) // ps
+    QG = Q * G
 
     @pl.when(ti <= lim)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)           # (KV*G, HD)
+        q = q_ref[0].astype(jnp.float32)           # (KV*Q*G, HD)
         k = k_ref[0].astype(jnp.float32)           # (ps, KV*HD)
         v = v_ref[0].astype(jnp.float32)
-        t_mask = (ti * ps + jax.lax.broadcasted_iota(
-            jnp.int32, (G, ps), 1)) < length
+        # per-query causal limit: row r of a kv block is query r // G
+        t_pos = ti * ps + jax.lax.broadcasted_iota(jnp.int32, (QG, ps), 1)
+        q_lim = (length - Q + 1
+                 + jax.lax.broadcasted_iota(jnp.int32, (QG, ps), 0) // G)
+        t_mask = t_pos < q_lim
         for kv in range(KV):                       # static unroll over heads
             k_head = k[:, kv * HD:(kv + 1) * HD]
             v_head = v[:, kv * HD:(kv + 1) * HD]
             s = jax.lax.dot_general(
-                q[kv * G:(kv + 1) * G], k_head,
+                q[kv * QG:(kv + 1) * QG], k_head,
                 (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale   # (G, ps)
+                preferred_element_type=jnp.float32) * scale   # (QG, ps)
             if quant:
                 # dequant folded past the dot: q·(k_t·s_t) = (q·k_t)·s_t —
                 # one (1, ps) row-scale of the score matrix instead of a
@@ -301,7 +311,7 @@ def _paged_decode_kernel(lens_ref, table_ref, layer_ref, q_ref, k_ref, v_ref,
                 # native f32 tile
                 s = s * ks_ref[0][kv:kv + 1, :]
             s = jnp.where(t_mask, s, NEG_INF)
-            rows = slice(kv * G, (kv + 1) * G)
+            rows = slice(kv * QG, (kv + 1) * QG)
             m_prev = m_ref[rows, :1]
             l_prev = l_ref[rows, :1]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -309,8 +319,8 @@ def _paged_decode_kernel(lens_ref, table_ref, layer_ref, q_ref, k_ref, v_ref,
             p = jnp.exp(s - m_new)
             l_ref[rows, :] = jnp.broadcast_to(
                 alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True),
-                (G, l_ref.shape[1]))
-            m_ref[rows, :] = jnp.broadcast_to(m_new, (G, m_ref.shape[1]))
+                (QG, l_ref.shape[1]))
+            m_ref[rows, :] = jnp.broadcast_to(m_new, (QG, m_ref.shape[1]))
             if quant:
                 # Σ_t p_t·(v_t·s_t) = (p·s) @ v — row-scale p instead of
                 # dequantizing V
@@ -332,9 +342,16 @@ def paged_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
                  k_scales: Optional[jnp.ndarray] = None,
                  v_scales: Optional[jnp.ndarray] = None,
                  interpret: Optional[bool] = None) -> jnp.ndarray:
-    """Single-token decode attention straight off the paged KV pool.
+    """Decode attention straight off the paged KV pool, 1..Q queries/slot.
 
-    q: (B, 1, H, HD); k_pages, v_pages: the physical pool in the kernel's
+    q: (B, Q, H, HD) — Q consecutive positions per slot, query qi at
+    position ``lengths[b] - Q + qi`` (Q=1 is classic decode; Q>1 is the
+    speculative-verify step: drafted tokens' KV is already written, the
+    per-query causal offset masks each query to its own prefix, and the
+    same page DMAs amortize over Q·G score rows). ``lengths`` counts live
+    rows INCLUDING all Q queries' writes.
+
+    k_pages, v_pages: the physical pool in the kernel's
     NATIVE flat layout (N, page, KV*HD) — for a multi-layer pool, N = L*P
     with ``layer`` a ()/(1,) dynamic layer index and ``pages_per_layer`` = P,
     so the caller's layer loop neither slices nor reshapes the pool (on a
@@ -356,7 +373,7 @@ def paged_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
     ((KV, page) blocks are native f32 tiles), so no per-element dequant
     runs in the kernel (the TRT-LLM kv-cache-quantization capability).
     """
-    B, _, H, HD = q.shape
+    B, Q, H, HD = q.shape
     N, ps, KVHD = k_pages.shape
     KV = KVHD // HD
     P = pages_per_layer if pages_per_layer is not None else N
@@ -368,7 +385,10 @@ def paged_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
     if interpret is None:
         interpret = _interpret_default()
 
-    qg = q.reshape(B, KV * G, HD)
+    # kv-major rows so the kernel's per-head slicing holds for any Q:
+    # row = kv*(Q*G) + qi*G + g
+    qg = (q.reshape(B, Q, KV, G, HD).transpose(0, 2, 1, 3, 4)
+          .reshape(B, KV * Q * G, HD))
 
     def q_map(b, ti, lens, table, lyr):
         return (b, 0, 0)
@@ -378,7 +398,7 @@ def paged_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
         return (lyr[0] * P + table[b, jnp.minimum(ti, lim)], 0, 0)
 
     in_specs = [
-        pl.BlockSpec((1, KV * G, HD), q_map),
+        pl.BlockSpec((1, KV * Q * G, HD), q_map),
         pl.BlockSpec((1, ps, KV * HD), kv_map),
         pl.BlockSpec((1, ps, KV * HD), kv_map),
     ]
@@ -390,25 +410,26 @@ def paged_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
 
     kernel = functools.partial(_paged_decode_kernel, ps=ps,
                                scale=1.0 / (HD ** 0.5), KV=KV, G=G, HD=HD,
-                               quant=quant)
+                               quant=quant, Q=Q)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(B, maxp),
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((1, KV * G, HD), q_map),
+            out_specs=pl.BlockSpec((1, KV * Q * G, HD), q_map),
             scratch_shapes=[
-                pltpu.VMEM((KV * G, HD), jnp.float32),
-                pltpu.VMEM((KV * G, 128), jnp.float32),
-                pltpu.VMEM((KV * G, 128), jnp.float32),
+                pltpu.VMEM((KV * Q * G, HD), jnp.float32),
+                pltpu.VMEM((KV * Q * G, 128), jnp.float32),
+                pltpu.VMEM((KV * Q * G, 128), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((B, KV * G, HD), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, KV * Q * G, HD), q.dtype),
         interpret=interpret,
     )(lengths.astype(jnp.int32), page_table.astype(jnp.int32),
       jnp.reshape(layer, (1,)).astype(jnp.int32), *args)
-    return out.reshape(B, 1, H, HD)
+    return (out.reshape(B, KV, Q, G, HD).transpose(0, 2, 1, 3, 4)
+            .reshape(B, Q, H, HD))
 
 
 def ragged_decode(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,

@@ -1,0 +1,84 @@
+"""Prompt-lookup speculative decoding: on-device n-gram drafting.
+
+The capability TRT-LLM ships inside the reference's NIM container
+(speculative decoding; ref docker-compose-nim-ms.yaml:2-28) — redesigned
+for the TPU serving engine's fused multi-step decode. RAG outputs quote
+their retrieved context, so the cheapest draft model is the request's own
+token history: find the latest earlier occurrence of the current suffix
+n-gram and propose the tokens that followed it. No draft model, no extra
+weights, no host round trip — drafting is a handful of (B, S) vector ops
+inside the compiled step, and verification rides the same weight read as
+a normal decode step (decode is HBM-bound: a (1+D)-token verify step
+costs nearly the same wall clock as a 1-token step).
+
+Acceptance is EXACT-MATCH against the per-slot seeded sample at each
+position: position i samples from the model's distribution conditioned on
+the accepted prefix with the request's deterministic key for token index
+generated+i, and drafts are accepted while they equal those samples. The
+emitted stream is therefore token-for-token what sequential decoding with
+the same keys would produce — speculation changes wall clock, never
+content (modulo the usual batched-matmul rounding of logits).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def draft_lookup(history: jnp.ndarray, lengths: jnp.ndarray,
+                 n_draft: int, ngram: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Draft up to ``n_draft`` tokens per slot from the slot's own history.
+
+    history: (B, S) int32 — token at each absolute position, valid through
+    index ``lengths[b]`` INCLUSIVE (the invariant the engine maintains:
+    ``history[b, lengths[b]]`` is the token being fed this step).
+    Returns (draft (B, n_draft) int32, draft_len (B,) int32): the tokens
+    that followed the LATEST earlier occurrence of the current trailing
+    ``ngram`` (the occurrence ending at the current position itself is
+    excluded), clipped to the known region; draft_len == 0 when the suffix
+    n-gram appears nowhere earlier (or the sequence is shorter than the
+    n-gram).
+    """
+    B, S = history.shape
+    L = lengths.astype(jnp.int32)                           # (B,)
+    # trailing n-gram: positions L-ngram+1 .. L
+    g_idx = L[:, None] - (ngram - 1) + jnp.arange(ngram, dtype=jnp.int32)
+    gram = jnp.take_along_axis(history, jnp.clip(g_idx, 0, S - 1), axis=1)
+    # candidate start p matches iff history[p+i] == gram[i] for all i
+    m = jnp.ones((B, S), bool)
+    for i in range(ngram):
+        m &= jnp.roll(history, -i, axis=1) == gram[:, i:i + 1]
+    pos = jnp.arange(S, dtype=jnp.int32)[None]              # (1, S)
+    # occurrence fully inside known history, strictly before the current
+    # suffix (p + ngram - 1 <= L - 1 excludes it and kills roll wrap-around)
+    cand = m & (pos + ngram - 1 <= L[:, None] - 1)
+    best = jnp.max(jnp.where(cand, pos, -1), axis=1)        # (B,) latest
+    found = (best >= 0) & (L >= ngram - 1)
+    d_idx = best[:, None] + ngram + jnp.arange(n_draft, dtype=jnp.int32)
+    draft = jnp.take_along_axis(history, jnp.clip(d_idx, 0, S - 1), axis=1)
+    # known continuation: positions best+ngram .. L  (history valid thru L)
+    avail = L + 1 - (best + ngram)
+    draft_len = jnp.where(found, jnp.clip(avail, 0, n_draft), 0)
+    return draft.astype(jnp.int32), draft_len.astype(jnp.int32)
+
+
+def acceptance(sampled: jnp.ndarray, draft: jnp.ndarray,
+               draft_len: jnp.ndarray) -> jnp.ndarray:
+    """Accepted-prefix length per slot → tokens emitted this step.
+
+    sampled: (B, W) — the per-position samples of a W-wide verify step
+    (W = 1 + n_draft); draft: (B, W-1); draft_len: (B,). Position i's
+    sample is valid iff every draft before it matched its sample, so the
+    step emits ``k+1`` tokens where k is the number of leading matches
+    within draft_len. Returns e (B,) in 1..W.
+    """
+    W = sampled.shape[1]
+    if W == 1:
+        return jnp.ones(sampled.shape[0], jnp.int32)
+    i = jnp.arange(W - 1, dtype=jnp.int32)[None]
+    ok = (sampled[:, :-1] == draft) & (i < draft_len[:, None])
+    k = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+    return (k + 1).astype(jnp.int32)

@@ -70,8 +70,11 @@ def engine():
     cfg = llama.LlamaConfig.tiny(vocab_size=300)  # > ByteTokenizer specials
     params = llama.init_params(jax.random.PRNGKey(5), cfg)
     tok = ByteTokenizer()
+    # spec_decode off: these low-level tests drive core.decode directly and
+    # read one sampled token per step; speculative acceptance emits several
+    # (the scheduler-level spec path is pinned in tests/test_spec_decode.py)
     ecfg = EngineConfig(max_batch_size=4, max_seq_len=128, prefill_chunk=32,
-                        page_size=16)
+                        page_size=16, spec_decode="off")
     core = EngineCore(cfg, ecfg, params, eos_id=tok.eos_id)
     return core, tok, cfg, params
 

@@ -83,7 +83,7 @@ def test_attention_output_close_to_fp_reference():
     def run(kv_quant):
         ecfg = EngineConfig(max_batch_size=2, max_seq_len=128,
                             prefill_chunk=32, page_size=16,
-                            kv_quant=kv_quant)
+                            kv_quant=kv_quant, spec_decode="off")
         core = EngineCore(cfg, ecfg, params, eos_id=tok.eos_id)
         state = core.init_state()
         alloc = core.new_allocator()

@@ -25,7 +25,7 @@ def longctx():
     mesh = pmesh.create_mesh(
         pmesh.MeshConfig(axes=pmesh.LONGCTX_AXES, shape=(1, 4, 2)))
     ecfg = EngineConfig(max_batch_size=2, max_seq_len=256, page_size=16,
-                        prefill_chunk=32)
+                        prefill_chunk=32, spec_decode="off")
     core = EngineCore(cfg, ecfg, params, eos_id=ByteTokenizer().eos_id,
                       mesh=mesh)
     return cfg, params, core
