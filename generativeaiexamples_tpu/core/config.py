@@ -222,6 +222,7 @@ class EngineConfig:
     spec_decode: str = configfield("spec_decode", default="on", help_txt="Prompt-lookup speculative decoding: on | off. Each decode step drafts spec_draft tokens from the request's own token history (n-gram continuation — RAG outputs quote their context) and verifies them in one widened step; decode is weight-read-bound, so accepted drafts are nearly free tokens. Output is token-identical to non-speculative decoding (exact-match acceptance under the per-request seed).")
     spec_draft: int = configfield("spec_draft", default=4, help_txt="Drafted tokens verified per decode step when spec_decode=on (the widened step processes 1+spec_draft positions per slot).")
     spec_ngram: int = configfield("spec_ngram", default=2, help_txt="Suffix n-gram length matched against the request's history to locate a draft continuation.")
+    max_adapters: int = configfield("max_adapters", default=4, help_txt="Resident LoRA adapter slots for per-request multi-adapter serving (slot 0 is the base model). Requests select an adapter by registered name (OpenAI `model` field); one decode batch mixes adapters freely.")
     model_family: str = configfield("model_family", default="llama3-8b", help_txt="Served model architecture (models.model_configs name, same names as the train CLI); APP_LLM_MODEL_NAME stays the cosmetic OpenAI model id.")
     long_prefill: str = configfield("long_prefill", default="auto", help_txt="Sequence-parallel whole-prompt prefill for multi-chunk prompts: auto (when the mesh has a seq axis) | off. One ring-attention pass replaces the chunk loop; decode does not interleave during it, but the pass is seq-axis-times faster.")
     attention: str = configfield("attention", default="auto", help_txt="Attention backend: auto (pallas on TPU, xla elsewhere) | pallas | xla.")

@@ -75,6 +75,20 @@ def build_scheduler(tiny: bool = False) -> tuple:
         logging.info("serving over mesh %s", dict(mesh.shape))
     core = EngineCore(model_cfg, cfg.engine, params, eos_id=tokenizer.eos_id,
                       mesh=mesh)
+    # per-request LoRA adapters: APP_ENGINE_ADAPTERS="name=dir,name2=dir2"
+    # (dirs written by train/lora.py save_adapters). Registered BEFORE
+    # warmup so the stacked-adapter programs compile once, up front.
+    import os
+    spec = os.environ.get("APP_ENGINE_ADAPTERS", "")
+    if spec:
+        from generativeaiexamples_tpu.train.lora import load_adapters
+        for entry in spec.split(","):
+            name, _, path = entry.strip().partition("=")
+            if not name or not path:
+                raise SystemExit(f"bad APP_ENGINE_ADAPTERS entry {entry!r} "
+                                 "(want name=dir,...)")
+            core.register_adapter(name, load_adapters(path, model_cfg))
+            logging.info("registered adapter %r from %s", name, path)
     if not tiny:
         # compile the whole serving program grid before the first request —
         # lazy compiles (~20-40 s each over a tunneled chip) would stall

@@ -87,6 +87,7 @@ class PrefillItem:
     top_p: float = 1.0
     gram_state: int = 0
     seed: int = 0                 # per-request sampling seed (PRNGKey base)
+    adapter_ix: int = 0           # resident LoRA slot (0 = base model)
 
 
 def unpack_decode_out(packed) -> Dict[str, Any]:
@@ -136,12 +137,15 @@ class DecodeState:
     # the token being fed next). Written by prefill chunks, activation, and
     # decode appends; read by prompt-lookup drafting (ops/speculative.py).
     history: jnp.ndarray
+    # (B,) i32 — resident LoRA adapter slot per request (0 = base model);
+    # selects rows of the stacked adapter tree in llama._maybe_lora
+    adapter_ix: jnp.ndarray
 
     def tree_flatten(self):
         return ((self.cache, self.tokens, self.active, self.generated,
                  self.max_gen, self.temperature, self.top_k, self.top_p,
                  self.rngs, self.gram_state, self.last_logprob,
-                 self.history), None)
+                 self.history, self.adapter_ix), None)
 
     @classmethod
     def tree_unflatten(cls, _, c):
@@ -269,6 +273,12 @@ class EngineCore:
                              "expected 'none' or 'int8'")
         self.params = params
         self.adapters = adapters
+        # per-request multi-LoRA registry: name -> resident slot (0 = base).
+        # register_adapter() stacks trees into slots; mutually exclusive
+        # with a constructor-supplied GLOBAL adapter tree (which applies to
+        # every request, the merged-serving compatibility path).
+        self._adapter_names: Dict[str, int] = {"": 0}
+        self._adapters_stacked = False
 
         # Donating the state through every dispatch is the memory-optimal
         # default, but a remote-attached PJRT client (the tunneled dev chip)
@@ -294,7 +304,7 @@ class EngineCore:
         self.group_buckets = tuple(gb)
         self._chunk_fn = jax.jit(self._chunk_impl, donate_argnums=dn)
         self._group_fn = jax.jit(self._group_impl, donate_argnums=dn,
-                                 static_argnums=(22,))
+                                 static_argnums=(23,))
         # constrained-decoding grammar registry: up to GRAM_SLOTS byte-DFAs
         # live in one flat device table; flat state g*GRAM_STATES+s, flat
         # state 0 = the shared reject sink (engine/grammar.py). Built lazily
@@ -346,12 +356,14 @@ class EngineCore:
             gram_state=jnp.zeros((B,), jnp.int32),
             last_logprob=jnp.zeros((B,), jnp.float32),
             history=jnp.zeros((B, self.max_seq), jnp.int32),
+            adapter_ix=jnp.zeros((B,), jnp.int32),
         )
         if self.mesh is not None:
             rest = jax.device_put(
                 (state.tokens, state.active, state.generated, state.max_gen,
                  state.temperature, state.top_k, state.top_p, state.rngs,
-                 state.gram_state, state.last_logprob, state.history),
+                 state.gram_state, state.last_logprob, state.history,
+                 state.adapter_ix),
                 self._replicated)
             state = DecodeState(cache, *rest)
         return state
@@ -389,20 +401,21 @@ class EngineCore:
         return history.at[slot, cols].set(tokens_row, mode="drop")
 
     def _chunk_impl(self, state: DecodeState, params, adapters, tokens,
-                    page_row, slot, start_pos, chunk_len
+                    page_row, slot, start_pos, chunk_len, aix
                     ) -> Tuple[DecodeState, jnp.ndarray]:
         # params/adapters ride as arguments, never closure constants — a
         # captured 6 GB pytree would be baked into the lowered program
         logits, cache = kv_cache.prefill_chunk(
             params, self.model_cfg, tokens, state.cache, page_row, slot,
             start_pos, chunk_len, self.num_pages, adapters=adapters,
-            mesh=self.mesh)
+            adapter_ix=aix[None], mesh=self.mesh)
         hist = self._hist_write_chunk(state.history, slot, tokens[0],
                                       start_pos, chunk_len)
         return dataclasses.replace(state, cache=cache, history=hist), logits[0]
 
     def prefill_chunk(self, state: DecodeState, chunk_ids, page_row, slot: int,
-                      start_pos: int) -> Tuple[DecodeState, jax.Array]:
+                      start_pos: int, adapter_ix: int = 0
+                      ) -> Tuple[DecodeState, jax.Array]:
         """Host wrapper: pad the chunk to a bucket, run the jitted chunk.
 
         chunk_ids: the token ids of this chunk (<= prefill_chunk of them);
@@ -417,7 +430,7 @@ class EngineCore:
         return self._chunk_fn(
             state, self.params, self.adapters, jnp.asarray(padded),
             jnp.asarray(page_row, jnp.int32), jnp.int32(slot),
-            jnp.int32(start_pos), jnp.int32(n))
+            jnp.int32(start_pos), jnp.int32(n), jnp.int32(adapter_ix))
 
     # ---------------------------------------------- long-context prefill
 
@@ -536,7 +549,7 @@ class EngineCore:
 
     def _activate_sampled(self, state: DecodeState, cache, logits, slot,
                           generated, max_gen, temperature, top_k, top_p,
-                          seed) -> Tuple[DecodeState, jnp.ndarray]:
+                          seed, aix=None) -> Tuple[DecodeState, jnp.ndarray]:
         """Shared tail of the fused prefill programs: sample the first token
         from last-position logits and activate the slot, all on-device.
         An immediate eos or an exhausted budget leaves the slot inactive
@@ -575,12 +588,14 @@ class EngineCore:
             gram_state=upd(state.gram_state, jnp.int32(0)),
             last_logprob=upd(state.last_logprob, lp),
             history=hist,
+            adapter_ix=upd(state.adapter_ix,
+                           jnp.int32(0) if aix is None else aix),
         )
         return new_state, tok
 
     def _chunk_last_impl(self, state: DecodeState, params, adapters, tokens,
                          page_row, slot, start_pos, chunk_len, generated,
-                         max_gen, temperature, top_k, top_p, seed
+                         max_gen, temperature, top_k, top_p, seed, aix
                          ) -> Tuple[DecodeState, jnp.ndarray]:
         """Final chunk fused with first-token sampling and slot activation —
         admission never blocks on a host round-trip; the first token's value
@@ -588,18 +603,18 @@ class EngineCore:
         logits, cache = kv_cache.prefill_chunk(
             params, self.model_cfg, tokens, state.cache, page_row, slot,
             start_pos, chunk_len, self.num_pages, adapters=adapters,
-            mesh=self.mesh)
+            adapter_ix=aix[None], mesh=self.mesh)
         state = dataclasses.replace(
             state, history=self._hist_write_chunk(
                 state.history, slot, tokens[0], start_pos, chunk_len))
         return self._activate_sampled(state, cache, logits, slot, generated,
                                       max_gen, temperature, top_k, top_p,
-                                      seed)
+                                      seed, aix)
 
     def prefill_chunk_last(self, state: DecodeState, chunk_ids, page_row,
                            slot: int, start_pos: int, generated: int,
                            max_gen: int, temperature: float, top_k: int,
-                           top_p: float, seed: int = 0
+                           top_p: float, seed: int = 0, adapter_ix: int = 0
                            ) -> Tuple[DecodeState, jax.Array]:
         """Final-chunk host wrapper: returns (state, first-token device
         scalar). ``generated`` counts tokens produced including this one."""
@@ -612,7 +627,7 @@ class EngineCore:
             jnp.asarray(page_row, jnp.int32), jnp.int32(slot),
             jnp.int32(start_pos), jnp.int32(n), jnp.int32(generated),
             jnp.int32(max_gen), jnp.float32(temperature), jnp.int32(top_k),
-            jnp.float32(top_p), jnp.int32(seed))
+            jnp.float32(top_p), jnp.int32(seed), jnp.int32(adapter_ix))
 
     # ------------------------------------------------------- grouped prefill
 
@@ -625,8 +640,8 @@ class EngineCore:
     def _group_impl(self, state: DecodeState, params, adapters, tokens,
                     page_rows, slots, len_slots, start_pos, chunk_len,
                     is_last, generated, max_gen, temperature, top_k, top_p,
-                    seeds, gram_states, gram_table, gram_accept, gram_dist,
-                    tok_bytes, tok_lens, use_grammar: bool
+                    seeds, adapter_ixs, gram_states, gram_table, gram_accept,
+                    gram_dist, tok_bytes, tok_lens, use_grammar: bool
                     ) -> Tuple[DecodeState, jnp.ndarray]:
         """G chunks in ONE dispatch; ``is_last`` rows additionally run the
         fused first-token sample + slot activation (the group generalization
@@ -641,7 +656,7 @@ class EngineCore:
         logits, cache = kv_cache.prefill_chunks(
             params, self.model_cfg, tokens, state.cache, page_rows,
             len_slots, start_pos, chunk_len, self.num_pages,
-            adapters=adapters, mesh=self.mesh)
+            adapters=adapters, adapter_ix=adapter_ixs, mesh=self.mesh)
         raw = logits   # pre-mask: logprobs report the model distribution
         if use_grammar:
             from generativeaiexamples_tpu.ops.sampling import (
@@ -682,6 +697,7 @@ class EngineCore:
             rngs=upd(state.rngs, bases),
             last_logprob=upd(state.last_logprob, lps),
             history=hist,
+            adapter_ix=upd(state.adapter_ix, adapter_ixs),
         )
         if use_grammar:
             nxt = grammar_advance(gram_states, toks, gram_table, tok_bytes,
@@ -717,6 +733,7 @@ class EngineCore:
         top_k = np.zeros((G,), np.int32)
         top_p = np.ones((G,), np.float32)
         seeds = np.zeros((G,), np.int32)
+        adapter_ixs = np.zeros((G,), np.int32)
         for i, it in enumerate(items):
             n = len(it.chunk_ids)
             if n > C:
@@ -734,6 +751,7 @@ class EngineCore:
             top_k[i] = it.top_k
             top_p[i] = it.top_p
             seeds[i] = it.seed
+            adapter_ixs[i] = it.adapter_ix
         # lengths-scatter dedup: only a slot's highest-start_pos row keeps
         # its true id (duplicate-index scatters are nondeterministic)
         len_slots = slots.copy()
@@ -755,7 +773,7 @@ class EngineCore:
             jnp.asarray(generated), jnp.asarray(max_gen),
             jnp.asarray(temperature), jnp.asarray(top_k),
             jnp.asarray(top_p), jnp.asarray(seeds),
-            jnp.asarray(gram_states),
+            jnp.asarray(adapter_ixs), jnp.asarray(gram_states),
             *self._gram_args(use_grammar), use_grammar)
 
     # -------------------------------------------- constrained decoding (DFA)
@@ -935,6 +953,7 @@ class EngineCore:
             rngs=upd(state.rngs, jax.random.PRNGKey(seed)),
             gram_state=upd(state.gram_state, jnp.int32(0)),  # no leakage
             last_logprob=upd(state.last_logprob, jnp.float32(0.0)),
+            adapter_ix=upd(state.adapter_ix, jnp.int32(0)),
         )
 
     def activate(self, state: DecodeState, slot: int, token: int,
@@ -946,6 +965,66 @@ class EngineCore:
             state, jnp.int32(slot), jnp.int32(token), jnp.int32(generated),
             jnp.int32(max_gen), jnp.float32(temperature), jnp.int32(top_k),
             jnp.float32(top_p), jnp.int32(seed))
+
+    # ------------------------------------------------- multi-LoRA serving
+
+    def register_adapter(self, name: str, tree) -> int:
+        """Install a trained adapter pytree (train/lora.py layout: leaves
+        (L, in, r)/(L, r, out)) into a resident slot; requests select it by
+        name (Request.adapter / the OpenAI `model` field). The first
+        registration switches the engine to STACKED adapter serving —
+        programs retrace once (register before `warmup` in production).
+        Slot 0 stays the all-zero base adapter."""
+        if not name:
+            raise ValueError("adapter name must be non-empty")
+        if name in self._adapter_names:
+            return self._adapter_names[name]
+        if self.adapters is not None and not self._adapters_stacked:
+            raise ValueError(
+                "engine was built with a global adapter tree; per-request "
+                "adapters need a base-only engine (serve the global tree "
+                "merged, or register it as a named adapter instead)")
+        N = self.cfg.max_adapters
+        ix = len(self._adapter_names)
+        if ix >= N:
+            raise ValueError(f"all {N} adapter slots in use "
+                             f"(APP_ENGINE_MAX_ADAPTERS)")
+        if not self._adapters_stacked:
+            # (L, …) -> (L, N, …) zero-initialized slot stack
+            self.adapters = jax.tree.map(
+                lambda leaf: jnp.zeros(
+                    (leaf.shape[0], N) + leaf.shape[1:], leaf.dtype),
+                tree)
+            self._adapters_stacked = True
+
+        def _set(s, leaf):
+            # explicit shape check: a rank-mismatched adapter must fail
+            # loudly here — `.at[].set` would BROADCAST a rank-1 leaf
+            # across a wider slot (serving it at rank-times its scale)
+            if tuple(leaf.shape) != (s.shape[0],) + tuple(s.shape[2:]):
+                raise ValueError(
+                    f"adapter {name!r} leaf shape {tuple(leaf.shape)} does "
+                    f"not match the resident slot layout "
+                    f"{(s.shape[0],) + tuple(s.shape[2:])} — all resident "
+                    f"adapters must share rank/targets (first registration "
+                    f"fixes the layout)")
+            return s.at[:, ix].set(leaf.astype(s.dtype))
+
+        self.adapters = jax.tree.map(_set, self.adapters, tree)
+        if self.mesh is not None:
+            self.adapters = jax.device_put(self.adapters, self._replicated)
+        self._adapter_names[name] = ix
+        return ix
+
+    def adapter_index(self, name: str) -> int:
+        """Resolve a request's adapter name (KeyError for unknown names —
+        the scheduler fails the request loudly, never silently serves
+        base weights under a fine-tune's name)."""
+        return self._adapter_names[name or ""]
+
+    @property
+    def adapter_names(self):
+        return [n for n in self._adapter_names if n]
 
     def _seed_history_impl(self, state: DecodeState, slot, ids
                            ) -> DecodeState:
@@ -993,7 +1072,7 @@ class EngineCore:
             logits, cache = kv_cache.decode_step(
                 params, self.model_cfg, state.tokens, state.cache,
                 page_table, state.active, self.num_pages, adapters=adapters,
-                mesh=self.mesh)
+                adapter_ix=state.adapter_ix, mesh=self.mesh)
             raw = logits.astype(jnp.float32)   # logprobs: model distribution
             if use_grammar:
                 # constrained decoding INSIDE the fused step: byte-DFA
@@ -1008,9 +1087,11 @@ class EngineCore:
             # inactive slots' stale temperatures must not defeat the
             # all-greedy fast path inside the sampler
             live_temp = jnp.where(state.active, state.temperature, 0.0)
+            live_topk = jnp.where(state.active, state.top_k, 0)
+            live_topp = jnp.where(state.active, state.top_p, 1.0)
             keys = jax.vmap(jax.random.fold_in)(state.rngs, state.generated)
             sampled = sample_logits_per_slot(keys, logits, live_temp,
-                                             state.top_k, state.top_p)
+                                             live_topk, live_topp)
             lp = token_logprob(raw, sampled)
             generated = state.generated + state.active.astype(jnp.int32)
             hit_eos = sampled == self.eos_id
@@ -1076,7 +1157,7 @@ class EngineCore:
             logits_w, cache = kv_cache.decode_step_wide(
                 params, self.model_cfg, inputs, state.cache, page_table,
                 state.active, self.num_pages, adapters=adapters,
-                mesh=self.mesh)
+                adapter_ix=state.adapter_ix, mesh=self.mesh)
             raw = logits_w.astype(jnp.float32)            # (B, W, V)
             logits_s = raw
             if use_grammar:
@@ -1087,6 +1168,8 @@ class EngineCore:
                 logits_s = jnp.concatenate([m0[:, None], logits_s[:, 1:]],
                                            axis=1)
             live_temp = jnp.where(state.active, state.temperature, 0.0)
+            live_topk = jnp.where(state.active, state.top_k, 0)
+            live_topp = jnp.where(state.active, state.top_p, 1.0)
             pos_w = jnp.arange(W, dtype=jnp.int32)[None]      # (1, W)
             gen_i = state.generated[:, None] + pos_w          # (B, W)
             keys = jax.vmap(jax.vmap(jax.random.fold_in, (None, 0)))(
@@ -1095,8 +1178,8 @@ class EngineCore:
             rep = lambda x: jnp.repeat(x, W, axis=0)
             sampled = sample_logits_per_slot(
                 keys.reshape(B * W, 2), logits_s.reshape(B * W, V),
-                rep(live_temp), rep(state.top_k),
-                rep(state.top_p)).reshape(B, W)
+                rep(live_temp), rep(live_topk),
+                rep(live_topp)).reshape(B, W)
             lp = token_logprob(raw.reshape(B * W, V),
                                sampled.reshape(B * W)).reshape(B, W)
             e = acceptance(sampled, draft, dlen)              # (B,) 1..W

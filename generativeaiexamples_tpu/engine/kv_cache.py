@@ -217,6 +217,7 @@ def prefill_chunk(params: llama.Params, cfg: llama.LlamaConfig,
                   start_pos: jnp.ndarray, chunk_len: jnp.ndarray,
                   num_pages: int,
                   adapters: Optional[llama.Params] = None,
+                  adapter_ix=None,
                   mesh=None,
                   ) -> Tuple[jnp.ndarray, PagedKVCache]:
     """One chunk of paged prompt processing for a single slot.
@@ -291,7 +292,8 @@ def prefill_chunk(params: llama.Params, cfg: llama.LlamaConfig,
     pools_in = ((cache.k, cache.v, cache.k_s, cache.v_s) if quant
                 else (cache.k, cache.v))
     h, pools = llama.scan_blocks_inplace(
-        cfg, h, params, pools_in, cos, sin, attn_and_update, adapters)
+        cfg, h, params, pools_in, cos, sin, attn_and_update, adapters,
+        adapter_ix)
     h_last = jnp.take_along_axis(
         h, (chunk_len - 1)[None, None, None].astype(jnp.int32), axis=1)
     logits = llama._unembed(cfg, params, h_last)[:, 0]               # (1, V)
@@ -307,6 +309,7 @@ def prefill_chunks(params: llama.Params, cfg: llama.LlamaConfig,
                    start_pos: jnp.ndarray, chunk_len: jnp.ndarray,
                    num_pages: int,
                    adapters: Optional[llama.Params] = None,
+                   adapter_ix=None,
                    mesh=None,
                    ) -> Tuple[jnp.ndarray, PagedKVCache]:
     """One chunk each for G DISTINCT slots, in a single pass.
@@ -394,7 +397,8 @@ def prefill_chunks(params: llama.Params, cfg: llama.LlamaConfig,
     pools_in = ((cache.k, cache.v, cache.k_s, cache.v_s) if quant
                 else (cache.k, cache.v))
     h, pools = llama.scan_blocks_inplace(
-        cfg, h, params, pools_in, cos, sin, attn_and_update, adapters)
+        cfg, h, params, pools_in, cos, sin, attn_and_update, adapters,
+        adapter_ix)
     last_ix = jnp.maximum(chunk_len - 1, 0)[:, None, None]        # (G, 1, 1)
     h_last = jnp.take_along_axis(h, last_ix.astype(jnp.int32), axis=1)
     logits = llama._unembed(cfg, params, h_last)[:, 0]            # (G, V)
@@ -410,6 +414,7 @@ def decode_step(params: llama.Params, cfg: llama.LlamaConfig,
                 page_table: jnp.ndarray, write_mask: jnp.ndarray,
                 num_pages: int,
                 adapters: Optional[llama.Params] = None,
+                adapter_ix=None,
                 mesh=None,
                 ) -> Tuple[jnp.ndarray, PagedKVCache]:
     """One paged decode step for every slot in the batch — the Q == 1
@@ -424,7 +429,7 @@ def decode_step(params: llama.Params, cfg: llama.LlamaConfig,
     """
     logits, new_cache = decode_step_wide(
         params, cfg, tokens[:, None], cache, page_table, write_mask,
-        num_pages, adapters=adapters, mesh=mesh)
+        num_pages, adapters=adapters, adapter_ix=adapter_ix, mesh=mesh)
     return logits[:, 0], PagedKVCache(
         k=new_cache.k, v=new_cache.v, lengths=cache.lengths + 1,
         k_s=new_cache.k_s, v_s=new_cache.v_s)
@@ -435,6 +440,7 @@ def decode_step_wide(params: llama.Params, cfg: llama.LlamaConfig,
                      page_table: jnp.ndarray, write_mask: jnp.ndarray,
                      num_pages: int,
                      adapters: Optional[llama.Params] = None,
+                     adapter_ix=None,
                      mesh=None,
                      ) -> Tuple[jnp.ndarray, PagedKVCache]:
     """Q-token speculative-VERIFY decode step (ops/speculative.py drafts).
@@ -556,7 +562,8 @@ def decode_step_wide(params: llama.Params, cfg: llama.LlamaConfig,
     pools_in = ((cache.k, cache.v, cache.k_s, cache.v_s) if quant
                 else (cache.k, cache.v))
     h, pools = llama.scan_blocks_inplace(
-        cfg, h, params, pools_in, cos, sin, attn_and_update, adapters)
+        cfg, h, params, pools_in, cos, sin, attn_and_update, adapters,
+        adapter_ix)
     logits = llama._unembed(cfg, params, h)                  # (B, Q, V)
     return logits, PagedKVCache(k=pools[0], v=pools[1], lengths=cache.lengths,
                                 k_s=pools[2] if quant else None,

@@ -100,6 +100,9 @@ class Request:
     logprobs: bool = False
     top_logprobs: int = 0
     logprob_data: List[tuple] = field(default_factory=list)
+    # registered LoRA adapter name ("" = base model); resolved to a
+    # resident slot at admission, one decode batch mixes adapters freely
+    adapter: str = ""
     # compiled constrained-decoding grammar (engine/grammar.py Grammar) or
     # None; on an engine without free grammar slots the request silently
     # degrades to unconstrained (prompt+parse still applies upstream).
@@ -160,6 +163,7 @@ class _Job:
     gram_on: bool = False         # constrained decoding active for the slot
     stop_buf: str = ""            # held-back text (possible stop prefix)
     stopped: bool = False         # a stop sequence matched; tail suppressed
+    adapter_ix: int = 0           # resolved LoRA slot (0 = base)
 
 
 class Scheduler:
@@ -398,12 +402,17 @@ class Scheduler:
         if not self._caching:
             return self.core.pages_for(n), 0, []
         if job.hashed_len != n:
-            job.page_hashes = chain_hashes(job.ids, self.core.page_size,
-                                           seed=self._cache_seed)
+            # the chain seed namespaces by adapter: KV depends on the
+            # weights that produced it, so requests served under different
+            # adapters must never share pages
+            job.page_hashes = chain_hashes(
+                job.ids, self.core.page_size,
+                seed=f"{self._cache_seed}|{job.request.adapter}")
             job.hashed_len = n
         hits = self._alloc.match(job.page_hashes)
         shared = self._cap_shared(n, len(hits) * self.core.page_size)
         if (shared and job.request.grammar is None
+                and not job.request.adapter
                 and self.core.cfg.long_prefill != "off"
                 and self.core.supports_long_prefill
                 and n - shared > 4 * self.core.chunk):
@@ -440,7 +449,8 @@ class Scheduler:
                 if (len(ids) // self.core.page_size
                         > len(job.ids) // self.core.page_size):
                     job.page_hashes = chain_hashes(
-                        ids, self.core.page_size, seed=self._cache_seed)
+                        ids, self.core.page_size,
+                        seed=f"{self._cache_seed}|{job.request.adapter}")
                     job.hashed_len = -1   # differs from ids: force recompute
         n_full = min(len(ids) // self.core.page_size, len(job.pages),
                      len(job.page_hashes))
@@ -466,9 +476,17 @@ class Scheduler:
                 return
             chosen: Optional[_Job] = None
             oversized: Optional[_Job] = None
+            bad_adapter: Optional[_Job] = None
             plan = None
             head = cands[0]
             for pos, job in enumerate(cands):
+                if job.request.adapter:
+                    try:
+                        job.adapter_ix = self.core.adapter_index(
+                            job.request.adapter)
+                    except (KeyError, AttributeError):
+                        bad_adapter = job
+                        break
                 n = len(job.ids)
                 need = self.core.pages_for(n)
                 if (n + 1 >= self.core.max_seq
@@ -488,6 +506,18 @@ class Scheduler:
                     head.bypass_count += 1
                     REGISTRY.counter("admission_skips").inc()
                     break
+            if bad_adapter is not None:
+                # never silently serve base weights under a fine-tune's name
+                job = bad_adapter
+                with self._lock:
+                    try:
+                        self._pending.remove(job)
+                    except ValueError:
+                        continue
+                self._fail(job, f"unknown adapter "
+                                f"{job.request.adapter!r}; registered: "
+                                f"{getattr(self.core, 'adapter_names', [])}")
+                continue
             if oversized is not None:
                 job = oversized
                 with self._lock:
@@ -595,7 +625,7 @@ class Scheduler:
         # _activate_sampled), so taking it would silently drop token-level
         # enforcement the serving layer promised the client.
         if (job.prefilled == 0 and len(job.ids) > self.core.chunk
-                and req.grammar is None
+                and req.grammar is None and not req.adapter
                 and self.core.cfg.long_prefill != "off"
                 and self.core.supports_long_prefill):
             job.prefill_started = time.perf_counter()
@@ -643,7 +673,7 @@ class Scheduler:
                     generated=len(job.gen_ids) + 1, max_gen=req.max_tokens,
                     temperature=req.temperature, top_k=req.top_k,
                     top_p=req.top_p, gram_state=gram_state,
-                    seed=req.seed or 0))
+                    seed=req.seed or 0, adapter_ix=job.adapter_ix))
                 start += len(chunk_ids)
                 if last:
                     finals.append(job)

@@ -99,11 +99,36 @@ class ModelServer:
     # ------------------------------------------------------------- endpoints
 
     async def models(self, request: web.Request) -> web.Response:
-        return web.json_response({
-            "object": "list",
-            "data": [{"id": self.model_name, "object": "model",
-                      "owned_by": "generativeaiexamples_tpu"}],
-        })
+        cards = [{"id": self.model_name, "object": "model",
+                  "owned_by": "generativeaiexamples_tpu"}]
+        # registered LoRA adapters serve as first-class model ids (the
+        # multi-LoRA convention OpenAI-compatible servers follow): a
+        # request whose `model` names one routes to that adapter's slot
+        for name in self._adapter_names():
+            cards.append({"id": name, "object": "model",
+                          "owned_by": "generativeaiexamples_tpu",
+                          "parent": self.model_name})
+        return web.json_response({"object": "list", "data": cards})
+
+    def _adapter_names(self) -> list:
+        core = getattr(self.scheduler, "core", None)
+        return list(getattr(core, "adapter_names", []) or [])
+
+    def _adapter_for(self, body: Dict[str, Any]) -> str:
+        """Route the OpenAI `model` field: a registered adapter name
+        selects that adapter; the base model id (or an absent field)
+        serves base weights. Once adapters exist, any OTHER id is a loud
+        404 — a typo'd fine-tune name must never silently serve base
+        weights (mirrors the scheduler's unknown-adapter guard)."""
+        model_id = str(body.get("model") or "")
+        names = self._adapter_names()
+        if model_id in names:
+            return model_id
+        if names and model_id and model_id != self.model_name:
+            raise web.HTTPNotFound(text=json.dumps(
+                {"error": f"unknown model {model_id!r}; served: "
+                          f"{[self.model_name] + names}"}))
+        return ""
 
     def _parse_sampling(self, body: Dict[str, Any]) -> Dict[str, Any]:
         def get(key, default, cast):
@@ -231,12 +256,18 @@ class ModelServer:
                 {"error": "n > 1 is not supported with tools or "
                           "response_format"}))
 
+        adapter = self._adapter_for(body)
+        # responses echo the REQUESTED model id (adapter traffic must not
+        # be attributed to the base model by client-side accounting)
+        model = adapter or self.model_name
+
         def make_req(i: int) -> Request:
             kw = dict(sampling)
             if i and kw["seed"] is not None:
                 kw["seed"] = kw["seed"] + i   # distinct, still reproducible
             return Request(prompt_ids=list(prompt_ids), grammar=grammar,
-                           grammar_prefix=grammar_prefix, **kw)
+                           grammar_prefix=grammar_prefix, adapter=adapter,
+                           **kw)
 
         reqs = [make_req(i) for i in range(n)]
         req = reqs[0]
@@ -251,7 +282,8 @@ class ModelServer:
             # call as soon as the envelope prefix parses, then stream the
             # argument text in fragments (tools_mod.ToolCallStreamer) —
             # long argument generations no longer sit silent
-            return await self._stream_tools(request, rid, req, drain, tools)
+            return await self._stream_tools(request, rid, req, drain, tools,
+                                            model)
         if stream and json_mode and grammar is not None and not tools:
             # the token-level grammar GUARANTEES valid JSON, so json-mode
             # output can stream as plain content deltas — but only when
@@ -259,7 +291,7 @@ class ModelServer:
             # admission); _stream_json peeks the first delta, checks
             # req.grammar_attached, and falls back to the buffered
             # extract path when enforcement degraded
-            return await self._stream_json(request, rid, req, drain)
+            return await self._stream_json(request, rid, req, drain, model)
         if not stream or tools or json_mode:
             # JSON-mode requests WITHOUT a grammar (and non-streamed
             # tools) still buffer: the extracted JSON value is rewritten
@@ -270,7 +302,7 @@ class ModelServer:
                 if not stream:
                     raise web.HTTPServiceUnavailable(
                         text=json.dumps({"error": req.error}))
-                return await self._stream_error(request, rid, req.error)
+                return await self._stream_error(request, rid, req.error, model)
             tool_calls = (tools_mod.parse_tool_calls(text, tools)
                           if tools else None)
             if json_mode and not tool_calls:
@@ -284,7 +316,7 @@ class ModelServer:
                 message["tool_calls"] = tool_calls
             if stream:
                 return await self._stream_buffered(request, rid, message,
-                                                   finish)
+                                                   finish, model)
             texts = [text] + [
                 await StreamDrain(self.scheduler.iter_text(r)).join_text()
                 for r in reqs[1:]]
@@ -306,7 +338,7 @@ class ModelServer:
             done_toks = sum(r.completion_tokens for r in reqs)
             payload = {
                 "id": rid, "object": "chat.completion" if chat else "text_completion",
-                "created": int(time.time()), "model": self.model_name,
+                "created": int(time.time()), "model": model,
                 "choices": choices,
                 "usage": {"prompt_tokens": len(prompt_ids),
                           "completion_tokens": done_toks,
@@ -320,11 +352,11 @@ class ModelServer:
         resp = await self._sse_response(request)
         if chat:
             for i in range(n):
-                await sse_write(resp, _chunk(self.model_name, rid,
+                await sse_write(resp, _chunk(model, rid,
                                              {"role": "assistant"}, index=i))
         if n == 1:
             async for delta in drain:
-                await sse_write(resp, _chunk(self.model_name, rid,
+                await sse_write(resp, _chunk(model, rid,
                                              {"content": delta}))
         else:
             # n-way merged stream: one pump per choice, deltas interleave
@@ -347,7 +379,7 @@ class ModelServer:
                 if delta is None:
                     live -= 1
                     continue
-                await sse_write(resp, _chunk(self.model_name, rid,
+                await sse_write(resp, _chunk(model, rid,
                                              {"content": delta}, index=i))
             for t in tasks:
                 t.cancel()
@@ -357,7 +389,7 @@ class ModelServer:
         for i, r in enumerate(reqs):
             finish = "error" if r.error else "stop"
             lps = self._format_logprobs(r) if r.logprobs else None
-            final = json.loads(_chunk(self.model_name, rid, {}, finish,
+            final = json.loads(_chunk(model, rid, {}, finish,
                                       index=i, logprobs=lps))
             if r.error:
                 final["error"] = r.error
@@ -366,7 +398,8 @@ class ModelServer:
         return resp
 
     async def _stream_json(self, request: web.Request, rid: str, req,
-                           drain: StreamDrain) -> web.StreamResponse:
+                           drain: StreamDrain,
+                           model: str) -> web.StreamResponse:
         """Stream a grammar-constrained JSON-mode generation. Enforcement
         can degrade at admission (all GRAM_SLOTS pinned, schema rejected at
         registration) — the scheduler records the decision on
@@ -377,7 +410,7 @@ class ModelServer:
         # headers + role chunk go out BEFORE the first-token wait so
         # client/proxy response timeouts see bytes during long prefills
         resp = await self._sse_response(request)
-        await sse_write(resp, _chunk(self.model_name, rid,
+        await sse_write(resp, _chunk(model, rid,
                                      {"role": "assistant"}))
         it = drain.__aiter__()
         try:
@@ -386,7 +419,7 @@ class ModelServer:
             first = None
         error: Optional[str] = None
         if req.grammar_attached and first is not None and not req.error:
-            await sse_write(resp, _chunk(self.model_name, rid,
+            await sse_write(resp, _chunk(model, rid,
                                          {"content": first}))
             async for delta in it:
                 if req.grammar_attached is False:
@@ -398,7 +431,7 @@ class ModelServer:
                     error = ("constrained decoding lost on preemption "
                              "resume; retry the request")
                     continue
-                await sse_write(resp, _chunk(self.model_name, rid,
+                await sse_write(resp, _chunk(model, rid,
                                              {"content": delta}))
         else:
             parts = [] if first is None else [first]
@@ -414,11 +447,11 @@ class ModelServer:
                     found = tools_mod.extract_json_value(text)
                     if found is not None:
                         text = json.dumps(found[0])
-                await sse_write(resp, _chunk(self.model_name, rid,
+                await sse_write(resp, _chunk(model, rid,
                                              {"content": text}))
         error = req.error or error
         finish = "error" if error else "stop"
-        final = json.loads(_chunk(self.model_name, rid, {}, finish))
+        final = json.loads(_chunk(model, rid, {}, finish))
         if error:
             final["error"] = error
         await sse_write(resp, json.dumps(final))
@@ -427,9 +460,10 @@ class ModelServer:
 
     async def _stream_tools(self, request: web.Request, rid: str, req,
                             drain: StreamDrain,
-                            tools: List[Dict[str, Any]]) -> web.StreamResponse:
+                            tools: List[Dict[str, Any]],
+                            model: str) -> web.StreamResponse:
         resp = await self._sse_response(request)
-        await sse_write(resp, _chunk(self.model_name, rid,
+        await sse_write(resp, _chunk(model, rid,
                                      {"role": "assistant"}))
         streamer = tools_mod.ToolCallStreamer(tools)
 
@@ -445,14 +479,14 @@ class ModelServer:
                 else:   # tool_args
                     delta = {"tool_calls": [{
                         "index": ev[1], "function": {"arguments": ev[2]}}]}
-                await sse_write(resp, _chunk(self.model_name, rid, delta))
+                await sse_write(resp, _chunk(model, rid, delta))
 
         async for text in drain:
             await emit(streamer.feed(text))
         await emit(streamer.finish())
         finish = ("error" if req.error
                   else "tool_calls" if streamer.committed else "stop")
-        final = json.loads(_chunk(self.model_name, rid, {}, finish))
+        final = json.loads(_chunk(model, rid, {}, finish))
         if req.error:
             final["error"] = req.error
         await sse_write(resp, json.dumps(final))
@@ -471,13 +505,13 @@ class ModelServer:
 
     async def _stream_buffered(self, request: web.Request, rid: str,
                                message: Dict[str, Any],
-                               finish: str) -> web.StreamResponse:
+                               finish: str, model: str) -> web.StreamResponse:
         """Replay a buffered tool/JSON result as a conforming SSE stream:
         role chunk, one delta carrying the whole content / tool_calls
         (OpenAI clients accumulate deltas, so a single full delta decodes
         identically), then the finish chunk."""
         resp = await self._sse_response(request)
-        await sse_write(resp, _chunk(self.model_name, rid, {"role": "assistant"}))
+        await sse_write(resp, _chunk(model, rid, {"role": "assistant"}))
         delta: Dict[str, Any] = {}
         if message.get("tool_calls"):
             delta["tool_calls"] = [
@@ -485,15 +519,15 @@ class ModelServer:
                 for i, call in enumerate(message["tool_calls"])]
         else:
             delta["content"] = message.get("content") or ""
-        await sse_write(resp, _chunk(self.model_name, rid, delta))
-        await sse_write(resp, _chunk(self.model_name, rid, {}, finish))
+        await sse_write(resp, _chunk(model, rid, delta))
+        await sse_write(resp, _chunk(model, rid, {}, finish))
         await sse_done(resp)
         return resp
 
     async def _stream_error(self, request: web.Request, rid: str,
-                            error: str) -> web.StreamResponse:
+                            error: str, model: str) -> web.StreamResponse:
         resp = await self._sse_response(request)
-        final = json.loads(_chunk(self.model_name, rid, {}, "error"))
+        final = json.loads(_chunk(model, rid, {}, "error"))
         final["error"] = error
         await sse_write(resp, json.dumps(final))
         await sse_done(resp)

@@ -263,17 +263,37 @@ def embed_tokens(params: Params, cfg: LlamaConfig,
 
 
 def _maybe_lora(x: jnp.ndarray, base_out: jnp.ndarray, adapters: Optional[Params],
-                name: str) -> jnp.ndarray:
+                name: str, adapter_ix: Optional[jnp.ndarray] = None
+                ) -> jnp.ndarray:
     """Add a low-rank update x@A@B·(α/r) if an adapter exists for `name`.
 
     Adapter layout (built by train/lora.py): adapters[name] = {"a": (r, in),
     "b": (out, r) * already stacked per layer when scanned} with scale folded
     into "b" at build time.
+
+    STACKED serving layout (engine multi-LoRA, per-layer slice ndim 3):
+    a (N, in, r), b (N, r, out) — N resident adapter slots, slot 0 all-zero
+    (the base model). ``adapter_ix`` (B,) selects each batch row's slot;
+    the update runs for every slot then gathers per row (N·r tiny work —
+    cheaper than a per-row (in, r) weight gather, and one program serves
+    any adapter mix).
     """
     if adapters is None or name not in adapters:
         return base_out
-    a = adapters[name]["a"]  # (in, r)
-    b = adapters[name]["b"]  # (r, out)
+    a = adapters[name]["a"]
+    b = adapters[name]["b"]
+    if a.ndim == 3:
+        B = x.shape[0]
+        ix = (adapter_ix.astype(jnp.int32) if adapter_ix is not None
+              else jnp.zeros((B,), jnp.int32))
+        bi = jnp.arange(B, dtype=jnp.int32)
+        za = jnp.einsum("bsi,nir->nbsr", x, a.astype(x.dtype))
+        z = za[ix, bi]                                    # (B, S, r)
+        # the second projection selects FIRST: the slot is known by now,
+        # so gather b[ix] (B·r·out elements — small) instead of running
+        # all N slots' projections
+        zo = jnp.einsum("bsr,bro->bso", z, b.astype(x.dtype)[ix])
+        return base_out + zo
     return base_out + (x @ a.astype(x.dtype)) @ b.astype(x.dtype)
 
 
@@ -285,17 +305,19 @@ def _norm(cfg: LlamaConfig, x: jnp.ndarray, layer: Params,
 
 
 def _proj(cfg: LlamaConfig, x: jnp.ndarray, layer: Params, name: str,
-          adapters: Optional[Params]) -> jnp.ndarray:
+          adapters: Optional[Params],
+          adapter_ix: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """x @ W (+ b) with the quant seam and optional LoRA update."""
     y = quant.matmul(x, layer[name])
     if cfg.use_bias:
         y = y + layer[name + "_b"].astype(y.dtype)
-    return _maybe_lora(x, y, adapters, name)
+    return _maybe_lora(x, y, adapters, name, adapter_ix)
 
 
 def _block(cfg: LlamaConfig, h: jnp.ndarray, layer: Params,
            cos: jnp.ndarray, sin: jnp.ndarray,
-           attn_fn, adapters: Optional[Params]
+           attn_fn, adapters: Optional[Params],
+           adapter_ix: Optional[jnp.ndarray] = None
            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """One transformer block; `attn_fn(q, k, v) -> ctx` abstracts prefill vs
     decode vs paged attention so the same block serves all paths. Returns
@@ -305,13 +327,13 @@ def _block(cfg: LlamaConfig, h: jnp.ndarray, layer: Params,
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     x = _norm(cfg, h, layer, "attn_norm")
-    q = _proj(cfg, x, layer, "wq", adapters).reshape(B, S, H, HD)
-    k = _proj(cfg, x, layer, "wk", adapters).reshape(B, S, KV, HD)
-    v = _proj(cfg, x, layer, "wv", adapters).reshape(B, S, KV, HD)
+    q = _proj(cfg, x, layer, "wq", adapters, adapter_ix).reshape(B, S, H, HD)
+    k = _proj(cfg, x, layer, "wk", adapters, adapter_ix).reshape(B, S, KV, HD)
+    v = _proj(cfg, x, layer, "wv", adapters, adapter_ix).reshape(B, S, KV, HD)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     ctx = attn_fn(q, k, v).reshape(B, S, H * HD)
-    h = h + _proj(cfg, ctx, layer, "wo", adapters)
+    h = h + _proj(cfg, ctx, layer, "wo", adapters, adapter_ix)
 
     x = _norm(cfg, h, layer, "mlp_norm")
     aux = jnp.float32(0.0)
@@ -326,12 +348,12 @@ def _block(cfg: LlamaConfig, h: jnp.ndarray, layer: Params,
             hidden_act=cfg.hidden_act)
         return h + moe_out, aux
     if cfg.mlp == "glu":
-        gate = _proj(cfg, x, layer, "w_gate", adapters)
-        up = _proj(cfg, x, layer, "w_up", adapters)
+        gate = _proj(cfg, x, layer, "w_gate", adapters, adapter_ix)
+        up = _proj(cfg, x, layer, "w_up", adapters, adapter_ix)
         act = glu(gate, up, cfg.hidden_act)
     else:   # plain c_fc -> act -> c_proj (StarCoder2)
-        act = activate(_proj(cfg, x, layer, "w_up", adapters), cfg.hidden_act)
-    return h + _proj(cfg, act, layer, "w_down", adapters), aux
+        act = activate(_proj(cfg, x, layer, "w_up", adapters, adapter_ix), cfg.hidden_act)
+    return h + _proj(cfg, act, layer, "w_down", adapters, adapter_ix), aux
 
 
 def _unembed(cfg: LlamaConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
@@ -516,7 +538,8 @@ def scan_blocks(cfg: LlamaConfig, h: jnp.ndarray, params: Params,
 def scan_blocks_inplace(cfg: LlamaConfig, h: jnp.ndarray, params: Params,
                         pools: Tuple[jnp.ndarray, ...],
                         cos: jnp.ndarray, sin: jnp.ndarray, attn_and_update,
-                        adapters: Optional[Params]):
+                        adapters: Optional[Params],
+                        adapter_ix: Optional[jnp.ndarray] = None):
     """Layer scan with the FULL KV pool(s) as loop carry, updated in place.
 
     Unlike :func:`scan_blocks` (per-layer cache slices as scan inputs and
@@ -537,7 +560,8 @@ def scan_blocks_inplace(cfg: LlamaConfig, h: jnp.ndarray, params: Params,
             ctx, store["pools"] = attn_and_update(q, k, v, pools, idx)
             return ctx
 
-        h, _ = _block(cfg, h, layer, cos, sin, attn, ad)  # aux unused serving
+        h, _ = _block(cfg, h, layer, cos, sin, attn, ad,
+                      adapter_ix)              # aux unused when serving
         return (h, store["pools"], idx + 1), None
 
     (h, pools, _), _ = jax.lax.scan(

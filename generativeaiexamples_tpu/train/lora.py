@@ -114,6 +114,41 @@ def adapter_logical_axes(cfg: LoraConfig) -> Params:
     return ax
 
 
+def save_adapters(directory: str, adapters: Params, cfg: LoraConfig) -> None:
+    """Persist an adapter tree + its LoraConfig sidecar — the unmerged
+    artifact the serving engine's per-request multi-LoRA loads directly
+    (replacing the reference's merge→re-export flow,
+    finetuning/Gemma/lora.ipynb cell 48, when the adapter should stay
+    hot-swappable)."""
+    import json as _json
+    import os as _os
+
+    from generativeaiexamples_tpu.train.checkpoints import save_params
+    save_params(directory, adapters)
+    with open(_os.path.join(directory, "lora.json"), "w",
+              encoding="utf-8") as fh:
+        _json.dump({"rank": cfg.rank, "alpha": cfg.alpha,
+                    "targets": list(cfg.targets)}, fh)
+
+
+def load_adapters(directory: str, model_cfg: llama.LlamaConfig) -> Params:
+    """Restore an adapter tree saved by :func:`save_adapters` (the
+    lora.json sidecar reconstructs the shape template)."""
+    import json as _json
+    import os as _os
+
+    import jax as _jax
+
+    from generativeaiexamples_tpu.train.checkpoints import load_params
+    with open(_os.path.join(directory, "lora.json"), encoding="utf-8") as fh:
+        meta = _json.load(fh)
+    cfg = LoraConfig(rank=int(meta["rank"]), alpha=float(meta["alpha"]),
+                     targets=tuple(meta["targets"]))
+    template = _jax.eval_shape(
+        lambda: init_adapters(_jax.random.PRNGKey(0), model_cfg, cfg))
+    return load_params(directory, model_cfg, target=template)
+
+
 def merge_adapters(params: Params, adapters: Params) -> Params:
     """Fold each low-rank product into the base weight: W' = W + a@b.
 
