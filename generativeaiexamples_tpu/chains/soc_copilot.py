@@ -1,0 +1,246 @@
+"""SOC analyst copilot: digital-fingerprint anomaly detection + agent.
+
+In-tree analogue of the reference's digital-human security analyst
+(ref: community/digital-human-security-analyst/ — Morpheus Digital
+Fingerprinting per-user autoencoders score event logs, flagged events
+become LLM alert summaries in a database, and a langchain agent with SOC
+tools — network traffic, user directory, threat intel, alert summaries —
+answers the analyst; the speech/face layers are served by the in-tree
+voice loop). TPU-first redesign of the DFP core: ONE jitted train step
+fits every user's tiny autoencoder simultaneously (`vmap` over the user
+axis — Morpheus trains per-user models serially in torch), so a fleet of
+per-entity fingerprints trains in a handful of fused dispatches.
+
+Event features (hour-of-day on the circle, app/location hashes, outcome,
+byte volume) deliberately mirror the DFP azure/duo feature sets at demo
+scale; the anomaly score is the autoencoder's reconstruction error in
+z-units of the user's own training distribution — "unusual FOR THIS USER",
+the property that distinguishes DFP from global outlier detection.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from generativeaiexamples_tpu.chains.tool_agent import Tool, ToolAgent
+
+FEATS = 12
+
+
+def _featurize(ev: Dict[str, Any]) -> np.ndarray:
+    """One auth/network event → a fixed feature vector."""
+    hour = float(ev.get("hour", 0.0))
+    ang = 2 * math.pi * hour / 24.0
+    app_h = (hash(("app", ev.get("app", ""))) % 997) / 997.0
+    loc_h = (hash(("loc", ev.get("location", ""))) % 997) / 997.0
+    dev_h = (hash(("dev", ev.get("device", ""))) % 997) / 997.0
+    mb = float(ev.get("bytes_mb", 0.0))
+    return np.asarray([
+        math.sin(ang), math.cos(ang),
+        app_h, loc_h, dev_h,
+        1.0 if ev.get("success", True) else 0.0,
+        math.log1p(mb) / 10.0,
+        1.0 if ev.get("admin", False) else 0.0,
+        1.0 if ev.get("vpn", False) else 0.0,
+        1.0 if ev.get("new_device", False) else 0.0,
+        float(ev.get("failures_last_hour", 0)) / 10.0,
+        1.0,
+    ], np.float32)
+
+
+def _init_ae(key, hidden: int = 4):
+    k1, k2 = jax.random.split(key)
+    s = 1.0 / math.sqrt(FEATS)
+    return {"w1": jax.random.normal(k1, (FEATS, hidden)) * s,
+            "b1": jnp.zeros((hidden,)),
+            "w2": jax.random.normal(k2, (hidden, FEATS)) * s,
+            "b2": jnp.zeros((FEATS,))}
+
+
+def _recon(p, x):
+    h = jnp.tanh(x @ p["w1"] + p["b1"])
+    return h @ p["w2"] + p["b2"]
+
+
+def _loss(p, x, mask):
+    err = ((_recon(p, x) - x) ** 2).mean(axis=-1)
+    return (err * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+@jax.jit
+def _train_all(params, xs, masks, lr: float = 5e-2, steps: int = 300):
+    """Fit EVERY user's autoencoder in one compiled program: the grad
+    step is vmapped over the leading user axis and scanned over epochs."""
+
+    def one_step(params, _):
+        def per_user(p, x, m):
+            g = jax.grad(_loss)(p, x, m)
+            return jax.tree.map(lambda a, b: a - lr * b, p, g)
+
+        return jax.vmap(per_user)(params, xs, masks), None
+
+    params, _ = jax.lax.scan(one_step, params, None, length=steps)
+    return params
+
+
+@jax.jit
+def _scores(params, xs):
+    def per_user(p, x):
+        return ((_recon(p, x) - x) ** 2).mean(axis=-1)
+
+    return jax.vmap(per_user)(params, xs)
+
+
+@dataclass
+class Fingerprints:
+    """Per-user behavioral models + their training error statistics."""
+
+    users: List[str]
+    params: Any
+    mu: np.ndarray                 # (U,) mean train reconstruction error
+    sd: np.ndarray                 # (U,)
+
+    @staticmethod
+    def fit(history: Dict[str, List[Dict[str, Any]]],
+            seed: int = 0) -> "Fingerprints":
+        users = sorted(history)
+        maxn = max(len(v) for v in history.values())
+        xs = np.zeros((len(users), maxn, FEATS), np.float32)
+        masks = np.zeros((len(users), maxn), np.float32)
+        for u, name in enumerate(users):
+            evs = history[name]
+            for i, ev in enumerate(evs):
+                xs[u, i] = _featurize(ev)
+                masks[u, i] = 1.0
+        keys = jax.random.split(jax.random.PRNGKey(seed), len(users))
+        params = jax.vmap(_init_ae)(keys)
+        params = _train_all(params, jnp.asarray(xs), jnp.asarray(masks))
+        errs = np.asarray(_scores(params, jnp.asarray(xs)))
+        mu = np.zeros(len(users), np.float32)
+        sd = np.ones(len(users), np.float32)
+        for u in range(len(users)):
+            e = errs[u][masks[u] > 0]
+            mu[u] = e.mean()
+            sd[u] = max(float(e.std()), 1e-4)
+        return Fingerprints(users=users, params=params, mu=mu, sd=sd)
+
+    def score(self, user: str, events: Sequence[Dict[str, Any]]
+              ) -> List[float]:
+        """Z-scored reconstruction error of each event under the USER'S
+        OWN model — "unusual for them", not globally unusual."""
+        u = self.users.index(user)
+        x = np.stack([_featurize(e) for e in events]).astype(np.float32)
+        p = jax.tree.map(lambda a: a[u], self.params)
+        err = np.asarray(((_recon(p, jnp.asarray(x)) - x) ** 2).mean(-1))
+        return [float((e - self.mu[u]) / self.sd[u]) for e in err]
+
+
+@dataclass
+class Alert:
+    user: str
+    z: float
+    event: Dict[str, Any]
+    summary: str
+    ts: float = field(default_factory=time.time)
+
+
+class AlertStore:
+    """Alert-summaries database (ref: the copilot's Alert Summaries DB fed
+    by DFP + an LLM NIM). Summaries come from the provided ``summarize``
+    callable — an LLM when one is wired in, a deterministic template
+    otherwise (tests, air-gapped ops)."""
+
+    def __init__(self, summarize: Optional[Callable[[str], str]] = None
+                 ) -> None:
+        self._alerts: List[Alert] = []
+        self._summarize = summarize
+
+    def ingest(self, fp: Fingerprints, user: str,
+               events: Sequence[Dict[str, Any]],
+               z_threshold: float = 3.0) -> List[Alert]:
+        out = []
+        for ev, z in zip(events, fp.score(user, events)):
+            if z < z_threshold:
+                continue
+            base = (f"Anomalous activity for user {user}: "
+                    f"app={ev.get('app')} location={ev.get('location')} "
+                    f"hour={ev.get('hour')} bytes_mb={ev.get('bytes_mb')} "
+                    f"(z={z:.1f} vs their own baseline)")
+            summary = self._summarize(base) if self._summarize else base
+            alert = Alert(user=user, z=z, event=dict(ev), summary=summary)
+            self._alerts.append(alert)
+            out.append(alert)
+        return out
+
+    def query(self, user: str = "", limit: int = 10) -> List[Alert]:
+        hits = [a for a in self._alerts if not user or a.user == user]
+        return sorted(hits, key=lambda a: -a.z)[:limit]
+
+
+def soc_tools(alerts: AlertStore, directory: Dict[str, Dict[str, Any]],
+              threat_intel: Dict[str, str],
+              traffic: List[Dict[str, Any]]) -> List[Tool]:
+    """The analyst agent's tool belt (ref: agent_tools.py — Network
+    Traffic DB, User Directory, Threat Intelligence, Alert Summaries)."""
+
+    def alerts_fn(user: str = "") -> str:
+        return json.dumps([{"user": a.user, "z": round(a.z, 1),
+                            "summary": a.summary}
+                           for a in alerts.query(user)])
+
+    def directory_fn(user: str) -> str:
+        return json.dumps(directory.get(user, {"error": "unknown user"}))
+
+    def intel_fn(indicator: str) -> str:
+        return json.dumps({"indicator": indicator,
+                           "intel": threat_intel.get(
+                               indicator, "no intel on this indicator")})
+
+    def traffic_fn(user: str) -> str:
+        return json.dumps([t for t in traffic if t.get("user") == user][:20])
+
+    u = {"type": "object", "properties": {"user": {"type": "string"}},
+         "required": ["user"]}
+    return [
+        Tool(name="query_alerts",
+             description="Recent DFP anomaly alert summaries, highest "
+                         "severity first; optional user filter.",
+             parameters={"type": "object",
+                         "properties": {"user": {"type": "string"}}},
+             fn=alerts_fn),
+        Tool(name="user_directory",
+             description="Role, department, manager and normal working "
+                         "hours of a user.",
+             parameters=u, fn=directory_fn),
+        Tool(name="threat_intel",
+             description="Threat-intelligence lookup for an indicator "
+                         "(IP, domain, file hash).",
+             parameters={"type": "object", "properties": {
+                 "indicator": {"type": "string"}},
+                 "required": ["indicator"]}, fn=intel_fn),
+        Tool(name="network_traffic",
+             description="Recent network flows for a user.",
+             parameters=u, fn=traffic_fn),
+    ]
+
+
+def build_copilot(llm, alerts: AlertStore, directory, threat_intel,
+                  traffic, max_steps: int = 6) -> ToolAgent:
+    """The analyst-facing agent: multi-step tool reasoning over the SOC
+    stores (speech in/out rides the playground voice loop)."""
+    return ToolAgent(
+        llm, soc_tools(alerts, directory, threat_intel, traffic),
+        max_steps=max_steps,
+        system_prompt=(
+            "You are a SOC analyst copilot. Triage alerts with the "
+            "tools: check the user's directory entry and recent "
+            "traffic, consult threat intel for indicators, and give "
+            "a verdict (false positive vs escalate) with reasons."))

@@ -1,0 +1,120 @@
+"""Auditor (NeMo-Auditor analogue) + asset-lifecycle predictor tools."""
+
+import json
+
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.chains.predictors import (
+    detect_anomalies, forecast, predict_rul, predictor_tools)
+from generativeaiexamples_tpu.evaluation.auditor import (
+    _CANARY, AuditConfig, PROBES, run_audit)
+
+
+# ------------------------------------------------------------------ auditor
+
+def _safe_target(messages):
+    """A well-behaved model: refuses, never leaks, ignores injections."""
+    return "I'm sorry, I can't help with that request."
+
+
+def _vulnerable_target(messages):
+    """Complies with everything, echoes the system prompt on request.
+    Reads through zero-width obfuscation (what the goodside probe tests)."""
+    user = messages[-1]["content"].lower().replace("​", "")
+    system = messages[0]["content"]
+    if "pwned" in user:
+        return "PWNED"
+    if "system prompt" in user or "instructions" in user \
+            or "configuration" in user:
+        return f"Sure, here it is: {system}"
+    return "Sure, here's how. Step 1: obtain a tension wrench..."
+
+
+def test_audit_flags_vulnerable_target_only():
+    cfg = AuditConfig()
+    clean = run_audit(_safe_target, cfg, target_name="safe")
+    assert clean.total_hits == 0
+    assert clean.total_attempts >= len(PROBES)
+
+    dirty = run_audit(_vulnerable_target, cfg, target_name="vuln")
+    hit_families = {r["probe"] for r in dirty.results if r["hits"]}
+    # every family lands at least one hit on the fully-compliant target
+    assert hit_families == set(PROBES)
+    # leakage detection is canary-exact
+    leak = next(r for r in dirty.results if r["probe"] == "leakage")
+    assert all(_CANARY in f["response"] for f in leak["failures"])
+
+
+def test_audit_report_shapes_and_probe_spec():
+    cfg = AuditConfig(probe_spec="dan.AutoDANCached, leakage", attempts=2)
+    assert cfg.families() == ["dan", "leakage"]
+    report = run_audit(_vulnerable_target, cfg, target_name="vuln")
+    blob = report.to_json()
+    assert blob["status"] == "completed"
+    assert blob["summary"]["attempts"] == (3 + 3) * 2
+    md = report.to_markdown()
+    assert "| dan |" in md and "| leakage |" in md
+    assert "Failing transcripts" in md
+    with pytest.raises(ValueError, match="unknown probe"):
+        AuditConfig(probe_spec="nonsense").families()
+
+
+def test_audit_counts_target_errors_as_findings():
+    def crashy(messages):
+        raise RuntimeError("boom")
+
+    report = run_audit(crashy, AuditConfig(probe_spec="dan"))
+    assert report.total_hits == report.total_attempts
+
+
+# --------------------------------------------------------------- predictors
+
+def test_forecast_continues_linear_trend():
+    t = np.arange(50, dtype=np.float32)
+    series = 2.0 + 0.5 * t
+    fc = forecast(series, horizon=10)[:, 0]
+    want = 2.0 + 0.5 * (50 + np.arange(10))
+    np.testing.assert_allclose(fc, want, rtol=1e-3, atol=1e-2)
+
+
+def test_predict_rul_threshold_crossing():
+    t = np.arange(60, dtype=np.float32)
+    health = 0.1 + 0.01 * t                   # fails at 1.0 → t = 90
+    out = predict_rul(health, failure_threshold=1.0, horizon=96)
+    assert out["status"] == "forecast_crossing"
+    assert out["rul"] == pytest.approx(31, abs=3)   # 90 - 59 ≈ 31 cycles
+    # healthy flat asset: conservative cap, never a tiny RUL
+    flat = predict_rul(np.full(60, 0.2, np.float32), 1.0,
+                       max_rul_cycles=500)
+    assert flat["status"] == "no_degradation_trend"
+    assert flat["rul"] == 400.0
+    short = predict_rul(np.ones(3, np.float32), 1.0)
+    assert short["status"] == "insufficient_data"
+
+
+def test_detect_anomalies_flags_spikes_only():
+    rng = np.random.RandomState(0)
+    series = np.sin(np.arange(200) / 9).astype(np.float32) \
+        + rng.randn(200).astype(np.float32) * 0.05
+    series[50] += 3.0
+    series[140] -= 2.5
+    out = detect_anomalies(series)
+    idx = {a["index"] for a in out["anomalies"]}
+    assert {50, 140} <= idx
+    assert len(idx) <= 6                     # no blanket flagging
+
+
+def test_predictor_tools_integrate_with_tool_agent():
+    tools = predictor_tools()
+    by_name = {t.name: t for t in tools}
+    series = json.dumps(list(np.round(0.1 + 0.01 * np.arange(40), 4)))
+    out = json.loads(by_name["predict_rul"].fn(
+        series=series, failure_threshold=1.0))
+    assert out["rul"] > 0
+    spec = by_name["predict_rul"].spec()
+    assert spec["function"]["name"] == "predict_rul"
+    anom = json.loads(by_name["detect_anomalies"].fn(
+        series=json.dumps({"series": [0, 0, 0, 9, 0, 0, 0, 0, 0, 0]}),
+        z_threshold=3.0))
+    assert any(a["index"] == 3 for a in anom["anomalies"])

@@ -1,0 +1,111 @@
+"""SOC copilot: per-user digital fingerprints, alert store, analyst agent.
+
+Pins the property that defines DFP (ref community/digital-human-security-
+analyst, Morpheus DFP workflow): anomaly means unusual FOR THIS USER — an
+event perfectly normal for a night-shift admin must alert when it appears
+in a day-shift accountant's stream, and vice versa.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.chains.soc_copilot import (
+    AlertStore, Fingerprints, build_copilot)
+
+
+def _day_event(i, **kw):
+    ev = {"hour": 9 + (i % 8), "app": "sap", "location": "office-berlin",
+          "device": "laptop-17", "success": True, "bytes_mb": 2.0}
+    ev.update(kw)
+    return ev
+
+
+def _night_event(i, **kw):
+    ev = {"hour": (22 + i % 7) % 24, "app": "ssh", "location": "dc-east",
+          "device": "bastion-3", "success": True, "bytes_mb": 40.0,
+          "admin": True}
+    ev.update(kw)
+    return ev
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    history = {
+        "alice": [_day_event(i) for i in range(64)],
+        "bob": [_night_event(i) for i in range(64)],
+    }
+    return Fingerprints.fit(history), history
+
+
+def test_fingerprints_are_per_user(fleet):
+    fp, _ = fleet
+    night = _night_event(0)
+    day = _day_event(0)
+    # bob's normal night admin work: normal for bob, anomalous for alice
+    assert fp.score("bob", [night])[0] < 3.0
+    assert fp.score("alice", [night])[0] > 3.0
+    assert fp.score("alice", [day])[0] < 3.0
+    assert fp.score("bob", [day])[0] > 3.0
+
+
+def test_exfil_event_alerts_with_summary(fleet):
+    fp, _ = fleet
+    store = AlertStore()
+    exfil = _day_event(0, hour=3, app="rclone", location="unknown-vps",
+                       bytes_mb=9000.0, new_device=True)
+    raised = store.ingest(fp, "alice", [_day_event(1), exfil])
+    assert len(raised) == 1
+    assert raised[0].user == "alice" and raised[0].z > 3.0
+    assert "rclone" in raised[0].summary
+    top = store.query("alice")
+    assert top and top[0].summary == raised[0].summary
+    # an LLM summarizer slots in via the callable seam
+    store2 = AlertStore(summarize=lambda s: f"SUMMARY: {s[:40]}")
+    raised2 = store2.ingest(fp, "alice", [exfil])
+    assert raised2[0].summary.startswith("SUMMARY:")
+
+
+class _ScriptedLLM:
+    """Tool-calling LLM stub: looks up alerts, then the directory, then
+    verdicts — the copilot loop, without weights."""
+
+    def __init__(self):
+        self.step = 0
+
+    def chat_tools(self, messages, tools, tool_choice="auto", **kw):
+        self.step += 1
+        if self.step == 1:
+            return {"role": "assistant", "content": None, "tool_calls": [
+                {"id": "c1", "type": "function", "function": {
+                    "name": "query_alerts",
+                    "arguments": json.dumps({"user": "alice"})}}]}
+        if self.step == 2:
+            return {"role": "assistant", "content": None, "tool_calls": [
+                {"id": "c2", "type": "function", "function": {
+                    "name": "user_directory",
+                    "arguments": json.dumps({"user": "alice"})}}]}
+        last = [m for m in messages if m.get("role") == "tool"]
+        return {"role": "assistant",
+                "content": f"Escalate: {len(last)} tool results reviewed."}
+
+
+def test_copilot_agent_runs_tools_end_to_end(fleet):
+    fp, _ = fleet
+    store = AlertStore()
+    store.ingest(fp, "alice", [_day_event(0, hour=3, app="rclone",
+                                          bytes_mb=9000.0)])
+    agent = build_copilot(
+        _ScriptedLLM(), store,
+        directory={"alice": {"role": "accountant", "hours": "9-17"}},
+        threat_intel={"unknown-vps": "known exfil staging host"},
+        traffic=[{"user": "alice", "dst": "unknown-vps", "mb": 9000}])
+    events = list(agent.run("Should I worry about alice?"))
+    kinds = [e["type"] for e in events]
+    assert kinds.count("tool_call") == 2
+    assert kinds[-1] == "final"
+    assert "Escalate" in events[-1]["content"]
+    # the first tool result actually carried the alert summary
+    tool_results = [e for e in events if e["type"] == "tool_result"]
+    assert "rclone" in tool_results[0]["content"]
