@@ -47,8 +47,20 @@ def build_scheduler(tiny: bool = False) -> tuple:
         model_cfg = configs[family]()
         tokenizer = get_tokenizer(cfg.engine.checkpoint_dir)
         if cfg.engine.checkpoint_dir:
-            from generativeaiexamples_tpu.train.checkpoints import load_params
-            params = load_params(cfg.engine.checkpoint_dir, model_cfg)
+            from generativeaiexamples_tpu.models.hf_loader import (
+                is_hf_dir, load_hf_dir)
+            if is_hf_dir(cfg.engine.checkpoint_dir):
+                # a local HuggingFace checkpoint serves directly (the
+                # NIM-parity path: real weights from a model directory,
+                # config derived from config.json — no conversion step)
+                model_cfg, params = load_hf_dir(cfg.engine.checkpoint_dir)
+                logging.info("serving HF checkpoint %s (%s layers, dim %s)",
+                             cfg.engine.checkpoint_dir,
+                             model_cfg.n_layers, model_cfg.dim)
+            else:
+                from generativeaiexamples_tpu.train.checkpoints import (
+                    load_params)
+                params = load_params(cfg.engine.checkpoint_dir, model_cfg)
         else:
             logging.warning("no checkpoint_dir set — serving RANDOM weights")
             params = llama.init_params(jax.random.PRNGKey(0), model_cfg)

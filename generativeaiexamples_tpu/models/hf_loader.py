@@ -1,0 +1,66 @@
+"""Serve a local HuggingFace Llama checkpoint directory directly.
+
+The reference's NIM serves real Llama checkpoints out of a model
+directory (docs/support-matrix.md:17-19); the in-tree equivalent loads a
+local HF-format directory — ``config.json`` + ``*.safetensors`` (+
+``tokenizer.json``, picked up separately by engine/tokenizer.py) — maps
+it through :func:`models.llama.params_from_hf`, and derives the
+:class:`LlamaConfig` from the HF config, so
+``APP_ENGINE_CHECKPOINT_DIR=/path/to/hf-llama`` serves real weights with
+no conversion step. Zero torch on the load path: safetensors reads
+straight into numpy.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from glob import glob
+from typing import Tuple
+
+from generativeaiexamples_tpu.models import llama
+
+
+def is_hf_dir(directory: str) -> bool:
+    return (os.path.isfile(os.path.join(directory, "config.json"))
+            and bool(glob(os.path.join(directory, "*.safetensors"))))
+
+
+def config_from_hf(directory: str) -> llama.LlamaConfig:
+    """LlamaConfig from an HF ``config.json`` (llama/llama3 families)."""
+    with open(os.path.join(directory, "config.json"), encoding="utf-8") as fh:
+        hc = json.load(fh)
+    arch = (hc.get("architectures") or ["LlamaForCausalLM"])[0]
+    if "Llama" not in arch:
+        raise ValueError(f"unsupported HF architecture {arch!r} "
+                         "(llama-family checkpoints only)")
+    n_heads = int(hc["num_attention_heads"])
+    head_dim = int(hc.get("head_dim")
+                   or hc["hidden_size"] // n_heads)
+    return llama.LlamaConfig(
+        vocab_size=int(hc["vocab_size"]),
+        dim=int(hc["hidden_size"]),
+        n_layers=int(hc["num_hidden_layers"]),
+        n_heads=n_heads,
+        n_kv_heads=int(hc.get("num_key_value_heads", n_heads)),
+        hidden_dim=int(hc["intermediate_size"]),
+        head_dim=head_dim,
+        rope_theta=float(hc.get("rope_theta", 500000.0)),
+        norm_eps=float(hc.get("rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(hc.get("tie_word_embeddings", False)),
+        dtype="bfloat16",
+    )
+
+
+def load_hf_dir(directory: str) -> Tuple[llama.LlamaConfig, llama.Params]:
+    """(config, params) from a local HF Llama directory — safetensors →
+    numpy → :func:`llama.params_from_hf` (which owns the layout mapping
+    and the HF-parity guarantees the test suite pins)."""
+    from safetensors.numpy import load_file
+
+    cfg = config_from_hf(directory)
+    state = {}
+    for shard in sorted(glob(os.path.join(directory, "*.safetensors"))):
+        state.update(load_file(shard))
+    params = llama.params_from_hf(state, cfg)
+    return cfg, params
