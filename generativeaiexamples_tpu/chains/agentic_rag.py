@@ -23,8 +23,11 @@ from generativeaiexamples_tpu.chains.basic_rag import _sampling, trim_context
 from generativeaiexamples_tpu.server import guardrails
 from generativeaiexamples_tpu.chains.context import ChainContext, get_context
 from generativeaiexamples_tpu.chains.loaders import load_document
+from generativeaiexamples_tpu.chains.lookahead import (
+    DEFAULT_SIM_THRESHOLD, LookaheadRetrieval)
 from generativeaiexamples_tpu.chains.query_decomposition import extract_json
 from generativeaiexamples_tpu.core.tracing import chain_instrumentation
+from generativeaiexamples_tpu.observability.otel import stage_span
 from generativeaiexamples_tpu.retrieval.bm25 import (
     BM25Index, reciprocal_rank_fusion)
 from generativeaiexamples_tpu.retrieval.store import Document
@@ -64,9 +67,15 @@ class AgenticRAG(BaseExample):
     # ------------------------------------------------------------ retrieval
 
     def _hybrid_retrieve(self, query: str, top_k: int) -> List[Document]:
+        return self._hybrid_with_vec(query, top_k)[1]
+
+    def _hybrid_with_vec(self, query: str, top_k: int, qvec=None):
         """BM25 + dense, fused by reciprocal rank (the EnsembleRetriever
-        equivalent)."""
-        qvec = self.ctx.embedder.embed_queries([query])[0]
+        equivalent). Returns (qvec, docs) — the vector feeds the lookahead
+        reconcile (chains/lookahead.py), which passes it back on a requery
+        so the query is never embedded twice."""
+        if qvec is None:
+            qvec = self.ctx.embedder.embed_queries([query])[0]
         dense_hits = self.ctx.store(COLLECTION).search(
             qvec, top_k=top_k * 2, score_threshold=0.0)
         sparse_hits = self.bm25.search(query, top_k=top_k * 2)
@@ -85,7 +94,34 @@ class AgenticRAG(BaseExample):
         dense_rank = [pool_idx(d) for d, _ in dense_hits]
         sparse_rank = [pool_idx(self._bm25_docs[i]) for i, _ in sparse_hits]
         fused = reciprocal_rank_fusion([dense_rank, sparse_rank], top_k=top_k)
-        return [pool[i] for i in fused]
+        return qvec, [pool[i] for i in fused]
+
+    def _rewrite_with_lookahead(self, question: str, top_k: int,
+                                held, reuse_similar: bool, **settings: Any):
+        """Run the question-rewrite LLM call with the CURRENT question's
+        in-hand retrieval seeded as the speculation (TeleRAG reconcile,
+        chains/lookahead.py). ``held`` is this iteration's already-computed
+        ``(qvec, ungraded_docs)`` — seeding it costs ZERO new encoder/store
+        work; retrieval runs again only when the rewrite diverges. Returns
+        (rewritten_question, (qvec, docs) valid for it).
+
+        ``reuse_similar=False`` forces the re-retrieve whenever the rewrite
+        changed the text at all — used on the docs-rejected path, where the
+        held docs were just graded irrelevant and a merely-similar rewrite
+        must hit BM25/dense afresh. An identical rewrite still reuses them
+        (same query → same docs, by construction)."""
+        look = LookaheadRetrieval(
+            lambda q, v=None: self._hybrid_with_vec(q, top_k, v),
+            sim_threshold=(DEFAULT_SIM_THRESHOLD if reuse_similar else 2.0))
+        look.seed(question, held)
+        with stage_span("rewrite"):
+            rewritten = self._rewrite_question(question, **settings)
+        with stage_span("retrieve"):
+            qvec, docs = look.reconcile(
+                rewritten,
+                embed=(lambda q: self.ctx.embedder.embed_queries([q])[0])
+                if reuse_similar else None)
+        return rewritten, (qvec, docs)
 
     # -------------------------------------------------------------- graders
 
@@ -142,14 +178,22 @@ class AgenticRAG(BaseExample):
         rcfg = self.ctx.config.retriever
         question = query
         generation = ""
+        held = None    # (qvec, ungraded docs) for the CURRENT question
         for attempt in range(MAX_RETRIES + 1):
-            docs = self._hybrid_retrieve(question, rcfg.top_k)
-            docs = self._grade_documents(question, docs, **llm_settings)
+            if held is None:
+                with stage_span("retrieve"):
+                    held = self._hybrid_with_vec(question, rcfg.top_k)
+            raw_docs = held[1]
+            docs = self._grade_documents(question, raw_docs, **llm_settings)
             if not docs:
                 if attempt >= MAX_RETRIES:
                     yield NO_CONTEXT_MSG
                     return
-                question = self._rewrite_question(question, **llm_settings)
+                # docs were graded irrelevant: only an IDENTICAL rewrite may
+                # reuse this iteration's retrieval (reuse_similar=False)
+                question, held = self._rewrite_with_lookahead(
+                    question, rcfg.top_k, held, reuse_similar=False,
+                    **llm_settings)
                 logger.info("no relevant docs; rewrote question to %r",
                             question)
                 continue
@@ -169,8 +213,15 @@ class AgenticRAG(BaseExample):
                 **llm_settings)
             if useful or attempt >= MAX_RETRIES:
                 break
-            if grounded:  # answered but not useful → rewrite the question
-                question = self._rewrite_question(question, **llm_settings)
+            if grounded:  # answered but not useful → rewrite the question;
+                # these docs PASSED grading, so a similar rewrite may reuse
+                # the held retrieval (reuse_similar=True)
+                question, held = self._rewrite_with_lookahead(
+                    question, rcfg.top_k, held, reuse_similar=True,
+                    **llm_settings)
+            # not grounded: regenerate the SAME question — `held` already
+            # carries its retrieval, so the next iteration re-grades without
+            # recomputing it (the store is deterministic)
             logger.info("generation rejected (grounded=%s); retrying",
                         grounded)
         yield generation or NO_CONTEXT_MSG

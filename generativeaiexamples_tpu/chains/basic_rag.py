@@ -21,6 +21,7 @@ from typing import Any, Dict, Iterator, List, Sequence
 from generativeaiexamples_tpu.chains.context import ChainContext, get_context
 from generativeaiexamples_tpu.chains.loaders import load_document
 from generativeaiexamples_tpu.core.tracing import chain_instrumentation
+from generativeaiexamples_tpu.observability.otel import stage_span
 from generativeaiexamples_tpu.retrieval.store import Document
 from generativeaiexamples_tpu.server import guardrails
 from generativeaiexamples_tpu.server.base import BaseExample
@@ -90,9 +91,14 @@ class BasicRAG(BaseExample):
     def rag_chain(self, query: str, chat_history: Sequence[Dict[str, str]],
                   **llm_settings: Any) -> Iterator[str]:
         rcfg = self.ctx.config.retriever
-        qvec = self.ctx.embedder.embed_queries([query])[0]
-        hits = self.ctx.store(self.collection).search(
-            qvec, top_k=rcfg.top_k, score_threshold=rcfg.score_threshold)
+        # stage spans + stage_<name>_s histograms (observability/otel.py):
+        # the per-request view of the pipelined dataplane — embed rides the
+        # cross-request micro-batcher, so concurrent requests share dispatches
+        with stage_span("embed"):
+            qvec = self.ctx.embedder.embed_queries([query])[0]
+        with stage_span("retrieve"):
+            hits = self.ctx.store(self.collection).search(
+                qvec, top_k=rcfg.top_k, score_threshold=rcfg.score_threshold)
         context_text = trim_context([d.content for d, _ in hits],
                                     self.ctx.embedder.tokenizer,
                                     rcfg.max_context_tokens)
@@ -100,7 +106,8 @@ class BasicRAG(BaseExample):
         system = self.ctx.prompts["rag_template"].format(context=context_text)
         messages = ([{"role": "system", "content": system}]
                     + list(chat_history) + [{"role": "user", "content": query}])
-        yield from self.ctx.llm.chat(messages, **_sampling(llm_settings))
+        with stage_span("generate"):
+            yield from self.ctx.llm.chat(messages, **_sampling(llm_settings))
 
     # ------------------------------------------------------------ documents
 

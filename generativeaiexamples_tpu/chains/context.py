@@ -66,11 +66,17 @@ def get_context(scheduler=None) -> ChainContext:
             from generativeaiexamples_tpu.chains.llm_client import get_llm
 
             config = get_config()
+            # process-wide encoders micro-batch across requests: every
+            # chain's embed/rerank call rides shared TPU dispatches
+            # (encoders/microbatch.py; windows in core/config.py)
             _context = ChainContext(
                 config=config,
                 llm=get_llm(scheduler),
-                embedder=Embedder(),
-                reranker=Reranker(),
+                embedder=Embedder(
+                    micro_window_s=config.embeddings.microbatch_window_ms
+                    / 1e3),
+                reranker=Reranker(
+                    micro_window_s=config.ranking.microbatch_window_ms / 1e3),
             )
         return _context
 

@@ -35,6 +35,12 @@ class LocalLLM:
         req = Request(prompt_ids=prompt_ids, max_tokens=max_tokens,
                       temperature=temperature, top_p=top_p, top_k=top_k,
                       grammar=grammar, stop=list(stop or []))
+        # Lazily submitted (generator body): submission on first pull keeps
+        # the invariant that an un-iterated chat() never orphans a
+        # generating request on the device. The dataplane's stage overlap
+        # comes from the chains issuing sibling stages concurrently
+        # (chains/lookahead.py), not from racing submit ahead of the
+        # consumer — every call site drains immediately.
         self.scheduler.submit(req)
         yield from self.scheduler.iter_text(req)
         # the scheduler rejects e.g. over-capacity prompts per-request

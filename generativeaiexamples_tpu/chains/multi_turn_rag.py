@@ -12,7 +12,13 @@ chains.py:63-68).
 
 TPU design: both rerank passes are single bucketed cross-encoder batches
 (one jitted forward each — see encoders/reranker.py), so the funnel costs
-~2 forwards instead of 80 HTTP calls.
+~2 forwards instead of 80 HTTP calls — and they ISSUE CONCURRENTLY, so the
+pair-granular micro-batcher (encoders/microbatch.py) can merge them into
+one. When the request carries chat history, the follow-up is first
+condensed into a standalone query (prompts.query_rewriter_prompt) with the
+raw-query retrieval speculatively overlapped behind that LLM call
+(chains/lookahead.py, TeleRAG) — docs/rag_pipeline.md has the full
+dataplane picture.
 """
 
 from __future__ import annotations
@@ -24,7 +30,10 @@ from generativeaiexamples_tpu.chains.basic_rag import _sampling, trim_context
 from generativeaiexamples_tpu.server import guardrails
 from generativeaiexamples_tpu.chains.context import ChainContext, get_context
 from generativeaiexamples_tpu.chains.loaders import load_document
+from generativeaiexamples_tpu.chains.lookahead import (
+    LookaheadRetrieval, submit_concurrently)
 from generativeaiexamples_tpu.core.tracing import chain_instrumentation
+from generativeaiexamples_tpu.observability.otel import stage_span
 from generativeaiexamples_tpu.retrieval.store import Document
 from generativeaiexamples_tpu.server.base import BaseExample
 from generativeaiexamples_tpu.server.registry import register_example
@@ -99,16 +108,59 @@ class MultiTurnRAG(BaseExample):
                     {"role": "user", "content": query}]
         yield from self.ctx.llm.chat(messages, **_sampling(llm_settings))
 
+    def _condense(self, query: str, chat_history: Sequence[Dict[str, str]],
+                  **settings: Any) -> str:
+        """Rewrite a follow-up question into a standalone retrieval query
+        using the turn history (the condense step of the reference's
+        conversational examples; prompts.query_rewriter_prompt)."""
+        s = _sampling(settings)
+        s.update(max_tokens=96, temperature=0.0)
+        history_txt = "\n".join(
+            f"{m.get('role', 'user')}: {m.get('content', '')}"
+            for m in chat_history)
+        out = "".join(self.ctx.llm.chat(
+            [{"role": "system",
+              "content": self.ctx.prompts["query_rewriter_prompt"]},
+             {"role": "user",
+              "content": f"History:\n{history_txt}\n\n"
+                         f"Follow-up question: {query}"}], **s)).strip()
+        return out or query
+
     @chain_instrumentation
     def rag_chain(self, query: str, chat_history: Sequence[Dict[str, str]],
                   **llm_settings: Any) -> Iterator[str]:
         rcfg = self.ctx.config.retriever
-        qvec = self.ctx.embedder.embed_queries([query])[0]
 
-        context_pool = self._retrieve_pool(DOCS, qvec, wide=True)
-        history_pool = self._retrieve_pool(CONV, qvec, wide=True)
-        context = self._funnel(query, context_pool)
-        history = self._funnel(query, history_pool)
+        def retrieve_pools(q: str, qvec=None):
+            if qvec is None:
+                qvec = self.ctx.embedder.embed_queries([q])[0]
+            return qvec, (self._retrieve_pool(DOCS, qvec, wide=True),
+                          self._retrieve_pool(CONV, qvec, wide=True))
+
+        search_query = query
+        if chat_history:
+            # Lookahead retrieval (TeleRAG, chains/lookahead.py): the
+            # condense LLM call and the raw-query retrieval run CONCURRENTLY;
+            # reconcile reuses the speculative pools when the rewrite stays
+            # close in embedding space and re-retrieves otherwise
+            look = LookaheadRetrieval(retrieve_pools).start(query)
+            with stage_span("condense"):
+                search_query = self._condense(query, chat_history,
+                                              **llm_settings)
+            with stage_span("retrieve"):
+                _, (context_pool, history_pool) = look.reconcile(
+                    search_query,
+                    embed=lambda q: self.ctx.embedder.embed_queries([q])[0])
+        else:
+            with stage_span("retrieve"):
+                _, (context_pool, history_pool) = retrieve_pools(query)
+
+        # both funnels issue together: the reranker micro-batcher coalesces
+        # their (query, passage) pairs into a shared cross-encoder dispatch
+        with stage_span("rerank"):
+            context, history = submit_concurrently(
+                lambda: self._funnel(search_query, context_pool),
+                lambda: self._funnel(search_query, history_pool))
 
         if not context and not history:
             yield NO_CONTEXT_MSG  # ref chains.py:198-203
@@ -129,9 +181,10 @@ class MultiTurnRAG(BaseExample):
                     {"role": "user", "content": query}]
 
         response = ""
-        for chunk in self.ctx.llm.chat(messages, **_sampling(llm_settings)):
-            response += chunk
-            yield chunk
+        with stage_span("generate"):
+            for chunk in self.ctx.llm.chat(messages, **_sampling(llm_settings)):
+                response += chunk
+                yield chunk
         self._save_memory(query, response)
 
     # ------------------------------------------------------------ documents

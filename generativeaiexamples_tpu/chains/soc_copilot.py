@@ -20,6 +20,7 @@ the property that distinguishes DFP from global outlier detection.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import math
 import time
@@ -35,13 +36,22 @@ from generativeaiexamples_tpu.chains.tool_agent import Tool, ToolAgent
 FEATS = 12
 
 
+def _stable_hash(kind: str, value: str) -> float:
+    """Process-independent categorical hash feature in [0, 1) — builtin
+    ``hash()`` is randomized per process (PYTHONHASHSEED), which would make
+    fingerprints trained in one process disagree with scoring in another."""
+    digest = hashlib.blake2b(f"{kind}:{value}".encode("utf-8"),
+                             digest_size=4).digest()
+    return (int.from_bytes(digest, "little") % 997) / 997.0
+
+
 def _featurize(ev: Dict[str, Any]) -> np.ndarray:
     """One auth/network event → a fixed feature vector."""
     hour = float(ev.get("hour", 0.0))
     ang = 2 * math.pi * hour / 24.0
-    app_h = (hash(("app", ev.get("app", ""))) % 997) / 997.0
-    loc_h = (hash(("loc", ev.get("location", ""))) % 997) / 997.0
-    dev_h = (hash(("dev", ev.get("device", ""))) % 997) / 997.0
+    app_h = _stable_hash("app", ev.get("app", ""))
+    loc_h = _stable_hash("loc", ev.get("location", ""))
+    dev_h = _stable_hash("dev", ev.get("device", ""))
     mb = float(ev.get("bytes_mb", 0.0))
     return np.asarray([
         math.sin(ang), math.cos(ang),

@@ -179,6 +179,7 @@ class EmbeddingConfig:
     dimensions: int = configfield("dimensions", default=512, help_txt="Embedding dimensionality.")
     model_engine: str = configfield("model_engine", default="tpu", help_txt="tpu|openai-compat.")
     server_url: str = configfield("server_url", default="", help_txt="Remote embedding server; empty = in-process.")
+    microbatch_window_ms: float = configfield("microbatch_window_ms", default=2.0, help_txt="Cross-request embed micro-batch wait window in ms; 0 disables coalescing (encoders/microbatch.py).")
 
 
 @dataclass(frozen=True)
@@ -188,6 +189,7 @@ class RankingConfig:
     model_name: str = configfield("model_name", default="rerank-minilm-tpu", help_txt="Cross-encoder model name.")
     model_engine: str = configfield("model_engine", default="tpu", help_txt="tpu|openai-compat.")
     server_url: str = configfield("server_url", default="", help_txt="Remote rerank server; empty = in-process.")
+    microbatch_window_ms: float = configfield("microbatch_window_ms", default=2.0, help_txt="Cross-request rerank micro-batch wait window in ms; 0 disables coalescing (encoders/microbatch.py).")
 
 
 @dataclass(frozen=True)

@@ -73,6 +73,14 @@ class Histogram:
         return self._count
 
     @property
+    def sum(self) -> float:
+        """Total of all observed values (never evicted, unlike the
+        percentile reservoir) — lets callers window a mean over an interval
+        by differencing (sum, count) snapshots; bench.py windows the
+        encoder micro-batch wait stats to the measured RAG phase this way."""
+        return self._sum
+
+    @property
     def mean(self) -> float:
         return self._sum / self._count if self._count else 0.0
 
@@ -108,6 +116,7 @@ class MetricsRegistry:
         for name, h in histograms.items():
             out[name] = {
                 "count": h.count,
+                "sum": round(h.sum, 6),
                 "mean": round(h.mean, 6),
                 "p50": round(h.percentile(50), 6),
                 "p90": round(h.percentile(90), 6),

@@ -8,4 +8,5 @@ the reference's clients consume (utils.py:431-440, 458-471).
 """
 
 from generativeaiexamples_tpu.encoders.embedder import Embedder  # noqa: F401
+from generativeaiexamples_tpu.encoders.microbatch import MicroBatcher  # noqa: F401
 from generativeaiexamples_tpu.encoders.reranker import Reranker  # noqa: F401

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.encoders.microbatch import MicroBatcher
 from generativeaiexamples_tpu.engine.tokenizer import Tokenizer, get_tokenizer
 from generativeaiexamples_tpu.models import bert
 
@@ -33,7 +34,8 @@ class Embedder:
     def __init__(self, cfg: Optional[bert.BertConfig] = None,
                  params: Optional[bert.Params] = None,
                  tokenizer: Optional[Tokenizer] = None,
-                 max_len: int = 512, max_batch: int = 32) -> None:
+                 max_len: int = 512, max_batch: int = 32,
+                 micro_window_s: float = 0.0) -> None:
         self.cfg = cfg or bert.BertConfig.tiny()
         self.params = params if params is not None else bert.init_params(
             jax.random.PRNGKey(11), self.cfg)
@@ -42,10 +44,26 @@ class Embedder:
         self.max_batch = max_batch
         self._embed = jax.jit(
             lambda p, t, m: bert.embed(p, self.cfg, t, m, normalize=True))
+        # cross-request micro-batching (encoders/microbatch.py): concurrent
+        # embed calls from chains / the HTTP server coalesce into single
+        # TPU dispatches. Opt-in — direct bulk users (ingest pipelines,
+        # tests) keep the plain path.
+        self._batcher: Optional[MicroBatcher] = (
+            MicroBatcher(self._run, max_items=max_batch,
+                         window_s=micro_window_s, name="embed")
+            if micro_window_s > 0 else None)
 
     @property
     def dim(self) -> int:
         return self.cfg.dim
+
+    def close(self) -> None:
+        """Stop the micro-batch worker thread (no-op without one). Code
+        that constructs embedders repeatedly in one process must close them
+        or leak a parked daemon thread per instance."""
+        if self._batcher is not None:
+            self._batcher.close()
+            self._batcher = None
 
     def _bucket(self, n: int, cap: int) -> int:
         b = 8
@@ -87,8 +105,17 @@ class Embedder:
         return (np.concatenate(out, axis=0) if out
                 else np.zeros((0, self.dim), np.float32))
 
+    def _dispatch(self, texts: Sequence[str]) -> np.ndarray:
+        if not texts:
+            return np.zeros((0, self.dim), np.float32)
+        if self._batcher is not None:
+            # rows route back as this submission's contiguous slice of the
+            # coalesced batch output — stack preserves input order
+            return np.asarray(self._batcher.submit(list(texts)))
+        return self._run(texts)
+
     def embed_queries(self, texts: Sequence[str]) -> np.ndarray:
-        return self._run([QUERY_PREFIX + t for t in texts])
+        return self._dispatch([QUERY_PREFIX + t for t in texts])
 
     def embed_documents(self, texts: Sequence[str]) -> np.ndarray:
-        return self._run([PASSAGE_PREFIX + t for t in texts])
+        return self._dispatch([PASSAGE_PREFIX + t for t in texts])

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.encoders.microbatch import MicroBatcher
 from generativeaiexamples_tpu.engine.tokenizer import Tokenizer, get_tokenizer
 from generativeaiexamples_tpu.models import bert
 
@@ -28,7 +29,8 @@ class Reranker:
     def __init__(self, cfg: Optional[bert.BertConfig] = None,
                  params: Optional[bert.Params] = None,
                  tokenizer: Optional[Tokenizer] = None,
-                 max_len: int = 512, max_batch: int = 64) -> None:
+                 max_len: int = 512, max_batch: int = 64,
+                 micro_window_s: float = 0.0) -> None:
         self.cfg = cfg or bert.BertConfig.tiny()
         self.params = params if params is not None else bert.init_params(
             jax.random.PRNGKey(13), self.cfg, with_rank_head=True)
@@ -37,6 +39,24 @@ class Reranker:
         self.max_batch = max_batch
         self._score = jax.jit(
             lambda p, t, m, tt: bert.rank_score(p, self.cfg, t, m, tt))
+        # cross-request micro-batching: scoring is (query, passage) PAIR
+        # granular, so two concurrent requests' 40-passage funnels coalesce
+        # into shared dispatches (encoders/microbatch.py). The coalescing
+        # unit must hold SEVERAL funnels (a 40→4 funnel is one ~40-pair
+        # submission, and submissions never split) — _score_pairs chunks by
+        # max_batch internally with dispatch-ahead, so a large unit costs
+        # nothing beyond the window.
+        self._batcher: Optional[MicroBatcher] = (
+            MicroBatcher(self._score_pairs, max_items=4 * max_batch,
+                         window_s=micro_window_s, name="rerank")
+            if micro_window_s > 0 else None)
+
+    def close(self) -> None:
+        """Stop the micro-batch worker thread (no-op without one) — see
+        Embedder.close()."""
+        if self._batcher is not None:
+            self._batcher.close()
+            self._batcher = None
 
     def _bucket(self, n: int, cap: int) -> int:
         b = 8
@@ -44,11 +64,19 @@ class Reranker:
             b *= 2
         return min(b, cap)
 
-    def _pack(self, query: str, passages: Sequence[str]):
-        q_ids = self.tokenizer.encode(query)[: self.max_len // 2]
+    def _pack_pairs(self, pairs: Sequence[Tuple[str, str]]):
+        """Bucketed (tokens, mask, types) for a batch of (query, passage)
+        pairs — pair-granular so one batch can mix queries (the micro-batch
+        coalescing unit)."""
+        q_cache: dict = {}
         rows = []
-        for p in passages:
-            p_ids = self.tokenizer.encode(p)[: self.max_len - len(q_ids) - 1]
+        for query, passage in pairs:
+            q_ids = q_cache.get(query)
+            if q_ids is None:
+                q_ids = self.tokenizer.encode(query)[: self.max_len // 2]
+                q_cache[query] = q_ids
+            p_ids = self.tokenizer.encode(passage)[
+                : self.max_len - len(q_ids) - 1]
             rows.append((q_ids, p_ids))
         S = self._bucket(max(len(q) + len(p) + 1 for q, p in rows), self.max_len)
         B = self._bucket(len(rows), self.max_batch)
@@ -64,22 +92,30 @@ class Reranker:
             mask[r, 0] = True
         return tokens, mask, types
 
-    def score(self, query: str, passages: Sequence[str]) -> np.ndarray:
-        """Relevance scores (len(passages),) — one jitted batch per ≤max_batch."""
-        if not passages:
-            return np.zeros((0,), np.float32)
-        # dispatch-ahead across batches (see embedder._run): issue all
-        # programs, then fetch — hides the per-batch transfer round trip
+    def _score_pairs(self, pairs: Sequence[Tuple[str, str]]) -> np.ndarray:
+        """Scores for (query, passage) pairs — one jitted batch per
+        ≤max_batch, dispatch-ahead across batches (see embedder._run):
+        issue all programs, then fetch — hides the per-batch transfer
+        round trip."""
         pending = []
-        for i in range(0, len(passages), self.max_batch):
-            chunk = passages[i:i + self.max_batch]
-            tokens, mask, types = self._pack(query, chunk)
+        for i in range(0, len(pairs), self.max_batch):
+            chunk = pairs[i:i + self.max_batch]
+            tokens, mask, types = self._pack_pairs(chunk)
             scores = self._score(self.params, jnp.asarray(tokens),
                                  jnp.asarray(mask), jnp.asarray(types))
             pending.append((scores, len(chunk)))
-        REGISTRY.counter("pairs_reranked").inc(len(passages))
+        REGISTRY.counter("pairs_reranked").inc(len(pairs))
         return np.concatenate([np.asarray(s_)[:n] for s_, n in pending],
                               axis=0)
+
+    def score(self, query: str, passages: Sequence[str]) -> np.ndarray:
+        """Relevance scores (len(passages),) for one query."""
+        if not passages:
+            return np.zeros((0,), np.float32)
+        pairs = [(query, p) for p in passages]
+        if self._batcher is not None:
+            return np.asarray(self._batcher.submit(pairs))
+        return self._score_pairs(pairs)
 
     def rerank(self, query: str, passages: Sequence[str],
                top_n: int = 4) -> List[Tuple[int, float]]:

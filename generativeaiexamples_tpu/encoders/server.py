@@ -11,7 +11,9 @@ Endpoints match what the reference's LangChain clients call:
 
 from __future__ import annotations
 
+import asyncio
 import json
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 from aiohttp import web
@@ -30,7 +32,14 @@ class EncoderServer:
         self.reranker = reranker
         self.model_name = model_name
         self.rerank_model_name = rerank_model_name
+        # Encoder calls leave the event loop: run inline they would BLOCK
+        # it, serializing concurrent HTTP requests and defeating the
+        # micro-batcher (encoders/microbatch.py) — with a pool, concurrent
+        # requests submit concurrently and coalesce into shared dispatches.
+        self._pool = ThreadPoolExecutor(max_workers=16,
+                                        thread_name_prefix="encoder-http")
         self.app = web.Application()
+        self.app.on_cleanup.append(self._shutdown)
         routes = [web.get("/health", self.health),
                   web.get("/metrics", self.metrics)]
         if embedder is not None:
@@ -38,6 +47,12 @@ class EncoderServer:
         if reranker is not None:
             routes.append(web.post("/v1/ranking", self.ranking))
         self.app.add_routes(routes)
+
+    async def _shutdown(self, app: web.Application) -> None:
+        self._pool.shutdown(wait=False)
+        for enc in (self.embedder, self.reranker):
+            if enc is not None and hasattr(enc, "close"):
+                enc.close()
 
     async def health(self, request: web.Request) -> web.Response:
         return web.json_response({"message": "Service is up."})
@@ -55,7 +70,8 @@ class EncoderServer:
         input_type = body.get("input_type", "passage")
         fn = (self.embedder.embed_queries if input_type == "query"
               else self.embedder.embed_documents)
-        vecs = fn(texts)
+        vecs = await asyncio.get_running_loop().run_in_executor(
+            self._pool, fn, texts)
         return web.json_response({
             "object": "list",
             "model": self.model_name,
@@ -72,7 +88,9 @@ class EncoderServer:
             raise web.HTTPBadRequest(text=json.dumps(
                 {"error": "query and passages required"}))
         top_n = int(body.get("top_n") or len(passages))
-        ranked = self.reranker.rerank(query, passages, top_n=top_n)
+        ranked = await asyncio.get_running_loop().run_in_executor(
+            self._pool, lambda: self.reranker.rerank(query, passages,
+                                                     top_n=top_n))
         return web.json_response({
             "model": self.rerank_model_name,
             "rankings": [{"index": i, "logit": s} for i, s in ranked],

@@ -978,7 +978,36 @@ class EngineCore:
         if not name:
             raise ValueError("adapter name must be non-empty")
         if name in self._adapter_names:
-            return self._adapter_names[name]
+            # No-rebind invariant: a registered name maps to the SAME
+            # weights forever. The scheduler's prefix-cache hash seed
+            # namespaces KV pages by adapter NAME alone (scheduler
+            # _cache_seed) — rebinding a name to new weights would serve
+            # pages computed under the old ones. Idempotent re-registration
+            # of identical weights is allowed; anything else is refused.
+            ix = self._adapter_names[name]
+            if self._adapters_stacked:
+                def _matches(s, leaf) -> bool:
+                    # EXACT equality, not allclose: the slot was written via
+                    # this same astype, so a true re-register matches
+                    # bitwise — while an incremental fine-tune whose deltas
+                    # sit under a tolerance must NOT be absorbed as
+                    # "identical" (it would silently serve stale weights)
+                    resident = s[:, ix]
+                    return (tuple(leaf.shape) == tuple(resident.shape)
+                            and bool(jnp.array_equal(resident,
+                                                     leaf.astype(s.dtype))))
+                try:
+                    same = all(jax.tree.leaves(
+                        jax.tree.map(_matches, self.adapters, tree)))
+                except ValueError:   # different tree structure = rebind
+                    same = False
+                if not same:
+                    raise ValueError(
+                        f"adapter {name!r} is already registered with "
+                        f"different weights; rebinding is not supported — "
+                        f"prefix-cache pages are namespaced by adapter name "
+                        f"and would go stale. Register under a new name.")
+            return ix
         if self.adapters is not None and not self._adapters_stacked:
             raise ValueError(
                 "engine was built with a global adapter tree; per-request "

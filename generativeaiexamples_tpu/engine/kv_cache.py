@@ -241,7 +241,8 @@ def prefill_chunk(params: llama.Params, cfg: llama.LlamaConfig,
 
     positions = start_pos + jnp.arange(C, dtype=jnp.int32)[None]    # (1, C)
     h = llama.embed_tokens(params, cfg, tokens)
-    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta,
+                                 scaling=cfg.rope_scaling)
     valid_through = (start_pos + chunk_len)[None]                   # (1,)
     chunk_pages = jax.lax.dynamic_slice(page_row, (start_pos // ps,), (n_cp,))
     cache_positions = jnp.arange(T, dtype=jnp.int32)[None]          # (1, T)
@@ -347,7 +348,8 @@ def prefill_chunks(params: llama.Params, cfg: llama.LlamaConfig,
 
     positions = start_pos[:, None] + jnp.arange(C, dtype=jnp.int32)[None]
     h = llama.embed_tokens(params, cfg, tokens)
-    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta,
+                                 scaling=cfg.rope_scaling)
     valid_through = start_pos + chunk_len                           # (G,)
     chunk_pages = jax.vmap(
         lambda row, sp: jax.lax.dynamic_slice(row, (sp // ps,), (n_cp,)))(
@@ -466,7 +468,8 @@ def decode_step_wide(params: llama.Params, cfg: llama.LlamaConfig,
     L = cache.lengths                                        # (B,)
     positions = L[:, None] + jnp.arange(Q, dtype=jnp.int32)[None]   # (B, Q)
     h = llama.embed_tokens(params, cfg, tokens)
-    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta,
+                                 scaling=cfg.rope_scaling)
     # rows valid for attention INCLUDE this step's Q writes. NOT clamped to
     # the pool capacity: the pallas kernel reconstructs query positions as
     # attn_len - Q + qi, so a clamp would shift every query's causal limit

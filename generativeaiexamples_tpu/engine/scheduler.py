@@ -126,6 +126,11 @@ class Request:
     first_token_at: Optional[float] = None
     completion_tokens: int = 0
     error: Optional[str] = None
+    # why generation ended — "eos" (model emitted EOS), "stop" (a stop
+    # sequence matched), "length" (max_tokens or cache capacity). The
+    # serving layer maps this to the OpenAI finish_reason contract
+    # (eos/stop → "stop", length → "length"); None = not finished / failed.
+    finish_reason: Optional[str] = None
 
 
 @dataclass
@@ -179,8 +184,13 @@ class Scheduler:
         self._free: List[int] = list(range(core.batch))
         self._alloc = core.new_allocator()
         # prefix caching (engine/prefix_cache.py): present iff the core's
-        # allocator speaks match/acquire/insert. seed namespaces the hash
-        # chain by the weights that produce KV (bumped per adapter set).
+        # allocator speaks match/acquire/insert. The hash-chain seed
+        # namespaces pages by the weights that produced their KV: the seed
+        # string appends the request's ADAPTER NAME, and names are
+        # immutable-once-registered (core.register_adapter refuses
+        # rebinding — the invariant this constant seed relies on; if
+        # rebinding is ever allowed, an adapter-epoch counter must be
+        # folded in here).
         self._caching = hasattr(self._alloc, "match")
         self._cache_seed = 0
         # speculative decoding widens every decode step to W positions per
@@ -337,6 +347,11 @@ class Scheduler:
                                          job.stop_buf + tail)
             if not hit:
                 emit += hold
+            if hit:
+                # a stop match found only at flush still ended the output
+                # at the stop string — report "stop", not the budget/EOS
+                # cause the caller recorded
+                job.request.finish_reason = "stop"
             if emit:
                 job.request.out_queue.put(emit)
         elif tail:
@@ -534,6 +549,7 @@ class Scheduler:
                     logger.warning("resume of %s no longer fits (%d tokens); "
                                    "finishing at capacity",
                                    job.request.request_id, n)
+                    job.request.finish_reason = "length"
                     self._finish(job)
                 else:
                     # could never be served — fail loudly rather than hang
@@ -767,6 +783,7 @@ class Scheduler:
             job.prefill_started = 0.0
         already = len(job.gen_ids)
         if first == self.core.eos_id:
+            req.finish_reason = "eos"
             del self._slots[job.slot]
             self._finish(job)
             return
@@ -774,6 +791,7 @@ class Scheduler:
             self._retire(job)
             return
         if already + 1 >= req.max_tokens:
+            req.finish_reason = "length"
             del self._slots[job.slot]
             self._finish(job)
 
@@ -796,6 +814,7 @@ class Scheduler:
                 req.out_queue.put(emit)
             if stopped:
                 job.stopped = True
+                req.finish_reason = "stop"
                 return True
         elif delta:
             req.out_queue.put(delta)
@@ -1021,6 +1040,10 @@ class Scheduler:
                         self._retire(job)
                         break
                 if out["done"][k, slot]:
+                    # the device ends a slot for EOS, generation budget, or
+                    # cache capacity — everything but EOS is a truncation
+                    req.finish_reason = ("eos" if out["hit_eos"][k, slot]
+                                         else "length")
                     del self._slots[slot]
                     self._finish(job)
                     break

@@ -67,6 +67,20 @@ def _grammar_for(kind: str, payload: str) -> Optional[object]:
     return None
 
 
+def _finish_reason(req, default: str = "stop") -> str:
+    """OpenAI finish_reason from the scheduler's recorded finish cause:
+    "length" must be distinguishable from a stop-string / EOS end (the
+    OpenAI contract clients use to detect budget truncation). ``default``
+    carries caller overrides like "tool_calls"."""
+    if getattr(req, "error", None):
+        return "error"
+    if default == "tool_calls":
+        return default   # a parsed tool call is complete regardless of cause
+    if getattr(req, "finish_reason", None) == "length":
+        return "length"
+    return default
+
+
 def _chunk(model: str, rid: str, delta: Dict[str, Any],
            finish_reason: Optional[str] = None, index: int = 0,
            logprobs: Optional[Dict[str, Any]] = None) -> str:
@@ -309,7 +323,7 @@ class ModelServer:
                 found = tools_mod.extract_json_value(text)
                 if found is not None:
                     text = json.dumps(found[0])
-            finish = "tool_calls" if tool_calls else "stop"
+            finish = "tool_calls" if tool_calls else _finish_reason(req)
             message: Dict[str, Any] = {"role": "assistant",
                                        "content": None if tool_calls else text}
             if tool_calls:
@@ -324,7 +338,7 @@ class ModelServer:
             for i, (r, t) in enumerate(zip(reqs, texts)):
                 # a secondary choice's engine failure must not pass off its
                 # truncated text as a clean stop
-                fin = (finish if i == 0 else "stop") if not r.error else "error"
+                fin = _finish_reason(r, finish if i == 0 else "stop")
                 choice: Dict[str, Any] = {"index": i, "finish_reason": fin}
                 msg = message if i == 0 else {"role": "assistant",
                                               "content": t}
@@ -387,7 +401,7 @@ class ModelServer:
         # the error rides inside a schema-shaped chunk so conforming clients
         # (chunk["choices"][0]) keep parsing
         for i, r in enumerate(reqs):
-            finish = "error" if r.error else "stop"
+            finish = _finish_reason(r)
             lps = self._format_logprobs(r) if r.logprobs else None
             final = json.loads(_chunk(model, rid, {}, finish,
                                       index=i, logprobs=lps))
@@ -450,7 +464,7 @@ class ModelServer:
                 await sse_write(resp, _chunk(model, rid,
                                              {"content": text}))
         error = req.error or error
-        finish = "error" if error else "stop"
+        finish = "error" if error else _finish_reason(req)
         final = json.loads(_chunk(model, rid, {}, finish))
         if error:
             final["error"] = error
@@ -485,7 +499,8 @@ class ModelServer:
             await emit(streamer.feed(text))
         await emit(streamer.finish())
         finish = ("error" if req.error
-                  else "tool_calls" if streamer.committed else "stop")
+                  else "tool_calls" if streamer.committed
+                  else _finish_reason(req))
         final = json.loads(_chunk(model, rid, {}, finish))
         if req.error:
             final["error"] = req.error

@@ -37,6 +37,7 @@ def config_from_hf(directory: str) -> llama.LlamaConfig:
     n_heads = int(hc["num_attention_heads"])
     head_dim = int(hc.get("head_dim")
                    or hc["hidden_size"] // n_heads)
+    rope_scaling = _parse_rope_scaling(hc.get("rope_scaling"))
     return llama.LlamaConfig(
         vocab_size=int(hc["vocab_size"]),
         dim=int(hc["hidden_size"]),
@@ -46,10 +47,42 @@ def config_from_hf(directory: str) -> llama.LlamaConfig:
         hidden_dim=int(hc["intermediate_size"]),
         head_dim=head_dim,
         rope_theta=float(hc.get("rope_theta", 500000.0)),
+        rope_scaling=rope_scaling,
         norm_eps=float(hc.get("rms_norm_eps", 1e-5)),
         tie_embeddings=bool(hc.get("tie_word_embeddings", False)),
         dtype="bfloat16",
     )
+
+
+def _parse_rope_scaling(block) -> "tuple | None":
+    """HF ``rope_scaling`` → the LlamaConfig tuple, or a LOUD failure.
+
+    Llama-3.1/3.2 checkpoints ship ``rope_type: "llama3"`` (rescale
+    low-frequency RoPE components at all positions — ops/layers.py
+    rotary_embedding applies it); serving such a checkpoint while ignoring
+    the block would produce silently wrong positional encodings, so any
+    rope_scaling this loader does not implement raises instead of
+    degrading."""
+    if not block:
+        return None
+    rope_type = str(block.get("rope_type") or block.get("type") or "")
+    if rope_type in ("default", "none"):
+        return None
+    if rope_type == "llama3":
+        try:
+            return (float(block["factor"]),
+                    float(block["low_freq_factor"]),
+                    float(block["high_freq_factor"]),
+                    int(block["original_max_position_embeddings"]))
+        except KeyError as exc:
+            raise ValueError(
+                f"rope_scaling of type 'llama3' is missing field {exc}; "
+                f"got {sorted(block)}") from exc
+    raise ValueError(
+        f"unsupported rope_scaling type {rope_type!r} in config.json — "
+        "implemented: 'llama3' (Llama-3.1/3.2), 'default'. Serving this "
+        "checkpoint without its scaling rule would silently corrupt "
+        "positional encodings.")
 
 
 def load_hf_dir(directory: str) -> Tuple[llama.LlamaConfig, llama.Params]:

@@ -55,6 +55,10 @@ class LlamaConfig:
     hidden_dim: int = 14336
     head_dim: int = 128
     rope_theta: float = 500000.0
+    # llama3 rope-scaling rule as (factor, low_freq_factor, high_freq_factor,
+    # original_max_position_embeddings); None = plain RoPE. A tuple (not a
+    # dict) so the frozen config stays hashable for jit static closures.
+    rope_scaling: Optional[Tuple[float, float, float, int]] = None
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
     dtype: str = "bfloat16"
@@ -406,7 +410,8 @@ def forward(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
     h = (input_embeds if input_embeds is not None
          else embed_tokens(params, cfg, tokens))
-    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta,
+                                 scaling=cfg.rope_scaling)
 
     attn = attn_fn if attn_fn is not None else partial(
         mha_prefill, q_positions=positions, kv_positions=positions,
@@ -490,7 +495,8 @@ def prefill_seq_parallel(params: Params, cfg: LlamaConfig,
         seq_lens = jnp.full((B,), S, jnp.int32)
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
     h = embed_tokens(params, cfg, tokens)
-    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta,
+                                 scaling=cfg.rope_scaling)
     attn = partial(sequence_parallel_attention, mesh=mesh, impl=impl,
                    kv_lens=seq_lens, causal=True)
 
@@ -613,7 +619,8 @@ def prefill(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
     T = cache.k.shape[2]
     positions = start_pos[:, None] + jnp.arange(S, dtype=jnp.int32)[None]
     h = embed_tokens(params, cfg, tokens)
-    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta,
+                                 scaling=cfg.rope_scaling)
     cache_positions = jnp.arange(T, dtype=jnp.int32)[None]
     kv_valid_through = (start_pos + seq_lens)
 
@@ -653,7 +660,8 @@ def decode_step(params: Params, cfg: LlamaConfig, tokens: jnp.ndarray,
     T = cache.k.shape[2]
     positions = cache.lengths[:, None]                      # (B, 1)
     h = embed_tokens(params, cfg, tokens[:, None])       # (B, 1, D)
-    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta,
+                                 scaling=cfg.rope_scaling)
     new_lengths = cache.lengths + 1
 
     use_pallas = (cfg.attn_impl == "pallas" and cfg.sliding_window == 0

@@ -376,6 +376,27 @@ def current_span() -> Optional[Span]:
     return _current_span.get()
 
 
+@contextmanager
+def stage_span(name: str, tracer_name: str = "rag") -> Iterator[Span]:
+    """Span + latency histogram for one RAG pipeline stage.
+
+    The pipelined dataplane needs per-stage visibility (embed / retrieve /
+    rerank / generate) on BOTH surfaces: the span lands in whatever exporter
+    is configured (child of the enclosing chain span, so stage waterfalls
+    show up in Jaeger), and the wall time lands in a ``stage_<name>_s``
+    histogram (core/metrics.py) that /metrics and bench.py read even when
+    tracing is disabled."""
+    from generativeaiexamples_tpu.core.metrics import REGISTRY
+
+    t0 = time.perf_counter()
+    try:
+        with get_tracer(tracer_name).span(f"{tracer_name}:{name}") as span:
+            yield span
+    finally:
+        REGISTRY.histogram(f"stage_{name}_s").observe(
+            time.perf_counter() - t0)
+
+
 # ---------------------------------------------------------------------------
 # W3C TraceContext propagation (ref: tracing.py:46 TraceContextTextMapPropagator)
 # ---------------------------------------------------------------------------

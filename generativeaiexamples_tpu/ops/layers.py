@@ -7,7 +7,7 @@ notes). Accumulations happen in float32 regardless of the bf16 carrier dtype.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -64,15 +64,34 @@ def glu(gate: jnp.ndarray, up: jnp.ndarray, act: str = "silu") -> jnp.ndarray:
 
 
 def rotary_embedding(positions: jnp.ndarray, head_dim: int,
-                     theta: float = 500000.0) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                     theta: float = 500000.0,
+                     scaling: Optional[Tuple[float, float, float, int]] = None,
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """cos/sin tables for the given positions, HF split-half convention.
 
     positions: (..., S) int32 → cos,sin: (..., S, head_dim) where the second
     half duplicates the first (rotate-half layout, matching HF Llama so HF
     checkpoints load without permutation).
+
+    ``scaling`` applies the llama3 rope-scaling rule as ``(factor,
+    low_freq_factor, high_freq_factor, original_max_position_embeddings)``
+    (HF ``_compute_llama3_parameters``): low-frequency components (wavelength
+    beyond the original context) are divided by ``factor``, high-frequency
+    components pass through, and the band between interpolates smoothly —
+    what Llama-3.1/3.2 checkpoints ship in config.json and need at ALL
+    positions for HF-parity outputs.
     """
     half = head_dim // 2
     freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    if scaling is not None:
+        factor, low_f, high_f, original_max = scaling
+        wavelen = 2.0 * jnp.pi / freqs
+        low_wavelen = original_max / low_f
+        high_wavelen = original_max / high_f
+        smooth = (original_max / wavelen - low_f) / (high_f - low_f)
+        mid = (1.0 - smooth) * freqs / factor + smooth * freqs
+        freqs = jnp.where(wavelen > low_wavelen, freqs / factor,
+                          jnp.where(wavelen < high_wavelen, freqs, mid))
     angles = positions.astype(jnp.float32)[..., None] * freqs  # (..., S, half)
     angles = jnp.concatenate([angles, angles], axis=-1)        # (..., S, hd)
     return jnp.cos(angles), jnp.sin(angles)

@@ -119,7 +119,8 @@ def pipelined_forward(params: Params, cfg: llama.LlamaConfig,
     h = llama.embed_tokens(params, cfg, tokens)              # (B, S, D)
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None],
                                  (B, S))
-    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta,
+                                 scaling=cfg.rope_scaling)
 
     data = int(mesh.shape.get("data", 1))
     if (B // data) % M:

@@ -124,26 +124,35 @@ class VectorStore:
 
     def search(self, query_embedding: np.ndarray, top_k: int = 4,
                score_threshold: float = 0.0) -> List[Tuple[Document, float]]:
+        # Under the lock: SNAPSHOT only. The matrix/grouped arrays are
+        # replaced (never mutated) by add(), and _valid_host is copied to
+        # device here, so the scoring below runs on a consistent view —
+        # concurrent searches (N RAG clients + lookahead threads, the
+        # pipelined dataplane's normal state) no longer serialize their
+        # device compute on the store lock.
         with self._lock:
             if not self._docs or self._matrix is None:
                 return []
-            q = jnp.asarray(np.asarray(query_embedding, np.float32))
-            q = q / jnp.linalg.norm(q).clip(1e-9)
             valid = jnp.asarray(self._valid_host)
+            matrix = self._matrix
             k = min(top_k, self._capacity)
             # gate on *live* rows (deleted entries stay as None placeholders);
             # an all-deleted store must fall through to brute force rather
             # than k-means over zero vectors
             n_live = int(np.count_nonzero(self._valid_host[: self._capacity]))
-            if self.index_type == "ivf" and n_live > self.nlist * 4:
+            use_ivf = self.index_type == "ivf" and n_live > self.nlist * 4
+            if use_ivf:
                 self._maybe_build_ivf()
-                cap = self._grouped.shape[1]
-                k = min(k, self.nprobe * cap)
-                vals, idx = _ivf_search(self._grouped, self._grouped_ids,
-                                        self._centroids, valid, q,
-                                        self.nprobe, k)
-            else:
-                vals, idx = _topk_scores(self._matrix, q, valid, k)
+                grouped, grouped_ids = self._grouped, self._grouped_ids
+                centroids = self._centroids
+        q = jnp.asarray(np.asarray(query_embedding, np.float32))
+        q = q / jnp.linalg.norm(q).clip(1e-9)
+        if use_ivf:
+            k = min(k, self.nprobe * grouped.shape[1])
+            vals, idx = _ivf_search(grouped, grouped_ids, centroids, valid, q,
+                                    self.nprobe, k)
+        else:
+            vals, idx = _topk_scores(matrix, q, valid, k)
         vals = np.asarray(vals)
         idx = np.asarray(idx)
         out: List[Tuple[Document, float]] = []

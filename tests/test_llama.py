@@ -173,3 +173,76 @@ def test_lora_adapters_thread_through_all_paths(tiny):
                         jnp.concatenate([tokens, nxt[:, None]], axis=1),
                         adapters=adapters)
     np.testing.assert_allclose(np.asarray(dec), np.asarray(ref[:, -1]), atol=1e-4)
+
+
+# ------------------------------------------------------------ rope scaling
+
+def test_rotary_llama3_scaling_bands():
+    """The llama3 rope_scaling rule (ADVICE: Llama-3.1/3.2 checkpoints):
+    high-frequency components pass through untouched, low-frequency
+    components are slowed by exactly `factor`, the band between
+    interpolates — pinned against a direct reimplementation of HF's
+    _compute_llama3_parameters."""
+    from generativeaiexamples_tpu.ops.layers import rotary_embedding
+
+    head_dim, theta = 64, 500000.0
+    factor, low_f, high_f, orig_max = 8.0, 1.0, 4.0, 8192
+    pos = jnp.arange(1, 9, dtype=jnp.int32)[None]
+    cos_s, sin_s = rotary_embedding(pos, head_dim, theta,
+                                    scaling=(factor, low_f, high_f, orig_max))
+
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (np.arange(half, dtype=np.float64) / half))
+    wavelen = 2 * np.pi / freqs
+    expected = freqs.copy()
+    for i in range(half):
+        if wavelen[i] > orig_max / low_f:             # low frequency
+            expected[i] = freqs[i] / factor
+        elif wavelen[i] >= orig_max / high_f:         # smooth band
+            smooth = (orig_max / wavelen[i] - low_f) / (high_f - low_f)
+            expected[i] = (1 - smooth) * freqs[i] / factor + smooth * freqs[i]
+    # the rule must actually fire on both ends for this shape
+    assert expected[0] == freqs[0]
+    assert expected[-1] == freqs[-1] / factor
+    angles = np.asarray(pos, np.float64)[..., None] * expected
+    np.testing.assert_allclose(np.asarray(cos_s)[..., :half],
+                               np.cos(angles), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(sin_s)[..., half:],
+                               np.sin(angles), atol=1e-5)
+    # and scaling=None stays the plain table
+    cos_p, _ = rotary_embedding(pos, head_dim, theta)
+    assert not np.allclose(np.asarray(cos_p), np.asarray(cos_s))
+
+
+def test_hf_loader_parses_and_rejects_rope_scaling(tmp_path):
+    import json as _json
+
+    from generativeaiexamples_tpu.models import hf_loader
+
+    base = {"architectures": ["LlamaForCausalLM"], "vocab_size": 300,
+            "hidden_size": 64, "num_hidden_layers": 2,
+            "num_attention_heads": 4, "num_key_value_heads": 2,
+            "intermediate_size": 128, "head_dim": 16,
+            "rope_theta": 500000.0}
+    d = tmp_path / "ckpt"
+    d.mkdir()
+
+    def write(extra):
+        (d / "config.json").write_text(_json.dumps({**base, **extra}))
+
+    write({"rope_scaling": {"rope_type": "llama3", "factor": 8.0,
+                            "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                            "original_max_position_embeddings": 8192}})
+    cfg = hf_loader.config_from_hf(str(d))
+    assert cfg.rope_scaling == (8.0, 1.0, 4.0, 8192)
+
+    write({})                                     # no block → plain RoPE
+    assert hf_loader.config_from_hf(str(d)).rope_scaling is None
+
+    write({"rope_scaling": {"rope_type": "yarn", "factor": 2.0}})
+    with pytest.raises(ValueError, match="rope_scaling"):
+        hf_loader.config_from_hf(str(d))          # unsupported: fail loudly
+
+    write({"rope_scaling": {"rope_type": "llama3", "factor": 8.0}})
+    with pytest.raises(ValueError, match="missing"):
+        hf_loader.config_from_hf(str(d))          # malformed: fail loudly

@@ -1,0 +1,405 @@
+"""Pipelined RAG dataplane: cross-request micro-batching, lookahead
+retrieval, finish-cause reporting.
+
+Pins the three mechanisms of the pipelined dataplane:
+
+  * MicroBatcher — concurrent callers coalesce into one dispatch, results
+    route back to the right caller, flush triggers on BOTH the wait window
+    and the max-batch cap, failures propagate without poisoning the worker;
+  * LookaheadRetrieval — a similar rewrite reuses speculative hits, a
+    divergent rewrite re-retrieves (TeleRAG reconcile);
+  * finish_reason — the scheduler records WHY a generation ended
+    (eos/stop/length) and the /v1 server maps it to the OpenAI contract.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.chains.lookahead import LookaheadRetrieval
+from generativeaiexamples_tpu.core.config import EngineConfig
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.encoders import Embedder, MicroBatcher, Reranker
+from generativeaiexamples_tpu.engine.engine import EngineCore
+from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
+from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+from generativeaiexamples_tpu.models import llama
+
+
+# ------------------------------------------------------------ microbatcher
+
+def test_microbatcher_coalesces_and_routes():
+    dispatched = []
+
+    def dispatch(items):
+        dispatched.append(list(items))
+        return [x * 2 for x in items]
+
+    mb = MicroBatcher(dispatch, max_items=64, window_s=0.05, name="mb_t1")
+    barrier = threading.Barrier(6)
+    out = {}
+
+    def worker(i):
+        barrier.wait()
+        out[i] = list(mb.submit([i, 10 + i]))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    mb.close()
+    # every caller got exactly its own doubled items back
+    for i in range(6):
+        assert out[i] == [2 * i, 2 * (10 + i)]
+    # callers released together shared dispatches: strictly fewer dispatches
+    # than callers, and at least one batch carried several submissions
+    assert len(dispatched) < 6
+    assert max(len(batch) for batch in dispatched) > 2
+
+
+def test_microbatcher_flushes_on_max_batch_without_window():
+    """A full batch dispatches immediately — the (long) window must not be
+    waited out when max_items items are already queued."""
+    def dispatch(items):
+        return list(items)
+
+    mb = MicroBatcher(dispatch, max_items=4, window_s=30.0, name="mb_t2")
+    t0 = time.perf_counter()
+    assert list(mb.submit([1, 2, 3, 4])) == [1, 2, 3, 4]
+    assert time.perf_counter() - t0 < 5.0
+    mb.close()
+
+
+def test_microbatcher_flushes_on_window_timeout():
+    """A lone submission dispatches after the window even though the batch
+    never fills."""
+    def dispatch(items):
+        return list(items)
+
+    mb = MicroBatcher(dispatch, max_items=64, window_s=0.01, name="mb_t3")
+    t0 = time.perf_counter()
+    assert list(mb.submit([7])) == [7]
+    assert time.perf_counter() - t0 < 5.0
+    mb.close()
+
+
+def test_microbatcher_propagates_errors_and_recovers():
+    calls = {"n": 0}
+
+    def dispatch(items):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ValueError("bad batch")
+        return list(items)
+
+    mb = MicroBatcher(dispatch, max_items=8, window_s=0.005, name="mb_t4")
+    with pytest.raises(ValueError, match="bad batch"):
+        mb.submit([1])
+    # the worker survives a failed dispatch and serves the next one
+    assert list(mb.submit([2])) == [2]
+    mb.close()
+
+
+def test_microbatcher_rejects_result_count_mismatch():
+    mb = MicroBatcher(lambda items: items[:-1], max_items=8,
+                      window_s=0.005, name="mb_t5")
+    with pytest.raises(RuntimeError, match="results"):
+        mb.submit([1, 2])
+    mb.close()
+
+
+@pytest.fixture(scope="module")
+def encoders():
+    # ONE compile for the whole module: every test shares these instances
+    # (the suite runs under tier-1's global timeout; per-test encoder
+    # construction would pay the bert jit twice more per test)
+    return (Embedder(micro_window_s=0.05), Reranker(micro_window_s=0.05))
+
+
+def test_concurrent_embed_queries_share_dispatch_no_leakage(encoders):
+    """The ISSUE's acceptance bar: concurrent embed_queries callers provably
+    share TPU dispatches (fill > 1) with results routed back per caller."""
+    e, _ = encoders
+    e.embed_queries(["warm the bucket"])
+    d0 = REGISTRY.counter("embed_dispatches").value
+    i0 = REGISTRY.counter("embeddings_computed").value
+
+    n = 8
+    barrier = threading.Barrier(n)
+    results = {}
+
+    def call(i):
+        barrier.wait()
+        results[i] = e.embed_queries([f"query text number {i}"])[0]
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dispatches = REGISTRY.counter("embed_dispatches").value - d0
+    items = REGISTRY.counter("embeddings_computed").value - i0
+    assert items == n
+    # released through a barrier, the callers coalesce: mean fill > 1
+    assert dispatches < n
+    assert items / dispatches > 1.0
+    # no cross-request leakage: each caller's vector equals its sequential
+    # embedding (batch composition only perturbs padding)
+    for i in range(n):
+        seq = e.embed_queries([f"query text number {i}"])[0]
+        np.testing.assert_allclose(results[i], seq, atol=1e-4)
+
+
+def test_concurrent_rerank_coalesces_across_queries(encoders):
+    """Pair-granular packing: two requests with DIFFERENT queries share a
+    cross-encoder dispatch and still score exactly as they would alone."""
+    _, r = encoders
+    passages = [f"passage about topic {i}" for i in range(6)]
+    r.score("warm", passages)
+    d0 = REGISTRY.counter("rerank_dispatches").value
+
+    barrier = threading.Barrier(2)
+    out = {}
+
+    def call(q):
+        barrier.wait()
+        out[q] = r.score(q, passages)
+
+    threads = [threading.Thread(target=call, args=(q,))
+               for q in ("what is topic 1", "tell me about topic 4")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert REGISTRY.counter("rerank_dispatches").value - d0 < 2
+    for q, scores in out.items():
+        # reference scores computed directly (same params, batcher bypassed)
+        np.testing.assert_allclose(
+            scores, r._score_pairs([(q, p) for p in passages]), atol=1e-4)
+
+
+# --------------------------------------------------------------- lookahead
+
+def test_lookahead_exact_match_reuses_without_embed():
+    calls = []
+
+    def retrieve(q, qvec=None):
+        calls.append(q)
+        return np.array([1.0, 0.0]), f"hits:{q}"
+
+    look = LookaheadRetrieval(retrieve).start("raw query")
+    qvec, payload = look.reconcile("raw query")   # no embed fn needed
+    assert payload == "hits:raw query"
+    assert calls == ["raw query"]
+
+
+def test_lookahead_similar_rewrite_reuses_hits():
+    calls = []
+
+    def retrieve(q, qvec=None):
+        calls.append(q)
+        return np.array([1.0, 0.0]), f"hits:{q}"
+
+    look = LookaheadRetrieval(retrieve, sim_threshold=0.85).start("raw")
+    # the rewrite embeds 0.9-similar to the raw query → speculative hits stand
+    qvec, payload = look.reconcile(
+        "rephrased", embed=lambda q: np.array([0.9, np.sqrt(1 - 0.81)]))
+    assert payload == "hits:raw"
+    assert calls == ["raw"]          # no second retrieval
+    np.testing.assert_allclose(qvec, [0.9, np.sqrt(1 - 0.81)])
+
+
+def test_lookahead_divergent_rewrite_requeries():
+    calls = []
+
+    def retrieve(q, qvec=None):
+        calls.append(q)
+        return np.array([1.0, 0.0]), f"hits:{q}"
+
+    look = LookaheadRetrieval(retrieve, sim_threshold=0.85).start("raw")
+    # orthogonal rewrite → the speculation is discarded and retrieval reruns
+    _, payload = look.reconcile(
+        "totally different", embed=lambda q: np.array([0.0, 1.0]))
+    assert payload == "hits:totally different"
+    assert calls == ["raw", "totally different"]
+    assert REGISTRY.counter("lookahead_requery").value >= 1
+
+
+def test_lookahead_speculation_failure_falls_back_to_requery():
+    """A failed speculative retrieval (poisoned co-batched dispatch, batcher
+    shutdown) must not fail the request — reconcile retrieves fresh."""
+    state = {"first": True}
+    calls = []
+
+    def retrieve(q, qvec=None):
+        if state["first"]:
+            state["first"] = False
+            raise RuntimeError("poisoned dispatch")
+        calls.append(q)
+        return np.array([1.0, 0.0]), f"hits:{q}"
+
+    look = LookaheadRetrieval(retrieve).start("raw")
+    _, payload = look.reconcile("raw")
+    assert payload == "hits:raw"
+    assert calls == ["raw"]
+
+
+def test_multi_turn_condense_overlaps_lookahead(tmp_path, encoders):
+    """With chat history, the multi-turn chain condenses the follow-up via
+    an LLM call OVERLAPPED with speculative retrieval on the raw query,
+    then answers with the condensed query's context."""
+    from generativeaiexamples_tpu.chains.context import ChainContext
+    from generativeaiexamples_tpu.chains.multi_turn_rag import MultiTurnRAG
+    from generativeaiexamples_tpu.core.config import get_config
+
+    class FakeLLM:
+        def __init__(self, responses):
+            self.responses = list(responses)
+            self.calls = []
+
+        def chat(self, messages, **settings):
+            self.calls.append(messages)
+            yield self.responses.pop(0)
+
+    embedder, reranker = encoders
+    llm = FakeLLM(["where do llamas live", "in the Andes"])
+    ctx = ChainContext(config=get_config(), llm=llm, embedder=embedder,
+                       reranker=reranker)
+    chain = MultiTurnRAG(context=ctx)
+    doc = tmp_path / "kb.txt"
+    doc.write_text("Llamas live in the Andes mountains of South America.")
+    chain.ingest_docs(str(doc), "kb.txt")
+
+    history = [{"role": "user", "content": "tell me about llamas"},
+               {"role": "assistant", "content": "they are camelids"}]
+    out = "".join(chain.rag_chain("where do they live?", history))
+    assert out == "in the Andes"
+    # first LLM call was the condense — it carried the turn history
+    condense_prompt = llm.calls[0][-1]["content"]
+    assert "tell me about llamas" in condense_prompt
+    assert "where do they live?" in condense_prompt
+    # no history → no condense call
+    llm.responses = ["just the answer"]
+    assert "".join(chain.rag_chain("where do llamas live?", [])) == \
+        "just the answer"
+    assert len(llm.calls) == 3
+
+
+# ----------------------------------------------------------- finish_reason
+
+@pytest.fixture(scope="module")
+def served():
+    cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    params = llama.init_params(jax.random.PRNGKey(5), cfg)
+    tok = ByteTokenizer()
+    ecfg = EngineConfig(max_batch_size=4, max_seq_len=128, page_size=8,
+                        prefill_chunk=16)
+    core = EngineCore(cfg, ecfg, params, eos_id=tok.eos_id)
+    return core, tok
+
+
+def _run_all(sched, reqs):
+    for r in reqs:
+        sched.submit(r)
+    while sched._tick():
+        pass
+    out = []
+    for r in reqs:
+        parts = []
+        while not r.out_queue.empty():
+            item = r.out_queue.get_nowait()
+            if isinstance(item, str):
+                parts.append(item)
+        out.append("".join(parts))
+    return out
+
+
+def test_finish_reason_length_vs_eos(served):
+    core, tok = served
+    sched = Scheduler(core, tok)
+    req = Request(prompt_ids=tok.encode("hello there", add_bos=True),
+                  max_tokens=6, temperature=0.0)
+    _run_all(sched, [req])
+    # greedy decode under random weights ends either by exhausting the
+    # budget (all 6 tokens → "length") or by sampling EOS early ("eos");
+    # the recorded cause must match what actually happened
+    if req.completion_tokens == 6:
+        assert req.finish_reason == "length"
+    else:
+        assert req.finish_reason == "eos"
+
+
+def test_finish_reason_stop(served):
+    core, tok = served
+    sched = Scheduler(core, tok)
+    prompt = tok.encode("tell me everything", add_bos=True)
+    base_req = Request(prompt_ids=list(prompt), max_tokens=8,
+                       temperature=0.0)
+    base = _run_all(sched, [base_req])[0]
+    assert len(base) > 2
+    stop_req = Request(prompt_ids=list(prompt), max_tokens=8,
+                       temperature=0.0, stop=[base[1]])
+    _run_all(sched, [stop_req])
+    assert stop_req.finish_reason == "stop"
+
+
+def test_server_maps_finish_reason_to_openai_contract():
+    from generativeaiexamples_tpu.engine.server import _finish_reason
+
+    class R:
+        error = None
+        finish_reason = None
+
+    r = R()
+    assert _finish_reason(r) == "stop"            # stub/legacy: default
+    r.finish_reason = "eos"
+    assert _finish_reason(r) == "stop"            # natural end → "stop"
+    r.finish_reason = "stop"
+    assert _finish_reason(r) == "stop"            # stop string → "stop"
+    r.finish_reason = "length"
+    assert _finish_reason(r) == "length"          # truncation is distinct
+    assert _finish_reason(r, "tool_calls") == "tool_calls"
+    r.error = "boom"
+    assert _finish_reason(r) == "error"           # failures never masquerade
+
+
+def test_server_reports_length_end_to_end(served):
+    """Non-streamed /v1/chat/completions with a tiny budget reports
+    finish_reason="length" when the budget was actually exhausted."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from generativeaiexamples_tpu.engine.server import ModelServer
+
+    core, tok = served
+    sched = Scheduler(core, tok)
+    sched.start()
+    server = ModelServer(sched, "tiny-llama")
+
+    async def run():
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            resp = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4, "temperature": 0.0,
+            })
+            assert resp.status == 200
+            return await resp.json()
+        finally:
+            await client.close()
+
+    try:
+        data = asyncio.new_event_loop().run_until_complete(run())
+        choice = data["choices"][0]
+        if data["usage"]["completion_tokens"] == 4:
+            assert choice["finish_reason"] == "length"
+        else:
+            assert choice["finish_reason"] == "stop"
+    finally:
+        sched.stop()
