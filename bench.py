@@ -45,6 +45,7 @@ import jax
 
 from generativeaiexamples_tpu.core.config import EngineConfig
 from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.observability.flight import FLIGHT
 from generativeaiexamples_tpu.engine.engine import EngineCore
 from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
 from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
@@ -446,8 +447,16 @@ def main() -> None:
     spec0 = REGISTRY.counter("spec_bonus_tokens").value
     base0 = REGISTRY.counter("spec_base_steps").value
     pfx0 = REGISTRY.counter("prefix_hit_tokens").value
+    # the flight recorder (observability/flight.py) samples scheduler state
+    # continuously; tighten its interval so even a short CPU phase yields a
+    # real distribution, and window its ring to this phase by wall clock —
+    # occupancy/queue-depth percentiles below are MEASURED per-step state,
+    # not the single uptime-average the bench used to hand-derive
+    FLIGHT.interval_s = min(FLIGHT.interval_s, 0.02)
+    thr_t0 = time.time()
     thr_reqs = [make_req(n) for n in thr_prompts]
     wall = _run_load(sched, thr_reqs)
+    thr_flight = [s for s in FLIGHT.window() if s["ts"] >= thr_t0]
     # snapshot BEFORE the RAG phase: its decode traffic must not leak into
     # the throughput phase's occupancy/HBM arithmetic
     decode_steps = REGISTRY.counter("decode_steps").value - steps0
@@ -486,6 +495,27 @@ def main() -> None:
                  if decode_steps else 0.0)
     tok_s = gen_tokens / wall
 
+    def _flight_pct(key: str, q: float) -> float:
+        vals = sorted(float(s[key]) for s in thr_flight if key in s)
+        if not vals:
+            return 0.0
+        return vals[min(len(vals) - 1, int(q / 100.0 * len(vals)))]
+
+    flight_stats = {
+        "flight_samples": len(thr_flight),
+        # honesty: a phase longer than capacity x interval evicts its early
+        # samples from the ring — flag it rather than pass a tail off as
+        # the whole phase
+        "flight_window_truncated": bool(
+            thr_flight
+            and thr_flight[0]["ts"] > thr_t0 + 2 * FLIGHT.interval_s),
+        "flight_occupancy_p50": round(_flight_pct("fill", 50), 3),
+        "flight_occupancy_p90": round(_flight_pct("fill", 90), 3),
+        "flight_queue_depth_p50": round(_flight_pct("waiting", 50), 1),
+        "flight_queue_depth_p90": round(_flight_pct("waiting", 90), 1),
+        "flight_kv_pages_used_p90": round(_flight_pct("kv_pages_used", 90), 1),
+    }
+
     # honesty: achieved FLOPs and HBM traffic vs physical peak
     flops = 2.0 * n_params * (prompt_tokens + gen_tokens)
     achieved_flops = flops / wall
@@ -521,6 +551,10 @@ def main() -> None:
         **rag_enc,
         "decode_steps": int(decode_steps),
         "batch_occupancy": round(occupancy, 3),
+        # per-step distributions from the flight recorder ring (windowed to
+        # the throughput phase) — batch_occupancy above is the phase MEAN,
+        # these show how the fill/queue actually moved through the phase
+        **flight_stats,
         # speculation transparency: fraction of throughput-phase tokens
         # that were accepted drafts, and mean tokens per participating
         # step-slot (1.0 = no speculation wins)

@@ -18,9 +18,10 @@ from typing import Optional
 
 from aiohttp import web
 
-from generativeaiexamples_tpu.core.metrics import REGISTRY
 from generativeaiexamples_tpu.encoders.embedder import Embedder
 from generativeaiexamples_tpu.encoders.reranker import Reranker
+from generativeaiexamples_tpu.server.common import (
+    add_debug_routes, health_handler, metrics_handler)
 
 
 class EncoderServer:
@@ -40,25 +41,22 @@ class EncoderServer:
                                         thread_name_prefix="encoder-http")
         self.app = web.Application()
         self.app.on_cleanup.append(self._shutdown)
-        routes = [web.get("/health", self.health),
-                  web.get("/metrics", self.metrics)]
+        # shared handlers (server/common.py): /metrics content-negotiates
+        # JSON vs Prometheus text exposition, same as the other servers
+        routes = [web.get("/health", health_handler),
+                  web.get("/metrics", metrics_handler)]
         if embedder is not None:
             routes.append(web.post("/v1/embeddings", self.embeddings))
         if reranker is not None:
             routes.append(web.post("/v1/ranking", self.ranking))
         self.app.add_routes(routes)
+        add_debug_routes(self.app)
 
     async def _shutdown(self, app: web.Application) -> None:
         self._pool.shutdown(wait=False)
         for enc in (self.embedder, self.reranker):
             if enc is not None and hasattr(enc, "close"):
                 enc.close()
-
-    async def health(self, request: web.Request) -> web.Response:
-        return web.json_response({"message": "Service is up."})
-
-    async def metrics(self, request: web.Request) -> web.Response:
-        return web.json_response(REGISTRY.snapshot())
 
     async def embeddings(self, request: web.Request) -> web.Response:
         body = await request.json()
@@ -100,5 +98,8 @@ class EncoderServer:
 def run_server(embedder: Optional[Embedder] = None,
                reranker: Optional[Reranker] = None,
                host: str = "0.0.0.0", port: int = 9080) -> None:
+    from generativeaiexamples_tpu.observability.bootstrap import (
+        init_observability)
+    init_observability("encoder")
     server = EncoderServer(embedder, reranker)
     web.run_app(server.app, host=host, port=port, print=None)

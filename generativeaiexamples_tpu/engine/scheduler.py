@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.observability.flight import FLIGHT, REQUEST_LOG
 from generativeaiexamples_tpu.engine.engine import (
     DecodeState, EngineCore, bits_to_f32, unpack_decode_out)
 from generativeaiexamples_tpu.engine.prefix_cache import chain_hashes
@@ -122,8 +123,19 @@ class Request:
     request_id: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
     # filled by the scheduler:
     out_queue: "queue.Queue" = field(default_factory=queue.Queue)
+    # per-request timeline (observability/flight.py renders it): every stamp
+    # shares the perf_counter clock, so queued <= admitted <= prefill_start
+    # <= first_token <= finished holds exactly. Stamps record the FIRST
+    # occurrence — a preemption resume re-admits and re-prefills, but the
+    # client-visible phases happened once; the resume shows up in
+    # `preemptions` instead.
     submitted_at: float = field(default_factory=time.perf_counter)
+    admitted_at: Optional[float] = None
+    prefill_start_at: Optional[float] = None
     first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    preemptions: int = 0
+    prefix_hit_tokens: int = 0
     completion_tokens: int = 0
     error: Optional[str] = None
     # why generation ended — "eos" (model emitted EOS), "stop" (a stop
@@ -310,8 +322,18 @@ class Scheduler:
         jobs += list(self._prefilling) + list(self._slots.values())
         self._prefilling.clear()
         self._slots.clear()
+        now = time.perf_counter()
         for job in jobs:
             job.request.error = reason
+            if job.request.finished_at is None:
+                job.request.finished_at = now
+            # the crash path counts in the finish-cause family too — a
+            # dashboard summing requests_finished{finish=...} over an
+            # incident must agree with the /debug/requests log
+            REGISTRY.counter("requests_failed").inc()
+            REGISTRY.counter("requests_finished",
+                             labels={"finish": "error"}).inc()
+            REQUEST_LOG.record(job.request)
             job.request.out_queue.put(_STOP)
             job.pages = []
             job.slot = -1
@@ -357,20 +379,35 @@ class Scheduler:
         elif tail:
             job.request.out_queue.put(tail)
         job.stop_buf = ""
-        job.request.out_queue.put(_STOP)
+        # stamp + log BEFORE releasing the stream: a client that reads
+        # X-Request-Id off the finished response and immediately GETs
+        # /debug/requests/<id> (or the server's span-attribute read after
+        # the drain ends) must find the completed timeline — _STOP is the
+        # happens-before edge consumers synchronize on
+        req = job.request
+        req.finished_at = time.perf_counter()
+        REGISTRY.counter("requests_completed").inc()
+        # labeled family: finish-cause breakdown without a counter per name
+        REGISTRY.counter("requests_finished",
+                         labels={"finish": req.finish_reason or "unknown"}
+                         ).inc()
+        REGISTRY.histogram("request_latency_s").observe(
+            req.finished_at - req.submitted_at)
+        REQUEST_LOG.record(req)
+        req.out_queue.put(_STOP)
         # decode-written pages join the prefix cache before release: a
         # follow-up turn whose templated prompt embeds this conversation
         # verbatim re-admits against them
         self._cache_insert(job, with_generated=True)
         self._release(job)
-        REGISTRY.counter("requests_completed").inc()
-        REGISTRY.histogram("request_latency_s").observe(
-            time.perf_counter() - job.request.submitted_at)
 
     def _fail(self, job: _Job, reason: str) -> None:
         job.request.error = reason
-        job.request.out_queue.put(_STOP)
+        job.request.finished_at = time.perf_counter()
         REGISTRY.counter("requests_failed").inc()
+        REGISTRY.counter("requests_finished", labels={"finish": "error"}).inc()
+        REQUEST_LOG.record(job.request)
+        job.request.out_queue.put(_STOP)
 
     def _table_device(self) -> jax.Array:
         if self._table_dev is None:
@@ -589,8 +626,11 @@ class Scheduler:
             job.prefilled = shared
             job.total_len = shared
             job.shared = shared
+            if job.request.admitted_at is None:
+                job.request.admitted_at = time.perf_counter()
             if self._caching:
                 if shared:
+                    job.request.prefix_hit_tokens += shared
                     REGISTRY.counter("prefix_hit_tokens").inc(shared)
                     if self._spec_w > 1 and hasattr(self.core,
                                                     "seed_history"):
@@ -645,6 +685,8 @@ class Scheduler:
                 and self.core.cfg.long_prefill != "off"
                 and self.core.supports_long_prefill):
             job.prefill_started = time.perf_counter()
+            if req.prefill_start_at is None:
+                req.prefill_start_at = job.prefill_started
             self._prefilling.popleft()
             REGISTRY.counter("prefill_long_passes").inc()
             self._state, tok = self.core.prefill_long_last(
@@ -674,6 +716,8 @@ class Scheduler:
             start = job.prefilled
             if start == job.shared:
                 job.prefill_started = time.perf_counter()
+                if req.prefill_start_at is None:
+                    req.prefill_start_at = job.prefill_started
             while len(items) < budget and start < len(job.ids):
                 chunk_ids = job.ids[start:start + self.core.chunk]
                 last = start + len(chunk_ids) >= len(job.ids)
@@ -918,6 +962,7 @@ class Scheduler:
         job.first_inflight = False
         with self._lock:
             self._pending.appendleft(job)
+        job.request.preemptions += 1
         REGISTRY.counter("preemptions").inc()
         logger.info("preempted request %s at %d generated tokens",
                     job.request.request_id, len(job.gen_ids))
@@ -1050,8 +1095,34 @@ class Scheduler:
 
     # -- driver loop --------------------------------------------------------
 
+    def _flight_fields(self) -> Dict[str, object]:
+        """One flight-recorder sample of scheduler state. Called only when a
+        sample is due (FLIGHT.maybe_sample time-gates), so the lock grab and
+        counter reads are off the per-tick fast path."""
+        with self._lock:
+            waiting = len(self._pending)
+        free = int(self._alloc.available)
+        total = int(self.core.num_pages)
+        return {
+            "fill": round(len(self._slots) / self.core.batch, 4),
+            "running": len(self._slots),
+            "prefilling": len(self._prefilling),
+            "waiting": waiting,
+            "inflight_dispatches": len(self._inflight),
+            "kv_pages_free": free,
+            "kv_pages_used": total - free,
+            "prefix_hit_tokens": REGISTRY.counter("prefix_hit_tokens").value,
+            "preemptions": REGISTRY.counter("preemptions").value,
+            "tokens_generated": REGISTRY.counter("tokens_generated").value,
+        }
+
     def _tick(self) -> bool:
         """One scheduling round; returns False when fully idle."""
+        # continuous per-step telemetry: the ring the /debug/flight window,
+        # SIGUSR1 dump, and bench.py occupancy stats all read. Idle ticks
+        # sample too (the 50 ms wake loop keeps calling _tick), so a
+        # post-incident window shows the queue draining to zero, not a gap.
+        FLIGHT.maybe_sample(self._flight_fields)
         worked = False
         # eager drain: any dispatch whose result already landed on the host
         # resolves NOW — first tokens stamp and done slots free without

@@ -39,9 +39,11 @@ from generativeaiexamples_tpu.engine import grammar as grammar_mod
 from generativeaiexamples_tpu.engine import tools as tools_mod
 from generativeaiexamples_tpu.engine.engine import TOP_LP
 from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
+from generativeaiexamples_tpu.observability import flight as flight_mod
+from generativeaiexamples_tpu.observability import otel
 from generativeaiexamples_tpu.server.common import (
-    MAX_TOKENS_CAP, StreamDrain, health_handler, metrics_handler, parse_stop,
-    sse_done, sse_write,
+    MAX_TOKENS_CAP, StreamDrain, add_debug_routes, health_handler,
+    metrics_handler, parse_stop, sse_done, sse_write,
 )
 
 
@@ -109,6 +111,9 @@ class ModelServer:
             web.post("/v1/chat/completions", self.chat_completions),
             web.post("/v1/completions", self.completions),
         ])
+        # /debug/flight + /debug/requests[/<id>] — the engine process is
+        # where the scheduler lives, so these answer with live data here
+        add_debug_routes(self.app)
 
     # ------------------------------------------------------------- endpoints
 
@@ -263,6 +268,34 @@ class ModelServer:
                    json_mode: bool = False,
                    grammar: Optional[object] = None,
                    grammar_prefix: str = "") -> web.StreamResponse:
+        """Span envelope around ``_serve``: by the time the response (stream
+        included) is written, the scheduler has stamped the request's full
+        timeline, so the span carries queue-wait/TTFT/preemption attributes
+        — per-request spans and ``/debug/requests/<id>`` agree by
+        construction. ``_serve`` stashes its primary Request on the aiohttp
+        request so this wrapper (and ``_sse_response``) can reach it."""
+        parent = otel.extract_traceparent(dict(request.headers))
+        with otel.use_parent(parent):
+            with otel.get_tracer("engine").span(
+                    "engine:completion",
+                    attributes={"http.path": str(request.path)}) as span:
+                try:
+                    return await self._serve(request, body, prompt_ids, chat,
+                                             tools, json_mode, grammar,
+                                             grammar_prefix)
+                finally:
+                    req = request.get("engine_request")
+                    if req is not None and otel.tracing_enabled():
+                        for key, value in flight_mod.timeline_attributes(
+                                req).items():
+                            span.set_attribute(key, value)
+
+    async def _serve(self, request: web.Request, body: Dict[str, Any],
+                     prompt_ids, chat: bool,
+                     tools: Optional[List[Dict[str, Any]]] = None,
+                     json_mode: bool = False,
+                     grammar: Optional[object] = None,
+                     grammar_prefix: str = "") -> web.StreamResponse:
         sampling = self._parse_sampling(body)
         n = max(1, min(int(body.get("n") or 1), 4))
         if n > 1 and (tools or json_mode):
@@ -285,6 +318,9 @@ class ModelServer:
 
         reqs = [make_req(i) for i in range(n)]
         req = reqs[0]
+        # the scheduler id is the /debug/requests/<id> lookup key; expose it
+        # on every response as X-Request-Id (span envelope reads it too)
+        request["engine_request"] = req
         rid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
         stream = bool(body.get("stream", False))
         for r in reqs:
@@ -361,7 +397,8 @@ class ModelServer:
             errs = [r.error for r in reqs if r.error]
             if errs:
                 payload["error"] = "; ".join(errs)
-            return web.json_response(payload)
+            return web.json_response(
+                payload, headers={"X-Request-Id": req.request_id})
 
         resp = await self._sse_response(request)
         if chat:
@@ -510,11 +547,15 @@ class ModelServer:
 
     @staticmethod
     async def _sse_response(request: web.Request) -> web.StreamResponse:
-        resp = web.StreamResponse(headers={
+        headers = {
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
             "Connection": "keep-alive",
-        })
+        }
+        req = request.get("engine_request")
+        if req is not None:
+            headers["X-Request-Id"] = req.request_id
+        resp = web.StreamResponse(headers=headers)
         await resp.prepare(request)
         return resp
 
@@ -551,6 +592,9 @@ class ModelServer:
 
 def run_server(scheduler: Scheduler, model_name: str, host: str = "0.0.0.0",
                port: int = 8000) -> None:
+    from generativeaiexamples_tpu.observability.bootstrap import (
+        init_observability)
+    init_observability("engine")
     server = ModelServer(scheduler, model_name)
     scheduler.start()
     web.run_app(server.app, host=host, port=port, print=None)

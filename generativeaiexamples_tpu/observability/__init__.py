@@ -6,6 +6,18 @@ self-contained span model: same trace/span semantics and W3C TraceContext
 propagation, exporters pluggable (console, in-memory for tests, JSONL file).
 """
 
+from generativeaiexamples_tpu.observability.bootstrap import (  # noqa: F401
+    init_observability,
+)
+from generativeaiexamples_tpu.observability.flight import (  # noqa: F401
+    FLIGHT,
+    REQUEST_LOG,
+    FlightRecorder,
+    RequestLog,
+    install_signal_dump,
+    timeline,
+    timeline_attributes,
+)
 from generativeaiexamples_tpu.observability.otel import (  # noqa: F401
     ConsoleSpanExporter,
     InMemorySpanExporter,

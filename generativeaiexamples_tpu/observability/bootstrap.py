@@ -1,0 +1,54 @@
+"""One-call observability activation for server startup.
+
+The pieces all exist individually — env-selected span exporters
+(otel.configure_from_env), the jax profiler server (profiling.start_server),
+the SIGUSR1 flight dump (flight.install_signal_dump) — but nothing in the
+serving path activated them: a process started with
+``APP_TRACING_EXPORTER=otlp APP_PROFILER_PORT=9012`` exported nothing and
+listened nowhere. Every server entrypoint (engine, encoder, chain) calls
+``init_observability()`` before binding its port.
+
+Env surface (all opt-in; absent vars are no-ops):
+
+  * ``APP_TRACING_EXPORTER`` (+ ``APP_TRACING_OTLP_ENDPOINT`` /
+    ``APP_TRACING_JSONL_PATH`` / ``APP_TRACING_SERVICE``) — span exporter;
+  * ``ENABLE_TRACING`` — actually emit spans (exporter alone is inert);
+  * ``APP_PROFILER_PORT`` — jax profiler server for live TensorBoard/xprof
+    capture (0/empty = off);
+  * ``APP_FLIGHT_DUMP_PATH`` — SIGUSR1 flight-dump target.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+_done = False
+
+
+def init_observability(service: str = "") -> None:
+    """Idempotent; safe from any server's startup path."""
+    global _done
+    if _done:
+        return
+    _done = True
+    from generativeaiexamples_tpu.observability import flight, otel, profiling
+
+    if service and not os.environ.get("APP_TRACING_SERVICE"):
+        os.environ["APP_TRACING_SERVICE"] = f"generativeaiexamples-tpu-{service}"
+    exporter = otel.configure_from_env()
+    if exporter is not None:
+        logger.info("tracing exporter: %s", type(exporter).__name__)
+    raw_port = os.environ.get("APP_PROFILER_PORT", "").strip()
+    if raw_port:
+        try:
+            port = int(raw_port)
+        except ValueError:
+            logger.warning("APP_PROFILER_PORT=%r is not an int; profiler "
+                           "server not started", raw_port)
+            port = 0
+        if port > 0:
+            profiling.start_server(port)
+    flight.install_signal_dump()

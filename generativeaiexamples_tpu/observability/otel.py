@@ -178,8 +178,15 @@ class OTLPHTTPSpanExporter(SpanExporter):
         self._q.put(span)
 
     def shutdown(self) -> None:
+        """Deterministic drain: every span export()ed before this call is
+        flushed before the thread exits. The sentinel wakes a blocked
+        ``_q.get`` immediately (no up-to-``flush_interval_s`` timeout wait),
+        and the loop's stop path drains the queue completely before its
+        final post — the old exit condition could observe ``_stop`` with a
+        non-empty final batch mid-race and leave it unsent."""
         self._stop.set()
-        self._thread.join(timeout=2 * self._interval + 5)
+        self._q.put(None)    # wake the getter now
+        self._thread.join(timeout=2 * self._interval + 10)
 
     # -- wire encoding -----------------------------------------------------
 
@@ -246,19 +253,31 @@ class OTLPHTTPSpanExporter(SpanExporter):
         while True:
             timeout = max(0.05, deadline - time.monotonic())
             try:
-                batch.append(self._q.get(timeout=timeout))
+                item = self._q.get(timeout=timeout)
+                if item is not None:     # None = shutdown wake sentinel
+                    batch.append(item)
             except _queue.Empty:
                 pass
-            flush_now = (len(batch) >= self._batch_size
-                         or time.monotonic() >= deadline
-                         or self._stop.is_set())
-            if flush_now and batch:
+            if self._stop.is_set():
+                # deterministic final drain: collect EVERYTHING already
+                # queued, post once, exit — never returns with spans that
+                # were export()ed before shutdown() still unsent
+                while True:
+                    try:
+                        item = self._q.get_nowait()
+                    except _queue.Empty:
+                        break
+                    if item is not None:
+                        batch.append(item)
+                if batch:
+                    self._post(batch)
+                return
+            if (len(batch) >= self._batch_size
+                    or time.monotonic() >= deadline) and batch:
                 self._post(batch)
                 batch = []
             if time.monotonic() >= deadline:
                 deadline = time.monotonic() + self._interval
-            if self._stop.is_set() and self._q.empty() and not batch:
-                return
 
     def _post(self, batch: List[Span]) -> None:
         import urllib.error

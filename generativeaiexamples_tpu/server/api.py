@@ -37,7 +37,8 @@ from generativeaiexamples_tpu.core.tracing import instrumentation_wrapper
 from generativeaiexamples_tpu.server.base import BaseExample
 from generativeaiexamples_tpu.server import guardrails as guardrails_mod
 from generativeaiexamples_tpu.server.common import (
-    MAX_TOKENS_CAP, StreamDrain, health_handler, metrics_handler, parse_stop,
+    MAX_TOKENS_CAP, StreamDrain, add_debug_routes, health_handler,
+    metrics_handler, parse_stop,
 )
 
 logger = logging.getLogger(__name__)
@@ -97,6 +98,10 @@ class ChainServer:
             web.post("/documents", self.upload_document),
             web.delete("/documents", self.delete_document),
         ])
+        # flight recorder + request timelines: the chain server usually
+        # hosts the in-process engine scheduler, so its /debug surface
+        # carries live engine data too
+        add_debug_routes(self.app)
 
     # ------------------------------------------------------------ generate
 
@@ -293,5 +298,8 @@ class ChainServer:
 
 def run_server(example: BaseExample, host: str = "0.0.0.0",
                port: int = 8081) -> None:
+    from generativeaiexamples_tpu.observability.bootstrap import (
+        init_observability)
+    init_observability("chain")
     server = ChainServer(example)
     web.run_app(server.app, host=host, port=port, print=None)

@@ -1,9 +1,16 @@
-"""Shared HTTP plumbing for the model server and the chain server.
+"""Shared HTTP plumbing for the model, encoder, and chain servers.
 
-One copy of the generation cap, the health/metrics handlers (compose
+One copy of the generation cap, the health/metrics/debug handlers (compose
 healthcheck parity, ref docker-compose-nim-ms.yaml:23-28 / server.py:249),
-and the SSE framing + per-request drain thread, so the two servers cannot
+and the SSE framing + per-request drain thread, so the servers cannot
 drift apart.
+
+``/metrics`` content-negotiates: the default stays the JSON snapshot
+(existing dashboards/tests), while ``Accept: text/plain`` (what a stock
+Prometheus scraper sends) or ``?format=prometheus`` serves text exposition
+format 0.0.4 — the stack is scrapeable without a sidecar exporter.
+``/debug/flight`` and ``/debug/requests[/<id>]`` expose the engine flight
+recorder and recent per-request timelines (observability/flight.py).
 """
 
 from __future__ import annotations
@@ -16,8 +23,11 @@ from typing import AsyncIterator, Optional
 from aiohttp import web
 
 from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.observability.flight import FLIGHT, REQUEST_LOG
 
 MAX_TOKENS_CAP = 1024  # ref: RAG/src/chain_server/server.py:104-110
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 def parse_stop(value) -> list:
@@ -33,8 +43,70 @@ async def health_handler(request: web.Request) -> web.Response:
     return web.json_response({"message": "Service is up."})
 
 
+def _wants_prometheus(request: web.Request) -> bool:
+    if request.query.get("format", "").lower() in ("prometheus", "text"):
+        return True
+    accept = request.headers.get("Accept", "")
+    # A Prometheus scraper asks for openmetrics/text-plain and never for
+    # JSON; generic HTTP clients (axios et al.) default to an Accept that
+    # LISTS text/plain as a fallback after application/json — those must
+    # keep getting the documented-default JSON snapshot, so text/plain only
+    # wins when JSON wasn't requested at all.
+    return ("openmetrics" in accept
+            or ("text/plain" in accept and "application/json" not in accept))
+
+
 async def metrics_handler(request: web.Request) -> web.Response:
+    if _wants_prometheus(request):
+        return web.Response(body=REGISTRY.render_prometheus().encode("utf-8"),
+                            headers={"Content-Type": PROMETHEUS_CONTENT_TYPE})
     return web.json_response(REGISTRY.snapshot())
+
+
+async def flight_handler(request: web.Request) -> web.Response:
+    """Windowed flight-recorder time series: ``?window=<seconds>`` bounds
+    the lookback (default: the whole ring)."""
+    raw = request.query.get("window", "")
+    seconds: Optional[float] = None
+    if raw:
+        try:
+            seconds = float(raw)
+        except ValueError:
+            raise web.HTTPBadRequest(text=json.dumps(
+                {"error": f"window must be a number of seconds, got {raw!r}"}))
+    return web.json_response({**FLIGHT.describe(),
+                              "window_s": seconds,
+                              "samples": FLIGHT.window(seconds)})
+
+
+async def requests_recent_handler(request: web.Request) -> web.Response:
+    try:
+        n = int(request.query.get("n", "50"))
+    except ValueError:
+        raise web.HTTPBadRequest(text=json.dumps(
+            {"error": "n must be an integer"}))
+    return web.json_response({"requests": REQUEST_LOG.recent(n)})
+
+
+async def request_timeline_handler(request: web.Request) -> web.Response:
+    rid = request.match_info.get("rid", "")
+    rec = REQUEST_LOG.get(rid)
+    if rec is None:
+        raise web.HTTPNotFound(text=json.dumps(
+            {"error": f"no recent request {rid!r} (log keeps the last "
+                      f"{REQUEST_LOG.capacity})"}))
+    return web.json_response(rec)
+
+
+def add_debug_routes(app: web.Application) -> None:
+    """Register the observability debug surface (engine, encoder, and chain
+    servers all carry it — the flight recorder and request log are process-
+    global, so whichever process hosts the scheduler answers with data)."""
+    app.add_routes([
+        web.get("/debug/flight", flight_handler),
+        web.get("/debug/requests", requests_recent_handler),
+        web.get("/debug/requests/{rid}", request_timeline_handler),
+    ])
 
 
 async def sse_write(resp: web.StreamResponse, payload: str) -> None:

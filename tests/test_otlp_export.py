@@ -100,6 +100,31 @@ def test_otlp_export_shape_and_anonymization(collector):
         in res["attributes"]
 
 
+def test_otlp_shutdown_drains_final_batch(collector):
+    """Every span export()ed before shutdown() must reach the collector —
+    the old _loop exit could race a non-empty final batch (and a blocked
+    q.get made shutdown wait out the flush interval); shutdown now wakes
+    the flusher and drains deterministically."""
+    endpoint, received = collector
+    # long flush interval + big batch: nothing would flush on its own
+    # within the test, so everything that arrives rode the shutdown drain
+    exp = otel.OTLPHTTPSpanExporter(endpoint=endpoint, flush_interval_s=30.0,
+                                    batch_size=1000)
+    n = 25
+    for i in range(n):
+        exp.export(otel.Span(name=f"drain{i}", trace_id="a" * 32,
+                             span_id=f"{i:016x}"))
+    t0 = time.monotonic()
+    exp.shutdown()
+    assert time.monotonic() - t0 < 10.0       # no interval-long stall
+    names = {s["name"]
+             for _, body in received
+             for rs in body["resourceSpans"]
+             for ss in rs["scopeSpans"]
+             for s in ss["spans"]}
+    assert names == {f"drain{i}" for i in range(n)}
+
+
 def test_otlp_export_survives_dead_collector():
     exp = otel.OTLPHTTPSpanExporter(endpoint="http://127.0.0.1:1",
                                     flush_interval_s=0.1)
