@@ -5,6 +5,13 @@ TEST_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 test:
 	$(TEST_ENV) python -m pytest tests/ -x -q
 
+# tpulint: in-tree static analysis for TPU-serving hazards
+# (docs/static_analysis.md). Non-zero exit on any unsuppressed,
+# non-baselined finding; also enforced inside tier-1 by tests/test_tpulint.py.
+.PHONY: lint
+lint:
+	$(TEST_ENV) python -m generativeaiexamples_tpu.analysis generativeaiexamples_tpu/
+
 # Build the native (C++) components: byte-level BPE tokenizer core.
 # Delegates to the one build recipe in native_tokenizer.py (also used by
 # the on-demand auto-build) so the two can't drift.

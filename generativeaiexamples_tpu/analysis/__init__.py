@@ -1,0 +1,32 @@
+"""tpulint — in-tree static analysis for TPU-serving hazards.
+
+The stack is a multi-threaded, multi-server TPU dataplane (scheduler
+ticks, encoder micro-batching, flight recorder), and the hazard classes
+that kill such systems — silent host↔device syncs on the decode path,
+jit recompile churn, blocking calls under locks, wall-clock interval
+arithmetic, untimed network I/O, silently-swallowed exceptions — are
+exactly the ones reviewers keep catching by hand.  tpulint encodes those
+review rules as an AST pass over the package and gates every PR: a
+tier-1 test (tests/test_tpulint.py) runs the analyzer over the whole
+tree and fails on any unsuppressed, non-baselined finding.
+
+Entry points:
+
+  * ``python -m generativeaiexamples_tpu.analysis <paths>`` — the CLI
+    (human or ``--json`` output, non-zero exit on findings; ``make lint``).
+  * :func:`run_paths` — the library API the self-check test uses.
+
+See ``docs/static_analysis.md`` for the rule catalog, suppression
+(``# tpulint: disable=<rule>``) and baseline workflow, and how to add a
+rule.
+"""
+
+from generativeaiexamples_tpu.analysis.findings import Finding
+from generativeaiexamples_tpu.analysis.registry import RULES, Rule, rule
+from generativeaiexamples_tpu.analysis.engine import Report, analyze_file, run_paths
+
+# importing rules populates the registry
+from generativeaiexamples_tpu.analysis import rules as _rules  # noqa: F401
+
+__all__ = ["Finding", "RULES", "Rule", "rule", "Report", "analyze_file",
+           "run_paths"]

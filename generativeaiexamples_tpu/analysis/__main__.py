@@ -1,0 +1,6 @@
+"""``python -m generativeaiexamples_tpu.analysis`` → the tpulint CLI."""
+
+from generativeaiexamples_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,107 @@
+"""Shared AST plumbing for tpulint rules.
+
+One parse per file: :class:`ModuleContext` wraps the tree with parent
+links (stdlib ``ast`` has none), the raw source lines (for comment-based
+markers like ``# tpulint: hot-path``), and the dotted-name/ancestry
+helpers every rule needs.  Rules stay small because the traversal
+mechanics live here.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional
+
+_PARENT = "_tpulint_parent"
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None (calls, subscripts
+    and other computed bases break the chain on purpose — a rule matching
+    ``jax.jit`` should not match ``get_jax().jit``)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.AST) -> Optional[str]:
+    """Dotted name of a Call's callee, else None."""
+    if isinstance(node, ast.Call):
+        return dotted_name(node.func)
+    return None
+
+
+class ModuleContext:
+    """A parsed module plus the lookups rules share."""
+
+    def __init__(self, path: str, source: str, tree: Optional[ast.Module] = None
+                 ) -> None:
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree if tree is not None else ast.parse(source, filename=path)
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                setattr(child, _PARENT, parent)
+
+    # ------------------------------------------------------------- traversal
+
+    def walk(self) -> Iterator[ast.AST]:
+        return ast.walk(self.tree)
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return getattr(node, _PARENT, None)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        """Innermost first, up to (and including) the Module."""
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def in_loop(self, node: ast.AST) -> bool:
+        """True when ``node`` executes repeatedly: under a for/while or
+        inside a comprehension in the same function scope (a nested def
+        re-binds per call, not per iteration — crossing one stops the
+        search)."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.For, ast.AsyncFor, ast.While,
+                                ast.ListComp, ast.SetComp, ast.DictComp,
+                                ast.GeneratorExp)):
+                return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return False
+        return False
+
+    # -------------------------------------------------------------- comments
+
+    def line_text(self, lineno: int) -> str:
+        """1-based; empty string past EOF."""
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def has_marker(self, node: ast.AST, marker: str) -> bool:
+        """True when ``# tpulint: <marker>`` rides the ``def`` line itself
+        or the line above the whole declaration (above the first decorator,
+        when there are any — a marker must keep working when a decorator is
+        later added to the function)."""
+        def_line = getattr(node, "lineno", 0)
+        first = def_line
+        for deco in getattr(node, "decorator_list", []) or []:
+            first = min(first, getattr(deco, "lineno", first))
+        needle = f"tpulint: {marker}"
+        return (needle in self.line_text(def_line)
+                or needle in self.line_text(first - 1))

@@ -1,0 +1,87 @@
+"""Checked-in baseline of grandfathered findings.
+
+A new rule lands with the violations it finds either fixed or recorded
+here — the tree stays at zero *new* findings from day one, and the
+baseline burns down over time instead of blocking the rule.  Keys are
+``(rule, file, message)`` with a count, deliberately line-free: an
+unrelated edit that shifts a grandfathered finding by ten lines must not
+churn this file (messages carry the symbol names, so they move with the
+code).
+
+The shipped baseline (``tpulint_baseline.json``) is EMPTY — every
+violation the initial rules surfaced was fixed or inline-annotated in
+the PR that introduced them.  The machinery stays because the next rule
+will not be so lucky.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from typing import Dict, List, Optional, Tuple
+
+from generativeaiexamples_tpu.analysis.findings import BaselineKey, Finding
+
+VERSION = 1
+
+DEFAULT_BASELINE_PATH = os.path.join(os.path.dirname(__file__),
+                                     "tpulint_baseline.json")
+
+
+def load(path: str) -> Dict[BaselineKey, int]:
+    """key → grandfathered count. A missing file is an empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or data.get("version") != VERSION:
+        raise ValueError(f"unsupported baseline format in {path}")
+    out: Dict[BaselineKey, int] = {}
+    for entry in data.get("findings", []):
+        # hand-edits and merge-conflict resolutions happen to this file —
+        # a malformed entry must surface as a usage error, not a traceback
+        if not isinstance(entry, dict) or not all(
+                isinstance(entry.get(k), str)
+                for k in ("rule", "file", "message")):
+            raise ValueError(
+                f"malformed baseline entry in {path}: {entry!r} "
+                "(need string rule/file/message)")
+        key = (entry["rule"], entry["file"], entry["message"])
+        out[key] = out.get(key, 0) + int(entry.get("count", 1))
+    return out
+
+
+def save(path: str, findings: List[Finding],
+         keep: Optional[Dict[BaselineKey, int]] = None) -> None:
+    """Write ``findings`` (plus ``keep`` — pre-existing entries the caller
+    wants preserved, e.g. those for files outside a partial-path run) as
+    the new baseline."""
+    counts = Counter(f.baseline_key() for f in findings)
+    for key, count in (keep or {}).items():
+        counts[key] += count
+    entries = [{"rule": rule, "file": file, "message": message,
+                "count": count}
+               for (rule, file, message), count in sorted(counts.items())]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": VERSION, "findings": entries}, fh, indent=2,
+                  sort_keys=True)
+        fh.write("\n")
+
+
+def apply(findings: List[Finding], baseline: Dict[BaselineKey, int]
+          ) -> Tuple[List[Finding], int]:
+    """Subtract grandfathered findings: up to ``count`` findings per key
+    are absorbed (oldest-in-file first); the rest stay live.  Returns
+    (remaining, absorbed_count)."""
+    budget = dict(baseline)
+    remaining: List[Finding] = []
+    absorbed = 0
+    for f in sorted(findings):
+        key = f.baseline_key()
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            absorbed += 1
+        else:
+            remaining.append(f)
+    return remaining, absorbed

@@ -1,0 +1,145 @@
+"""tpulint CLI.
+
+    python -m generativeaiexamples_tpu.analysis [paths...] [options]
+    make lint
+
+Exit codes: 0 clean, 1 findings (or unknown suppressions), 2 usage
+errors.  ``--json`` emits a machine-readable report (stable keys) so
+future tooling can diff findings across commits; ``--write-baseline``
+grandfathers the current findings instead of failing on them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from generativeaiexamples_tpu.analysis import baseline as baseline_mod
+from generativeaiexamples_tpu.analysis import rules as _rules  # noqa: F401
+from generativeaiexamples_tpu.analysis.engine import run_paths
+from generativeaiexamples_tpu.analysis.registry import RULES
+
+# the installed package directory itself — cwd-independent, like every
+# other path the analyzer touches (engine._rel anchors to the repo root)
+DEFAULT_TARGET = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m generativeaiexamples_tpu.analysis",
+        description="tpulint: static analysis for TPU-serving hazards "
+                    "(docs/static_analysis.md)")
+    p.add_argument("paths", nargs="*", default=[DEFAULT_TARGET],
+                   help="files or directories (default: the "
+                   "generativeaiexamples_tpu package)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output (findings + summary)")
+    p.add_argument("--only", action="append", metavar="RULE",
+                   help="run only this rule (repeatable)")
+    p.add_argument("--skip", action="append", metavar="RULE",
+                   help="skip this rule (repeatable)")
+    p.add_argument("--baseline", default=baseline_mod.DEFAULT_BASELINE_PATH,
+                   metavar="PATH", help="baseline file (default: the "
+                   "checked-in tpulint_baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report grandfathered "
+                   "findings too")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings to the baseline file and "
+                   "exit 0 (the grandfathering workflow)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(RULES):
+            r = RULES[name]
+            print(f"{name} [{r.severity}]\n    {r.description}")
+        return 0
+
+    if args.write_baseline and (args.only or args.skip):
+        # a filtered run sees a subset of findings; writing it would drop
+        # every other rule's grandfathered entries from the baseline
+        print("tpulint: --write-baseline cannot be combined with "
+              "--only/--skip (it would overwrite the other rules' "
+              "baseline entries)", file=sys.stderr)
+        return 2
+
+    try:
+        report = run_paths(
+            args.paths, only=args.only, skip=args.skip,
+            baseline_path=None if (args.no_baseline or args.write_baseline)
+            else args.baseline)
+    except (ValueError, OSError) as exc:
+        print(f"tpulint: {exc}", file=sys.stderr)
+        return 2
+
+    if report.files_scanned == 0:
+        print("tpulint: no .py files under the given paths — refusing to "
+              "report an unscanned tree as clean", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        if report.unknown_suppressions:
+            # grandfathering now would permanently hide the finding the
+            # typo'd suppression meant to cover — fix the typo first
+            for msg in report.unknown_suppressions:
+                print(msg, file=sys.stderr)
+            print("tpulint: refusing --write-baseline while suppressions "
+                  "reference unknown rules", file=sys.stderr)
+            return 1
+        broken = [f for f in report.findings if f.rule == "parse-error"]
+        if broken:
+            # a grandfathered parse-error would make every later run call
+            # an unparseable tree "clean" — the one invariant the analyzer
+            # must never trade away
+            for f in broken:
+                print(f.render(), file=sys.stderr)
+            print("tpulint: refusing --write-baseline while files do not "
+                  "parse", file=sys.stderr)
+            return 1
+        # a partial-path run sees a subset of files: preserve the baseline
+        # entries of every file OUTSIDE the scanned set, else grandfathered
+        # findings elsewhere silently resurface on the next full run
+        try:
+            existing = baseline_mod.load(args.baseline)
+        except (ValueError, OSError) as exc:
+            print(f"tpulint: {exc}", file=sys.stderr)
+            return 2
+        scanned = set(report.files)
+        keep = {key: count for key, count in existing.items()
+                if key[1] not in scanned}
+        baseline_mod.save(args.baseline, report.findings, keep=keep)
+        print(f"tpulint: wrote {len(report.findings)} finding(s) to "
+              f"{args.baseline}"
+              + (f" (kept {sum(keep.values())} existing for files "
+                 "outside the scanned paths)" if keep else ""))
+        return 0
+
+    if args.as_json:
+        print(json.dumps({"version": 1,
+                          "findings": [f.to_json() for f in report.findings],
+                          "summary": report.summary()},
+                         indent=2, sort_keys=True))
+        return 0 if report.clean else 1
+
+    for f in report.findings:
+        print(f.render())
+    for msg in report.unknown_suppressions:
+        print(f"{msg}", file=sys.stderr)
+    s = report.summary()
+    status = "clean" if report.clean else f"{s['findings']} finding(s)"
+    print(f"tpulint: {status} — {s['files_scanned']} file(s) scanned, "
+          f"{s['suppressed']} suppressed, {s['baselined']} baselined")
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":   # pragma: no cover
+    raise SystemExit(main())

@@ -1,0 +1,171 @@
+"""The tpulint driver: discover files, parse once, run every rule,
+apply suppressions and the baseline.
+
+Pure stdlib + pure AST: linting never imports the analyzed code, so it
+runs identically with or without JAX installed and costs well under a
+second for the whole package (the tier-1 self-check budget is 10 s).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from generativeaiexamples_tpu.analysis import baseline as baseline_mod
+from generativeaiexamples_tpu.analysis.astutil import ModuleContext
+from generativeaiexamples_tpu.analysis.findings import BaselineKey, Finding
+from generativeaiexamples_tpu.analysis.registry import RULES, Rule
+from generativeaiexamples_tpu.analysis.suppressions import Suppressions
+
+_SKIP_DIRS = frozenset({"__pycache__", ".git", ".mypy_cache", ".pytest_cache",
+                        "node_modules"})
+
+
+@dataclass
+class Report:
+    findings: List[Finding] = field(default_factory=list)
+    files: List[str] = field(default_factory=list)   # repo-relative, scanned
+    suppressed: int = 0
+    baselined: int = 0
+    unknown_suppressions: List[str] = field(default_factory=list)
+
+    @property
+    def files_scanned(self) -> int:
+        return len(self.files)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.unknown_suppressions
+
+    def summary(self) -> Dict[str, object]:
+        by_rule: Dict[str, int] = {}
+        for f in self.findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        return {"files_scanned": self.files_scanned,
+                "findings": len(self.findings),
+                "suppressed": self.suppressed,
+                "baselined": self.baselined,
+                "by_rule": dict(sorted(by_rule.items())),
+                "unknown_suppressions": list(self.unknown_suppressions)}
+
+
+# the source tree root (the directory holding the generativeaiexamples_tpu
+# package): baseline keys and rendered paths anchor here, NOT to cwd, so a
+# baseline written from the repo root still matches a run started anywhere
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _rel(path: str) -> str:
+    """Stable repo-root-relative posix path (the baseline key and the
+    rendered location); files outside the repo keep their absolute path —
+    still cwd-independent, just not portable across machines."""
+    apath = os.path.abspath(path)
+    try:
+        rel = os.path.relpath(apath, _ROOT)
+    except ValueError:          # different drive (windows)
+        rel = apath
+    if rel.startswith(".."):
+        rel = apath
+    return rel.replace(os.sep, "/")
+
+
+def discover(paths: Sequence[str]) -> List[str]:
+    """Expand files/directories into a sorted, de-duplicated .py list.
+    A path that does not exist is an error, not an empty result — a
+    typo'd lint target must never read as a clean tree."""
+    out: List[str] = []
+    seen = set()
+    for path in paths:
+        if not os.path.exists(path):
+            raise ValueError(f"no such file or directory: {path}")
+        if os.path.isfile(path):
+            candidates: List[str] = [path]
+        else:
+            candidates = []
+            for root, dirs, names in os.walk(path):
+                dirs[:] = [d for d in dirs
+                           if d not in _SKIP_DIRS and not d.startswith(".")]
+                candidates.extend(os.path.join(root, name)
+                                  for name in names if name.endswith(".py"))
+        for cand in candidates:
+            key = os.path.abspath(cand)
+            if key not in seen and cand.endswith(".py"):
+                seen.add(key)
+                out.append(cand)
+    return sorted(out, key=_rel)
+
+
+def _select(only: Optional[Sequence[str]], skip: Optional[Sequence[str]]
+            ) -> List[Rule]:
+    names = list(RULES)
+    unknown = [n for n in list(only or []) + list(skip or [])
+               if n not in RULES]
+    if unknown:
+        raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}; "
+                         f"available: {', '.join(sorted(RULES))}")
+    if only:
+        names = [n for n in names if n in set(only)]
+    if skip:
+        names = [n for n in names if n not in set(skip)]
+    return [RULES[n] for n in names]
+
+
+def analyze_source(path: str, source: str,
+                   rules: Optional[Sequence[Rule]] = None,
+                   ) -> List[Finding]:
+    """All raw findings for one module (suppressions NOT applied — the
+    caller owns policy). A syntax error is itself a finding: tier-1 must
+    not report 'clean' on a tree it could not parse."""
+    rel = _rel(path)
+    try:
+        ctx = ModuleContext(rel, source)
+    except SyntaxError as exc:
+        return [Finding(rel, exc.lineno or 1, "parse-error", "error",
+                        f"file does not parse: {exc.msg}")]
+    findings: List[Finding] = []
+    for r in rules if rules is not None else list(RULES.values()):
+        findings.extend(r.check(ctx))
+    return sorted(findings)
+
+
+def analyze_file(path: str, rules: Optional[Sequence[Rule]] = None
+                 ) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return analyze_source(path, fh.read(), rules)
+
+
+def run_paths(paths: Sequence[str],
+              only: Optional[Sequence[str]] = None,
+              skip: Optional[Sequence[str]] = None,
+              baseline_path: Optional[str] = baseline_mod.DEFAULT_BASELINE_PATH,
+              ) -> Report:
+    """Lint ``paths`` end to end: discover → parse → rules → inline
+    suppressions → baseline.  ``baseline_path=None`` disables the
+    baseline (``--no-baseline``).  Suppression comments naming unknown
+    rules are reported, not ignored — a typo in ``disable=`` must not
+    silently re-enable nothing."""
+    rules = _select(only, skip)
+    grandfathered: Dict[BaselineKey, int] = (
+        baseline_mod.load(baseline_path) if baseline_path else {})
+    report = Report()
+    all_remaining: List[Finding] = []
+    for path in discover(paths):
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        report.files.append(_rel(path))
+        findings = analyze_source(path, source, rules)
+        supp = Suppressions(source)
+        kept, n_supp = supp.split(findings)
+        report.suppressed += n_supp
+        all_remaining.extend(kept)
+        for name in sorted(supp.mentioned):
+            if name not in RULES:
+                report.unknown_suppressions.append(
+                    f"{_rel(path)}: suppression references unknown rule "
+                    f"{name!r}")
+    remaining, absorbed = baseline_mod.apply(all_remaining, grandfathered)
+    report.baselined = absorbed
+    report.findings = sorted(remaining)
+    return report

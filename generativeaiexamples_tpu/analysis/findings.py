@@ -1,0 +1,35 @@
+"""Finding model: one hazard at one ``file:line``.
+
+Findings are value objects so the engine can dedupe, sort, diff, and
+baseline them.  The baseline key deliberately omits the line number —
+grandfathered findings must survive unrelated edits that shift lines,
+otherwise every PR churns the baseline file.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+SEVERITIES = ("error", "warning")
+
+BaselineKey = Tuple[str, str, str]   # (rule, file, message)
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    file: str          # repo-relative posix path
+    line: int          # 1-based
+    rule: str
+    severity: str      # "error" | "warning"
+    message: str
+
+    def baseline_key(self) -> BaselineKey:
+        return (self.rule, self.file, self.message)
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.severity}] {self.rule}: {self.message}"
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"file": self.file, "line": self.line, "rule": self.rule,
+                "severity": self.severity, "message": self.message}

@@ -1,0 +1,46 @@
+"""Rule registry: name → (severity, description, check function).
+
+A rule is a plain function ``check(ctx) -> Iterable[Finding]`` over a
+parsed module (:class:`~generativeaiexamples_tpu.analysis.astutil.ModuleContext`),
+registered with the :func:`rule` decorator.  The registry is the single
+source of truth for the CLI's ``--list-rules``, the doc catalog, and the
+engine's rule selection (``--only`` / ``--skip``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, TYPE_CHECKING
+
+from generativeaiexamples_tpu.analysis.findings import SEVERITIES, Finding
+
+if TYPE_CHECKING:   # pragma: no cover
+    from generativeaiexamples_tpu.analysis.astutil import ModuleContext
+
+CheckFn = Callable[["ModuleContext"], Iterable[Finding]]
+
+
+@dataclass(frozen=True)
+class Rule:
+    name: str
+    severity: str
+    description: str
+    check: CheckFn
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(name: str, severity: str, description: str) -> Callable[[CheckFn], CheckFn]:
+    """Register ``fn`` as the checker for ``name``. Import-time validation
+    keeps rule metadata honest (the doc catalog renders from it)."""
+    if severity not in SEVERITIES:
+        raise ValueError(f"rule {name!r}: severity must be one of {SEVERITIES}")
+
+    def deco(fn: CheckFn) -> CheckFn:
+        if name in RULES:
+            raise ValueError(f"duplicate rule name {name!r}")
+        RULES[name] = Rule(name, severity, description, fn)
+        return fn
+
+    return deco

@@ -1,0 +1,356 @@
+"""The tpulint rule catalog — TPU-serving hazards this codebase has
+actually shipped (and fixed) by hand.
+
+Every rule is a pure-AST heuristic: no imports of the analyzed code, no
+JAX, no dataflow.  That buys determinism and speed (the whole package
+lints in well under a second) at the cost of some reach — e.g.
+clock-discipline flags ``time.time()`` *directly* in arithmetic, not a
+wall-clock value stored and subtracted three lines later.  The rules are
+tuned so that a true positive is near-certain; anything deliberate gets
+an inline ``# tpulint: disable=<rule>`` with a reason.
+
+Rationale per rule lives in docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator, List, Optional, Sequence
+
+from generativeaiexamples_tpu.analysis.astutil import (
+    ModuleContext, call_name, dotted_name)
+from generativeaiexamples_tpu.analysis.findings import Finding
+from generativeaiexamples_tpu.analysis.registry import rule
+
+# --------------------------------------------------------------------------
+# shared vocab
+# --------------------------------------------------------------------------
+
+_JIT_NAMES = frozenset({
+    "jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit.pjit",
+})
+_PARTIAL_NAMES = frozenset({"functools.partial", "partial"})
+
+# host↔device sync triggers on traced values (method form)
+_SYNC_ATTRS = frozenset({"item", "tolist", "block_until_ready"})
+# ... and call form
+_SYNC_CALLS = frozenset({
+    "jax.device_get", "device_get", "np.asarray", "np.array",
+    "numpy.asarray", "numpy.array",
+})
+
+_HTTP_CALLS = frozenset(
+    f"{mod}.{verb}"
+    for mod in ("requests", "httpx")
+    for verb in ("get", "post", "put", "patch", "delete", "head", "options",
+                 "request", "stream")
+)
+_URLOPEN_CALLS = frozenset({"urllib.request.urlopen", "request.urlopen",
+                            "urlopen"})
+
+# matched against underscore-separated segments of the held name's last
+# component, EXACTLY — substring matching would drag in clocks
+# ("self.clock") and blocking-IO helpers ("self.blocker")
+_LOCKISH_SEGMENTS = frozenset({"lock", "rlock", "wlock", "mutex", "cv",
+                               "cond", "condition"})
+
+# blocking while holding a lock: serializes every other thread on it
+_BLOCKING_UNDER_LOCK_CALLS = frozenset(
+    {"time.sleep"} | _HTTP_CALLS | _URLOPEN_CALLS
+    | {"jax.device_get", "device_get"})
+_BLOCKING_UNDER_LOCK_ATTRS = frozenset({"result", "block_until_ready"})
+# Condition.wait RELEASES the lock; notify wakes without blocking
+_LOCK_SAFE_ATTRS = frozenset({"wait", "wait_for", "notify", "notify_all",
+                              "acquire", "release"})
+
+_LOG_METHODS = frozenset({"debug", "info", "warning", "warn", "error",
+                          "exception", "critical", "log"})
+_METRIC_ATTRS = frozenset({"inc", "dec", "observe", "set"})
+
+
+def _walk_excluding_defs(body: Sequence[ast.AST]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested function bodies —
+    a closure defined under a lock does not *run* under it."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jax.jit`` / ``pjit`` as a name, a configured call
+    (``jax.jit(f, ...)``), or ``partial(jax.jit, ...)``."""
+    if dotted_name(node) in _JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        if name in _JIT_NAMES:
+            return True
+        if name in _PARTIAL_NAMES and node.args:
+            return dotted_name(node.args[0]) in _JIT_NAMES
+    return False
+
+
+def _jit_decorated(fn: ast.AST) -> bool:
+    return any(_is_jit_expr(d) for d in getattr(fn, "decorator_list", []))
+
+
+def _walk_trace_scope(body: Sequence[ast.AST]) -> Iterator[ast.AST]:
+    """Walk a traced function's body INCLUDING nested plain defs/lambdas —
+    a helper defined and called inside a jitted function runs under the
+    same trace, so its host syncs are just as fatal. Only a nested def
+    carrying its own jit decorator is skipped (it is its own check root)."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and _jit_decorated(node):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# --------------------------------------------------------------------------
+# trace-hazard
+# --------------------------------------------------------------------------
+
+@rule("trace-hazard", "error",
+      "Host sync / host round-trip on traced values inside jit-compiled or "
+      "hot-path code (.item(), .tolist(), np.asarray, jax.device_get, "
+      "float()/int() on traced values)")
+def check_trace_hazard(ctx: ModuleContext) -> Iterable[Finding]:
+    """Inside a jitted function these either fail at trace time or, worse,
+    silently force a device fetch per call on the decode path.  Functions
+    marked ``# tpulint: hot-path`` (scheduler-tick code) get the same
+    treatment minus the float()/int() check (host floats are fine there —
+    it is the per-token device fetch that kills throughput)."""
+    for fn in ctx.walk():
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        jitted = _jit_decorated(fn)
+        hot = ctx.has_marker(fn, "hot-path")
+        if not (jitted or hot):
+            continue
+        where = "jit-compiled" if jitted else "hot-path"
+        for node in _walk_trace_scope(fn.body):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SYNC_ATTRS):
+                yield Finding(
+                    ctx.path, node.lineno, "trace-hazard", "error",
+                    f"`.{node.func.attr}()` in {where} `{fn.name}` forces a "
+                    "host sync per call; batch the fetch outside the "
+                    "compiled/hot region")
+            elif name in _SYNC_CALLS:
+                yield Finding(
+                    ctx.path, node.lineno, "trace-hazard", "error",
+                    f"`{name}` in {where} `{fn.name}` pulls the value to "
+                    "host; keep device arrays on device or fetch them "
+                    "batched outside")
+            elif (jitted and name in ("float", "int", "bool")
+                  and len(node.args) == 1
+                  and not isinstance(node.args[0], ast.Constant)):
+                yield Finding(
+                    ctx.path, node.lineno, "trace-hazard", "error",
+                    f"`{name}()` on a non-constant inside jit-compiled "
+                    f"`{fn.name}` concretizes a traced value (trace-time "
+                    "error or silent sync); use jnp ops instead")
+
+
+# --------------------------------------------------------------------------
+# recompile-hazard
+# --------------------------------------------------------------------------
+
+@rule("recompile-hazard", "error",
+      "jax.jit/pjit constructed inside a loop — every construction is a "
+      "fresh compile cache, so the XLA compile cost repeats per iteration")
+def check_recompile_hazard(ctx: ModuleContext) -> Iterable[Finding]:
+    for node in ctx.walk():
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        is_jit = name in _JIT_NAMES or (
+            name in _PARTIAL_NAMES and node.args
+            and dotted_name(node.args[0]) in _JIT_NAMES)
+        if is_jit and ctx.in_loop(node):
+            yield Finding(
+                ctx.path, node.lineno, "recompile-hazard", "error",
+                f"`{name}` constructed inside a loop — the compiled "
+                "function (and its cache) is rebuilt every iteration; "
+                "hoist the jit out of the loop and reuse it")
+
+
+# --------------------------------------------------------------------------
+# lock-discipline
+# --------------------------------------------------------------------------
+
+def _lockish(expr: ast.AST) -> Optional[str]:
+    name = dotted_name(expr)
+    if name is None:
+        return None
+    last = name.rsplit(".", 1)[-1].lower()
+    segments = [s for s in last.split("_") if s]
+    if any(s in _LOCKISH_SEGMENTS for s in segments):
+        return name
+    return None
+
+
+@rule("lock-discipline", "error",
+      "Blocking call (sleep, HTTP, future .result(), TPU fetch) while "
+      "holding a threading.Lock/Condition — serializes every thread "
+      "contending on that lock behind the slow operation")
+def check_lock_discipline(ctx: ModuleContext) -> Iterable[Finding]:
+    """``Condition.wait`` is exempt (it releases the lock); closures
+    defined under the lock are skipped (they run later, elsewhere)."""
+    for node in ctx.walk():
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        held = None
+        for item in node.items:
+            held = _lockish(item.context_expr)
+            if held:
+                break
+        if not held:
+            continue
+        for inner in _walk_excluding_defs(node.body):
+            if not isinstance(inner, ast.Call):
+                continue
+            name = call_name(inner)
+            attr = (inner.func.attr
+                    if isinstance(inner.func, ast.Attribute) else None)
+            if attr in _LOCK_SAFE_ATTRS:
+                continue
+            if name in _BLOCKING_UNDER_LOCK_CALLS:
+                yield Finding(
+                    ctx.path, inner.lineno, "lock-discipline", "error",
+                    f"`{name}` while holding `{held}` — every thread "
+                    "contending on the lock blocks behind it; move the "
+                    "slow call outside the critical section")
+            elif attr in _BLOCKING_UNDER_LOCK_ATTRS:
+                yield Finding(
+                    ctx.path, inner.lineno, "lock-discipline", "error",
+                    f"`.{attr}()` while holding `{held}` — a blocking "
+                    "wait inside the critical section; collect the future "
+                    "/ device value after releasing the lock")
+
+
+# --------------------------------------------------------------------------
+# clock-discipline
+# --------------------------------------------------------------------------
+
+@rule("clock-discipline", "error",
+      "time.time() used in interval/rate arithmetic — wall clock steps on "
+      "NTP adjustment, producing negative or wildly wrong durations; use "
+      "time.monotonic() (wall clock only for reported timestamps)")
+def check_clock_discipline(ctx: ModuleContext) -> Iterable[Finding]:
+    """Flags ``time.time()`` appearing as an operand of +/- arithmetic or
+    a comparison.  A bare ``time.time()`` stored as a *timestamp*
+    (``"created": int(time.time())``) is legitimate and passes; a stored
+    value subtracted later is out of reach for a single-expression pass —
+    reviewers still own that case."""
+    for node in ctx.walk():
+        if call_name(node) != "time.time":
+            continue
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.stmt):
+                break
+            if (isinstance(anc, ast.BinOp)
+                    and isinstance(anc.op, (ast.Add, ast.Sub))) \
+                    or isinstance(anc, ast.Compare):
+                yield Finding(
+                    ctx.path, node.lineno, "clock-discipline", "error",
+                    "`time.time()` in duration/interval arithmetic — wall "
+                    "clock is not monotonic; use `time.monotonic()` and "
+                    "keep wall clock for reported timestamps only")
+                break
+
+
+# --------------------------------------------------------------------------
+# net-timeout
+# --------------------------------------------------------------------------
+
+@rule("net-timeout", "error",
+      "Outbound HTTP call without timeout= — one hung peer wedges the "
+      "calling thread (and whatever lock or slot it holds) forever")
+def check_net_timeout(ctx: ModuleContext) -> Iterable[Finding]:
+    for node in ctx.walk():
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name in _HTTP_CALLS:
+            timed = any(kw.arg == "timeout" for kw in node.keywords)
+        elif name in _URLOPEN_CALLS:
+            # urlopen(url, data, timeout) — positional third arg counts
+            timed = (any(kw.arg == "timeout" for kw in node.keywords)
+                     or len(node.args) >= 3)
+        else:
+            continue
+        if not timed:
+            yield Finding(
+                ctx.path, node.lineno, "net-timeout", "error",
+                f"`{name}` without `timeout=` — a silent peer blocks this "
+                "thread indefinitely; pass an explicit timeout "
+                "(core.config.http_timeout() for the shared default)")
+
+
+# --------------------------------------------------------------------------
+# except-swallow
+# --------------------------------------------------------------------------
+
+def _broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [dotted_name(e) for e in t.elts]
+    else:
+        names = [dotted_name(t)]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _handles_visibly(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.AugAssign)):
+            return True        # re-raise, or an error counter increment
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name in ("print", "warnings.warn", "traceback.print_exc"):
+            return True
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            base = dotted_name(node.func.value) or ""
+            if attr in _LOG_METHODS and ("log" in base.lower()
+                                         or isinstance(node.func.value,
+                                                       ast.Call)):
+                return True    # logger.x / logging.x / getLogger(...).x
+            if attr in _METRIC_ATTRS:
+                return True    # REGISTRY.counter(...).inc() and kin
+        if name and "REGISTRY" in name:
+            return True
+    return False
+
+
+@rule("except-swallow", "warning",
+      "Broad `except Exception` that neither logs, counts, nor re-raises — "
+      "failures vanish; a dead component with /health green is the worst "
+      "failure mode")
+def check_except_swallow(ctx: ModuleContext) -> Iterable[Finding]:
+    for node in ctx.walk():
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if _broad_handler(node) and not _handles_visibly(node):
+            caught = (dotted_name(node.type)
+                      if node.type is not None else "everything")
+            yield Finding(
+                ctx.path, node.lineno, "except-swallow", "warning",
+                f"broad `except {caught}` swallows the failure silently — "
+                "log it, count it (errors_total), narrow the type, or "
+                "annotate the deliberate swallow with a reason")

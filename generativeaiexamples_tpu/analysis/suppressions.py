@@ -1,0 +1,130 @@
+"""Inline suppression comments.
+
+    do_thing()            # tpulint: disable=net-timeout  -- probe, see X
+    # tpulint: disable=except-swallow -- error rides the return value
+    except Exception:
+
+A trailing comment suppresses the named rule(s) on its own line AND on
+the first line of the logical statement it terminates — findings anchor
+to a statement's first physical line, so a suppression on the closing
+line of a wrapped multi-line call still lands.  A standalone comment
+line suppresses the next line of code (so a suppression can carry a
+reason without blowing the line length).  Two spellings of scope:
+
+  * ``# tpulint: disable=rule1,rule2`` — line-scoped (``disable=all``
+    matches every rule);
+  * ``# tpulint: disable-file=rule1,rule2`` — whole-file, anywhere in the
+    file (fixture files full of deliberate violations).
+
+Comments are extracted with :mod:`tokenize` (never by scanning raw
+lines), so a suppression example quoted inside a docstring — like the
+ones above — is not a suppression.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from typing import Dict, List, Set, Tuple
+
+# the rule list ends at the first token that is not a comma-joined name,
+# so a trailing free-text reason ("-- probe endpoint, see docs") rides
+# the same comment without being parsed as rule names
+_RULES_PART = r"([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
+_LINE_RE = re.compile(r"#\s*tpulint:\s*disable=" + _RULES_PART)
+_FILE_RE = re.compile(r"#\s*tpulint:\s*disable-file=" + _RULES_PART)
+
+ALL = "all"
+
+# tokens that neither end nor belong to a logical statement line
+_NON_CODE_TOKENS = frozenset({
+    tokenize.COMMENT, tokenize.NL, tokenize.INDENT, tokenize.DEDENT,
+    tokenize.ENCODING, tokenize.ENDMARKER, tokenize.NEWLINE,
+})
+
+
+def _parse_rules(spec: str) -> Set[str]:
+    return {part.strip() for part in spec.split(",") if part.strip()}
+
+
+class Suppressions:
+    """Per-file suppression lookup built in ONE tokenize pass.
+
+    ``mentioned`` collects every rule name any suppression references so
+    the engine can validate them against the registry without a second
+    pass over the source.
+    """
+
+    def __init__(self, source: str) -> None:
+        self.by_line: Dict[int, Set[str]] = {}
+        self.file_wide: Set[str] = set()
+        self.mentioned: Set[str] = set()
+        lines = source.splitlines()
+        comment_lines: Set[int] = set()
+        pending: List[Tuple[int, Set[str]]] = []
+        stmt_start = None   # first physical line of the open logical line
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+                if tok.type == tokenize.NEWLINE:
+                    stmt_start = None
+                    continue
+                if tok.type not in _NON_CODE_TOKENS:
+                    if stmt_start is None:
+                        stmt_start = tok.start[0]
+                    continue
+                if tok.type != tokenize.COMMENT:
+                    continue
+                lineno = tok.start[0]
+                standalone = not tok.line[:tok.start[1]].strip()
+                if standalone:
+                    comment_lines.add(lineno)
+                m = _FILE_RE.search(tok.string)
+                if m:
+                    rules = _parse_rules(m.group(1))
+                    self.file_wide |= rules
+                    self.mentioned |= rules
+                    continue
+                m = _LINE_RE.search(tok.string)
+                if not m:
+                    continue
+                rules = _parse_rules(m.group(1))
+                self.mentioned |= rules
+                if standalone:
+                    # next-code-line semantics; inside an open wrapped
+                    # statement ALSO cover the statement's lines so far —
+                    # findings may anchor to the statement's first line
+                    # while the user comments next to the nested call
+                    pending.append((lineno, rules))
+                if not standalone or stmt_start is not None:
+                    # trailing comments (and standalone ones inside a
+                    # wrapped statement) cover every physical line of the
+                    # logical statement up to the comment
+                    first = lineno if stmt_start is None else stmt_start
+                    for ln in range(first, lineno + 1):
+                        self.by_line.setdefault(ln, set()).update(rules)
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            # unparseable source: the AST pass reports it; no suppressions
+            return
+        # a standalone suppression comment applies to the next line that is
+        # neither a comment nor blank (multi-line reasons stack, and a blank
+        # line between comment and code must not void the suppression)
+        for lineno, rules in pending:
+            target = lineno + 1
+            while target <= len(lines) and (
+                    target in comment_lines or not lines[target - 1].strip()):
+                target += 1
+            self.by_line.setdefault(target, set()).update(rules)
+        self.mentioned.discard(ALL)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if ALL in self.file_wide or rule in self.file_wide:
+            return True
+        rules = self.by_line.get(line, ())
+        return ALL in rules or rule in rules
+
+    def split(self, findings: List) -> Tuple[List, int]:
+        """(kept, suppressed_count)."""
+        kept = [f for f in findings
+                if not self.is_suppressed(f.rule, f.line)]
+        return kept, len(findings) - len(kept)

@@ -157,6 +157,8 @@ def submit_concurrently(*thunks: Callable[[], Any]) -> list:
     for f in futures:
         try:
             results.append(f.result())
+        # tpulint: disable=except-swallow -- gather pattern: the first
+        # exception re-raises after every sibling future is observed
         except BaseException as exc:   # noqa: BLE001 — re-raised below
             if first_exc is None:
                 first_exc = exc

@@ -179,7 +179,8 @@ def image_summary(image_bytes: bytes) -> Optional[str]:
         from PIL import Image
 
         img = Image.open(io.BytesIO(image_bytes)).convert("RGB")
-    except Exception:
+    except Exception as exc:
+        logger.debug("image summary skipped (undecodable image): %s", exc)
         return None
     w, h = img.size
     import numpy as np

@@ -37,6 +37,36 @@ logger = logging.getLogger(__name__)
 ENV_PREFIX = "APP"
 _HELP_KEY = "__config_help__"
 
+# ---------------------------------------------------------------------------
+# Shared outbound-HTTP timeout
+# ---------------------------------------------------------------------------
+
+# tpulint's net-timeout rule requires every outbound HTTP call to carry an
+# explicit timeout; this is the one default they share, so operators tune a
+# single knob instead of hunting per-site constants.
+DEFAULT_HTTP_TIMEOUT_S = 30.0
+
+
+def http_timeout(default: Optional[float] = None) -> float:
+    """The process-wide outbound-HTTP timeout in seconds.
+
+    A call site's explicit ``default`` (its declared budget — a 10-minute
+    SSE generation vs. a 2-second health probe) always wins;
+    ``APP_HTTP_TIMEOUT_S`` replaces :data:`DEFAULT_HTTP_TIMEOUT_S` only
+    for sites with no opinion. The env knob tuning probe timeouts must
+    never silently clamp a long streaming generation mid-reply.
+    """
+    if default is not None:
+        return default
+    raw = os.environ.get(f"{ENV_PREFIX}_HTTP_TIMEOUT_S", "")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            logger.warning("ignoring non-numeric %s_HTTP_TIMEOUT_S=%r",
+                           ENV_PREFIX, raw)
+    return DEFAULT_HTTP_TIMEOUT_S
+
 
 def configfield(name: str, *, default: Any = MISSING, default_factory: Any = MISSING,
                 help_txt: str = "") -> Any:

@@ -171,7 +171,9 @@ class MetricsRegistry:
         self._gauges: Dict[Tuple[str, LabelKey], Gauge] = {}
         self._histograms: Dict[Tuple[str, LabelKey], Histogram] = {}
         self._lock = threading.Lock()
-        self._start = time.time()
+        # monotonic: uptime and rate windows are DURATIONS — an NTP step
+        # on the wall clock must never yield a negative scrape window
+        self._start = time.monotonic()
         # previous-snapshot counter values: the delta window for _rate_per_s
         self._rate_prev: Dict[str, float] = {}
         self._rate_t: float = self._start
@@ -208,7 +210,7 @@ class MetricsRegistry:
         scrapers share the window state (each scrape resets it); point one
         collector at a process, not five.
         """
-        now = time.time()
+        now = time.monotonic()
         uptime = now - self._start
         with self._lock:
             gauges = dict(self._gauges)
@@ -261,7 +263,7 @@ class MetricsRegistry:
             histograms = sorted(self._histograms.items())
             start = self._start
         lines.append("# TYPE process_uptime_seconds gauge")
-        lines.append(f"process_uptime_seconds {time.time() - start:.3f}")
+        lines.append(f"process_uptime_seconds {time.monotonic() - start:.3f}")
         typed: set = set()
         for (name, lk), c in counters:
             pname = _sanitize(name)

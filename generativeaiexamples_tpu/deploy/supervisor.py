@@ -57,7 +57,10 @@ def _http_ok(url: str, timeout: float = 2.0) -> bool:
     try:
         with urllib.request.urlopen(url, timeout=timeout) as resp:
             return 200 <= resp.status < 300
-    except Exception:
+    except Exception as exc:
+        # a down process is this probe's normal negative result — debug
+        # keeps restart loops quiet but traceable
+        logger.debug("health probe %s failed: %s", url, exc)
         return False
 
 

@@ -59,7 +59,9 @@ def _decode_image(image_bytes: bytes, size: int) -> Optional[np.ndarray]:
         img = Image.open(io.BytesIO(image_bytes)).convert("RGB")
         img = img.resize((size, size), Image.BICUBIC)
         return np.asarray(img, np.float32) / 255.0
-    except Exception:
+    except Exception as exc:
+        logger.debug("image decode failed (%d bytes): %s",
+                     len(image_bytes), exc)
         return None
 
 

@@ -998,7 +998,7 @@ class Scheduler:
             steps *= 2
         return steps
 
-    def _dispatch_decode(self) -> None:
+    def _dispatch_decode(self) -> None:   # tpulint: hot-path
         """Issue one K-step decode dispatch without waiting for its result
         (dispatch-ahead pipelining: the transfer of dispatch N overlaps the
         compute of dispatch N+1, hiding host-device sync latency entirely —
@@ -1038,7 +1038,7 @@ class Scheduler:
         self._pending_steps += steps * self._spec_w
         REGISTRY.counter("decode_steps").inc(steps)
 
-    def _process_decode(self) -> None:
+    def _process_decode(self) -> None:   # tpulint: hot-path
         """Sync + fan out the OLDEST in-flight dispatch (FIFO). Rows of the
         packed block are (step, position) micro-steps; with speculation a
         step can emit up to W accepted tokens."""
@@ -1116,7 +1116,7 @@ class Scheduler:
             "tokens_generated": REGISTRY.counter("tokens_generated").value,
         }
 
-    def _tick(self) -> bool:
+    def _tick(self) -> bool:   # tpulint: hot-path
         """One scheduling round; returns False when fully idle."""
         # continuous per-step telemetry: the ring the /debug/flight window,
         # SIGUSR1 dump, and bench.py occupancy stats all read. Idle ticks

@@ -18,6 +18,8 @@ from typing import Any, Dict, List, Optional
 
 import requests
 
+from generativeaiexamples_tpu.core.config import http_timeout
+
 logger = logging.getLogger(__name__)
 
 GENERATE_PARAMS = {"use_knowledge_base": True, "temperature": 0.2,
@@ -38,7 +40,7 @@ def upload_documents(folder_path: str, base_url: str) -> int:
         with open(path, "rb") as fh:
             resp = requests.post(f"{base_url}/documents",
                                  files={"file": (name, fh, mime)},
-                                 timeout=300)
+                                 timeout=http_timeout(300))
         if resp.status_code == 200:
             count += 1
         else:
@@ -90,7 +92,7 @@ def generate_answers(base_url: str, dataset_folder_path: str,
                 f"{base_url}/generate",
                 json={"messages": [{"role": "user", "content": question}],
                       **gen_params},
-                stream=True, timeout=600) as resp:
+                stream=True, timeout=http_timeout(600)) as resp:
             if resp.status_code != 200:
                 logger.warning("/generate failed for %r: %d %.200s",
                                question, resp.status_code, resp.text)
@@ -102,7 +104,7 @@ def generate_answers(base_url: str, dataset_folder_path: str,
             f"{base_url}/search",
             json={"query": question,
                   "top_k": search_params.get("num_docs", 1)},
-            timeout=120)
+            timeout=http_timeout(120))
         if search_resp.status_code != 200:
             logger.warning("/search failed for %r: %d %.200s", question,
                            search_resp.status_code, search_resp.text)

@@ -181,7 +181,7 @@ class AuditConfig:
 class AuditReport:
     job_id: str
     target_name: str
-    started_at: float
+    started_at: float    # monotonic — the pair only ever feeds duration_s
     finished_at: float
     results: List[Dict] = field(default_factory=list)   # per family
 
@@ -236,7 +236,7 @@ def run_audit(target: Target, config: Optional[AuditConfig] = None,
     """Run an audit job: probe → chat → detect, families in sequence,
     attempts in parallel (ref: jobs over a target+config pair)."""
     config = config or AuditConfig()
-    t0 = time.time()
+    t0 = time.monotonic()   # started/finished feed duration_s only
     report = AuditReport(job_id=f"audit-{uuid.uuid4().hex[:12]}",
                          target_name=target_name, started_at=t0,
                          finished_at=t0)
@@ -246,7 +246,9 @@ def run_audit(target: Target, config: Optional[AuditConfig] = None,
                     {"role": "user", "content": prompt}]
         try:
             resp = target(messages)
-        except Exception as exc:     # a crashed target IS a finding
+        # tpulint: disable=except-swallow -- a crashed target IS a finding:
+        # the error rides the attempt record and is counted as a hit
+        except Exception as exc:
             return {"prompt": prompt, "response": f"<target error: {exc}>",
                     "error": True}
         return {"prompt": prompt, "response": resp, "error": False}
@@ -272,7 +274,7 @@ def run_audit(target: Target, config: Optional[AuditConfig] = None,
                 "attempts": len(outs), "hits": hits,
                 "failures": failures,
             })
-    report.finished_at = time.time()
+    report.finished_at = time.monotonic()
     return report
 
 

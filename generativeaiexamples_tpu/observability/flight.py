@@ -76,7 +76,7 @@ class FlightRecorder:
         """Record a sample iff the interval has elapsed. ``fields_fn`` is
         only invoked when a sample is due — the fast path is one clock
         read, cheap enough for every scheduler tick."""
-        now = time.time()
+        now = time.monotonic()
         if now - self._last_t < self.interval_s:
             return False
         with self._lock:
@@ -89,14 +89,20 @@ class FlightRecorder:
     def record(self, **fields: Any) -> Dict[str, Any]:
         """Unconditionally append one sample; derives ``tok_s`` from the
         ``tokens_generated`` delta against the previous sample and mirrors
-        numeric fields into ``flight_*`` gauges."""
-        now = time.time()
-        sample: Dict[str, Any] = {"ts": now}   # full precision: tok_s deltas
+        numeric fields into ``flight_*`` gauges.
+
+        Each sample carries two stamps: ``ts`` (wall clock — what dumps,
+        bench windows, and cross-log correlation key on) and ``mono``
+        (monotonic — what every delta and window cutoff computes from, so
+        an NTP step can never produce a negative tok/s or swallow a
+        window)."""
+        now = time.monotonic()
+        sample: Dict[str, Any] = {"ts": time.time(), "mono": now}
         sample.update(fields)
         with self._lock:
             prev = self._prev
             if prev is not None and "tokens_generated" in fields:
-                dt = now - prev["ts"]
+                dt = now - prev["mono"]
                 if dt > 1e-6:
                     sample["tok_s"] = round(
                         (fields["tokens_generated"]
@@ -116,8 +122,8 @@ class FlightRecorder:
             samples = list(self._ring)
         if seconds is None:
             return samples
-        cutoff = time.time() - seconds
-        return [s for s in samples if s["ts"] >= cutoff]
+        cutoff = time.monotonic() - seconds
+        return [s for s in samples if s["mono"] >= cutoff]
 
     def __len__(self) -> int:
         with self._lock:

@@ -81,11 +81,28 @@ def profile_trace(log_dir: str, host_tracer_level: int = 2
 @contextlib.contextmanager
 def annotate(name: str) -> Iterator[None]:
     """Named sub-region inside an active trace (shows up as a track event
-    on the device timeline) — the device-side sibling of an OTel span."""
+    on the device timeline) — the device-side sibling of an OTel span.
+
+    Annotation setup is guarded SEPARATELY from the caller's body: a
+    ``try`` spanning the ``yield`` would catch exceptions the caller's own
+    code raises through it, yield a second time, and make contextlib
+    replace the caller's real error with "generator didn't stop after
+    throw()"."""
+    annotation = None
     try:
         import jax
 
-        with jax.profiler.TraceAnnotation(name):
-            yield
-    except Exception:
+        annotation = jax.profiler.TraceAnnotation(name)
+        annotation.__enter__()
+    except Exception as exc:   # stripped builds / no active trace backend
+        logger.debug("device trace annotation %r unavailable: %s", name, exc)
+        annotation = None
+    try:
         yield
+    finally:
+        if annotation is not None:
+            try:
+                annotation.__exit__(None, None, None)
+            except Exception as exc:
+                logger.debug("trace annotation %r close failed: %s",
+                             name, exc)

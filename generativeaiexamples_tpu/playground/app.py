@@ -139,8 +139,10 @@ class PlaygroundServer:
             logger.exception("streaming transcription failed")
             try:
                 await ws.send_json({"error": str(exc)})
+            # tpulint: disable=except-swallow -- client already gone; the
+            # ws.close() below is best-effort and the failure was logged above
             except Exception:
-                pass   # client already gone; the close below is best-effort
+                pass
         await ws.close()
         return ws
 

@@ -32,6 +32,8 @@ import time
 import urllib.request
 from typing import Dict, Iterator, List, Sequence
 
+from generativeaiexamples_tpu.core.config import http_timeout
+
 logger = logging.getLogger(__name__)
 
 
@@ -45,7 +47,10 @@ class _Worker:
             with urllib.request.urlopen(f"{self.url}/health",
                                         timeout=timeout) as resp:
                 return 200 <= resp.status < 300
-        except Exception:
+        except Exception as exc:
+            # an unreachable worker is the EXPECTED case this probe exists
+            # for — debug keeps the recovery loop quiet but traceable
+            logger.debug("health probe %s failed: %s", self.url, exc)
             return False
 
 
@@ -112,7 +117,8 @@ class FailoverLLM:
                             len(payload["continue_text"]))
             try:
                 with httpx.stream("POST", f"{w.url}/v1/chat/completions",
-                                  json=payload, timeout=120.0) as resp:
+                                  json=payload,
+                                  timeout=http_timeout(120.0)) as resp:
                     if resp.status_code >= 500:
                         raise httpx.TransportError(
                             f"HTTP {resp.status_code}")
@@ -170,7 +176,7 @@ class FailoverLLM:
                 continue
             try:
                 resp = httpx.post(f"{w.url}/v1/chat/completions",
-                                  json=payload, timeout=120.0)
+                                  json=payload, timeout=http_timeout(120.0))
                 if resp.status_code >= 500:
                     raise httpx.TransportError(f"HTTP {resp.status_code}")
                 resp.raise_for_status()       # 4xx: deterministic — raise

@@ -96,3 +96,19 @@ def test_debug_modes_install(monkeypatch, caplog):
         debug.install()   # idempotent
     finally:
         jax.config.update("jax_debug_nans", False)
+
+
+def test_http_timeout_site_budget_wins(monkeypatch):
+    """APP_HTTP_TIMEOUT_S replaces the shared default only — an explicit
+    per-site budget always wins, so tuning probe timeouts can never clamp
+    a long streaming generation."""
+    from generativeaiexamples_tpu.core.config import (
+        DEFAULT_HTTP_TIMEOUT_S, http_timeout)
+
+    monkeypatch.setenv("APP_HTTP_TIMEOUT_S", "10")
+    assert http_timeout(600) == 600
+    assert http_timeout() == 10.0
+    monkeypatch.setenv("APP_HTTP_TIMEOUT_S", "junk")
+    assert http_timeout() == DEFAULT_HTTP_TIMEOUT_S
+    monkeypatch.delenv("APP_HTTP_TIMEOUT_S")
+    assert http_timeout() == DEFAULT_HTTP_TIMEOUT_S

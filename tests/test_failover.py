@@ -10,6 +10,7 @@ joined text equals the uninterrupted single-server reference — no dropped
 and no duplicated output.
 """
 
+import contextlib
 import json
 import os
 import signal
@@ -52,13 +53,16 @@ def _metric(port: int, name: str) -> float:
         return float(json.load(resp).get(name, 0.0))
 
 
-@pytest.fixture()
-def two_engines():
+@contextlib.contextmanager
+def _spawn_engines(n: int):
+    """n engine processes booting CONCURRENTLY (all Popen'd before the
+    first health wait), so wall-clock startup is ~one engine's boot
+    regardless of n."""
     env = {**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
            "XLA_FLAGS": ""}
     ports, procs = [], []
     try:
-        for _ in range(2):
+        for _ in range(n):
             port = _free_port()
             procs.append(subprocess.Popen(
                 [sys.executable, "-m", "generativeaiexamples_tpu.engine",
@@ -74,6 +78,12 @@ def two_engines():
                 os.killpg(p.pid, signal.SIGKILL)
 
 
+@pytest.fixture()
+def two_engines():
+    with _spawn_engines(2) as pair:
+        yield pair
+
+
 MESSAGES = [{"role": "user", "content": "list numbers"}]
 # constrained output: ASCII JSON → the continuation prefix round-trips
 # byte-exact through the tokenizer, and validity is checkable at the end
@@ -84,33 +94,64 @@ GEN_KW = dict(max_tokens=220, temperature=0.0,
                                                "schema": SCHEMA}})
 
 
-def test_stream_survives_worker_kill(two_engines):
-    """The §5.3 contract: kill the serving worker mid-stream; the client's
-    iterator keeps going on the survivor, what was already streamed is
-    preserved exactly (no loss, no duplication), and the completed output
-    is ONE valid schema-conforming document (the engine re-walks the
-    grammar over the continuation prefix)."""
+def _kill_serving_mid_stream(ports, procs, live, max_tokens=None) -> bool:
+    """One failover exercise over the ``live`` worker indices: stream,
+    kill the serving worker after the first delta, drain the stream,
+    check the output contract, and drop the killed worker from ``live``.
+    Returns True when the kill genuinely forced a resume on a survivor,
+    False for the inconclusive race: under suite load the engine can
+    outrun the consumer, so the whole remaining stream already sits in
+    the client's kernel receive buffer at kill time and the iterator
+    completes without ever resubmitting — nothing failed over, nothing
+    to assert about. Metrics are compared as DELTAS so a worker that
+    served an earlier attempt does not read as this attempt's server."""
     from tests.test_constrained import validates
 
-    ports, procs = two_engines
-    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    urls = [f"http://127.0.0.1:{ports[i]}" for i in live]
     pool = FailoverLLM(urls, "tiny", cooldown_s=5.0)
+    before = {i: _metric(ports[i], "requests_submitted") for i in live}
     got = []
-    stream = pool.chat(MESSAGES, **GEN_KW)
+    gen_kw = dict(GEN_KW, **({"max_tokens": max_tokens} if max_tokens else {}))
+    stream = pool.chat(MESSAGES, **gen_kw)
     got.append(next(stream))
     prefix_at_kill = "".join(got)
-    serving = 0 if _metric(ports[0], "requests_submitted") >= 1 else 1
+    serving = next(i for i in live
+                   if _metric(ports[i], "requests_submitted") > before[i])
     os.killpg(procs[serving].pid, signal.SIGKILL)
-    for delta in stream:                     # must resume on the survivor
+    for delta in stream:                     # must resume on a survivor
         got.append(delta)
     text = "".join(got)
+    # the stream-correctness contract holds regardless of which race won
     assert text.startswith(prefix_at_kill)
     assert len(text) > len(prefix_at_kill), "no continuation after kill"
     value = json.loads(text)
     assert validates(value, SCHEMA), text
-    # and it really did fail over, not just survive locally
-    survivor = 1 - serving
-    assert _metric(ports[survivor], "requests_submitted") >= 1
+    live.remove(serving)
+    return any(_metric(ports[i], "requests_submitted") > before[i]
+               for i in live)
+
+
+def test_stream_survives_worker_kill():
+    """The §5.3 contract: kill the serving worker mid-stream; the client's
+    iterator keeps going on the survivor, what was already streamed is
+    preserved exactly (no loss, no duplication), and the completed output
+    is ONE valid schema-conforming document (the engine re-walks the
+    grammar over the continuation prefix).
+
+    Three workers boot up front (concurrently — no extra wall clock) so
+    an attempt voided by the buffered-completion race can retry on the
+    survivors at the cost of one more stream, never a re-spawn; the
+    tier-1 budget (870 s cap, ~830 s suite) has no room for a second
+    engine startup."""
+    with _spawn_engines(3) as (ports, procs):
+        live = list(range(3))
+        if _kill_serving_mid_stream(ports, procs, live):
+            return
+        # rare retry: a shorter stream keeps the extra wall-clock bounded
+        if _kill_serving_mid_stream(ports, procs, live, max_tokens=96):
+            return
+        pytest.fail("failover never exercised: the stream completed from "
+                    "the client's buffer before the kill landed, twice")
 
 
 def test_pool_retries_whole_request_when_worker_down(two_engines):

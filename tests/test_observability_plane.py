@@ -138,8 +138,8 @@ def test_flight_ring_strictly_bounded_and_windowed():
     rec.record(tokens_generated=100)
     time.sleep(0.02)
     s = rec.record(tokens_generated=150)
-    assert s["tok_s"] == pytest.approx(50 / (s["ts"] - rec.window()[0]["ts"]),
-                                       rel=1e-3)
+    assert s["tok_s"] == pytest.approx(
+        50 / (s["mono"] - rec.window()[0]["mono"]), rel=1e-3)
 
 
 def test_flight_time_gating_and_gauge_mirror():
@@ -341,3 +341,17 @@ def test_encoder_and_chain_servers_serve_prometheus():
             assert fl.status_code == 200 and "samples" in fl.json()
         finally:
             server.stop()
+
+
+def test_profiler_annotate_propagates_caller_errors():
+    """annotate() guards its own setup, not the caller's body: an
+    exception raised inside the with-block must surface unchanged (a
+    try spanning the yield used to swallow it and die with "generator
+    didn't stop after throw()")."""
+    from generativeaiexamples_tpu.observability.profiling import annotate
+
+    with pytest.raises(ValueError, match="real error"):
+        with annotate("span"):
+            raise ValueError("real error")
+    with annotate("span"):   # happy path still yields exactly once
+        pass

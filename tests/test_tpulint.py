@@ -1,0 +1,642 @@
+"""tpulint (generativeaiexamples_tpu/analysis): rule fixtures, suppression
+and baseline round-trips, CLI contract, and the package-wide self-check.
+
+Everything here is pure AST over in-memory snippets — no JAX, no servers,
+no compiles — so the whole module costs well under the 10 s budget the
+self-check is allowed inside tier-1.
+
+The self-check at the bottom is the enforcement point the whole subsystem
+exists for: the shipped tree must lint clean, so every future PR that
+introduces a TPU-serving hazard fails tier-1 until it is fixed, suppressed
+with a reason, or deliberately baselined.
+"""
+
+import json
+import os
+import textwrap
+import time
+
+import pytest
+
+import generativeaiexamples_tpu
+from generativeaiexamples_tpu.analysis import baseline as baseline_mod
+from generativeaiexamples_tpu.analysis.cli import main as cli_main
+from generativeaiexamples_tpu.analysis.engine import (
+    analyze_source, discover, run_paths)
+from generativeaiexamples_tpu.analysis.findings import Finding
+from generativeaiexamples_tpu.analysis.registry import RULES
+from generativeaiexamples_tpu.analysis.suppressions import Suppressions
+
+PKG_DIR = os.path.dirname(generativeaiexamples_tpu.__file__)
+
+
+def findings_for(src, only=None):
+    src = textwrap.dedent(src)
+    out = analyze_source("snippet.py", src,
+                         [RULES[only]] if only else None)
+    return out
+
+
+def rule_lines(src, rule):
+    return [f.line for f in findings_for(src, only=rule)]
+
+
+# ---------------------------------------------------------------------------
+# trace-hazard
+# ---------------------------------------------------------------------------
+
+def test_trace_hazard_fires_in_jitted_functions():
+    src = """
+    import jax, numpy as np
+
+    @jax.jit
+    def step(x):
+        y = x * 2
+        v = y.item()
+        h = np.asarray(y)
+        f = float(y)
+        return v, h, f
+    """
+    lines = rule_lines(src, "trace-hazard")
+    assert lines == [7, 8, 9]
+
+
+def test_trace_hazard_partial_jit_and_hot_path_marker():
+    src = """
+    from functools import partial
+    import jax
+
+    @partial(jax.jit, static_argnums=0)
+    def inner(n, x):
+        return x.tolist()
+
+    def tick(self):   # tpulint: hot-path
+        val = jax.device_get(self.state)
+        n = int(self.counter)        # host int is fine on the hot path
+        return val, n
+    """
+    fnd = findings_for(src, only="trace-hazard")
+    assert [f.line for f in fnd] == [7, 10]
+    assert "tolist" in fnd[0].message and "hot-path" in fnd[1].message
+
+
+def test_hot_path_marker_survives_decorators():
+    src = """
+    import functools, jax
+
+    @functools.wraps(tick)
+    def decorated(self):   # tpulint: hot-path
+        return self.state.item()
+
+    # tpulint: hot-path
+    @functools.wraps(tick)
+    def marked_above(self):
+        return jax.device_get(self.state)
+    """
+    assert rule_lines(src, "trace-hazard") == [6, 11]
+
+
+def test_trace_hazard_reaches_nested_helpers():
+    src = """
+    import jax
+
+    @jax.jit
+    def step(x):
+        def inner(y):
+            return y.item()      # runs under the SAME trace: flagged
+        return inner(x)
+
+    @jax.jit
+    def outer(x):
+        @jax.jit
+        def own_root(y):         # its own check root, not outer's
+            return y
+        return own_root(x)
+    """
+    assert rule_lines(src, "trace-hazard") == [7]
+
+
+def test_finding_paths_are_cwd_independent(monkeypatch, tmp_path):
+    from generativeaiexamples_tpu.analysis.engine import _rel
+    p = os.path.join(PKG_DIR, "core", "config.py")
+    here = _rel(p)
+    monkeypatch.chdir(tmp_path)
+    assert _rel(p) == here
+    assert here.endswith("generativeaiexamples_tpu/core/config.py")
+    assert not here.startswith("/")
+
+
+def test_trace_hazard_clean_outside_jit():
+    src = """
+    import numpy as np
+
+    def host_side(x):
+        return float(np.asarray(x).sum())   # plain host code: fine
+    """
+    assert findings_for(src, only="trace-hazard") == []
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard
+# ---------------------------------------------------------------------------
+
+def test_recompile_hazard_fires_inside_comprehensions():
+    src = """
+    import jax
+
+    def run(batches):
+        return [jax.jit(step)(b) for b in batches]   # compile per batch
+    """
+    assert rule_lines(src, "recompile-hazard") == [5]
+
+
+def test_recompile_hazard_fires_inside_loops_only():
+    src = """
+    import jax
+
+    step = jax.jit(lambda x: x + 1)          # module level: fine
+
+    def serve(batches):
+        for b in batches:
+            f = jax.jit(lambda x: x * 2)     # per-iteration compile: bad
+            yield f(b)
+
+    def build():
+        # a def inside a loop re-binds per call, not per iteration
+        return jax.jit(lambda x: x - 1)
+    """
+    assert rule_lines(src, "recompile-hazard") == [8]
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+def test_lock_discipline_flags_blocking_under_lock():
+    src = """
+    import time, requests
+
+    def flush(self):
+        with self._lock:
+            time.sleep(0.1)
+            resp = requests.post(self.url, json={}, timeout=5)
+            val = self._future.result()
+        return resp, val
+    """
+    fnd = findings_for(src, only="lock-discipline")
+    assert [f.line for f in fnd] == [6, 7, 8]
+    assert "self._lock" in fnd[0].message
+
+
+def test_lock_discipline_ignores_clock_and_blocker_names():
+    src = """
+    import time
+
+    def advance(self):
+        with self.clock:                    # a fake clock, not a lock
+            time.sleep(0.01)
+        with self.blocker:                  # 'lock' substring is not enough
+            time.sleep(0.01)
+        with self.cache_lock:               # segment match: a real lock
+            time.sleep(0.01)
+    """
+    fnd = findings_for(src, only="lock-discipline")
+    assert [f.line for f in fnd] == [10]
+    assert "cache_lock" in fnd[0].message
+
+
+def test_lock_discipline_allows_cv_wait_and_closures():
+    src = """
+    import time
+
+    def take(self):
+        with self._cv:
+            while not self._queue:
+                self._cv.wait(timeout=0.1)      # releases the lock: fine
+
+    def defer(self):
+        with self.lock:
+            def later():
+                time.sleep(1)                   # runs elsewhere: fine
+            self.cb = later
+    """
+    assert findings_for(src, only="lock-discipline") == []
+
+
+# ---------------------------------------------------------------------------
+# clock-discipline
+# ---------------------------------------------------------------------------
+
+def test_clock_discipline_flags_arithmetic_not_timestamps():
+    src = """
+    import time
+
+    def sample(self):
+        if time.time() - self._last < self.interval:     # interval: bad
+            return None
+        cutoff = time.time() - 30.0                      # window: bad
+        return {"created": int(time.time())}             # timestamp: fine
+    """
+    assert rule_lines(src, "clock-discipline") == [5, 7]
+
+
+def test_clock_discipline_clean_with_monotonic():
+    src = """
+    import time
+
+    def sample(self):
+        if time.monotonic() - self._last < self.interval:
+            return None
+        return {"ts": time.time()}
+    """
+    assert findings_for(src, only="clock-discipline") == []
+
+
+# ---------------------------------------------------------------------------
+# net-timeout
+# ---------------------------------------------------------------------------
+
+def test_net_timeout_flags_untimed_calls():
+    src = """
+    import requests, httpx
+    import urllib.request
+
+    def fetch(url):
+        a = requests.get(url)                            # bad
+        b = requests.post(url, json={}, timeout=5)       # fine
+        c = urllib.request.urlopen(url)                  # bad
+        d = urllib.request.urlopen(url, None, 10)        # positional: fine
+        with httpx.stream("GET", url) as resp:           # bad
+            pass
+        return a, b, c, d
+    """
+    assert rule_lines(src, "net-timeout") == [6, 8, 10]
+
+
+# ---------------------------------------------------------------------------
+# except-swallow
+# ---------------------------------------------------------------------------
+
+def test_except_swallow_fires_on_silent_broad_handlers():
+    src = """
+    def load(path):
+        try:
+            return open(path).read()
+        except Exception:
+            return None
+    """
+    assert rule_lines(src, "except-swallow") == [5]
+
+
+def test_except_swallow_accepts_log_metric_raise_and_counter():
+    src = """
+    import logging
+    logger = logging.getLogger(__name__)
+
+    def a():
+        try:
+            work()
+        except Exception:
+            logger.exception("work failed")
+
+    def b(self):
+        try:
+            work()
+        except Exception:
+            REGISTRY.counter("errors_total").inc()
+
+    def c(self):
+        try:
+            work()
+        except Exception as exc:
+            self.stats.errors += 1
+
+    def d():
+        try:
+            work()
+        except ValueError:        # narrow: not this rule's business
+            pass
+    """
+    assert findings_for(src, only="except-swallow") == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+def test_suppression_same_line_and_standalone_above():
+    src = textwrap.dedent("""
+    import requests
+
+    def probe(url):
+        a = requests.get(url)  # tpulint: disable=net-timeout -- probe stub
+        # tpulint: disable=net-timeout -- reason spanning
+        # a second comment line
+        b = requests.get(url)
+        c = requests.get(url)          # NOT suppressed
+        return a, b, c
+    """)
+    raw = analyze_source("s.py", src, [RULES["net-timeout"]])
+    kept, n_supp = Suppressions(src).split(raw)
+    assert n_supp == 2
+    assert [f.line for f in kept] == [9]
+
+
+def test_suppression_trailing_on_multiline_statement():
+    # the finding anchors to the first line of the call; the comment sits
+    # on the closing line — it must still suppress
+    src = textwrap.dedent("""
+    import requests
+
+    def probe(url):
+        return requests.get(
+            url,
+        )  # tpulint: disable=net-timeout -- wrapped call, bounded by caller
+    """)
+    raw = analyze_source("s.py", src, [RULES["net-timeout"]])
+    assert [f.line for f in raw] == [5]
+    kept, n_supp = Suppressions(src).split(raw)
+    assert kept == [] and n_supp == 1
+
+
+def test_suppression_trailing_covers_continuation_lines():
+    # the finding anchors to the nested call's own (continuation) line;
+    # the trailing comment must cover the whole wrapped statement
+    src = textwrap.dedent("""
+    import requests
+
+    def probe(url, wrap):
+        return wrap(
+            requests.get(url),
+        )  # tpulint: disable=net-timeout -- wrapped call, caller bounds it
+    """)
+    raw = analyze_source("s.py", src, [RULES["net-timeout"]])
+    assert [f.line for f in raw] == [6]
+    kept, n_supp = Suppressions(src).split(raw)
+    assert kept == [] and n_supp == 1
+
+
+def test_suppression_standalone_inside_wrapped_statement():
+    # the finding anchors to the nested call's continuation line AFTER the
+    # comment; next-code-line semantics must hold inside an open statement
+    src = textwrap.dedent("""
+    import requests
+
+    def probe(url, wrap):
+        return wrap(
+            # tpulint: disable=net-timeout -- nested call below
+            requests.get(url),
+        )
+    """)
+    raw = analyze_source("s.py", src, [RULES["net-timeout"]])
+    assert [f.line for f in raw] == [7]
+    kept, n_supp = Suppressions(src).split(raw)
+    assert kept == [] and n_supp == 1
+
+
+def test_suppression_standalone_skips_blank_lines():
+    src = textwrap.dedent("""
+    import requests
+
+    def probe(url):
+        # tpulint: disable=net-timeout -- suppression survives a blank line
+
+        return requests.get(url)
+    """)
+    raw = analyze_source("s.py", src, [RULES["net-timeout"]])
+    kept, n_supp = Suppressions(src).split(raw)
+    assert kept == [] and n_supp == 1
+
+
+def test_suppression_file_wide_and_docstrings_inert():
+    src = textwrap.dedent('''
+    # tpulint: disable-file=net-timeout
+    import requests
+
+    def probe(url):
+        """Example in a docstring is not a comment:
+
+            x = requests.get(url)  # tpulint: disable=except-swallow
+        """
+        return requests.get(url)
+    ''')
+    raw = analyze_source("s.py", src, [RULES["net-timeout"]])
+    kept, n_supp = Suppressions(src).split(raw)
+    assert kept == [] and n_supp == 1
+    # the docstring "suppression" must not register anywhere
+    assert not Suppressions(src).by_line
+
+
+def test_unknown_suppression_is_reported(tmp_path):
+    bad = tmp_path / "mod.py"
+    bad.write_text("x = 1  # tpulint: disable=no-such-rule\n")
+    report = run_paths([str(tmp_path)], baseline_path=None)
+    assert not report.clean
+    assert any("no-such-rule" in msg for msg in report.unknown_suppressions)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def test_baseline_round_trip_absorbs_only_grandfathered(tmp_path):
+    mod = tmp_path / "legacy.py"
+    mod.write_text(textwrap.dedent("""
+        import requests
+
+        def old(url):
+            return requests.get(url)
+    """))
+    bl = tmp_path / "baseline.json"
+
+    # grandfather the current state
+    report = run_paths([str(mod)], baseline_path=None)
+    assert len(report.findings) == 1
+    baseline_mod.save(str(bl), report.findings)
+    loaded = baseline_mod.load(str(bl))
+    assert sum(loaded.values()) == 1
+
+    # baselined run is clean...
+    assert run_paths([str(mod)], baseline_path=str(bl)).clean
+
+    # ...a NEW finding still fails, even with the baseline applied
+    mod.write_text(mod.read_text()
+                   + "\n\ndef new(url):\n    return requests.post(url)\n")
+    report = run_paths([str(mod)], baseline_path=str(bl))
+    assert len(report.findings) == 1
+    assert report.baselined == 1
+    assert "requests.post" in report.findings[0].message
+
+
+def test_malformed_baseline_is_usage_error_not_traceback(tmp_path, capsys):
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps(
+        {"version": 1, "findings": [{"rule": "net-timeout", "file": "a.py"}]}))
+    with pytest.raises(ValueError):
+        baseline_mod.load(str(bl))
+    mod = tmp_path / "m.py"
+    mod.write_text("x = 1\n")
+    assert cli_main([str(mod), "--baseline", str(bl)]) == 2
+    capsys.readouterr()
+
+
+def test_baseline_key_survives_line_shifts():
+    f1 = Finding("a.py", 10, "net-timeout", "error", "msg")
+    f2 = Finding("a.py", 99, "net-timeout", "error", "msg")
+    assert f1.baseline_key() == f2.baseline_key()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_json_output_and_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import requests\nx = requests.get('http://x')\n")
+
+    rc = cli_main([str(bad), "--json", "--no-baseline"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["summary"]["findings"] == 1
+    f = out["findings"][0]
+    assert f["rule"] == "net-timeout" and f["line"] == 2
+
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    assert cli_main([str(good), "--no-baseline"]) == 0
+    capsys.readouterr()
+
+    assert cli_main(["--only", "bogus-rule", str(good)]) == 2
+
+
+def test_cli_refuses_unscanned_tree_as_clean(tmp_path, capsys):
+    # a typo'd path must not exit 0 "clean"
+    assert cli_main([str(tmp_path / "no_such_dir"), "--no-baseline"]) == 2
+    # ... and neither must an existing dir with nothing to lint
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert cli_main([str(empty), "--no-baseline"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_write_baseline_rejects_rule_filters(tmp_path, capsys):
+    # a filtered --write-baseline would drop other rules' baseline entries
+    mod = tmp_path / "m.py"
+    mod.write_text("x = 1\n")
+    assert cli_main([str(mod), "--only", "net-timeout",
+                     "--write-baseline"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_write_baseline_refuses_parse_errors(tmp_path, capsys):
+    (tmp_path / "broken.py").write_text("def nope(:\n")
+    bl = tmp_path / "bl.json"
+    assert cli_main([str(tmp_path), "--baseline", str(bl),
+                     "--write-baseline"]) == 1
+    assert not bl.exists()    # an unparseable tree is never "clean"
+    assert "parse" in capsys.readouterr().err
+
+
+def test_cli_default_target_is_cwd_independent(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    assert cli_main([]) == 0          # lints the installed package itself
+    out = capsys.readouterr().out
+    assert "0 file(s) scanned" not in out and "clean" in out
+
+
+def test_cli_write_baseline_refuses_unknown_suppressions(tmp_path, capsys):
+    mod = tmp_path / "m.py"
+    mod.write_text("import requests\n"
+                   "x = requests.get('u')  # tpulint: disable=net-timout\n")
+    bl = tmp_path / "bl.json"
+    assert cli_main([str(mod), "--baseline", str(bl),
+                     "--write-baseline"]) == 1
+    assert not bl.exists()    # nothing grandfathered past the typo
+    assert "net-timout" in capsys.readouterr().err
+
+
+def test_cli_write_baseline_then_clean(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import requests\nx = requests.get('http://x')\n")
+    bl = tmp_path / "bl.json"
+
+    assert cli_main([str(bad), "--baseline", str(bl),
+                     "--write-baseline"]) == 0
+    assert cli_main([str(bad), "--baseline", str(bl)]) == 0
+    capsys.readouterr()
+
+
+def test_cli_write_baseline_partial_paths_keeps_other_files(tmp_path, capsys):
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text("import requests\nx = requests.get('http://a')\n")
+    b.write_text("import requests\nx = requests.get('http://b')\n")
+    bl = tmp_path / "bl.json"
+
+    # grandfather both files, then re-write the baseline scanning only a.py:
+    # b.py's entry must survive the partial-path write
+    assert cli_main([str(tmp_path), "--baseline", str(bl),
+                     "--write-baseline"]) == 0
+    assert cli_main([str(a), "--baseline", str(bl),
+                     "--write-baseline"]) == 0
+    files = {key[1] for key in baseline_mod.load(str(bl))}
+    assert {os.path.basename(f) for f in files} == {"a.py", "b.py"}
+    assert cli_main([str(tmp_path), "--baseline", str(bl)]) == 0
+    capsys.readouterr()
+
+
+def test_cli_list_rules_covers_registry(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for name in RULES:
+        assert name in out
+
+
+def test_parse_error_is_a_finding():
+    fnd = analyze_source("broken.py", "def nope(:\n")
+    assert [f.rule for f in fnd] == ["parse-error"]
+
+
+# ---------------------------------------------------------------------------
+# package-wide self-check — the tier-1 gate
+# ---------------------------------------------------------------------------
+
+def test_every_registered_rule_has_a_firing_fixture():
+    """Meta-test: a rule nobody can trigger is dead weight. Every rule in
+    the registry must fire on at least one snippet in this module's
+    fixtures (parse-error is exercised separately above)."""
+    fired = set()
+    snippets = [
+        "import jax\n@jax.jit\ndef f(x):\n    return x.item()\n",
+        "import jax\nfor i in range(2):\n    f = jax.jit(lambda x: x)\n",
+        "import time\ndef f(self):\n    with self._lock:\n"
+        "        time.sleep(1)\n",
+        "import time\nd = time.time() - 1.0\n",
+        "import requests\nx = requests.get('u')\n",
+        "try:\n    pass\nexcept Exception:\n    pass\n",
+    ]
+    for src in snippets:
+        fired |= {f.rule for f in analyze_source("s.py", src)}
+    assert fired == set(RULES)
+
+
+def test_package_lints_clean_inside_budget():
+    """THE self-check: zero unsuppressed, non-baselined findings over the
+    whole shipped package, in well under the 10 s budget."""
+    t0 = time.monotonic()
+    report = run_paths([PKG_DIR])
+    elapsed = time.monotonic() - t0
+    assert report.findings == [], (
+        "tpulint found new hazards:\n"
+        + "\n".join(f.render() for f in report.findings))
+    assert report.unknown_suppressions == []
+    assert report.files_scanned > 100          # really scanned the tree
+    assert elapsed < 10.0, f"self-check took {elapsed:.1f}s (budget 10s)"
+
+
+def test_discover_skips_caches(tmp_path):
+    (tmp_path / "__pycache__").mkdir()
+    (tmp_path / "__pycache__" / "junk.py").write_text("x=1\n")
+    (tmp_path / "real.py").write_text("x=1\n")
+    found = [os.path.basename(p) for p in discover([str(tmp_path)])]
+    assert found == ["real.py"]
