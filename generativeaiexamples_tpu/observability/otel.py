@@ -260,8 +260,11 @@ class OTLPHTTPSpanExporter(SpanExporter):
                 pass
             if self._stop.is_set():
                 # deterministic final drain: collect EVERYTHING already
-                # queued, post once, exit — never returns with spans that
-                # were export()ed before shutdown() still unsent
+                # queued, post it, exit — never returns with spans that
+                # were export()ed before shutdown() still unsent. Posted in
+                # _batch_size chunks: a busy process can shut down with a
+                # full flush interval of backlog, and one giant request
+                # would trip collector request-size limits and drop it all
                 while True:
                     try:
                         item = self._q.get_nowait()
@@ -269,8 +272,12 @@ class OTLPHTTPSpanExporter(SpanExporter):
                         break
                     if item is not None:
                         batch.append(item)
-                if batch:
-                    self._post(batch)
+                for i in range(0, len(batch), self._batch_size):
+                    if not self._post(batch[i:i + self._batch_size]):
+                        # a dead endpoint fails every later chunk too —
+                        # stop rather than serialize a 5 s timeout per
+                        # chunk past shutdown()'s join budget
+                        break
                 return
             if (len(batch) >= self._batch_size
                     or time.monotonic() >= deadline) and batch:
@@ -279,7 +286,7 @@ class OTLPHTTPSpanExporter(SpanExporter):
             if time.monotonic() >= deadline:
                 deadline = time.monotonic() + self._interval
 
-    def _post(self, batch: List[Span]) -> None:
+    def _post(self, batch: List[Span]) -> bool:
         import urllib.error
         import urllib.request
         req = urllib.request.Request(
@@ -289,6 +296,7 @@ class OTLPHTTPSpanExporter(SpanExporter):
             with urllib.request.urlopen(req, timeout=5):
                 pass
             self._warned = False
+            return True
         except (urllib.error.URLError, OSError) as exc:
             if not self._warned:   # one warning per outage, not per batch
                 import logging
@@ -296,6 +304,7 @@ class OTLPHTTPSpanExporter(SpanExporter):
                     "OTLP export to %s failed (%s); dropping spans until "
                     "the collector returns", self._url, exc)
                 self._warned = True
+            return False
 
 
 _exporter: SpanExporter = ConsoleSpanExporter()

@@ -125,6 +125,31 @@ def test_otlp_shutdown_drains_final_batch(collector):
     assert names == {f"drain{i}" for i in range(n)}
 
 
+def test_otlp_shutdown_drain_chunks_to_batch_size(collector):
+    """A busy process can shut down with more backlog than one request
+    should carry — the final drain must post in batch_size chunks, not one
+    giant request a collector's size limit would reject wholesale."""
+    endpoint, received = collector
+    exp = otel.OTLPHTTPSpanExporter(endpoint=endpoint, flush_interval_s=30.0,
+                                    batch_size=10)
+    n = 25
+    for i in range(n):
+        exp.export(otel.Span(name=f"chunk{i}", trace_id="b" * 32,
+                             span_id=f"{i:016x}"))
+    exp.shutdown()
+    names = {s["name"]
+             for _, body in received
+             for rs in body["resourceSpans"]
+             for ss in rs["scopeSpans"]
+             for s in ss["spans"]}
+    assert names == {f"chunk{i}" for i in range(n)}
+    per_post = [len(ss["spans"])
+                for _, body in received
+                for rs in body["resourceSpans"]
+                for ss in rs["scopeSpans"]]
+    assert max(per_post) <= 10 and len(per_post) >= 3
+
+
 def test_otlp_export_survives_dead_collector():
     exp = otel.OTLPHTTPSpanExporter(endpoint="http://127.0.0.1:1",
                                     flush_interval_s=0.1)
