@@ -368,7 +368,12 @@ def main() -> None:
                             spec_decode="on" if spec_draft else "off",
                             spec_draft=max(spec_draft, 0) or 1)
         lat_prompts = [480] * 12 + [1200] * 4          # = slot count
-        thr_prompts = [480] * 20 + [1200] * 6 + [96] * 6   # 2x slots
+        # throughput mix tagged with SLO classes (observability/slo.py):
+        # chat-shaped prompts are interactive, the long bulk prompts are
+        # batch — goodput below is SLO-ATTAINED req/s per class, the
+        # NinjaLLM-style headline next to raw tok/s
+        thr_prompts = ([(480, "interactive")] * 20 + [(1200, "batch")] * 6
+                       + [(96, "interactive")] * 6)   # 2x slots
         max_tokens, warm_lens = 96, (128, 480, 1200)
     else:
         model_cfg = llama.LlamaConfig.tiny(vocab_size=300)
@@ -377,7 +382,7 @@ def main() -> None:
         ecfg = EngineConfig(max_batch_size=4, max_seq_len=512,
                             page_size=16, prefill_chunk=32, quant=quant)
         lat_prompts = [24] * 4
-        thr_prompts = [24] * 6 + [70] * 2
+        thr_prompts = [(24, "interactive")] * 6 + [(70, "batch")] * 2
         max_tokens, warm_lens = 8, (24, 70)
 
     # -- LoRA fine-tuning throughput (BASELINE's second metric: tok/s/chip)
@@ -410,14 +415,15 @@ def main() -> None:
     _PREFIX = [32 + (i * 7) % 90 for i in range(2 * ecfg.page_size)]
     _req_counter = [0]
 
-    def make_req(n_prompt: int) -> Request:
+    def make_req(n_prompt: int, slo_class: str = "interactive") -> Request:
         import random as _rnd
         _req_counter[0] += 1
         body_rng = _rnd.Random(10_000 + _req_counter[0])
         n_body = max(1, n_prompt - len(_PREFIX))
         ids = (_PREFIX[:max(0, n_prompt - n_body)]
                + [32 + body_rng.randrange(90) for _ in range(n_body)])
-        return Request(prompt_ids=ids, max_tokens=max_tokens, temperature=0.0)
+        return Request(prompt_ids=ids, max_tokens=max_tokens,
+                       temperature=0.0, slo_class=slo_class)
 
     # warm the end-to-end request path (prefill/decode interleave, sampler,
     # detokenizer) — programs are already compiled by core.warmup()
@@ -454,7 +460,7 @@ def main() -> None:
     # not the single uptime-average the bench used to hand-derive
     FLIGHT.interval_s = min(FLIGHT.interval_s, 0.02)
     thr_t0 = time.time()
-    thr_reqs = [make_req(n) for n in thr_prompts]
+    thr_reqs = [make_req(n, cls) for n, cls in thr_prompts]
     wall = _run_load(sched, thr_reqs)
     thr_flight = [s for s in FLIGHT.window() if s["ts"] >= thr_t0]
     # snapshot BEFORE the RAG phase: its decode traffic must not leak into
@@ -483,6 +489,20 @@ def main() -> None:
                           "unit": "error", "vs_baseline": 0,
                           "errors": errors[:3]}))
         sys.exit(1)
+
+    # per-class goodput: SLO-ATTAINED requests per second of the throughput
+    # phase, plus the attainment fraction (the scheduler judged each request
+    # at finish — observability/slo.py stamped the verdict on r.slo)
+    by_cls: dict = {}
+    for r in thr_reqs:
+        by_cls.setdefault(r.slo_class, []).append(r)
+    slo_goodput = {}
+    slo_attainment = {}
+    for cls, rs in sorted(by_cls.items()):
+        attained = sum(1 for r in rs
+                       if (r.slo or {}).get("outcome") == "attained")
+        slo_goodput[cls] = round(attained / wall, 2)
+        slo_attainment[cls] = round(attained / len(rs), 4)
 
     phase_p50s = sorted(
         statistics.median(r.first_token_at - r.submitted_at for r in reqs)
@@ -546,6 +566,11 @@ def main() -> None:
         "ttft_max_s": round(ttfts[-1], 4),
         "ttft_p50_per_phase": [round(p, 4) for p in phase_p50s],
         "gen_tok_s_2x_load": round(tok_s, 1),
+        # SLO goodput (throughput phase): attained req/s and attainment
+        # fraction per declared class — raw tok/s that misses its budgets
+        # is not serving capacity
+        "slo_goodput_req_s": slo_goodput,
+        "slo_attainment": slo_attainment,
         "rag_req_s": round(rag_req_s, 2),
         "rag_e2e_p50_s": round(rag_p50, 3),
         **rag_enc,

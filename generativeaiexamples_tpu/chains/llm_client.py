@@ -15,6 +15,7 @@ from functools import lru_cache
 from typing import Dict, Iterator, Optional, Sequence
 
 from generativeaiexamples_tpu.core.config import get_config
+from generativeaiexamples_tpu.observability import slo as slo_mod
 
 logger = logging.getLogger(__name__)
 
@@ -103,8 +104,12 @@ class RemoteLLM:
                    "top_p": top_p, "stream": True}
         if stop:
             payload["stop"] = list(stop)
+        # SLO class + remaining deadline + traceparent ride every engine
+        # call (observability/slo.py): the engine judges attainment against
+        # the budget the CHAIN admitted the request under, not a default
         with httpx.stream("POST", f"{self.base_url}/v1/chat/completions",
-                          json=payload, timeout=120.0) as resp:
+                          json=payload, timeout=120.0,
+                          headers=slo_mod.outbound_headers()) as resp:
             for line in resp.iter_lines():
                 if not line.startswith("data: "):
                     continue
@@ -136,7 +141,8 @@ class RemoteLLM:
             payload["tools"] = list(tools)
             payload["tool_choice"] = tool_choice
         resp = httpx.post(f"{self.base_url}/v1/chat/completions",
-                          json=payload, timeout=120.0)
+                          json=payload, timeout=120.0,
+                          headers=slo_mod.outbound_headers())
         resp.raise_for_status()
         data = resp.json()
         return data["choices"][0]["message"]

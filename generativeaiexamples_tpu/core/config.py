@@ -263,6 +263,61 @@ class EngineConfig:
 
 
 @dataclass(frozen=True)
+class SLOInteractiveConfig:
+    """Budgets for the ``interactive`` SLO class (chat-facing traffic — the
+    BASELINE.md <1 s TTFT north star lives here)."""
+
+    ttft_s: float = configfield("ttft_s", default=1.0, help_txt="Time-to-first-token budget (s) for interactive requests.")
+    tpot_s: float = configfield("tpot_s", default=0.25, help_txt="Time-per-output-token budget (s) — streaming cadence after the first token.")
+    e2e_s: float = configfield("e2e_s", default=30.0, help_txt="End-to-end deadline (s) stamped at chain-server admission.")
+    sheddable: bool = configfield("sheddable", default=False, help_txt="May the scheduler shed this class under critical SLO pressure?")
+
+
+@dataclass(frozen=True)
+class SLOBatchConfig:
+    """Budgets for the ``batch`` SLO class (offline-ish bulk work: eval
+    runs, SDG, ingestion summarization)."""
+
+    ttft_s: float = configfield("ttft_s", default=10.0, help_txt="Time-to-first-token budget (s) for batch requests.")
+    tpot_s: float = configfield("tpot_s", default=1.0, help_txt="Time-per-output-token budget (s) for batch requests.")
+    e2e_s: float = configfield("e2e_s", default=300.0, help_txt="End-to-end deadline (s) for batch requests.")
+    sheddable: bool = configfield("sheddable", default=False, help_txt="May the scheduler shed this class under critical SLO pressure?")
+
+
+@dataclass(frozen=True)
+class SLOBestEffortConfig:
+    """Budgets for the ``best_effort`` SLO class: the load-shedding valve.
+    Under critical error-budget burn the scheduler rejects these at
+    admission so interactive traffic keeps its budgets."""
+
+    ttft_s: float = configfield("ttft_s", default=30.0, help_txt="Time-to-first-token budget (s) for best-effort requests.")
+    tpot_s: float = configfield("tpot_s", default=2.0, help_txt="Time-per-output-token budget (s) for best-effort requests.")
+    e2e_s: float = configfield("e2e_s", default=600.0, help_txt="End-to-end deadline (s) for best-effort requests.")
+    sheddable: bool = configfield("sheddable", default=True, help_txt="May the scheduler shed this class under critical SLO pressure?")
+
+
+@dataclass(frozen=True)
+class SLOConfig:
+    """Serving objectives + burn-rate alerting (observability/slo.py).
+
+    Attainment target and the Google-SRE-style paired burn-rate windows: a
+    pressure level fires only when BOTH the fast and the slow window burn
+    past a threshold — the fast window reacts to new incidents, the slow
+    window keeps one latency blip from paging."""
+
+    default_class: str = configfield("default_class", default="interactive", help_txt="SLO class assumed when a request carries no X-Request-Class.")
+    target: float = configfield("target", default=0.99, help_txt="Attainment objective per class (0.99 = 1% error budget).")
+    fast_window_s: float = configfield("fast_window_s", default=300.0, help_txt="Fast burn-rate window (s) — reacts to new incidents.")
+    slow_window_s: float = configfield("slow_window_s", default=3600.0, help_txt="Slow burn-rate window (s) — confirms the incident is sustained.")
+    warn_burn: float = configfield("warn_burn", default=2.0, help_txt="Burn-rate threshold (x error budget) both windows must exceed for pressure=warn.")
+    critical_burn: float = configfield("critical_burn", default=10.0, help_txt="Burn-rate threshold both windows must exceed for pressure=critical (sheds best_effort).")
+    min_events: int = configfield("min_events", default=10, help_txt="Minimum finished requests in the fast window before pressure can leave ok (no paging on 2 requests).")
+    interactive: SLOInteractiveConfig = configfield("interactive", default_factory=SLOInteractiveConfig, help_txt="Interactive-class budgets.")
+    batch: SLOBatchConfig = configfield("batch", default_factory=SLOBatchConfig, help_txt="Batch-class budgets.")
+    best_effort: SLOBestEffortConfig = configfield("best_effort", default_factory=SLOBestEffortConfig, help_txt="Best-effort-class budgets.")
+
+
+@dataclass(frozen=True)
 class AppConfig:
     """Top-level app configuration (ref: configuration.py:166-204)."""
 
@@ -273,6 +328,7 @@ class AppConfig:
     ranking: RankingConfig = configfield("ranking", default_factory=RankingConfig, help_txt="Reranker.")
     retriever: RetrieverConfig = configfield("retriever", default_factory=RetrieverConfig, help_txt="Retriever.")
     engine: EngineConfig = configfield("engine", default_factory=EngineConfig, help_txt="TPU engine.")
+    slo: SLOConfig = configfield("slo", default_factory=SLOConfig, help_txt="Serving SLOs + burn-rate alerting.")
 
 
 # ---------------------------------------------------------------------------

@@ -129,14 +129,29 @@ class Histogram:
         self._dirty = False
         self._count = 0
         self._sum = 0.0
+        self._exemplar: Optional[Tuple[Dict[str, str], float, float]] = None
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[Mapping[str, str]] = None) -> None:
         with self._lock:
             self._count += 1
             self._sum += value
             self._ring.append(value)      # maxlen evicts the oldest
             self._dirty = True
+            if exemplar:
+                # newest exemplar wins: the point is "show me A trace for
+                # this series", and recency beats any sampling scheme for
+                # an incident drill-down (wall ts: exemplar timestamps are
+                # reported instants, not interval math)
+                self._exemplar = (dict(exemplar), float(value), time.time())
+
+    @property
+    def exemplar(self) -> Optional[Tuple[Dict[str, str], float, float]]:
+        """(labels, value, unix_ts) of the newest exemplar-carrying
+        observation (e.g. ``{"trace_id": ...}`` on the SLO path)."""
+        with self._lock:
+            return self._exemplar
 
     def percentile(self, q: float) -> float:
         with self._lock:
@@ -247,8 +262,14 @@ class MetricsRegistry:
             }
         return out
 
-    def render_prometheus(self) -> str:
-        """Prometheus text exposition format 0.0.4.
+    def render_prometheus(self, openmetrics: bool = False) -> str:
+        """Prometheus text exposition format 0.0.4 — or, with
+        ``openmetrics=True``, OpenMetrics 1.0 (``# EOF`` terminator and
+        **exemplars**: a histogram observed with ``exemplar={"trace_id":
+        ...}`` renders ``… # {trace_id="…"} <value> <ts>`` on its
+        ``_count`` sample, so a latency series links to a concrete trace).
+        Exemplars are OpenMetrics-only: format 0.0.4 parsers reject the
+        ``#`` suffix, and existing scrapers keep byte-stable output.
 
         Counters/gauges render as single samples per labeled series;
         histograms render summary-style — ``name{quantile="0.5"}`` exact
@@ -286,8 +307,19 @@ class MetricsRegistry:
                 qkey = lk + (("quantile", f"{q}"),)
                 lines.append(
                     f"{_render_name(pname, qkey)} {h.percentile(q * 100)}")
-            lines.append(f"{_render_name(pname + '_count', lk)} {h.count}")
+            count_line = f"{_render_name(pname + '_count', lk)} {h.count}"
+            if openmetrics:
+                ex = h.exemplar
+                if ex is not None:
+                    ex_labels, ex_value, ex_ts = ex
+                    inner = ",".join(f'{k}="{_escape_label(str(v))}"'
+                                     for k, v in sorted(ex_labels.items()))
+                    count_line += (f" # {{{inner}}} {ex_value} "
+                                   f"{ex_ts:.3f}")
+            lines.append(count_line)
             lines.append(f"{_render_name(pname + '_sum', lk)} {h.sum}")
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
 

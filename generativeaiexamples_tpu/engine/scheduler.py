@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.observability import slo as slo_mod
 from generativeaiexamples_tpu.observability.flight import FLIGHT, REQUEST_LOG
 from generativeaiexamples_tpu.engine.engine import (
     DecodeState, EngineCore, bits_to_f32, unpack_decode_out)
@@ -120,6 +121,18 @@ class Request:
     # token-level-valid output to a streaming client (engine/server.py
     # falls back to its buffered extract path when it isn't True).
     grammar_attached: Optional[bool] = None
+    # SLO plane (observability/slo.py): the request's serving class (empty
+    # = config default, stamped at submit), its remaining end-to-end
+    # budget in seconds (NOT an absolute instant — propagated across
+    # processes as remaining-ms, so clocks never need to agree), the W3C
+    # trace id exemplars/breach records link on, and the post-finish
+    # judgment (slo_outcome is a scheduler preset — "shed" — that
+    # overrides judging; slo is the full verdict dict).
+    slo_class: str = ""
+    deadline_s: Optional[float] = None
+    trace_id: str = ""
+    slo_outcome: Optional[str] = None
+    slo: Optional[dict] = None
     request_id: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
     # filled by the scheduler:
     out_queue: "queue.Queue" = field(default_factory=queue.Queue)
@@ -284,6 +297,12 @@ class Scheduler:
             # deterministically instead of letting np.int32 raise mid-tick
             # (which would fail every in-flight request via _fail_all)
             request.seed = int(request.seed) & 0x7FFFFFFF
+        # resolve the SLO class + deadline now (explicit fields win; else
+        # the ambient admission context; else the config default with its
+        # full e2e budget) — judging at finish needs both
+        slo_mod.stamp_request(request,
+                              slo_class=request.slo_class or None,
+                              deadline_s=request.deadline_s)
         job = _Job(request=request,
                    detok=IncrementalDetokenizer(self.tokenizer),
                    ids=list(request.prompt_ids))
@@ -333,6 +352,7 @@ class Scheduler:
             REGISTRY.counter("requests_failed").inc()
             REGISTRY.counter("requests_finished",
                              labels={"finish": "error"}).inc()
+            slo_mod.SLO.observe(job.request)
             REQUEST_LOG.record(job.request)
             job.request.out_queue.put(_STOP)
             job.pages = []
@@ -393,6 +413,10 @@ class Scheduler:
                          ).inc()
         REGISTRY.histogram("request_latency_s").observe(
             req.finished_at - req.submitted_at)
+        # judge SLO attainment BEFORE the log write and the stream release:
+        # the /debug/requests timeline and the breach record a client can
+        # fetch right after [DONE] already carry the verdict
+        slo_mod.SLO.observe(req)
         REQUEST_LOG.record(req)
         req.out_queue.put(_STOP)
         # decode-written pages join the prefix cache before release: a
@@ -406,6 +430,7 @@ class Scheduler:
         job.request.finished_at = time.perf_counter()
         REGISTRY.counter("requests_failed").inc()
         REGISTRY.counter("requests_finished", labels={"finish": "error"}).inc()
+        slo_mod.SLO.observe(job.request)
         REQUEST_LOG.record(job.request)
         job.request.out_queue.put(_STOP)
 
@@ -509,6 +534,32 @@ class Scheduler:
         if n_full > 0:
             self._alloc.insert(job.page_hashes[:n_full], job.pages[:n_full])
 
+    def _shed_pending(self) -> None:
+        """Load shedding under critical error-budget burn (observability/
+        slo.py): while ``SLO.pressure()`` is ``critical``, pending
+        requests of a sheddable class (``best_effort`` by default) are
+        rejected at admission — a fast, honest 'shed' error beats queueing
+        them behind traffic that is already missing its budgets. Only
+        FRESH submissions shed: a preempted resume already streamed tokens
+        to its client, and truncating a live stream to save budget would
+        be a worse breach than the one being protected against."""
+        if slo_mod.SLO.pressure() != "critical":
+            return
+        with self._lock:
+            shed = [j for j in self._pending
+                    if not j.gen_ids and j.admit_seq == 0
+                    and slo_mod.SLO.resolve_or_default(
+                        j.request.slo_class).sheddable]
+            for job in shed:
+                self._pending.remove(job)
+        for job in shed:
+            job.request.slo_outcome = "shed"
+            REGISTRY.counter("slo_shed_total",
+                             labels={"class": job.request.slo_class}).inc()
+            self._fail(job, "shed: SLO pressure is critical (error budget "
+                            "burning); best-effort admission rejected — "
+                            "retry when pressure clears (/debug/slo)")
+
     def _admit(self) -> None:
         """Move pending jobs into the prefilling set while slots+pages last.
 
@@ -521,6 +572,7 @@ class Scheduler:
         bypass is counted against the blocked head; past _BYPASS_MAX the
         queue reverts to strict FIFO until the head admits, so a stream of
         small prompts cannot starve the big one."""
+        self._shed_pending()
         while self._free:
             with self._lock:
                 cands = list(self._pending)[: self._ADMIT_SCAN]

@@ -41,6 +41,7 @@ from generativeaiexamples_tpu.engine.engine import TOP_LP
 from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
 from generativeaiexamples_tpu.observability import flight as flight_mod
 from generativeaiexamples_tpu.observability import otel
+from generativeaiexamples_tpu.observability import slo as slo_mod
 from generativeaiexamples_tpu.server.common import (
     MAX_TOKENS_CAP, StreamDrain, add_debug_routes, health_handler,
     metrics_handler, parse_stop, sse_done, sse_write,
@@ -166,6 +167,23 @@ class ModelServer:
             "logprobs": bool(get("logprobs", False, bool) or top_lp),
             "top_logprobs": max(0, min(top_lp, TOP_LP)),
         }
+
+    @staticmethod
+    def _parse_slo(request: web.Request) -> Dict[str, Any]:
+        """SLO admission fields from the propagated headers (observability/
+        slo.py; the chain server — or any client — sends class + REMAINING
+        deadline budget in ms). An unknown class is a loud 400: silently
+        downgrading a caller's objective would falsify every attainment
+        number downstream. The W3C trace id (same ``traceparent`` the span
+        envelope consumes) rides along so SLO histograms/breach records
+        link to the request's trace."""
+        try:
+            cls, deadline_s = slo_mod.parse_inbound(request.headers)
+        except ValueError as exc:
+            raise web.HTTPBadRequest(text=json.dumps({"error": str(exc)}))
+        parent = otel.extract_traceparent(dict(request.headers))
+        return {"slo_class": cls or "", "deadline_s": deadline_s,
+                "trace_id": parent.trace_id if parent else ""}
 
     def _format_logprobs(self, req) -> Dict[str, Any]:
         """OpenAI chat `logprobs` object from the scheduler's raw
@@ -307,6 +325,7 @@ class ModelServer:
         # responses echo the REQUESTED model id (adapter traffic must not
         # be attributed to the base model by client-side accounting)
         model = adapter or self.model_name
+        slo_fields = self._parse_slo(request)
 
         def make_req(i: int) -> Request:
             kw = dict(sampling)
@@ -314,7 +333,7 @@ class ModelServer:
                 kw["seed"] = kw["seed"] + i   # distinct, still reproducible
             return Request(prompt_ids=list(prompt_ids), grammar=grammar,
                            grammar_prefix=grammar_prefix, adapter=adapter,
-                           **kw)
+                           **slo_fields, **kw)
 
         reqs = [make_req(i) for i in range(n)]
         req = reqs[0]

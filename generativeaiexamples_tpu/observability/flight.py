@@ -115,15 +115,19 @@ class FlightRecorder:
             REGISTRY.gauge(f"flight_{key}").set(value)
         return sample
 
-    def window(self, seconds: Optional[float] = None) -> List[Dict[str, Any]]:
+    def window(self, seconds: Optional[float] = None,
+               limit: Optional[int] = None) -> List[Dict[str, Any]]:
         """Samples from the last ``seconds`` (None = whole ring), oldest
-        first."""
+        first; ``limit`` keeps only the NEWEST n of them (a capped debug
+        poll wants the most recent state, not the window's head)."""
         with self._lock:
             samples = list(self._ring)
-        if seconds is None:
-            return samples
-        cutoff = time.monotonic() - seconds
-        return [s for s in samples if s["mono"] >= cutoff]
+        if seconds is not None:
+            cutoff = time.monotonic() - seconds
+            samples = [s for s in samples if s["mono"] >= cutoff]
+        if limit is not None and len(samples) > limit:
+            samples = samples[len(samples) - limit:]
+        return samples
 
     def __len__(self) -> int:
         with self._lock:
@@ -180,6 +184,11 @@ def timeline(req: Any) -> Dict[str, Any]:
         "prompt_tokens": len(getattr(req, "prompt_ids", []) or []),
         "finish": getattr(req, "finish_reason", None),
         "error": getattr(req, "error", None),
+        # SLO plane (observability/slo.py): the scheduler judges attainment
+        # BEFORE recording, so timelines, breach records, and
+        # slo_requests_total agree per request
+        "slo_class": getattr(req, "slo_class", None),
+        "slo": getattr(req, "slo", None),
         "finished_unix": time.time(),
     }
     durations: Dict[str, float] = {}

@@ -38,6 +38,28 @@ _current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar
     "gaie_tpu_current_span", default=None
 )
 
+# The serving-layer request id (X-Request-Id) for the request being handled
+# on THIS thread/task: stage_span stamps it on every pipeline-stage span so
+# timelines (/debug/requests/<id>), spans, and SLO breach records join on
+# one key. Set by the chain server inside its StreamDrain reader thread
+# (contextvars do not cross threads, so it is established where the chain
+# actually executes).
+_request_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "gaie_tpu_request_id", default=""
+)
+
+
+def set_request_id(request_id: str) -> "contextvars.Token[str]":
+    return _request_id.set(request_id)
+
+
+def reset_request_id(token: "contextvars.Token[str]") -> None:
+    _request_id.reset(token)
+
+
+def current_request_id() -> str:
+    return _request_id.get()
+
 
 def tracing_enabled() -> bool:
     return os.environ.get("ENABLE_TRACING", "").strip().lower() in ("1", "true", "yes")
@@ -419,6 +441,12 @@ def stage_span(name: str, tracer_name: str = "rag") -> Iterator[Span]:
     t0 = time.perf_counter()
     try:
         with get_tracer(tracer_name).span(f"{tracer_name}:{name}") as span:
+            rid = _request_id.get()
+            if rid and span is not _NOOP_SPAN:
+                # the X-Request-Id of the request this stage serves — the
+                # join key across spans, /debug/requests timelines, and
+                # SLO breach records (never stamped on the shared no-op)
+                span.set_attribute("request_id", rid)
             yield span
     finally:
         REGISTRY.histogram(f"stage_{name}_s").observe(

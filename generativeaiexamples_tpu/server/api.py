@@ -34,6 +34,8 @@ from aiohttp import web
 
 from generativeaiexamples_tpu.core.metrics import REGISTRY
 from generativeaiexamples_tpu.core.tracing import instrumentation_wrapper
+from generativeaiexamples_tpu.observability import otel
+from generativeaiexamples_tpu.observability import slo as slo_mod
 from generativeaiexamples_tpu.server.base import BaseExample
 from generativeaiexamples_tpu.server import guardrails as guardrails_mod
 from generativeaiexamples_tpu.server.common import (
@@ -137,77 +139,121 @@ class ChainServer:
         if stop:
             settings["stop"] = stop
         REGISTRY.counter("generate_requests").inc()
-        rid = uuid.uuid4().hex
+        # X-Request-Id propagates the way the engine's does: honor the
+        # caller's id (gateway retries / cross-log joins) or mint one; the
+        # SSE chunk ids, the response header, stage-span attributes, and
+        # any downstream SLO breach records all join on this one key
+        rid = request.headers.get("X-Request-Id", "").strip() or uuid.uuid4().hex
+        # SLO admission (observability/slo.py): class from header or body,
+        # deadline stamped NOW — all downstream LLM calls propagate the
+        # remaining budget. Unknown class names fail loudly (422, like
+        # every other malformed field on this endpoint).
+        try:
+            slo_class, deadline_s = slo_mod.parse_inbound(
+                request.headers,
+                fallback_class=str(body.get("slo_class") or ""))
+        except ValueError as exc:
+            raise web.HTTPUnprocessableEntity(
+                text=json.dumps({"error": str(exc)}))
+        deadline_ms: Optional[float] = (
+            None if deadline_s is None else deadline_s * 1000.0)
 
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
+            "X-Request-Id": rid,
         })
         await resp.prepare(request)
 
         def guarded():
             # runs on the StreamDrain reader thread: rails' device work
             # (intent embedding) must not block the event loop, and a rails
-            # failure must yield the canned error inside a valid SSE stream
+            # failure must yield the canned error inside a valid SSE stream.
+            # The admission context + request id are (re-)established HERE
+            # because this generator body executes on that reader thread —
+            # contextvars set in the handler coroutine don't cross threads.
+            token = otel.set_request_id(rid)
             try:
-                if self.guardrails is not None:
-                    canned = self.guardrails.check_input(query)
-                    if canned is not None:
-                        REGISTRY.counter("guardrails_input_blocks").inc()
-                        yield canned
-                        return
-                chain = (self.example.rag_chain if use_kb else self.example.llm_chain)
-                if (self.guardrails is not None
-                        and self.guardrails.has_output_rails):
-                    # output rails (fact-check / scrub) need the complete
-                    # answer: buffer, check, emit once — rails trade
-                    # streaming latency for verification by design
-                    guardrails_mod.take_context()  # clear any stale record
-                    answer = "".join(chain(query, history, **settings))
-                    # fact-check against the context the chain actually
-                    # prompted with; re-retrieve only for chains that don't
-                    # record one
-                    context = guardrails_mod.take_context() if use_kb else ""
-                    if context is None:
-                        context = self._rails_context(query)
-                    yield self.guardrails.check_output(answer, context, query)
-                    return
-                yield from chain(query, history, **settings)
-            except Exception:  # canned error message (ref :380-392)
-                logger.exception("generation failed")
-                REGISTRY.counter("generate_errors").inc()
-                yield ("Error from chain server. Please check chain-server "
-                       "logs for more details.")
+                with slo_mod.admission(slo_class, deadline_ms=deadline_ms):
+                    yield from self._guarded_chain(query, history, use_kb,
+                                                   settings)
+            finally:
+                otel.reset_request_id(token)
 
         from generativeaiexamples_tpu.engine.scheduler import _stop_scan
-        first = True
+        first_at: Optional[float] = None
+        last_at = 0.0
+        chunks = 0
         held = ""
         hit = False
+
+        async def emit(content: str) -> None:
+            nonlocal first_at, last_at, chunks
+            now = time.perf_counter()
+            if first_at is None:
+                first_at = now
+                REGISTRY.histogram("e2e_ttft_s").observe(now - t_start)
+            last_at = now
+            chunks += 1
+            await resp.write(f"data: {_chain_chunk(rid, content)}\n\n".encode())
+
         async for item in StreamDrain(guarded()):
             if stop:
                 item, held, hit = _stop_scan(stop, held + item)
                 if item:
-                    if first:
-                        REGISTRY.histogram("e2e_ttft_s").observe(
-                            time.perf_counter() - t_start)
-                        first = False
-                    await resp.write(
-                        f"data: {_chain_chunk(rid, item)}\n\n".encode())
+                    await emit(item)
                 if hit:
                     break
                 continue
-            if first:
-                REGISTRY.histogram("e2e_ttft_s").observe(time.perf_counter() - t_start)
-                first = False
-            await resp.write(f"data: {_chain_chunk(rid, item)}\n\n".encode())
+            await emit(item)
         if held and not hit:
             # trailing holdback that never completed a stop match
-            await resp.write(f"data: {_chain_chunk(rid, held)}\n\n".encode())
+            await emit(held)
         await resp.write(f"data: {_chain_chunk(rid, '', 'stop')}\n\n".encode())
         await resp.write(b"data: [DONE]\n\n")
         await resp.write_eof()
         REGISTRY.histogram("e2e_latency_s").observe(time.perf_counter() - t_start)
+        if chunks > 1 and first_at is not None:
+            # chain-level time-per-output-chunk: the streaming-cadence
+            # sibling of the engine's token-exact TPOT (SSE deltas can
+            # carry several tokens, so this is an upper-ish proxy —
+            # docs/observability.md's metric catalog spells out the pair)
+            REGISTRY.histogram("e2e_tpot_s").observe(
+                (last_at - first_at) / (chunks - 1))
         return resp
+
+    def _guarded_chain(self, query, history, use_kb, settings):
+        """The rails-wrapped chain body ``generate`` streams (sync
+        generator; runs on the StreamDrain reader thread)."""
+        try:
+            if self.guardrails is not None:
+                canned = self.guardrails.check_input(query)
+                if canned is not None:
+                    REGISTRY.counter("guardrails_input_blocks").inc()
+                    yield canned
+                    return
+            chain = (self.example.rag_chain if use_kb else self.example.llm_chain)
+            if (self.guardrails is not None
+                    and self.guardrails.has_output_rails):
+                # output rails (fact-check / scrub) need the complete
+                # answer: buffer, check, emit once — rails trade
+                # streaming latency for verification by design
+                guardrails_mod.take_context()  # clear any stale record
+                answer = "".join(chain(query, history, **settings))
+                # fact-check against the context the chain actually
+                # prompted with; re-retrieve only for chains that don't
+                # record one
+                context = guardrails_mod.take_context() if use_kb else ""
+                if context is None:
+                    context = self._rails_context(query)
+                yield self.guardrails.check_output(answer, context, query)
+                return
+            yield from chain(query, history, **settings)
+        except Exception:  # canned error message (ref :380-392)
+            logger.exception("generation failed")
+            REGISTRY.counter("generate_errors").inc()
+            yield ("Error from chain server. Please check chain-server "
+                   "logs for more details.")
 
     def _rails_context(self, query: str) -> str:
         """Retrieved evidence for the fact-check rail (the oran app passes

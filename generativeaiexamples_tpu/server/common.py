@@ -23,11 +23,23 @@ from typing import AsyncIterator, Optional
 from aiohttp import web
 
 from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.observability import slo as slo_mod
 from generativeaiexamples_tpu.observability.flight import FLIGHT, REQUEST_LOG
 
 MAX_TOKENS_CAP = 1024  # ref: RAG/src/chain_server/server.py:104-110
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+OPENMETRICS_CONTENT_TYPE = ("application/openmetrics-text; version=1.0.0; "
+                            "charset=utf-8")
+
+# Debug-surface caps: a poll during an incident must never serialize an
+# unbounded ring into one response. Explicit query params may widen up to
+# the hard max; absent params get the sane default.
+FLIGHT_WINDOW_DEFAULT_S = 600.0
+FLIGHT_LIMIT_DEFAULT = 1024
+FLIGHT_LIMIT_MAX = 8192
+REQUESTS_LIMIT_DEFAULT = 50
+REQUESTS_LIMIT_MAX = 500
 
 
 def parse_stop(value) -> list:
@@ -40,7 +52,22 @@ def parse_stop(value) -> list:
 
 
 async def health_handler(request: web.Request) -> web.Response:
-    return web.json_response({"message": "Service is up."})
+    # slo_pressure rides the liveness probe so a pool client learns about
+    # error-budget burn for free with every health check it already makes
+    # (server/failover.py records it per worker)
+    return web.json_response({"message": "Service is up.",
+                              "slo_pressure": slo_mod.SLO.pressure()})
+
+
+def _wants_openmetrics(request: web.Request) -> bool:
+    # Explicit opt-in ONLY: stock Prometheus advertises
+    # application/openmetrics-text in its default Accept, and this registry
+    # renders exemplars without the # TYPE metadata a conforming OpenMetrics
+    # parser requires before accepting them — switching on Accept would flip
+    # every existing scraper onto a body it may reject. 0.0.4 output stays
+    # byte-stable for all Accept-negotiated traffic; the exemplar-carrying
+    # form is a diagnostic surface behind ?format=openmetrics.
+    return request.query.get("format", "").lower() == "openmetrics"
 
 
 def _wants_prometheus(request: web.Request) -> bool:
@@ -57,35 +84,62 @@ def _wants_prometheus(request: web.Request) -> bool:
 
 
 async def metrics_handler(request: web.Request) -> web.Response:
+    if _wants_openmetrics(request):
+        # OpenMetrics 1.0: same series, plus exemplars (trace ids on the
+        # SLO latency histograms) and the # EOF terminator
+        body = REGISTRY.render_prometheus(openmetrics=True)
+        return web.Response(body=body.encode("utf-8"),
+                            headers={"Content-Type":
+                                     OPENMETRICS_CONTENT_TYPE})
     if _wants_prometheus(request):
         return web.Response(body=REGISTRY.render_prometheus().encode("utf-8"),
                             headers={"Content-Type": PROMETHEUS_CONTENT_TYPE})
     return web.json_response(REGISTRY.snapshot())
 
 
+def _query_number(request: web.Request, name: str, default, cast,
+                  maximum=None):
+    raw = request.query.get(name, "")
+    if not raw:
+        return default
+    try:
+        value = cast(raw)
+    except ValueError:
+        raise web.HTTPBadRequest(text=json.dumps(
+            {"error": f"{name} must be a number, got {raw!r}"}))
+    if maximum is not None:
+        value = min(value, maximum)
+    return value
+
+
 async def flight_handler(request: web.Request) -> web.Response:
-    """Windowed flight-recorder time series: ``?window=<seconds>`` bounds
-    the lookback (default: the whole ring)."""
-    raw = request.query.get("window", "")
-    seconds: Optional[float] = None
-    if raw:
-        try:
-            seconds = float(raw)
-        except ValueError:
-            raise web.HTTPBadRequest(text=json.dumps(
-                {"error": f"window must be a number of seconds, got {raw!r}"}))
+    """Windowed flight-recorder time series. ``?window=<seconds>`` bounds
+    the lookback (default 600 s) and ``?limit=<n>`` the sample count
+    (default 1024, newest kept; hard cap 8192) — the full ~17 min ring is
+    ~4096 samples and serializing it all into one incident-time poll is
+    exactly the wrong moment for a megabyte response."""
+    seconds = _query_number(request, "window", FLIGHT_WINDOW_DEFAULT_S, float)
+    limit = _query_number(request, "limit", FLIGHT_LIMIT_DEFAULT, int,
+                          maximum=FLIGHT_LIMIT_MAX)
+    samples = FLIGHT.window(seconds, limit=max(0, limit))
     return web.json_response({**FLIGHT.describe(),
                               "window_s": seconds,
-                              "samples": FLIGHT.window(seconds)})
+                              "limit": limit,
+                              "samples": samples})
 
 
 async def requests_recent_handler(request: web.Request) -> web.Response:
-    try:
-        n = int(request.query.get("n", "50"))
-    except ValueError:
-        raise web.HTTPBadRequest(text=json.dumps(
-            {"error": "n must be an integer"}))
-    return web.json_response({"requests": REQUEST_LOG.recent(n)})
+    n = _query_number(request, "n", REQUESTS_LIMIT_DEFAULT, int,
+                      maximum=REQUESTS_LIMIT_MAX)
+    return web.json_response({"requests": REQUEST_LOG.recent(n),
+                              "limit": n})
+
+
+async def slo_handler(request: web.Request) -> web.Response:
+    """Per-class SLO attainment, burn rates, pressure, recent breaches
+    (observability/slo.py) — the operator view of 'are we keeping our
+    objectives and should the fleet be shedding'."""
+    return web.json_response(slo_mod.SLO.debug_payload())
 
 
 async def request_timeline_handler(request: web.Request) -> web.Response:
@@ -106,6 +160,7 @@ def add_debug_routes(app: web.Application) -> None:
         web.get("/debug/flight", flight_handler),
         web.get("/debug/requests", requests_recent_handler),
         web.get("/debug/requests/{rid}", request_timeline_handler),
+        web.get("/debug/slo", slo_handler),
     ])
 
 

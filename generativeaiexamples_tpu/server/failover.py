@@ -33,20 +33,49 @@ import urllib.request
 from typing import Dict, Iterator, List, Sequence
 
 from generativeaiexamples_tpu.core.config import http_timeout
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.observability import slo as slo_mod
 
 logger = logging.getLogger(__name__)
+
+_PRESSURE_GAUGE = {"ok": 0, "warn": 1, "critical": 2}
 
 
 class _Worker:
     def __init__(self, url: str) -> None:
         self.url = url.rstrip("/")
         self.down_until = 0.0
+        # last SLO pressure the worker reported on /health (observability/
+        # slo.py rides the liveness body): "" until first probed. A worker
+        # can be alive-but-burning — the pool surfaces that distinction.
+        self.slo_pressure = ""
 
     def healthy(self, timeout: float = 2.0) -> bool:
         try:
             with urllib.request.urlopen(f"{self.url}/health",
                                         timeout=timeout) as resp:
-                return 200 <= resp.status < 300
+                ok = 200 <= resp.status < 300
+                if ok:
+                    try:
+                        body = json.loads(resp.read().decode("utf-8"))
+                        self.slo_pressure = str(
+                            body.get("slo_pressure", "") or "")
+                    except (ValueError, UnicodeDecodeError) as exc:
+                        logger.debug("health body from %s unparsable: %s",
+                                     self.url, exc)
+                    if self.slo_pressure in _PRESSURE_GAUGE:
+                        # per-worker pressure on the POOL CLIENT's own
+                        # /metrics (0/1/2) — the operator view of
+                        # alive-but-burning workers, refreshed by the
+                        # probes the serving path already makes
+                        REGISTRY.gauge(
+                            "failover_worker_slo_pressure",
+                            labels={"worker": self.url},
+                        ).set(_PRESSURE_GAUGE[self.slo_pressure])
+                    if self.slo_pressure == "critical":
+                        logger.warning("worker %s healthy but reports "
+                                       "critical SLO pressure", self.url)
+                return ok
         except Exception as exc:
             # an unreachable worker is the EXPECTED case this probe exists
             # for — debug keeps the recovery loop quiet but traceable
@@ -116,8 +145,13 @@ class FailoverLLM:
                 logger.info("resuming stream on %s at %d chars", w.url,
                             len(payload["continue_text"]))
             try:
+                # SLO class + remaining deadline + traceparent, same as
+                # RemoteLLM — a failover RESUME carries the (shrunken)
+                # remaining budget, so the survivor judges against the
+                # deadline the original admission stamped
                 with httpx.stream("POST", f"{w.url}/v1/chat/completions",
                                   json=payload,
+                                  headers=slo_mod.outbound_headers(),
                                   timeout=http_timeout(120.0)) as resp:
                     if resp.status_code >= 500:
                         raise httpx.TransportError(
@@ -176,7 +210,9 @@ class FailoverLLM:
                 continue
             try:
                 resp = httpx.post(f"{w.url}/v1/chat/completions",
-                                  json=payload, timeout=http_timeout(120.0))
+                                  json=payload,
+                                  headers=slo_mod.outbound_headers(),
+                                  timeout=http_timeout(120.0))
                 if resp.status_code >= 500:
                     raise httpx.TransportError(f"HTTP {resp.status_code}")
                 resp.raise_for_status()       # 4xx: deterministic — raise

@@ -1,0 +1,397 @@
+"""SLO & goodput plane: burn-rate evaluator, attainment judging, shedding.
+
+ISSUE 4's acceptance surface, kept hostless and cheap (tier-1 budget is
+~870 s and the suite already sits near it): the evaluator runs under a
+FAKE monotonic clock (fully deterministic window math), the live tests
+ride the FakeCore scheduler from test_scheduler_fuzz (pure numpy — no
+compile), and the HTTP tests reuse the socket-thread harness from
+test_chain_server.
+"""
+
+import time
+from types import SimpleNamespace
+
+import pytest
+import requests
+
+from test_scheduler_fuzz import FakeCore
+from test_chain_server import _ServerThread, _free_port
+
+from generativeaiexamples_tpu.core.metrics import MetricsRegistry, REGISTRY
+from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
+from generativeaiexamples_tpu.engine.server import ModelServer
+from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+from generativeaiexamples_tpu.observability import otel
+from generativeaiexamples_tpu.observability import slo as slo_mod
+from generativeaiexamples_tpu.observability.slo import SLOClass, SloTracker
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+CLASSES = {
+    "interactive": SLOClass("interactive", ttft_s=0.5, tpot_s=0.05,
+                            e2e_s=10.0),
+    "best_effort": SLOClass("best_effort", ttft_s=30.0, tpot_s=2.0,
+                            e2e_s=600.0, sheddable=True),
+}
+KNOBS = dict(default_class="interactive", target=0.9, fast_window_s=60.0,
+             slow_window_s=600.0, warn_burn=2.0, critical_burn=10.0,
+             min_events=5)
+
+
+def _tracker(clock) -> SloTracker:
+    return SloTracker(classes=CLASSES, clock=clock, **KNOBS)
+
+
+def _req(cls="interactive", ttft=0.1, tpot=0.01, ntok=11, error=None,
+         preset=None, deadline=None, rid="r1", trace=""):
+    first = 0.0 + ttft
+    finished = first + tpot * (ntok - 1)
+    return SimpleNamespace(slo_class=cls, error=error, slo_outcome=preset,
+                           submitted_at=0.0, first_token_at=first,
+                           finished_at=finished, completion_tokens=ntok,
+                           deadline_s=deadline, request_id=rid,
+                           trace_id=trace)
+
+
+# ------------------------------------------------------------------ judging
+
+def test_judge_dimensions_and_outcomes():
+    t = _tracker(FakeClock())
+    assert t.judge(_req())["outcome"] == "attained"
+    v = t.judge(_req(ttft=0.9))
+    assert v["outcome"] == "breached" and "ttft" in v["breaches"]
+    assert v["breaches"]["ttft"]["budget_s"] == 0.5
+    v = t.judge(_req(tpot=0.2))
+    assert "tpot" in v["breaches"]
+    # a propagated deadline TIGHTER than the class e2e budget wins
+    v = t.judge(_req(ttft=0.4, tpot=0.04, ntok=11, deadline=0.5))
+    assert "e2e" in v["breaches"]
+    assert t.judge(_req(error="boom"))["outcome"] == "error"
+    # the scheduler's shed preset overrides judging entirely
+    assert t.judge(_req(error="shed", preset="shed"))["outcome"] == "shed"
+    # unknown class names fall back to the default class, never crash
+    assert t.judge(_req(cls="nope"))["class"] == "interactive"
+
+
+# ---------------------------------------------------- burn-rate evaluator
+
+def test_burn_rate_windows_and_pressure_transitions():
+    clock = FakeClock()
+    t = _tracker(clock)
+    assert t.pressure() == "ok"
+
+    # 10 breaches: error rate 1.0 / budget 0.1 = burn 50 in both windows
+    clock.advance(2.0)
+    for i in range(10):
+        t.observe(_req(ttft=0.9, rid=f"b{i}"))
+    clock.advance(1.5)          # past the 1 s pressure cache
+    assert t.burn_rates("interactive")["fast"] == pytest.approx(10.0)
+    assert t.burn_rates("interactive")["slow"] == pytest.approx(10.0)
+    assert t.pressure() == "critical"
+
+    # fast window rolls over: burn decays there first, and the PAIRED rule
+    # (both windows must exceed) drops pressure even while the slow window
+    # still remembers the incident
+    clock.advance(KNOBS["fast_window_s"] + 5.0)
+    for i in range(20):
+        t.observe(_req(rid=f"g{i}"))
+    clock.advance(1.5)
+    rates = t.burn_rates("interactive")
+    assert rates["fast"] == pytest.approx(0.0)
+    assert rates["slow"] > 2.0          # old breaches still inside 600 s
+    assert t.pressure() == "ok"
+
+    # slow rollover: everything ages out
+    clock.advance(KNOBS["slow_window_s"] + 5.0)
+    assert t.burn_rates("interactive")["slow"] == pytest.approx(0.0)
+
+
+def test_pressure_needs_min_events_and_ignores_sheddable_classes():
+    clock = FakeClock()
+    t = _tracker(clock)
+    # 3 breaches < min_events=5: never page on a handful of requests
+    for i in range(3):
+        t.observe(_req(ttft=0.9, rid=f"b{i}"))
+    clock.advance(1.5)
+    assert t.pressure() == "ok"
+    # best_effort burning its own budget must NOT raise pressure — shedding
+    # it would then keep pressure high forever (self-reinforcing)
+    for i in range(50):
+        t.observe(_req(cls="best_effort", ttft=40.0, ntok=2, rid=f"s{i}"))
+    clock.advance(1.5)
+    assert t.pressure() == "ok"
+
+
+def test_observe_stamps_request_and_logs_breaches():
+    clock = FakeClock()
+    t = _tracker(clock)
+    req = _req(ttft=0.9, rid="breach-1", trace="ab" * 16)
+    t.observe(req)
+    assert req.slo["outcome"] == "breached"
+    payload = t.debug_payload()
+    assert payload["classes"]["interactive"]["budgets"]["ttft_s"] == 0.5
+    recent = payload["recent_breaches"]
+    assert recent and recent[0]["request_id"] == "breach-1"
+    assert recent[0]["trace_id"] == "ab" * 16
+    assert "ttft" in recent[0]["breaches"]
+
+
+# ------------------------------------------------------- exemplars (metrics)
+
+def test_exemplar_round_trip_through_render_prometheus():
+    r = MetricsRegistry()
+    h = r.histogram("lat_s", labels={"class": "interactive"})
+    h.observe(0.2)
+    h.observe(0.31, exemplar={"trace_id": "deadbeef"})
+    # format 0.0.4 output is byte-stable: no exemplars, no EOF
+    plain = r.render_prometheus()
+    assert "deadbeef" not in plain and "# EOF" not in plain
+    om = r.render_prometheus(openmetrics=True)
+    assert om.rstrip().endswith("# EOF")
+    line = next(l for l in om.splitlines() if l.startswith("lat_s_count"))
+    series, exemplar = line.split(" # ", 1)
+    assert series == 'lat_s_count{class="interactive"} 2'
+    assert exemplar.startswith('{trace_id="deadbeef"} 0.31 ')
+    # newest exemplar wins
+    h.observe(0.5, exemplar={"trace_id": "cafe"})
+    assert 'trace_id="cafe"' in r.render_prometheus(openmetrics=True)
+
+
+# ------------------------------------------------------- deadline propagation
+
+def test_admission_context_and_outbound_headers(monkeypatch):
+    monkeypatch.setattr(slo_mod, "SLO", _tracker(FakeClock()))
+    assert slo_mod.outbound_headers() == {}   # no admission, no headers
+    with slo_mod.admission("interactive"):
+        headers = slo_mod.outbound_headers()
+        assert headers[slo_mod.CLASS_HEADER] == "interactive"
+        # remaining budget in ms, at most the full e2e budget
+        assert 0 < int(headers[slo_mod.DEADLINE_HEADER]) <= 10_000
+    # an inbound remaining-deadline rides through, shrunken not reset
+    with slo_mod.admission("interactive", deadline_ms=1500.0):
+        rem = int(slo_mod.outbound_headers()[slo_mod.DEADLINE_HEADER])
+        assert 0 < rem <= 1500
+
+
+def test_stage_span_carries_request_id(monkeypatch):
+    monkeypatch.setenv("ENABLE_TRACING", "true")
+    exporter = otel.InMemorySpanExporter()
+    old = otel._exporter
+    otel.set_exporter(exporter)
+    try:
+        token = otel.set_request_id("rid-123")
+        try:
+            with otel.stage_span("retrieve"):
+                pass
+        finally:
+            otel.reset_request_id(token)
+    finally:
+        otel.set_exporter(old)
+    assert exporter.spans[0].attributes["request_id"] == "rid-123"
+
+
+# ------------------------------------------------------------- scheduler shed
+
+def _critical_tracker():
+    clock = FakeClock()
+    t = _tracker(clock)
+    for i in range(10):
+        t.observe(_req(ttft=0.9, rid=f"b{i}"))
+    clock.advance(1.5)
+    assert t.pressure() == "critical"
+    return t
+
+
+def test_scheduler_sheds_best_effort_under_critical(monkeypatch):
+    monkeypatch.setattr(slo_mod, "SLO", _critical_tracker())
+    core = FakeCore(batch=4, max_seq=64, page_size=8, chunk=16, steps=2,
+                    group=4)
+    sched = Scheduler(core, ByteTokenizer())
+    sched.start()
+    try:
+        shed = Request(prompt_ids=[40, 41, 42, 43], max_tokens=4,
+                       slo_class="best_effort")
+        sched.submit(shed)
+        assert "".join(sched.iter_text(shed)) == ""
+        assert shed.error and "shed" in shed.error
+        assert shed.slo["outcome"] == "shed"
+        assert REGISTRY.counter("slo_shed_total",
+                                labels={"class": "best_effort"}).value >= 1
+        # non-sheddable traffic keeps flowing through the same pressure
+        kept = Request(prompt_ids=[44, 45, 46, 47], max_tokens=4,
+                       slo_class="interactive")
+        sched.submit(kept)
+        text = "".join(sched.iter_text(kept))
+        assert kept.error is None and text
+        assert kept.slo["class"] == "interactive"
+    finally:
+        sched.stop()
+
+
+def test_scheduler_admits_best_effort_when_pressure_clears(monkeypatch):
+    monkeypatch.setattr(slo_mod, "SLO", _tracker(FakeClock()))
+    core = FakeCore(batch=4, max_seq=64, page_size=8, chunk=16, steps=2,
+                    group=4)
+    sched = Scheduler(core, ByteTokenizer())
+    sched.start()
+    try:
+        req = Request(prompt_ids=[50, 51, 52, 53], max_tokens=4,
+                      slo_class="best_effort")
+        sched.submit(req)
+        text = "".join(sched.iter_text(req))
+        assert req.error is None and text
+        assert req.slo_class == "best_effort"
+    finally:
+        sched.stop()
+
+
+# ------------------------------------------------------------- live over HTTP
+
+@pytest.fixture(scope="module")
+def served_engine():
+    core = FakeCore(batch=4, max_seq=64, page_size=8, chunk=16, steps=2,
+                    group=4)
+    sched = Scheduler(core, ByteTokenizer())
+    sched.start()
+    port = _free_port()
+    server = _ServerThread(ModelServer(sched, "fake-tpu").app, port)
+    server.start()
+    try:
+        yield f"http://127.0.0.1:{port}"
+    finally:
+        server.stop()
+        sched.stop()
+
+
+def _wait_for(pred, timeout=10.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_live_class_header_judged_and_on_timeline(served_engine):
+    trace = "12ab" * 8
+    resp = requests.post(
+        f"{served_engine}/v1/completions",
+        json={"prompt": "hello slo", "max_tokens": 6},
+        headers={"X-Request-Class": "batch",
+                 "X-Request-Deadline-Ms": "250000",
+                 "traceparent": f"00-{trace}-{'ab12' * 4}-01"},
+        timeout=30)
+    assert resp.status_code == 200
+    rid = resp.headers["X-Request-Id"]
+    assert _wait_for(lambda: requests.get(
+        f"{served_engine}/debug/requests/{rid}",
+        timeout=5).status_code == 200)
+    rec = requests.get(f"{served_engine}/debug/requests/{rid}",
+                       timeout=5).json()
+    assert rec["slo_class"] == "batch"
+    assert rec["slo"]["outcome"] in ("attained", "breached")
+    # the SLO latency histogram carries the trace id as an exemplar on the
+    # explicit OpenMetrics opt-in — Accept-negotiated traffic (including a
+    # stock Prometheus scraper, which advertises openmetrics) keeps the
+    # byte-stable 0.0.4 body
+    om = requests.get(f"{served_engine}/metrics?format=openmetrics",
+                      timeout=5)
+    assert om.headers["Content-Type"].startswith(
+        "application/openmetrics-text")
+    assert om.text.rstrip().endswith("# EOF")
+    assert trace in om.text
+    plain = requests.get(
+        f"{served_engine}/metrics",
+        headers={"Accept": "application/openmetrics-text"}, timeout=5)
+    assert plain.headers["Content-Type"].startswith("text/plain")
+    assert "# EOF" not in plain.text
+
+
+def test_live_unknown_class_is_a_400(served_engine):
+    resp = requests.post(f"{served_engine}/v1/completions",
+                         json={"prompt": "x", "max_tokens": 2},
+                         headers={"X-Request-Class": "platinum"},
+                         timeout=30)
+    assert resp.status_code == 400
+    assert "platinum" in resp.json()["error"]
+
+
+def test_live_debug_slo_and_health_pressure(served_engine):
+    body = requests.get(f"{served_engine}/debug/slo", timeout=5).json()
+    assert body["pressure"] in ("ok", "warn", "critical")
+    for cls in ("interactive", "batch", "best_effort"):
+        assert "budgets" in body["classes"][cls]
+        assert "burn_rate" in body["classes"][cls]
+    health = requests.get(f"{served_engine}/health", timeout=5).json()
+    assert health["message"] == "Service is up."
+    assert health["slo_pressure"] in ("ok", "warn", "critical")
+
+
+def test_live_debug_caps(served_engine):
+    fl = requests.get(f"{served_engine}/debug/flight?limit=2",
+                      timeout=5).json()
+    assert len(fl["samples"]) <= 2 and fl["limit"] == 2
+    # limit is clamped to the hard cap rather than erroring
+    fl = requests.get(f"{served_engine}/debug/flight?limit=99999",
+                      timeout=5).json()
+    assert fl["limit"] == 8192
+    rq = requests.get(f"{served_engine}/debug/requests?n=99999",
+                      timeout=5).json()
+    assert rq["limit"] == 500
+    assert requests.get(f"{served_engine}/debug/flight?limit=x",
+                        timeout=5).status_code == 400
+
+
+# --------------------------------------------------------------- chain server
+
+def test_chain_server_request_id_and_slo_admission():
+    from generativeaiexamples_tpu.server.api import ChainServer
+    from generativeaiexamples_tpu.server.base import BaseExample
+
+    class _Example(BaseExample):
+        def llm_chain(self, query, chat_history, **kw):
+            yield from ("alpha ", "beta ", "gamma")
+
+        def rag_chain(self, query, chat_history, **kw):
+            yield from ("alpha ", "beta ", "gamma")
+
+        def ingest_docs(self, filepath, filename):
+            pass
+
+    port = _free_port()
+    server = _ServerThread(ChainServer(_Example()).app, port)
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{port}/generate"
+        tpot_h = REGISTRY.histogram("e2e_tpot_s")
+        count0 = tpot_h.count
+        resp = requests.post(
+            url, json={"messages": [{"role": "user", "content": "hi"}],
+                       "use_knowledge_base": False},
+            headers={"X-Request-Id": "chain-rid-7",
+                     "X-Request-Class": "interactive"},
+            timeout=30)
+        assert resp.status_code == 200
+        # the caller's id is honored on the response header AND inside
+        # every SSE chunk — one join key end to end
+        assert resp.headers["X-Request-Id"] == "chain-rid-7"
+        assert '"id": "chain-rid-7"' in resp.text
+        # 3 content chunks -> the chain-level TPOT proxy observed once
+        assert tpot_h.count == count0 + 1
+        # unknown class fails loudly (422, the chain server's contract)
+        resp = requests.post(
+            url, json={"messages": [{"role": "user", "content": "hi"}]},
+            headers={"X-Request-Class": "gold"}, timeout=30)
+        assert resp.status_code == 422
+    finally:
+        server.stop()
