@@ -42,6 +42,7 @@ import threading
 import time
 
 import jax
+import jax.numpy as jnp
 
 from generativeaiexamples_tpu.core.config import EngineConfig
 from generativeaiexamples_tpu.core.metrics import REGISTRY
@@ -314,9 +315,121 @@ def _measure_rag_e2e(sched, n_clients: int, rounds: int,
     return len(latencies) / wall, statistics.median(latencies), enc_stats
 
 
+def _kernel_microbench(on_tpu: bool, reps: int = None) -> dict:
+    """Ragged mixed-phase dispatch vs the separate prefill+decode dispatches
+    (`--kernel-bench` satellite of the ragged-paged-attention round).
+
+    Three raggedness mixes of one attention layer's work: decode-only (the
+    baseline the ragged kernel must not regress), decode+chunk (the serving
+    shape the mixed dispatch fuses), and a sparse batch + chunk (mostly
+    empty ragged rows — the skip path). "separate" is what the two-dispatch
+    engine runs per layer: the paged decode kernel PLUS the chunk's dense
+    gather + flash prefill; "ragged" is the one ragged_paged_attention call
+    covering all rows. Every timed quantity is host-observed (a value fetch
+    closes each rep — block_until_ready lies over the tunnel), and times
+    are medians over reps of pre-compiled callables.
+    """
+    import numpy as np
+    from generativeaiexamples_tpu.ops import pallas as pallas_ops
+
+    if on_tpu:
+        B, ps, maxp, H, KV, HD, C = 16, 128, 12, 24, 8, 128, 512
+        reps = reps or 30
+        dtype = jnp.bfloat16
+    else:   # functional shapes: interpret-mode kernels, labeled by device
+        B, ps, maxp, H, KV, HD, C = 4, 16, 4, 4, 2, 16, 32
+        reps = reps or 3
+        dtype = jnp.float32
+    Qb = 8
+    n_ch = C // ps
+    P = B * maxp + n_ch + 1
+    rng = np.random.default_rng(0)
+    r_ = lambda shape: jnp.asarray(rng.standard_normal(shape), dtype)
+    k_pages = r_((P, ps, KV * HD))
+    v_pages = r_((P, ps, KV * HD))
+    table = jnp.asarray(
+        rng.permutation(np.arange(1, B * maxp + 1)).reshape(B, maxp),
+        jnp.int32)
+    chunk_row = jnp.asarray(
+        np.pad(np.arange(B * maxp + 1, B * maxp + 1 + n_ch), (0, maxp - n_ch)),
+        jnp.int32)
+    q_dec = r_((B, 1, H, HD))
+    q_ch = r_((C // Qb, Qb, H, HD))
+    lens_full = jnp.asarray(rng.integers(ps, maxp * ps, B), jnp.int32)
+
+    def timed(fn, *args):
+        out = fn(*args)                       # compile
+        _ = float(jnp.sum(out.astype(jnp.float32)))
+        walls = []
+        for _i in range(reps):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            _ = float(jnp.sum(out.astype(jnp.float32)))   # host-observed
+            walls.append(time.perf_counter() - t0)
+        return statistics.median(walls)
+
+    paged = jax.jit(lambda q, lens: pallas_ops.paged_decode(
+        q, k_pages, v_pages, table, lens))
+    ragged = jax.jit(lambda q, tb, lens, p0, qn: pallas_ops.
+                     ragged_paged_attention(q, k_pages, v_pages, tb, lens,
+                                            p0, qn))
+
+    def chunk_prefill(qc):
+        # the two-dispatch engine's chunk attention: dense gather + flash
+        k_dense = k_pages[chunk_row].reshape(1, maxp * ps, KV, HD)
+        v_dense = v_pages[chunk_row].reshape(1, maxp * ps, KV, HD)
+        return pallas_ops.flash_prefill(
+            qc.reshape(1, C, H, HD), k_dense, v_dense,
+            kv_valid_through=jnp.asarray([C], jnp.int32))
+    chunk_fn = jax.jit(chunk_prefill)
+
+    results = {}
+    for name, n_active in (("decode_only", B), ("mixed", B),
+                           ("sparse_mixed", max(1, B // 4))):
+        with_chunk = name != "decode_only"
+        active = jnp.arange(B) < n_active
+        lens = jnp.where(active, lens_full, 0)
+        sep = timed(paged, q_dec, jnp.maximum(lens, 1))
+        if with_chunk:
+            sep += timed(chunk_fn, q_ch)
+        # ragged: decode rows (q_num = active?1:0) + chunk rows
+        q_rows = jnp.concatenate(
+            [jnp.pad(q_dec, ((0, 0), (0, Qb - 1), (0, 0), (0, 0)))]
+            + ([q_ch] if with_chunk else []))
+        tb = jnp.concatenate(
+            [table] + ([jnp.broadcast_to(chunk_row[None],
+                                         (C // Qb, maxp))] if with_chunk
+                       else []))
+        jr = jnp.arange(C // Qb, dtype=jnp.int32)
+        lens_r = jnp.concatenate(
+            [jnp.maximum(lens, 1)]
+            + ([jnp.full((C // Qb,), C, jnp.int32)] if with_chunk else []))
+        p0 = jnp.concatenate(
+            [jnp.maximum(lens, 1) - 1] + ([jr * Qb] if with_chunk else []))
+        qn = jnp.concatenate(
+            [active.astype(jnp.int32)]
+            + ([jnp.full((C // Qb,), Qb, jnp.int32)] if with_chunk else []))
+        rag = timed(ragged, q_rows, tb, lens_r, p0, qn)
+        results[name] = {
+            "separate_ms": round(sep * 1e3, 3),
+            "ragged_ms": round(rag * 1e3, 3),
+            "ragged_speedup": round(sep / rag, 3) if rag else None,
+        }
+    return {
+        "shapes": {"slots": B, "page": ps, "heads": H, "kv_heads": KV,
+                   "head_dim": HD, "chunk": C, "q_block": Qb, "reps": reps},
+        "device": str(jax.devices()[0]),
+        "mixes": results,
+    }
+
+
 def main() -> None:
     import os
     on_tpu = jax.default_backend() == "tpu"
+    if "--kernel-bench" in sys.argv:
+        print(json.dumps({"metric": "ragged_kernel_bench",
+                          **_kernel_microbench(on_tpu)}))
+        return
     quant = os.environ.get("BENCH_QUANT", "int8" if on_tpu else "none")
     # tuning knobs (default = the shipped serving point); BENCH_FAST=1
     # skips the trainer/encoder phases and runs one latency rep — for
@@ -480,6 +593,9 @@ def main() -> None:
         rag_req_s, rag_p50, rag_enc = _measure_rag_e2e(
             sched, n_clients=4, rounds=1, max_tokens=8,
             max_context_tokens=120)
+    # scheduler-defined mixed-dispatch observables, snapshotted while the
+    # driver state is still alive (same fields /debug/flight serves)
+    flight_now = sched._flight_fields()
     sched.stop()
 
     lat_all = [r for reqs in lat_runs for r in reqs]
@@ -576,6 +692,20 @@ def main() -> None:
         **rag_enc,
         "decode_steps": int(decode_steps),
         "batch_occupancy": round(occupancy, 3),
+        # mixed-phase dispatch (ragged paged attention): whether the engine
+        # served prefill chunks inside decode dispatches, how often, and
+        # the kernel's query-row occupancy alongside batch_occupancy —
+        # read from the scheduler's own flight fields so the bench and
+        # /debug/flight can never disagree about the definition
+        "mixed_phase_dispatch": "on" if core.mixed_supported else "off",
+        "mixed_dispatch_frac": flight_now["mixed_dispatch_frac"],
+        "ragged_row_util": flight_now["ragged_row_util"],
+        # ragged vs separate dispatches at a few raggedness mixes (the
+        # kernel microbench; `python bench.py --kernel-bench` for the
+        # standalone mode). Skipped under BENCH_FAST: its ~5 fresh compiles
+        # defeat the quick-iteration mode's purpose.
+        "kernel_bench": None if fast else _kernel_microbench(
+            on_tpu, reps=None if on_tpu else 2)["mixes"],
         # per-step distributions from the flight recorder ring (windowed to
         # the throughput phase) — batch_occupancy above is the phase MEAN,
         # these show how the fill/queue actually moved through the phase

@@ -235,6 +235,69 @@ class EngineCore:
         buckets.append(self.chunk)
         self.buckets = tuple(buckets)
 
+        # ---- mixed-phase dispatch gate (ragged paged attention) ----------
+        # Resolved ONCE here, failing loudly — the config gate must never
+        # select a kernel the chip rejects at trace time (first dispatch).
+        # APP_MIXED_PHASE_DISPATCH overrides engine.mixed_phase_dispatch.
+        import os
+        from generativeaiexamples_tpu.ops import pallas as pallas_ops
+        mixed = (os.environ.get("APP_MIXED_PHASE_DISPATCH", "").strip().lower()
+                 or getattr(engine_cfg, "mixed_phase_dispatch", "auto"))
+        if mixed not in ("on", "off", "auto"):
+            raise ValueError(f"APP_MIXED_PHASE_DISPATCH must be on|off|auto, "
+                             f"got {mixed!r}")
+        # ragged rows carry q_block queries each; decode slots need their
+        # full speculative width to fit one row
+        qb = 8
+        while qb < self.spec_width:
+            qb *= 2
+        self._mixed_q_block = qb
+        reasons = []
+        if tp > 1:
+            reasons.append("tensor parallelism (mixed dispatch is the "
+                           "single-chip path; TP keeps two dispatches)")
+        if model_cfg.sliding_window:
+            reasons.append("sliding-window attention")
+        if self.chunk % qb:
+            reasons.append(f"prefill_chunk ({self.chunk}) not a multiple of "
+                           f"the ragged q_block ({qb})")
+        if attn == "pallas" and not pallas_ops.ragged_paged_supported(
+                self.page_size, model_cfg.head_dim, qb):
+            reasons.append(
+                f"page_size={self.page_size} / head_dim="
+                f"{model_cfg.head_dim} outside the ragged kernel's limits")
+        if attn == "pallas" and (
+                pallas_ops.paged_decode_supported(self.page_size,
+                                                  model_cfg.head_dim)
+                != pallas_ops.ragged_paged_supported(self.page_size,
+                                                     model_cfg.head_dim, qb)):
+            # the two predicates are one predicate by construction; if they
+            # ever drift, the decode gate and the mixed gate would disagree
+            # about what the chip accepts — refuse to start
+            raise ValueError(
+                "paged_decode_supported and ragged_paged_supported disagree "
+                f"for page_size={self.page_size}, head_dim="
+                f"{model_cfg.head_dim} — kernel-support predicates have "
+                "drifted (ops/pallas/attention.py)")
+        if mixed == "on" and reasons:
+            raise ValueError("APP_MIXED_PHASE_DISPATCH=on but the mixed "
+                             "program cannot serve this config: "
+                             + "; ".join(reasons))
+        if mixed == "auto":
+            # on-by-default where it pays: the real chip. CPU test configs
+            # opt in explicitly so tier-1 does not pay extra compiles.
+            mixed = ("on" if not reasons
+                     and jax.default_backend() == "tpu" else "off")
+            if mixed == "off":
+                # the diagnostic an operator chasing mixed_dispatch_frac==0
+                # follows (docs/observability.md): say WHY auto resolved off
+                import logging
+                logging.getLogger(__name__).info(
+                    "mixed-phase dispatch: auto resolved off (%s)",
+                    "; ".join(reasons) or
+                    f"backend {jax.default_backend()!r} is not tpu")
+        self._mixed = mixed == "on" and not reasons
+
         if mesh is not None:
             from generativeaiexamples_tpu.parallel import sharding as psh
             params = psh.shard_params(
@@ -324,6 +387,8 @@ class EngineCore:
                                       donate_argnums=dn)
         self._decode_fn = jax.jit(self._decode_impl, donate_argnums=dn,
                                   static_argnums=(9, 10, 11))
+        self._mixed_fn = jax.jit(self._mixed_impl, donate_argnums=dn,
+                                 static_argnums=(20, 21, 22, 23))
         self._activate_fn = jax.jit(self._activate_impl, donate_argnums=dn)
         self._release_fn = jax.jit(self._release_impl, donate_argnums=dn)
         self._seed_hist_fn = jax.jit(self._seed_history_impl,
@@ -431,6 +496,27 @@ class EngineCore:
             state, self.params, self.adapters, jnp.asarray(padded),
             jnp.asarray(page_row, jnp.int32), jnp.int32(slot),
             jnp.int32(start_pos), jnp.int32(n), jnp.int32(adapter_ix))
+
+    @property
+    def mixed_row_queries(self) -> int:
+        """Padded query positions PER DECODE SLOT inside a mixed dispatch:
+        the ragged kernel pads every slot's row to q_block, while the XLA
+        fallback keeps the raw speculative width — the scheduler's
+        ragged_row_util gauge divides by this so 'kernel occupancy' means
+        what the kernel actually ran."""
+        if self.model_cfg.attn_impl == "pallas":
+            return self._mixed_q_block
+        return self.spec_width
+
+    @property
+    def mixed_supported(self) -> bool:
+        """Mixed-phase dispatch (one program = decode step + prefill chunk,
+        kv_cache.mixed_step) available for the engine's CURRENT state: the
+        init-time gate held AND no adapter tree is resident — the fused
+        forward runs base weights for every row, so the first
+        register_adapter() turns the mixed path off and the scheduler
+        reverts to the two-dispatch path."""
+        return self._mixed and self.adapters is None
 
     # ---------------------------------------------- long-context prefill
 
@@ -928,6 +1014,25 @@ class EngineCore:
             for steps in steps_list:
                 state, out = self.decode(state, table, steps,
                                          use_grammar=bool(gs))
+            if self.mixed_supported:
+                # the mixed-phase program's mid-chunk and final-chunk
+                # variants at EVERY depth the adaptive scheduler can pick,
+                # in BOTH grammar modes — a grammared slot decoding when a
+                # plain long prompt is admitted dispatches
+                # decode_mixed(use_grammar=True), which must not pay its
+                # compile mid-serving (narrower page-pressure depths
+                # compile lazily, same as the decode grid)
+                for last in (False, True):
+                    item = PrefillItem(
+                        chunk_ids=[1] * min(4, self.chunk),
+                        page_row=np.zeros((self.max_pages_per_slot,),
+                                          np.int32),
+                        slot=self.batch, start_pos=0, is_last=last,
+                        generated=1, max_gen=0)
+                    for steps in steps_list:
+                        state, out = self.decode_mixed(
+                            state, table, steps, item,
+                            use_grammar=bool(gs))
         jax.block_until_ready(out["packed"])
         # the throwaway pool frees here; callers init the real state after
 
@@ -1080,10 +1185,15 @@ class EngineCore:
 
     # ----------------------------------------------------------------- decode
 
-    def _decode_impl(self, state: DecodeState, params, adapters, page_table,
-                     gram_table, gram_accept, gram_dist, tok_bytes, tok_lens,
-                     steps: int, use_grammar: bool, want_top: bool
-                     ) -> Tuple[DecodeState, Dict[str, Any]]:
+    def _decode_step_fn(self, params, adapters, page_table, gram_table,
+                        gram_accept, gram_dist, tok_bytes, tok_lens,
+                        use_grammar: bool, want_top: bool):
+        """Build the one-decode-step body shared by the pure-decode scan
+        (`_decode_impl`) and the mixed-phase program (`_mixed_impl`).
+        Returns ``step(state, forward=None) -> (state, out)`` with out
+        leaves shaped (W, B); ``forward`` overrides the model call of THIS
+        step — the mixed program injects kv_cache.mixed_step as step 0's
+        forward so a prefill chunk rides the same dispatch."""
         from generativeaiexamples_tpu.ops.sampling import (
             sample_logits_per_slot, token_logprob)
         W = self.spec_width
@@ -1097,11 +1207,13 @@ class EngineCore:
             return history.at[batch_ix if vals.ndim == 1 else
                               batch_ix[:, None], safe].set(vals, mode="drop")
 
-        def step_narrow(state):
-            logits, cache = kv_cache.decode_step(
-                params, self.model_cfg, state.tokens, state.cache,
-                page_table, state.active, self.num_pages, adapters=adapters,
-                adapter_ix=state.adapter_ix, mesh=self.mesh)
+        def step_narrow(state, forward=None):
+            if forward is None:
+                forward = lambda st: kv_cache.decode_step(
+                    params, self.model_cfg, st.tokens, st.cache,
+                    page_table, st.active, self.num_pages, adapters=adapters,
+                    adapter_ix=st.adapter_ix, mesh=self.mesh)
+            logits, cache = forward(state)
             raw = logits.astype(jnp.float32)   # logprobs: model distribution
             if use_grammar:
                 # constrained decoding INSIDE the fused step: byte-DFA
@@ -1163,7 +1275,7 @@ class EngineCore:
                 out["top_lps"] = (top_vals - lse)[None]
             return new_state, out
 
-        def step_wide(state):
+        def step_wide(state, forward=None):
             # prompt-lookup speculative verify: draft W-1 tokens from the
             # slot's own history, run ONE widened step over current+drafts,
             # accept the longest prefix matching the per-position seeded
@@ -1175,6 +1287,11 @@ class EngineCore:
                 grammar_advance, grammar_mask)
             from generativeaiexamples_tpu.ops.speculative import (
                 acceptance, draft_lookup)
+            if forward is None:
+                forward = lambda inp, st: kv_cache.decode_step_wide(
+                    params, self.model_cfg, inp, st.cache, page_table,
+                    st.active, self.num_pages, adapters=adapters,
+                    adapter_ix=st.adapter_ix, mesh=self.mesh)
             L = state.cache.lengths
             draft, dlen = draft_lookup(state.history, L, W - 1,
                                        self.cfg.spec_ngram)
@@ -1183,10 +1300,7 @@ class EngineCore:
                 # one sampled token at a time); their drafts are voided
                 dlen = jnp.where(state.gram_state > 0, 0, dlen)
             inputs = jnp.concatenate([state.tokens[:, None], draft], axis=1)
-            logits_w, cache = kv_cache.decode_step_wide(
-                params, self.model_cfg, inputs, state.cache, page_table,
-                state.active, self.num_pages, adapters=adapters,
-                adapter_ix=state.adapter_ix, mesh=self.mesh)
+            logits_w, cache = forward(inputs, state)
             raw = logits_w.astype(jnp.float32)            # (B, W, V)
             logits_s = raw
             if use_grammar:
@@ -1279,20 +1393,33 @@ class EngineCore:
                 out["top_lps"] = jnp.transpose(top_vals - lse, (1, 0, 2))
             return new_state, out
 
-        def step(state, _):
-            return step_wide(state) if W > 1 else step_narrow(state)
+        return step_wide if W > 1 else step_narrow
 
+    def _decode_impl(self, state: DecodeState, params, adapters, page_table,
+                     gram_table, gram_accept, gram_dist, tok_bytes, tok_lens,
+                     steps: int, use_grammar: bool, want_top: bool
+                     ) -> Tuple[DecodeState, Dict[str, Any]]:
+        step = self._decode_step_fn(params, adapters, page_table, gram_table,
+                                    gram_accept, gram_dist, tok_bytes,
+                                    tok_lens, use_grammar, want_top)
         # K fused steps per dispatch: the host syncs once per K (or K·W
         # with speculation) tokens/slot, which is what makes decode
         # dispatch-latency-proof (SURVEY hard-part #3; essential over the
         # tunneled single-chip dev setup, still a win on local PCIe/ICI-
         # attached hosts). outs arrays are (K, W, B).
-        state, outs = jax.lax.scan(step, state, None, length=steps)
+        state, outs = jax.lax.scan(lambda s, _: step(s), state, None,
+                                   length=steps)
+        return state, self._pack_decode_outs(outs, steps, want_top)
+
+    def _pack_decode_outs(self, outs: Dict[str, Any], steps: int,
+                          want_top: bool) -> Dict[str, Any]:
         # one contiguous int32 block so the host fetches the whole dispatch
         # result in a single transfer (a pytree device_get pays one round
         # trip PER LEAF — 5x the latency on a remote-attached chip);
         # float rows ride as raw bits (bitcast), not int casts. Micro-rows
         # are (step, position) pairs flattened in order.
+        B = self.batch
+        W = self.spec_width
         R = steps * W
 
         def as_row(k):
@@ -1316,7 +1443,112 @@ class EngineCore:
         if want_top:
             outs["top_ids"] = outs["top_ids"].reshape(R, B, TOP_LP)
             outs["top_lps"] = outs["top_lps"].reshape(R, B, TOP_LP)
-        return state, outs
+        return outs
+
+    def _mixed_impl(self, state: DecodeState, params, adapters, page_table,
+                    gram_table, gram_accept, gram_dist, tok_bytes, tok_lens,
+                    tokens, page_row, slot, start_pos, chunk_len, generated,
+                    max_gen, temperature, top_k, top_p, seed, steps: int,
+                    use_grammar: bool, want_top: bool, is_last: bool
+                    ) -> Tuple[DecodeState, Dict[str, Any]]:
+        """The MIXED-PHASE program: `steps` fused decode steps where step 0's
+        forward ALSO prefills one chunk (kv_cache.mixed_step) — prefill
+        stops being a separate dispatch, so a long admission no longer
+        stalls the decode tick (ROADMAP item 2; the r05 third-phase TTFT
+        tail). Decode semantics are bit-identical to `_decode_impl` (same
+        step body, with step 0's model call swapped); the chunk follows the
+        `_chunk_impl` / `_chunk_last_impl` contract: lengths + history are
+        set after step 0, and ``is_last`` chunks run the fused first-token
+        sample + slot activation AFTER the scan, so the fresh slot starts
+        decoding next dispatch exactly as on the two-dispatch path.
+        The chunk tail is unconstrained (grammared finals keep the grouped
+        prefill path — the scheduler routes them there)."""
+        step = self._decode_step_fn(params, adapters, page_table, gram_table,
+                                    gram_accept, gram_dist, tok_bytes,
+                                    tok_lens, use_grammar, want_top)
+        W = self.spec_width
+        cell: Dict[str, Any] = {}
+
+        if W > 1:
+            def forward(inputs, st):
+                dec, ch, cache = kv_cache.mixed_step(
+                    params, self.model_cfg, inputs, st.cache, page_table,
+                    st.active, self.num_pages, tokens, page_row, start_pos,
+                    chunk_len, mesh=self.mesh, q_block=self._mixed_q_block)
+                cell["chunk_logits"] = ch
+                return dec, cache
+        else:
+            def forward(st):
+                dec, ch, cache = kv_cache.mixed_step(
+                    params, self.model_cfg, st.tokens[:, None], st.cache,
+                    page_table, st.active, self.num_pages, tokens, page_row,
+                    start_pos, chunk_len, mesh=self.mesh,
+                    q_block=self._mixed_q_block)
+                cell["chunk_logits"] = ch
+                # mirror kv_cache.decode_step's narrow wrapper contract
+                return dec[:, 0], dataclasses.replace(
+                    cache, lengths=cache.lengths + 1)
+
+        state, out0 = step(state, forward=forward)
+        # the chunk's page writes are now part of the dispatched program:
+        # record its lengths + history exactly as _chunk_impl does (the
+        # chunk's slot is inactive during the scan, so later steps keep
+        # both untouched)
+        state = dataclasses.replace(
+            state,
+            cache=dataclasses.replace(
+                state.cache,
+                lengths=state.cache.lengths.at[slot].set(
+                    start_pos + chunk_len)),
+            history=self._hist_write_chunk(state.history, slot, tokens[0],
+                                           start_pos, chunk_len))
+        if steps > 1:
+            state, outs = jax.lax.scan(lambda s, _: step(s), state, None,
+                                       length=steps - 1)
+            outs = jax.tree.map(
+                lambda a, b: jnp.concatenate([a[None], b], axis=0), out0,
+                outs)
+        else:
+            outs = jax.tree.map(lambda x: x[None], out0)
+        if is_last:
+            # fused first-token sample + activation AFTER the scan: the
+            # fresh slot joins decode at the NEXT dispatch, so its first
+            # token resolves through the same batched fetch / input_tokens
+            # paths as a grouped-prefill activation
+            state, _tok = self._activate_sampled(
+                state, state.cache, cell["chunk_logits"], slot, generated,
+                max_gen, temperature, top_k, top_p, seed)
+        return state, self._pack_decode_outs(outs, steps, want_top)
+
+    def decode_mixed(self, state: DecodeState, page_table: jax.Array,   # tpulint: hot-path
+                     steps: int, item: PrefillItem,
+                     use_grammar: bool = False, want_top: bool = False
+                     ) -> Tuple[DecodeState, Dict[str, Any]]:
+        """One mixed-phase dispatch: ``steps`` fused decode steps PLUS one
+        prefill chunk riding the same program (`_mixed_impl`). ``item`` is
+        the chunk exactly as `prefill_group` would take it (the scheduler's
+        packing policy is unchanged — this is the same chunk, fused instead
+        of dispatched separately). Requires `mixed_supported`; the out
+        block is identical to `decode`'s."""
+        if not self.mixed_supported:
+            raise ValueError("mixed-phase dispatch is gated off for this "
+                             "engine (APP_MIXED_PHASE_DISPATCH, adapters, "
+                             "or an unsupported config)")
+        n = len(item.chunk_ids)
+        if n > self.chunk:
+            raise ValueError(f"chunk of {n} tokens exceeds prefill_chunk "
+                             f"({self.chunk})")
+        padded = np.zeros((1, self.chunk), np.int32)
+        padded[0, :n] = item.chunk_ids
+        return self._mixed_fn(
+            state, self.params, self.adapters, page_table,
+            *self._gram_args(use_grammar), jnp.asarray(padded),
+            jnp.asarray(item.page_row, jnp.int32), jnp.int32(item.slot),
+            jnp.int32(item.start_pos), jnp.int32(n),
+            jnp.int32(item.generated), jnp.int32(item.max_gen),
+            jnp.float32(item.temperature), jnp.int32(item.top_k),
+            jnp.float32(item.top_p), jnp.int32(item.seed), steps,
+            use_grammar, want_top, bool(item.is_last))
 
     def decode(self, state: DecodeState, page_table: jax.Array,
                steps: int = 1, use_grammar: bool = False,

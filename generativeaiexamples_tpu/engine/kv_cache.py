@@ -145,14 +145,12 @@ def _kv_dequant_dense(q: jnp.ndarray, s: jnp.ndarray, KV: int, HD: int,
             * s[..., None]).astype(dtype)
 
 
-def _write_pages_dense(pools, flat_pages, flat_rows, k, v, G, C, n_cp, ps,
-                       T, KV, HD, dtype):
-    """Shared prefill page write + dense attention view, both pool modes.
+def _scatter_pages(pools, flat_pages, k, v, G, C, n_cp, ps, KV, HD):
+    """Scatter a page-aligned chunk's K/V into whole physical pages.
 
     k, v: (G, C, KV, HD) new chunk KV; flat_pages: (G*n_cp,) physical rows
-    to scatter whole pages into; flat_rows: (G, maxp) rows to gather the
-    dense (G, T, KV, HD) attention view back out. Quantizes per token/head
-    when the pools carry scales. Returns (k_dense, v_dense, pools')."""
+    to scatter into. Quantizes per token/head when the pools carry scales.
+    Returns pools'."""
     if len(pools) == 4:
         k_pool, v_pool, ks_pool, vs_pool = pools
         kq, ks = _kv_quantize(k.reshape(G, C, KV * HD), KV, HD)
@@ -162,23 +160,46 @@ def _write_pages_dense(pools, flat_pages, flat_rows, k, v, G, C, n_cp, ps,
         # pool layout is (rows, KV, ps): transpose the per-token scales in
         sT = lambda s: (s.reshape(G, n_cp, ps, KV)
                         .transpose(0, 1, 3, 2).reshape(G * n_cp, KV, ps))
-        new_ks = ks_pool.at[flat_pages].set(sT(ks))
-        new_vs = vs_pool.at[flat_pages].set(sT(vs))
+        return (new_k, new_v, ks_pool.at[flat_pages].set(sT(ks)),
+                vs_pool.at[flat_pages].set(sT(vs)))
+    k_pool, v_pool = pools
+    return (k_pool.at[flat_pages].set(
+                k.astype(k_pool.dtype).reshape(G * n_cp, ps, KV * HD)),
+            v_pool.at[flat_pages].set(
+                v.astype(v_pool.dtype).reshape(G * n_cp, ps, KV * HD)))
+
+
+def _gather_dense(pools, flat_rows, G, T, KV, HD, dtype):
+    """Dense (G, T, KV, HD) attention view of the pool rows ``flat_rows``
+    (G, maxp) — the XLA-fallback read path (the pallas kernels instead DMA
+    pages in place). Dequantizes when the pools carry scales."""
+    ps = pools[0].shape[1]
+    if len(pools) == 4:
+        k_pool, v_pool, ks_pool, vs_pool = pools
         dT = lambda sp: (sp[flat_rows].reshape(G, -1, KV, ps)
                          .transpose(0, 1, 3, 2).reshape(G, T, KV))
-        k_dense = _kv_dequant_dense(new_k[flat_rows].reshape(G, T, -1),
-                                    dT(new_ks), KV, HD, dtype)
-        v_dense = _kv_dequant_dense(new_v[flat_rows].reshape(G, T, -1),
-                                    dT(new_vs), KV, HD, dtype)
-        return k_dense, v_dense, (new_k, new_v, new_ks, new_vs)
-    k_pool, v_pool = pools
-    new_k = k_pool.at[flat_pages].set(
-        k.astype(k_pool.dtype).reshape(G * n_cp, ps, KV * HD))
-    new_v = v_pool.at[flat_pages].set(
-        v.astype(v_pool.dtype).reshape(G * n_cp, ps, KV * HD))
-    k_dense = new_k[flat_rows].reshape(G, T, KV, HD)
-    v_dense = new_v[flat_rows].reshape(G, T, KV, HD)
-    return k_dense, v_dense, (new_k, new_v)
+        k_dense = _kv_dequant_dense(k_pool[flat_rows].reshape(G, T, -1),
+                                    dT(ks_pool), KV, HD, dtype)
+        v_dense = _kv_dequant_dense(v_pool[flat_rows].reshape(G, T, -1),
+                                    dT(vs_pool), KV, HD, dtype)
+        return k_dense, v_dense
+    return (pools[0][flat_rows].reshape(G, T, KV, HD),
+            pools[1][flat_rows].reshape(G, T, KV, HD))
+
+
+def _write_pages_dense(pools, flat_pages, flat_rows, k, v, G, C, n_cp, ps,
+                       T, KV, HD, dtype):
+    """Shared prefill page write + dense attention view, both pool modes.
+
+    k, v: (G, C, KV, HD) new chunk KV; flat_pages: (G*n_cp,) physical rows
+    to scatter whole pages into; flat_rows: (G, maxp) rows to gather the
+    dense (G, T, KV, HD) attention view back out. Quantizes per token/head
+    when the pools carry scales. Returns (k_dense, v_dense, pools')."""
+    out_pools = _scatter_pages(pools, flat_pages, k, v, G, C, n_cp, ps, KV,
+                               HD)
+    k_dense, v_dense = _gather_dense(out_pools, flat_rows, G, T, KV, HD,
+                                     dtype)
+    return k_dense, v_dense, out_pools
 
 
 class PageAllocator:
@@ -544,17 +565,9 @@ def decode_step_wide(params: llama.Params, cfg: llama.LlamaConfig,
                                               k_scales=new_ks,
                                               v_scales=new_vs)
         else:
-            def sTd(sp):
-                return (sp[idx * num_pages + page_table]
-                        .transpose(0, 1, 3, 2).reshape(B, T, KV))
-            k_dense = new_k[idx * num_pages + page_table].reshape(
-                B, T, KV, HD) if not quant else _kv_dequant_dense(
-                new_k[idx * num_pages + page_table].reshape(B, T, -1),
-                sTd(new_ks), KV, HD, h.dtype)
-            v_dense = new_v[idx * num_pages + page_table].reshape(
-                B, T, KV, HD) if not quant else _kv_dequant_dense(
-                new_v[idx * num_pages + page_table].reshape(B, T, -1),
-                sTd(new_vs), KV, HD, h.dtype)
+            k_dense, v_dense = _gather_dense(
+                out_pools, idx * num_pages + page_table, B, T, KV, HD,
+                h.dtype)
             ctx = mha_prefill(
                 q, k_dense, v_dense, q_positions=positions,
                 kv_positions=cache_positions,
@@ -571,6 +584,190 @@ def decode_step_wide(params: llama.Params, cfg: llama.LlamaConfig,
     return logits, PagedKVCache(k=pools[0], v=pools[1], lengths=cache.lengths,
                                 k_s=pools[2] if quant else None,
                                 v_s=pools[3] if quant else None)
+
+
+def mixed_step(params: llama.Params, cfg: llama.LlamaConfig,   # tpulint: hot-path
+               tokens: jnp.ndarray, cache: PagedKVCache,
+               page_table: jnp.ndarray, write_mask: jnp.ndarray,
+               num_pages: int, chunk_tokens: jnp.ndarray,
+               chunk_page_row: jnp.ndarray, chunk_start: jnp.ndarray,
+               chunk_len: jnp.ndarray, mesh=None, q_block: int = 8,
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, PagedKVCache]:
+    """ONE mixed-phase forward: a Q-wide decode step for every slot PLUS one
+    prefill chunk, fused into a single program — the ragged-paged-attention
+    serving shape (ROADMAP item 2, arxiv 2604.15464). Prefill and decode
+    stop being separate dispatches: the chunk's matmuls fatten the decode
+    step's tiles instead of stalling the decode tick, which is the
+    single-chip fix for prefill/decode interference (the r05 TTFT tail).
+
+    tokens: (B, Q) decode inputs exactly as in :func:`decode_step_wide`;
+    chunk_tokens: (1, C) right-padded page-aligned chunk of the PREFILLING
+    slot (which must be masked out of ``write_mask`` — it is not decoding
+    yet); chunk_page_row: (max_pages,) its block-table row; chunk_start /
+    chunk_len: scalars as in :func:`prefill_chunk`.
+
+    Under ``attn_impl == "pallas"`` all rows run as ONE
+    ``ragged_paged_attention`` kernel per layer (decode slots are q_num=Q
+    rows, the chunk C/q_block rows); otherwise the XLA fallback computes
+    the same math over dense gathered views. Base weights only — per-row
+    LoRA mixes cannot ride the fused (1, N) token axis, so EngineCore gates
+    the mixed program off while adapters are resident — and single-chip
+    (tp == 1; the TP meshes keep the two-dispatch path).
+
+    Returns (decode logits (B, Q, V), chunk last-valid-position logits
+    (1, V), cache) with ``lengths`` UNCHANGED: the engine advances decode
+    lengths by accepted counts and sets the chunk slot's length, exactly as
+    when :func:`decode_step_wide` and :func:`prefill_chunk` run separately
+    (which this must — and tests do — match numerically).
+    """
+    B, Q = tokens.shape
+    _, C = chunk_tokens.shape
+    ps = cache.page_size
+    if C % ps != 0:
+        raise ValueError(f"chunk size {C} must be page-aligned (page={ps})")
+    if C % q_block != 0 or q_block < 1:
+        raise ValueError(f"chunk size {C} must be a multiple of the ragged "
+                         f"q_block ({q_block})")
+    if _tp_degree(mesh) > 1:
+        raise ValueError("mixed_step is the single-chip serving path "
+                         "(tp == 1); tensor-parallel meshes keep the "
+                         "two-dispatch path")
+    n_cp = C // ps
+    maxp = page_table.shape[1]
+    T = maxp * ps
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    n_ch_rows = C // q_block
+
+    L = cache.lengths                                        # (B,)
+    dec_pos = L[:, None] + jnp.arange(Q, dtype=jnp.int32)[None]     # (B, Q)
+    ch_pos = chunk_start + jnp.arange(C, dtype=jnp.int32)[None]     # (1, C)
+    positions = jnp.concatenate([dec_pos.reshape(1, B * Q), ch_pos], axis=1)
+    flat_tokens = jnp.concatenate([tokens.reshape(1, B * Q), chunk_tokens],
+                                  axis=1)                           # (1, N)
+    h = llama.embed_tokens(params, cfg, flat_tokens)
+    cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta,
+                                 scaling=cfg.rope_scaling)
+
+    # decode rows: same write/attention geometry as decode_step_wide
+    attn_len = L + Q
+    batch_ix = jnp.arange(B, dtype=jnp.int32)[:, None]
+    ok = write_mask[:, None] & (dec_pos < T)
+    rows = jnp.where(ok, page_table[batch_ix, dec_pos // ps], jnp.int32(0))
+    offs = dec_pos % ps                                      # (B, Q)
+    # chunk pages: same geometry as prefill_chunk
+    chunk_pages = jax.lax.dynamic_slice(chunk_page_row,
+                                        (chunk_start // ps,), (n_cp,))
+    valid_through = (chunk_start + chunk_len)[None]          # (1,)
+
+    use_pallas = (cfg.attn_impl == "pallas" and cfg.sliding_window == 0
+                  and q_block >= Q
+                  and pallas_ops.ragged_paged_supported(ps, HD, q_block))
+    quant = cache.quantized
+
+    if use_pallas:
+        # per-row ragged metadata, shared by every layer's kernel call:
+        # B decode rows first, then the chunk's C/q_block rows
+        jr = jnp.arange(n_ch_rows, dtype=jnp.int32)
+        row_tables = jnp.concatenate(
+            [page_table, jnp.broadcast_to(chunk_page_row[None],
+                                          (n_ch_rows, maxp))])
+        q_num_ch = jnp.clip(chunk_len - jr * q_block, 0, q_block)
+        # idle tail rows (q_num == 0) get kv_len 0, NOT the chunk's end:
+        # the kernel skips their compute either way, but only a zero
+        # length clamps their page-index map to one repeated block so the
+        # K/V DMAs are elided too — otherwise every empty row of a short
+        # final chunk would stream the whole prefix per layer for nothing
+        kv_lens = jnp.concatenate(
+            [attn_len, jnp.where(q_num_ch > 0, chunk_start + chunk_len, 0)])
+        q_pos0 = jnp.concatenate([L, chunk_start + jr * q_block])
+        q_num = jnp.concatenate(
+            [jnp.full((B,), Q, jnp.int32), q_num_ch])
+    cache_positions = jnp.broadcast_to(
+        jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+
+    def attn_and_update(q, k, v, pools, idx):
+        # q/k/v: (1, N, H|KV, HD) — B*Q decode rows, then the C chunk rows
+        k_dec = k[:, :B * Q].reshape(B, Q, KV * HD)
+        v_dec = v[:, :B * Q].reshape(B, Q, KV * HD)
+        k_ch = k[:, B * Q:]                                  # (1, C, KV, HD)
+        v_ch = v[:, B * Q:]
+        # chunk pages scatter first, then the decode rows — the page sets
+        # are disjoint (the chunk's slot is write-masked out of decode)
+        flat_pages = idx * num_pages + chunk_pages
+        pools = _scatter_pages(pools, flat_pages, k_ch, v_ch, 1, C, n_cp,
+                               ps, KV, HD)
+        flat_rows = idx * num_pages + rows                   # (B, Q)
+        if quant:
+            k_pool, v_pool, ks_pool, vs_pool = pools
+            kq, ks = _kv_quantize(k_dec, KV, HD)
+            vq, vs = _kv_quantize(v_dec, KV, HD)
+            new_k = k_pool.at[flat_rows, offs].set(kq)
+            new_v = v_pool.at[flat_rows, offs].set(vq)
+            new_ks = ks_pool.at[flat_rows, :, offs].set(ks)
+            new_vs = vs_pool.at[flat_rows, :, offs].set(vs)
+            out_pools = (new_k, new_v, new_ks, new_vs)
+        else:
+            new_k = pools[0].at[flat_rows, offs].set(
+                k_dec.astype(pools[0].dtype))
+            new_v = pools[1].at[flat_rows, offs].set(
+                v_dec.astype(pools[1].dtype))
+            new_ks = new_vs = None
+            out_pools = (new_k, new_v)
+        q_dec = q[0, :B * Q].reshape(B, Q, H, HD)
+        q_ch = q[:, B * Q:]                                  # (1, C, H, HD)
+        if use_pallas:
+            pad = q_block - Q
+            q_rows = q_dec if pad == 0 else jnp.pad(
+                q_dec, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            q_rows = jnp.concatenate(
+                [q_rows, q_ch[0].reshape(n_ch_rows, q_block, H, HD)])
+            ctx_rows = pallas_ops.ragged_paged_attention(
+                q_rows, new_k, new_v, row_tables, kv_lens, q_pos0, q_num,
+                layer=idx, pages_per_layer=num_pages, k_scales=new_ks,
+                v_scales=new_vs)
+            ctx = jnp.concatenate(
+                [ctx_rows[:B, :Q].reshape(1, B * Q, H, HD),
+                 ctx_rows[B:].reshape(1, C, H, HD)], axis=1)
+        else:
+            # the two-dispatch math over dense gathered views, fused into
+            # one program: decode rows then the chunk
+            k_dense, v_dense = _gather_dense(
+                out_pools, idx * num_pages + page_table, B, T, KV, HD,
+                h.dtype)
+            ctx_dec = mha_prefill(
+                q_dec, k_dense, v_dense, q_positions=dec_pos,
+                kv_positions=cache_positions,
+                kv_mask=cache_positions < attn_len[:, None], causal=True,
+                window=cfg.sliding_window)
+            kc_dense, vc_dense = _gather_dense(
+                out_pools, (idx * num_pages + chunk_page_row)[None], 1, T,
+                KV, HD, h.dtype)
+            ctx_ch = mha_prefill(
+                q_ch, kc_dense, vc_dense, q_positions=ch_pos,
+                kv_positions=cache_positions[:1],
+                kv_mask=cache_positions[:1] < valid_through[:, None],
+                causal=True, window=cfg.sliding_window)
+            ctx = jnp.concatenate([ctx_dec.reshape(1, B * Q, H, HD),
+                                   ctx_ch], axis=1)
+        return ctx, out_pools
+
+    pools_in = ((cache.k, cache.v, cache.k_s, cache.v_s) if quant
+                else (cache.k, cache.v))
+    h, pools = llama.scan_blocks_inplace(
+        cfg, h, params, pools_in, cos, sin, attn_and_update, None)
+    # unembed only the rows anyone reads: every decode position + the
+    # chunk's last valid position
+    h_last = jnp.take_along_axis(
+        h, (B * Q + jnp.maximum(chunk_len - 1, 0))[None, None, None]
+        .astype(jnp.int32), axis=1)                          # (1, 1, D)
+    h_sel = jnp.concatenate([h[:, :B * Q], h_last], axis=1)
+    logits = llama._unembed(cfg, params, h_sel)              # (1, B*Q+1, V)
+    dec_logits = logits[0, :B * Q].reshape(B, Q, -1)
+    chunk_logits = logits[:, B * Q]                          # (1, V)
+    return dec_logits, chunk_logits, PagedKVCache(
+        k=pools[0], v=pools[1], lengths=cache.lengths,
+        k_s=pools[2] if quant else None,
+        v_s=pools[3] if quant else None)
 
 
 def prefill_seq_parallel(params: llama.Params, cfg: llama.LlamaConfig,

@@ -243,6 +243,13 @@ class Scheduler:
         self._admit_counter = 0
         self._holding = False      # inside a prefill-priority ramp episode
         self._hold_left = 0        # chunk budget remaining in the episode
+        # mixed-phase dispatch accounting (ragged paged attention): how
+        # many decode dispatches fused a prefill chunk, and the last
+        # dispatch's query-row utilization (active rows / padded rows) —
+        # the kernel-occupancy observables next to batch_occupancy
+        self._decode_dispatches = 0
+        self._mixed_dispatches = 0
+        self._ragged_row_util = 0.0
         # batched first-token fetches in flight: [(future, pairs)]. Several
         # ride concurrently (one per admission burst) — a single serialized
         # fetch would resolve the whole ramp's first tokens only after the
@@ -728,14 +735,12 @@ class Scheduler:
 
         job = self._prefilling[0]
         req = job.request
-        # Grammared requests stay on the chunked path: the long sequence-
-        # parallel program's activation tail clears gram_state (engine.py
-        # _activate_sampled), so taking it would silently drop token-level
-        # enforcement the serving layer promised the client.
-        if (job.prefilled == 0 and len(job.ids) > self.core.chunk
-                and req.grammar is None and not req.adapter
-                and self.core.cfg.long_prefill != "off"
-                and self.core.supports_long_prefill):
+        # Grammared requests stay on the chunked path (the predicate lives
+        # in _long_pass_claims, shared with the mixed packer): the long
+        # sequence-parallel program's activation tail clears gram_state
+        # (engine.py _activate_sampled), so taking it would silently drop
+        # token-level enforcement the serving layer promised the client.
+        if self._long_pass_claims(job):
             job.prefill_started = time.perf_counter()
             if req.prefill_start_at is None:
                 req.prefill_start_at = job.prefill_started
@@ -1050,7 +1055,73 @@ class Scheduler:
             steps *= 2
         return steps
 
-    def _dispatch_decode(self) -> None:   # tpulint: hot-path
+    def _long_pass_claims(self, job: _Job) -> bool:
+        """Will the sequence-parallel long-prefill pass take this job's
+        whole prompt? ONE predicate shared by the grouped packer
+        (_prefill_step_inner) and the mixed packer (_mixed_eligible) — if
+        the two ever disagreed, a job the ring pass expects could be
+        consumed chunk-by-chunk instead (or vice versa)."""
+        req = job.request
+        return (job.prefilled == 0 and len(job.ids) > self.core.chunk
+                and req.grammar is None and not req.adapter
+                and self.core.cfg.long_prefill != "off"
+                and self.core.supports_long_prefill)
+
+    def _mixed_eligible(self, job: _Job) -> bool:
+        """May this prefilling job's NEXT chunk ride the decode dispatch
+        (engine.decode_mixed)? The packing policy is the existing chunked-
+        prefill sizing; what stays on the two-dispatch path: jobs the
+        sequence-parallel long pass will claim, adapter'd jobs (the mixed
+        forward runs base weights only), grammared FINAL chunks (their
+        fused first token must sample under the DFA, which only the grouped
+        prefill program wires up), and the BULK of very long prompts — the
+        mixed program fuses one chunk per dispatch while the grouped path
+        moves up to prefill_group chunks per tick, so a prompt with more
+        than a group of chunks left would prefill group-times slower fused;
+        it takes the grouped path until its tail fits one group."""
+        req = job.request
+        if job.adapter_ix or req.adapter:
+            return False
+        if self._long_pass_claims(job):
+            return False
+        remaining = len(job.ids) - job.prefilled
+        if remaining > max(1, self.core.cfg.prefill_group) * self.core.chunk:
+            return False
+        last = remaining <= self.core.chunk
+        if last and req.grammar is not None:
+            return False
+        return True
+
+    def _pack_mixed_chunk(self):   # tpulint: hot-path
+        """Build the head prefilling job's next chunk as a PrefillItem to
+        ride THIS decode dispatch. Called AFTER _grow_pages (whose page-
+        pressure preemption may evict the head), so every check re-runs
+        against post-grow state; returns (item, job, is_last) or None (the
+        chunk then takes the normal grouped-prefill dispatch next tick)."""
+        from generativeaiexamples_tpu.engine.engine import PrefillItem
+        if (len(self._prefilling) != 1 or not self._slots
+                or not getattr(self.core, "mixed_supported", False)):
+            return None
+        job = self._prefilling[0]
+        if not self._mixed_eligible(job):
+            return None
+        req = job.request
+        start = job.prefilled
+        chunk_ids = job.ids[start:start + self.core.chunk]
+        last = start + len(chunk_ids) >= len(job.ids)
+        if start == job.shared:
+            job.prefill_started = time.perf_counter()
+            if req.prefill_start_at is None:
+                req.prefill_start_at = job.prefill_started
+        item = PrefillItem(
+            chunk_ids=chunk_ids, page_row=self._table[job.slot],
+            slot=job.slot, start_pos=start, is_last=last,
+            generated=len(job.gen_ids) + 1, max_gen=req.max_tokens,
+            temperature=req.temperature, top_k=req.top_k, top_p=req.top_p,
+            seed=req.seed or 0)
+        return item, job, last
+
+    def _dispatch_decode(self, try_mixed: bool = False) -> None:   # tpulint: hot-path
         """Issue one K-step decode dispatch without waiting for its result
         (dispatch-ahead pipelining: the transfer of dispatch N overlaps the
         compute of dispatch N+1, hiding host-device sync latency entirely —
@@ -1061,6 +1132,7 @@ class Scheduler:
         steps = self._grow_pages(self._steps)
         if not self._slots:
             return
+        packed_chunk = self._pack_mixed_chunk() if try_mixed else None
         fresh = [(s, j) for s, j in self._slots.items()
                  if j.first_pending and not j.first_inflight]
         for _, j in fresh:
@@ -1069,8 +1141,36 @@ class Scheduler:
         use_grammar = any(j.gram_on for j in self._slots.values())
         want_top = any(j.request.logprobs and j.request.top_logprobs > 0
                        for j in self._slots.values())
-        self._state, out = self.core.decode(self._state, self._table_device(),
-                                            steps, use_grammar, want_top)
+        if packed_chunk is not None:
+            # mixed-phase dispatch: the chunk rides the decode program
+            # (ragged paged attention) — active slots' decode tick is not
+            # stalled by a separate prefill dispatch
+            item, mixed_job, mixed_last = packed_chunk
+            self._state, out = self.core.decode_mixed(
+                self._state, self._table_device(), steps, item, use_grammar,
+                want_top)
+            self._mixed_dispatches += 1
+            REGISTRY.counter("mixed_dispatches").inc()
+            REGISTRY.counter("prefill_chunks").inc()
+        else:
+            self._state, out = self.core.decode(
+                self._state, self._table_device(), steps, use_grammar,
+                want_top)
+        self._decode_dispatches += 1
+        # kernel occupancy of this dispatch's query rows: active query
+        # positions over padded positions. A fused chunk pads to the full
+        # prefill_chunk bucket, and inside a mixed dispatch every decode
+        # slot's row pads to the engine's padded row width (q_block under
+        # the ragged kernel, spec_w under the XLA fallback) — the gauge
+        # must report what the kernel actually ran
+        active_q = len(self._slots) * self._spec_w
+        padded_q = self.core.batch * self._spec_w
+        if packed_chunk is not None:
+            row_q = getattr(self.core, "mixed_row_queries", self._spec_w)
+            active_q += len(item.chunk_ids)
+            padded_q = self.core.batch * row_q + self.core.chunk
+        self._ragged_row_util = active_q / padded_q
+        REGISTRY.gauge("ragged_row_util").set(round(self._ragged_row_util, 4))
         REGISTRY.histogram("decode_issue_s").observe(time.perf_counter() - t0)
         REGISTRY.histogram("decode_batch_fill").observe(
             len(self._slots) / self.core.batch)
@@ -1089,6 +1189,21 @@ class Scheduler:
                                dict(self._slots)))
         self._pending_steps += steps * self._spec_w
         REGISTRY.counter("decode_steps").inc(steps)
+        if packed_chunk is not None:
+            # the fused chunk's writes are now dispatched: advance the
+            # job's prefill bookkeeping exactly as _prefill_step_inner
+            # does. An is_last chunk activated its slot ON DEVICE at the
+            # end of the dispatch (after the fused decode steps), so the
+            # job joins _slots AFTER the in-flight snapshot above — its
+            # first token resolves via the next dispatch / batched fetch,
+            # never against this dispatch's stale step-0 inputs.
+            mixed_job.prefilled = item.start_pos + len(item.chunk_ids)
+            mixed_job.total_len = mixed_job.prefilled
+            if mixed_last:
+                self._prefilling.remove(mixed_job)
+                self._cache_insert(mixed_job)
+                self._mark_first_pending(mixed_job, None)
+                self._slots[mixed_job.slot] = mixed_job
 
     def _process_decode(self) -> None:   # tpulint: hot-path
         """Sync + fan out the OLDEST in-flight dispatch (FIFO). Rows of the
@@ -1166,6 +1281,14 @@ class Scheduler:
             "prefix_hit_tokens": REGISTRY.counter("prefix_hit_tokens").value,
             "preemptions": REGISTRY.counter("preemptions").value,
             "tokens_generated": REGISTRY.counter("tokens_generated").value,
+            # mixed-phase dispatch observables (mirrored as flight_* gauges):
+            # what fraction of decode dispatches fused a prefill chunk, and
+            # the last dispatch's active/padded query-row utilization —
+            # kernel occupancy next to the slot-level `fill`
+            "mixed_dispatch_frac": round(
+                self._mixed_dispatches / self._decode_dispatches, 4)
+                if self._decode_dispatches else 0.0,
+            "ragged_row_util": round(self._ragged_row_util, 4),
         }
 
     def _tick(self) -> bool:   # tpulint: hot-path
@@ -1221,7 +1344,19 @@ class Scheduler:
             self._hold_left = self.core.cfg.prefill_hold_chunks
         elif not ramp:
             self._holding = False
-        if self._prefilling:
+        # Mixed-phase dispatch: when ONE job is prefilling while decode is
+        # live (the r05 TTFT-tail shape — a long prompt admitted mid-
+        # decode), its next chunk rides the decode dispatch as extra ragged
+        # rows (engine.decode_mixed) instead of a separate program, so the
+        # decode tick never stalls for it. Ramps (hold active) and multi-
+        # job refills keep the grouped prefill path — G-at-once activation
+        # beats one fused chunk there.
+        try_mixed = (bool(self._prefilling) and bool(self._slots)
+                     and len(self._prefilling) == 1
+                     and not (self._holding and self._hold_left > 0)
+                     and getattr(self.core, "mixed_supported", False)
+                     and self._mixed_eligible(self._prefilling[0]))
+        if self._prefilling and not try_mixed:
             # ONE grouped dispatch per tick: up to prefill_group jobs' chunks
             # ride a single program (same device-seconds as serial chunks,
             # 1/G the dispatch overhead, G-at-once slot activation). Each
@@ -1259,7 +1394,7 @@ class Scheduler:
                 j.first_batched = True
             self._first_fetches.append((fut, waiting))
         if self._slots and not hold:
-            self._dispatch_decode()
+            self._dispatch_decode(try_mixed)
             worked = True
         # backpressure: bound dispatches in flight; drain fully once
         # nothing is left to dispatch

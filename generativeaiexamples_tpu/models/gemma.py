@@ -18,6 +18,12 @@ code:
 Gemma always ties embeddings (no lm_head) and allows head_dim * n_heads !=
 dim (e.g. 2B: dim 2048, 8 heads of 256), which the llama layout already
 supports.
+
+Because every serving path keys off LlamaConfig knobs, Gemma also rides the
+mixed-phase dispatch (engine/kv_cache.mixed_step → ops/pallas
+ragged_paged_attention) unchanged: ``embed_scale`` applies inside the shared
+``embed_tokens`` and the 256-wide heads sit inside the ragged kernel's
+head_dim limits, so the engine-init gate resolves exactly as for llama.
 """
 
 from __future__ import annotations

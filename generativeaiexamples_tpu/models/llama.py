@@ -79,9 +79,11 @@ class LlamaConfig:
     n_experts_per_tok: int = 2
     capacity_factor: float = 1.25
     # "xla" | "pallas": inference attention backend. Pallas kernels
-    # (ops/pallas/attention.py) need head-axis-unsharded layouts; callers
-    # that shard heads over a tensor axis must keep "xla" (or wrap the
-    # kernels in shard_map).
+    # (ops/pallas/attention.py: flash prefill, ragged/paged decode, and the
+    # mixed-phase ragged-paged kernel that engine/kv_cache.mixed_step fuses
+    # prefill chunks + decode rows through) need head-axis-unsharded
+    # layouts; callers that shard heads over a tensor axis must keep "xla"
+    # (or wrap the kernels in shard_map).
     attn_impl: str = "xla"
 
     @staticmethod
@@ -556,7 +558,10 @@ def scan_blocks_inplace(cfg: LlamaConfig, h: jnp.ndarray, params: Params,
     ``pools`` is any tuple of pool arrays (k, v [, k_scales, v_scales] for
     a quantized cache); ``attn_and_update(q, k_chunk, v_chunk, pools,
     layer_idx) -> (ctx, pools')`` owns the writes and the (paged)
-    attention read. Returns (h, pools')."""
+    attention read — the token axis may even pack SEVERAL phases' rows
+    (kv_cache.mixed_step concatenates every slot's decode positions with a
+    prefill chunk and attends them as independent ragged rows).
+    Returns (h, pools')."""
     def body(carry, xs):
         h, pools, idx = carry
         layer, ad = xs

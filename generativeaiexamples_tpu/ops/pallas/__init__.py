@@ -10,6 +10,8 @@ from generativeaiexamples_tpu.ops.pallas.attention import (  # noqa: F401
     paged_decode,
     paged_decode_supported,
     ragged_decode,
+    ragged_paged_attention,
+    ragged_paged_supported,
     decode_supported,
     prefill_supported,
 )

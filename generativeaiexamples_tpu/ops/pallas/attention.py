@@ -70,6 +70,20 @@ def paged_decode_supported(page_size: int, head_dim: int) -> bool:
     return _pick_block(page_size, page_size) == page_size >= 8 and head_dim >= 8
 
 
+def ragged_paged_supported(page_size: int, head_dim: int,
+                           q_block: int = 8) -> bool:
+    """Shapes the mixed-phase ragged kernel handles. It DMAs one physical
+    page per grid step exactly like its decode special case, so the
+    page_size / head_dim limits are BY CONSTRUCTION the same as
+    :func:`paged_decode_supported` — the engine's config gate checks both at
+    init and refuses to start if they ever diverge (a kernel the chip
+    rejects at trace time must fail at engine init, not at first dispatch).
+    ``q_block`` (queries per ragged row) only adds a power-of-two row
+    granularity on top."""
+    return (paged_decode_supported(page_size, head_dim)
+            and q_block >= 1 and q_block & (q_block - 1) == 0)
+
+
 # ---------------------------------------------------------------------------
 # Flash prefill
 # ---------------------------------------------------------------------------
@@ -247,9 +261,9 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
                        jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
 
 
-def _paged_decode_kernel(lens_ref, table_ref, layer_ref, q_ref, k_ref, v_ref,
-                         *rest, ps: int, scale: float, KV: int, G: int,
-                         HD: int, quant: bool, Q: int = 1):
+def _ragged_paged_kernel(lens_ref, pos0_ref, qnum_ref, table_ref, layer_ref,
+                         q_ref, k_ref, v_ref, *rest, ps: int, scale: float,
+                         KV: int, G: int, HD: int, quant: bool, Qb: int = 1):
     # rest = (ks_ref, vs_ref, o_ref, acc, m, l) when quant else (o_ref, …):
     # a quantized pool carries int8 pages + (KV, ps) per-token-per-head
     # scale tiles; the dequant folds past the dots (scores/probabilities
@@ -259,19 +273,26 @@ def _paged_decode_kernel(lens_ref, table_ref, layer_ref, q_ref, k_ref, v_ref,
     else:
         ks_ref = vs_ref = None
         o_ref, acc_ref, m_ref, l_ref = rest
-    # Grid (B, maxp): ONE grid step per (slot, logical page), all KV heads
-    # processed in a static in-kernel loop — at serving shapes the per-page
-    # work is tiny, so a (B, KV, pages) grid is overhead-bound (profiled at
-    # ~0.25 us/step x 1024 steps x 28 layers ≈ 7 ms per decode step on a 3B
-    # model; this layout cuts the grid by KV x). ti is the LOGICAL page
-    # index (position ti*ps + row); table_ref/layer_ref ride in SMEM for the
-    # index maps alone.
+    # Grid (R, maxp): ONE grid step per (ragged row, logical page), all KV
+    # heads processed in a static in-kernel loop — at serving shapes the
+    # per-page work is tiny, so a (R, KV, pages) grid is overhead-bound
+    # (profiled at ~0.25 us/step x 1024 steps x 28 layers ≈ 7 ms per decode
+    # step on a 3B model; this layout cuts the grid by KV x). ti is the
+    # LOGICAL page index (position ti*ps + row); table_ref/layer_ref ride
+    # in SMEM for the index maps alone.
     #
-    # Q > 1 is the SPECULATIVE-VERIFY variant: the slot carries Q queries at
-    # consecutive positions length-Q .. length-1 (draft verification — the
-    # same page DMAs amortize over Q·G score rows, which also feeds the MXU
-    # fatter tiles). Query qi may attend keys at positions < length-Q+1+qi:
-    # per-query causal offsets, the only semantic difference from Q == 1.
+    # Each grid row r is an INDEPENDENT ragged span of up to Qb queries
+    # against its own page-table row — the mixed-phase formulation
+    # (ROADMAP item 2, arxiv 2604.15464): a decode slot is a row with
+    # q_num=1, a speculative-verify slot a row with q_num=W drafted
+    # positions, a prefill chunk a run of rows covering its whole chunk —
+    # one dispatch serves any mix. Per-row SMEM metadata:
+    #   lens_ref[r]  — live KV rows (INCLUDING this row's queries' writes);
+    #   pos0_ref[r]  — absolute position of the row's query 0 (query j sits
+    #                  at pos0+j and attends keys at positions <= pos0+j);
+    #   qnum_ref[r]  — valid queries; rows with 0 are SKIPPED (their page
+    #                  DMAs clamp to a repeated block and compute never
+    #                  runs), not padded — an idle row costs ~nothing.
     del table_ref, layer_ref
     b = pl.program_id(0)
     ti = pl.program_id(1)
@@ -284,19 +305,22 @@ def _paged_decode_kernel(lens_ref, table_ref, layer_ref, q_ref, k_ref, v_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
 
     length = lens_ref[b]
+    pos0 = pos0_ref[b]
+    q_num = qnum_ref[b]
     lim = (jnp.maximum(length, 1) - 1) // ps
-    QG = Q * G
+    QG = Qb * G
 
-    @pl.when(ti <= lim)
+    @pl.when((ti <= lim) & (q_num > 0))
     def _compute():
-        q = q_ref[0].astype(jnp.float32)           # (KV*Q*G, HD)
+        q = q_ref[0].astype(jnp.float32)           # (KV*Qb*G, HD)
         k = k_ref[0].astype(jnp.float32)           # (ps, KV*HD)
         v = v_ref[0].astype(jnp.float32)
-        # per-query causal limit: row r of a kv block is query r // G
+        # per-query causal limit: row r of a kv block is query r // G, at
+        # absolute position pos0 + r // G; padding queries (>= q_num) are
+        # fully masked — their output rows are the caller's to discard
         t_pos = ti * ps + jax.lax.broadcasted_iota(jnp.int32, (QG, ps), 1)
-        q_lim = (length - Q + 1
-                 + jax.lax.broadcasted_iota(jnp.int32, (QG, ps), 0) // G)
-        t_mask = t_pos < q_lim
+        q_ix = jax.lax.broadcasted_iota(jnp.int32, (QG, ps), 0) // G
+        t_mask = (t_pos <= pos0 + q_ix) & (q_ix < q_num)
         for kv in range(KV):                       # static unroll over heads
             k_head = k[:, kv * HD:(kv + 1) * HD]
             v_head = v[:, kv * HD:(kv + 1) * HD]
@@ -335,37 +359,44 @@ def _paged_decode_kernel(lens_ref, table_ref, layer_ref, q_ref, k_ref, v_ref,
                     jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
 
 
-def paged_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
-                 page_table: jnp.ndarray, lengths: jnp.ndarray,
-                 layer: Optional[jnp.ndarray] = None,
-                 pages_per_layer: Optional[int] = None,
-                 k_scales: Optional[jnp.ndarray] = None,
-                 v_scales: Optional[jnp.ndarray] = None,
-                 interpret: Optional[bool] = None) -> jnp.ndarray:
-    """Decode attention straight off the paged KV pool, 1..Q queries/slot.
+def ragged_paged_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
+                           v_pages: jnp.ndarray, row_tables: jnp.ndarray,
+                           kv_lens: jnp.ndarray, q_pos0: jnp.ndarray,
+                           q_num: jnp.ndarray,
+                           layer: Optional[jnp.ndarray] = None,
+                           pages_per_layer: Optional[int] = None,
+                           k_scales: Optional[jnp.ndarray] = None,
+                           v_scales: Optional[jnp.ndarray] = None,
+                           interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Mixed-phase ragged attention straight off the paged KV pool.
 
-    q: (B, Q, H, HD) — Q consecutive positions per slot, query qi at
-    position ``lengths[b] - Q + qi`` (Q=1 is classic decode; Q>1 is the
-    speculative-verify step: drafted tokens' KV is already written, the
-    per-query causal offset masks each query to its own prefix, and the
-    same page DMAs amortize over Q·G score rows). ``lengths`` counts live
-    rows INCLUDING all Q queries' writes.
+    The engine's single attention dispatch for any mix of serving phases
+    (ROADMAP item 2 / arxiv 2604.15464): q is (R, Qb, H, HD) — R
+    independent ragged rows of up to Qb queries each, every row reading its
+    OWN page-table row of the shared pool. A decode slot contributes one
+    row with ``q_num=1``, a speculative-verify slot one row with its draft
+    width, a prefill chunk ``C / Qb`` consecutive rows covering the whole
+    chunk; empty rows (``q_num=0``) are skipped outright — their page DMAs
+    clamp to a repeated block and compute never runs.
 
-    k_pages, v_pages: the physical pool in the kernel's
-    NATIVE flat layout (N, page, KV*HD) — for a multi-layer pool, N = L*P
-    with ``layer`` a ()/(1,) dynamic layer index and ``pages_per_layer`` = P,
-    so the caller's layer loop neither slices nor reshapes the pool (on a
-    multi-GB loop-carried buffer either would force XLA to materialize a
-    full copy per layer); page_table: (B, max_pages) logical→physical page
-    ids; lengths: (B,) live rows per slot (including the token written this
-    step).
+    Per-row metadata (scalar-prefetched SMEM):
+      row_tables: (R, maxp) logical→physical page ids;
+      kv_lens:    (R,) live KV rows, INCLUDING the row's own queries' writes;
+      q_pos0:     (R,) absolute position of query 0 — query j sits at
+                  ``q_pos0 + j`` and attends keys at positions <= that
+                  (per-row causal offsets);
+      q_num:      (R,) valid queries; output rows past q_num are garbage
+                  (finite, never NaN) the caller discards.
 
-    This is the decode-bandwidth kernel of the serving engine: each grid step
-    DMAs exactly one physical page chosen by scalar-prefetched table lookup —
-    no dense gather of the pool ever materializes (the XLA fallback in
-    engine/kv_cache.py moves ~2 extra copies of the cache per step), and
-    pages past the slot's length clamp to a repeated index so their DMA is
-    skipped entirely. Matches ``mha_decode`` on the gathered-dense view.
+    k_pages, v_pages: the physical pool in the kernel's NATIVE flat layout
+    (N, page, KV*HD) — for a multi-layer pool, N = L*P with ``layer`` a
+    ()/(1,) dynamic layer index and ``pages_per_layer`` = P, so the
+    caller's layer loop neither slices nor reshapes the pool (on a multi-GB
+    loop-carried buffer either would force XLA to materialize a full copy
+    per layer). Each grid step DMAs exactly one physical page chosen by
+    scalar-prefetched table lookup — no dense gather of the pool ever
+    materializes — and pages past a row's kv_len clamp to a repeated index
+    so their DMA is skipped entirely.
 
     ``k_scales``/``v_scales`` (N, KV, page) switch the kernel to its int8
     variant: pages hold int8 with the dequant folded past the dots —
@@ -373,32 +404,32 @@ def paged_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
     ((KV, page) blocks are native f32 tiles), so no per-element dequant
     runs in the kernel (the TRT-LLM kv-cache-quantization capability).
     """
-    B, Q, H, HD = q.shape
+    R, Qb, H, HD = q.shape
     N, ps, KVHD = k_pages.shape
     KV = KVHD // HD
     P = pages_per_layer if pages_per_layer is not None else N
     if layer is None:
         layer = jnp.zeros((), jnp.int32)
-    maxp = page_table.shape[1]
+    maxp = row_tables.shape[1]
     G = H // KV
     quant = k_scales is not None
     if interpret is None:
         interpret = _interpret_default()
 
-    # kv-major rows so the kernel's per-head slicing holds for any Q:
-    # row = kv*(Q*G) + qi*G + g
-    qg = (q.reshape(B, Q, KV, G, HD).transpose(0, 2, 1, 3, 4)
-          .reshape(B, KV * Q * G, HD))
+    # kv-major rows so the kernel's per-head slicing holds for any Qb:
+    # row = kv*(Qb*G) + qi*G + g
+    qg = (q.reshape(R, Qb, KV, G, HD).transpose(0, 2, 1, 3, 4)
+          .reshape(R, KV * Qb * G, HD))
 
-    def q_map(b, ti, lens, table, lyr):
-        return (b, 0, 0)
+    def q_map(r, ti, lens, pos0, qnum, table, lyr):
+        return (r, 0, 0)
 
-    def kv_map(b, ti, lens, table, lyr):
-        lim = (jnp.maximum(lens[b], 1) - 1) // ps
-        return (lyr[0] * P + table[b, jnp.minimum(ti, lim)], 0, 0)
+    def kv_map(r, ti, lens, pos0, qnum, table, lyr):
+        lim = (jnp.maximum(lens[r], 1) - 1) // ps
+        return (lyr[0] * P + table[r, jnp.minimum(ti, lim)], 0, 0)
 
     in_specs = [
-        pl.BlockSpec((1, KV * Q * G, HD), q_map),
+        pl.BlockSpec((1, KV * Qb * G, HD), q_map),
         pl.BlockSpec((1, ps, KV * HD), kv_map),
         pl.BlockSpec((1, ps, KV * HD), kv_map),
     ]
@@ -408,28 +439,57 @@ def paged_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
                      pl.BlockSpec((1, KV, ps), kv_map)]
         args += [k_scales, v_scales]
 
-    kernel = functools.partial(_paged_decode_kernel, ps=ps,
+    kernel = functools.partial(_ragged_paged_kernel, ps=ps,
                                scale=1.0 / (HD ** 0.5), KV=KV, G=G, HD=HD,
-                               quant=quant, Q=Q)
+                               quant=quant, Qb=Qb)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=3,
-            grid=(B, maxp),
+            num_scalar_prefetch=5,
+            grid=(R, maxp),
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((1, KV * Q * G, HD), q_map),
+            out_specs=pl.BlockSpec((1, KV * Qb * G, HD), q_map),
             scratch_shapes=[
-                pltpu.VMEM((KV * Q * G, HD), jnp.float32),
-                pltpu.VMEM((KV * Q * G, 128), jnp.float32),
-                pltpu.VMEM((KV * Q * G, 128), jnp.float32),
+                pltpu.VMEM((KV * Qb * G, HD), jnp.float32),
+                pltpu.VMEM((KV * Qb * G, 128), jnp.float32),
+                pltpu.VMEM((KV * Qb * G, 128), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((B, KV * Q * G, HD), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((R, KV * Qb * G, HD), q.dtype),
         interpret=interpret,
-    )(lengths.astype(jnp.int32), page_table.astype(jnp.int32),
+    )(kv_lens.astype(jnp.int32), q_pos0.astype(jnp.int32),
+      q_num.astype(jnp.int32), row_tables.astype(jnp.int32),
       jnp.reshape(layer, (1,)).astype(jnp.int32), *args)
-    return (out.reshape(B, KV, Q, G, HD).transpose(0, 2, 1, 3, 4)
-            .reshape(B, Q, H, HD))
+    return (out.reshape(R, KV, Qb, G, HD).transpose(0, 2, 1, 3, 4)
+            .reshape(R, Qb, H, HD))
+
+
+def paged_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
+                 page_table: jnp.ndarray, lengths: jnp.ndarray,
+                 layer: Optional[jnp.ndarray] = None,
+                 pages_per_layer: Optional[int] = None,
+                 k_scales: Optional[jnp.ndarray] = None,
+                 v_scales: Optional[jnp.ndarray] = None,
+                 interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Decode attention straight off the paged KV pool, 1..Q queries/slot.
+
+    The uniform-row special case of :func:`ragged_paged_attention`: every
+    slot is one ragged row of exactly Q valid queries ending at its length.
+    q: (B, Q, H, HD) — Q consecutive positions per slot, query qi at
+    position ``lengths[b] - Q + qi`` (Q=1 is classic decode; Q>1 is the
+    speculative-verify step: drafted tokens' KV is already written and the
+    per-query causal offset masks each query to its own prefix).
+    ``lengths`` counts live rows INCLUDING all Q queries' writes.
+    Matches ``mha_decode`` on the gathered-dense view; see
+    :func:`ragged_paged_attention` for the pool layout and int8 contract.
+    """
+    B, Q, _, _ = q.shape
+    lengths = lengths.astype(jnp.int32)
+    return ragged_paged_attention(
+        q, k_pages, v_pages, page_table, lengths, lengths - Q,
+        jnp.full((B,), Q, jnp.int32), layer=layer,
+        pages_per_layer=pages_per_layer, k_scales=k_scales,
+        v_scales=v_scales, interpret=interpret)
 
 
 def ragged_decode(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,

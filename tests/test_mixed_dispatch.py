@@ -1,0 +1,218 @@
+"""Mixed-phase dispatch: one program serves prefill-chunk + decode rows.
+
+The tentpole contract (ROADMAP item 2, ragged paged attention): fusing a
+prefill chunk into the decode dispatch (kv_cache.mixed_step wired through
+engine.decode_mixed and the scheduler's tick) must be NUMERICALLY the
+two-dispatch path — same decode logits, same chunk logits, same pool
+contents in every valid position — and behaviorally better: a long prompt
+admitted mid-decode rides the decode dispatches instead of stalling them.
+The fallback gate (APP_MIXED_PHASE_DISPATCH / engine.mixed_phase_dispatch)
+must resolve at engine init and fail loudly for configs the kernel cannot
+serve.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.core.config import EngineConfig
+from generativeaiexamples_tpu.engine import kv_cache
+from generativeaiexamples_tpu.engine.engine import EngineCore
+from generativeaiexamples_tpu.engine.kv_cache import PagedKVCache
+from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
+from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+from generativeaiexamples_tpu.models import llama
+
+TOL = 2e-2
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    params = llama.init_params(jax.random.PRNGKey(5), cfg)
+    return cfg, params, ByteTokenizer()
+
+
+# ---------------------------------------------------------------- kv_cache
+
+# two params keep the file inside the tier-1 budget: xla/none covers the
+# dense-gather fallback, pallas/int8 the ragged kernel + quantized pool
+# (the bf16 ragged kernel is pinned by tests/test_pallas.py directly)
+@pytest.mark.parametrize("attn_impl,kv_quant",
+                         [("xla", "none"), ("pallas", "int8")])
+def test_mixed_step_matches_two_dispatch(attn_impl, kv_quant):
+    """mixed_step(decode B slots + one chunk) == decode_step_wide then
+    prefill_chunk, on logits AND on a follow-up decode step that reads
+    every valid KV row back through attention (padding rows past chunk_len
+    legitimately hold different garbage — masked everywhere)."""
+    cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64),
+                              head_dim=16, attn_impl=attn_impl)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    B, ps, maxp, W, C = 3, 16, 8, 2, 32
+    num_pages = 32
+    cache = PagedKVCache.create(cfg, B, num_pages, ps, kv_quant=kv_quant)
+    table = np.zeros((B, maxp), np.int32)
+    rng = np.random.default_rng(0)
+    for slot in (0, 1):                      # two mid-decode slots
+        ids = rng.integers(1, 60, 20)
+        table[slot, :2] = [1 + slot * 4, 2 + slot * 4]
+        _, cache = kv_cache.prefill_chunk(
+            params, cfg, jnp.asarray(np.pad(ids, (0, 12))[None], jnp.int32),
+            cache, jnp.asarray(table[slot]), jnp.int32(slot), jnp.int32(0),
+            jnp.int32(20), num_pages)
+    chunk_row = np.zeros((maxp,), np.int32)
+    chunk_row[:3] = [20, 21, 22]             # slot 2's fresh admission
+    chunk_len = 17      # of C=32: a partial row AND an idle (q_num=0) row
+    chunk_ids = np.pad(rng.integers(1, 60, chunk_len), (0, C - chunk_len))
+    tokens = jnp.asarray(rng.integers(1, 60, (B, W)), jnp.int32)
+    write_mask = jnp.asarray([True, True, False])
+    dev_table = jnp.asarray(table)
+
+    lg_sep, cache_a = kv_cache.decode_step_wide(
+        params, cfg, tokens, cache, dev_table, write_mask, num_pages)
+    lg_ch, cache_a = kv_cache.prefill_chunk(
+        params, cfg, jnp.asarray(chunk_ids[None], jnp.int32), cache_a,
+        jnp.asarray(chunk_row), jnp.int32(2), jnp.int32(0),
+        jnp.int32(chunk_len), num_pages)
+    dec, ch, cache_b = kv_cache.mixed_step(
+        params, cfg, tokens, cache, dev_table, write_mask, num_pages,
+        jnp.asarray(chunk_ids[None], jnp.int32), jnp.asarray(chunk_row),
+        jnp.int32(0), jnp.int32(chunk_len), q_block=8)
+
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(lg_sep), atol=TOL)
+    np.testing.assert_allclose(np.asarray(ch), np.asarray(lg_ch), atol=TOL)
+    # mixed_step's lengths contract matches decode_step_wide's: unchanged
+    assert np.array_equal(np.asarray(cache_b.lengths),
+                          np.asarray(cache.lengths))
+
+    # functional pool equivalence: advance lengths the way the engine does
+    # (decode slots accept all W, the chunk slot activates at chunk_len),
+    # give slot 2 its table row, and decode one step over every slot —
+    # attention reads every VALID row of both pools
+    table[2] = chunk_row
+    dev_table = jnp.asarray(table)
+    lengths = jnp.asarray([20 + W, 20 + W, chunk_len], jnp.int32)
+    cache_a = PagedKVCache(k=cache_a.k, v=cache_a.v, lengths=lengths,
+                           k_s=cache_a.k_s, v_s=cache_a.v_s)
+    cache_b = PagedKVCache(k=cache_b.k, v=cache_b.v, lengths=lengths,
+                           k_s=cache_b.k_s, v_s=cache_b.v_s)
+    nxt = jnp.asarray(rng.integers(1, 60, (B,)), jnp.int32)
+    on = jnp.asarray([True, True, True])
+    lg_a, _ = kv_cache.decode_step(params, cfg, nxt, cache_a, dev_table, on,
+                                   num_pages)
+    lg_b, _ = kv_cache.decode_step(params, cfg, nxt, cache_b, dev_table, on,
+                                   num_pages)
+    np.testing.assert_allclose(np.asarray(lg_b), np.asarray(lg_a), atol=TOL)
+
+
+# ------------------------------------------------------------------ engine
+
+def test_mixed_gate_resolution(tiny, monkeypatch):
+    cfg, params, _ = tiny
+    base = dict(max_batch_size=2, max_seq_len=64, prefill_chunk=16,
+                page_size=16)
+    core = EngineCore(cfg, EngineConfig(mixed_phase_dispatch="on", **base),
+                      params, eos_id=3)
+    assert core.mixed_supported
+    # auto resolves OFF on CPU backends: tier-1 never pays the mixed
+    # program's compiles unless a test opts in
+    core = EngineCore(cfg, EngineConfig(mixed_phase_dispatch="auto", **base),
+                      params, eos_id=3)
+    assert not core.mixed_supported
+    # the bare env var overrides the config field (ops kill switch)
+    monkeypatch.setenv("APP_MIXED_PHASE_DISPATCH", "off")
+    core = EngineCore(cfg, EngineConfig(mixed_phase_dispatch="on", **base),
+                      params, eos_id=3)
+    assert not core.mixed_supported
+    monkeypatch.delenv("APP_MIXED_PHASE_DISPATCH")
+    # a resident adapter tree turns the fused (base-weights-only) path off
+    core = EngineCore(cfg, EngineConfig(mixed_phase_dispatch="on", **base),
+                      params, eos_id=3)
+    core.adapters = object()       # stand-in for a stacked adapter tree
+    assert not core.mixed_supported
+
+
+def test_mixed_on_unsupported_config_fails_at_init(tiny):
+    """The config gate must never select a kernel the chip rejects at trace
+    time: pallas forced + a page size the paged kernels cannot DMA must
+    fail AT ENGINE INIT, not at the first dispatch."""
+    cfg, params, _ = tiny
+    with pytest.raises(ValueError, match="cannot serve"):
+        EngineCore(cfg, EngineConfig(mixed_phase_dispatch="on",
+                                     attention="pallas", max_batch_size=2,
+                                     max_seq_len=64, prefill_chunk=16,
+                                     page_size=4),
+                   params, eos_id=3)
+
+
+# --------------------------------------------------------------- scheduler
+
+def _run_workload(cfg, params, tok, mixed: str):
+    """Two short streams decoding, then a long prompt admitted mid-decode.
+    Hand-driven ticks (no driver thread). Returns (texts, scheduler)."""
+    ecfg = EngineConfig(max_batch_size=4, max_seq_len=256, prefill_chunk=16,
+                        page_size=16, spec_decode="on", spec_draft=2,
+                        prefill_hold_chunks=0, mixed_phase_dispatch=mixed,
+                        decode_steps_per_dispatch=2)
+    core = EngineCore(cfg, ecfg, params, eos_id=tok.eos_id)
+    sched = Scheduler(core, tok)
+    reqs = [Request(prompt_ids=tok.encode("hello wor"), max_tokens=40,
+                    temperature=0.0),
+            Request(prompt_ids=tok.encode("abcdefgh"), max_tokens=40,
+                    temperature=0.0)]
+    for r in reqs:
+        sched.submit(r)
+    for _ in range(4):
+        sched._tick()
+    long_req = Request(prompt_ids=tok.encode("xy" * 40), max_tokens=6,
+                       temperature=0.0)
+    reqs.append(long_req)
+    sched.submit(long_req)
+    # spy: separate prefill dispatches issued while decode slots are live —
+    # the stall the mixed path exists to remove
+    stalls = [0]
+    orig = sched._prefill_step
+
+    def spying_prefill_step():
+        if sched._slots:
+            stalls[0] += 1
+        return orig()
+
+    sched._prefill_step = spying_prefill_step
+    for _ in range(200):
+        sched._tick()
+        if all(r.finished_at is not None for r in reqs):
+            break
+    texts = []
+    for r in reqs:
+        assert r.error is None, r.error
+        assert r.finished_at is not None, "request did not finish"
+        parts = []
+        while not r.out_queue.empty():
+            item = r.out_queue.get()
+            if isinstance(item, str):
+                parts.append(item)
+        texts.append("".join(parts))
+    return texts, stalls[0], sched
+
+
+def test_scheduler_mixed_long_prompt_rides_decode_dispatches(tiny):
+    """With mixed dispatch on, a long prompt admitted mid-decode prefills
+    INSIDE the decode dispatches (mixed_dispatch_frac > 0, zero separate
+    prefill programs while slots are live) and the emitted streams are
+    token-identical to the two-dispatch path (greedy, seeded spec)."""
+    cfg, params, tok = tiny
+    texts_on, stalls_on, sched_on = _run_workload(cfg, params, tok, "on")
+    assert sched_on._mixed_dispatches > 0
+    assert stalls_on == 0
+    flight = sched_on._flight_fields()
+    assert flight["mixed_dispatch_frac"] > 0
+    assert 0 < flight["ragged_row_util"] <= 1
+
+    texts_off, stalls_off, sched_off = _run_workload(cfg, params, tok, "off")
+    assert sched_off._mixed_dispatches == 0
+    assert stalls_off > 0          # the stall the mixed path removes
+    assert texts_on == texts_off   # bit-identical streams, either path

@@ -171,8 +171,117 @@ def test_paged_decode_stale_table_entries_are_masked():
                                atol=TOL)
 
 
+def _ragged_row_reference(q_row, k_pages, v_pages, table, kv_len, pos0,
+                          q_num):
+    """Per-row authority for the ragged kernel: dense-gather the row's pages
+    and run masked mha_prefill at the row's absolute query positions."""
+    ps = k_pages.shape[1]
+    HD = q_row.shape[-1]
+    KV = k_pages.shape[2] // HD
+    T = table.shape[0] * ps
+    k_dense = k_pages[table].reshape(1, T, KV, HD)
+    v_dense = v_pages[table].reshape(1, T, KV, HD)
+    qpos = pos0 + jnp.arange(q_row.shape[0])[None]
+    kpos = jnp.arange(T)[None]
+    out = mha_prefill(q_row[None], k_dense, v_dense, q_positions=qpos,
+                      kv_positions=kpos, kv_mask=kpos < kv_len, causal=True)
+    return out[0, :q_num]
+
+
+def test_ragged_paged_prefill_rows_match_reference():
+    """Prefill-chunk-style rows: consecutive q_block spans of one sequence,
+    per-row causal offsets, a partial last row (q_num < q_block)."""
+    from generativeaiexamples_tpu.ops.pallas import ragged_paged_attention
+
+    rng = np.random.default_rng(11)
+    ps, maxp, H, KV, HD, Qb = 16, 4, 4, 2, 32, 8
+    P = 8
+    C = 32                                    # chunk of 4 rows of Qb=8
+    chunk_len = 27                            # last row partial (3 valid)
+    q = _rand(rng, (C // Qb, Qb, H, HD))
+    k_pages = _rand(rng, (P, ps, KV * HD))
+    v_pages = _rand(rng, (P, ps, KV * HD))
+    table = jnp.array([3, 5, 1, 0], jnp.int32)
+    R = C // Qb
+    tables = jnp.broadcast_to(table[None], (R, maxp))
+    kv_lens = jnp.full((R,), chunk_len, jnp.int32)
+    q_pos0 = jnp.arange(R, dtype=jnp.int32) * Qb
+    q_num = jnp.clip(chunk_len - q_pos0, 0, Qb)
+
+    out = ragged_paged_attention(q, k_pages, v_pages, tables, kv_lens,
+                                 q_pos0, q_num, interpret=True)
+    for r in range(R):
+        n = int(q_num[r])
+        if n == 0:
+            continue
+        ref = _ragged_row_reference(q[r], k_pages, v_pages, table,
+                                    chunk_len, int(q_pos0[r]), n)
+        np.testing.assert_allclose(np.asarray(out[r, :n]), np.asarray(ref),
+                                   atol=TOL)
+
+
+def test_ragged_paged_mixed_rows():
+    """One dispatch serving all three phases at once: two decode rows
+    (q_num=1), one spec-draft row (q_num=3), and two prefill-chunk rows —
+    each against its own page-table row and per-row causal offsets."""
+    from generativeaiexamples_tpu.ops.pallas import ragged_paged_attention
+
+    rng = np.random.default_rng(12)
+    ps, maxp, H, KV, HD, Qb = 16, 4, 4, 2, 32, 8
+    P = 16
+    q = _rand(rng, (5, Qb, H, HD))
+    k_pages = _rand(rng, (P, ps, KV * HD))
+    v_pages = _rand(rng, (P, ps, KV * HD))
+    tables = jnp.array([[1, 2, 3, 0],         # decode slot, 40 live rows
+                        [4, 5, 0, 0],         # decode slot, 17 live rows
+                        [6, 7, 8, 9],         # spec slot, 3 drafted queries
+                        [10, 11, 0, 0],       # chunk rows (one sequence)
+                        [10, 11, 0, 0]], jnp.int32)
+    kv_lens = jnp.array([40, 17, 60, 21, 21], jnp.int32)
+    q_num = jnp.array([1, 1, 3, 8, 5], jnp.int32)
+    q_pos0 = jnp.array([39, 16, 57, 8, 16], jnp.int32)
+
+    out = ragged_paged_attention(q, k_pages, v_pages, tables, kv_lens,
+                                 q_pos0, q_num, interpret=True)
+    for r in range(5):
+        n = int(q_num[r])
+        ref = _ragged_row_reference(q[r], k_pages, v_pages, tables[r],
+                                    int(kv_lens[r]), int(q_pos0[r]), n)
+        np.testing.assert_allclose(np.asarray(out[r, :n]), np.asarray(ref),
+                                   atol=TOL)
+
+
+def test_ragged_paged_empty_rows_are_skipped():
+    """Rows with q_num == 0 (idle ragged rows) carry garbage tables and
+    lengths; they must not disturb the live rows and must stay finite."""
+    from generativeaiexamples_tpu.ops.pallas import ragged_paged_attention
+
+    rng = np.random.default_rng(13)
+    ps, maxp, H, KV, HD, Qb = 16, 4, 4, 2, 16, 8
+    P = 8
+    q = _rand(rng, (3, Qb, H, HD))
+    k_pages = _rand(rng, (P, ps, KV * HD))
+    v_pages = _rand(rng, (P, ps, KV * HD))
+    live_table = jnp.array([[2, 3, 0, 0]], jnp.int32)
+    tables = jnp.concatenate(
+        [live_table, jnp.array([[7, 7, 7, 7], [0, 0, 0, 0]], jnp.int32)])
+    kv_lens = jnp.array([25, 64, 0], jnp.int32)
+    q_pos0 = jnp.array([24, 0, 0], jnp.int32)
+    q_num = jnp.array([1, 0, 0], jnp.int32)
+
+    out = ragged_paged_attention(q, k_pages, v_pages, tables, kv_lens,
+                                 q_pos0, q_num, interpret=True)
+    assert np.isfinite(np.asarray(out)).all()
+    solo = ragged_paged_attention(q[:1], k_pages, v_pages, live_table,
+                                  kv_lens[:1], q_pos0[:1], q_num[:1],
+                                  interpret=True)
+    np.testing.assert_allclose(np.asarray(out[0, :1]),
+                               np.asarray(solo[0, :1]), atol=TOL)
+
+
 def test_supported_predicates():
-    from generativeaiexamples_tpu.ops.pallas import paged_decode_supported
+    from generativeaiexamples_tpu.ops.pallas import (
+        paged_decode_supported, ragged_paged_supported)
 
     assert prefill_supported(512, 512, 128)
     assert prefill_supported(64, 2048, 128)
@@ -182,6 +291,13 @@ def test_supported_predicates():
     assert paged_decode_supported(128, 128)
     assert paged_decode_supported(16, 16)
     assert not paged_decode_supported(4, 128)     # page too small to DMA
+    # the mixed-phase config gate (engine init) relies on the ragged and
+    # paged predicates agreeing on page/head limits — a drift here would
+    # let the engine select a kernel the chip rejects at trace time
+    for page, hd in ((128, 128), (16, 16), (4, 128), (12, 64), (128, 4)):
+        assert (ragged_paged_supported(page, hd)
+                == paged_decode_supported(page, hd))
+    assert not ragged_paged_supported(128, 128, q_block=12)  # non-pow2 rows
 
 
 def test_model_prefill_decode_with_pallas_backend():

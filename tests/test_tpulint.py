@@ -168,6 +168,30 @@ def test_recompile_hazard_fires_inside_loops_only():
     assert rule_lines(src, "recompile-hazard") == [8]
 
 
+def test_mixed_dispatch_path_fixture_trace_and_recompile_hazards():
+    """Regression fixture for the mixed-phase dispatch hot path: the
+    kernel-launch helpers (kv_cache.mixed_step, engine.decode_mixed,
+    scheduler._pack_mixed_chunk) carry `# tpulint: hot-path`, so a stray
+    host sync or a per-dispatch jit in a future edit of the mixed path
+    must keep tripping trace-hazard / recompile-hazard."""
+    src = """
+    import jax, numpy as np
+
+    def mixed_step(params, tokens, cache):   # tpulint: hot-path
+        lengths = np.asarray(cache.lengths)      # host pull per dispatch
+        return tokens.tolist()
+
+    def decode_mixed(self, state, items):   # tpulint: hot-path
+        for item in items:
+            fn = jax.jit(lambda s: s)            # compile per packed chunk
+            state = fn(state)
+        return state
+    """
+    trace = rule_lines(src, "trace-hazard")
+    assert trace == [5, 6]
+    assert rule_lines(src, "recompile-hazard") == [10]
+
+
 # ---------------------------------------------------------------------------
 # lock-discipline
 # ---------------------------------------------------------------------------
