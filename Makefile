@@ -23,6 +23,13 @@ native:
 bench:
 	python bench.py
 
+# Disaggregated serving round: role'd tiny engine workers (prefill/decode,
+# APP_ENGINE_ROLE) behind the least-loaded routing frontend; emits one JSON
+# line with disagg_ttft_p50_s / handoff_ms / router_imbalance.
+.PHONY: bench-disagg
+bench-disagg:
+	$(TEST_ENV) python bench.py --multichip
+
 dryrun:
 	$(TEST_ENV) XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	  python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"

@@ -236,6 +236,7 @@ class RetrieverConfig:
 class EngineConfig:
     """In-tree TPU serving engine knobs (no reference equivalent — replaces NIM)."""
 
+    role: str = configfield("role", default="unified", help_txt="Engine serving role for disaggregated prefill/decode topologies: unified (default — one worker does everything, today's zero-config behavior) | prefill (runs chunked prefill only and exports the finished request's KV pages + sampling state via /v1/kv/prefill; never dispatches decode) | decode (full worker that additionally imports handed-off KV via /v1/kv/handoff and decodes from the first token on). The failover router (server/failover.py) discovers roles from /health and routes phases to the matching workers.")
     max_batch_size: int = configfield("max_batch_size", default=8, help_txt="Decode-slot capacity of the continuous batcher.")
     max_seq_len: int = configfield("max_seq_len", default=2048, help_txt="KV-cache length per slot.")
     page_size: int = configfield("page_size", default=128, help_txt="KV page granularity (tokens).")

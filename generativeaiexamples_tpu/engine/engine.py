@@ -182,6 +182,17 @@ class EngineCore:
                     f"({model_cfg.n_heads}) and kv heads "
                     f"({model_cfg.n_kv_heads}) — set engine.mesh_shape "
                     f"(APP_ENGINE_MESH_SHAPE), e.g. 'DxT' with a dividing T")
+        role = (getattr(engine_cfg, "role", "unified") or "unified")
+        role = str(role).strip().lower()
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(f"APP_ENGINE_ROLE must be unified|prefill|"
+                             f"decode, got {role!r}")
+        # disaggregated serving role: "prefill" workers run chunked prefill
+        # only and export finished requests' KV pages (export_slot_kv);
+        # "decode" workers additionally import handed-off pages
+        # (import_slot_kv) and decode from the first token on; "unified"
+        # (default) is today's single-worker behavior, zero-config unchanged
+        self.role = role
         self.model_cfg = model_cfg
         self.cfg = engine_cfg
         self.eos_id = eos_id
@@ -368,6 +379,14 @@ class EngineCore:
         self._chunk_fn = jax.jit(self._chunk_impl, donate_argnums=dn)
         self._group_fn = jax.jit(self._group_impl, donate_argnums=dn,
                                  static_argnums=(23,))
+        # KV handoff programs (disaggregated serving): the export gather
+        # must NOT donate — the state keeps serving after the copy-out
+        self._export_fn = jax.jit(self._export_impl)
+        self._import_fn = jax.jit(self._import_impl, donate_argnums=dn)
+        # transported pool dtype, validated on both ends of a handoff
+        self._kv_dtype = ("int8" if engine_cfg.kv_quant == "int8"
+                          else str(jax.dtypes.canonicalize_dtype(
+                              model_cfg.jdtype)))
         # constrained-decoding grammar registry: up to GRAM_SLOTS byte-DFAs
         # live in one flat device table; flat state g*GRAM_STATES+s, flat
         # state 0 = the shared reject sink (engine/grammar.py). Built lazily
@@ -388,7 +407,7 @@ class EngineCore:
         self._decode_fn = jax.jit(self._decode_impl, donate_argnums=dn,
                                   static_argnums=(9, 10, 11))
         self._mixed_fn = jax.jit(self._mixed_impl, donate_argnums=dn,
-                                 static_argnums=(20, 21, 22, 23))
+                                 static_argnums=(22, 23, 24))
         self._activate_fn = jax.jit(self._activate_impl, donate_argnums=dn)
         self._release_fn = jax.jit(self._release_impl, donate_argnums=dn)
         self._seed_hist_fn = jax.jit(self._seed_history_impl,
@@ -1002,6 +1021,7 @@ class EngineCore:
         state = self.init_state()
         table = self.put_table(
             np.zeros((self.batch, self.max_pages_per_slot), np.int32))
+        last_out = None
         for gs in ((0, gram_start) if gram_start else (0,)):
             for g in self.group_buckets:
                 items = [PrefillItem(
@@ -1010,30 +1030,39 @@ class EngineCore:
                     slot=self.batch, start_pos=0, is_last=True, generated=1,
                     max_gen=0, gram_state=gs)
                     for _ in range(g)]  # OOB slots: compiles, writes nothing
-                state, _ = self.prefill_group(state, items)
+                state, last_out = self.prefill_group(state, items)
+            if self.role == "prefill":
+                # a prefill-role worker never dispatches decode (the
+                # scheduler gates it off): skip the whole decode/mixed
+                # compile grid — most of a unified worker's warmup time
+                continue
             for steps in steps_list:
                 state, out = self.decode(state, table, steps,
                                          use_grammar=bool(gs))
+                last_out = out["packed"]
             if self.mixed_supported:
-                # the mixed-phase program's mid-chunk and final-chunk
-                # variants at EVERY depth the adaptive scheduler can pick,
-                # in BOTH grammar modes — a grammared slot decoding when a
-                # plain long prompt is admitted dispatches
+                # the mixed-phase program at EVERY depth the adaptive
+                # scheduler can pick, in BOTH grammar modes — a grammared
+                # slot decoding when a plain prompt is admitted dispatches
                 # decode_mixed(use_grammar=True), which must not pay its
-                # compile mid-serving (narrower page-pressure depths
-                # compile lazily, same as the decode grid)
-                for last in (False, True):
-                    item = PrefillItem(
+                # compile mid-serving. ``is_last`` rides as data (one
+                # compile serves mid/final chunks); the single-chunk and
+                # full-group buckets warm here, intermediate buckets
+                # compile lazily like narrower page-pressure depths
+                for g in sorted({1, self.group_buckets[-1]}):
+                    items = [PrefillItem(
                         chunk_ids=[1] * min(4, self.chunk),
                         page_row=np.zeros((self.max_pages_per_slot,),
                                           np.int32),
-                        slot=self.batch, start_pos=0, is_last=last,
+                        slot=self.batch, start_pos=0, is_last=bool(i % 2),
                         generated=1, max_gen=0)
+                        for i in range(g)]
                     for steps in steps_list:
                         state, out = self.decode_mixed(
-                            state, table, steps, item,
+                            state, table, steps, items,
                             use_grammar=bool(gs))
-        jax.block_until_ready(out["packed"])
+                        last_out = out["packed"]
+        jax.block_until_ready(last_out)
         # the throwaway pool frees here; callers init the real state after
 
     # --------------------------------------------------------- slot lifecycle
@@ -1182,6 +1211,146 @@ class EngineCore:
         """Deactivate a slot (preemption); its pages may be reused at once —
         subsequent decode writes for the slot go to the null page."""
         return self._release_fn(state, jnp.int32(slot))
+
+    # ------------------------------------------- KV handoff (disaggregation)
+
+    def _export_bucket(self, n_exp: int) -> int:
+        """Power-of-two page-count buckets bound the handoff programs' XLA
+        compile count (the gather/scatter shapes are otherwise one compile
+        per distinct prompt page count)."""
+        b = 1
+        while b < n_exp:
+            b *= 2
+        return min(b, self.max_pages_per_slot)
+
+    def _export_impl(self, state: DecodeState, page_ids):
+        return kv_cache.export_pages(state.cache, page_ids, self.num_pages)
+
+    def export_slot_kv(self, state: DecodeState, pages, length) -> dict:   # tpulint: hot-path
+        """Gather a prefilled slot's live pages into a dense, host-side
+        handoff payload (kv_cache.export_pages) — the prefill worker's half
+        of disaggregated serving. Dtype-preserving: an int8 pool ships int8
+        values + f32 scales, never a dequantized copy. Blocks on one
+        device→host fetch of the gathered buffer (the prefill role's
+        per-request sync point, the analogue of the unified engine's TTFT
+        fetch). Returns geometry metadata + (L, n_pages, …) numpy buffers;
+        the serving layer base64s them for the HTTP plane
+        (kv_cache.encode_kv_payload)."""
+        n_exp = max(1, -(-int(length) // self.page_size))
+        b = self._export_bucket(n_exp)
+        ids = np.zeros((b,), np.int32)
+        ids[:n_exp] = list(pages)[:n_exp]
+        k, v, k_s, v_s = self._export_fn(state, jnp.asarray(ids))
+        L = self.model_cfg.n_layers
+
+        def trim(a):
+            if a is None:
+                return None
+            # tpulint: disable=trace-hazard -- the export IS the copy-out:
+            # one deliberate device->host fetch per handed-off request (the
+            # prefill role's per-request sync point, documented above)
+            host = np.asarray(jax.device_get(a))
+            return np.ascontiguousarray(
+                host.reshape((L, b) + host.shape[1:])[:, :n_exp])
+
+        return {
+            "version": 1,
+            "length": int(length),
+            "n_pages": n_exp,
+            "page_size": self.page_size,
+            "n_layers": L,
+            "kv_dim": self.model_cfg.n_kv_heads * self.model_cfg.head_dim,
+            "kv_dtype": self._kv_dtype,
+            "k": trim(k), "v": trim(v),
+            "k_s": trim(k_s), "v_s": trim(v_s),
+        }
+
+    def validate_handoff(self, payload: dict) -> None:
+        """Loudly refuse a payload this pool cannot host — a silent page-
+        size / layer-count / dtype mismatch would serve garbage KV as if it
+        were the prompt."""
+        mine = {"page_size": self.page_size,
+                "n_layers": self.model_cfg.n_layers,
+                "kv_dim": self.model_cfg.n_kv_heads * self.model_cfg.head_dim,
+                "kv_dtype": self._kv_dtype}
+        for key, want in mine.items():
+            got = payload.get(key)
+            if got != want:
+                raise ValueError(
+                    f"handoff {key} mismatch: payload carries {got!r}, this "
+                    f"engine serves {want!r} — prefill and decode workers "
+                    f"must share model geometry and kv_quant")
+        n = int(payload.get("length", 0))
+        if n < 1 or n + 1 >= self.max_seq:
+            raise ValueError(f"handoff length {n} outside this engine's "
+                             f"serving range (max_seq {self.max_seq})")
+        n_pages = int(payload.get("n_pages", 0))
+        if n_pages != max(1, -(-n // self.page_size)):
+            raise ValueError("handoff n_pages inconsistent with length")
+        # cross-check the parts the scalars only CLAIM: prompt ids and the
+        # buffers themselves. A self-consistent-but-wrong payload must be a
+        # loud admission failure here — discovered later it would either
+        # crash mid-tick (failing every in-flight request via the driver's
+        # reset) or be silently zero-padded into garbage KV.
+        if "prompt_ids" in payload and len(payload["prompt_ids"]) != n:
+            raise ValueError(
+                f"handoff length {n} does not match its "
+                f"{len(payload['prompt_ids'])} prompt_ids")
+        kv_dim = mine["kv_dim"]
+        want_kv = (mine["n_layers"], n_pages, self.page_size, kv_dim)
+        want_sc = (mine["n_layers"], n_pages, self.model_cfg.n_kv_heads,
+                   self.page_size)
+        for key, want in (("k", want_kv), ("v", want_kv),
+                          ("k_s", want_sc), ("v_s", want_sc)):
+            arr = payload.get(key)
+            if arr is None:
+                if key in ("k", "v") or self.cfg.kv_quant == "int8":
+                    raise ValueError(f"handoff payload is missing {key!r}")
+                continue
+            shape = tuple(getattr(arr, "shape", ()))
+            if shape != want:
+                raise ValueError(
+                    f"handoff {key} buffer shape {shape} does not match "
+                    f"the metadata's {want}")
+
+    def _import_impl(self, state: DecodeState, page_ids, slot, length,
+                     k, v, k_s, v_s) -> DecodeState:
+        cache = kv_cache.import_pages(state.cache, page_ids, self.num_pages,
+                                      slot, length, k, v, k_s=k_s, v_s=v_s)
+        return dataclasses.replace(state, cache=cache)
+
+    def import_slot_kv(self, state: DecodeState, slot: int, pages,
+                       payload: dict) -> DecodeState:   # tpulint: hot-path
+        """Scatter an exported handoff payload into freshly allocated pages
+        of THIS pool and set ``lengths[slot]`` (kv_cache.import_pages) —
+        the decode worker's half of disaggregated serving. The caller
+        (scheduler) then seeds history and activates the slot with the
+        payload's first token, after which decode proceeds exactly as if
+        the prefill had run locally."""
+        self.validate_handoff(payload)
+        n_exp = int(payload["n_pages"])
+        b = self._export_bucket(n_exp)
+        ids = np.zeros((b,), np.int32)
+        ids[:n_exp] = list(pages)[:n_exp]
+        L = self.model_cfg.n_layers
+
+        def pad(a):
+            if a is None:
+                return None
+            a = np.asarray(a)
+            if a.shape[1] < b:
+                a = np.concatenate(
+                    [a, np.zeros((L, b - a.shape[1]) + a.shape[2:],
+                                 a.dtype)], axis=1)
+            return jnp.asarray(a.reshape((L * b,) + a.shape[2:]))
+
+        quant = self.cfg.kv_quant == "int8"
+        return self._import_fn(
+            state, jnp.asarray(ids), jnp.int32(slot),
+            jnp.int32(int(payload["length"])), pad(payload["k"]),
+            pad(payload["v"]),
+            pad(payload["k_s"]) if quant else None,
+            pad(payload["v_s"]) if quant else None)
 
     # ----------------------------------------------------------------- decode
 
@@ -1445,23 +1614,67 @@ class EngineCore:
             outs["top_lps"] = outs["top_lps"].reshape(R, B, TOP_LP)
         return outs
 
+    def _activate_group(self, state: DecodeState, logits, slots, is_last,
+                        start_pos, chunk_len, generated, max_gen,
+                        temperature, top_k, top_p, seeds) -> DecodeState:
+        """Grouped on-device first-token sample + slot activation for the
+        ``is_last`` rows of a mixed dispatch — `_group_impl`'s activation
+        tail, minus grammar (the scheduler keeps grammared finals on the
+        grouped prefill program, whose fused first token samples under the
+        DFA). Rows with is_last False — and padding rows, slot == batch —
+        drop every scatter, so one compile serves any mid/final mix."""
+        from generativeaiexamples_tpu.ops.sampling import (
+            sample_logits_per_slot, token_logprob)
+        bases = jax.vmap(jax.random.PRNGKey)(seeds)           # (G, 2)
+        subs = jax.vmap(jax.random.fold_in)(bases, generated - 1)
+        toks = sample_logits_per_slot(subs, logits, temperature, top_k,
+                                      top_p)
+        lps = token_logprob(logits, toks)
+        alive = is_last & (toks != self.eos_id) & (generated < max_gen)
+        act_slots = jnp.where(is_last, slots, jnp.int32(self.batch))
+        upd = lambda arr, val: arr.at[act_slots].set(val, mode="drop")
+        # the fused token enters history at its position (= prompt length,
+        # which the step-0 lengths scatter just set for these slots)
+        tok_col = jnp.minimum(start_pos + chunk_len, self.max_seq - 1)
+        hist = state.history.at[act_slots, tok_col].set(toks, mode="drop")
+        zeros = jnp.zeros_like(slots)
+        return dataclasses.replace(
+            state,
+            tokens=upd(state.tokens, toks),
+            active=upd(state.active, alive),
+            generated=upd(state.generated, generated),
+            max_gen=upd(state.max_gen, max_gen),
+            temperature=upd(state.temperature, temperature),
+            top_k=upd(state.top_k, top_k),
+            top_p=upd(state.top_p, top_p),
+            rngs=upd(state.rngs, bases),
+            # activation clears a previous occupant's DFA state (mixed
+            # chunk tails are unconstrained by construction)
+            gram_state=upd(state.gram_state, zeros),
+            last_logprob=upd(state.last_logprob, lps),
+            history=hist,
+            adapter_ix=upd(state.adapter_ix, zeros),
+        )
+
     def _mixed_impl(self, state: DecodeState, params, adapters, page_table,
                     gram_table, gram_accept, gram_dist, tok_bytes, tok_lens,
-                    tokens, page_row, slot, start_pos, chunk_len, generated,
-                    max_gen, temperature, top_k, top_p, seed, steps: int,
-                    use_grammar: bool, want_top: bool, is_last: bool
-                    ) -> Tuple[DecodeState, Dict[str, Any]]:
+                    tokens, page_rows, slots, len_slots, start_pos,
+                    chunk_len, is_last, generated, max_gen, temperature,
+                    top_k, top_p, seeds, steps: int, use_grammar: bool,
+                    want_top: bool) -> Tuple[DecodeState, Dict[str, Any]]:
         """The MIXED-PHASE program: `steps` fused decode steps where step 0's
-        forward ALSO prefills one chunk (kv_cache.mixed_step) — prefill
-        stops being a separate dispatch, so a long admission no longer
-        stalls the decode tick (ROADMAP item 2; the r05 third-phase TTFT
-        tail). Decode semantics are bit-identical to `_decode_impl` (same
-        step body, with step 0's model call swapped); the chunk follows the
-        `_chunk_impl` / `_chunk_last_impl` contract: lengths + history are
-        set after step 0, and ``is_last`` chunks run the fused first-token
-        sample + slot activation AFTER the scan, so the fresh slot starts
-        decoding next dispatch exactly as on the two-dispatch path.
-        The chunk tail is unconstrained (grammared finals keep the grouped
+        forward ALSO prefills up to G chunks from DISTINCT prefilling slots
+        (kv_cache.mixed_step) — prefill stops being a separate dispatch, so
+        admissions no longer stall the decode tick (ROADMAP item 2; the r05
+        third-phase TTFT tail). Decode semantics are bit-identical to
+        `_decode_impl` (same step body, with step 0's model call swapped);
+        the chunks follow the `_group_impl` contract: lengths (via the
+        ``len_slots`` duplicate-scatter dedup) + history are set after step
+        0, and ``is_last`` rows run the fused first-token sample + slot
+        activation AFTER the scan, so fresh slots start decoding next
+        dispatch exactly as on the two-dispatch path. ``is_last`` rides as
+        data, so one compile per group bucket serves any mid/final mix.
+        Chunk tails are unconstrained (grammared finals keep the grouped
         prefill path — the scheduler routes them there)."""
         step = self._decode_step_fn(params, adapters, page_table, gram_table,
                                     gram_accept, gram_dist, tok_bytes,
@@ -1473,7 +1686,7 @@ class EngineCore:
             def forward(inputs, st):
                 dec, ch, cache = kv_cache.mixed_step(
                     params, self.model_cfg, inputs, st.cache, page_table,
-                    st.active, self.num_pages, tokens, page_row, start_pos,
+                    st.active, self.num_pages, tokens, page_rows, start_pos,
                     chunk_len, mesh=self.mesh, q_block=self._mixed_q_block)
                 cell["chunk_logits"] = ch
                 return dec, cache
@@ -1481,7 +1694,7 @@ class EngineCore:
             def forward(st):
                 dec, ch, cache = kv_cache.mixed_step(
                     params, self.model_cfg, st.tokens[:, None], st.cache,
-                    page_table, st.active, self.num_pages, tokens, page_row,
+                    page_table, st.active, self.num_pages, tokens, page_rows,
                     start_pos, chunk_len, mesh=self.mesh,
                     q_block=self._mixed_q_block)
                 cell["chunk_logits"] = ch
@@ -1490,18 +1703,23 @@ class EngineCore:
                     cache, lengths=cache.lengths + 1)
 
         state, out0 = step(state, forward=forward)
-        # the chunk's page writes are now part of the dispatched program:
-        # record its lengths + history exactly as _chunk_impl does (the
-        # chunk's slot is inactive during the scan, so later steps keep
-        # both untouched)
+        # the chunks' page writes are now part of the dispatched program:
+        # record lengths + history exactly as _group_impl does (the chunk
+        # slots are inactive during the scan, so later steps keep both
+        # untouched; padding rows carry OOB slots and drop)
+        G, C = tokens.shape
+        j = jnp.arange(C, dtype=jnp.int32)[None]              # (1, C)
+        h_rows = jnp.broadcast_to(slots[:, None], (G, C))
+        h_cols = jnp.where(j < chunk_len[:, None],
+                           start_pos[:, None] + j, self.max_seq)
         state = dataclasses.replace(
             state,
             cache=dataclasses.replace(
                 state.cache,
-                lengths=state.cache.lengths.at[slot].set(
-                    start_pos + chunk_len)),
-            history=self._hist_write_chunk(state.history, slot, tokens[0],
-                                           start_pos, chunk_len))
+                lengths=state.cache.lengths.at[len_slots].set(
+                    start_pos + chunk_len, mode="drop")),
+            history=state.history.at[h_rows, h_cols].set(tokens,
+                                                         mode="drop"))
         if steps > 1:
             state, outs = jax.lax.scan(lambda s, _: step(s), state, None,
                                        length=steps - 1)
@@ -1510,45 +1728,87 @@ class EngineCore:
                 outs)
         else:
             outs = jax.tree.map(lambda x: x[None], out0)
-        if is_last:
-            # fused first-token sample + activation AFTER the scan: the
-            # fresh slot joins decode at the NEXT dispatch, so its first
-            # token resolves through the same batched fetch / input_tokens
-            # paths as a grouped-prefill activation
-            state, _tok = self._activate_sampled(
-                state, state.cache, cell["chunk_logits"], slot, generated,
-                max_gen, temperature, top_k, top_p, seed)
+        # fused first-token sample + activation AFTER the scan for is_last
+        # rows: fresh slots join decode at the NEXT dispatch, so their
+        # first tokens resolve through the same batched fetch /
+        # input_tokens paths as a grouped-prefill activation
+        state = self._activate_group(state, cell["chunk_logits"], slots,
+                                     is_last, start_pos, chunk_len,
+                                     generated, max_gen, temperature,
+                                     top_k, top_p, seeds)
         return state, self._pack_decode_outs(outs, steps, want_top)
 
     def decode_mixed(self, state: DecodeState, page_table: jax.Array,   # tpulint: hot-path
-                     steps: int, item: PrefillItem,
-                     use_grammar: bool = False, want_top: bool = False
+                     steps: int, items, use_grammar: bool = False,
+                     want_top: bool = False
                      ) -> Tuple[DecodeState, Dict[str, Any]]:
-        """One mixed-phase dispatch: ``steps`` fused decode steps PLUS one
-        prefill chunk riding the same program (`_mixed_impl`). ``item`` is
-        the chunk exactly as `prefill_group` would take it (the scheduler's
-        packing policy is unchanged — this is the same chunk, fused instead
-        of dispatched separately). Requires `mixed_supported`; the out
-        block is identical to `decode`'s."""
+        """One mixed-phase dispatch: ``steps`` fused decode steps PLUS up to
+        ``prefill_group`` prefill chunks from DISTINCT prefilling jobs
+        riding the same program as extra ragged rows (`_mixed_impl`).
+        ``items`` is a PrefillItem or a list of them, exactly as
+        `prefill_group` would take them (the scheduler's packing policy is
+        unchanged — the same chunks, fused instead of dispatched
+        separately); groups pad to the `group_buckets` power-of-two ladder
+        so the program count stays bounded. Requires `mixed_supported`;
+        the out block is identical to `decode`'s."""
+        if isinstance(items, PrefillItem):
+            items = [items]
         if not self.mixed_supported:
             raise ValueError("mixed-phase dispatch is gated off for this "
                              "engine (APP_MIXED_PHASE_DISPATCH, adapters, "
                              "or an unsupported config)")
-        n = len(item.chunk_ids)
-        if n > self.chunk:
-            raise ValueError(f"chunk of {n} tokens exceeds prefill_chunk "
-                             f"({self.chunk})")
-        padded = np.zeros((1, self.chunk), np.int32)
-        padded[0, :n] = item.chunk_ids
+        G = next(b for b in self.group_buckets if len(items) <= b)
+        C = self.chunk
+        maxp = self.max_pages_per_slot
+        tokens = np.zeros((G, C), np.int32)
+        page_rows = np.zeros((G, maxp), np.int32)
+        slots = np.full((G,), self.batch, np.int32)      # padding = OOB
+        start_pos = np.zeros((G,), np.int32)
+        chunk_len = np.zeros((G,), np.int32)
+        is_last = np.zeros((G,), bool)
+        generated = np.ones((G,), np.int32)
+        max_gen = np.zeros((G,), np.int32)
+        temperature = np.ones((G,), np.float32)
+        top_k = np.zeros((G,), np.int32)
+        top_p = np.ones((G,), np.float32)
+        seeds = np.zeros((G,), np.int32)
+        for i, it in enumerate(items):
+            n = len(it.chunk_ids)
+            if n > C:
+                raise ValueError(f"chunk of {n} tokens exceeds "
+                                 f"prefill_chunk ({C})")
+            tokens[i, :n] = it.chunk_ids
+            page_rows[i] = it.page_row
+            slots[i] = it.slot
+            start_pos[i] = it.start_pos
+            chunk_len[i] = n
+            is_last[i] = it.is_last
+            generated[i] = it.generated
+            max_gen[i] = it.max_gen
+            temperature[i] = it.temperature
+            top_k[i] = it.top_k
+            top_p[i] = it.top_p
+            seeds[i] = it.seed
+        # lengths-scatter dedup, as in prefill_group (the packer sends one
+        # chunk per DISTINCT slot, so this is normally the identity — kept
+        # so a buggy caller cannot trigger nondeterministic scatters)
+        len_slots = slots.copy()
+        newest: Dict[int, int] = {}
+        for i, it in enumerate(items):
+            newest[it.slot] = i
+        for i in range(len(items)):
+            if newest.get(int(slots[i])) != i:
+                len_slots[i] = self.batch
         return self._mixed_fn(
             state, self.params, self.adapters, page_table,
-            *self._gram_args(use_grammar), jnp.asarray(padded),
-            jnp.asarray(item.page_row, jnp.int32), jnp.int32(item.slot),
-            jnp.int32(item.start_pos), jnp.int32(n),
-            jnp.int32(item.generated), jnp.int32(item.max_gen),
-            jnp.float32(item.temperature), jnp.int32(item.top_k),
-            jnp.float32(item.top_p), jnp.int32(item.seed), steps,
-            use_grammar, want_top, bool(item.is_last))
+            *self._gram_args(use_grammar), jnp.asarray(tokens),
+            jnp.asarray(page_rows), jnp.asarray(slots),
+            jnp.asarray(len_slots), jnp.asarray(start_pos),
+            jnp.asarray(chunk_len), jnp.asarray(is_last),
+            jnp.asarray(generated), jnp.asarray(max_gen),
+            jnp.asarray(temperature), jnp.asarray(top_k),
+            jnp.asarray(top_p), jnp.asarray(seeds), steps, use_grammar,
+            want_top)
 
     def decode(self, state: DecodeState, page_table: jax.Array,
                steps: int = 1, use_grammar: bool = False,

@@ -202,6 +202,118 @@ def _write_pages_dense(pools, flat_pages, flat_rows, k, v, G, C, n_cp, ps,
     return k_dense, v_dense, out_pools
 
 
+# ---------------------------------------------------------------------------
+# KV-page handoff (disaggregated prefill/decode serving)
+# ---------------------------------------------------------------------------
+
+def export_pages(cache: PagedKVCache, page_ids: jnp.ndarray,   # tpulint: hot-path
+                 num_pages: int):
+    """Gather a slot's live pages into a dense, dtype-preserving buffer.
+
+    The prefill half of the KV handoff between engine roles: a prefill
+    worker finishes a prompt, gathers the slot's physical pages into one
+    contiguous buffer, and ships buffer + metadata to a decode worker whose
+    :func:`import_pages` scatters it into freshly allocated pages of its
+    own pool. The buffer preserves the pool dtype — an int8 pool exports
+    int8 values plus the f32 per-token-per-head scales, never a dequantized
+    copy (half the transfer, and the importing pool stores exactly what a
+    local prefill would have written).
+
+    page_ids: (n_p,) physical page ids covering the slot's first n_p
+    logical pages (padding entries may carry 0 — the null page — whose
+    exported rows are garbage the importer never reads). Returns
+    (k, v, k_s, v_s): k/v are (L*n_p, page, KV*HD) in pool dtype with
+    layer-major rows (layer l's j-th page at row ``l*n_p + j``); k_s/v_s
+    are (L*n_p, KV, page) f32 for int8 pools, None otherwise.
+    """
+    L = cache.k.shape[0] // num_pages
+    rows = (jnp.arange(L, dtype=jnp.int32)[:, None] * num_pages
+            + page_ids[None, :].astype(jnp.int32)).reshape(-1)
+    if cache.quantized:
+        return cache.k[rows], cache.v[rows], cache.k_s[rows], cache.v_s[rows]
+    return cache.k[rows], cache.v[rows], None, None
+
+
+def import_pages(cache: PagedKVCache, page_ids: jnp.ndarray,   # tpulint: hot-path
+                 num_pages: int, slot: jnp.ndarray, length: jnp.ndarray,
+                 k: jnp.ndarray, v: jnp.ndarray,
+                 k_s: Optional[jnp.ndarray] = None,
+                 v_s: Optional[jnp.ndarray] = None) -> PagedKVCache:
+    """Scatter an exported page buffer into this pool's pages and set the
+    receiving slot's length — the decode half of the KV handoff.
+
+    page_ids: (n_p,) freshly allocated physical pages on the RECEIVING
+    pool (padding entries carry 0: their rows scatter into the null page,
+    which no request owns). k/v (and scales) must match this pool's dtype
+    and geometry — the engine validates before dispatching, because a
+    silent int8↔bf16 or page-size mismatch would serve garbage KV.
+    ``lengths[slot] = length`` exactly as a local prefill would have left
+    it; the first decode step then writes the first generated token's KV
+    at position ``length``.
+    """
+    L = cache.k.shape[0] // num_pages
+    rows = (jnp.arange(L, dtype=jnp.int32)[:, None] * num_pages
+            + page_ids[None, :].astype(jnp.int32)).reshape(-1)
+    lengths = cache.lengths.at[slot].set(length)
+    new_k = cache.k.at[rows].set(k.astype(cache.k.dtype))
+    new_v = cache.v.at[rows].set(v.astype(cache.v.dtype))
+    if cache.quantized:
+        if k_s is None or v_s is None:
+            raise ValueError("int8 pool import needs k_s/v_s scales")
+        return PagedKVCache(k=new_k, v=new_v, lengths=lengths,
+                            k_s=cache.k_s.at[rows].set(k_s),
+                            v_s=cache.v_s.at[rows].set(v_s))
+    return PagedKVCache(k=new_k, v=new_v, lengths=lengths)
+
+
+# the JSON wire format of a handoff payload: these array fields ride as
+# base64 alongside the scalar metadata (engine/server.py /v1/kv/handoff)
+_PAYLOAD_ARRAYS = ("k", "v", "k_s", "v_s")
+
+
+def _np_dtype(name: str):
+    """np.dtype for a payload's dtype string, including the ml_dtypes
+    extension types numpy cannot resolve by name (bfloat16)."""
+    import numpy as _np
+    if name == "bfloat16":
+        import ml_dtypes
+        return _np.dtype(ml_dtypes.bfloat16)
+    return _np.dtype(name)
+
+
+def encode_kv_payload(payload: dict) -> dict:
+    """Host KV-handoff payload (numpy buffers) → JSON-safe dict: arrays
+    become {b64, dtype, shape} triples, everything else passes through."""
+    import base64
+    import numpy as _np
+    out = {}
+    for key, value in payload.items():
+        if key in _PAYLOAD_ARRAYS and value is not None:
+            arr = _np.ascontiguousarray(value)
+            out[key] = {"b64": base64.b64encode(arr.tobytes()).decode("ascii"),
+                        "dtype": str(arr.dtype),
+                        "shape": list(arr.shape)}
+        else:
+            out[key] = value
+    return out
+
+
+def decode_kv_payload(wire: dict) -> dict:
+    """Inverse of :func:`encode_kv_payload`."""
+    import base64
+    import numpy as _np
+    out = {}
+    for key, value in wire.items():
+        if (key in _PAYLOAD_ARRAYS and isinstance(value, dict)
+                and "b64" in value):
+            buf = base64.b64decode(value["b64"])
+            out[key] = _np.frombuffer(
+                buf, dtype=_np_dtype(value["dtype"])).reshape(value["shape"])
+        else:
+            out[key] = value
+    return out
+
+
 class PageAllocator:
     """Host-side free-list over physical pages 1..num_pages-1 (0 = null)."""
 
@@ -590,38 +702,42 @@ def mixed_step(params: llama.Params, cfg: llama.LlamaConfig,   # tpulint: hot-pa
                tokens: jnp.ndarray, cache: PagedKVCache,
                page_table: jnp.ndarray, write_mask: jnp.ndarray,
                num_pages: int, chunk_tokens: jnp.ndarray,
-               chunk_page_row: jnp.ndarray, chunk_start: jnp.ndarray,
+               chunk_page_rows: jnp.ndarray, chunk_start: jnp.ndarray,
                chunk_len: jnp.ndarray, mesh=None, q_block: int = 8,
                ) -> Tuple[jnp.ndarray, jnp.ndarray, PagedKVCache]:
-    """ONE mixed-phase forward: a Q-wide decode step for every slot PLUS one
-    prefill chunk, fused into a single program — the ragged-paged-attention
-    serving shape (ROADMAP item 2, arxiv 2604.15464). Prefill and decode
-    stop being separate dispatches: the chunk's matmuls fatten the decode
-    step's tiles instead of stalling the decode tick, which is the
+    """ONE mixed-phase forward: a Q-wide decode step for every slot PLUS up
+    to G prefill chunks, fused into a single program — the ragged-paged-
+    attention serving shape (ROADMAP item 2, arxiv 2604.15464). Prefill and
+    decode stop being separate dispatches: the chunks' matmuls fatten the
+    decode step's tiles instead of stalling the decode tick, which is the
     single-chip fix for prefill/decode interference (the r05 TTFT tail).
 
     tokens: (B, Q) decode inputs exactly as in :func:`decode_step_wide`;
-    chunk_tokens: (1, C) right-padded page-aligned chunk of the PREFILLING
-    slot (which must be masked out of ``write_mask`` — it is not decoding
-    yet); chunk_page_row: (max_pages,) its block-table row; chunk_start /
-    chunk_len: scalars as in :func:`prefill_chunk`.
+    chunk_tokens: (G, C) right-padded page-aligned chunks, one per DISTINCT
+    PREFILLING slot (every chunk's slot must be masked out of
+    ``write_mask`` — it is not decoding yet); chunk_page_rows: (G,
+    max_pages) their block-table rows; chunk_start / chunk_len: (G,) as in
+    :func:`prefill_chunks`. Padding rows carry ``chunk_len == 0`` and
+    all-zero page rows (their writes land on the null page, their ragged
+    rows are skipped). G == 1 is exactly the round-5 single-chunk mixed
+    dispatch.
 
     Under ``attn_impl == "pallas"`` all rows run as ONE
     ``ragged_paged_attention`` kernel per layer (decode slots are q_num=Q
-    rows, the chunk C/q_block rows); otherwise the XLA fallback computes
+    rows, each chunk C/q_block rows); otherwise the XLA fallback computes
     the same math over dense gathered views. Base weights only — per-row
     LoRA mixes cannot ride the fused (1, N) token axis, so EngineCore gates
     the mixed program off while adapters are resident — and single-chip
     (tp == 1; the TP meshes keep the two-dispatch path).
 
-    Returns (decode logits (B, Q, V), chunk last-valid-position logits
-    (1, V), cache) with ``lengths`` UNCHANGED: the engine advances decode
-    lengths by accepted counts and sets the chunk slot's length, exactly as
-    when :func:`decode_step_wide` and :func:`prefill_chunk` run separately
-    (which this must — and tests do — match numerically).
+    Returns (decode logits (B, Q, V), per-chunk last-valid-position logits
+    (G, V), cache) with ``lengths`` UNCHANGED: the engine advances decode
+    lengths by accepted counts and sets each chunk slot's length, exactly
+    as when :func:`decode_step_wide` and :func:`prefill_chunks` run
+    separately (which this must — and tests do — match numerically).
     """
     B, Q = tokens.shape
-    _, C = chunk_tokens.shape
+    G, C = chunk_tokens.shape
     ps = cache.page_size
     if C % ps != 0:
         raise ValueError(f"chunk size {C} must be page-aligned (page={ps})")
@@ -636,13 +752,16 @@ def mixed_step(params: llama.Params, cfg: llama.LlamaConfig,   # tpulint: hot-pa
     maxp = page_table.shape[1]
     T = maxp * ps
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    n_ch_rows = C // q_block
+    n_ch_rows = C // q_block                                 # per chunk
 
     L = cache.lengths                                        # (B,)
     dec_pos = L[:, None] + jnp.arange(Q, dtype=jnp.int32)[None]     # (B, Q)
-    ch_pos = chunk_start + jnp.arange(C, dtype=jnp.int32)[None]     # (1, C)
-    positions = jnp.concatenate([dec_pos.reshape(1, B * Q), ch_pos], axis=1)
-    flat_tokens = jnp.concatenate([tokens.reshape(1, B * Q), chunk_tokens],
+    ch_pos = (chunk_start[:, None]
+              + jnp.arange(C, dtype=jnp.int32)[None])               # (G, C)
+    positions = jnp.concatenate([dec_pos.reshape(1, B * Q),
+                                 ch_pos.reshape(1, G * C)], axis=1)
+    flat_tokens = jnp.concatenate([tokens.reshape(1, B * Q),
+                                   chunk_tokens.reshape(1, G * C)],
                                   axis=1)                           # (1, N)
     h = llama.embed_tokens(params, cfg, flat_tokens)
     cos, sin = rotary_embedding(positions, cfg.head_dim, cfg.rope_theta,
@@ -654,10 +773,11 @@ def mixed_step(params: llama.Params, cfg: llama.LlamaConfig,   # tpulint: hot-pa
     ok = write_mask[:, None] & (dec_pos < T)
     rows = jnp.where(ok, page_table[batch_ix, dec_pos // ps], jnp.int32(0))
     offs = dec_pos % ps                                      # (B, Q)
-    # chunk pages: same geometry as prefill_chunk
-    chunk_pages = jax.lax.dynamic_slice(chunk_page_row,
-                                        (chunk_start // ps,), (n_cp,))
-    valid_through = (chunk_start + chunk_len)[None]          # (1,)
+    # chunk pages: same geometry as prefill_chunks
+    chunk_pages = jax.vmap(
+        lambda row, sp: jax.lax.dynamic_slice(row, (sp // ps,), (n_cp,)))(
+        chunk_page_rows, chunk_start)                        # (G, n_cp)
+    valid_through = chunk_start + chunk_len                  # (G,)
 
     use_pallas = (cfg.attn_impl == "pallas" and cfg.sliding_window == 0
                   and q_block >= Q
@@ -666,35 +786,40 @@ def mixed_step(params: llama.Params, cfg: llama.LlamaConfig,   # tpulint: hot-pa
 
     if use_pallas:
         # per-row ragged metadata, shared by every layer's kernel call:
-        # B decode rows first, then the chunk's C/q_block rows
+        # B decode rows first, then each chunk's C/q_block rows
         jr = jnp.arange(n_ch_rows, dtype=jnp.int32)
         row_tables = jnp.concatenate(
-            [page_table, jnp.broadcast_to(chunk_page_row[None],
-                                          (n_ch_rows, maxp))])
-        q_num_ch = jnp.clip(chunk_len - jr * q_block, 0, q_block)
+            [page_table, jnp.repeat(chunk_page_rows, n_ch_rows, axis=0)])
+        q_num_ch = jnp.clip(chunk_len[:, None] - jr[None] * q_block,
+                            0, q_block)                      # (G, n_ch_rows)
         # idle tail rows (q_num == 0) get kv_len 0, NOT the chunk's end:
         # the kernel skips their compute either way, but only a zero
         # length clamps their page-index map to one repeated block so the
         # K/V DMAs are elided too — otherwise every empty row of a short
         # final chunk would stream the whole prefix per layer for nothing
         kv_lens = jnp.concatenate(
-            [attn_len, jnp.where(q_num_ch > 0, chunk_start + chunk_len, 0)])
-        q_pos0 = jnp.concatenate([L, chunk_start + jr * q_block])
+            [attn_len, jnp.where(q_num_ch > 0, valid_through[:, None],
+                                 0).reshape(-1)])
+        q_pos0 = jnp.concatenate(
+            [L, (chunk_start[:, None] + jr[None] * q_block).reshape(-1)])
         q_num = jnp.concatenate(
-            [jnp.full((B,), Q, jnp.int32), q_num_ch])
+            [jnp.full((B,), Q, jnp.int32), q_num_ch.reshape(-1)])
     cache_positions = jnp.broadcast_to(
         jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+    chunk_cache_positions = jnp.broadcast_to(
+        jnp.arange(T, dtype=jnp.int32)[None], (G, T))
 
     def attn_and_update(q, k, v, pools, idx):
-        # q/k/v: (1, N, H|KV, HD) — B*Q decode rows, then the C chunk rows
+        # q/k/v: (1, N, H|KV, HD) — B*Q decode rows, then the G*C chunk rows
         k_dec = k[:, :B * Q].reshape(B, Q, KV * HD)
         v_dec = v[:, :B * Q].reshape(B, Q, KV * HD)
-        k_ch = k[:, B * Q:]                                  # (1, C, KV, HD)
-        v_ch = v[:, B * Q:]
+        k_ch = k[:, B * Q:].reshape(G, C, KV, HD)
+        v_ch = v[:, B * Q:].reshape(G, C, KV, HD)
         # chunk pages scatter first, then the decode rows — the page sets
-        # are disjoint (the chunk's slot is write-masked out of decode)
-        flat_pages = idx * num_pages + chunk_pages
-        pools = _scatter_pages(pools, flat_pages, k_ch, v_ch, 1, C, n_cp,
+        # are disjoint (every chunk's slot is write-masked out of decode;
+        # duplicate indices only occur among padding rows, on the null page)
+        flat_pages = (idx * num_pages + chunk_pages).reshape(-1)
+        pools = _scatter_pages(pools, flat_pages, k_ch, v_ch, G, C, n_cp,
                                ps, KV, HD)
         flat_rows = idx * num_pages + rows                   # (B, Q)
         if quant:
@@ -714,23 +839,23 @@ def mixed_step(params: llama.Params, cfg: llama.LlamaConfig,   # tpulint: hot-pa
             new_ks = new_vs = None
             out_pools = (new_k, new_v)
         q_dec = q[0, :B * Q].reshape(B, Q, H, HD)
-        q_ch = q[:, B * Q:]                                  # (1, C, H, HD)
+        q_ch = q[0, B * Q:].reshape(G, C, H, HD)
         if use_pallas:
             pad = q_block - Q
             q_rows = q_dec if pad == 0 else jnp.pad(
                 q_dec, ((0, 0), (0, pad), (0, 0), (0, 0)))
             q_rows = jnp.concatenate(
-                [q_rows, q_ch[0].reshape(n_ch_rows, q_block, H, HD)])
+                [q_rows, q_ch.reshape(G * n_ch_rows, q_block, H, HD)])
             ctx_rows = pallas_ops.ragged_paged_attention(
                 q_rows, new_k, new_v, row_tables, kv_lens, q_pos0, q_num,
                 layer=idx, pages_per_layer=num_pages, k_scales=new_ks,
                 v_scales=new_vs)
             ctx = jnp.concatenate(
                 [ctx_rows[:B, :Q].reshape(1, B * Q, H, HD),
-                 ctx_rows[B:].reshape(1, C, H, HD)], axis=1)
+                 ctx_rows[B:].reshape(1, G * C, H, HD)], axis=1)
         else:
             # the two-dispatch math over dense gathered views, fused into
-            # one program: decode rows then the chunk
+            # one program: decode rows then the chunks
             k_dense, v_dense = _gather_dense(
                 out_pools, idx * num_pages + page_table, B, T, KV, HD,
                 h.dtype)
@@ -740,30 +865,31 @@ def mixed_step(params: llama.Params, cfg: llama.LlamaConfig,   # tpulint: hot-pa
                 kv_mask=cache_positions < attn_len[:, None], causal=True,
                 window=cfg.sliding_window)
             kc_dense, vc_dense = _gather_dense(
-                out_pools, (idx * num_pages + chunk_page_row)[None], 1, T,
+                out_pools, idx * num_pages + chunk_page_rows, G, T,
                 KV, HD, h.dtype)
             ctx_ch = mha_prefill(
                 q_ch, kc_dense, vc_dense, q_positions=ch_pos,
-                kv_positions=cache_positions[:1],
-                kv_mask=cache_positions[:1] < valid_through[:, None],
+                kv_positions=chunk_cache_positions,
+                kv_mask=chunk_cache_positions < valid_through[:, None],
                 causal=True, window=cfg.sliding_window)
             ctx = jnp.concatenate([ctx_dec.reshape(1, B * Q, H, HD),
-                                   ctx_ch], axis=1)
+                                   ctx_ch.reshape(1, G * C, H, HD)], axis=1)
         return ctx, out_pools
 
     pools_in = ((cache.k, cache.v, cache.k_s, cache.v_s) if quant
                 else (cache.k, cache.v))
     h, pools = llama.scan_blocks_inplace(
         cfg, h, params, pools_in, cos, sin, attn_and_update, None)
-    # unembed only the rows anyone reads: every decode position + the
+    # unembed only the rows anyone reads: every decode position + each
     # chunk's last valid position
+    last_ix = (B * Q + jnp.arange(G, dtype=jnp.int32) * C
+               + jnp.maximum(chunk_len - 1, 0))              # (G,)
     h_last = jnp.take_along_axis(
-        h, (B * Q + jnp.maximum(chunk_len - 1, 0))[None, None, None]
-        .astype(jnp.int32), axis=1)                          # (1, 1, D)
+        h, last_ix[None, :, None].astype(jnp.int32), axis=1)  # (1, G, D)
     h_sel = jnp.concatenate([h[:, :B * Q], h_last], axis=1)
-    logits = llama._unembed(cfg, params, h_sel)              # (1, B*Q+1, V)
+    logits = llama._unembed(cfg, params, h_sel)              # (1, B*Q+G, V)
     dec_logits = logits[0, :B * Q].reshape(B, Q, -1)
-    chunk_logits = logits[:, B * Q]                          # (1, V)
+    chunk_logits = logits[0, B * Q:]                         # (G, V)
     return dec_logits, chunk_logits, PagedKVCache(
         k=pools[0], v=pools[1], lengths=cache.lengths,
         k_s=pools[2] if quant else None,

@@ -133,6 +133,15 @@ class Request:
     trace_id: str = ""
     slo_outcome: Optional[str] = None
     slo: Optional[dict] = None
+    # Disaggregated serving (engine roles): prefill_only requests run
+    # chunked prefill and END at the first sampled token — instead of
+    # decoding, the scheduler exports the slot's KV pages + sampling state
+    # into ``handoff`` (core.export_slot_kv) and finishes with
+    # finish_reason "handoff"; no text is ever streamed. A decode-role
+    # worker admits the payload via submit_prefilled() and decodes from
+    # the first token on.
+    prefill_only: bool = False
+    handoff: Optional[dict] = None
     request_id: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
     # filled by the scheduler:
     out_queue: "queue.Queue" = field(default_factory=queue.Queue)
@@ -194,6 +203,9 @@ class _Job:
     stop_buf: str = ""            # held-back text (possible stop prefix)
     stopped: bool = False         # a stop sequence matched; tail suppressed
     adapter_ix: int = 0           # resolved LoRA slot (0 = base)
+    # KV-handoff payload for admit-with-prefilled-KV (submit_prefilled):
+    # imported at admission instead of running prefill chunks
+    preload: Optional[dict] = None
 
 
 class Scheduler:
@@ -202,6 +214,11 @@ class Scheduler:
     def __init__(self, core: EngineCore, tokenizer: Tokenizer) -> None:
         self.core = core
         self.tokenizer = tokenizer
+        # disaggregated serving role (core/config.py APP_ENGINE_ROLE): a
+        # "prefill" worker NEVER dispatches decode — finished prefills
+        # export their KV instead (_export_handoff); "decode"/"unified"
+        # behave identically here (the role is a routing contract)
+        self._role = str(getattr(core, "role", "unified") or "unified")
         self._lock = threading.Lock()
         self._pending: Deque[_Job] = deque()     # awaiting slot+pages
         self._prefilling: Deque[_Job] = deque()  # admitted, chunking in
@@ -318,6 +335,53 @@ class Scheduler:
         self._wake.set()
         REGISTRY.counter("requests_submitted").inc()
         return request
+
+    def submit_prefilled(self, request: Request, payload: dict) -> Request:
+        """Admit-with-prefilled-KV (decode role): enqueue a request whose
+        prompt KV arrives as an exported handoff payload instead of being
+        prefilled locally. Admission imports the pages into freshly
+        allocated ones (core.import_slot_kv), seeds history, and starts
+        decoding at the payload's first token — stamping the same timeline
+        fields a local prefill would, so SLO accounting and the flight
+        recorder stay truthful. Raises ValueError (synchronously, before
+        anything is queued) when the payload cannot be hosted by this
+        engine's pool — geometry/dtype mismatches must be a loud admission
+        failure, never a mid-tick driver reset."""
+        if not hasattr(self.core, "import_slot_kv"):
+            raise ValueError("this engine cannot import handed-off KV")
+        self.core.validate_handoff(payload)
+        if request.seed is None:
+            request.seed = int(payload.get("seed", 0) or 0)
+        request.seed = int(request.seed) & 0x7FFFFFFF
+        slo_mod.stamp_request(request,
+                              slo_class=request.slo_class or None,
+                              deadline_s=request.deadline_s)
+        job = _Job(request=request,
+                   detok=IncrementalDetokenizer(self.tokenizer),
+                   ids=list(request.prompt_ids))
+        job.preload = dict(payload)
+        with self._lock:
+            self._pending.append(job)
+        self._wake.set()
+        REGISTRY.counter("requests_submitted").inc()
+        REGISTRY.counter("kv_handoff_submitted").inc()
+        return request
+
+    def load_stats(self) -> Dict[str, object]:
+        """Live load surface for the routing frontend: /health rides these
+        fields so the router's least-loaded scoring sees queue depth and
+        slot fill with every probe it already makes (server/failover.py)."""
+        with self._lock:
+            waiting = len(self._pending)
+        return {
+            "engine_role": self._role,
+            "running": len(self._slots),
+            "prefilling": len(self._prefilling),
+            "waiting": waiting,
+            "batch": int(getattr(self.core, "batch", 0) or 0),
+            "kv_pages_free": int(getattr(self._alloc, "available", 0)),
+            "inflight_dispatches": len(self._inflight),
+        }
 
     def iter_text(self, request: Request) -> Iterator[str]:
         """Blocking iterator over the request's text deltas."""
@@ -483,7 +547,10 @@ class Scheduler:
         prefill pass skip reuse unless the cache covers most of the prompt
         — one ring pass beats re-chunking a nearly-uncovered prompt."""
         n = len(job.ids)
-        if not self._caching:
+        if job.preload is not None or not self._caching:
+            # handoff imports SCATTER into their pages — they must never be
+            # served shared (refcounted) prefix-cache pages, which other
+            # requests may be reading; always allocate fresh
             return self.core.pages_for(n), 0, []
         if job.hashed_len != n:
             # the chain seed namespaces by adapter: KV depends on the
@@ -706,7 +773,64 @@ class Scheduler:
             self._table[slot, :] = 0
             self._table[slot, :len(pages)] = pages
             self._table_dev = None
-            self._prefilling.append(job)
+            if job.preload is not None:
+                self._admit_prefilled(job)
+            else:
+                self._prefilling.append(job)
+
+    def _admit_prefilled(self, job: _Job) -> None:   # tpulint: hot-path
+        """Admission-with-prefilled-KV: import the handoff payload into the
+        slot's freshly allocated pages, seed history, activate at the
+        payload's first token, and emit that token — after this the slot
+        decodes exactly as if the prefill had run locally. Timeline stamps
+        mirror a local admission (prefill_start == the import instant), so
+        /debug/requests, the flight recorder, and SLO judging stay
+        truthful for disaggregated traffic."""
+        req = job.request
+        payload = job.preload
+        job.preload = None
+        now = time.perf_counter()
+        if req.prefill_start_at is None:
+            req.prefill_start_at = now
+        self._state = self.core.import_slot_kv(
+            self._state, job.slot, job.pages, payload)
+        n = len(job.ids)
+        job.prefilled = n
+        job.total_len = n
+        REGISTRY.counter("kv_handoff_imports").inc()
+        first = int(payload.get("first_token", self.core.eos_id))
+        gen = max(1, int(payload.get("generated", 1)))
+        if req.first_token_at is None:
+            # the first token was sampled remotely; it reaches this
+            # worker's client now — TTFT is honest end-to-end latency
+            req.first_token_at = now
+            REGISTRY.histogram("ttft_s").observe(now - req.submitted_at)
+        if first == self.core.eos_id:
+            req.finish_reason = "eos"
+            self._finish(job)
+            return
+        alive = gen < req.max_tokens
+        if alive:
+            if (self._spec_w > 1 and hasattr(self.core, "seed_history")):
+                # imported pages skip prefill dispatches, so the drafting
+                # history row must be seeded explicitly (as for prefix-
+                # cache hits)
+                self._state = self.core.seed_history(self._state, job.slot,
+                                                     job.ids)
+            self._state = self.core.activate(
+                self._state, job.slot, first, gen, req.max_tokens,
+                req.temperature, req.top_k, req.top_p, seed=req.seed or 0)
+            self._slots[job.slot] = job
+        if self._emit_token(job, first,
+                            float(payload.get("first_logprob") or 0.0)):
+            if alive:
+                self._retire(job)
+            else:
+                self._finish(job)
+            return
+        if not alive:
+            req.finish_reason = "length"
+            self._finish(job)
 
     # -- prefill ------------------------------------------------------------
 
@@ -754,8 +878,8 @@ class Scheduler:
             job.prefilled = len(job.ids)
             job.total_len = job.prefilled
             self._cache_insert(job)
-            self._mark_first_pending(job, tok)
-            self._slots[job.slot] = job
+            del tok   # value rides state.tokens (_mark_first_pending)
+            self._enter_decode(job)
             return 1
 
         # Build a group of up to prefill_group CHUNKS, head job first —
@@ -802,8 +926,7 @@ class Scheduler:
             self._prefilling.remove(job)
             # prompt pages are now fully write-dispatched: publish them
             self._cache_insert(job)
-            self._mark_first_pending(job, None)
-            self._slots[job.slot] = job
+            self._enter_decode(job)
         return len(items)
 
     def _gram_state_for(self, job: _Job) -> int:
@@ -854,6 +977,19 @@ class Scheduler:
         job.first_batched = False
         job.first_epoch += 1
 
+    def _enter_decode(self, job: _Job) -> None:
+        """A job's final chunk is dispatched: flag its fused first token
+        and hand the slot to the decode set. prefill_only slots are
+        RELEASED on device immediately — the fused activation turned them
+        on, but nothing may decode-advance them before the export
+        (state.tokens immutably holds the fused first token for the
+        batched fetch; decode's input_tokens carries it too on workers
+        that keep dispatching)."""
+        self._mark_first_pending(job, None)
+        self._slots[job.slot] = job
+        if job.request.prefill_only:
+            self._state = self.core.release(self._state, job.slot)
+
     def _retire(self, job: _Job) -> None:
         """Stop-sequence retirement: the device still thinks the slot is
         generating, so deactivate it before finishing (in-flight results
@@ -882,6 +1018,12 @@ class Scheduler:
         if job.prefill_started:
             REGISTRY.histogram("prefill_s").observe(now - job.prefill_started)
             job.prefill_started = 0.0
+        if req.prefill_only:
+            # disaggregated serving: a prefill-role request ENDS here —
+            # export the slot's KV pages + sampling state instead of
+            # decoding (the decode worker emits this token to the client)
+            self._export_handoff(job, first, lp)
+            return
         already = len(job.gen_ids)
         if first == self.core.eos_id:
             req.finish_reason = "eos"
@@ -895,6 +1037,50 @@ class Scheduler:
             req.finish_reason = "length"
             del self._slots[job.slot]
             self._finish(job)
+
+    def _export_handoff(self, job: _Job, first: int,
+                        lp: Optional[float] = None) -> None:   # tpulint: hot-path
+        """Finish a prefill_only request by exporting its KV pages +
+        sampling state (core.export_slot_kv) into Request.handoff. The
+        export gather is dispatched BEFORE the slot's pages are released,
+        so the driver's in-order stream makes it safe against reuse; the
+        fetch is this role's per-request host sync point."""
+        req = job.request
+        t0 = time.perf_counter()
+        try:
+            payload = self.core.export_slot_kv(self._state, job.pages,
+                                               len(job.ids))
+        except Exception as exc:
+            logger.exception("KV export failed for %s", req.request_id)
+            del self._slots[job.slot]
+            self._state = self.core.release(self._state, job.slot)
+            self._fail(job, f"kv export failed: {exc}")
+            self._release(job)
+            return
+        REGISTRY.histogram("kv_export_s").observe(time.perf_counter() - t0)
+        REGISTRY.counter("kv_handoff_exports").inc()
+        payload.update({
+            "prompt_ids": [int(t) for t in job.ids],
+            "first_token": int(first),
+            "first_logprob": float(lp) if lp is not None else 0.0,
+            "generated": len(job.gen_ids) + 1,
+            "seed": int(req.seed or 0),
+            "max_tokens": int(req.max_tokens),
+            "temperature": float(req.temperature),
+            "top_k": int(req.top_k),
+            "top_p": float(req.top_p),
+            "stop": list(req.stop),
+            "slo_class": req.slo_class,
+        })
+        req.handoff = payload
+        req.finish_reason = "handoff"
+        del self._slots[job.slot]
+        # the fused final chunk activated the slot on device; nothing may
+        # decode it (prefill role never dispatches decode, but a unified
+        # worker serving prefill_only traffic does) — released at
+        # activation time, release again here is a cheap no-op safeguard
+        self._state = self.core.release(self._state, job.slot)
+        self._finish(job)
 
     def _emit_token(self, job: _Job, tok: int, lp: Optional[float] = None,
                     top: Optional[list] = None) -> bool:
@@ -934,6 +1120,8 @@ class Scheduler:
             job = self._slots.get(slot)
             if job is None:
                 continue
+            if getattr(job.request, "prefill_only", False):
+                continue   # awaiting KV export; never decode-advances
             while self._slots.get(slot) is job:
                 # total_len is the host view (updated only when a dispatch is
                 # processed); writes already in flight plus this dispatch's
@@ -1074,13 +1262,17 @@ class Scheduler:
         sequence-parallel long pass will claim, adapter'd jobs (the mixed
         forward runs base weights only), grammared FINAL chunks (their
         fused first token must sample under the DFA, which only the grouped
-        prefill program wires up), and the BULK of very long prompts — the
-        mixed program fuses one chunk per dispatch while the grouped path
-        moves up to prefill_group chunks per tick, so a prompt with more
-        than a group of chunks left would prefill group-times slower fused;
-        it takes the grouped path until its tail fits one group."""
+        prefill program wires up), prefill_only handoff jobs (their export
+        path stays on the grouped program), and the BULK of very long
+        prompts — the mixed program fuses one chunk per job per dispatch
+        while the grouped path moves up to prefill_group chunks per tick,
+        so a prompt with more than a group of chunks left would prefill
+        group-times slower fused; it takes the grouped path until its tail
+        fits one group."""
         req = job.request
         if job.adapter_ix or req.adapter:
+            return False
+        if getattr(req, "prefill_only", False):
             return False
         if self._long_pass_claims(job):
             return False
@@ -1092,34 +1284,42 @@ class Scheduler:
             return False
         return True
 
-    def _pack_mixed_chunk(self):   # tpulint: hot-path
-        """Build the head prefilling job's next chunk as a PrefillItem to
-        ride THIS decode dispatch. Called AFTER _grow_pages (whose page-
-        pressure preemption may evict the head), so every check re-runs
-        against post-grow state; returns (item, job, is_last) or None (the
-        chunk then takes the normal grouped-prefill dispatch next tick)."""
+    def _pack_mixed_chunks(self):   # tpulint: hot-path
+        """Build every prefilling job's next chunk as PrefillItems to ride
+        THIS decode dispatch as extra ragged rows — one chunk per DISTINCT
+        job (their slots are disjoint by construction, so the fused page
+        scatters never collide). Called AFTER _grow_pages (whose page-
+        pressure preemption may evict jobs), so every check re-runs against
+        post-grow state; returns (items, [(job, is_last), …]) or None (the
+        chunks then take the normal grouped-prefill dispatch next tick)."""
         from generativeaiexamples_tpu.engine.engine import PrefillItem
-        if (len(self._prefilling) != 1 or not self._slots
+        if (not self._prefilling or not self._slots
                 or not getattr(self.core, "mixed_supported", False)):
             return None
-        job = self._prefilling[0]
-        if not self._mixed_eligible(job):
+        jobs = list(self._prefilling)
+        cap = max(1, getattr(self.core.cfg, "prefill_group", 1))
+        if len(jobs) > cap:
             return None
-        req = job.request
-        start = job.prefilled
-        chunk_ids = job.ids[start:start + self.core.chunk]
-        last = start + len(chunk_ids) >= len(job.ids)
-        if start == job.shared:
-            job.prefill_started = time.perf_counter()
-            if req.prefill_start_at is None:
-                req.prefill_start_at = job.prefill_started
-        item = PrefillItem(
-            chunk_ids=chunk_ids, page_row=self._table[job.slot],
-            slot=job.slot, start_pos=start, is_last=last,
-            generated=len(job.gen_ids) + 1, max_gen=req.max_tokens,
-            temperature=req.temperature, top_k=req.top_k, top_p=req.top_p,
-            seed=req.seed or 0)
-        return item, job, last
+        if any(not self._mixed_eligible(j) for j in jobs):
+            return None
+        items, metas = [], []
+        for job in jobs:
+            req = job.request
+            start = job.prefilled
+            chunk_ids = job.ids[start:start + self.core.chunk]
+            last = start + len(chunk_ids) >= len(job.ids)
+            if start == job.shared:
+                job.prefill_started = time.perf_counter()
+                if req.prefill_start_at is None:
+                    req.prefill_start_at = job.prefill_started
+            items.append(PrefillItem(
+                chunk_ids=chunk_ids, page_row=self._table[job.slot],
+                slot=job.slot, start_pos=start, is_last=last,
+                generated=len(job.gen_ids) + 1, max_gen=req.max_tokens,
+                temperature=req.temperature, top_k=req.top_k,
+                top_p=req.top_p, seed=req.seed or 0))
+            metas.append((job, last))
+        return items, metas
 
     def _dispatch_decode(self, try_mixed: bool = False) -> None:   # tpulint: hot-path
         """Issue one K-step decode dispatch without waiting for its result
@@ -1132,7 +1332,7 @@ class Scheduler:
         steps = self._grow_pages(self._steps)
         if not self._slots:
             return
-        packed_chunk = self._pack_mixed_chunk() if try_mixed else None
+        packed_chunks = self._pack_mixed_chunks() if try_mixed else None
         fresh = [(s, j) for s, j in self._slots.items()
                  if j.first_pending and not j.first_inflight]
         for _, j in fresh:
@@ -1141,34 +1341,38 @@ class Scheduler:
         use_grammar = any(j.gram_on for j in self._slots.values())
         want_top = any(j.request.logprobs and j.request.top_logprobs > 0
                        for j in self._slots.values())
-        if packed_chunk is not None:
-            # mixed-phase dispatch: the chunk rides the decode program
-            # (ragged paged attention) — active slots' decode tick is not
-            # stalled by a separate prefill dispatch
-            item, mixed_job, mixed_last = packed_chunk
+        if packed_chunks is not None:
+            # mixed-phase dispatch: every prefilling job's next chunk rides
+            # the decode program as extra ragged rows — active slots'
+            # decode tick is not stalled by a separate prefill dispatch
+            items, mixed_metas = packed_chunks
             self._state, out = self.core.decode_mixed(
-                self._state, self._table_device(), steps, item, use_grammar,
+                self._state, self._table_device(), steps, items, use_grammar,
                 want_top)
             self._mixed_dispatches += 1
             REGISTRY.counter("mixed_dispatches").inc()
-            REGISTRY.counter("prefill_chunks").inc()
+            REGISTRY.counter("prefill_chunks").inc(len(items))
         else:
             self._state, out = self.core.decode(
                 self._state, self._table_device(), steps, use_grammar,
                 want_top)
         self._decode_dispatches += 1
         # kernel occupancy of this dispatch's query rows: active query
-        # positions over padded positions. A fused chunk pads to the full
-        # prefill_chunk bucket, and inside a mixed dispatch every decode
-        # slot's row pads to the engine's padded row width (q_block under
-        # the ragged kernel, spec_w under the XLA fallback) — the gauge
-        # must report what the kernel actually ran
+        # positions over padded positions. Fused chunks pad to the full
+        # prefill_chunk bucket (and the group to its power-of-two bucket),
+        # and inside a mixed dispatch every decode slot's row pads to the
+        # engine's padded row width (q_block under the ragged kernel,
+        # spec_w under the XLA fallback) — the gauge must report what the
+        # kernel actually ran
         active_q = len(self._slots) * self._spec_w
         padded_q = self.core.batch * self._spec_w
-        if packed_chunk is not None:
+        if packed_chunks is not None:
             row_q = getattr(self.core, "mixed_row_queries", self._spec_w)
-            active_q += len(item.chunk_ids)
-            padded_q = self.core.batch * row_q + self.core.chunk
+            g_bucket = next(b for b in self.core.group_buckets
+                            if len(items) <= b)
+            active_q += sum(len(it.chunk_ids) for it in items)
+            padded_q = (self.core.batch * row_q
+                        + g_bucket * self.core.chunk)
         self._ragged_row_util = active_q / padded_q
         REGISTRY.gauge("ragged_row_util").set(round(self._ragged_row_util, 4))
         REGISTRY.histogram("decode_issue_s").observe(time.perf_counter() - t0)
@@ -1189,21 +1393,21 @@ class Scheduler:
                                dict(self._slots)))
         self._pending_steps += steps * self._spec_w
         REGISTRY.counter("decode_steps").inc(steps)
-        if packed_chunk is not None:
-            # the fused chunk's writes are now dispatched: advance the
+        if packed_chunks is not None:
+            # the fused chunks' writes are now dispatched: advance each
             # job's prefill bookkeeping exactly as _prefill_step_inner
             # does. An is_last chunk activated its slot ON DEVICE at the
             # end of the dispatch (after the fused decode steps), so the
             # job joins _slots AFTER the in-flight snapshot above — its
             # first token resolves via the next dispatch / batched fetch,
             # never against this dispatch's stale step-0 inputs.
-            mixed_job.prefilled = item.start_pos + len(item.chunk_ids)
-            mixed_job.total_len = mixed_job.prefilled
-            if mixed_last:
-                self._prefilling.remove(mixed_job)
-                self._cache_insert(mixed_job)
-                self._mark_first_pending(mixed_job, None)
-                self._slots[mixed_job.slot] = mixed_job
+            for (mixed_job, mixed_last), it in zip(mixed_metas, items):
+                mixed_job.prefilled = it.start_pos + len(it.chunk_ids)
+                mixed_job.total_len = mixed_job.prefilled
+                if mixed_last:
+                    self._prefilling.remove(mixed_job)
+                    self._cache_insert(mixed_job)
+                    self._enter_decode(mixed_job)
 
     def _process_decode(self) -> None:   # tpulint: hot-path
         """Sync + fan out the OLDEST in-flight dispatch (FIFO). Rows of the
@@ -1344,18 +1548,22 @@ class Scheduler:
             self._hold_left = self.core.cfg.prefill_hold_chunks
         elif not ramp:
             self._holding = False
-        # Mixed-phase dispatch: when ONE job is prefilling while decode is
-        # live (the r05 TTFT-tail shape — a long prompt admitted mid-
-        # decode), its next chunk rides the decode dispatch as extra ragged
+        # Mixed-phase dispatch: when jobs are prefilling while decode is
+        # live (the r05 TTFT-tail shape — prompts admitted mid-decode),
+        # each one's next chunk rides the decode dispatch as extra ragged
         # rows (engine.decode_mixed) instead of a separate program, so the
-        # decode tick never stalls for it. Ramps (hold active) and multi-
-        # job refills keep the grouped prefill path — G-at-once activation
-        # beats one fused chunk there.
-        try_mixed = (bool(self._prefilling) and bool(self._slots)
-                     and len(self._prefilling) == 1
+        # decode tick never stalls for them. Up to prefill_group jobs fuse
+        # per dispatch (one chunk each); ramps (hold active) and refills
+        # with any ineligible job keep the grouped prefill path — G-at-once
+        # chunk-deep prefill beats one fused chunk per job there.
+        try_mixed = (self._role != "prefill"
+                     and bool(self._prefilling) and bool(self._slots)
+                     and len(self._prefilling)
+                     <= max(1, getattr(self.core.cfg, "prefill_group", 1))
                      and not (self._holding and self._hold_left > 0)
                      and getattr(self.core, "mixed_supported", False)
-                     and self._mixed_eligible(self._prefilling[0]))
+                     and all(self._mixed_eligible(j)
+                             for j in self._prefilling))
         if self._prefilling and not try_mixed:
             # ONE grouped dispatch per tick: up to prefill_group jobs' chunks
             # ride a single program (same device-seconds as serial chunks,
@@ -1393,7 +1601,10 @@ class Scheduler:
             for _, j, _e in waiting:
                 j.first_batched = True
             self._first_fetches.append((fut, waiting))
-        if self._slots and not hold:
+        if self._slots and not hold and self._role != "prefill":
+            # a prefill-role worker NEVER dispatches decode: its "slots"
+            # are finished prefills awaiting the batched first-token fetch
+            # and their KV export (_export_handoff)
             self._dispatch_decode(try_mixed)
             worked = True
         # backpressure: bound dispatches in flight; drain fully once

@@ -36,6 +36,7 @@ from typing import Any, Dict, List, Optional
 from aiohttp import web
 
 from generativeaiexamples_tpu.engine import grammar as grammar_mod
+from generativeaiexamples_tpu.engine import kv_cache as kv_cache_mod
 from generativeaiexamples_tpu.engine import tools as tools_mod
 from generativeaiexamples_tpu.engine.engine import TOP_LP
 from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
@@ -43,8 +44,8 @@ from generativeaiexamples_tpu.observability import flight as flight_mod
 from generativeaiexamples_tpu.observability import otel
 from generativeaiexamples_tpu.observability import slo as slo_mod
 from generativeaiexamples_tpu.server.common import (
-    MAX_TOKENS_CAP, StreamDrain, add_debug_routes, health_handler,
-    metrics_handler, parse_stop, sse_done, sse_write,
+    MAX_TOKENS_CAP, StreamDrain, add_debug_routes, metrics_handler,
+    parse_stop, sse_done, sse_write,
 )
 
 
@@ -104,19 +105,57 @@ class ModelServer:
     def __init__(self, scheduler: Scheduler, model_name: str) -> None:
         self.scheduler = scheduler
         self.model_name = model_name
-        self.app = web.Application()
+        self.app = web.Application(client_max_size=1024 ** 3)
         self.app.add_routes([
-            web.get("/health", health_handler),
+            # role-aware health: the engine's own handler rides the
+            # scheduler's load surface on the liveness body, so the
+            # routing frontend (server/failover.py) discovers roles and
+            # queue depth with the probes it already makes
+            web.get("/health", self.health),
             web.get("/metrics", metrics_handler),
             web.get("/v1/models", self.models),
             web.post("/v1/chat/completions", self.chat_completions),
             web.post("/v1/completions", self.completions),
+            # KV-page handoff between engine roles (disaggregated
+            # serving): prefill exports, handoff imports + streams
+            web.post("/v1/kv/prefill", self.kv_prefill),
+            web.post("/v1/kv/handoff", self.kv_handoff),
         ])
         # /debug/flight + /debug/requests[/<id>] — the engine process is
         # where the scheduler lives, so these answer with live data here
         add_debug_routes(self.app)
 
     # ------------------------------------------------------------- endpoints
+
+    @property
+    def role(self) -> str:
+        """This worker's serving role (core/config.py APP_ENGINE_ROLE)."""
+        core = getattr(self.scheduler, "core", None)
+        return str(getattr(core, "role", "unified") or "unified")
+
+    async def health(self, request: web.Request) -> web.Response:
+        """Liveness + the routing surface: engine_role, queue depth, slot
+        fill, and slo_pressure ride the probe the pool client already
+        makes (server/failover.py scores least-loaded dispatch from
+        exactly these fields)."""
+        stats: Dict[str, Any] = {}
+        try:
+            stats = self.scheduler.load_stats()
+        except Exception as exc:
+            # health must answer even if the scheduler is mid-reset
+            logging.getLogger(__name__).debug("load_stats failed: %s", exc)
+        return web.json_response({"message": "Service is up.",
+                                  "slo_pressure": slo_mod.SLO.pressure(),
+                                  **stats})
+
+    def _require_decode_capable(self) -> None:
+        if self.role == "prefill":
+            raise web.HTTPConflict(text=json.dumps(
+                {"error": "this worker serves APP_ENGINE_ROLE=prefill: it "
+                          "only runs chunked prefill (/v1/kv/prefill) and "
+                          "never decodes — route generation to a decode or "
+                          "unified worker (server/failover.py does this "
+                          "from /health role discovery)"}))
 
     async def models(self, request: web.Request) -> web.Response:
         cards = [{"id": self.model_name, "object": "model",
@@ -206,8 +245,13 @@ class ModelServer:
             content.append(entry)
         return {"content": content}
 
-    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
-        body = await request.json()
+    def _prepare_chat(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """The ONE message-preparation pipeline for every chat-shaped
+        entrypoint (/v1/chat/completions and /v1/kv/prefill): thinking
+        toggle, forced-tool validation, message normalization, tool/JSON
+        prompt-contract injection. Shared so the two endpoints cannot
+        drift — the prompt a handoff route prefills must be exactly the
+        prompt a unified route would have served."""
         messages = body.get("messages", [])
         if not messages:
             raise web.HTTPBadRequest(text=json.dumps(
@@ -224,7 +268,8 @@ class ModelServer:
         tools = body.get("tools") or []
         tool_choice = body.get("tool_choice", "auto" if tools else "none")
         response_format = body.get("response_format") or {}
-        json_mode = response_format.get("type") in ("json_object", "json_schema")
+        json_mode = response_format.get("type") in ("json_object",
+                                                    "json_schema")
         name = tools_mod.forced_name(tool_choice)
         if name and name not in tools_mod.tool_names(tools):
             raise web.HTTPBadRequest(text=json.dumps(
@@ -232,11 +277,28 @@ class ModelServer:
         messages = tools_mod.normalize_messages(messages)
         use_tools = bool(tools) and tool_choice != "none"
         if use_tools:
-            messages = tools_mod.inject_tool_prompt(messages, tools, tool_choice)
+            messages = tools_mod.inject_tool_prompt(messages, tools,
+                                                    tool_choice)
         if json_mode:
             # with tools, the JSON constraint scopes to non-tool replies
             messages = tools_mod.inject_json_prompt(
                 messages, response_format, with_tools=use_tools)
+        return {"messages": messages, "tools": tools,
+                "tool_choice": tool_choice,
+                "response_format": response_format, "json_mode": json_mode,
+                "use_tools": use_tools, "forced_name": name}
+
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        self._require_decode_capable()
+        body = await request.json()
+        prep = self._prepare_chat(body)
+        messages = prep["messages"]
+        tools = prep["tools"]
+        tool_choice = prep["tool_choice"]
+        response_format = prep["response_format"]
+        json_mode = prep["json_mode"]
+        use_tools = prep["use_tools"]
+        name = prep["forced_name"]
         # On-device constrained decoding whenever the output contract is
         # unambiguous: a forced/required tool call, or JSON mode without
         # tools (tool_choice "auto" may legally answer in prose, so it
@@ -273,10 +335,101 @@ class ModelServer:
                                grammar_prefix=cont)
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
+        self._require_decode_capable()
         body = await request.json()
         prompt = body.get("prompt", "")
         prompt_ids = self.scheduler.tokenizer.encode(prompt, add_bos=True)
         return await self._run(request, body, prompt_ids, chat=False)
+
+    # ------------------------------------------- KV handoff (disaggregation)
+
+    def _prompt_ids_from_body(self, body: Dict[str, Any]) -> list:
+        """Render a /v1/kv/prefill request body to prompt ids: chat
+        messages run the SAME preparation pipeline as /v1/chat/completions
+        (`_prepare_chat` — one copy, so the endpoints cannot drift;
+        token-level grammars still do NOT ride the handoff — constrained
+        decoding on disaggregated routes degrades to prompt+parse,
+        documented in docs/performance.md); a raw ``prompt`` is encoded
+        directly. ``continue_text`` appends an emitted prefix for
+        mid-stream failover resumes, exactly as the unified resume path
+        does."""
+        if body.get("messages"):
+            prep = self._prepare_chat(body)
+            prompt_ids = self.scheduler.tokenizer.apply_chat_template(
+                prep["messages"])
+        else:
+            prompt_ids = self.scheduler.tokenizer.encode(
+                str(body.get("prompt", "")), add_bos=True)
+        cont = str(body.get("continue_text") or "")
+        if cont:
+            prompt_ids = prompt_ids + self.scheduler.tokenizer.encode(cont)
+        return prompt_ids
+
+    async def kv_prefill(self, request: web.Request) -> web.Response:
+        """Run chunked prefill for a request and return the exported KV
+        pages + sampling state as a JSON handoff payload — the prefill
+        half of disaggregated serving. Any role can serve this (a unified
+        worker is a valid prefill source); the payload POSTs to a decode
+        worker's /v1/kv/handoff, which imports it and streams the
+        completion."""
+        body = await request.json()
+        prompt_ids = self._prompt_ids_from_body(body)
+        sampling = self._parse_sampling(body)
+        sampling.pop("logprobs", None)
+        sampling.pop("top_logprobs", None)
+        slo_fields = self._parse_slo(request)
+        req = Request(prompt_ids=list(prompt_ids), prefill_only=True,
+                      **slo_fields, **sampling)
+        request["engine_request"] = req
+        self.scheduler.submit(req)
+        await StreamDrain(self.scheduler.iter_text(req)).join_text()
+        if req.error or not req.handoff:
+            raise web.HTTPServiceUnavailable(text=json.dumps(
+                {"error": req.error or "prefill produced no handoff"}))
+        wire = kv_cache_mod.encode_kv_payload(req.handoff)
+        return web.json_response(wire,
+                                 headers={"X-Request-Id": req.request_id})
+
+    async def kv_handoff(self, request: web.Request) -> web.StreamResponse:
+        """Import a /v1/kv/prefill payload into this worker's pool and
+        stream the completion (SSE, same framing as /v1/chat/completions)
+        — the decode half of disaggregated serving. Pool-geometry or
+        dtype mismatches are a loud 409: prefill and decode workers must
+        serve the same model + kv_quant."""
+        self._require_decode_capable()
+        body = await request.json()
+        try:
+            payload = kv_cache_mod.decode_kv_payload(body)
+        except Exception as exc:
+            raise web.HTTPBadRequest(text=json.dumps(
+                {"error": f"undecodable handoff payload: {exc}"}))
+        slo_fields = self._parse_slo(request)
+        req = Request(
+            prompt_ids=[int(t) for t in payload.get("prompt_ids", [])],
+            max_tokens=int(payload.get("max_tokens", 128)),
+            temperature=float(payload.get("temperature", 0.7)),
+            top_k=int(payload.get("top_k", 0)),
+            top_p=float(payload.get("top_p", 1.0)),
+            stop=parse_stop(payload.get("stop")),
+            seed=int(payload.get("seed", 0)),
+            **slo_fields)
+        try:
+            self.scheduler.submit_prefilled(req, payload)
+        except ValueError as exc:
+            raise web.HTTPConflict(text=json.dumps({"error": str(exc)}))
+        request["engine_request"] = req
+        model = str(body.get("model") or self.model_name)
+        rid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
+        resp = await self._sse_response(request)
+        await sse_write(resp, _chunk(model, rid, {"role": "assistant"}))
+        async for delta in StreamDrain(self.scheduler.iter_text(req)):
+            await sse_write(resp, _chunk(model, rid, {"content": delta}))
+        final = json.loads(_chunk(model, rid, {}, _finish_reason(req)))
+        if req.error:
+            final["error"] = req.error
+        await sse_write(resp, json.dumps(final))
+        await sse_done(resp)
+        return resp
 
     # --------------------------------------------------------------- serving
 

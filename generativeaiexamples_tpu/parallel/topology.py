@@ -1,0 +1,49 @@
+"""Serving-topology planning: which engine role each worker of a pool runs.
+
+The parallel/ glue for disaggregated prefill/decode serving (ROADMAP item
+1): `parallel/{mesh,sharding,pipeline}.py` shape programs WITHIN a worker;
+this module shapes the pool ACROSS workers — how many processes run
+chunked prefill + KV export (``APP_ENGINE_ROLE=prefill``) versus decode
+replicas importing handed-off pages (``role=decode``). bench.py's
+disaggregated round and deploy tooling consume the plan; the routing
+frontend (server/failover.py) discovers the resulting roles from /health
+at runtime, so the plan never has to be communicated out of band.
+
+Prefill:decode sizing. Prefill is compute-bound (one prompt saturates a
+chip's MXU), decode is weight-read-bound and batches across requests, so
+decode replicas want the larger share of a pool; ~1/3 prefill is the
+RAGO-style starting split for chat-shaped traffic (long prompts, short
+answers skew higher; the router's least-loaded scoring absorbs the error
+within a role).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+def plan_engine_roles(n_workers: int,
+                      prefill_share: float = 1.0 / 3.0) -> List[str]:
+    """Role per worker for an ``n_workers`` pool.
+
+    One worker stays unified (disaggregation needs at least one of each
+    role to beat it); larger pools split ``prefill_share`` of workers to
+    prefill, the rest to decode, always keeping at least one of each.
+    """
+    if n_workers < 1:
+        raise ValueError(f"need at least one worker, got {n_workers}")
+    if not 0.0 < prefill_share < 1.0:
+        raise ValueError(f"prefill_share must be in (0, 1), "
+                         f"got {prefill_share}")
+    if n_workers == 1:
+        return ["unified"]
+    n_prefill = min(max(1, round(n_workers * prefill_share)), n_workers - 1)
+    return ["prefill"] * n_prefill + ["decode"] * (n_workers - n_prefill)
+
+
+def describe_topology(roles: List[str]) -> Dict[str, int]:
+    """Role → count summary (bench JSON + logs)."""
+    out: Dict[str, int] = {}
+    for r in roles:
+        out[r] = out.get(r, 0) + 1
+    return out

@@ -1,23 +1,39 @@
-"""Multi-worker serving resilience: health-tracked failover with mid-stream
-resume.
+"""Multi-worker routing frontend: role discovery, SLO-aware least-loaded
+dispatch, disaggregated prefill→decode handoff, and mid-stream failover.
 
-Closes SURVEY §5.3's multi-host gap (the round-3 partial): the reference
-leans on compose healthchecks + `restart: always` + generous client retries
-(ref: RAG/examples/local_deploy/docker-compose-nim-ms.yaml:23-28,
-docker-compose-vectordb.yaml:90,108) — a worker death still kills every
-in-flight generation. Here the chain-server side heals mid-stream:
+Generalizes the round-3 health-tracked failover pool into the serving
+frontend ROADMAP item 1 calls for (the placement/phase-splitting axis RAGO
+identifies as dominant for RAG serving): the reference leans on compose
+healthchecks + ``restart: always`` + client retries (ref: RAG/examples/
+local_deploy/docker-compose-nim-ms.yaml:23-28), one static worker behind
+one URL. Here the chain-server side routes:
 
-  * ``FailoverLLM`` speaks OpenAI ``/v1`` to a POOL of engine workers
-    (e.g. one per TPU slice host). A request streams from one worker; if
-    the connection dies or the stream reports an engine error, the client
-    RESUBMITS to a surviving worker carrying the text already emitted
-    (``continue_text`` — the engine renders template + prefix and decodes
-    onward, the same prompt+generated resume shape its own scheduler uses
-    for preemptions, engine/server.py). The consumer's iterator never
-    notices: no duplicate text, no dropped stream.
-  * Failed workers are circuit-broken for a cooldown and re-admitted only
-    after ``/health`` passes — meanwhile deploy/supervisor.py restarts the
-    dead process (its §5.3 role), so the pool self-heals.
+  * **Role discovery.** Every worker's ``/health`` body carries its
+    ``engine_role`` (core/config.py ``APP_ENGINE_ROLE``) plus live load —
+    queue depth, slot fill, SLO pressure (engine/server.py health). The
+    pool learns the topology from the probes it already makes; a worker
+    with no role field is a plain unified worker (old engines keep
+    working).
+  * **Least-loaded dispatch.** Selection is scored, not round-robin:
+    ``(running + prefilling + waiting + locally-dispatched) / batch`` plus
+    an SLO-pressure penalty (the PR-4 headroom/shed signals surfaced on
+    /health) — an alive-but-burning worker is dispreferred before it ever
+    breaches. ``dispatched`` counts this client's own sends since the last
+    probe, so a burst between probes still spreads.
+  * **Disaggregated serving.** When the pool holds prefill- AND decode-role
+    workers, a chat streams in two phases: POST ``/v1/kv/prefill`` on the
+    least-loaded prefill worker (chunked prefill + KV-page export), then
+    hand the payload to the least-loaded decode replica's
+    ``/v1/kv/handoff`` and stream the completion. Long prefills never
+    contend with decode steps for a chip — the structural fix for the
+    prefill/decode interference the single-chip mixed dispatch (PR 5) can
+    only soften.
+  * **Failure path preserved.** A worker death mid-stream circuit-breaks it
+    for a cooldown and RESUMES on survivors carrying the emitted prefix
+    (``continue_text`` — re-prefilled through the same route, so a
+    disaggregated resume re-prefills on a prefill worker and decodes on
+    another replica). The consumer's iterator never notices: no duplicate
+    text, no dropped stream.
 
 The pool is selected by APP_LLM_SERVER_URL containing a comma-separated
 URL list (chains/llm_client.py get_llm) — zero changes to any chain.
@@ -27,10 +43,11 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 import time
 import urllib.request
-from typing import Dict, Iterator, List, Sequence
+from typing import Dict, Iterator, List, Optional, Sequence
 
 from generativeaiexamples_tpu.core.config import http_timeout
 from generativeaiexamples_tpu.core.metrics import REGISTRY
@@ -39,12 +56,28 @@ from generativeaiexamples_tpu.observability import slo as slo_mod
 logger = logging.getLogger(__name__)
 
 _PRESSURE_GAUGE = {"ok": 0, "warn": 1, "critical": 2}
+# least-loaded scoring: an alive-but-burning worker yields to a healthy one
+# unless every alternative is deeply queued (critical ≈ 4 extra batches)
+_PRESSURE_PENALTY = {"": 0.0, "ok": 0.0, "warn": 1.0, "critical": 4.0}
 
 
 class _Worker:
     def __init__(self, url: str) -> None:
         self.url = url.rstrip("/")
         self.down_until = 0.0
+        # discovered from /health (engine/server.py health handler): the
+        # worker's serving role and live load. "" role = not yet probed;
+        # a health body with no engine_role field is a unified worker.
+        self.role = ""
+        self.running = 0
+        self.prefilling = 0
+        self.waiting = 0
+        self.batch = 0
+        self.probed_at = 0.0          # monotonic of the last good probe
+        # requests THIS client routed here since the last probe: keeps a
+        # burst between probes spreading instead of piling on one worker
+        self.dispatched = 0
+        self.total_dispatched = 0     # never reset (bench imbalance reads it)
         # last SLO pressure the worker reported on /health (observability/
         # slo.py rides the liveness body): "" until first probed. A worker
         # can be alive-but-burning — the pool surfaces that distinction.
@@ -58,11 +91,20 @@ class _Worker:
                 if ok:
                     try:
                         body = json.loads(resp.read().decode("utf-8"))
+                        self.role = str(body.get("engine_role", "")
+                                        or "unified")
+                        self.running = int(body.get("running", 0) or 0)
+                        self.prefilling = int(body.get("prefilling", 0) or 0)
+                        self.waiting = int(body.get("waiting", 0) or 0)
+                        self.batch = int(body.get("batch", 0) or 0)
                         self.slo_pressure = str(
                             body.get("slo_pressure", "") or "")
-                    except (ValueError, UnicodeDecodeError) as exc:
+                    except (ValueError, UnicodeDecodeError, TypeError) as exc:
                         logger.debug("health body from %s unparsable: %s",
                                      self.url, exc)
+                        self.role = self.role or "unified"
+                    self.probed_at = time.monotonic()
+                    self.dispatched = 0
                     if self.slo_pressure in _PRESSURE_GAUGE:
                         # per-worker pressure on the POOL CLIENT's own
                         # /metrics (0/1/2) — the operator view of
@@ -82,34 +124,124 @@ class _Worker:
             logger.debug("health probe %s failed: %s", self.url, exc)
             return False
 
+    @property
+    def score(self) -> float:
+        """Lower = less loaded. Queue depth normalized by slot capacity,
+        plus the SLO-pressure penalty — the headroom/pressure signals from
+        the PR-4 SLO plane, read straight off /health."""
+        cap = float(self.batch or 8)
+        depth = (self.running + self.prefilling + self.waiting
+                 + self.dispatched)
+        return depth / cap + _PRESSURE_PENALTY.get(self.slo_pressure, 0.0)
+
 
 class FailoverLLM:
-    """Drop-in for RemoteLLM (chains/llm_client.py) over several workers."""
+    """Routing frontend over a pool of engine workers — drop-in for
+    RemoteLLM (chains/llm_client.py). Unified pools behave like the round-3
+    failover client (now least-loaded instead of round-robin); pools with
+    prefill-/decode-role workers serve disaggregated."""
 
     def __init__(self, urls: Sequence[str], model: str,
-                 cooldown_s: float = 10.0, max_attempts: int = 4) -> None:
+                 cooldown_s: float = 10.0, max_attempts: int = 4,
+                 refresh_s: Optional[float] = None) -> None:
         if not urls:
             raise ValueError("FailoverLLM needs at least one worker URL")
         self._workers = [_Worker(u) for u in urls]
         self.model = model
         self.cooldown_s = cooldown_s
         self.max_attempts = max_attempts
-        self._rr = 0
+        if refresh_s is None:
+            try:
+                refresh_s = float(os.environ.get("APP_ROUTER_REFRESH_S",
+                                                 "2.0"))
+            except ValueError:
+                refresh_s = 2.0
+        self.refresh_s = refresh_s
+        self._discovered = False
+        self._discover_lock = threading.Lock()
+        # guards SELECTION state (score reads + dispatched increments) for
+        # concurrent chat threads; health probes stay outside it (HTTP
+        # under a lock is a tpulint-enforced hazard)
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------- selection
 
-    def _candidates(self) -> List[_Worker]:
-        """Round-robin order, circuit-broken workers last (re-probed —
-        the supervisor may have restarted them)."""
-        with self._lock:
-            self._rr += 1
-            order = (self._workers[self._rr % len(self._workers):]
-                     + self._workers[: self._rr % len(self._workers)])
+    def _ensure_roles(self) -> None:
+        """One-time topology discovery: probe every worker once so the
+        first request already routes by role (later probes refresh lazily
+        on the serving path)."""
+        if self._discovered:
+            return
+        with self._discover_lock:
+            if self._discovered:
+                return
+            for w in self._workers:
+                if not w.healthy():
+                    self._mark_down(w)
+            self._discovered = True
+
+    def topology(self) -> Dict[str, List[str]]:
+        """Discovered role → worker-URL map (bench + debugging surface)."""
+        self._ensure_roles()
+        out: Dict[str, List[str]] = {}
+        for w in self._workers:
+            out.setdefault(w.role or "unified", []).append(w.url)
+        return out
+
+    def dispatch_counts(self) -> Dict[str, Dict[str, object]]:
+        """Per-worker lifetime dispatch counts + roles (bench reads the
+        decode-replica imbalance from these)."""
+        return {w.url: {"role": w.role or "unified",
+                        "dispatched": w.total_dispatched}
+                for w in self._workers}
+
+    def _pick(self, roles: Sequence[str],
+              exclude: Sequence[str] = ()) -> Optional[_Worker]:   # tpulint: hot-path
+        """Least-loaded healthy worker among ``roles``. Stale load views
+        refresh via /health on the way (bounded by the probe timeout);
+        circuit-broken workers re-probe only once their cooldown expires
+        (the supervisor may have restarted them)."""
+        self._ensure_roles()
         now = time.monotonic()
-        up = [w for w in order if w.down_until <= now]
-        recovering = [w for w in order if w.down_until > now]
-        return up + recovering
+        cands = [w for w in self._workers
+                 if (w.role or "unified") in roles and w.url not in exclude]
+        up = [w for w in cands if w.down_until <= now]
+        for w in up:
+            if now - w.probed_at > self.refresh_s and not w.healthy():
+                self._mark_down(w)
+        # re-filter by ROLE as well as liveness: a refresh above may have
+        # just discovered that a worker admitted under a stale/unknown role
+        # actually serves a different one (e.g. a prefill worker that was
+        # down at discovery) — dispatching to it would draw a deterministic
+        # role 409, not a retryable transport error
+        up = [w for w in up if w.down_until <= time.monotonic()
+              and (w.role or "unified") in roles]
+        if not up:
+            # every candidate is cooling down: re-probe rather than fail —
+            # a restarted worker re-admits the moment /health passes
+            for w in cands:
+                if w.healthy() and (w.role or "unified") in roles:
+                    w.down_until = 0.0
+                    up.append(w)
+        if not up:
+            return None
+        with self._lock:
+            best = min(up, key=lambda w: w.score)
+            best.dispatched += 1
+            best.total_dispatched += 1
+        REGISTRY.counter("router_dispatches",
+                         labels={"worker": best.url,
+                                 "role": best.role or "unified"}).inc()
+        return best
+
+    def _has_disagg(self) -> bool:
+        """Serve disaggregated iff the pool holds at least one prefill-role
+        AND one decode-role worker not currently circuit-broken."""
+        self._ensure_roles()
+        now = time.monotonic()
+        alive = [w for w in self._workers if w.down_until <= now]
+        return (any(w.role == "prefill" for w in alive)
+                and any(w.role == "decode" for w in alive))
 
     def _mark_down(self, w: _Worker) -> None:
         w.down_until = time.monotonic() + self.cooldown_s
@@ -121,29 +253,87 @@ class FailoverLLM:
     def chat(self, messages: Sequence[Dict[str, str]], max_tokens: int = 256,
              temperature: float = 0.7, top_p: float = 1.0,
              top_k: int = 0, response_format: Dict = None) -> Iterator[str]:
-        """Streaming chat that survives worker death mid-generation.
-        ``response_format`` rides through to the engine — under a
+        """Streaming chat that survives worker death mid-generation and
+        serves disaggregated when the pool topology allows. On a unified
+        pool, ``response_format`` rides through to the engine — under a
         json_schema grammar the resumed stream is byte-exact (the engine
-        walks the grammar over the continuation prefix)."""
+        walks the grammar over the continuation prefix). On disaggregated
+        routes constrained decoding degrades to prompt+parse (the grammar
+        state does not ride the handoff — docs/performance.md)."""
+        if self._has_disagg():
+            yield from self._chat_disagg(messages, max_tokens, temperature,
+                                         top_p, top_k, response_format)
+        else:
+            yield from self._chat_unified(messages, max_tokens, temperature,
+                                          top_p, top_k, response_format)
+
+    def _payload(self, messages, max_tokens, temperature, top_p, top_k,
+                 response_format, emitted: List[str],
+                 stream: bool) -> Dict[str, object]:
+        payload: Dict[str, object] = {
+            "model": self.model, "messages": list(messages),
+            "max_tokens": max_tokens, "temperature": temperature,
+            "top_p": top_p, "top_k": top_k}
+        if stream:
+            payload["stream"] = True
+        if response_format:
+            payload["response_format"] = dict(response_format)
+        if emitted:
+            payload["continue_text"] = "".join(emitted)
+        return payload
+
+    def _pump_sse(self, resp, emitted: List[str]) -> Iterator[str]:
+        """Drain one OpenAI SSE stream, yielding content deltas and
+        recording them in ``emitted`` (the resume prefix). Raises
+        TransportError when the stream dies before [DONE] — the caller
+        fails over; an engine-reported request error raises RuntimeError
+        (retrying the same payload is pointless and would circuit-break a
+        healthy worker)."""
         import httpx
 
-        emitted: List[str] = []
-        last_err: Exception = RuntimeError("no engine worker available")
-        for attempt in range(self.max_attempts):
-            cands = self._candidates()
-            w = cands[0]
-            if w.down_until > time.monotonic() and not w.healthy():
-                last_err = RuntimeError(f"{w.url} unhealthy")
+        truncated = True
+        for line in resp.iter_lines():
+            if not line.startswith("data: "):
                 continue
-            payload = {"model": self.model, "messages": list(messages),
-                       "max_tokens": max_tokens, "temperature": temperature,
-                       "top_p": top_p, "top_k": top_k, "stream": True}
-            if response_format:
-                payload["response_format"] = dict(response_format)
+            data = line[len("data: "):]
+            if data.strip() == "[DONE]":
+                truncated = False
+                break
+            chunk = json.loads(data)
+            choices = chunk.get("choices") or [{}]
+            if (chunk.get("error")
+                    or choices[0].get("finish_reason") == "error"):
+                raise RuntimeError(f"engine error: {chunk.get('error')}")
+            content = choices[0].get("delta", {}).get("content")
+            if content:
+                emitted.append(content)
+                yield content
+        if truncated:
+            raise httpx.TransportError("stream truncated")
+
+    def _chat_unified(self, messages, max_tokens, temperature, top_p,
+                      top_k, response_format,
+                      emitted: Optional[List[str]] = None) -> Iterator[str]:
+        """The round-3 failover path over unified/decode workers, selection
+        upgraded from round-robin to least-loaded. ``emitted`` carries a
+        prefix already delivered to the consumer (a disaggregated route
+        falling back mid-stream) — it rides as ``continue_text`` so the
+        stream resumes instead of restarting."""
+        import httpx
+
+        emitted = [] if emitted is None else emitted
+        last_err: Exception = RuntimeError("no engine worker available")
+        for _ in range(self.max_attempts):
+            w = self._pick(("unified", "decode", ""))
+            if w is None:
+                last_err = RuntimeError("no unified/decode worker up")
+                continue
+            payload = self._payload(messages, max_tokens, temperature,
+                                    top_p, top_k, response_format, emitted,
+                                    stream=True)
             if emitted:
-                payload["continue_text"] = "".join(emitted)
                 logger.info("resuming stream on %s at %d chars", w.url,
-                            len(payload["continue_text"]))
+                            len(str(payload["continue_text"])))
             try:
                 # SLO class + remaining deadline + traceparent, same as
                 # RemoteLLM — a failover RESUME carries the (shrunken)
@@ -157,33 +347,8 @@ class FailoverLLM:
                         raise httpx.TransportError(
                             f"HTTP {resp.status_code}")
                     resp.raise_for_status()   # 4xx: deterministic — raise
-                    truncated = True
-                    for line in resp.iter_lines():
-                        if not line.startswith("data: "):
-                            continue
-                        data = line[len("data: "):]
-                        if data.strip() == "[DONE]":
-                            truncated = False
-                            break
-                        chunk = json.loads(data)
-                        choices = chunk.get("choices") or [{}]
-                        if (chunk.get("error")
-                                or choices[0].get("finish_reason") == "error"):
-                            # the engine is ALIVE and reporting a request-
-                            # level failure: retrying the same payload is
-                            # pointless and would circuit-break a healthy
-                            # worker — surface it
-                            raise RuntimeError(
-                                f"engine error: {chunk.get('error')}")
-                        content = choices[0].get("delta", {}).get("content")
-                        if content:
-                            emitted.append(content)
-                            yield content
-                    if not truncated:
-                        return                          # clean completion
-                # stream ended without [DONE]: the worker died mid-reply —
-                # mark it down and resume on a survivor
-                raise httpx.TransportError(f"{w.url} stream truncated")
+                    yield from self._pump_sse(resp, emitted)
+                    return                    # clean completion
             except (httpx.TransportError, httpx.StreamError,
                     json.JSONDecodeError, ConnectionError, OSError) as exc:
                 last_err = exc
@@ -192,9 +357,84 @@ class FailoverLLM:
             f"LLM request failed across {self.max_attempts} attempts: "
             f"{last_err}")
 
+    def _chat_disagg(self, messages, max_tokens, temperature, top_p,
+                     top_k, response_format) -> Iterator[str]:   # tpulint: hot-path
+        """Two-phase disaggregated serving: prefill (KV export) on the
+        least-loaded prefill worker, decode on the least-loaded decode
+        replica. A failure in either phase circuit-breaks that worker and
+        re-runs the route; resumes fold the emitted prefix into the next
+        prefill (``continue_text``), so a decode-replica death re-prefills
+        elsewhere and continues the stream seamlessly. If the
+        disaggregated topology collapses mid-retry (all prefill or all
+        decode workers down), the attempt falls back to the unified path
+        with the same resume prefix."""
+        import httpx
+
+        emitted: List[str] = []
+        last_err: Exception = RuntimeError("no engine worker available")
+        for _ in range(self.max_attempts):
+            if not self._has_disagg():
+                # topology collapsed mid-retry: the unified path carries
+                # the already-yielded prefix so the stream RESUMES, never
+                # restarts (no duplicated text at the consumer)
+                yield from self._chat_unified(messages, max_tokens,
+                                              temperature, top_p, top_k,
+                                              response_format,
+                                              emitted=emitted)
+                return
+            pw = self._pick(("prefill",))
+            if pw is None:
+                last_err = RuntimeError("no prefill worker up")
+                continue
+            payload = self._payload(messages, max_tokens, temperature,
+                                    top_p, top_k, response_format, emitted,
+                                    stream=False)
+            try:
+                resp = httpx.post(f"{pw.url}/v1/kv/prefill", json=payload,
+                                  headers=slo_mod.outbound_headers(),
+                                  timeout=http_timeout(120.0))
+                if resp.status_code >= 500:
+                    raise httpx.TransportError(f"HTTP {resp.status_code}")
+                resp.raise_for_status()       # 4xx: deterministic — raise
+                handoff = resp.json()
+            except (httpx.TransportError, httpx.StreamError,
+                    json.JSONDecodeError, ConnectionError, OSError) as exc:
+                last_err = exc
+                self._mark_down(pw)
+                continue
+            dw = self._pick(("decode",))
+            if dw is None:
+                last_err = RuntimeError("no decode worker up")
+                continue
+            t0 = time.monotonic()
+            try:
+                with httpx.stream("POST", f"{dw.url}/v1/kv/handoff",
+                                  json=handoff,
+                                  headers=slo_mod.outbound_headers(),
+                                  timeout=http_timeout(120.0)) as dresp:
+                    if dresp.status_code >= 500:
+                        raise httpx.TransportError(
+                            f"HTTP {dresp.status_code}")
+                    dresp.raise_for_status()
+                    # handoff latency: prefill payload in hand → decode
+                    # stream open (admission imported the pages)
+                    REGISTRY.histogram("router_handoff_s").observe(
+                        time.monotonic() - t0)
+                    yield from self._pump_sse(dresp, emitted)
+                    return                    # clean completion
+            except (httpx.TransportError, httpx.StreamError,
+                    json.JSONDecodeError, ConnectionError, OSError) as exc:
+                last_err = exc
+                self._mark_down(dw)
+        raise RuntimeError(
+            f"LLM request failed across {self.max_attempts} attempts: "
+            f"{last_err}")
+
     def chat_tools(self, messages: Sequence[Dict], tools: Sequence[Dict],
                    tool_choice="auto", **sampling) -> Dict:
-        """Non-streamed tool turn: whole-request retry across the pool."""
+        """Non-streamed tool turn: whole-request retry across the pool's
+        decode-capable workers (tool turns buffer server-side, so they
+        stay on the single-worker path regardless of topology)."""
         import httpx
 
         payload = {"model": self.model, "messages": list(messages),
@@ -204,9 +444,9 @@ class FailoverLLM:
             payload["tool_choice"] = tool_choice
         last_err: Exception = RuntimeError("no engine worker available")
         for _ in range(self.max_attempts):
-            w = self._candidates()[0]
-            if w.down_until > time.monotonic() and not w.healthy():
-                last_err = RuntimeError(f"{w.url} unhealthy")
+            w = self._pick(("unified", "decode", ""))
+            if w is None:
+                last_err = RuntimeError("no unified/decode worker up")
                 continue
             try:
                 resp = httpx.post(f"{w.url}/v1/chat/completions",

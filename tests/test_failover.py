@@ -195,3 +195,163 @@ def test_pool_retries_whole_request_when_worker_down(two_engines):
     pool = FailoverLLM(urls, "tiny", cooldown_s=2.0)
     text = "".join(pool.chat(MESSAGES, max_tokens=32, temperature=0.0))
     assert text
+
+
+# ---------------------------------------------------------------------------
+# Routing frontend: role discovery + least-loaded dispatch over FAKE workers
+# (plain HTTP servers serving canned /health + SSE bodies — no engines, no
+# compile cost; the real prefill→handoff path is pinned in-process by
+# tests/test_disagg.py and over HTTP by bench.run_disagg_round)
+# ---------------------------------------------------------------------------
+
+import http.server
+import threading
+
+
+class _FakeWorker:
+    """Canned engine worker: /health reports a role + load, the serving
+    endpoints reply with minimal conforming bodies and count hits."""
+
+    def __init__(self, role="unified", running=0, waiting=0, batch=8,
+                 pressure="ok", text="hello"):
+        self.role, self.text = role, text
+        self.running, self.waiting, self.batch = running, waiting, batch
+        self.pressure = pressure
+        self.alive = True            # False → /health answers 503 (draining)
+        self.hits = {"health": 0, "prefill": 0, "handoff": 0, "chat": 0}
+        worker = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, body: bytes, ctype: str) -> None:
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path != "/health":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                worker.hits["health"] += 1
+                if not worker.alive:
+                    self.send_response(503)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self._reply(json.dumps({
+                    "message": "up", "engine_role": worker.role,
+                    "running": worker.running, "prefilling": 0,
+                    "waiting": worker.waiting, "batch": worker.batch,
+                    "slo_pressure": worker.pressure}).encode(),
+                    "application/json")
+
+            def do_POST(self):
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                if self.path == "/v1/kv/prefill":
+                    worker.hits["prefill"] += 1
+                    self._reply(json.dumps(
+                        {"fake_payload_from": worker.role}).encode(),
+                        "application/json")
+                    return
+                key = ("handoff" if self.path == "/v1/kv/handoff"
+                       else "chat")
+                worker.hits[key] += 1
+                sse = (
+                    'data: {"choices":[{"delta":{"role":"assistant"},'
+                    '"finish_reason":null}]}\n\n'
+                    'data: {"choices":[{"delta":{"content":'
+                    + json.dumps(worker.text) +
+                    '},"finish_reason":null}]}\n\n'
+                    'data: {"choices":[{"delta":{},'
+                    '"finish_reason":"stop"}]}\n\n'
+                    "data: [DONE]\n\n")
+                self._reply(sse.encode(), "text/event-stream")
+
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                      Handler)
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}"
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@contextlib.contextmanager
+def _fake_pool(*workers):
+    try:
+        yield workers
+    finally:
+        for w in workers:
+            w.close()
+
+
+def test_router_discovers_roles_and_routes_disaggregated():
+    """With prefill- and decode-role workers in the pool, a chat runs the
+    two-phase route: /v1/kv/prefill on the prefill worker, the payload to
+    ONE decode replica's /v1/kv/handoff — never /v1/chat/completions."""
+    with _fake_pool(_FakeWorker("prefill"), _FakeWorker("decode", text="ab"),
+                    _FakeWorker("decode", text="ab")) as (pw, d1, d2):
+        pool = FailoverLLM([pw.url, d1.url, d2.url], "tiny")
+        topo = pool.topology()
+        assert topo == {"prefill": [pw.url], "decode": [d1.url, d2.url]}
+        text = "".join(pool.chat(MESSAGES, max_tokens=8))
+        assert text == "ab"
+        assert pw.hits["prefill"] == 1 and pw.hits["chat"] == 0
+        assert d1.hits["handoff"] + d2.hits["handoff"] == 1
+        assert d1.hits["chat"] + d2.hits["chat"] == 0
+
+
+def test_router_least_loaded_under_skewed_pressure():
+    """Least-loaded scoring: a decode replica deep in queue and burning
+    its SLO budget (pressure=warn) loses every dispatch to an idle one."""
+    loaded = _FakeWorker("decode", running=7, waiting=6, pressure="warn")
+    idle = _FakeWorker("decode")
+    with _fake_pool(_FakeWorker("prefill"), loaded, idle) as (pw, _, __):
+        # long refresh: the probe view stays fixed, selection is pure score
+        pool = FailoverLLM([pw.url, loaded.url, idle.url], "tiny",
+                           refresh_s=60.0)
+        for _ in range(4):
+            assert "".join(pool.chat(MESSAGES, max_tokens=8))
+        assert idle.hits["handoff"] == 4
+        assert loaded.hits["handoff"] == 0
+
+
+def test_router_unified_pool_prefers_unloaded_worker():
+    busy = _FakeWorker("unified", running=8, waiting=9, pressure="critical")
+    calm = _FakeWorker("unified")
+    with _fake_pool(busy, calm):
+        pool = FailoverLLM([busy.url, calm.url], "tiny", refresh_s=60.0)
+        for _ in range(3):
+            assert "".join(pool.chat(MESSAGES, max_tokens=8))
+        assert calm.hits["chat"] == 3 and busy.hits["chat"] == 0
+
+
+def test_router_drain_and_readmission():
+    """A worker whose /health fails is circuit-broken (drained) and traffic
+    moves off it; once its health passes again and the cooldown expires,
+    the router re-admits it."""
+    a = _FakeWorker("unified")
+    b = _FakeWorker("unified")
+    with _fake_pool(a, b):
+        pool = FailoverLLM([a.url, b.url], "tiny", cooldown_s=0.2,
+                           refresh_s=0.0)   # probe on every pick
+        a.alive = False                      # drain a
+        for _ in range(2):
+            assert "".join(pool.chat(MESSAGES, max_tokens=8))
+        assert a.hits["chat"] == 0 and b.hits["chat"] == 2
+        # recovery: health passes again; after the cooldown the router
+        # re-admits — make b look saturated so the next pick must choose a
+        a.alive = True
+        b.running, b.waiting, b.pressure = 8, 9, "critical"
+        time.sleep(0.25)
+        assert "".join(pool.chat(MESSAGES, max_tokens=8))
+        assert a.hits["chat"] == 1

@@ -79,8 +79,9 @@ def test_mixed_step_matches_two_dispatch(attn_impl, kv_quant):
         jnp.int32(chunk_len), num_pages)
     dec, ch, cache_b = kv_cache.mixed_step(
         params, cfg, tokens, cache, dev_table, write_mask, num_pages,
-        jnp.asarray(chunk_ids[None], jnp.int32), jnp.asarray(chunk_row),
-        jnp.int32(0), jnp.int32(chunk_len), q_block=8)
+        jnp.asarray(chunk_ids[None], jnp.int32),
+        jnp.asarray(chunk_row[None]), jnp.asarray([0], jnp.int32),
+        jnp.asarray([chunk_len], jnp.int32), q_block=8)
 
     np.testing.assert_allclose(np.asarray(dec), np.asarray(lg_sep), atol=TOL)
     np.testing.assert_allclose(np.asarray(ch), np.asarray(lg_ch), atol=TOL)
@@ -197,6 +198,66 @@ def _run_workload(cfg, params, tok, mixed: str):
                 parts.append(item)
         texts.append("".join(parts))
     return texts, stalls[0], sched
+
+
+def test_scheduler_mixed_packs_multiple_jobs(tiny):
+    """Chunks from MULTIPLE prefilling jobs ride ONE mixed dispatch as
+    extra ragged rows (round 5 fused exactly one job per dispatch; multi-
+    job refills fell back to grouped prefill) — and the emitted streams
+    stay token-identical to the two-dispatch path."""
+    cfg, params, tok = tiny
+
+    def run(mixed: str):
+        ecfg = EngineConfig(max_batch_size=4, max_seq_len=256,
+                            prefill_chunk=16, page_size=16,
+                            spec_decode="off", prefill_hold_chunks=0,
+                            mixed_phase_dispatch=mixed,
+                            decode_steps_per_dispatch=2)
+        core = EngineCore(cfg, ecfg, params, eos_id=tok.eos_id)
+        sched = Scheduler(core, tok)
+        reqs = [Request(prompt_ids=tok.encode("hello wor"), max_tokens=30,
+                        temperature=0.0),
+                Request(prompt_ids=tok.encode("abcdefgh"), max_tokens=30,
+                        temperature=0.0)]
+        for r in reqs:
+            sched.submit(r)
+        for _ in range(4):
+            sched._tick()
+        sizes = []
+        orig = core.decode_mixed
+
+        def spying_decode_mixed(state, table, steps, items, *a, **kw):
+            sizes.append(len(items) if isinstance(items, list) else 1)
+            return orig(state, table, steps, items, *a, **kw)
+
+        core.decode_mixed = spying_decode_mixed
+        longs = [Request(prompt_ids=tok.encode("xy" * 24), max_tokens=5,
+                         temperature=0.0),
+                 Request(prompt_ids=tok.encode("qr" * 24), max_tokens=5,
+                         temperature=0.0)]
+        reqs += longs
+        for r in longs:
+            sched.submit(r)
+        for _ in range(300):
+            sched._tick()
+            if all(r.finished_at is not None for r in reqs):
+                break
+        texts = []
+        for r in reqs:
+            assert r.error is None, r.error
+            assert r.finished_at is not None, "request did not finish"
+            parts = []
+            while not r.out_queue.empty():
+                item = r.out_queue.get()
+                if isinstance(item, str):
+                    parts.append(item)
+            texts.append("".join(parts))
+        return texts, sizes
+
+    texts_on, sizes = run("on")
+    assert any(s >= 2 for s in sizes), sizes   # two jobs fused per dispatch
+    texts_off, _ = run("off")
+    assert texts_on == texts_off
 
 
 def test_scheduler_mixed_long_prompt_rides_decode_dispatches(tiny):
