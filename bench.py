@@ -44,8 +44,10 @@ import time
 import jax
 import jax.numpy as jnp
 
+from generativeaiexamples_tpu.core import perfmodel
 from generativeaiexamples_tpu.core.config import EngineConfig
 from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.observability.devtime import DEVTIME
 from generativeaiexamples_tpu.observability.flight import FLIGHT
 from generativeaiexamples_tpu.engine.engine import EngineCore
 from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
@@ -54,21 +56,28 @@ from generativeaiexamples_tpu.models import llama
 
 TTFT_TARGET_S = 1.0
 
-# bf16 matmul peak (FLOP/s) and HBM bandwidth (B/s) per chip generation
-_CHIP_PEAKS = {
-    "v5 lite": (197e12, 819e9),    # v5e
-    "v5p": (459e12, 2765e9),
-    "v4": (275e12, 1228e9),
-    "v6": (918e12, 1640e9),        # Trillium
-}
 
-
-def _chip_peaks(device) -> tuple:
-    kind = getattr(device, "device_kind", "") or ""
-    for key, peaks in _CHIP_PEAKS.items():
-        if key in kind:
-            return peaks
-    return (None, None)
+def analytic_totals(n_params: int, quant: str, dtype_itemsize: int,
+                    prompt_tokens: int, gen_tokens: int, decode_steps: int,
+                    wall_s: float, device=None) -> dict:
+    """The bench's analytic MFU/HBM arithmetic, computed EXCLUSIVELY
+    through core/perfmodel.py — the same formulas the live devtime ledger
+    derives its gauges from, so bench and serving can never disagree
+    silently. tests/test_devtime.py pins this function's output for one
+    known config against hand-derived constants; an edit to either side
+    fails that test loudly instead of skewing the recorded trajectory."""
+    perf = perfmodel.PerfModel.build(n_params, quant, dtype_itemsize, device)
+    tokens = prompt_tokens + gen_tokens
+    out = {
+        "flops": perf.flops(tokens),
+        "achieved_flops": perf.flops(tokens) / wall_s,
+        "param_bytes": perf.param_bytes,
+        "hbm_read_bytes": perf.weight_read_bytes(decode_steps),
+        "achieved_bw": perf.weight_read_bytes(decode_steps) / wall_s,
+        "mfu": perf.mfu(tokens, wall_s),
+        "hbm_weight_read_util": perf.hbm_read_util(decode_steps, wall_s),
+    }
+    return out
 
 
 def _run_load(sched, reqs) -> float:
@@ -723,6 +732,51 @@ def main() -> None:
     spec_base = REGISTRY.counter("spec_base_steps").value - base0
     pfx_hits = REGISTRY.counter("prefix_hit_tokens").value - pfx0
 
+    # -- device-time attribution pass (observability/devtime.py) -----------
+    # A SEPARATE short pass with the ledger fencing every dispatch
+    # (mode=on): full per-program attribution without perturbing the
+    # headline phases above, whose pipelining a per-dispatch fence would
+    # serialize. Reports where the engine's wall time went by named ledger
+    # program with the queue-vs-device split — next to the analytic totals,
+    # so the two accountings can be compared in one JSON line.
+    prior_mode = DEVTIME.mode
+    DEVTIME.reset(keep_warm=True)     # keep warmup's compile-watch marks
+    DEVTIME.configure(mode="on")
+    DEVTIME.attach_perf(perfmodel.PerfModel.build(
+        n_params, ecfg.quant,
+        jax.dtypes.canonicalize_dtype(model_cfg.jdtype).itemsize,
+        device=jax.devices()[0]))
+    att_prompts = thr_prompts[:max(4, ecfg.max_batch_size)]
+    att_reqs = [make_req(n, cls) for n, cls in att_prompts]
+    att_wall = _run_load(sched, att_reqs)
+    dt_snap = DEVTIME.snapshot()
+    DEVTIME.configure(mode=prior_mode)
+    dt_tot = dt_snap["totals"]
+    dt_attributed = (dt_tot["device_s"] + dt_tot["queue_s"]
+                     + dt_tot["issue_s"])
+    dt_by_prog: dict = {}
+    for row in dt_snap["programs"]:
+        agg = dt_by_prog.setdefault(row["program"],
+                                    {"count": 0, "device_s": 0.0,
+                                     "queue_s": 0.0, "tokens": 0})
+        agg["count"] += row["count"]
+        agg["device_s"] = round(agg["device_s"] + row["device_s"], 4)
+        agg["queue_s"] = round(agg["queue_s"] + row["queue_s"], 4)
+        agg["tokens"] += row["tokens"]
+    for agg in dt_by_prog.values():
+        agg["wall_frac"] = (round(agg["device_s"] / att_wall, 4)
+                            if att_wall else 0.0)
+    devtime_report = {
+        "devtime_wall_s": round(att_wall, 4),
+        "devtime_attributed_frac": (round(dt_attributed / att_wall, 4)
+                                    if att_wall else 0.0),
+        "devtime_device_s": dt_tot["device_s"],
+        "devtime_queue_s": dt_tot["queue_s"],
+        "devtime_issue_s": dt_tot["issue_s"],
+        "devtime_by_program": dt_by_prog,
+        "recompiles_total": dt_snap["recompiles_total"],
+    }
+
     # -- RAG end-to-end phase (chain server + embedder + store + engine) ---
     if on_tpu:
         rag_req_s, rag_p50, rag_enc = _measure_rag_e2e(
@@ -755,7 +809,7 @@ def main() -> None:
             disagg = {"disagg_error": str(exc)}
 
     lat_all = [r for reqs in lat_runs for r in reqs]
-    errors = [r.error for r in lat_all + thr_reqs if r.error]
+    errors = [r.error for r in lat_all + thr_reqs + att_reqs if r.error]
     if errors:
         print(json.dumps({"metric": "serving_bench_FAILED", "value": -1,
                           "unit": "error", "vs_baseline": 0,
@@ -808,17 +862,16 @@ def main() -> None:
         "flight_kv_pages_used_p90": round(_flight_pct("kv_pages_used", 90), 1),
     }
 
-    # honesty: achieved FLOPs and HBM traffic vs physical peak
-    flops = 2.0 * n_params * (prompt_tokens + gen_tokens)
-    achieved_flops = flops / wall
-    param_bytes = n_params * (1 if ecfg.quant == "int8" else
-                              jax.dtypes.canonicalize_dtype(
-                                  model_cfg.jdtype).itemsize)
-    hbm_read = decode_steps * float(param_bytes)      # weight reads alone
-    achieved_bw = hbm_read / wall
-    peak_flops, peak_bw = _chip_peaks(jax.devices()[0])
-    mfu = achieved_flops / peak_flops if peak_flops else None
-    bw_util = achieved_bw / peak_bw if peak_bw else None
+    # honesty: achieved FLOPs and HBM traffic vs physical peak — ONE set of
+    # formulas (core/perfmodel.py via analytic_totals), shared with the live
+    # devtime ledger's gauges
+    analytic = analytic_totals(
+        n_params, ecfg.quant,
+        jax.dtypes.canonicalize_dtype(model_cfg.jdtype).itemsize,
+        prompt_tokens, gen_tokens, int(decode_steps), wall,
+        device=jax.devices()[0])
+    mfu = analytic["mfu"]
+    bw_util = analytic["hbm_weight_read_util"]
     for name, util in (("MFU", mfu), ("HBM", bw_util)):
         if util is not None and util >= 1.0:
             print(json.dumps({
@@ -878,6 +931,11 @@ def main() -> None:
                             if prompt_tokens else 0.0),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "hbm_weight_read_util": round(bw_util, 4) if bw_util is not None else None,
+        # ledger-sourced per-program device-time breakdown (the attribution
+        # pass above): measured wall attributed to NAMED programs with the
+        # queue-vs-device split, next to the analytic totals — when the two
+        # disagree, one of them is lying and the JSON shows it
+        **devtime_report,
         "lora_tok_s_chip": round(lora_tok_s, 1),
         "embed_docs_s": round(emb_docs_s, 1),
         "rerank_pairs_s": round(rerank_pairs_s, 1),

@@ -300,6 +300,39 @@ def check_net_timeout(ctx: ModuleContext) -> Iterable[Finding]:
 
 
 # --------------------------------------------------------------------------
+# devtime-fence
+# --------------------------------------------------------------------------
+
+@rule("devtime-fence", "error",
+      "Bare block_until_ready outside the devtime ledger's sampled fence "
+      "helper — an ad-hoc device fence serializes the dispatch pipeline "
+      "and bypasses the APP_DEVTIME sampling gate")
+def check_devtime_fence(ctx: ModuleContext) -> Iterable[Finding]:
+    """Every device fence in serving code must route through
+    observability/devtime.py's :func:`_fence` (gated by ``APP_DEVTIME``) —
+    the ledger exists so timing fences are SAMPLED and accounted, and one
+    stray ``jax.block_until_ready`` on the hot path quietly re-serializes
+    the pipelining PR 2–5 built. Fires on both the module-call and the
+    method form, anywhere (a fence in 'cold' code has a way of migrating
+    into a loop). The deliberate exceptions — warmup's compile barrier,
+    the ledger's own helper, bench phase boundaries — carry annotated
+    suppressions with their reasons."""
+    for node in ctx.walk():
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        attr = (node.func.attr
+                if isinstance(node.func, ast.Attribute) else None)
+        if name == "jax.block_until_ready" or attr == "block_until_ready":
+            yield Finding(
+                ctx.path, node.lineno, "devtime-fence", "error",
+                "bare `block_until_ready` — route device fences through "
+                "observability/devtime.py's sampled ledger helper "
+                "(APP_DEVTIME gate), or annotate the deliberate fence "
+                "with a reason")
+
+
+# --------------------------------------------------------------------------
 # except-swallow
 # --------------------------------------------------------------------------
 

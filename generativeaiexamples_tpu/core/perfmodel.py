@@ -1,0 +1,106 @@
+"""Analytic FLOP / HBM-byte models — the ONE copy bench.py and the live
+device-time ledger (observability/devtime.py) both compute from.
+
+Until PR 9 these formulas lived inline in bench.py, so the serving engine
+could not report a live MFU and a future bench edit could silently skew
+the recorded trajectory. Everything here is first-principles arithmetic
+over public model facts:
+
+  * decoder-only transformer FLOPs ≈ ``2 · n_params`` per processed token
+    (the forward matmuls touch every weight once; attention-score FLOPs are
+    a small correction at serving context lengths and are deliberately
+    excluded — the same convention BASELINE.json's targets use);
+  * decode is weight-read-bound: every fused decode step re-reads the full
+    weight set, so weight-read HBM traffic is ``steps · param_bytes`` with
+    ``param_bytes`` the quant-aware resident weight footprint;
+  * chip peaks are the published bf16 matmul FLOP/s and HBM bandwidth per
+    TPU generation (``CHIP_PEAKS``), keyed by ``device_kind`` substring.
+
+A tier-1 test (tests/test_devtime.py) pins these outputs for one known
+config against hand-derived constants AND against bench.py's reporting
+helper, so an edit to either side fails loudly instead of drifting.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+# bf16 matmul peak (FLOP/s) and HBM bandwidth (B/s) per chip generation,
+# keyed by a substring of jax's ``device_kind``
+CHIP_PEAKS = {
+    "v5 lite": (197e12, 819e9),    # v5e
+    "v5p": (459e12, 2765e9),
+    "v4": (275e12, 1228e9),
+    "v6": (918e12, 1640e9),        # Trillium
+}
+
+
+def chip_peaks(device) -> Tuple[Optional[float], Optional[float]]:
+    """(peak_flops, peak_hbm_bw) for a jax device; (None, None) when the
+    generation is unknown (CPU, simulators) — callers must treat utilization
+    as unreportable then, never as zero."""
+    kind = getattr(device, "device_kind", "") or ""
+    for key, peaks in CHIP_PEAKS.items():
+        if key in kind:
+            return peaks
+    return (None, None)
+
+
+def decode_flops(n_params: int, tokens: float) -> float:
+    """Model FLOPs to process ``tokens`` token positions (prefill or
+    decode): 2 FLOPs per parameter per token."""
+    return 2.0 * float(n_params) * float(tokens)
+
+
+def weight_bytes(n_params: int, quant: str, dtype_itemsize: int) -> float:
+    """Resident weight footprint in bytes — what one full weight read
+    (one decode step) streams from HBM. int8 weight-only quantization
+    stores 1 byte/param (per-channel scales are noise next to the weights
+    and excluded, matching the bench's historical arithmetic)."""
+    return float(n_params) * (1 if quant == "int8" else int(dtype_itemsize))
+
+
+@dataclass(frozen=True)
+class PerfModel:
+    """One model-on-one-chip analytic envelope: FLOPs, weight bytes, peaks.
+
+    ``mfu``/``hbm_read_util`` return None (not 0.0) when the chip's peaks
+    are unknown — an unknown denominator must never masquerade as an idle
+    chip."""
+
+    n_params: int
+    param_bytes: float
+    peak_flops: Optional[float] = None
+    peak_bw: Optional[float] = None
+
+    @classmethod
+    def build(cls, n_params: int, quant: str, dtype_itemsize: int,
+              device=None) -> "PerfModel":
+        peak_flops, peak_bw = chip_peaks(device) if device is not None \
+            else (None, None)
+        return cls(n_params=int(n_params),
+                   param_bytes=weight_bytes(n_params, quant, dtype_itemsize),
+                   peak_flops=peak_flops, peak_bw=peak_bw)
+
+    def flops(self, tokens: float) -> float:
+        return decode_flops(self.n_params, tokens)
+
+    def weight_read_bytes(self, weight_passes: float) -> float:
+        """HBM bytes streamed by ``weight_passes`` full weight reads (one
+        per fused decode step; grouped prefill pays one per dispatch)."""
+        return float(weight_passes) * self.param_bytes
+
+    def mfu(self, tokens: float, seconds: float) -> Optional[float]:
+        """Achieved model-FLOP utilization of ``tokens`` positions computed
+        in ``seconds`` of device time."""
+        if not self.peak_flops or seconds <= 0:
+            return None
+        return self.flops(tokens) / seconds / self.peak_flops
+
+    def hbm_read_util(self, weight_passes: float,
+                      seconds: float) -> Optional[float]:
+        """Fraction of peak HBM bandwidth consumed by weight re-reads."""
+        if not self.peak_bw or seconds <= 0:
+            return None
+        return self.weight_read_bytes(weight_passes) / seconds / self.peak_bw

@@ -39,6 +39,8 @@ import time
 from typing import Any, Callable, List, Optional, Sequence
 
 from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.observability.devtime import (DEVTIME,
+                                                            pow2_bucket)
 
 
 class _Pending:
@@ -159,6 +161,7 @@ class MicroBatcher:
             REGISTRY.histogram(f"{self.name}_batch_requests").observe(
                 len(batch))
             REGISTRY.counter(f"{self.name}_dispatches").inc()
+            t0 = time.perf_counter()
             try:
                 results = self._dispatch(flat)
                 if len(results) != len(flat):
@@ -170,6 +173,15 @@ class MicroBatcher:
                     p.error = exc
                     p.event.set()
                 continue
+            # devtime ledger: the encoder dispatch blocks until results are
+            # host-side, so its wall is a pre-measured duration — no fence
+            # in any mode. Bucket = the pow2 batch bucket (the compile
+            # unit); mfu=False keeps encoder items out of the LLM's
+            # model-FLOP gauges.
+            b2 = pow2_bucket(len(flat))
+            DEVTIME.commit(self.name, f"b{b2}",
+                           device_s=time.perf_counter() - t0,
+                           tokens=len(flat), padded_tokens=b2, mfu=False)
             start = 0
             for p in batch:
                 p.result = results[start:start + len(p.items)]

@@ -309,6 +309,20 @@ class EngineCore:
                     f"backend {jax.default_backend()!r} is not tpu")
         self._mixed = mixed == "on" and not reasons
 
+        # device-time ledger gate (observability/devtime.py): the bare env
+        # APP_DEVTIME wins, else the config field (engine.devtime /
+        # APP_ENGINE_DEVTIME via the env overlay) — applied HERE so a
+        # file-configured mode actually takes effect, same pattern as the
+        # mixed-phase gate above; bad values fail loudly at init
+        dv = (os.environ.get("APP_DEVTIME", "").strip().lower()
+              or str(getattr(engine_cfg, "devtime", "off")
+                     or "off").strip().lower())
+        if dv not in ("off", "sample", "on"):
+            raise ValueError(f"engine.devtime (APP_DEVTIME) must be "
+                             f"off|sample|on, got {dv!r}")
+        from generativeaiexamples_tpu.observability.devtime import DEVTIME
+        DEVTIME.configure(mode=dv)
+
         if mesh is not None:
             from generativeaiexamples_tpu.parallel import sharding as psh
             params = psh.shard_params(
@@ -330,6 +344,16 @@ class EngineCore:
             self._kv_sharding = None
             self._scale_sharding = None
             self._replicated = None
+        # analytic perf envelope (core/perfmodel.py): parameter count and
+        # quant-aware weight footprint captured BEFORE quantization consumes
+        # the tree — the live devtime ledger and bench derive MFU/HBM-read
+        # utilization from these same numbers
+        self.n_params = int(sum(int(x.size)
+                                for x in jax.tree.leaves(params)))
+        from generativeaiexamples_tpu.core import perfmodel as _perfmodel
+        self.param_bytes = _perfmodel.weight_bytes(
+            self.n_params, engine_cfg.quant,
+            jax.dtypes.canonicalize_dtype(model_cfg.jdtype).itemsize)
         if engine_cfg.quant == "int8":
             # after shard_params: elementwise quantize + keepdims amax
             # propagate each weight's NamedSharding onto q and s, so TP
@@ -413,6 +437,17 @@ class EngineCore:
         self._seed_hist_fn = jax.jit(self._seed_history_impl,
                                      donate_argnums=dn)
         self._sample_fn = jax.jit(self._sample_impl)
+
+    @property
+    def perf_model(self):
+        """Analytic FLOP/HBM model for THIS engine on THIS chip
+        (core/perfmodel.py) — Scheduler.start attaches it to the devtime
+        ledger so engine_mfu / engine_hbm_read_util gauges go live."""
+        from generativeaiexamples_tpu.core import perfmodel
+        peak_flops, peak_bw = perfmodel.chip_peaks(jax.devices()[0])
+        return perfmodel.PerfModel(
+            n_params=self.n_params, param_bytes=self.param_bytes,
+            peak_flops=peak_flops, peak_bw=peak_bw)
 
     # ------------------------------------------------------------------ state
 
@@ -1062,7 +1097,29 @@ class EngineCore:
                             state, table, steps, items,
                             use_grammar=bool(gs))
                         last_out = out["packed"]
-        jax.block_until_ready(last_out)
+        # suppressed devtime-fence: warmup's one deliberate fence — every
+        # compile must land before serving starts (the whole point)
+        jax.block_until_ready(last_out)   # tpulint: disable=devtime-fence -- warmup must block until the compile grid lands
+        # compile-watch (observability/devtime.py): record exactly the keys
+        # this grid compiled, so their first SERVING dispatch is not
+        # mistaken for a mid-serving recompile. Keys warmup deliberately
+        # leaves cold (want_top variants, intermediate mixed group buckets,
+        # narrower page-pressure decode depths, the long-prefill ring pass)
+        # stay unmarked — their first live use IS a real latency cliff and
+        # must fire the recompile watch.
+        from generativeaiexamples_tpu.observability.devtime import DEVTIME
+        for g in self.group_buckets:
+            DEVTIME.mark_warm("prefill", f"g{g}")
+        for gs in ((0, gram_start) if gram_start else (0,)):
+            suffix = "+gram" if gs else ""
+            if self.role == "prefill":
+                continue
+            for steps in steps_list:
+                DEVTIME.mark_warm(f"decode{suffix}", f"s{steps}")
+            if self.mixed_supported:
+                for g in sorted({1, self.group_buckets[-1]}):
+                    for steps in steps_list:
+                        DEVTIME.mark_warm(f"mixed{suffix}", f"g{g}s{steps}")
         # the throwaway pool frees here; callers init the real state after
 
     # --------------------------------------------------------- slot lifecycle

@@ -43,6 +43,7 @@ import numpy as np
 
 from generativeaiexamples_tpu.core.metrics import REGISTRY
 from generativeaiexamples_tpu.observability import slo as slo_mod
+from generativeaiexamples_tpu.observability.devtime import DEVTIME, pow2_bucket
 from generativeaiexamples_tpu.observability.flight import FLIGHT, REQUEST_LOG
 from generativeaiexamples_tpu.engine.engine import (
     DecodeState, EngineCore, bits_to_f32, unpack_decode_out)
@@ -142,6 +143,10 @@ class Request:
     # the first token on.
     prefill_only: bool = False
     handoff: Optional[dict] = None
+    # host-observed seconds spent importing a handoff payload at admission
+    # (decode role; includes the devtime fence when one was sampled) — the
+    # kv_handoff span's kv.import_s attribute reads this
+    kv_import_s: Optional[float] = None
     request_id: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
     # filled by the scheduler:
     out_queue: "queue.Queue" = field(default_factory=queue.Queue)
@@ -284,6 +289,14 @@ class Scheduler:
     def start(self) -> None:
         if self._running:
             return
+        # devtime plane: hand the ledger this engine's analytic perf model
+        # (live MFU/HBM gauges) and close the warm window — program keys
+        # first compiled after this point are mid-serving recompiles
+        try:
+            DEVTIME.attach_perf(getattr(self.core, "perf_model", None))
+        except Exception:   # tpulint: disable=except-swallow -- fakes without device peaks must not block startup; the ledger just runs gauge-less
+            pass
+        DEVTIME.mark_serving()
         self._running = True
         self._thread = threading.Thread(target=self._loop, name="engine-driver",
                                         daemon=True)
@@ -797,6 +810,15 @@ class Scheduler:
         n = len(job.ids)
         job.prefilled = n
         job.total_len = n
+        # import dispatch is async: retain=False keeps the sampled fence
+        # target (the fresh state's tokens) out of the ledger's queue
+        # marker — the NEXT dispatch donates the state, and fencing a
+        # donated-away buffer raises
+        pb = min(pow2_bucket(int(payload.get("n_pages", 1))),
+                 int(getattr(self.core, "max_pages_per_slot", 1 << 30)))
+        DEVTIME.commit("kv_import", f"p{pb}", self._state.tokens, t0=now,
+                       tokens=n, mfu=False, retain=False)
+        req.kv_import_s = round(time.perf_counter() - now, 6)
         REGISTRY.counter("kv_handoff_imports").inc()
         first = int(payload.get("first_token", self.core.eos_id))
         gen = max(1, int(payload.get("generated", 1)))
@@ -870,6 +892,7 @@ class Scheduler:
                 req.prefill_start_at = job.prefill_started
             self._prefilling.popleft()
             REGISTRY.counter("prefill_long_passes").inc()
+            t0 = DEVTIME.track()
             self._state, tok = self.core.prefill_long_last(
                 self._state, job.ids, self._table[job.slot], job.slot,
                 generated=len(job.gen_ids) + 1, max_gen=req.max_tokens,
@@ -878,6 +901,16 @@ class Scheduler:
             job.prefilled = len(job.ids)
             job.total_len = job.prefilled
             self._cache_insert(job)
+            # ledger key: the ring pass compiles per padded-length bucket;
+            # warmup never pre-compiles it, so its first live use fires the
+            # compile-watch (a TRUE mid-serving latency cliff)
+            nb = pow2_bucket(len(job.ids), start=self.core.chunk)
+            # retain=False: `tok` rides state.tokens, which the next
+            # dispatch donates — a retained queue marker would fence a
+            # deleted buffer (same hazard as the kv_import commit)
+            DEVTIME.commit("prefill_long", f"n{nb}", tok, t0=t0,
+                           tokens=len(job.ids), padded_tokens=nb,
+                           weight_passes=1.0, retain=False)
             del tok   # value rides state.tokens (_mark_first_pending)
             self._enter_decode(job)
             return 1
@@ -921,7 +954,17 @@ class Scheduler:
             job.prefilled = start
             job.total_len = start
         REGISTRY.counter("prefill_chunks").inc(len(items))
+        t0 = DEVTIME.track()
         self._state, _toks = self.core.prefill_group(self._state, items)
+        # one ledger entry per grouped-prefill compile unit (the padded
+        # power-of-two group bucket); gram_state rides as data inside the
+        # same program, so grammar does NOT split the key here
+        g_bucket = next(b for b in self.core.group_buckets
+                        if len(items) <= b)
+        DEVTIME.commit("prefill", f"g{g_bucket}", _toks, t0=t0,
+                       tokens=sum(len(it.chunk_ids) for it in items),
+                       padded_tokens=g_bucket * self.core.chunk,
+                       weight_passes=1.0)
         for job in finals:
             self._prefilling.remove(job)
             # prompt pages are now fully write-dispatched: publish them
@@ -1057,8 +1100,21 @@ class Scheduler:
             self._fail(job, f"kv export failed: {exc}")
             self._release(job)
             return
-        REGISTRY.histogram("kv_export_s").observe(time.perf_counter() - t0)
+        export_s = time.perf_counter() - t0
+        REGISTRY.histogram("kv_export_s").observe(export_s)
         REGISTRY.counter("kv_handoff_exports").inc()
+        # the export's device_get already synced — a pre-measured commit,
+        # no extra fence in any mode; bucket mirrors the engine's export
+        # compile unit (_export_bucket: pow2 CLAMPED at the slot's page
+        # capacity — an unclamped key would name a program that never
+        # compiles)
+        pb = min(pow2_bucket(int(payload.get("n_pages", 1))),
+                 int(getattr(self.core, "max_pages_per_slot", 1 << 30)))
+        DEVTIME.commit("kv_export", f"p{pb}", device_s=export_s,
+                       tokens=len(job.ids), mfu=False)
+        # riding the payload, the downstream kv_prefill span attributes the
+        # export's device time per request (and the decode side ignores it)
+        payload["export_s"] = round(export_s, 6)
         payload.update({
             "prompt_ids": [int(t) for t in job.ids],
             "first_token": int(first),
@@ -1378,6 +1434,29 @@ class Scheduler:
         REGISTRY.histogram("decode_issue_s").observe(time.perf_counter() - t0)
         REGISTRY.histogram("decode_batch_fill").observe(
             len(self._slots) / self.core.batch)
+        # devtime ledger (observability/devtime.py): classify this dispatch
+        # into its XLA compile-unit key. Grammar and top-logprob variants
+        # ARE separate compiles (static args), so they split the program
+        # name; tokens are useful positions (steps x active slots x spec
+        # width, plus fused chunk tokens — chunks run once, not per step).
+        # With APP_DEVTIME=off this only counts; no fence is ever taken.
+        suffix = (("+gram" if use_grammar else "")
+                  + ("+top" if want_top else ""))
+        if packed_chunks is not None:
+            DEVTIME.commit(
+                f"mixed{suffix}", f"g{g_bucket}s{steps}", out["packed"],
+                t0=t0,
+                tokens=(steps * len(self._slots) * self._spec_w
+                        + sum(len(it.chunk_ids) for it in items)),
+                padded_tokens=(steps * self.core.batch * self._spec_w
+                               + g_bucket * self.core.chunk),
+                weight_passes=float(steps))
+        else:
+            DEVTIME.commit(
+                f"decode{suffix}", f"s{steps}", out["packed"], t0=t0,
+                tokens=steps * len(self._slots) * self._spec_w,
+                padded_tokens=steps * self.core.batch * self._spec_w,
+                weight_passes=float(steps))
         # hand the result to a fetcher thread NOW: the device→host round
         # trip (~100 ms over a remote-attached chip) overlaps further
         # dispatching instead of serializing into the driver loop. (Round 3
@@ -1493,6 +1572,12 @@ class Scheduler:
                 self._mixed_dispatches / self._decode_dispatches, 4)
                 if self._decode_dispatches else 0.0,
             "ragged_row_util": round(self._ragged_row_util, 4),
+            # devtime plane: mid-serving XLA recompiles so far (the cliff
+            # counter, engine_recompiles_total) and the device+queue+issue
+            # seconds the ledger has attributed to named programs — both
+            # mirror to flight_* gauges like every numeric field here
+            "recompiles": REGISTRY.counter("engine_recompiles_total").value,
+            "devtime_attributed_s": round(DEVTIME.attributed_s(), 4),
         }
 
     def _tick(self) -> bool:   # tpulint: hot-path

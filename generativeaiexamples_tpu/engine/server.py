@@ -26,9 +26,12 @@ still buffers for its extract-and-rewrite step.
 
 from __future__ import annotations
 
+import asyncio
 import functools
 import json
 import logging
+import os
+import re
 import time
 import uuid
 from typing import Any, Dict, List, Optional
@@ -69,6 +72,19 @@ def _grammar_for(kind: str, payload: str) -> Optional[object]:
             "schema outside the DFA-regular subset (%s); serving with "
             "prompt+parse only", exc)
     return None
+
+
+_RID_SAFE = re.compile(r"[^A-Za-z0-9_.:\-]")
+
+
+def inbound_request_id(headers) -> str:
+    """Adopt a caller-supplied ``X-Request-Id`` (the failover router stamps
+    one id on every dispatch of a logical request, including the
+    prefill→handoff pair) so ``/debug/requests/<id>`` correlates the same
+    request across workers. Sanitized and length-capped — the id is a log/
+    URL key, never trusted further."""
+    raw = (headers.get("X-Request-Id") or "").strip()
+    return _RID_SAFE.sub("", raw)[:64]
 
 
 def _finish_reason(req, default: str = "stop") -> str:
@@ -120,7 +136,13 @@ class ModelServer:
             # serving): prefill exports, handoff imports + streams
             web.post("/v1/kv/prefill", self.kv_prefill),
             web.post("/v1/kv/handoff", self.kv_handoff),
+            # on-demand device profiling around LIVE serving (observability/
+            # profiling.profile_trace was bench-only before): capture N
+            # seconds of trace, return the directory to load in
+            # TensorBoard/Perfetto — no profiler-server tooling needed
+            web.post("/debug/profile", self.debug_profile),
         ])
+        self._profiling = False
         # /debug/flight + /debug/requests[/<id>] — the engine process is
         # where the scheduler lives, so these answer with live data here
         add_debug_routes(self.app)
@@ -156,6 +178,45 @@ class ModelServer:
                           "never decodes — route generation to a decode or "
                           "unified worker (server/failover.py does this "
                           "from /health role discovery)"}))
+
+    async def debug_profile(self, request: web.Request) -> web.Response:
+        """``POST /debug/profile?seconds=N``: capture a device trace around
+        live serving (observability/profiling.profile_trace) and return the
+        trace directory. One capture at a time (jax has one global
+        profiler); duration is clamped to [0.05, 60] s so a typo'd query
+        cannot wedge the profiler for an hour. 503 when the profiler is
+        unavailable (stripped builds) — never a silent empty capture."""
+        from generativeaiexamples_tpu.observability import profiling
+        try:
+            seconds = float(request.query.get("seconds", "") or 2.0)
+        except ValueError:
+            raise web.HTTPBadRequest(text=json.dumps(
+                {"error": "seconds must be a number"}))
+        seconds = min(max(seconds, 0.05), 60.0)
+        log_dir = (request.query.get("dir", "").strip()
+                   or os.environ.get("APP_PROFILE_DIR", "")
+                   or "/tmp/gaie_tpu_profiles")
+        if self._profiling:
+            raise web.HTTPConflict(text=json.dumps(
+                {"error": "a profile capture is already running (jax has "
+                          "one global profiler); retry when it returns"}))
+        self._profiling = True
+        try:
+            with profiling.profile_trace(log_dir) as run_dir:
+                if run_dir is not None:
+                    # only hold the capture window when a trace is actually
+                    # recording — an unavailable profiler answers 503 NOW,
+                    # not after sleeping the full requested duration
+                    await asyncio.sleep(seconds)
+        finally:
+            self._profiling = False
+        if run_dir is None:
+            raise web.HTTPServiceUnavailable(text=json.dumps(
+                {"error": "device profiler unavailable on this build"}))
+        return web.json_response({"trace_dir": run_dir,
+                                  "seconds": seconds,
+                                  "hint": "load in TensorBoard's profile "
+                                          "plugin or Perfetto"})
 
     async def models(self, request: web.Request) -> web.Response:
         cards = [{"id": self.model_name, "object": "model",
@@ -373,22 +434,48 @@ class ModelServer:
         worker's /v1/kv/handoff, which imports it and streams the
         completion."""
         body = await request.json()
-        prompt_ids = self._prompt_ids_from_body(body)
-        sampling = self._parse_sampling(body)
-        sampling.pop("logprobs", None)
-        sampling.pop("top_logprobs", None)
-        slo_fields = self._parse_slo(request)
-        req = Request(prompt_ids=list(prompt_ids), prefill_only=True,
-                      **slo_fields, **sampling)
-        request["engine_request"] = req
-        self.scheduler.submit(req)
-        await StreamDrain(self.scheduler.iter_text(req)).join_text()
-        if req.error or not req.handoff:
-            raise web.HTTPServiceUnavailable(text=json.dumps(
-                {"error": req.error or "prefill produced no handoff"}))
-        wire = kv_cache_mod.encode_kv_payload(req.handoff)
-        return web.json_response(wire,
-                                 headers={"X-Request-Id": req.request_id})
+        parent = otel.extract_traceparent(dict(request.headers))
+        with otel.use_parent(parent):
+            with otel.get_tracer("engine").span(
+                    "engine:kv_prefill",
+                    attributes={"http.path": str(request.path)}) as span:
+                prompt_ids = self._prompt_ids_from_body(body)
+                sampling = self._parse_sampling(body)
+                sampling.pop("logprobs", None)
+                sampling.pop("top_logprobs", None)
+                slo_fields = self._parse_slo(request)
+                rid_in = inbound_request_id(request.headers)
+                if rid_in:
+                    slo_fields["request_id"] = rid_in
+                req = Request(prompt_ids=list(prompt_ids), prefill_only=True,
+                              **slo_fields, **sampling)
+                request["engine_request"] = req
+                self.scheduler.submit(req)
+                await StreamDrain(self.scheduler.iter_text(req)).join_text()
+                if req.error or not req.handoff:
+                    raise web.HTTPServiceUnavailable(text=json.dumps(
+                        {"error": req.error
+                         or "prefill produced no handoff"}))
+                wire = kv_cache_mod.encode_kv_payload(req.handoff)
+                payload_body = json.dumps(wire).encode("utf-8")
+                if otel.tracing_enabled():
+                    # the disagg-route trace's prefill leg: how big the KV
+                    # payload is, how many pages move, what the export's
+                    # device copy-out cost, and the queue-vs-device split
+                    # from the request timeline
+                    span.set_attribute("kv.payload_bytes", len(payload_body))
+                    span.set_attribute("kv.pages",
+                                       int(req.handoff.get("n_pages", 0)))
+                    span.set_attribute(
+                        "kv.export_device_s",
+                        float(req.handoff.get("export_s", 0.0)))
+                    for key, value in flight_mod.timeline_attributes(
+                            req).items():
+                        span.set_attribute(key, value)
+                return web.Response(
+                    body=payload_body,
+                    content_type="application/json",
+                    headers={"X-Request-Id": req.request_id})
 
     async def kv_handoff(self, request: web.Request) -> web.StreamResponse:
         """Import a /v1/kv/prefill payload into this worker's pool and
@@ -397,39 +484,67 @@ class ModelServer:
         dtype mismatches are a loud 409: prefill and decode workers must
         serve the same model + kv_quant."""
         self._require_decode_capable()
-        body = await request.json()
+        raw = await request.read()
         try:
+            body = json.loads(raw)
             payload = kv_cache_mod.decode_kv_payload(body)
         except Exception as exc:
             raise web.HTTPBadRequest(text=json.dumps(
                 {"error": f"undecodable handoff payload: {exc}"}))
-        slo_fields = self._parse_slo(request)
-        req = Request(
-            prompt_ids=[int(t) for t in payload.get("prompt_ids", [])],
-            max_tokens=int(payload.get("max_tokens", 128)),
-            temperature=float(payload.get("temperature", 0.7)),
-            top_k=int(payload.get("top_k", 0)),
-            top_p=float(payload.get("top_p", 1.0)),
-            stop=parse_stop(payload.get("stop")),
-            seed=int(payload.get("seed", 0)),
-            **slo_fields)
-        try:
-            self.scheduler.submit_prefilled(req, payload)
-        except ValueError as exc:
-            raise web.HTTPConflict(text=json.dumps({"error": str(exc)}))
-        request["engine_request"] = req
-        model = str(body.get("model") or self.model_name)
-        rid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
-        resp = await self._sse_response(request)
-        await sse_write(resp, _chunk(model, rid, {"role": "assistant"}))
-        async for delta in StreamDrain(self.scheduler.iter_text(req)):
-            await sse_write(resp, _chunk(model, rid, {"content": delta}))
-        final = json.loads(_chunk(model, rid, {}, _finish_reason(req)))
-        if req.error:
-            final["error"] = req.error
-        await sse_write(resp, json.dumps(final))
-        await sse_done(resp)
-        return resp
+        parent = otel.extract_traceparent(dict(request.headers))
+        with otel.use_parent(parent):
+            with otel.get_tracer("engine").span(
+                    "engine:kv_handoff",
+                    attributes={"http.path": str(request.path)}) as span:
+                slo_fields = self._parse_slo(request)
+                rid_in = inbound_request_id(request.headers)
+                if rid_in:
+                    slo_fields["request_id"] = rid_in
+                req = Request(
+                    prompt_ids=[int(t)
+                                for t in payload.get("prompt_ids", [])],
+                    max_tokens=int(payload.get("max_tokens", 128)),
+                    temperature=float(payload.get("temperature", 0.7)),
+                    top_k=int(payload.get("top_k", 0)),
+                    top_p=float(payload.get("top_p", 1.0)),
+                    stop=parse_stop(payload.get("stop")),
+                    seed=int(payload.get("seed", 0)),
+                    **slo_fields)
+                try:
+                    self.scheduler.submit_prefilled(req, payload)
+                except ValueError as exc:
+                    raise web.HTTPConflict(text=json.dumps(
+                        {"error": str(exc)}))
+                request["engine_request"] = req
+                model = str(body.get("model") or self.model_name)
+                rid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
+                resp = await self._sse_response(request)
+                await sse_write(resp, _chunk(model, rid,
+                                             {"role": "assistant"}))
+                async for delta in StreamDrain(self.scheduler.iter_text(req)):
+                    await sse_write(resp, _chunk(model, rid,
+                                                 {"content": delta}))
+                final = json.loads(_chunk(model, rid, {},
+                                          _finish_reason(req)))
+                if req.error:
+                    final["error"] = req.error
+                await sse_write(resp, json.dumps(final))
+                await sse_done(resp)
+                if otel.tracing_enabled():
+                    # the trace's decode leg: payload size in, pages
+                    # imported, import cost at admission, and the timeline
+                    # attrs (queue wait vs prefill→first-token = the
+                    # queue-vs-device split of this worker)
+                    span.set_attribute("kv.payload_bytes", len(raw))
+                    span.set_attribute("kv.pages",
+                                       int(payload.get("n_pages", 0)))
+                    if req.kv_import_s is not None:
+                        span.set_attribute("kv.import_s",
+                                           float(req.kv_import_s))
+                    for key, value in flight_mod.timeline_attributes(
+                            req).items():
+                        span.set_attribute(key, value)
+                return resp
 
     # --------------------------------------------------------------- serving
 
@@ -480,10 +595,18 @@ class ModelServer:
         model = adapter or self.model_name
         slo_fields = self._parse_slo(request)
 
+        rid_in = inbound_request_id(request.headers)
+
         def make_req(i: int) -> Request:
             kw = dict(sampling)
             if i and kw["seed"] is not None:
                 kw["seed"] = kw["seed"] + i   # distinct, still reproducible
+            if rid_in:
+                # the router's id becomes THIS worker's request id, so the
+                # /debug/requests timelines of every worker that touched
+                # the request share one key; secondary n>1 choices get a
+                # derived suffix (ids must stay log-unique per process)
+                kw["request_id"] = rid_in if i == 0 else f"{rid_in}.{i}"
             return Request(prompt_ids=list(prompt_ids), grammar=grammar,
                            grammar_prefix=grammar_prefix, adapter=adapter,
                            **slo_fields, **kw)

@@ -4,10 +4,19 @@ Replaces the reference's OTel-SDK + collector + Jaeger sidecar stack
 (ref: RAG/tools/observability/, RAG/src/chain_server/tracing.py) with a
 self-contained span model: same trace/span semantics and W3C TraceContext
 propagation, exporters pluggable (console, in-memory for tests, JSONL file).
+
+Sibling planes: ``flight`` (scheduler-state ring + request timelines),
+``slo`` (budgets, burn rates, shed/hazard pressure), ``devtime`` (the
+per-dispatch device-time ledger + compile-watch — which program burned the
+chip, live), ``profiling`` (jax device traces).
 """
 
 from generativeaiexamples_tpu.observability.bootstrap import (  # noqa: F401
     init_observability,
+)
+from generativeaiexamples_tpu.observability.devtime import (  # noqa: F401
+    DEVTIME,
+    DevtimeLedger,
 )
 from generativeaiexamples_tpu.observability.flight import (  # noqa: F401
     FLIGHT,

@@ -1,0 +1,477 @@
+"""Device-time attribution plane: which program burned the chip, live.
+
+The bench could always compute MFU after the fact, but the serving engine
+itself could not say which program FAMILY the time went to, whether a
+mid-serving XLA recompile caused a latency cliff, or what a KV handoff
+costs per request — RAGO (arxiv 2503.14649) frames serving optimization as
+a search that is only navigable with exactly this per-phase attribution.
+One process-global ledger (``DEVTIME``), three layers:
+
+  * **Dispatch ledger.** Every device program the engine issues is
+    classified into a ``(program, bucket)`` key that mirrors the XLA
+    compile unit — ``decode[+gram][+top] / s<steps>``, ``mixed / g<G>s<K>``,
+    ``prefill / g<G>``, ``prefill_long / n<len>``, ``kv_export / p<pages>``,
+    ``kv_import / p<pages>``, encoder micro-batches ``embed|rerank /
+    b<batch>`` — and accumulates count, device/queue/issue seconds, useful
+    vs padded token rows, and weight-read passes. Served as
+    ``engine_device_seconds{program,bucket}`` plus live ``engine_mfu
+    {program}`` / ``engine_hbm_read_util`` gauges (formulas from
+    core/perfmodel.py — the same arithmetic bench.py reports) and the
+    ``GET /debug/devtime`` breakdown.
+
+  * **Sampling gate.** ``APP_DEVTIME`` = ``off`` (default: counts only,
+    ZERO added device fences — test-enforced) | ``sample`` (one timing
+    fence every ``APP_DEVTIME_SAMPLE_N``-th commit; device seconds
+    extrapolated by the stride) | ``on`` (fence every dispatch — full
+    attribution for bench/debug; it serializes the dispatch pipeline, so
+    never the serving default). Every fence routes through :func:`_fence`
+    — the tpulint ``devtime-fence`` rule flags any other bare
+    ``jax.block_until_ready`` so instrumentation cannot quietly become the
+    bottleneck it measures. A timed commit splits its wall into
+    ``queue_s`` (draining work queued ahead) vs ``device_s`` (this
+    program) using the previous dispatch's output as the drain marker.
+
+  * **Compile-watch.** :meth:`DevtimeLedger.mark_warm` records every key
+    ``EngineCore.warmup`` compiled; :meth:`mark_serving` closes that
+    window (Scheduler.start). A key first seen AFTER serving started that
+    warmup never compiled is a mid-serving XLA recompile: counted into
+    ``engine_recompiles_total``, recorded as a flight-recorder event, and
+    (when timing is enabled) raised as a ``recompile`` hazard through the
+    PR 4 SLO pressure plane — the classic TPU latency cliff becomes an
+    alert instead of a mystery p99. ``GET /debug/compiles`` lists every
+    compile event with its trigger key; first-call vs steady-state timing
+    per key corroborates when sampling is on.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.observability.flight import FLIGHT
+
+logger = logging.getLogger(__name__)
+
+_MODES = ("off", "sample", "on")
+_WINDOW = 256          # trailing timed samples per program for live gauges
+_COMPILE_LOG = 256     # bounded compile-event history
+
+
+def _env_mode() -> Tuple[str, int]:
+    """(mode, sample_n) from the environment: the bare ``APP_DEVTIME``
+    wins, else the config-documented ``APP_ENGINE_DEVTIME``
+    (core/config.py EngineConfig.devtime), else off."""
+    raw = (os.environ.get("APP_DEVTIME", "").strip().lower()
+           or os.environ.get("APP_ENGINE_DEVTIME", "").strip().lower()
+           or "off")
+    if raw not in _MODES:
+        logger.warning("APP_DEVTIME=%r is not off|sample|on; using off", raw)
+        raw = "off"
+    try:
+        n = int(os.environ.get("APP_DEVTIME_SAMPLE_N", "") or 16)
+    except ValueError:
+        n = 16
+    return raw, max(1, n)
+
+
+def pow2_bucket(n: int, start: int = 1) -> int:
+    """Smallest power-of-two multiple of ``start`` covering ``n`` — THE
+    bucket function every ledger key derives from (kv page counts, encoder
+    batch sizes, long-prefill lengths). One copy, so committing sites and
+    warm-key marking can never fork the key space."""
+    b = max(1, start)
+    while b < n:
+        b *= 2
+    return b
+
+
+def _fence(arrays: Any) -> None:
+    """The ONE device fence the ledger ever takes — the sampling gate's
+    enforcement point (tests monkeypatch this to prove ``off`` adds zero
+    fences; tpulint's devtime-fence rule flags fences that bypass it).
+    ``jax.block_until_ready`` passes host (numpy) arrays through untouched,
+    so FakeCore scheduler tests exercise the identical code path."""
+    import jax
+    jax.block_until_ready(arrays)   # tpulint: disable=devtime-fence -- this IS the sampled ledger fence every other call site must route through
+
+
+class _Entry:
+    """Accumulator for one (program, bucket) ledger key."""
+
+    __slots__ = ("program", "bucket", "count", "timed", "device_s", "queue_s",
+                 "issue_s", "tokens", "padded_tokens", "timed_tokens",
+                 "weight_passes", "first_seen_unix", "first_timed_s",
+                 "window")
+
+    def __init__(self, program: str, bucket: str) -> None:
+        self.program = program
+        self.bucket = bucket
+        self.count = 0
+        self.timed = 0
+        self.device_s = 0.0
+        self.queue_s = 0.0
+        self.issue_s = 0.0         # host time to issue the async dispatch
+        self.tokens = 0.0          # useful token positions processed
+        self.padded_tokens = 0.0   # positions the program actually padded to
+        self.timed_tokens = 0.0    # tokens of the TIMED dispatches only
+        self.weight_passes = 0.0   # full weight-set HBM reads
+        self.first_seen_unix = time.time()
+        self.first_timed_s: Optional[float] = None
+        # trailing timed (tokens, device_s, weight_passes) for live gauges
+        self.window: deque = deque(maxlen=_WINDOW)
+
+    def snapshot(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "program": self.program, "bucket": self.bucket,
+            "count": self.count, "timed": self.timed,
+            "device_s": round(self.device_s, 6),
+            "queue_s": round(self.queue_s, 6),
+            "issue_s": round(self.issue_s, 6),
+            "tokens": int(self.tokens),
+            "padded_tokens": int(self.padded_tokens),
+            "weight_passes": round(self.weight_passes, 2),
+            "row_util": (round(self.tokens / self.padded_tokens, 4)
+                         if self.padded_tokens else None),
+            "first_seen_unix": round(self.first_seen_unix, 3),
+        }
+        if self.timed:
+            # sampled mode times 1/N of the dispatches: the estimate scales
+            # the timed seconds by the observed count ratio (uniformity
+            # assumption, stated in docs/observability.md)
+            out["est_device_s"] = round(
+                self.device_s * self.count / self.timed, 6)
+            out["first_timed_s"] = (round(self.first_timed_s, 6)
+                                    if self.first_timed_s is not None
+                                    else None)
+            steady = sorted(d for _, d, _ in self.window)
+            out["steady_p50_s"] = (round(steady[len(steady) // 2], 6)
+                                   if steady else None)
+        return out
+
+
+class DevtimeLedger:
+    """Process-global dispatch ledger + compile-watch (see module doc).
+
+    Thread-safety: commits arrive from the engine driver thread, encoder
+    micro-batch workers, and bench threads; one lock guards the maps, and
+    the (optional) fence always runs OUTSIDE it so a slow device sync never
+    serializes other committers.
+    """
+
+    def __init__(self, mode: Optional[str] = None,
+                 sample_n: Optional[int] = None) -> None:
+        env_mode, env_n = _env_mode()
+        self._mode = mode if mode in _MODES else env_mode
+        self._sample_n = max(1, int(sample_n or env_n))
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple[str, str], _Entry] = {}
+        self._compiles: deque = deque(maxlen=_COMPILE_LOG)
+        self._warm: set = set()
+        self._serving = False
+        self._commits = 0
+        self._marker: Any = None          # previous dispatch's fence target
+        self._perf = None                 # core.perfmodel.PerfModel
+        # global trailing window of (weight_passes, device_s) for the
+        # engine_hbm_read_util gauge (weight-bearing programs only)
+        self._bw_window: deque = deque(maxlen=_WINDOW)
+        # tests may redirect the recompile hazard away from the global SLO
+        self.hazard_sink: Optional[Callable[[str, Dict[str, Any]], None]] = None
+        # the metric families exist (0-valued) from process start, so a
+        # scrape before the first dispatch still sees the catalog
+        REGISTRY.counter("engine_recompiles_total")
+        REGISTRY.gauge("engine_hbm_read_util")
+
+    # ------------------------------------------------------------ lifecycle
+
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+    @property
+    def timing_enabled(self) -> bool:
+        return self._mode != "off"
+
+    def configure(self, mode: Optional[str] = None,
+                  sample_n: Optional[int] = None) -> None:
+        """Runtime override (bench's attribution pass, tests)."""
+        with self._lock:
+            if mode is not None:
+                if mode not in _MODES:
+                    raise ValueError(f"devtime mode must be one of {_MODES}, "
+                                     f"got {mode!r}")
+                self._mode = mode
+                if mode == "off":
+                    self._marker = None   # drop the held buffer reference
+            if sample_n is not None:
+                self._sample_n = max(1, int(sample_n))
+
+    def attach_perf(self, perf) -> None:
+        """Install the analytic model (core/perfmodel.PerfModel) the live
+        MFU/HBM gauges derive from; None detaches (gauges stop updating)."""
+        with self._lock:
+            self._perf = perf
+
+    def mark_warm(self, program: str, bucket: Any) -> None:
+        """Record that warmup compiled this key — its first dispatch is not
+        a compile event (EngineCore.warmup calls this per compiled key)."""
+        with self._lock:
+            self._warm.add((program, str(bucket)))
+
+    def mark_serving(self) -> None:
+        """Close the warm window (Scheduler.start): keys first seen after
+        this that warmup never compiled count as mid-serving recompiles."""
+        with self._lock:
+            self._serving = True
+
+    def reset(self, keep_warm: bool = False) -> None:
+        """Drop accumulated stats (tests, bench's attribution pass).
+        ``keep_warm`` preserves the warm-key set and serving flag — AND
+        folds every already-seen key into it (those programs are compiled
+        in this process, whether warmup or a lazy first use compiled them)
+        — so a stats reset can never re-announce an old compile as a fresh
+        recompile."""
+        with self._lock:
+            if keep_warm:
+                self._warm.update(self._entries.keys())
+            self._entries.clear()
+            self._compiles.clear()
+            self._commits = 0
+            self._marker = None
+            self._bw_window.clear()
+            if not keep_warm:
+                self._warm.clear()
+                self._serving = False
+
+    # --------------------------------------------------------------- commit
+
+    def track(self) -> float:
+        """Stamp taken immediately before issuing a dispatch; pass it to
+        :meth:`commit` as ``t0`` so issue/queue/device time can split."""
+        return time.perf_counter()
+
+    def commit(self, program: str, bucket: Any, out: Any = None, *,
+               t0: Optional[float] = None, tokens: float = 0,
+               padded_tokens: float = 0, weight_passes: float = 0.0,
+               device_s: Optional[float] = None, mfu: bool = True,
+               retain: bool = True) -> None:
+        """Account one issued device program.
+
+        ``out`` is an output array (or pytree) of the dispatch — the fence
+        target when this commit is sampled; with ``retain`` it also becomes
+        the queue-drain marker for the next sampled commit (pass
+        ``retain=False`` for buffers a later dispatch may donate away —
+        fencing a deleted buffer raises). ``device_s`` short-circuits the
+        gate for callers that already synced (kv export's copy-out, the
+        encoder micro-batch whose dispatch blocks on results): the
+        pre-measured duration is recorded with no extra fence in ANY mode.
+        ``mfu=False`` keeps non-LLM programs (encoders, KV moves) out of
+        the model-FLOP gauges — their tokens are not model forward passes.
+        """
+        bucket = str(bucket)
+        key = (program, bucket)
+        t_commit = time.perf_counter()
+        queue_s = 0.0
+        # a pre-measured commit is a CENSUS (every occurrence reports), so
+        # it must never be stride-extrapolated like a 1/N gate sample
+        pre_measured = device_s is not None
+        timed = pre_measured
+        if not timed and out is not None and t0 is not None:
+            with self._lock:
+                if self._mode == "off":
+                    due = False
+                else:
+                    self._commits += 1
+                    due = (self._mode == "on"
+                           or self._commits % self._sample_n == 0)
+                marker = self._marker if due else None
+                if self._mode != "off" and retain:
+                    self._marker = out
+            if due:
+                if marker is not None:
+                    try:
+                        _fence(marker)
+                    except Exception as exc:   # donated/deleted buffer
+                        logger.debug("devtime queue marker unfencible: %s",
+                                     exc)
+                    queue_s = max(0.0, time.perf_counter() - t_commit)
+                t_dev = time.perf_counter()
+                _fence(out)
+                device_s = time.perf_counter() - t_dev
+                timed = True
+        issue_s = max(0.0, t_commit - t0) if t0 is not None else 0.0
+        with self._lock:
+            entry = self._entries.get(key)
+            first = entry is None
+            if first:
+                entry = self._entries[key] = _Entry(program, bucket)
+            entry.count += 1
+            entry.tokens += tokens
+            entry.padded_tokens += padded_tokens
+            entry.weight_passes += weight_passes
+            if timed:
+                # issue seconds only for TIMED commits: attributed_s() sums
+                # device+queue+issue, and mixing census issue time with
+                # 1/N-sampled device time would make the total meaningless
+                # in sample mode (mode=on — the bench's attribution pass —
+                # times everything, so nothing is lost there)
+                entry.issue_s += issue_s
+            perf = self._perf
+            stride = (self._sample_n
+                      if self._mode == "sample" and not pre_measured else 1)
+            gauge_sums = None
+            if timed:
+                entry.timed += 1
+                entry.device_s += device_s
+                entry.queue_s += queue_s
+                entry.timed_tokens += tokens
+                if entry.first_timed_s is None:
+                    entry.first_timed_s = device_s
+                entry.window.append((tokens, device_s, weight_passes))
+                if weight_passes:
+                    self._bw_window.append((weight_passes, device_s))
+                if perf is not None:
+                    # window sums gathered under the lock — deques must not
+                    # be iterated while another committer appends
+                    gauge_sums = (
+                        sum(t for t, _, _ in entry.window),
+                        sum(d for _, d, _ in entry.window),
+                        sum(w for w, _ in self._bw_window),
+                        sum(d for _, d in self._bw_window),
+                    )
+            if first:
+                event = self._first_seen_locked(key)
+            else:
+                event = None
+        # metrics + hazards OUTSIDE the lock (REGISTRY has its own locks;
+        # the SLO sink may take the tracker's)
+        if timed:
+            # sampled mode extrapolates by the stride so the Prometheus
+            # counter tracks attributed seconds, not 1/N of them
+            REGISTRY.counter(
+                "engine_device_seconds",
+                labels={"program": program, "bucket": bucket}).inc(
+                device_s * stride)
+            if gauge_sums is not None:
+                self._update_gauges(program, perf, mfu, gauge_sums)
+        elif first:
+            # the family exists from the key's first (untimed) dispatch on;
+            # engine_mfu only for model-forward programs — a permanently-0
+            # gauge for kv/encoder programs would average a fake idle chip
+            # into any aggregation over the program label
+            REGISTRY.counter("engine_device_seconds",
+                             labels={"program": program, "bucket": bucket})
+            if mfu:
+                REGISTRY.gauge("engine_mfu", labels={"program": program})
+        if event is not None:
+            self._announce_compile(event)
+
+    def _update_gauges(self, program: str, perf, mfu: bool,
+                       sums: Tuple[float, float, float, float]) -> None:
+        wt, wd, bw_w, bw_d = sums
+        if mfu:
+            m = perf.mfu(wt, wd)
+            if m is not None:
+                REGISTRY.gauge("engine_mfu",
+                               labels={"program": program}).set(round(m, 4))
+        util = perf.hbm_read_util(bw_w, bw_d)
+        if util is not None:
+            REGISTRY.gauge("engine_hbm_read_util").set(round(util, 4))
+
+    # -------------------------------------------------------- compile-watch
+
+    def _first_seen_locked(self, key: Tuple[str, str]) -> Optional[Dict]:
+        """Caller holds the lock. A key's first dispatch is a compile event
+        unless warmup compiled it; one seen mid-serving is a RECOMPILE."""
+        if key in self._warm:
+            return None
+        event = {
+            "program": key[0], "bucket": key[1],
+            "ts_unix": round(time.time(), 3),
+            "during_serving": self._serving,
+        }
+        self._compiles.append(event)
+        return event
+
+    def _announce_compile(self, event: Dict[str, Any]) -> None:
+        if not event["during_serving"]:
+            return
+        REGISTRY.counter("engine_recompiles_total").inc()
+        FLIGHT.event("recompile", program=event["program"],
+                     bucket=event["bucket"])
+        logger.warning(
+            "mid-serving XLA compile: program %s bucket %s was never warmed "
+            "— live requests stall behind this compile (latency cliff); "
+            "see GET /debug/compiles", event["program"], event["bucket"])
+        if not self.timing_enabled:
+            return   # default off-mode: observe-only, no pressure coupling
+        try:
+            sink = self.hazard_sink
+            if sink is not None:
+                sink("recompile", dict(event))
+            else:
+                from generativeaiexamples_tpu.observability import slo
+                slo.SLO.note_hazard("recompile", dict(event))
+        except Exception as exc:
+            logger.debug("recompile hazard sink failed: %s", exc)
+
+    # ------------------------------------------------------------ reporting
+
+    def attributed_s(self) -> float:
+        """Total seconds the ledger can attribute to named programs
+        (device + queue + issue; timed samples only, no extrapolation)."""
+        with self._lock:
+            return sum(e.device_s + e.queue_s + e.issue_s
+                       for e in self._entries.values())
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``GET /debug/devtime`` body."""
+        with self._lock:
+            entries = sorted(self._entries.values(),
+                             key=lambda e: -(e.device_s or e.count))
+            rows = [e.snapshot() for e in entries]
+            perf = self._perf
+            mode, sample_n = self._mode, self._sample_n
+            serving = self._serving
+        totals = {
+            "count": sum(r["count"] for r in rows),
+            "timed": sum(r["timed"] for r in rows),
+            "device_s": round(sum(r["device_s"] for r in rows), 6),
+            "queue_s": round(sum(r["queue_s"] for r in rows), 6),
+            "issue_s": round(sum(r["issue_s"] for r in rows), 6),
+        }
+        out: Dict[str, Any] = {
+            "mode": mode, "sample_n": sample_n, "serving": serving,
+            "programs": rows, "totals": totals,
+            "recompiles_total": REGISTRY.counter(
+                "engine_recompiles_total").value,
+        }
+        if perf is not None:
+            out["perf_model"] = {
+                "n_params": perf.n_params,
+                "param_bytes": perf.param_bytes,
+                "peak_flops": perf.peak_flops,
+                "peak_bw": perf.peak_bw,
+            }
+        return out
+
+    def compiles(self) -> Dict[str, Any]:
+        """The ``GET /debug/compiles`` body: every compile event (newest
+        first) with its trigger key; ``during_serving`` marks the
+        recompiles (the latency cliffs)."""
+        with self._lock:
+            events = list(self._compiles)[::-1]
+            warm = len(self._warm)
+        return {
+            "events": events,
+            "warmed_keys": warm,
+            "recompiles_total": REGISTRY.counter(
+                "engine_recompiles_total").value,
+        }
+
+
+DEVTIME = DevtimeLedger()

@@ -68,6 +68,11 @@ class FlightRecorder:
         self.interval_s = interval_s if interval_s is not None else (
             _env_float("APP_FLIGHT_INTERVAL_MS", 250.0) / 1000.0)
         self._ring: Deque[Dict[str, Any]] = deque(maxlen=max(1, self.capacity))
+        # discrete incidents (recompiles, resets) keep their OWN bounded
+        # ring: periodic samples share a fixed field shape that window
+        # consumers (bench percentiles, dashboards) iterate uniformly, and
+        # an event sample interleaved among them would break that contract
+        self._events: Deque[Dict[str, Any]] = deque(maxlen=256)
         self._lock = threading.Lock()
         self._last_t = 0.0
         self._prev: Optional[Dict[str, Any]] = None
@@ -115,6 +120,30 @@ class FlightRecorder:
             REGISTRY.gauge(f"flight_{key}").set(value)
         return sample
 
+    def event(self, name: str, **fields: Any) -> Dict[str, Any]:
+        """Record a discrete incident — a mid-serving recompile, a pool
+        reset — into the event ring (``/debug/flight`` serves it next to
+        the sample window; SIGUSR1 dumps carry it). Events bypass the time
+        gate and never touch the periodic ring or its tok/s delta chain:
+        sample consumers iterate a fixed field shape that an interleaved
+        event would break."""
+        sample: Dict[str, Any] = {"ts": time.time(), "mono": time.monotonic(),
+                                  "event": name}
+        sample.update(fields)
+        with self._lock:
+            self._events.append(sample)
+        return sample
+
+    def events(self, seconds: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Events from the last ``seconds`` (None = whole ring), oldest
+        first."""
+        with self._lock:
+            events = list(self._events)
+        if seconds is not None:
+            cutoff = time.monotonic() - seconds
+            events = [e for e in events if e["mono"] >= cutoff]
+        return events
+
     def window(self, seconds: Optional[float] = None,
                limit: Optional[int] = None) -> List[Dict[str, Any]]:
         """Samples from the last ``seconds`` (None = whole ring), oldest
@@ -136,18 +165,22 @@ class FlightRecorder:
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
+            self._events.clear()
             self._prev = None
             self._last_t = 0.0
 
     def describe(self) -> Dict[str, Any]:
+        with self._lock:
+            n_events = len(self._events)
         return {"capacity": self.capacity,
                 "interval_s": self.interval_s,
-                "samples_held": len(self)}
+                "samples_held": len(self),
+                "events_held": n_events}
 
     def dump(self, path: str) -> str:
         """Write the full ring as JSON (the SIGUSR1 / post-incident dump)."""
         payload = {"dumped_at_unix": time.time(), **self.describe(),
-                   "samples": self.window()}
+                   "samples": self.window(), "events": self.events()}
         with open(path, "w", encoding="utf-8") as fh:
             json.dump(payload, fh)
         return path

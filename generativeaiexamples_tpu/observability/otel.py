@@ -457,11 +457,48 @@ def stage_span(name: str, tracer_name: str = "rag") -> Iterator[Span]:
 # W3C TraceContext propagation (ref: tracing.py:46 TraceContextTextMapPropagator)
 # ---------------------------------------------------------------------------
 
-def inject_traceparent(headers: Dict[str, str]) -> Dict[str, str]:
-    span = _current_span.get()
+def inject_traceparent(headers: Dict[str, str],
+                       span: Optional[Span] = None) -> Dict[str, str]:
+    """Stamp the W3C ``traceparent`` for ``span`` (explicit — a manually
+    managed span, see :func:`start_span`) or the ambient current span."""
+    span = span if span is not None else _current_span.get()
     if span is not None and tracing_enabled():
         headers["traceparent"] = f"00-{span.trace_id}-{span.span_id}-01"
     return headers
+
+
+def start_span(name: str, attributes: Optional[Mapping[str, Any]] = None,
+               parent: Optional[Span] = None) -> Optional[Span]:
+    """Manually-managed span for generator/streaming call sites where a
+    ``with`` block cannot scope the work (the failover router's streamed
+    chat lives across many ``yield``s — a context manager there would leak
+    the contextvar into the consumer between resumptions). Returns None
+    when tracing is disabled; close with :func:`end_span`. The span is
+    NOT installed as the ambient current span — propagate it explicitly
+    via ``inject_traceparent(headers, span=...)``."""
+    if not tracing_enabled():
+        return None
+    parent = parent if parent is not None else _current_span.get()
+    return Span(
+        name=name,
+        trace_id=parent.trace_id if parent else secrets.token_hex(16),
+        span_id=secrets.token_hex(8),
+        parent_id=parent.span_id if parent else None,
+        start_ns=time.time_ns(),
+        attributes=dict(attributes or {}),
+    )
+
+
+def end_span(span: Optional[Span]) -> None:
+    """Finish + export a :func:`start_span` span (same health-probe tail
+    filter as the context-manager path). None is a no-op, so call sites
+    need no tracing-enabled guard of their own."""
+    if span is None:
+        return
+    span.end_ns = time.time_ns()
+    haystack = span.name + " " + str(span.attributes.get("http.path", ""))
+    if not any(s in haystack for s in _drop_name_substrings):
+        _exporter.export(span)
 
 
 def extract_traceparent(headers: Mapping[str, str]) -> Optional[Span]:

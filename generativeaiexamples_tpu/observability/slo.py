@@ -141,6 +141,11 @@ class SloTracker:
         self._pressure = "ok"
         self._pressure_at: Optional[float] = None
         self._pressure_ttl = 1.0   # re-evaluate at most once per second
+        # external hazards (devtime's mid-serving recompile watch): each
+        # floors pressure at "warn" until its TTL — a latency cliff becomes
+        # an alert BEFORE the burn windows can even see its breaches
+        self._hazards: Deque[Dict[str, Any]] = deque(maxlen=32)
+        self._hazard_until: Optional[float] = None
 
     # ------------------------------------------------------------ config
 
@@ -179,6 +184,28 @@ class SloTracker:
             self._breaches.clear()
             self._pressure = "ok"
             self._pressure_at = None
+            self._hazards.clear()
+            self._hazard_until = None
+
+    # ------------------------------------------------------------ hazards
+
+    def note_hazard(self, kind: str, detail: Optional[Dict[str, Any]] = None,
+                    warn_for_s: float = 60.0) -> None:
+        """Record an external hazard — an event that predicts imminent
+        breaches before any request has actually missed its budget (the
+        devtime compile-watch reports mid-serving XLA recompiles here).
+        Pressure is floored at ``warn`` for ``warn_for_s`` so routers and
+        dashboards see the cliff as it happens; ``critical`` (shedding)
+        still requires real measured burn."""
+        now = self._clock()
+        with self._lock:
+            self._hazards.append({"ts_unix": time.time(), "kind": kind,
+                                  "detail": dict(detail or {})})
+            until = now + max(0.0, warn_for_s)
+            if self._hazard_until is None or until > self._hazard_until:
+                self._hazard_until = until
+            self._pressure_at = None   # next pressure() re-evaluates
+        REGISTRY.counter("slo_hazards_total", labels={"kind": kind}).inc()
 
     # ------------------------------------------------------------ judging
 
@@ -337,6 +364,9 @@ class SloTracker:
                         level = cand
                     break
         with self._lock:
+            if (level == "ok" and self._hazard_until is not None
+                    and now < self._hazard_until):
+                level = "warn"   # active hazard floors pressure (note_hazard)
             self._pressure = level
             self._pressure_at = now
         REGISTRY.gauge("slo_pressure").set(_PRESSURE_LEVELS.index(level))
@@ -374,8 +404,13 @@ class SloTracker:
             }
         with self._lock:
             breaches = list(self._breaches)[::-1]
+            hazards = list(self._hazards)[::-1]
+            hazard_active = (self._hazard_until is not None
+                             and self._clock() < self._hazard_until)
         return {
             "pressure": pressure,
+            "hazard_active": hazard_active,
+            "recent_hazards": hazards,
             "target": float(self.knob("target")),
             "windows_s": {"fast": float(self.knob("fast_window_s")),
                           "slow": float(self.knob("slow_window_s"))},

@@ -210,8 +210,11 @@ class ChainServer:
             # trailing holdback that never completed a stop match
             await emit(held)
         await resp.write(f"data: {_chain_chunk(rid, '', 'stop')}\n\n".encode())
-        await resp.write(b"data: [DONE]\n\n")
-        await resp.write_eof()
+        # metrics observe BEFORE the stream closes: a client that reads
+        # [DONE] and immediately scrapes /metrics must find this request's
+        # latency/TPOT already counted (the same happens-before discipline
+        # the scheduler applies to _STOP — write_eof is the edge clients
+        # synchronize on)
         REGISTRY.histogram("e2e_latency_s").observe(time.perf_counter() - t_start)
         if chunks > 1 and first_at is not None:
             # chain-level time-per-output-chunk: the streaming-cadence
@@ -220,6 +223,8 @@ class ChainServer:
             # docs/observability.md's metric catalog spells out the pair)
             REGISTRY.histogram("e2e_tpot_s").observe(
                 (last_at - first_at) / (chunks - 1))
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
         return resp
 
     def _guarded_chain(self, query, history, use_kb, settings):

@@ -125,7 +125,10 @@ async def flight_handler(request: web.Request) -> web.Response:
     return web.json_response({**FLIGHT.describe(),
                               "window_s": seconds,
                               "limit": limit,
-                              "samples": samples})
+                              "samples": samples,
+                              # discrete incidents (recompiles, resets) ride
+                              # their own ring so sample shapes stay uniform
+                              "events": FLIGHT.events(seconds)})
 
 
 async def requests_recent_handler(request: web.Request) -> web.Response:
@@ -133,6 +136,24 @@ async def requests_recent_handler(request: web.Request) -> web.Response:
                       maximum=REQUESTS_LIMIT_MAX)
     return web.json_response({"requests": REQUEST_LOG.recent(n),
                               "limit": n})
+
+
+async def devtime_handler(request: web.Request) -> web.Response:
+    """Per-program device-time ledger (observability/devtime.py): where the
+    chip's time went, by (program, bucket) key, with useful-vs-padded rows,
+    queue/device/issue split, and the live MFU inputs. Counts populate in
+    every mode; device seconds need APP_DEVTIME=sample|on."""
+    from generativeaiexamples_tpu.observability.devtime import DEVTIME
+    return web.json_response(DEVTIME.snapshot())
+
+
+async def compiles_handler(request: web.Request) -> web.Response:
+    """Compile-watch log (observability/devtime.py): every program key
+    whose first dispatch was NOT pre-compiled by warmup, with its trigger
+    key; entries with during_serving=true are the mid-serving recompiles
+    behind engine_recompiles_total (latency cliffs)."""
+    from generativeaiexamples_tpu.observability.devtime import DEVTIME
+    return web.json_response(DEVTIME.compiles())
 
 
 async def slo_handler(request: web.Request) -> web.Response:
@@ -161,6 +182,11 @@ def add_debug_routes(app: web.Application) -> None:
         web.get("/debug/requests", requests_recent_handler),
         web.get("/debug/requests/{rid}", request_timeline_handler),
         web.get("/debug/slo", slo_handler),
+        # devtime ledger + compile-watch: process-global like FLIGHT, so
+        # the encoder server answers with its embed/rerank micro-batch
+        # entries and the engine with its dispatch families
+        web.get("/debug/devtime", devtime_handler),
+        web.get("/debug/compiles", compiles_handler),
     ])
 
 

@@ -47,10 +47,12 @@ import os
 import threading
 import time
 import urllib.request
+import uuid
 from typing import Dict, Iterator, List, Optional, Sequence
 
 from generativeaiexamples_tpu.core.config import http_timeout
 from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.observability import otel
 from generativeaiexamples_tpu.observability import slo as slo_mod
 
 logger = logging.getLogger(__name__)
@@ -259,13 +261,32 @@ class FailoverLLM:
         json_schema grammar the resumed stream is byte-exact (the engine
         walks the grammar over the continuation prefix). On disaggregated
         routes constrained decoding degrades to prompt+parse (the grammar
-        state does not ride the handoff — docs/performance.md)."""
+        state does not ride the handoff — docs/performance.md).
+
+        One ``X-Request-Id`` is minted per logical request and stamped on
+        EVERY dispatch this call makes — the prefill→handoff pair, every
+        failover retry/resume — so each worker's ``/debug/requests``
+        timeline for the request shares the router's key."""
+        rid = uuid.uuid4().hex[:12]
         if self._has_disagg():
             yield from self._chat_disagg(messages, max_tokens, temperature,
-                                         top_p, top_k, response_format)
+                                         top_p, top_k, response_format, rid)
         else:
             yield from self._chat_unified(messages, max_tokens, temperature,
-                                          top_p, top_k, response_format)
+                                          top_p, top_k, response_format,
+                                          rid=rid)
+
+    def _headers(self, rid: str,
+                 span: Optional[otel.Span] = None) -> Dict[str, str]:
+        """Outbound dispatch headers: SLO class + remaining deadline, the
+        router's request id, and (when tracing) the W3C traceparent of the
+        router's root span — the engine workers' spans become children, so
+        one trace covers router → prefill → KV export → decode → first
+        token."""
+        headers = slo_mod.outbound_headers()
+        headers["X-Request-Id"] = rid
+        otel.inject_traceparent(headers, span=span)
+        return headers
 
     def _payload(self, messages, max_tokens, temperature, top_p, top_k,
                  response_format, emitted: List[str],
@@ -313,15 +334,19 @@ class FailoverLLM:
 
     def _chat_unified(self, messages, max_tokens, temperature, top_p,
                       top_k, response_format,
-                      emitted: Optional[List[str]] = None) -> Iterator[str]:
+                      emitted: Optional[List[str]] = None,
+                      rid: Optional[str] = None, span=None) -> Iterator[str]:
         """The round-3 failover path over unified/decode workers, selection
         upgraded from round-robin to least-loaded. ``emitted`` carries a
         prefix already delivered to the consumer (a disaggregated route
         falling back mid-stream) — it rides as ``continue_text`` so the
-        stream resumes instead of restarting."""
+        stream resumes instead of restarting. ``rid``/``span`` ride from
+        the calling route so retries and fallbacks keep one request id and
+        one trace."""
         import httpx
 
         emitted = [] if emitted is None else emitted
+        rid = rid or uuid.uuid4().hex[:12]
         last_err: Exception = RuntimeError("no engine worker available")
         for _ in range(self.max_attempts):
             w = self._pick(("unified", "decode", ""))
@@ -341,7 +366,7 @@ class FailoverLLM:
                 # deadline the original admission stamped
                 with httpx.stream("POST", f"{w.url}/v1/chat/completions",
                                   json=payload,
-                                  headers=slo_mod.outbound_headers(),
+                                  headers=self._headers(rid, span),
                                   timeout=http_timeout(120.0)) as resp:
                     if resp.status_code >= 500:
                         raise httpx.TransportError(
@@ -358,7 +383,7 @@ class FailoverLLM:
             f"{last_err}")
 
     def _chat_disagg(self, messages, max_tokens, temperature, top_p,
-                     top_k, response_format) -> Iterator[str]:   # tpulint: hot-path
+                     top_k, response_format, rid: str) -> Iterator[str]:   # tpulint: hot-path
         """Two-phase disaggregated serving: prefill (KV export) on the
         least-loaded prefill worker, decode on the least-loaded decode
         replica. A failure in either phase circuit-breaks that worker and
@@ -367,68 +392,116 @@ class FailoverLLM:
         elsewhere and continues the stream seamlessly. If the
         disaggregated topology collapses mid-retry (all prefill or all
         decode workers down), the attempt falls back to the unified path
-        with the same resume prefix."""
+        with the same resume prefix.
+
+        The router owns the ROOT span of the disaggregated trace
+        (manually managed — this is a generator, a ``with`` block would
+        leak the contextvar into the consumer between yields): its
+        traceparent is injected into BOTH dispatches, so the workers'
+        ``engine:kv_prefill`` / ``engine:kv_handoff`` spans join one
+        trace, and the span carries the route's own attribution — payload
+        bytes, page count, per-phase wall — directly pricing the HTTP
+        base64 KV seam per request."""
         import httpx
 
         emitted: List[str] = []
         last_err: Exception = RuntimeError("no engine worker available")
-        for _ in range(self.max_attempts):
-            if not self._has_disagg():
-                # topology collapsed mid-retry: the unified path carries
-                # the already-yielded prefix so the stream RESUMES, never
-                # restarts (no duplicated text at the consumer)
-                yield from self._chat_unified(messages, max_tokens,
-                                              temperature, top_p, top_k,
-                                              response_format,
-                                              emitted=emitted)
-                return
-            pw = self._pick(("prefill",))
-            if pw is None:
-                last_err = RuntimeError("no prefill worker up")
-                continue
-            payload = self._payload(messages, max_tokens, temperature,
-                                    top_p, top_k, response_format, emitted,
-                                    stream=False)
-            try:
-                resp = httpx.post(f"{pw.url}/v1/kv/prefill", json=payload,
-                                  headers=slo_mod.outbound_headers(),
-                                  timeout=http_timeout(120.0))
-                if resp.status_code >= 500:
-                    raise httpx.TransportError(f"HTTP {resp.status_code}")
-                resp.raise_for_status()       # 4xx: deterministic — raise
-                handoff = resp.json()
-            except (httpx.TransportError, httpx.StreamError,
-                    json.JSONDecodeError, ConnectionError, OSError) as exc:
-                last_err = exc
-                self._mark_down(pw)
-                continue
-            dw = self._pick(("decode",))
-            if dw is None:
-                last_err = RuntimeError("no decode worker up")
-                continue
-            t0 = time.monotonic()
-            try:
-                with httpx.stream("POST", f"{dw.url}/v1/kv/handoff",
-                                  json=handoff,
-                                  headers=slo_mod.outbound_headers(),
-                                  timeout=http_timeout(120.0)) as dresp:
-                    if dresp.status_code >= 500:
+        span = otel.start_span("router:chat_disagg",
+                               attributes={"request_id": rid})
+        try:
+            for attempt in range(self.max_attempts):
+                if not self._has_disagg():
+                    # topology collapsed mid-retry: the unified path
+                    # carries the already-yielded prefix so the stream
+                    # RESUMES, never restarts (no duplicated text at the
+                    # consumer) — same rid, same trace
+                    if span is not None:
+                        span.set_attribute("router.fell_back_unified", True)
+                    yield from self._chat_unified(messages, max_tokens,
+                                                  temperature, top_p, top_k,
+                                                  response_format,
+                                                  emitted=emitted,
+                                                  rid=rid, span=span)
+                    return
+                pw = self._pick(("prefill",))
+                if pw is None:
+                    last_err = RuntimeError("no prefill worker up")
+                    continue
+                payload = self._payload(messages, max_tokens, temperature,
+                                        top_p, top_k, response_format,
+                                        emitted, stream=False)
+                t_pf = time.monotonic()
+                try:
+                    resp = httpx.post(f"{pw.url}/v1/kv/prefill",
+                                      json=payload,
+                                      headers=self._headers(rid, span),
+                                      timeout=http_timeout(120.0))
+                    if resp.status_code >= 500:
                         raise httpx.TransportError(
-                            f"HTTP {dresp.status_code}")
-                    dresp.raise_for_status()
-                    # handoff latency: prefill payload in hand → decode
-                    # stream open (admission imported the pages)
-                    REGISTRY.histogram("router_handoff_s").observe(
-                        time.monotonic() - t0)
-                    yield from self._pump_sse(dresp, emitted)
-                    return                    # clean completion
-            except (httpx.TransportError, httpx.StreamError,
-                    json.JSONDecodeError, ConnectionError, OSError) as exc:
-                last_err = exc
-                self._mark_down(dw)
-        raise RuntimeError(
-            f"LLM request failed across {self.max_attempts} attempts: "
-            f"{last_err}")
+                            f"HTTP {resp.status_code}")
+                    resp.raise_for_status()   # 4xx: deterministic — raise
+                    handoff = resp.json()
+                except (httpx.TransportError, httpx.StreamError,
+                        json.JSONDecodeError, ConnectionError,
+                        OSError) as exc:
+                    last_err = exc
+                    self._mark_down(pw)
+                    continue
+                if span is not None:
+                    span.set_attribute("router.attempts", attempt + 1)
+                    span.set_attribute("router.prefill_worker", pw.url)
+                    span.set_attribute("router.prefill_s",
+                                       round(time.monotonic() - t_pf, 6))
+                    span.set_attribute("kv.payload_bytes",
+                                       len(resp.content))
+                    span.set_attribute("kv.pages",
+                                       int(handoff.get("n_pages", 0) or 0))
+                dw = self._pick(("decode",))
+                if dw is None:
+                    last_err = RuntimeError("no decode worker up")
+                    continue
+                t0 = time.monotonic()
+                try:
+                    with httpx.stream("POST", f"{dw.url}/v1/kv/handoff",
+                                      json=handoff,
+                                      headers=self._headers(rid, span),
+                                      timeout=http_timeout(120.0)) as dresp:
+                        if dresp.status_code >= 500:
+                            raise httpx.TransportError(
+                                f"HTTP {dresp.status_code}")
+                        dresp.raise_for_status()
+                        # handoff latency: prefill payload in hand → decode
+                        # stream open (admission imported the pages)
+                        handoff_open = time.monotonic() - t0
+                        REGISTRY.histogram("router_handoff_s").observe(
+                            handoff_open)
+                        if span is not None:
+                            span.set_attribute("router.decode_worker",
+                                               dw.url)
+                            span.set_attribute("router.handoff_open_s",
+                                               round(handoff_open, 6))
+                        yield from self._pump_sse(dresp, emitted)
+                        return                    # clean completion
+                except (httpx.TransportError, httpx.StreamError,
+                        json.JSONDecodeError, ConnectionError,
+                        OSError) as exc:
+                    last_err = exc
+                    self._mark_down(dw)
+            raise RuntimeError(
+                f"LLM request failed across {self.max_attempts} attempts: "
+                f"{last_err}")
+        except Exception:
+            # any failure leaving this route — attempt exhaustion, the
+            # unified fallback exhausting ITS attempts, a mid-stream pump
+            # error — must export an ERROR span, or trace-status filters
+            # miss exactly the requests worth looking at. (GeneratorExit —
+            # the consumer abandoning the stream — is not a server error
+            # and passes through untouched.)
+            if span is not None:
+                span.status = "ERROR"
+            raise
+        finally:
+            otel.end_span(span)
 
     def chat_tools(self, messages: Sequence[Dict], tools: Sequence[Dict],
                    tool_choice="auto", **sampling) -> Dict:
@@ -442,6 +515,7 @@ class FailoverLLM:
         if tools:
             payload["tools"] = list(tools)
             payload["tool_choice"] = tool_choice
+        rid = uuid.uuid4().hex[:12]
         last_err: Exception = RuntimeError("no engine worker available")
         for _ in range(self.max_attempts):
             w = self._pick(("unified", "decode", ""))
@@ -451,7 +525,7 @@ class FailoverLLM:
             try:
                 resp = httpx.post(f"{w.url}/v1/chat/completions",
                                   json=payload,
-                                  headers=slo_mod.outbound_headers(),
+                                  headers=self._headers(rid),
                                   timeout=http_timeout(120.0))
                 if resp.status_code >= 500:
                     raise httpx.TransportError(f"HTTP {resp.status_code}")

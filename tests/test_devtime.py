@@ -1,0 +1,515 @@
+"""Device-time attribution plane (observability/devtime.py + core/perfmodel).
+
+Covers the PR-9 acceptance surface:
+
+  * ledger classification and accounting over plain commits and over the
+    REAL scheduler driving FakeCore (test_scheduler_fuzz) — including the
+    hard guarantee that ``APP_DEVTIME=off`` adds ZERO device fences to the
+    scheduler tick (every fence routes through ``devtime._fence``, which
+    these tests replace with a counter);
+  * the sampling gate (fence every Nth commit; pre-measured commits never
+    fence in any mode);
+  * compile-watch: recompile detection fires exactly once per new program
+    key, warm keys are exempt, pre-serving lazy compiles are listed but
+    not counted, and the SLO hazard only couples in when timing is on;
+  * perfmodel ↔ bench drift lock: bench.analytic_totals pinned against
+    hand-derived constants AND the PerfModel primitives for one known
+    config;
+  * the disaggregated route: one trace id spans router → prefill →
+    handoff (fake HTTP workers record the headers they receive), with
+    payload-byte attributes on the router's root span and ONE
+    X-Request-Id across the dispatch pair;
+  * the debug surfaces: /debug/devtime, /debug/compiles, POST
+    /debug/profile, and the engine's inbound X-Request-Id adoption.
+"""
+
+import asyncio
+import contextlib
+import http.server
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.core import perfmodel
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.observability import devtime as devtime_mod
+from generativeaiexamples_tpu.observability import otel
+from generativeaiexamples_tpu.observability.devtime import DevtimeLedger
+from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
+from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+from generativeaiexamples_tpu.server.failover import FailoverLLM
+
+from test_scheduler_fuzz import FakeCore
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+def _count_fences(monkeypatch):
+    calls = []
+    monkeypatch.setattr(devtime_mod, "_fence", lambda arrays: calls.append(1))
+    return calls
+
+
+# ---------------------------------------------------------------------------
+# ledger accounting + sampling gate
+# ---------------------------------------------------------------------------
+
+def test_ledger_accumulates_per_key_without_timing():
+    led = DevtimeLedger(mode="off")
+    for _ in range(3):
+        led.commit("decode", "s8", np.zeros(2), t0=time.perf_counter(),
+                   tokens=16, padded_tokens=32, weight_passes=8.0)
+    led.commit("prefill", "g4", np.zeros(2), t0=time.perf_counter(),
+               tokens=64, padded_tokens=64)
+    snap = led.snapshot()
+    rows = {(r["program"], r["bucket"]): r for r in snap["programs"]}
+    dec = rows[("decode", "s8")]
+    assert dec["count"] == 3 and dec["timed"] == 0
+    assert dec["tokens"] == 48 and dec["padded_tokens"] == 96
+    assert dec["row_util"] == 0.5
+    assert rows[("prefill", "g4")]["row_util"] == 1.0
+    assert snap["totals"]["count"] == 4
+    assert snap["mode"] == "off"
+
+
+def test_off_mode_takes_zero_fences(monkeypatch):
+    calls = _count_fences(monkeypatch)
+    led = DevtimeLedger(mode="off")
+    for _ in range(50):
+        led.commit("decode", "s8", np.zeros(2), t0=time.perf_counter(),
+                   tokens=1)
+    assert calls == []
+    assert led.snapshot()["totals"]["timed"] == 0
+
+
+def test_sample_mode_fences_every_nth(monkeypatch):
+    calls = []
+
+    def slow_fence(arrays):   # measurable, so rounding can't zero it out
+        calls.append(1)
+        time.sleep(0.002)
+
+    monkeypatch.setattr(devtime_mod, "_fence", slow_fence)
+    led = DevtimeLedger(mode="sample", sample_n=4)
+    for _ in range(8):
+        led.commit("decode", "s8", np.zeros(2), t0=time.perf_counter(),
+                   tokens=4)
+    # commits 4 and 8 are due: each fences the queue marker + its own out
+    assert len(calls) == 4
+    row = led.snapshot()["programs"][0]
+    assert row["count"] == 8 and row["timed"] == 2
+    assert row["device_s"] > 0
+    # sampled seconds extrapolate by the observed count ratio
+    assert row["est_device_s"] == pytest.approx(row["device_s"] * 4,
+                                                rel=0.01)
+
+
+def test_on_mode_fences_every_commit(monkeypatch):
+    calls = _count_fences(monkeypatch)
+    led = DevtimeLedger(mode="on")
+    led.commit("decode", "s8", np.zeros(2), t0=time.perf_counter())
+    led.commit("decode", "s8", np.zeros(2), t0=time.perf_counter())
+    # first commit has no queue marker yet: 1 fence; second: marker + out
+    assert len(calls) == 3
+    assert led.snapshot()["programs"][0]["timed"] == 2
+
+
+def test_premeasured_commit_never_fences(monkeypatch):
+    calls = _count_fences(monkeypatch)
+    led = DevtimeLedger(mode="off")
+    led.commit("kv_export", "p2", device_s=0.5, tokens=10, mfu=False)
+    assert calls == []
+    row = led.snapshot()["programs"][0]
+    assert row["timed"] == 1 and row["device_s"] == 0.5
+
+
+def test_premeasured_commit_is_census_not_stride_extrapolated(monkeypatch):
+    """A pre-measured commit reports EVERY occurrence — sample mode must
+    not multiply its Prometheus seconds by the gate stride."""
+    _count_fences(monkeypatch)
+    led = DevtimeLedger(mode="sample", sample_n=16)
+    ctr = REGISTRY.counter("engine_device_seconds",
+                           labels={"program": "kv_export", "bucket": "p9"})
+    base = ctr.value
+    led.commit("kv_export", "p9", device_s=0.25, tokens=10, mfu=False)
+    assert ctr.value - base == pytest.approx(0.25)
+    row = led.snapshot()["programs"][0]
+    assert row["est_device_s"] == pytest.approx(0.25)   # count == timed
+
+
+def test_reset_keep_warm_folds_seen_keys(monkeypatch):
+    """reset(keep_warm=True) must not re-announce an already-compiled key
+    as a fresh recompile (the bench attribution pass resets stats over a
+    fully-compiled engine)."""
+    _count_fences(monkeypatch)
+    hazards = []
+    led = DevtimeLedger(mode="on")
+    led.hazard_sink = lambda kind, detail: hazards.append(kind)
+    led.mark_serving()
+    base = REGISTRY.counter("engine_recompiles_total").value
+    led.commit("decode", "s16", np.zeros(1), t0=time.perf_counter())
+    assert REGISTRY.counter("engine_recompiles_total").value == base + 1
+    led.reset(keep_warm=True)
+    led.commit("decode", "s16", np.zeros(1), t0=time.perf_counter())
+    assert REGISTRY.counter("engine_recompiles_total").value == base + 1
+    assert hazards == ["recompile"]   # once, not twice
+
+
+# ---------------------------------------------------------------------------
+# compile-watch
+# ---------------------------------------------------------------------------
+
+def test_recompile_fires_exactly_once_per_new_key(monkeypatch):
+    _count_fences(monkeypatch)
+    hazards = []
+    led = DevtimeLedger(mode="on")
+    led.hazard_sink = lambda kind, detail: hazards.append((kind, detail))
+    led.mark_warm("decode", "s8")
+    led.mark_serving()
+    base = REGISTRY.counter("engine_recompiles_total").value
+    # warm key: its first dispatch is NOT a compile event
+    led.commit("decode", "s8", np.zeros(1), t0=time.perf_counter())
+    assert REGISTRY.counter("engine_recompiles_total").value == base
+    # new key mid-serving: exactly one event however many dispatches follow
+    for _ in range(3):
+        led.commit("decode", "s4", np.zeros(1), t0=time.perf_counter())
+    assert REGISTRY.counter("engine_recompiles_total").value == base + 1
+    assert [k for k, _ in hazards] == ["recompile"]
+    assert hazards[0][1]["program"] == "decode"
+    events = led.compiles()["events"]
+    assert len(events) == 1
+    assert events[0]["bucket"] == "s4" and events[0]["during_serving"]
+
+
+def test_pre_serving_compile_listed_but_not_counted(monkeypatch):
+    _count_fences(monkeypatch)
+    led = DevtimeLedger(mode="on")
+    led.hazard_sink = lambda *a: pytest.fail("hazard before serving")
+    base = REGISTRY.counter("engine_recompiles_total").value
+    led.commit("prefill", "g1", np.zeros(1), t0=time.perf_counter())
+    assert REGISTRY.counter("engine_recompiles_total").value == base
+    events = led.compiles()["events"]
+    assert len(events) == 1 and not events[0]["during_serving"]
+
+
+def test_off_mode_counts_recompiles_without_hazard():
+    sink_calls = []
+    led = DevtimeLedger(mode="off")
+    led.hazard_sink = lambda kind, detail: sink_calls.append(kind)
+    led.mark_serving()
+    base = REGISTRY.counter("engine_recompiles_total").value
+    led.commit("decode", "s2", tokens=1)
+    assert REGISTRY.counter("engine_recompiles_total").value == base + 1
+    assert sink_calls == []   # observe-only when timing is off
+
+
+# ---------------------------------------------------------------------------
+# live gauges + Prometheus families
+# ---------------------------------------------------------------------------
+
+def test_gauges_and_prometheus_families(monkeypatch):
+    _count_fences(monkeypatch)
+    led = DevtimeLedger(mode="on")
+    led.attach_perf(perfmodel.PerfModel(n_params=1000, param_bytes=1000.0,
+                                        peak_flops=1e6, peak_bw=1e6))
+    led.commit("decode", "s8", np.zeros(4), t0=time.perf_counter(),
+               tokens=100, padded_tokens=100, weight_passes=8.0)
+    text = REGISTRY.render_prometheus()
+    assert 'engine_device_seconds{bucket="s8",program="decode"}' in text
+    assert 'engine_mfu{program="decode"}' in text
+    assert "engine_hbm_read_util" in text
+    assert "engine_recompiles_total" in text
+    assert REGISTRY.gauge("engine_mfu",
+                          labels={"program": "decode"}).value > 0
+    assert REGISTRY.gauge("engine_hbm_read_util").value > 0
+
+
+# ---------------------------------------------------------------------------
+# the scheduler over FakeCore: classification + the off-mode fence guarantee
+# ---------------------------------------------------------------------------
+
+def test_scheduler_off_mode_adds_zero_fences(monkeypatch):
+    calls = _count_fences(monkeypatch)
+    devtime_mod.DEVTIME.configure(mode="off")
+    devtime_mod.DEVTIME.reset()
+    core = FakeCore(batch=4, max_seq=64, page_size=8, chunk=16, steps=2,
+                    group=4)
+    sched = Scheduler(core, ByteTokenizer())
+    sched.start()
+    try:
+        reqs = [Request(prompt_ids=[40 + i] * 12, max_tokens=6,
+                        temperature=0.0) for i in range(3)]
+        for r in reqs:
+            sched.submit(r)
+        for r in reqs:
+            assert "".join(sched.iter_text(r))
+            assert r.error is None
+    finally:
+        sched.stop()
+    # THE acceptance guarantee: off = zero added device fences per tick
+    assert calls == []
+    snap = devtime_mod.DEVTIME.snapshot()
+    keys = {(r["program"], r["bucket"]) for r in snap["programs"]}
+    assert ("decode", "s2") in keys            # steps=2 dispatch family
+    assert any(p == "prefill" for p, _ in keys)
+    assert snap["totals"]["count"] > 0 and snap["totals"]["timed"] == 0
+    fields = sched._flight_fields()
+    assert "recompiles" in fields and "devtime_attributed_s" in fields
+    devtime_mod.DEVTIME.reset()
+
+
+def test_scheduler_sampled_mode_times_dispatches(monkeypatch):
+    devtime_mod.DEVTIME.reset()
+    devtime_mod.DEVTIME.configure(mode="on")
+    try:
+        core = FakeCore(batch=2, max_seq=64, page_size=8, chunk=16, steps=2,
+                        group=2)
+        sched = Scheduler(core, ByteTokenizer())
+        sched.start()
+        try:
+            req = Request(prompt_ids=[50] * 10, max_tokens=5,
+                          temperature=0.0)
+            sched.submit(req)
+            assert "".join(sched.iter_text(req))
+        finally:
+            sched.stop()
+        snap = devtime_mod.DEVTIME.snapshot()
+        assert snap["totals"]["timed"] > 0
+        assert snap["totals"]["device_s"] > 0
+        assert devtime_mod.DEVTIME.attributed_s() > 0
+    finally:
+        devtime_mod.DEVTIME.configure(mode="off")
+        devtime_mod.DEVTIME.reset()
+
+
+# ---------------------------------------------------------------------------
+# perfmodel ↔ bench drift lock
+# ---------------------------------------------------------------------------
+
+def _load_bench():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(root, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _FakeV5e:
+    device_kind = "TPU v5 lite core"
+
+
+def test_bench_analytic_totals_pinned_to_hand_constants():
+    """One known config, three independent derivations: hand constants,
+    bench.analytic_totals, and the PerfModel primitives. A formula edit on
+    EITHER side (bench or core/perfmodel) breaks the agreement loudly."""
+    bench = _load_bench()
+    n_params = 1_000_000
+    out = bench.analytic_totals(n_params, "int8", 2, prompt_tokens=300,
+                                gen_tokens=700, decode_steps=50, wall_s=2.0)
+    # hand-derived: 2 FLOPs/param/token x 1e6 params x 1000 tokens
+    assert out["flops"] == 2.0e9
+    assert out["achieved_flops"] == 1.0e9
+    assert out["param_bytes"] == 1.0e6          # int8: 1 byte per param
+    assert out["hbm_read_bytes"] == 50.0e6      # 50 weight re-reads
+    assert out["achieved_bw"] == 25.0e6
+    assert out["mfu"] is None                   # no device: unreportable
+    assert out["hbm_weight_read_util"] is None
+
+    out2 = bench.analytic_totals(n_params, "none", 2, 300, 700, 50, 2.0,
+                                 device=_FakeV5e())
+    assert out2["param_bytes"] == 2.0e6         # bf16: 2 bytes per param
+    assert out2["mfu"] == pytest.approx(1.0e9 / 197e12)
+    assert out2["hbm_weight_read_util"] == pytest.approx(
+        (50 * 2.0e6 / 2.0) / 819e9)
+
+    pm = perfmodel.PerfModel.build(n_params, "none", 2, _FakeV5e())
+    assert pm.flops(1000) == out2["flops"]
+    assert pm.weight_read_bytes(50) == out2["hbm_read_bytes"]
+    assert pm.mfu(1000, 2.0) == out2["mfu"]
+    assert perfmodel.chip_peaks(_FakeV5e()) == (197e12, 819e9)
+
+
+# ---------------------------------------------------------------------------
+# SLO hazard coupling
+# ---------------------------------------------------------------------------
+
+def test_note_hazard_floors_pressure_at_warn():
+    from test_slo_plane import FakeClock, _tracker
+    clock = FakeClock()
+    tracker = _tracker(clock)
+    assert tracker.pressure() == "ok"
+    tracker.note_hazard("recompile", {"program": "decode", "bucket": "s4"},
+                        warn_for_s=30.0)
+    clock.advance(2.0)
+    assert tracker.pressure() == "warn"       # floored by the active hazard
+    payload = tracker.debug_payload()
+    assert payload["hazard_active"]
+    assert payload["recent_hazards"][0]["kind"] == "recompile"
+    clock.advance(60.0)
+    assert tracker.pressure() == "ok"         # hazard TTL expired
+    assert REGISTRY.counter("slo_hazards_total",
+                            labels={"kind": "recompile"}).value >= 1
+
+
+# ---------------------------------------------------------------------------
+# disaggregated route: one trace, one request id, payload-byte attrs
+# ---------------------------------------------------------------------------
+
+class _RecordingWorker:
+    """Fake engine worker that records the HEADERS of every POST it serves
+    — the propagation assertions read them back."""
+
+    def __init__(self, role: str, text: str = "ok"):
+        self.role, self.text = role, text
+        self.posts = {}           # path -> [lower-cased header dicts]
+        worker = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, body: bytes, ctype: str) -> None:
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self._reply(json.dumps({
+                    "message": "up", "engine_role": worker.role,
+                    "running": 0, "prefilling": 0, "waiting": 0,
+                    "batch": 8, "slo_pressure": "ok"}).encode(),
+                    "application/json")
+
+            def do_POST(self):
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                worker.posts.setdefault(self.path, []).append(
+                    {k.lower(): v for k, v in self.headers.items()})
+                if self.path == "/v1/kv/prefill":
+                    self._reply(json.dumps(
+                        {"n_pages": 3, "fake": True}).encode(),
+                        "application/json")
+                    return
+                sse = (
+                    'data: {"choices":[{"delta":{"role":"assistant"},'
+                    '"finish_reason":null}]}\n\n'
+                    'data: {"choices":[{"delta":{"content":'
+                    + json.dumps(worker.text) +
+                    '},"finish_reason":null}]}\n\n'
+                    'data: {"choices":[{"delta":{},'
+                    '"finish_reason":"stop"}]}\n\n'
+                    "data: [DONE]\n\n")
+                self._reply(sse.encode(), "text/event-stream")
+
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                      Handler)
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}"
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_disagg_route_shares_one_trace_and_request_id(monkeypatch):
+    monkeypatch.setenv("ENABLE_TRACING", "true")
+    exporter = otel.InMemorySpanExporter()
+    prev_exporter = otel._exporter
+    otel.set_exporter(exporter)
+    pw, dw = _RecordingWorker("prefill"), _RecordingWorker("decode")
+    try:
+        pool = FailoverLLM([pw.url, dw.url], "tiny")
+        text = "".join(pool.chat([{"role": "user", "content": "hi"}],
+                                 max_tokens=4))
+        assert text == "ok"
+        spans = [s for s in exporter.spans if s.name == "router:chat_disagg"]
+        assert len(spans) == 1
+        span = spans[0]
+        # payload-byte + page-count attribution on the router's root span
+        assert span.attributes["kv.payload_bytes"] > 0
+        assert span.attributes["kv.pages"] == 3
+        assert span.attributes["router.prefill_s"] >= 0
+        assert span.attributes["router.handoff_open_s"] >= 0
+        assert span.end_ns > span.start_ns
+        ph = pw.posts["/v1/kv/prefill"][0]
+        dh = dw.posts["/v1/kv/handoff"][0]
+        # ONE trace id spans router → prefill → handoff
+        assert ph["traceparent"].split("-")[1] == span.trace_id
+        assert dh["traceparent"].split("-")[1] == span.trace_id
+        # and ONE X-Request-Id correlates the dispatch pair across workers
+        assert ph["x-request-id"] == dh["x-request-id"]
+        assert ph["x-request-id"] == span.attributes["request_id"]
+    finally:
+        otel.set_exporter(prev_exporter)
+        pw.close()
+        dw.close()
+
+
+def test_unified_dispatch_carries_request_id():
+    w = _RecordingWorker("unified")
+    try:
+        pool = FailoverLLM([w.url], "tiny")
+        assert "".join(pool.chat([{"role": "user", "content": "hi"}],
+                                 max_tokens=4)) == "ok"
+        headers = w.posts["/v1/chat/completions"][0]
+        assert len(headers["x-request-id"]) == 12
+    finally:
+        w.close()
+
+
+def test_engine_adopts_inbound_request_id():
+    from generativeaiexamples_tpu.engine.server import inbound_request_id
+    assert inbound_request_id({"X-Request-Id": "abc-123"}) == "abc-123"
+    assert inbound_request_id({"X-Request-Id": "x" * 100}) == "x" * 64
+    assert inbound_request_id({"X-Request-Id": "a b\n<svg>"}) == "absvg"
+    assert inbound_request_id({}) == ""
+
+
+# ---------------------------------------------------------------------------
+# debug surfaces
+# ---------------------------------------------------------------------------
+
+def test_debug_devtime_and_compiles_handlers():
+    from aiohttp.test_utils import make_mocked_request
+    from generativeaiexamples_tpu.server import common
+    resp = asyncio.run(common.devtime_handler(
+        make_mocked_request("GET", "/debug/devtime")))
+    data = json.loads(resp.body)
+    assert "programs" in data and data["mode"] in ("off", "sample", "on")
+    resp2 = asyncio.run(common.compiles_handler(
+        make_mocked_request("GET", "/debug/compiles")))
+    data2 = json.loads(resp2.body)
+    assert "events" in data2 and "recompiles_total" in data2
+
+
+def test_debug_profile_endpoint(monkeypatch, tmp_path):
+    from types import SimpleNamespace
+    from aiohttp import web
+    from aiohttp.test_utils import make_mocked_request
+    from generativeaiexamples_tpu.engine.server import ModelServer
+    from generativeaiexamples_tpu.observability import profiling
+
+    @contextlib.contextmanager
+    def fake_trace(log_dir, host_tracer_level=2):
+        yield os.path.join(log_dir, "trace_1")
+
+    monkeypatch.setattr(profiling, "profile_trace", fake_trace)
+    server = ModelServer(SimpleNamespace(core=None, tokenizer=None), "m")
+    resp = asyncio.run(server.debug_profile(make_mocked_request(
+        "POST", f"/debug/profile?seconds=0.01&dir={tmp_path}")))
+    data = json.loads(resp.body)
+    assert data["seconds"] == 0.05            # clamped to the floor
+    assert data["trace_dir"].endswith("trace_1")
+    with pytest.raises(web.HTTPBadRequest):
+        asyncio.run(server.debug_profile(make_mocked_request(
+            "POST", "/debug/profile?seconds=nope")))

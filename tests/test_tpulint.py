@@ -626,6 +626,38 @@ def test_parse_error_is_a_finding():
 
 
 # ---------------------------------------------------------------------------
+# devtime-fence
+# ---------------------------------------------------------------------------
+
+def test_devtime_fence_flags_both_fence_forms():
+    src = """
+    import jax
+
+    def tick(self):
+        jax.block_until_ready(self.out)
+
+    def helper(arrs):
+        arrs.block_until_ready()
+    """
+    fnd = findings_for(src, only="devtime-fence")
+    assert [f.line for f in fnd] == [5, 8]
+    assert "devtime" in fnd[0].message
+
+
+def test_devtime_fence_suppressible_with_reason():
+    src = """
+    import jax
+
+    def warmup(self, out):
+        jax.block_until_ready(out)   # tpulint: disable=devtime-fence -- compile barrier
+    """
+    sup = Suppressions(textwrap.dedent(src))
+    fnd = [f for f in findings_for(src, only="devtime-fence")
+           if not sup.is_suppressed(f.rule, f.line)]
+    assert fnd == []
+
+
+# ---------------------------------------------------------------------------
 # package-wide self-check — the tier-1 gate
 # ---------------------------------------------------------------------------
 
@@ -642,6 +674,7 @@ def test_every_registered_rule_has_a_firing_fixture():
         "import time\nd = time.time() - 1.0\n",
         "import requests\nx = requests.get('u')\n",
         "try:\n    pass\nexcept Exception:\n    pass\n",
+        "import jax\njax.block_until_ready(x)\n",
     ]
     for src in snippets:
         fired |= {f.rule for f in analyze_source("s.py", src)}
