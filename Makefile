@@ -30,6 +30,13 @@ bench:
 bench-disagg:
 	$(TEST_ENV) python bench.py --multichip
 
+# Chaos resilience round: tiny workers + the failover router under a FIXED
+# seeded fault schedule (observability/chaos.py); emits one JSON line with
+# goodput_frac / ttft_p99_s / retries_total (docs/robustness.md).
+.PHONY: bench-chaos
+bench-chaos:
+	$(TEST_ENV) python bench.py --chaos
+
 dryrun:
 	$(TEST_ENV) XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	  python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"

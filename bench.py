@@ -432,6 +432,30 @@ def _kernel_microbench(on_tpu: bool, reps: int = None) -> dict:
     }
 
 
+def _bench_free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _bench_wait_health(port: int, timeout: float) -> None:
+    """Poll a spawned worker's /health until 200 (shared by the disagg and
+    chaos rounds — one copy, so the boot-wait semantics cannot diverge)."""
+    import urllib.request
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/health", timeout=2) as r:
+                if r.status == 200:
+                    return
+        except Exception:
+            pass
+        time.sleep(0.5)
+    raise RuntimeError(f"engine on :{port} never became healthy")
+
+
 def run_disagg_round(n_workers: int = 3, n_requests: int = 12,
                      max_tokens: int = 16,
                      health_timeout: float = 240.0) -> dict:
@@ -453,11 +477,9 @@ def run_disagg_round(n_workers: int = 3, n_requests: int = 12,
     """
     import os
     import signal
-    import socket
     import statistics as stats
     import subprocess
     import threading
-    import urllib.request
 
     from generativeaiexamples_tpu.parallel.topology import (
         describe_topology, plan_engine_roles)
@@ -465,28 +487,10 @@ def run_disagg_round(n_workers: int = 3, n_requests: int = 12,
 
     roles = plan_engine_roles(n_workers)
 
-    def free_port() -> int:
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
-
-    def wait_health(port: int) -> None:
-        deadline = time.monotonic() + health_timeout
-        while time.monotonic() < deadline:
-            try:
-                with urllib.request.urlopen(
-                        f"http://127.0.0.1:{port}/health", timeout=2) as r:
-                    if r.status == 200:
-                        return
-            except Exception:
-                pass
-            time.sleep(0.5)
-        raise RuntimeError(f"engine on :{port} never became healthy")
-
     procs, ports = [], []
     try:
         for role in roles:
-            port = free_port()
+            port = _bench_free_port()
             env = {**os.environ, "JAX_PLATFORMS": "cpu",
                    "PALLAS_AXON_POOL_IPS": "", "XLA_FLAGS": "",
                    "APP_ENGINE_ROLE": role}
@@ -501,7 +505,7 @@ def run_disagg_round(n_workers: int = 3, n_requests: int = 12,
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
             ports.append(port)
         for port in ports:
-            wait_health(port)
+            _bench_wait_health(port, health_timeout)
 
         urls = [f"http://127.0.0.1:{p}" for p in ports]
         router = FailoverLLM(urls, "tiny-llama-test", cooldown_s=5.0)
@@ -566,12 +570,191 @@ def run_disagg_round(n_workers: int = 3, n_requests: int = 12,
                 os.killpg(p.pid, signal.SIGKILL)
 
 
+CHAOS_SEED = 1337
+# the FIXED injected-fault schedule of the recorded chaos round: router-
+# side transport flakiness (delays + resets) and engine-side stalls/5xx.
+# Changing either string changes what the trajectory's chaos numbers mean
+# — treat them like BASELINE targets, not tuning knobs.
+CHAOS_ROUTER_SPEC = "http.delay=0.10/0.05,http.drop=0.08"
+CHAOS_WORKER_SPEC = "tick.stall=0.05/0.02,http.error=0.05"
+
+
+def run_chaos_round(n_workers: int = 2, n_requests: int = 16,
+                    max_tokens: int = 12, deadline_ms: float = 20_000.0,
+                    health_timeout: float = 240.0) -> dict:
+    """Chaos resilience round (`bench.py --chaos` / `make bench-chaos`):
+    goodput and TTFT under a FIXED seeded fault schedule, so robustness
+    gets a number in the BENCH trajectory like everything else.
+
+    Two tiny unified workers boot with APP_CHAOS armed (scheduler tick
+    stalls + server-side injected 5xx); the router process injects
+    transport faults (delays + connection resets) at its own dispatch
+    seam and serves ``n_requests`` concurrent chats through
+    server/failover.FailoverLLM under the shared resilience policy —
+    jittered backoff, retry budget, SLO-deadline cutoff (each request is
+    admitted with a ``deadline_ms`` budget). Reported numbers are
+    host-observed at the router: ``goodput_frac`` (streams that completed
+    within their deadline / all), ``ttft_p50_s``/``ttft_p99_s``,
+    ``retries_total`` (budgeted policy retries actually taken), and both
+    sides' injected-fault counts. Workers run the deterministic tiny
+    model on CPU — the phase measures the CONTROL plane under faults,
+    not chip arithmetic."""
+    import os
+    import signal
+    import statistics as stats
+    import subprocess
+    import threading
+    import urllib.request
+
+    from generativeaiexamples_tpu.core.metrics import REGISTRY
+    from generativeaiexamples_tpu.observability import chaos as chaos_mod
+    from generativeaiexamples_tpu.observability import slo as slo_mod
+    from generativeaiexamples_tpu.server.failover import FailoverLLM
+
+    def worker_injections(port: int) -> dict:
+        """This worker's per-fault injection counts off /debug/chaos."""
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/chaos", timeout=5) as r:
+            return {f: v["injected"]
+                    for f, v in json.load(r)["faults"].items()}
+
+    procs, ports = [], []
+    try:
+        for _ in range(n_workers):
+            port = _bench_free_port()
+            env = {**os.environ, "JAX_PLATFORMS": "cpu",
+                   "PALLAS_AXON_POOL_IPS": "", "XLA_FLAGS": "",
+                   "APP_CHAOS": "on",
+                   "APP_CHAOS_SEED": str(CHAOS_SEED),
+                   "APP_CHAOS_SPEC": CHAOS_WORKER_SPEC}
+            env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                           "/tmp/generativeaiexamples_tpu_jit_cache")
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "generativeaiexamples_tpu.engine",
+                 "--tiny", "--host", "127.0.0.1", "--port", str(port)],
+                env=env, start_new_session=True,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+            ports.append(port)
+        for port in ports:
+            _bench_wait_health(port, health_timeout)
+
+        urls = [f"http://127.0.0.1:{p}" for p in ports]
+        router = FailoverLLM(urls, "tiny-llama-test", cooldown_s=1.0)
+        messages = [{"role": "user", "content": "list the pump voltages"}]
+
+        def one(i: int, record) -> None:
+            t0 = time.perf_counter()
+            first = None
+            ok = True
+            try:
+                with slo_mod.admission("interactive",
+                                       deadline_ms=deadline_ms):
+                    for delta in router.chat(messages,
+                                             max_tokens=max_tokens,
+                                             temperature=0.0):
+                        if first is None:
+                            first = time.perf_counter() - t0
+            except Exception:
+                ok = False
+            record.append((ok, first, time.perf_counter() - t0))
+
+        warm: list = []
+        one(0, warm)                      # compile/bucket paths, untimed
+        # arm the router-side schedule only for the TIMED phase, and
+        # window every counter to it — worker-side injection counts are
+        # baselined here so boot/warm-phase injections (workers run with
+        # APP_CHAOS on from their first health poll) stay out of the
+        # recorded numbers
+        worker_base = {}
+        for port in ports:
+            try:
+                worker_base[port] = worker_injections(port)
+            except Exception:
+                worker_base[port] = {}
+        chaos_mod.CHAOS.configure(mode="on", seed=CHAOS_SEED,
+                                  spec=CHAOS_ROUTER_SPEC)
+        retries0 = REGISTRY.counter("retry_attempts_total",
+                                    labels={"pool": "router"}).value
+        denied0 = {r: REGISTRY.counter(
+            "retries_denied_total",
+            labels={"pool": "router", "reason": r}).value
+            for r in ("budget", "deadline", "attempts")}
+        done: list = []
+        threads = [threading.Thread(target=one, args=(i, done))
+                   for i in range(n_requests)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        router_chaos = chaos_mod.CHAOS.snapshot()
+        chaos_mod.CHAOS.reset()
+
+        good = [r for r in done
+                if r[0] and r[1] is not None
+                and r[2] <= deadline_ms / 1000.0]
+        ttfts = sorted(f for ok, f, _ in done if ok and f is not None)
+        workers_chaos = {}
+        for port in ports:
+            try:
+                counts = worker_injections(port)
+                base = worker_base.get(port, {})
+                workers_chaos[f"127.0.0.1:{port}"] = {
+                    f: n - base.get(f, 0)
+                    for f, n in counts.items() if n - base.get(f, 0)}
+            except Exception:
+                workers_chaos[f"127.0.0.1:{port}"] = "unreachable"
+
+        def pct(vals, q):
+            if not vals:
+                return None
+            return round(vals[min(int(q * len(vals)), len(vals) - 1)], 4)
+
+        return {
+            "n_workers": n_workers,
+            "n_requests": n_requests,
+            "seed": CHAOS_SEED,
+            "router_fault_spec": CHAOS_ROUTER_SPEC,
+            "worker_fault_spec": CHAOS_WORKER_SPEC,
+            "deadline_ms": deadline_ms,
+            "goodput_frac": round(len(good) / n_requests, 4),
+            "completed": sum(1 for ok, _, _ in done if ok),
+            "failed": sum(1 for ok, _, _ in done if not ok),
+            "ttft_p50_s": round(stats.median(ttfts), 4) if ttfts else None,
+            "ttft_p99_s": pct(ttfts, 0.99),
+            "retries_total": int(
+                REGISTRY.counter("retry_attempts_total",
+                                 labels={"pool": "router"}).value
+                - retries0),
+            "retries_denied": {
+                r: int(REGISTRY.counter(
+                    "retries_denied_total",
+                    labels={"pool": "router", "reason": r}).value
+                    - denied0[r])
+                for r in denied0},
+            "router_injections": {
+                f: v["injected"]
+                for f, v in router_chaos["faults"].items() if v["injected"]},
+            "worker_injections": workers_chaos,
+            "workers_backend": "tiny-cpu",
+        }
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                os.killpg(p.pid, signal.SIGKILL)
+
+
 def main() -> None:
     import os
     on_tpu = jax.default_backend() == "tpu"
     if "--kernel-bench" in sys.argv:
         print(json.dumps({"metric": "ragged_kernel_bench",
                           **_kernel_microbench(on_tpu)}))
+        return
+    if "--chaos" in sys.argv:
+        # chaos resilience round (`make bench-chaos`): goodput + p99 TTFT
+        # under the fixed seeded fault schedule, one parsed JSON line
+        print(json.dumps({"metric": "chaos_resilience",
+                          **run_chaos_round()}))
         return
     if "--multichip" in sys.argv:
         # standalone disaggregated round (`make bench-disagg`): role'd

@@ -333,6 +333,126 @@ def check_devtime_fence(ctx: ModuleContext) -> Iterable[Finding]:
 
 
 # --------------------------------------------------------------------------
+# retry-discipline
+# --------------------------------------------------------------------------
+
+# a call whose presence marks a loop as backoff-disciplined: the shared
+# jittered helpers (server/resilience.py), a policy gate, or a plain sleep
+_BACKOFF_CALLS = frozenset({"time.sleep", "asyncio.sleep", "sleep"})
+_BACKOFF_ATTRS = frozenset({"sleep", "before_retry", "backoff_s"})
+
+# each-iteration-consumes-new-input markers: a loop that pulls fresh work
+# every pass (queue consumer, stream reader) is a PUMP, not a retry loop —
+# continuing after an exception there skips a bad item, it does not re-run
+# the same operation
+_CONSUME_ATTRS = frozenset({"get", "get_nowait", "pop", "popleft",
+                            "read", "read_chunk", "readline", "recv",
+                            "accept", "next"})
+
+
+def _loop_has_call(loop: ast.AST, names: frozenset,
+                   attrs: frozenset) -> bool:
+    for node in _walk_excluding_defs(loop.body):
+        if not isinstance(node, ast.Call):
+            continue
+        if call_name(node) in names:
+            return True
+        if isinstance(node.func, ast.Attribute) and node.func.attr in attrs:
+            return True
+    return False
+
+
+def _retrying_handlers(loop: ast.AST):
+    """ExceptHandlers inside ``loop`` (own body only, not nested defs)
+    that neither raise, return, nor break — i.e. the loop runs again
+    after the failure: a retry."""
+    for node in _walk_excluding_defs(loop.body):
+        if not isinstance(node, ast.Try):
+            continue
+        for handler in node.handlers:
+            exits = any(isinstance(inner, (ast.Raise, ast.Return, ast.Break))
+                        for inner in _walk_excluding_defs(handler.body))
+            if not exits:
+                yield handler
+
+
+_DELIVER_ATTRS = frozenset({"set", "put", "put_nowait", "append",
+                            "appendleft"})
+
+
+def _delivers_error(handler: ast.ExceptHandler) -> bool:
+    """A handler that hands the failure to a consumer (event.set(),
+    queue.put(), dead_letter.append()) and loops is a PUMP skipping a bad
+    item — the item's owner sees the error; the loop is not blindly
+    re-running the same operation."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _DELIVER_ATTRS:
+            return True
+    return False
+
+
+def _is_true_const(expr: ast.AST) -> bool:
+    return isinstance(expr, ast.Constant) and expr.value in (True, 1)
+
+
+@rule("retry-discipline", "error",
+      "Retry loop without backoff discipline: an unbounded `while True` "
+      "retry, or a bounded network retry with no backoff between attempts "
+      "— a synchronized retry storm amplifies the outage it responds to")
+def check_retry_discipline(ctx: ModuleContext) -> Iterable[Finding]:
+    """Two shapes, both tuned for near-certain true positives:
+
+    * ``while True`` containing an except handler that swallows-and-loops,
+      with no backoff/sleep call AND no per-iteration input consumption
+      (queue ``get``/``pop``/``read`` — pump loops skip bad items, they
+      don't retry them): an unbounded, undelayed retry spins the CPU and
+      hammers whatever it is retrying against.
+    * ``for _ in range(...)`` retrying an HTTP call (the transport-retry
+      shape) with no backoff call in the loop: bounded, but a correlated
+      failure burst retries in lockstep — route it through
+      server/resilience.py's jittered policy.
+    """
+    for node in ctx.walk():
+        if isinstance(node, ast.While) and _is_true_const(node.test):
+            handlers = [h for h in _retrying_handlers(node)
+                        if not _delivers_error(h)]
+            if not handlers:
+                continue
+            if _loop_has_call(node, _BACKOFF_CALLS, _BACKOFF_ATTRS):
+                continue
+            if _loop_has_call(node, frozenset(), _CONSUME_ATTRS):
+                continue
+            yield Finding(
+                ctx.path, handlers[0].lineno, "retry-discipline", "error",
+                "unbounded `while True` retry with no backoff — cap the "
+                "attempts and sleep a jittered backoff between them "
+                "(server/resilience.py full_jitter_backoff)")
+        elif isinstance(node, ast.For) \
+                and isinstance(node.iter, ast.Call) \
+                and call_name(node.iter) in ("range",):
+            handlers = list(_retrying_handlers(node))
+            if not handlers:
+                continue
+            has_http = any(
+                isinstance(inner, ast.Call)
+                and (call_name(inner) in _HTTP_CALLS
+                     or call_name(inner) in _URLOPEN_CALLS)
+                for inner in _walk_excluding_defs(node.body))
+            if not has_http:
+                continue   # LLM re-prompt loops etc. — backoff is wrong there
+            if _loop_has_call(node, _BACKOFF_CALLS, _BACKOFF_ATTRS):
+                continue
+            yield Finding(
+                ctx.path, handlers[0].lineno, "retry-discipline", "error",
+                "network retry loop with no backoff between attempts — "
+                "a correlated failure burst retries in lockstep; gate "
+                "each retry through the shared jittered policy "
+                "(server/resilience.ResiliencePolicy.before_retry)")
+
+
+# --------------------------------------------------------------------------
 # except-swallow
 # --------------------------------------------------------------------------
 

@@ -11,8 +11,15 @@ async generator against the `Event` contract.
 
 Mechanics the reference gets from its streaming engine, kept here:
   * bounded concurrency (a flood of events cannot stampede the TPU);
-  * per-event retry with capped attempts, then a dead-letter list —
-    an event is either answered, or visibly failed, never lost;
+  * per-event retry with capped attempts and FULL-JITTER exponential
+    backoff (server/resilience.py — the shared implementation; the old
+    linear ``retry_delay_s * attempt`` sleep retried a correlated burst
+    of failures in lockstep), then a dead-letter list — an event is
+    either answered, or visibly failed, never lost. Dead letters count
+    into ``event_agent_dead_letter_total`` and the most recent ride the
+    process-wide :data:`DEAD_LETTERS` ring, served at
+    ``GET /debug/deadletter`` (server/common.py) — a poisoned topic is
+    an operator page, not a log archaeology project;
   * results stream to a sink callback as they finish (publish side).
 """
 
@@ -22,11 +29,40 @@ import asyncio
 import dataclasses
 import json
 import logging
+import threading
 import time
+from collections import deque
 from typing import (Any, AsyncIterator, Callable, Dict, List, Optional,
                     Sequence)
 
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.server.resilience import full_jitter_backoff
+
 logger = logging.getLogger(__name__)
+
+# process-wide dead-letter ring (newest last): every EventDrivenAgent in
+# the process appends here so /debug/deadletter shows poisoned events
+# without a handle on the agent instance. Bounded — an unbounded poison
+# topic must not become an unbounded memory leak.
+DEAD_LETTERS: deque = deque(maxlen=256)
+_DEAD_LOCK = threading.Lock()
+
+
+def record_dead_letter(event: "Event", error: str, attempts: int) -> None:
+    with _DEAD_LOCK:
+        DEAD_LETTERS.append({"ts_unix": round(time.time(), 3),
+                             "key": event.key, "error": error,
+                             "attempts": attempts})
+    REGISTRY.counter("event_agent_dead_letter_total").inc()
+
+
+def dead_letter_payload() -> Dict[str, Any]:
+    """The ``GET /debug/deadletter`` body (newest first)."""
+    with _DEAD_LOCK:
+        items = list(DEAD_LETTERS)[::-1]
+    return {"total": REGISTRY.counter("event_agent_dead_letter_total").value,
+            "ring_capacity": DEAD_LETTERS.maxlen,
+            "dead_letters": items}
 
 
 @dataclasses.dataclass
@@ -73,12 +109,16 @@ class EventDrivenAgent:
     def __init__(self, handler: Callable[[Event], str],
                  result_sink: Optional[Callable[[EventResult], None]] = None,
                  max_concurrency: int = 4, max_retries: int = 2,
-                 retry_delay_s: float = 0.5) -> None:
+                 retry_delay_s: float = 0.5,
+                 retry_cap_s: float = 30.0) -> None:
         self.handler = handler
         self.result_sink = result_sink
         self.max_concurrency = max_concurrency
         self.max_retries = max_retries
+        # base of the shared full-jitter exponential backoff (retry n
+        # sleeps uniform in [0, min(retry_cap_s, retry_delay_s * 2^(n-1))])
         self.retry_delay_s = retry_delay_s
+        self.retry_cap_s = retry_cap_s
         self.results: List[EventResult] = []
         self.dead_letter: List[Event] = []
 
@@ -105,8 +145,15 @@ class EventDrivenAgent:
                             latency_s=time.perf_counter() - t0)
                         self.dead_letter.append(
                             dataclasses.replace(event, attempt=attempt))
+                        record_dead_letter(event, str(exc), attempt)
                         break
-                    await asyncio.sleep(self.retry_delay_s * attempt)
+                    # jittered exponential backoff (shared helper): a
+                    # correlated failure burst (engine restart, dead
+                    # retriever) retries decorrelated instead of in
+                    # lockstep waves
+                    await asyncio.sleep(full_jitter_backoff(
+                        attempt, base_s=self.retry_delay_s,
+                        cap_s=self.retry_cap_s))
         self.results.append(result)
         if self.result_sink is not None:
             try:

@@ -68,6 +68,18 @@ def http_timeout(default: Optional[float] = None) -> float:
     return DEFAULT_HTTP_TIMEOUT_S
 
 
+def env_float(name: str, default: float) -> float:
+    """A float env knob with a warn-and-default parse (the robustness
+    plane's APP_WATCHDOG_*/APP_ROUTER_* knobs share this one reader)."""
+    raw = os.environ.get(name, "")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            logger.warning("ignoring non-numeric %s=%r", name, raw)
+    return default
+
+
 def configfield(name: str, *, default: Any = MISSING, default_factory: Any = MISSING,
                 help_txt: str = "") -> Any:
     """Declare a documented config field (ref: configuration_wizard.py:42-63).

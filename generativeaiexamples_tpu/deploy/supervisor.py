@@ -10,10 +10,15 @@ policies) — the failure-detection/recovery layer SURVEY §5.3 calls for:
   * **failure detection** — the monitor thread polls both process liveness
     (exit code) and the health endpoint; either failing marks the service
     down;
-  * **recovery** — crashed services restart with exponential backoff (and
-    their dependents simply keep running: the per-request failure path is
-    handled inside each service — e.g. the scheduler fails streams loudly
-    and keeps serving, engine/scheduler.py);
+  * **recovery** — crashed services restart with FULL-JITTER exponential
+    backoff (server/resilience.py, the one backoff implementation every
+    retry loop shares): a stack of services crashing together restarts
+    spread out instead of as a synchronized herd hammering the same
+    port/device at the same instant. Restarts count into
+    ``supervisor_restarts_total{service}``. Dependents simply keep
+    running: the per-request failure path is handled inside each service
+    — e.g. the scheduler fails streams loudly and keeps serving,
+    engine/scheduler.py;
   * **ordered teardown** — reverse dependency order, SIGTERM then SIGKILL.
 """
 
@@ -28,6 +33,9 @@ import time
 import urllib.request
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
+
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.server.resilience import full_jitter_backoff
 
 logger = logging.getLogger(__name__)
 
@@ -51,6 +59,11 @@ class _ServiceState:
     healthy: bool = False
     restarts: int = 0
     backoff_until: float = 0.0
+    # a death has been noticed and its restart scheduled at backoff_until;
+    # the spawn happens on a LATER monitor pass (the jitter must be real —
+    # spawning in the same pass would restart a crashed stack as the
+    # synchronized herd the jitter exists to break up)
+    pending_restart: bool = False
 
 
 def _http_ok(url: str, timeout: float = 2.0) -> bool:
@@ -185,19 +198,33 @@ class Supervisor:
                 st.healthy = False
                 if not st.spec.restart:
                     continue
-                if st.restarts >= st.spec.max_restarts:
-                    logger.error("%s exceeded %d restarts; giving up",
-                                 spec.name, spec.max_restarts)
-                    continue
                 now = time.monotonic()
+                if not st.pending_restart:
+                    if st.restarts >= st.spec.max_restarts:
+                        logger.error("%s exceeded %d restarts; giving up",
+                                     spec.name, spec.max_restarts)
+                        continue
+                    st.restarts += 1
+                    # full jitter (server/resilience.py): uniform in
+                    # [0, min(60, 2^restarts)] — the old deterministic
+                    # min(2**restarts, 60) restarted a crashed stack as a
+                    # synchronized herd (every service's next attempt
+                    # landed on the same instant, re-colliding on
+                    # ports/device). The spawn waits for backoff_until on
+                    # a later pass, so the jitter actually spaces the herd.
+                    delay = full_jitter_backoff(st.restarts + 1, base_s=1.0,
+                                                cap_s=60.0)
+                    st.backoff_until = now + delay
+                    st.pending_restart = True
+                    REGISTRY.counter("supervisor_restarts_total",
+                                     labels={"service": spec.name}).inc()
+                    logger.warning("%s died (rc=%s); restart %d/%d in %.1fs",
+                                   spec.name,
+                                   st.proc.returncode if st.proc else "?",
+                                   st.restarts, spec.max_restarts, delay)
                 if now < st.backoff_until:
                     continue
-                st.restarts += 1
-                st.backoff_until = now + min(2 ** st.restarts, 60)
-                logger.warning("%s died (rc=%s); restart %d/%d",
-                               spec.name,
-                               st.proc.returncode if st.proc else "?",
-                               st.restarts, spec.max_restarts)
+                st.pending_restart = False
                 try:
                     self._spawn(st)
                 except Exception:

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.observability import chaos as chaos_mod
 from generativeaiexamples_tpu.observability import slo as slo_mod
 from generativeaiexamples_tpu.observability.devtime import DEVTIME, pow2_bucket
 from generativeaiexamples_tpu.observability.flight import FLIGHT, REQUEST_LOG
@@ -283,6 +284,10 @@ class Scheduler:
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self._wake = threading.Event()
+        # tick heartbeat for the engine watchdog (engine/watchdog.py): the
+        # driver stamps this every loop iteration; a sustained gap while
+        # _running means the driver is wedged inside one tick
+        self.last_tick_mono = time.monotonic()
 
     # ------------------------------------------------------------------ API
 
@@ -523,6 +528,16 @@ class Scheduler:
             self._table_dev = self.core.put_table(self._table)
         return self._table_dev
 
+    def _alloc_pages(self, n: int):   # tpulint: hot-path
+        """The ONE KV page allocation seam (admission + decode growth):
+        the chaos plane's forced-exhaustion fault injects here, so a
+        chaos run exercises exactly the paths a genuinely empty pool
+        takes — head-of-line waiting, youngest-slot preemption — and
+        nothing else. APP_CHAOS=off is a single attribute read."""
+        if chaos_mod.CHAOS.enabled and chaos_mod.CHAOS.page_fault():
+            return None
+        return self._alloc.alloc(n)
+
     # -- admission ----------------------------------------------------------
 
     _ADMIT_SCAN = 32     # pending jobs considered per admission pass
@@ -747,7 +762,7 @@ class Scheduler:
                     self._alloc.acquire(hits)
                 except ValueError:
                     continue   # matched pages evicted mid-pass; rescan
-            fresh = self._alloc.alloc(need)
+            fresh = self._alloc_pages(need)
             if fresh is None:
                 if hits:
                     self._alloc.free(hits)
@@ -1198,7 +1213,7 @@ class Scheduler:
                               self.core.max_pages_per_slot)
                 if len(job.pages) >= target:
                     break
-                got = self._alloc.alloc(1)
+                got = self._alloc_pages(1)
                 if got is not None:
                     self._table[slot, len(job.pages)] = got[0]
                     job.pages.extend(got)
@@ -1467,9 +1482,12 @@ class Scheduler:
         # snapshot slot→job at dispatch time: a slot freed and reused while
         # this dispatch is in flight must not leak the old job's tokens into
         # the new job's stream (identity-checked at processing).
-        # in-flight accounting is in POSITIONS (steps × speculative width)
+        # in-flight accounting is in POSITIONS (steps × speculative width);
+        # (issue instant, steps) rides along for the watchdog's hung-
+        # dispatch bound (engine/watchdog.py reads the head entry's age)
         self._inflight.append((steps * self._spec_w, packed, fresh,
-                               dict(self._slots)))
+                               dict(self._slots),
+                               (time.monotonic(), steps)))
         self._pending_steps += steps * self._spec_w
         REGISTRY.counter("decode_steps").inc(steps)
         if packed_chunks is not None:
@@ -1492,11 +1510,17 @@ class Scheduler:
         """Sync + fan out the OLDEST in-flight dispatch (FIFO). Rows of the
         packed block are (step, position) micro-steps; with speculation a
         step can emit up to W accepted tokens."""
-        positions, packed, fresh, active_map = self._inflight.popleft()
-        self._pending_steps -= positions
+        # PEEK, don't pop: while this thread blocks in result() the entry
+        # must stay visible as _inflight[0] — it is exactly the dispatch
+        # the watchdog's hung-dispatch bound has to see (popping first
+        # would hide a wedged dispatch and degrade detection to the much
+        # coarser tick-stall heartbeat)
+        positions, packed, fresh, active_map, _issued = self._inflight[0]
         # one transfer per dispatch, already in flight on the fetcher thread
         t0 = time.perf_counter()
         out = unpack_decode_out(packed.result())
+        self._inflight.popleft()
+        self._pending_steps -= positions
         REGISTRY.histogram("sync_wait_s").observe(time.perf_counter() - t0)
         now = time.perf_counter()
         REGISTRY.counter("tokens_generated").inc(int(out["emitted"].sum()))
@@ -1582,6 +1606,12 @@ class Scheduler:
 
     def _tick(self) -> bool:   # tpulint: hot-path
         """One scheduling round; returns False when fully idle."""
+        # chaos plane (observability/chaos.py): injected tick stalls (what
+        # the watchdog heartbeat detects) and worker death (propagates to
+        # the driver loop's crash handler — every in-flight request fails
+        # loudly, state resets). Off = one attribute read, nothing more.
+        if chaos_mod.CHAOS.enabled:
+            chaos_mod.CHAOS.tick_fault()
         # continuous per-step telemetry: the ring the /debug/flight window,
         # SIGUSR1 dump, and bench.py occupancy stats all read. Idle ticks
         # sample too (the 50 ms wake loop keeps calling _tick), so a
@@ -1704,6 +1734,7 @@ class Scheduler:
         logger.info("engine driver thread started (slots=%d pages=%d)",
                     self.core.batch, self.core.num_pages)
         while self._running:
+            self.last_tick_mono = time.monotonic()
             try:
                 if not self._tick():
                     # idle: wait for work without burning the core

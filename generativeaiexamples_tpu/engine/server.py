@@ -43,6 +43,9 @@ from generativeaiexamples_tpu.engine import kv_cache as kv_cache_mod
 from generativeaiexamples_tpu.engine import tools as tools_mod
 from generativeaiexamples_tpu.engine.engine import TOP_LP
 from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
+from generativeaiexamples_tpu.engine.watchdog import (
+    EngineWatchdog, watchdog_enabled)
+from generativeaiexamples_tpu.observability import chaos as chaos_mod
 from generativeaiexamples_tpu.observability import flight as flight_mod
 from generativeaiexamples_tpu.observability import otel
 from generativeaiexamples_tpu.observability import slo as slo_mod
@@ -118,9 +121,15 @@ def _chunk(model: str, rid: str, delta: Dict[str, Any],
 
 
 class ModelServer:
-    def __init__(self, scheduler: Scheduler, model_name: str) -> None:
+    def __init__(self, scheduler: Scheduler, model_name: str,
+                 watchdog: Optional[EngineWatchdog] = None) -> None:
         self.scheduler = scheduler
         self.model_name = model_name
+        # health arbiter (engine/watchdog.py): while it reports not-
+        # serving (tripped or draining), /health answers 503 so the
+        # routing frontend circuit-breaks this worker away; None = no
+        # watchdog (APP_WATCHDOG=off), health is liveness-only as before
+        self.watchdog = watchdog
         self.app = web.Application(client_max_size=1024 ** 3)
         self.app.add_routes([
             # role-aware health: the engine's own handler rides the
@@ -141,6 +150,9 @@ class ModelServer:
             # seconds of trace, return the directory to load in
             # TensorBoard/Perfetto — no profiler-server tooling needed
             web.post("/debug/profile", self.debug_profile),
+            # graceful drain (engine/watchdog.py): 503 on /health while
+            # in-flight streams finish; ?off=1 re-admits the worker
+            web.post("/debug/drain", self.debug_drain),
         ])
         self._profiling = False
         # /debug/flight + /debug/requests[/<id>] — the engine process is
@@ -159,16 +171,59 @@ class ModelServer:
         """Liveness + the routing surface: engine_role, queue depth, slot
         fill, and slo_pressure ride the probe the pool client already
         makes (server/failover.py scores least-loaded dispatch from
-        exactly these fields)."""
+        exactly these fields). A tripped watchdog (hung dispatch, stalled
+        driver tick) or an operator drain answers 503 — the router
+        circuit-breaks this worker away while in-flight streams keep
+        serving, and re-admits it once the condition clears."""
         stats: Dict[str, Any] = {}
         try:
             stats = self.scheduler.load_stats()
         except Exception as exc:
             # health must answer even if the scheduler is mid-reset
             logging.getLogger(__name__).debug("load_stats failed: %s", exc)
-        return web.json_response({"message": "Service is up.",
-                                  "slo_pressure": slo_mod.SLO.pressure(),
-                                  **stats})
+        body = {"message": "Service is up.",
+                "slo_pressure": slo_mod.SLO.pressure(),
+                **stats}
+        if self.watchdog is not None:
+            body["watchdog"] = self.watchdog.status()
+            if not self.watchdog.serving_ok():
+                body["message"] = ("Service is draining."
+                                   if self.watchdog.draining
+                                   else "Service is unhealthy "
+                                        "(watchdog tripped).")
+                return web.json_response(body, status=503)
+        return web.json_response(body)
+
+    async def debug_drain(self, request: web.Request) -> web.Response:
+        """``POST /debug/drain`` starts a graceful drain (health 503, new
+        traffic routes away, in-flight streams finish); ``?off=1`` lifts
+        it. 409 when no watchdog is attached (APP_WATCHDOG=off)."""
+        if self.watchdog is None:
+            raise web.HTTPConflict(text=json.dumps(
+                {"error": "no watchdog attached (APP_WATCHDOG=off); "
+                          "drain needs the health arbiter"}))
+        if request.query.get("off", "").strip() in ("1", "true", "on"):
+            self.watchdog.undrain()
+        else:
+            self.watchdog.drain()
+        return web.json_response(self.watchdog.status())
+
+    async def _chaos_gate(self, site: str) -> None:
+        """Server-side chaos injection (observability/chaos.py) at the
+        HTTP seam: an injected delay await-sleeps (never blocks the
+        loop), an injected 5xx answers 503 — the router's retry policy
+        must absorb both. APP_CHAOS=off is one attribute read."""
+        if not chaos_mod.CHAOS.enabled:
+            return
+        action = chaos_mod.CHAOS.server_fault(site)
+        if action is None:
+            return
+        kind, param = action
+        if kind == "delay":
+            await asyncio.sleep(param)
+        elif kind == "error":
+            raise web.HTTPServiceUnavailable(text=json.dumps(
+                {"error": f"chaos: injected 5xx at {site}"}))
 
     def _require_decode_capable(self) -> None:
         if self.role == "prefill":
@@ -351,6 +406,7 @@ class ModelServer:
 
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
         self._require_decode_capable()
+        await self._chaos_gate("engine.chat")
         body = await request.json()
         prep = self._prepare_chat(body)
         messages = prep["messages"]
@@ -397,6 +453,7 @@ class ModelServer:
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
         self._require_decode_capable()
+        await self._chaos_gate("engine.completions")
         body = await request.json()
         prompt = body.get("prompt", "")
         prompt_ids = self.scheduler.tokenizer.encode(prompt, add_bos=True)
@@ -433,6 +490,7 @@ class ModelServer:
         worker is a valid prefill source); the payload POSTs to a decode
         worker's /v1/kv/handoff, which imports it and streams the
         completion."""
+        await self._chaos_gate("engine.kv_prefill")
         body = await request.json()
         parent = otel.extract_traceparent(dict(request.headers))
         with otel.use_parent(parent):
@@ -456,7 +514,15 @@ class ModelServer:
                     raise web.HTTPServiceUnavailable(text=json.dumps(
                         {"error": req.error
                          or "prefill produced no handoff"}))
-                wire = kv_cache_mod.encode_kv_payload(req.handoff)
+                handoff = req.handoff
+                if chaos_mod.CHAOS.enabled:
+                    # chaos KV corruption (truncated rows / garbled
+                    # geometry): the DECODE side must 409 this loudly at
+                    # import validation — the fault class exists to prove
+                    # corrupt payloads can never become served garbage KV
+                    handoff = chaos_mod.CHAOS.corrupt_kv(
+                        handoff, site="engine.kv_prefill")
+                wire = kv_cache_mod.encode_kv_payload(handoff)
                 payload_body = json.dumps(wire).encode("utf-8")
                 if otel.tracing_enabled():
                     # the disagg-route trace's prefill leg: how big the KV
@@ -484,6 +550,7 @@ class ModelServer:
         dtype mismatches are a loud 409: prefill and decode workers must
         serve the same model + kv_quant."""
         self._require_decode_capable()
+        await self._chaos_gate("engine.kv_handoff")
         raw = await request.read()
         try:
             body = json.loads(raw)
@@ -890,6 +957,10 @@ def run_server(scheduler: Scheduler, model_name: str, host: str = "0.0.0.0",
     from generativeaiexamples_tpu.observability.bootstrap import (
         init_observability)
     init_observability("engine")
-    server = ModelServer(scheduler, model_name)
+    watchdog = None
+    if watchdog_enabled():
+        watchdog = EngineWatchdog(scheduler)
+        watchdog.start()
+    server = ModelServer(scheduler, model_name, watchdog=watchdog)
     scheduler.start()
     web.run_app(server.app, host=host, port=port, print=None)

@@ -156,6 +156,23 @@ async def compiles_handler(request: web.Request) -> web.Response:
     return web.json_response(DEVTIME.compiles())
 
 
+async def chaos_handler(request: web.Request) -> web.Response:
+    """Fault-injection plane state (observability/chaos.py): mode, seed,
+    active spec, per-fault decision/injection counts — a chaos run's
+    injected schedule is inspectable, not inferred from symptoms."""
+    from generativeaiexamples_tpu.observability.chaos import CHAOS
+    return web.json_response(CHAOS.snapshot())
+
+
+async def deadletter_handler(request: web.Request) -> web.Response:
+    """Event-agent dead letters (chains/event_agent.py): events that
+    exhausted their retry budget, newest first — paired with the
+    ``event_agent_dead_letter_total`` counter."""
+    from generativeaiexamples_tpu.chains.event_agent import (
+        dead_letter_payload)
+    return web.json_response(dead_letter_payload())
+
+
 async def slo_handler(request: web.Request) -> web.Response:
     """Per-class SLO attainment, burn rates, pressure, recent breaches
     (observability/slo.py) — the operator view of 'are we keeping our
@@ -187,6 +204,10 @@ def add_debug_routes(app: web.Application) -> None:
         # entries and the engine with its dispatch families
         web.get("/debug/devtime", devtime_handler),
         web.get("/debug/compiles", compiles_handler),
+        # robustness plane: the chaos injector's live schedule and the
+        # event agents' dead-letter ring (docs/robustness.md)
+        web.get("/debug/chaos", chaos_handler),
+        web.get("/debug/deadletter", deadletter_handler),
     ])
 
 

@@ -50,10 +50,13 @@ import urllib.request
 import uuid
 from typing import Dict, Iterator, List, Optional, Sequence
 
+from generativeaiexamples_tpu.core.config import env_float as _env_float
 from generativeaiexamples_tpu.core.config import http_timeout
 from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.observability import chaos as chaos_mod
 from generativeaiexamples_tpu.observability import otel
 from generativeaiexamples_tpu.observability import slo as slo_mod
+from generativeaiexamples_tpu.server import resilience
 
 logger = logging.getLogger(__name__)
 
@@ -67,6 +70,14 @@ class _Worker:
     def __init__(self, url: str) -> None:
         self.url = url.rstrip("/")
         self.down_until = 0.0
+        # circuit-breaker half-open state (server/resilience.py doctrine):
+        # set when the worker is marked down; once the cooldown expires
+        # exactly ONE thread (probe_lock try-acquire) runs the canary
+        # health probe — the rest keep treating the worker as down until
+        # the probe passes, so recovery is a single request, not a
+        # stampede of everything that queued up during the outage
+        self.half_open = False
+        self.probe_lock = threading.Lock()
         # discovered from /health (engine/server.py health handler): the
         # worker's serving role and live load. "" role = not yet probed;
         # a health body with no engine_role field is a unified worker.
@@ -144,21 +155,40 @@ class FailoverLLM:
     prefill-/decode-role workers serve disaggregated."""
 
     def __init__(self, urls: Sequence[str], model: str,
-                 cooldown_s: float = 10.0, max_attempts: int = 4,
-                 refresh_s: Optional[float] = None) -> None:
+                 cooldown_s: Optional[float] = None, max_attempts: int = 4,
+                 refresh_s: Optional[float] = None,
+                 hedge_s: Optional[float] = None,
+                 policy: Optional[resilience.ResiliencePolicy] = None) -> None:
         if not urls:
             raise ValueError("FailoverLLM needs at least one worker URL")
         self._workers = [_Worker(u) for u in urls]
         self.model = model
-        self.cooldown_s = cooldown_s
+        self.cooldown_s = (cooldown_s if cooldown_s is not None
+                           else _env_float("APP_ROUTER_COOLDOWN_S", 10.0))
         self.max_attempts = max_attempts
         if refresh_s is None:
-            try:
-                refresh_s = float(os.environ.get("APP_ROUTER_REFRESH_S",
-                                                 "2.0"))
-            except ValueError:
-                refresh_s = 2.0
+            refresh_s = _env_float("APP_ROUTER_REFRESH_S", 2.0)
         self.refresh_s = refresh_s
+        # hedged KV-handoff opens (server/resilience.hedged_call): when the
+        # primary decode replica hasn't opened the stream within hedge_s,
+        # dispatch the SAME payload to the second-least-loaded replica and
+        # stream whichever opens first. 0 (default) = off — hedging is
+        # tail-latency insurance, priced at one duplicate dispatch.
+        self.hedge_s = (hedge_s if hedge_s is not None
+                        else _env_float("APP_ROUTER_HEDGE_S", 0.0))
+        # the shared retry policy: jittered backoff between attempts, a
+        # per-pool retry BUDGET (token bucket — a retry storm cannot
+        # amplify an outage beyond 1 + ratio), and the SLO-deadline
+        # cutoff (a request past its deadline is shed, not retried)
+        self._policy = policy if policy is not None else \
+            resilience.ResiliencePolicy(
+                "router", max_attempts=max_attempts,
+                base_s=_env_float("APP_ROUTER_BACKOFF_S", 0.05),
+                cap_s=_env_float("APP_ROUTER_BACKOFF_CAP_S", 2.0),
+                budget=resilience.RetryBudget(
+                    "router",
+                    ratio=_env_float("APP_ROUTER_RETRY_RATIO", 0.5),
+                    burst=_env_float("APP_ROUTER_RETRY_BURST", 10.0)))
         self._discovered = False
         self._discover_lock = threading.Lock()
         # guards SELECTION state (score reads + dispatched increments) for
@@ -198,16 +228,41 @@ class FailoverLLM:
                 for w in self._workers}
 
     def _pick(self, roles: Sequence[str],
-              exclude: Sequence[str] = ()) -> Optional[_Worker]:   # tpulint: hot-path
+              exclude: Sequence[str] = (),
+              charge: bool = True) -> Optional[_Worker]:   # tpulint: hot-path
         """Least-loaded healthy worker among ``roles``. Stale load views
         refresh via /health on the way (bounded by the probe timeout);
         circuit-broken workers re-probe only once their cooldown expires
-        (the supervisor may have restarted them)."""
+        (the supervisor may have restarted them). ``charge=False``
+        selects WITHOUT counting a dispatch — for a hedge candidate that
+        only launches if the primary is slow; the actual launch charges
+        it via :meth:`_charge` so scores and router_dispatches never
+        record dispatches that didn't happen."""
         self._ensure_roles()
         now = time.monotonic()
         cands = [w for w in self._workers
                  if (w.role or "unified") in roles and w.url not in exclude]
         up = [w for w in cands if w.down_until <= now]
+        # half-open recovery: a worker past its cooldown needs ONE passing
+        # canary probe before traffic returns. probe_lock try-acquire makes
+        # it single-flight — concurrent picks skip the worker this pass
+        # instead of stampeding everything that queued during the outage
+        # onto a replica that may still be booting.
+        for w in list(up):
+            if not w.half_open:
+                continue
+            if w.probe_lock.acquire(blocking=False):
+                try:
+                    if w.healthy():
+                        w.half_open = False
+                        logger.info("worker %s passed half-open probe; "
+                                    "re-admitted", w.url)
+                    else:
+                        self._mark_down(w)
+                finally:
+                    w.probe_lock.release()
+            if w.half_open:
+                up.remove(w)
         for w in up:
             if now - w.probed_at > self.refresh_s and not w.healthy():
                 self._mark_down(w)
@@ -224,17 +279,30 @@ class FailoverLLM:
             for w in cands:
                 if w.healthy() and (w.role or "unified") in roles:
                     w.down_until = 0.0
+                    w.half_open = False   # the probe WAS the canary
                     up.append(w)
         if not up:
             return None
         with self._lock:
             best = min(up, key=lambda w: w.score)
-            best.dispatched += 1
-            best.total_dispatched += 1
-        REGISTRY.counter("router_dispatches",
-                         labels={"worker": best.url,
-                                 "role": best.role or "unified"}).inc()
+            if charge:
+                best.dispatched += 1
+                best.total_dispatched += 1
+        if charge:
+            REGISTRY.counter("router_dispatches",
+                             labels={"worker": best.url,
+                                     "role": best.role or "unified"}).inc()
         return best
+
+    def _charge(self, w: _Worker) -> None:
+        """Count a dispatch against a worker selected with charge=False —
+        called at the instant its hedge leg actually launches."""
+        with self._lock:
+            w.dispatched += 1
+            w.total_dispatched += 1
+        REGISTRY.counter("router_dispatches",
+                         labels={"worker": w.url,
+                                 "role": w.role or "unified"}).inc()
 
     def _has_disagg(self) -> bool:
         """Serve disaggregated iff the pool holds at least one prefill-role
@@ -247,6 +315,9 @@ class FailoverLLM:
 
     def _mark_down(self, w: _Worker) -> None:
         w.down_until = time.monotonic() + self.cooldown_s
+        # once the cooldown expires the worker is HALF-OPEN: one canary
+        # health probe (single-flight) must pass before traffic returns
+        w.half_open = True
         logger.warning("engine worker %s marked down for %.0fs", w.url,
                        self.cooldown_s)
 
@@ -268,6 +339,7 @@ class FailoverLLM:
         failover retry/resume — so each worker's ``/debug/requests``
         timeline for the request shares the router's key."""
         rid = uuid.uuid4().hex[:12]
+        self._policy.note_request()   # first attempt: retry-budget deposit
         if self._has_disagg():
             yield from self._chat_disagg(messages, max_tokens, temperature,
                                          top_p, top_k, response_format, rid)
@@ -348,7 +420,13 @@ class FailoverLLM:
         emitted = [] if emitted is None else emitted
         rid = rid or uuid.uuid4().hex[:12]
         last_err: Exception = RuntimeError("no engine worker available")
-        for _ in range(self.max_attempts):
+        for attempt in range(self.max_attempts):
+            if attempt and not self._policy.before_retry(attempt):
+                # denied by the shared policy: retry budget spent (a storm
+                # must not amplify the outage) or the request's remaining
+                # SLO deadline cannot survive the backoff — shed, not
+                # retried (retries_denied_total{pool,reason})
+                break
             w = self._pick(("unified", "decode", ""))
             if w is None:
                 last_err = RuntimeError("no unified/decode worker up")
@@ -360,6 +438,11 @@ class FailoverLLM:
                 logger.info("resuming stream on %s at %d chars", w.url,
                             len(str(payload["continue_text"])))
             try:
+                # chaos seam (observability/chaos.py): inside the try so an
+                # injected reset/5xx takes the SAME failover path a real
+                # one would; APP_CHAOS=off is one attribute read
+                if chaos_mod.CHAOS.enabled:
+                    chaos_mod.CHAOS.http_fault("router.chat")
                 # SLO class + remaining deadline + traceparent, same as
                 # RemoteLLM — a failover RESUME carries the (shrunken)
                 # remaining budget, so the survivor judges against the
@@ -410,6 +493,8 @@ class FailoverLLM:
                                attributes={"request_id": rid})
         try:
             for attempt in range(self.max_attempts):
+                if attempt and not self._policy.before_retry(attempt):
+                    break   # budget spent or deadline unmeetable: shed
                 if not self._has_disagg():
                     # topology collapsed mid-retry: the unified path
                     # carries the already-yielded prefix so the stream
@@ -432,6 +517,8 @@ class FailoverLLM:
                                         emitted, stream=False)
                 t_pf = time.monotonic()
                 try:
+                    if chaos_mod.CHAOS.enabled:
+                        chaos_mod.CHAOS.http_fault("router.prefill")
                     resp = httpx.post(f"{pw.url}/v1/kv/prefill",
                                       json=payload,
                                       headers=self._headers(rid, span),
@@ -460,33 +547,78 @@ class FailoverLLM:
                 if dw is None:
                     last_err = RuntimeError("no decode worker up")
                     continue
+                cands = [dw]
+                if self.hedge_s > 0:
+                    # hedged handoff: arm the second-least-loaded replica;
+                    # it is dispatched only if the primary hasn't opened
+                    # the stream within hedge_s (resilience.hedged_call).
+                    # charge=False: arming is not dispatching — the leg is
+                    # charged by _open_handoff iff it actually launches
+                    dw2 = self._pick(("decode",), exclude=(dw.url,),
+                                     charge=False)
+                    if dw2 is not None:
+                        cands.append(dw2)
                 t0 = time.monotonic()
+                winner = dw
                 try:
-                    with httpx.stream("POST", f"{dw.url}/v1/kv/handoff",
-                                      json=handoff,
-                                      headers=self._headers(rid, span),
-                                      timeout=http_timeout(120.0)) as dresp:
-                        if dresp.status_code >= 500:
-                            raise httpx.TransportError(
-                                f"HTTP {dresp.status_code}")
-                        dresp.raise_for_status()
-                        # handoff latency: prefill payload in hand → decode
-                        # stream open (admission imported the pages)
-                        handoff_open = time.monotonic() - t0
-                        REGISTRY.histogram("router_handoff_s").observe(
-                            handoff_open)
-                        if span is not None:
-                            span.set_attribute("router.decode_worker",
-                                               dw.url)
-                            span.set_attribute("router.handoff_open_s",
-                                               round(handoff_open, 6))
-                        yield from self._pump_sse(dresp, emitted)
-                        return                    # clean completion
+                    cm, dresp, winner = self._open_handoff(cands, handoff,
+                                                           rid, span)
+                except httpx.HTTPStatusError as exc:
+                    if exc.response is not None \
+                            and exc.response.status_code == 409:
+                        # the decode pool REFUSED the payload (geometry/
+                        # dtype validation — e.g. a corrupted handoff):
+                        # the payload itself is suspect, the worker is
+                        # fine. Re-run the route for a FRESH prefill
+                        # instead of circuit-breaking a healthy replica.
+                        REGISTRY.counter("router_handoff_rejects_total").inc()
+                        logger.warning("decode pool rejected handoff "
+                                       "payload (409); re-prefilling: %s",
+                                       exc)
+                        last_err = exc
+                        continue
+                    raise
                 except (httpx.TransportError, httpx.StreamError,
                         json.JSONDecodeError, ConnectionError,
                         OSError) as exc:
                     last_err = exc
-                    self._mark_down(dw)
+                    if len(cands) == 1:
+                        # hedged opens mark their own failed legs via the
+                        # on_error callback (incl. a loser masked by the
+                        # winner); only the plain single-leg open is
+                        # circuit-broken here
+                        self._mark_down(dw)
+                    continue
+                try:
+                    # handoff latency: prefill payload in hand → decode
+                    # stream open (admission imported the pages)
+                    handoff_open = time.monotonic() - t0
+                    REGISTRY.histogram("router_handoff_s").observe(
+                        handoff_open)
+                    if span is not None:
+                        span.set_attribute("router.decode_worker",
+                                           winner.url)
+                        span.set_attribute("router.handoff_open_s",
+                                           round(handoff_open, 6))
+                        if winner is not dw:
+                            span.set_attribute("router.hedged", True)
+                    if winner is not dw:
+                        # the primary LOST its own hedge: it is slow, not
+                        # down, so it is not circuit-broken here — but a
+                        # chronically losing replica is an operator
+                        # signal (its own watchdog owns detecting a
+                        # genuinely wedged stream path via /health 503)
+                        REGISTRY.counter("router_hedge_losses_total",
+                                         labels={"worker": dw.url}).inc()
+                    yield from self._pump_sse(dresp, emitted)
+                    return                    # clean completion
+                except (httpx.TransportError, httpx.StreamError,
+                        json.JSONDecodeError, ConnectionError,
+                        OSError) as exc:
+                    last_err = exc
+                    self._mark_down(winner)
+                finally:
+                    cm.__exit__(None, None, None)
             raise RuntimeError(
                 f"LLM request failed across {self.max_attempts} attempts: "
                 f"{last_err}")
@@ -503,6 +635,61 @@ class FailoverLLM:
         finally:
             otel.end_span(span)
 
+    def _open_handoff(self, cands: List[_Worker], handoff: Dict,
+                      rid: str, span):
+        """Open a /v1/kv/handoff SSE stream on one of ``cands`` and return
+        ``(context_manager, response, worker)`` with the response already
+        status-checked. One candidate = a plain open; two = a hedged open
+        (resilience.hedged_call): the secondary launches only if the
+        primary hasn't opened within ``hedge_s``, first success streams,
+        the straggler's stream is closed the moment it lands."""
+        import httpx
+
+        # headers are built on the CALLER's thread: hedged legs run on
+        # fresh threads with an empty contextvars context, where the SLO
+        # admission (slo_mod.outbound_headers) would silently resolve to
+        # nothing — and dropping the deadline header would disable
+        # deadline accounting on every hedged-mode request
+        headers = self._headers(rid, span)
+
+        def open_one(w: _Worker):
+            if w is not cands[0]:
+                self._charge(w)   # the hedge leg launched: NOW it counts
+            if chaos_mod.CHAOS.enabled:
+                chaos_mod.CHAOS.http_fault("router.handoff")
+            cm = httpx.stream("POST", f"{w.url}/v1/kv/handoff",
+                              json=handoff,
+                              headers=headers,
+                              timeout=http_timeout(120.0))
+            resp = cm.__enter__()
+            try:
+                if resp.status_code >= 500:
+                    raise httpx.TransportError(f"HTTP {resp.status_code}")
+                resp.raise_for_status()   # 4xx: deterministic — raise
+            except BaseException:
+                cm.__exit__(None, None, None)
+                raise
+            return (cm, resp, w)
+
+        if len(cands) == 1:
+            return open_one(cands[0])
+
+        def leg_failed(ix: int, exc: Exception) -> None:
+            # a losing leg's TRANSPORT failure must still circuit-break
+            # that worker — the winner masking it would leave a hard-down
+            # primary in rotation (lowest score, re-picked every request).
+            # A 409 stays un-broken: the payload is suspect, not the worker.
+            if not isinstance(exc, httpx.HTTPStatusError):
+                self._mark_down(cands[ix])
+
+        result, _ix = resilience.hedged_call(
+            [lambda w=w: open_one(w) for w in cands],
+            hedge_after_s=self.hedge_s,
+            cancel=lambda r: r[0].__exit__(None, None, None),
+            on_error=leg_failed,
+            name="router_handoff")
+        return result
+
     def chat_tools(self, messages: Sequence[Dict], tools: Sequence[Dict],
                    tool_choice="auto", **sampling) -> Dict:
         """Non-streamed tool turn: whole-request retry across the pool's
@@ -516,13 +703,18 @@ class FailoverLLM:
             payload["tools"] = list(tools)
             payload["tool_choice"] = tool_choice
         rid = uuid.uuid4().hex[:12]
+        self._policy.note_request()
         last_err: Exception = RuntimeError("no engine worker available")
-        for _ in range(self.max_attempts):
+        for attempt in range(self.max_attempts):
+            if attempt and not self._policy.before_retry(attempt):
+                break   # budget spent or deadline unmeetable: shed
             w = self._pick(("unified", "decode", ""))
             if w is None:
                 last_err = RuntimeError("no unified/decode worker up")
                 continue
             try:
+                if chaos_mod.CHAOS.enabled:
+                    chaos_mod.CHAOS.http_fault("router.tools")
                 resp = httpx.post(f"{w.url}/v1/chat/completions",
                                   json=payload,
                                   headers=self._headers(rid),

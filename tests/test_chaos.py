@@ -1,0 +1,423 @@
+"""Chaos plane + watchdog: the ISSUE-10 fault-class matrix.
+
+Contract under test (observability/chaos.py, engine/watchdog.py, the
+failover router's shared resilience policy): with APP_CHAOS=off the plane
+adds ZERO work to hot paths (the devtime zero-fence pattern, enforced by
+monkeypatching the decision point); with chaos on, every injected fault
+class yields either a token-identical stream after recovery or a loud
+typed error — no hung streams, no silent KV corruption — and retry storms
+stay inside their budget while deadline-expired requests are shed, not
+retried.
+
+Everything here runs on fakes (FakeCore scheduler, canned-HTTP workers,
+SimpleNamespace engines) — no real engine boots, no compiles.
+"""
+
+import asyncio
+import queue
+import time
+from collections import deque
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler, _STOP
+from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+from generativeaiexamples_tpu.engine.watchdog import EngineWatchdog
+from generativeaiexamples_tpu.observability import chaos as chaos_mod
+from generativeaiexamples_tpu.observability import slo as slo_mod
+from generativeaiexamples_tpu.server import resilience
+from generativeaiexamples_tpu.server.failover import FailoverLLM
+
+from tests.test_failover import MESSAGES, _FakeWorker, _fake_pool
+from tests.test_scheduler_fuzz import FakeCore, oracle
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    """Every test leaves the process-global plane as the environment
+    configured it (off in the test env)."""
+    yield
+    chaos_mod.CHAOS.reset()
+
+
+# ------------------------------------------------------------ spec parsing
+
+def test_spec_parsing_and_unknown_fault_is_loud():
+    spec = chaos_mod.parse_spec("http.drop=0.5,tick.stall=1.0/0.02/3")
+    assert spec["http.drop"] == (0.5, 0.0, None)
+    assert spec["tick.stall"] == (1.0, 0.02, 3)
+    with pytest.raises(ValueError, match="unknown chaos fault"):
+        chaos_mod.parse_spec("http.dorp=0.5")
+    with pytest.raises(ValueError, match="fault=prob"):
+        chaos_mod.parse_spec("justafault")
+
+
+def test_fault_schedule_is_deterministic_per_seed():
+    def schedule(seed):
+        p = chaos_mod.ChaosPlane(mode="on", seed=seed,
+                                 spec="page.exhaust=0.4")
+        return [p.page_fault() for _ in range(64)]
+
+    assert schedule(11) == schedule(11)
+    assert schedule(11) != schedule(12)
+    assert any(schedule(11)) and not all(schedule(11))
+
+
+def test_injection_cap_recovers_after_max():
+    p = chaos_mod.ChaosPlane(mode="on", seed=1, spec="page.exhaust=1.0//2")
+    assert [p.page_fault() for _ in range(5)] == [True, True, False, False,
+                                                 False]
+
+
+# ----------------------------------------------------- zero-overhead (off)
+
+def test_scheduler_off_mode_makes_zero_chaos_decisions(monkeypatch):
+    """THE acceptance guarantee (the APP_DEVTIME zero-fence pattern):
+    chaos off = not one fault decision on the serving path — no RNG draw,
+    no sleep, no counter — while a REAL scheduler streams requests."""
+    decisions = []
+    monkeypatch.setattr(
+        chaos_mod.ChaosPlane, "_decide",
+        lambda self, fault: decisions.append(fault) or None)
+    assert not chaos_mod.CHAOS.enabled
+    core = FakeCore(batch=4, max_seq=64, page_size=8, chunk=16, steps=2,
+                    group=4)
+    sched = Scheduler(core, ByteTokenizer())
+    sched.start()
+    try:
+        reqs = [Request(prompt_ids=[40 + i] * 12, max_tokens=6,
+                        temperature=0.0) for i in range(3)]
+        for r in reqs:
+            sched.submit(r)
+        for r in reqs:
+            assert "".join(sched.iter_text(r))
+            assert r.error is None
+    finally:
+        sched.stop()
+    assert decisions == []
+
+
+# ------------------------------------------- scheduler fault classes
+
+def _drive(sched, reqs, core, max_ticks=20000):
+    """Tick the scheduler on the test thread until every request STOPs,
+    mirroring the driver loop's crash handling for injected death."""
+    done = 0
+    ticks = 0
+    while done < len(reqs) and ticks < max_ticks:
+        try:
+            worked = sched._tick()
+        except chaos_mod.ChaosFault:
+            sched._fail_all("engine error")
+            sched._state = core.init_state()
+            worked = True
+        ticks += 1
+        if not worked:
+            time.sleep(0.0005)
+        done = sum(1 for r in reqs if r.finished_at is not None)
+    return ticks
+
+
+def _collect(req):
+    items = []
+    try:
+        while True:
+            items.append(req.out_queue.get_nowait())
+    except queue.Empty:
+        pass
+    return items
+
+
+def test_injected_worker_death_fails_loudly_and_engine_recovers():
+    """worker.die: in-flight requests end with the loud typed 'engine
+    error' (STOP delivered exactly once, emitted prefix uncorrupted) and
+    the scheduler keeps serving — a later request completes
+    token-identical."""
+    core = FakeCore(batch=2, max_seq=64, page_size=8, chunk=16, steps=2)
+    sched = Scheduler(core, ByteTokenizer())
+    chaos_mod.CHAOS.configure(mode="on", seed=3, spec="worker.die=1.0//1")
+    req = Request(prompt_ids=[45] * 10, max_tokens=8, temperature=0.0)
+    sched.submit(req)
+    _drive(sched, [req], core)
+    assert req.error == "engine error"
+    items = _collect(req)
+    assert items.count(_STOP) == 1 and items[-1] is _STOP
+    # after the injected death (max=1), the engine serves again
+    req2 = Request(prompt_ids=[46] * 10, max_tokens=8, temperature=0.0)
+    sched.submit(req2)
+    _drive(sched, [req2], core)
+    assert req2.error is None
+    got = "".join(s for s in _collect(req2) if s is not _STOP)
+    want = ByteTokenizer().decode(oracle(req2.prompt_ids, 8, core.max_seq))
+    assert got == want
+    sched._fetcher.shutdown(wait=False)
+
+
+def test_forced_page_exhaustion_streams_token_identical():
+    """page.exhaust: forced allocation failures (admission + decode
+    growth) cost preemptions and latency, NEVER correctness — every
+    stream matches its solo oracle exactly."""
+    core = FakeCore(batch=3, max_seq=64, page_size=8, chunk=16, steps=2,
+                    num_pages=13)
+    sched = Scheduler(core, ByteTokenizer())
+    chaos_mod.CHAOS.configure(mode="on", seed=5, spec="page.exhaust=0.4")
+    tok = ByteTokenizer()
+    reqs = [Request(prompt_ids=[40 + i] * (6 + 7 * i), max_tokens=10,
+                    temperature=0.0) for i in range(4)]
+    for r in reqs:
+        sched.submit(r)
+    _drive(sched, reqs, core)
+    snap = chaos_mod.CHAOS.snapshot()
+    assert snap["faults"]["page.exhaust"]["injected"] > 0
+    for r in reqs:
+        assert r.error is None, r.error
+        got = "".join(s for s in _collect(r) if s is not _STOP)
+        assert got == tok.decode(oracle(r.prompt_ids, 10, core.max_seq))
+    sched._fetcher.shutdown(wait=False)
+
+
+def test_tick_stall_injection_counts_and_streams_survive(monkeypatch):
+    core = FakeCore(batch=2, max_seq=64, page_size=8, chunk=16, steps=2)
+    sched = Scheduler(core, ByteTokenizer())
+    # prob 1.0: EVERY tick stalls — the number of ticks a stream takes is
+    # timing-dependent (future landings), so a fractional probability
+    # could legitimately draw zero injections on a fast run
+    chaos_mod.CHAOS.configure(mode="on", seed=9,
+                              spec="tick.stall=1.0/0.001")
+    stalls = []
+    # monkeypatched (and reset() also restores it): no real wall time in
+    # CI, and no leak into later chaos runs in this process
+    monkeypatch.setattr(chaos_mod.CHAOS, "sleep", stalls.append)
+    req = Request(prompt_ids=[50] * 12, max_tokens=6, temperature=0.0)
+    sched.submit(req)
+    _drive(sched, [req], core)
+    assert req.error is None
+    assert stalls and all(s == 0.001 for s in stalls)
+    sched._fetcher.shutdown(wait=False)
+
+
+# --------------------------------------------------- KV handoff corruption
+
+def _fake_engine_geometry():
+    return SimpleNamespace(
+        page_size=8,
+        model_cfg=SimpleNamespace(n_layers=2, n_kv_heads=2, head_dim=4),
+        _kv_dtype="bfloat16",
+        max_seq=64,
+        cfg=SimpleNamespace(kv_quant="none"))
+
+
+def _well_formed_payload():
+    return {
+        "version": 1, "length": 10, "n_pages": 2, "page_size": 8,
+        "n_layers": 2, "kv_dim": 8, "kv_dtype": "bfloat16",
+        "k": np.zeros((2, 2, 8, 8), np.float32),
+        "v": np.zeros((2, 2, 8, 8), np.float32),
+        "k_s": None, "v_s": None,
+        "prompt_ids": list(range(10)),
+    }
+
+
+def test_kv_corruption_is_refused_never_served():
+    """kv.truncate / kv.garble: a corrupted handoff payload MUST fail
+    import validation loudly (the serving layer maps this ValueError to a
+    409) — silent acceptance would scatter garbage KV under a live
+    prompt, the one unforgivable outcome."""
+    from generativeaiexamples_tpu.engine.engine import EngineCore
+
+    ns = _fake_engine_geometry()
+    EngineCore.validate_handoff(ns, _well_formed_payload())   # sane baseline
+
+    trunc = chaos_mod.ChaosPlane(mode="on", seed=1, spec="kv.truncate=1.0")
+    bad = trunc.corrupt_kv(_well_formed_payload())
+    assert bad["k"].shape == (2, 1, 8, 8)
+    with pytest.raises(ValueError, match="shape"):
+        EngineCore.validate_handoff(ns, bad)
+
+    garble = chaos_mod.ChaosPlane(mode="on", seed=1, spec="kv.garble=1.0")
+    bad2 = garble.corrupt_kv(_well_formed_payload())
+    assert bad2["page_size"] == 9
+    with pytest.raises(ValueError, match="page_size"):
+        EngineCore.validate_handoff(ns, bad2)
+
+
+# ------------------------------------------------- router transport chaos
+
+def test_router_recovers_token_identical_from_injected_resets():
+    """http.drop (2 injections, then clean): the router circuit-breaks,
+    retries under the shared policy, and the client's joined stream is
+    IDENTICAL to a fault-free run — recovery, not degradation."""
+    a = _FakeWorker("unified", text="hello-chaos")
+    b = _FakeWorker("unified", text="hello-chaos")
+    with _fake_pool(a, b):
+        chaos_mod.CHAOS.configure(mode="on", seed=21,
+                                  spec="http.drop=1.0//2")
+        pool = FailoverLLM([a.url, b.url], "tiny", cooldown_s=0.05,
+                           refresh_s=60.0)
+        text = "".join(pool.chat(MESSAGES, max_tokens=8))
+        assert text == "hello-chaos"
+        snap = chaos_mod.CHAOS.snapshot()
+        assert snap["faults"]["http.drop"]["injected"] == 2
+
+
+def test_retry_budget_bounds_pool_retries_under_sustained_outage():
+    """Acceptance criterion: under a 100%-failure injected outage, total
+    retries across the pool stay within ratio*requests + burst — the
+    storm cannot amplify the outage by max_attempts."""
+    a = _FakeWorker("unified")
+    with _fake_pool(a):
+        chaos_mod.CHAOS.configure(mode="on", seed=2, spec="http.drop=1.0")
+        policy = resilience.ResiliencePolicy(
+            "router-budget-test", max_attempts=4, base_s=0.0, cap_s=0.0,
+            budget=resilience.RetryBudget("router-budget-test", ratio=0.5,
+                                          burst=2.0))
+        pool = FailoverLLM([a.url], "tiny", cooldown_s=0.0,
+                           refresh_s=60.0, policy=policy)
+        n_requests = 8
+        for _ in range(n_requests):
+            with pytest.raises(RuntimeError):
+                "".join(pool.chat(MESSAGES, max_tokens=8))
+        dispatches = chaos_mod.CHAOS.snapshot()["faults"]["http.drop"][
+            "injected"]
+        retries = dispatches - n_requests
+        assert retries <= 0.5 * n_requests + 2.0, \
+            f"retry storm: {retries} retries for {n_requests} requests"
+        assert retries >= 2               # the burst allowed some retries
+
+
+def test_deadline_expired_requests_are_shed_not_retried():
+    """Acceptance criterion: a request already past its SLO deadline gets
+    NO retry — one attempt, a loud error, capacity preserved."""
+    a = _FakeWorker("unified")
+    b = _FakeWorker("unified")
+    with _fake_pool(a, b):
+        chaos_mod.CHAOS.configure(mode="on", seed=4, spec="http.drop=1.0")
+        pool = FailoverLLM([a.url, b.url], "tiny", cooldown_s=0.0,
+                           refresh_s=60.0)
+        denied0 = REGISTRY.counter(
+            "retries_denied_total",
+            labels={"pool": "router", "reason": "deadline"}).value
+        with slo_mod.admission("interactive", deadline_ms=0.0):
+            with pytest.raises(RuntimeError):
+                "".join(pool.chat(MESSAGES, max_tokens=8))
+        assert chaos_mod.CHAOS.snapshot()["faults"]["http.drop"][
+            "injected"] == 1              # the first attempt, nothing more
+        assert REGISTRY.counter(
+            "retries_denied_total",
+            labels={"pool": "router", "reason": "deadline"}).value \
+            == denied0 + 1
+
+
+# ----------------------------------------------------------------- watchdog
+
+def _fake_sched(perf=None):
+    return SimpleNamespace(
+        core=SimpleNamespace(perf_model=perf),
+        _running=True,
+        last_tick_mono=1000.0,
+        _inflight=deque())
+
+
+def test_watchdog_trips_on_tick_stall_and_recovers():
+    now = [1000.0]
+    sched = _fake_sched()
+    wd = EngineWatchdog(sched, tick_stall_s=10.0, clock=lambda: now[0])
+    trips0 = REGISTRY.counter("engine_watchdog_trips_total",
+                              labels={"kind": "tick_stall"}).value
+    hazards0 = REGISTRY.counter("slo_hazards_total",
+                                labels={"kind": "watchdog_tick_stall"}).value
+    assert wd.check() and wd.serving_ok()
+    now[0] = 1011.0                      # 11 s without a tick: wedged
+    assert not wd.check() and not wd.serving_ok()
+    assert "tick_stall" in wd.status()["tripped"]
+    # edge-counted: a second poll in the same incident adds no trip
+    assert not wd.check()
+    assert REGISTRY.counter("engine_watchdog_trips_total",
+                            labels={"kind": "tick_stall"}).value \
+        == trips0 + 1
+    assert REGISTRY.counter("slo_hazards_total",
+                            labels={"kind": "watchdog_tick_stall"}).value \
+        == hazards0 + 1
+    sched.last_tick_mono = 1011.0        # driver ticked again
+    assert wd.check() and wd.serving_ok()
+
+
+def test_watchdog_trips_on_hung_dispatch_and_clears_when_drained():
+    now = [2000.0]
+    sched = _fake_sched()
+    sched.last_tick_mono = now[0]
+    wd = EngineWatchdog(sched, tick_stall_s=1e9, dispatch_bound_s=30.0,
+                        clock=lambda: now[0])
+    sched._inflight.append((16, None, [], {}, (2000.0, 8)))
+    now[0] = 2010.0
+    sched.last_tick_mono = now[0]
+    assert wd.check()                    # 10 s < 30 s bound
+    now[0] = 2031.0
+    sched.last_tick_mono = now[0]
+    assert not wd.check()
+    assert "hung_dispatch" in wd.status()["tripped"]
+    sched._inflight.clear()              # the dispatch finally resolved
+    assert wd.check() and wd.serving_ok()
+
+
+def test_watchdog_dispatch_bound_is_model_informed():
+    """With a perf model attached the hung-dispatch bound derives from
+    the analytic weight-read time (core/perfmodel.py), not the blind
+    absolute default."""
+    from generativeaiexamples_tpu.core.perfmodel import PerfModel
+
+    perf = PerfModel(n_params=int(1e9), param_bytes=1e10,
+                     peak_flops=1e14, peak_bw=1e12)
+    wd = EngineWatchdog(_fake_sched(perf=perf), dispatch_bound_s=60.0,
+                        dispatch_factor=200.0, clock=lambda: 0.0)
+    # 8 steps × 1e10 B / 1e12 B/s = 0.08 s expected → 200× = 16 s
+    assert wd.dispatch_bound(8) == pytest.approx(16.0)
+    # no peaks → the absolute bound applies (never None, never disabled)
+    wd2 = EngineWatchdog(_fake_sched(), dispatch_bound_s=60.0,
+                         clock=lambda: 0.0)
+    assert wd2.dispatch_bound(8) == 60.0
+
+
+def test_health_answers_503_while_tripped_or_draining():
+    """The routing contract: a tripped or draining worker fails its
+    health probe, so the failover pool circuit-breaks it away; recovery
+    or undrain restores 200."""
+    from generativeaiexamples_tpu.engine.server import ModelServer
+
+    sched = _fake_sched()
+    sched.load_stats = lambda: {"engine_role": "unified", "running": 0}
+    sched.tokenizer = None
+    wd = EngineWatchdog(sched, tick_stall_s=10.0, clock=lambda: 1000.0)
+    server = ModelServer(sched, "tiny", watchdog=wd)
+
+    def health_status():
+        resp = asyncio.run(server.health(None))
+        return resp.status
+
+    assert health_status() == 200
+    wd.healthy = False                    # as a trip would set
+    assert health_status() == 503
+    wd.healthy = True
+    wd.drain()
+    assert health_status() == 503
+    assert wd.status()["draining"]
+    wd.undrain()
+    assert health_status() == 200
+
+
+def test_debug_drain_endpoint_toggles():
+    from generativeaiexamples_tpu.engine.server import ModelServer
+
+    sched = _fake_sched()
+    sched.load_stats = lambda: {}
+    wd = EngineWatchdog(sched, clock=lambda: 0.0)
+    server = ModelServer(sched, "tiny", watchdog=wd)
+    asyncio.run(server.debug_drain(SimpleNamespace(query={})))
+    assert wd.draining
+    asyncio.run(server.debug_drain(SimpleNamespace(query={"off": "1"})))
+    assert not wd.draining

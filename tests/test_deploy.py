@@ -11,6 +11,8 @@ import time
 import pytest
 import requests
 
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.deploy import supervisor as supervisor_mod
 from generativeaiexamples_tpu.deploy.supervisor import ServiceSpec, Supervisor
 
 
@@ -107,6 +109,42 @@ def test_crash_restart_with_backoff(tmp_path):
         assert sup2.status()["flaky"]["healthy"]
     finally:
         sup2.down()
+
+
+def test_restart_backoff_is_jittered_and_counted(monkeypatch):
+    """The restart path routes through the SHARED full-jitter backoff
+    (server/resilience.py — no more synchronized min(2**n, 60) herd) and
+    counts supervisor_restarts_total{service}."""
+    delays = []
+
+    def fake_backoff(attempt, base_s=1.0, cap_s=60.0, rng=None):
+        delays.append((attempt, base_s, cap_s))
+        return 0.0                      # restart immediately: fast test
+
+    monkeypatch.setattr(supervisor_mod, "full_jitter_backoff", fake_backoff)
+    spec = ServiceSpec(name="dying",
+                       command=[sys.executable, "-c",
+                                "import sys; sys.exit(1)"],
+                       max_restarts=2)
+    restarts0 = REGISTRY.counter("supervisor_restarts_total",
+                                 labels={"service": "dying"}).value
+    sup = Supervisor([spec], poll_interval_s=0.05)
+    sup.up()
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if sup.status()["dying"]["restarts"] == 2:
+                break
+            time.sleep(0.05)
+        assert sup.status()["dying"]["restarts"] == 2
+    finally:
+        sup.down()
+    # full jitter consulted once per restart, with growing attempt numbers
+    assert [a for a, _, _ in delays] == [2, 3]
+    assert all(cap == 60.0 for _, _, cap in delays)
+    assert REGISTRY.counter("supervisor_restarts_total",
+                            labels={"service": "dying"}).value \
+        == restarts0 + 2
 
 
 def test_dependency_cycle_rejected():

@@ -3,9 +3,11 @@
 import json
 import threading
 
+from generativeaiexamples_tpu.chains import event_agent as event_agent_mod
 from generativeaiexamples_tpu.chains.event_agent import (
-    Event, EventDrivenAgent, jsonl_event_source, list_source,
-    make_cve_triage_handler)
+    Event, EventDrivenAgent, dead_letter_payload, jsonl_event_source,
+    list_source, make_cve_triage_handler)
+from generativeaiexamples_tpu.core.metrics import REGISTRY
 
 
 def test_events_processed_with_bounded_concurrency():
@@ -54,6 +56,48 @@ def test_retry_then_dead_letter_and_sink():
     assert not bad.ok and "boom" in bad.error and bad.attempts == 3
     good = next(r for r in seen if r.key == "good")
     assert good.ok and good.output == "ok"
+
+
+def test_retry_backoff_is_jittered_exponential(monkeypatch):
+    """Retries sleep the SHARED full-jitter backoff (server/resilience.py)
+    with the agent's retry_delay_s as base — not the old linear
+    delay*attempt lockstep."""
+    calls = []
+
+    def fake_backoff(attempt, base_s=0.5, cap_s=60.0, rng=None):
+        calls.append((attempt, base_s, cap_s))
+        return 0.0
+
+    monkeypatch.setattr(event_agent_mod, "full_jitter_backoff", fake_backoff)
+
+    def always_fails(event):
+        raise RuntimeError("down")
+
+    agent = EventDrivenAgent(always_fails, max_retries=3,
+                             retry_delay_s=0.25, retry_cap_s=7.0)
+    agent.run_sync(list_source([Event(key="x")]))
+    assert calls == [(1, 0.25, 7.0), (2, 0.25, 7.0), (3, 0.25, 7.0)]
+
+
+def test_dead_letters_counted_and_exposed_on_debug_surface():
+    """Exhausted events ride the process-wide ring served at
+    GET /debug/deadletter and count event_agent_dead_letter_total."""
+    total0 = REGISTRY.counter("event_agent_dead_letter_total").value
+
+    def always_fails(event):
+        raise RuntimeError("poisoned payload")
+
+    agent = EventDrivenAgent(always_fails, max_retries=0,
+                             retry_delay_s=0.0)
+    agent.run_sync(list_source([Event(key="dead-1"), Event(key="dead-2")]))
+    assert REGISTRY.counter("event_agent_dead_letter_total").value \
+        == total0 + 2
+    payload = dead_letter_payload()
+    assert payload["total"] == total0 + 2
+    recent_keys = [d["key"] for d in payload["dead_letters"][:2]]
+    assert set(recent_keys) == {"dead-1", "dead-2"}
+    top = payload["dead_letters"][0]
+    assert "poisoned payload" in top["error"] and top["attempts"] == 1
 
 
 def test_jsonl_event_source(tmp_path):

@@ -213,11 +213,13 @@ class _FakeWorker:
     endpoints reply with minimal conforming bodies and count hits."""
 
     def __init__(self, role="unified", running=0, waiting=0, batch=8,
-                 pressure="ok", text="hello"):
+                 pressure="ok", text="hello", delay=0.0, reject_handoffs=0):
         self.role, self.text = role, text
         self.running, self.waiting, self.batch = running, waiting, batch
         self.pressure = pressure
         self.alive = True            # False → /health answers 503 (draining)
+        self.delay = delay           # seconds before serving any POST
+        self.reject_handoffs = reject_handoffs   # first N handoffs get 409
         self.hits = {"health": 0, "prefill": 0, "handoff": 0, "chat": 0}
         worker = self
 
@@ -254,6 +256,18 @@ class _FakeWorker:
 
             def do_POST(self):
                 self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                if worker.delay:
+                    time.sleep(worker.delay)
+                if self.path == "/v1/kv/handoff" and worker.reject_handoffs:
+                    worker.reject_handoffs -= 1
+                    worker.hits["handoff"] += 1
+                    body = json.dumps({"error": "handoff mismatch"}).encode()
+                    self.send_response(409)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path == "/v1/kv/prefill":
                     worker.hits["prefill"] += 1
                     self._reply(json.dumps(
@@ -355,3 +369,80 @@ def test_router_drain_and_readmission():
         time.sleep(0.25)
         assert "".join(pool.chat(MESSAGES, max_tokens=8))
         assert a.hits["chat"] == 1
+
+
+def test_router_half_open_recovery_is_single_flight():
+    """Circuit-break recovery is HALF-OPEN: when a broken worker's
+    cooldown expires, exactly ONE canary health probe re-admits it —
+    concurrent requests do not stampede it with probes (or traffic)
+    while its recovery is unconfirmed."""
+    import threading
+
+    a = _FakeWorker("unified")
+    b = _FakeWorker("unified")
+    with _fake_pool(a, b):
+        pool = FailoverLLM([a.url, b.url], "tiny", cooldown_s=0.3,
+                           refresh_s=60.0)
+        a.alive = False
+        assert "".join(pool.chat(MESSAGES, max_tokens=8))   # breaks a
+        assert a.hits["chat"] == 0
+        a.alive = True
+        time.sleep(0.35)             # cooldown expired: a is half-open
+        h0 = a.hits["health"]
+        errs = []
+
+        def one():
+            try:
+                assert "".join(pool.chat(MESSAGES, max_tokens=8))
+            except Exception as exc:   # pragma: no cover - surfaced below
+                errs.append(exc)
+
+        threads = [threading.Thread(target=one) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        # the canary: one single-flight probe, not one per queued request
+        assert a.hits["health"] == h0 + 1
+        assert a.hits["chat"] + b.hits["chat"] >= 9
+
+
+def test_router_hedged_handoff_wins_on_slow_replica():
+    """Hedged KV-handoff dispatch: when the least-loaded decode replica
+    sits on the open beyond hedge_s, the payload is re-dispatched to the
+    second-least-loaded one and the faster stream serves the client."""
+    from generativeaiexamples_tpu.core.metrics import REGISTRY
+
+    slow = _FakeWorker("decode", text="slow", delay=1.0)
+    fast = _FakeWorker("decode", text="fast", running=1)   # scored second
+    with _fake_pool(_FakeWorker("prefill"), slow, fast) as (pw, _, __):
+        pool = FailoverLLM([pw.url, slow.url, fast.url], "tiny",
+                           refresh_s=60.0, hedge_s=0.05)
+        wins0 = REGISTRY.counter("hedge_wins_total",
+                                 labels={"pool": "router_handoff"}).value
+        text = "".join(pool.chat(MESSAGES, max_tokens=8))
+        assert text == "fast"
+        assert fast.hits["handoff"] == 1
+        assert REGISTRY.counter("hedge_wins_total",
+                                labels={"pool": "router_handoff"}).value \
+            == wins0 + 1
+
+
+def test_router_handoff_409_reprefills_instead_of_breaking_replica():
+    """A decode pool REFUSING a handoff payload (409 — the validation
+    path a corrupted/mismatched payload hits) triggers a fresh prefill
+    retry; the healthy replica is NOT circuit-broken and the stream
+    completes normally."""
+    from generativeaiexamples_tpu.core.metrics import REGISTRY
+
+    d = _FakeWorker("decode", text="ok", reject_handoffs=1)
+    with _fake_pool(_FakeWorker("prefill"), d) as (pw, _):
+        pool = FailoverLLM([pw.url, d.url], "tiny", refresh_s=60.0)
+        rejects0 = REGISTRY.counter("router_handoff_rejects_total").value
+        text = "".join(pool.chat(MESSAGES, max_tokens=8))
+        assert text == "ok"
+        assert pw.hits["prefill"] == 2        # re-prefilled a fresh payload
+        assert d.hits["handoff"] == 2         # 409 then success — no break
+        assert REGISTRY.counter("router_handoff_rejects_total").value \
+            == rejects0 + 1

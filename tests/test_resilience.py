@@ -1,0 +1,172 @@
+"""Unified resilience policy (server/resilience.py): jitter bounds, retry
+budgets, deadline cutoff, hedged dispatch — pure host logic, no engines.
+
+These are the contracts the router/supervisor/event-agent rewiring leans
+on, so each is pinned with injected rng/sleep/clock: exact delays, exact
+token arithmetic, no wall-clock flake.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.observability import slo as slo_mod
+from generativeaiexamples_tpu.server.resilience import (
+    ResiliencePolicy, RetryBudget, full_jitter_backoff, hedged_call)
+
+
+# --------------------------------------------------------------- backoff
+
+def test_full_jitter_backoff_bounds_and_growth():
+    rng = random.Random(0)
+    for attempt in range(1, 12):
+        ceiling = min(60.0, 0.5 * 2 ** (attempt - 1))
+        for _ in range(50):
+            d = full_jitter_backoff(attempt, base_s=0.5, cap_s=60.0, rng=rng)
+            assert 0.0 <= d <= ceiling
+
+
+def test_full_jitter_backoff_deterministic_with_injected_rng():
+    a = [full_jitter_backoff(i, rng=random.Random(7)) for i in range(1, 6)]
+    b = [full_jitter_backoff(i, rng=random.Random(7)) for i in range(1, 6)]
+    assert a == b
+
+
+def test_full_jitter_backoff_actually_jitters():
+    rng = random.Random(3)
+    draws = {full_jitter_backoff(4, base_s=1.0, cap_s=60.0, rng=rng)
+             for _ in range(20)}
+    assert len(draws) > 10          # not the old deterministic 2**n
+
+
+# ---------------------------------------------------------------- budget
+
+def test_retry_budget_token_bucket_semantics():
+    b = RetryBudget("t", ratio=0.5, burst=2.0)
+    assert b.tokens == 2.0                     # starts full: cold blips retry
+    assert b.try_spend() and b.try_spend()
+    assert not b.try_spend()                   # empty: refuse
+    b.note_request()                           # +0.5
+    assert not b.try_spend()                   # 0.5 < 1
+    b.note_request()
+    assert b.try_spend()                       # 1.0 spends
+    for _ in range(100):
+        b.note_request()
+    assert b.tokens == 2.0                     # deposits cap at burst
+
+
+def test_retry_budget_bounds_amplification_under_sustained_outage():
+    """THE budget property: with every attempt failing, total retries are
+    bounded by ratio*requests + burst — the storm cannot multiply the
+    outage by max_attempts."""
+    budget = RetryBudget("outage", ratio=0.2, burst=3.0)
+    policy = ResiliencePolicy("outage", max_attempts=5, base_s=0.0,
+                              cap_s=0.0, budget=budget,
+                              sleep=lambda s: None)
+    n_requests, retries = 40, 0
+    for _ in range(n_requests):
+        policy.note_request()
+        for attempt in range(1, policy.max_attempts):
+            if not policy.before_retry(attempt, deadline_s=None):
+                break
+            retries += 1
+    assert retries <= 0.2 * n_requests + 3.0
+    assert retries >= 3               # the burst did allow initial retries
+
+
+# ---------------------------------------------------------------- policy
+
+def test_policy_sleeps_jittered_backoff_between_retries():
+    slept = []
+    policy = ResiliencePolicy("p", max_attempts=4, base_s=0.1, cap_s=1.0,
+                              rng=random.Random(1), sleep=slept.append)
+    assert policy.before_retry(1)
+    assert policy.before_retry(2)
+    assert len(slept) == 2
+    assert 0.0 <= slept[0] <= 0.1 and 0.0 <= slept[1] <= 0.2
+
+
+def test_policy_denies_past_attempt_cap():
+    policy = ResiliencePolicy("p", max_attempts=2, sleep=lambda s: None)
+    assert policy.before_retry(1)
+    assert not policy.before_retry(2)
+
+
+def test_policy_deadline_cutoff_sheds_instead_of_retrying():
+    """A retry whose backoff alone outlives the remaining SLO deadline is
+    refused — capacity goes to requests that can still make it."""
+    denied0 = REGISTRY.counter("retries_denied_total",
+                               labels={"pool": "ddl",
+                                       "reason": "deadline"}).value
+    policy = ResiliencePolicy("ddl", max_attempts=4, base_s=0.2, cap_s=0.2,
+                              rng=random.Random(2), sleep=lambda s: None)
+    assert not policy.before_retry(1, deadline_s=0.0)
+    assert policy.before_retry(1, deadline_s=10.0)
+    after = REGISTRY.counter("retries_denied_total",
+                             labels={"pool": "ddl",
+                                     "reason": "deadline"}).value
+    assert after == denied0 + 1
+
+
+def test_policy_reads_ambient_slo_admission_deadline():
+    policy = ResiliencePolicy("amb", max_attempts=4, base_s=0.05,
+                              cap_s=0.05, sleep=lambda s: None)
+    with slo_mod.admission("interactive", deadline_ms=0.0):
+        assert not policy.before_retry(1)      # already past the deadline
+    with slo_mod.admission("interactive", deadline_ms=60_000):
+        assert policy.before_retry(1)
+
+
+# ----------------------------------------------------------------- hedge
+
+def test_hedged_call_fast_primary_never_hedges():
+    hedges0 = REGISTRY.counter("hedges_total", labels={"pool": "h1"}).value
+    result, ix = hedged_call([lambda: "primary", lambda: "secondary"],
+                             hedge_after_s=0.2, name="h1")
+    assert (result, ix) == ("primary", 0)
+    assert REGISTRY.counter("hedges_total",
+                            labels={"pool": "h1"}).value == hedges0
+
+
+def test_hedged_call_slow_primary_loses_to_hedge():
+    cancelled = []
+    release = threading.Event()
+
+    def slow():
+        release.wait(timeout=5.0)
+        return "slow"
+
+    result, ix = hedged_call([slow, lambda: "fast"], hedge_after_s=0.02,
+                             cancel=cancelled.append, name="h2")
+    assert (result, ix) == ("fast", 1)
+    release.set()
+    deadline = time.monotonic() + 2.0
+    while not cancelled and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert cancelled == ["slow"]       # the straggler was reaped, not leaked
+
+
+def test_hedged_call_fast_failure_fails_over_immediately():
+    t0 = time.monotonic()
+
+    def boom():
+        raise ConnectionError("down")
+
+    result, ix = hedged_call([boom, lambda: "ok"], hedge_after_s=5.0,
+                             name="h3")
+    assert (result, ix) == ("ok", 1)
+    assert time.monotonic() - t0 < 2.0   # did NOT wait out the hedge window
+
+
+def test_hedged_call_all_failures_raise_last_error():
+    def boom_a():
+        raise ConnectionError("a")
+
+    def boom_b():
+        raise ValueError("b")
+
+    with pytest.raises((ConnectionError, ValueError)):
+        hedged_call([boom_a, boom_b], hedge_after_s=0.01, name="h4")

@@ -49,6 +49,7 @@ from generativeaiexamples_tpu.engine import scheduler as sched_mod
 from generativeaiexamples_tpu.engine.kv_cache import PageAllocator
 from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler, _STOP
 from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+from generativeaiexamples_tpu.observability import chaos as chaos_mod
 
 EOS = 3
 VOCAB = 260
@@ -233,12 +234,24 @@ class _Spec:
     family: int = 0
 
 
-def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict) -> Optional[str]:
-    """Run one scheduled episode; returns an error description or None."""
+def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
+                 chaos_spec: Optional[str] = None) -> Optional[str]:
+    """Run one scheduled episode; returns an error description or None.
+
+    ``chaos_spec`` arms the fault-injection plane (observability/chaos.py,
+    seeded by this episode's seed — the fault schedule replays with the
+    workload): forced page exhaustion, tick stalls, worker death. The
+    invariants then allow exactly ONE extra outcome — a request failed by
+    injected worker death carries the loud "engine error" and its emitted
+    text is a PREFIX of its oracle — everything else must still stream
+    token-identical. Never a hang, never silent truncation.
+    """
     rng = np.random.RandomState(seed)
     core = FakeCore(**core_kw)
     tok = ByteTokenizer()
     sched = Scheduler(core, tok)
+    if chaos_spec is not None:
+        chaos_mod.CHAOS.configure(mode="on", seed=seed, spec=chaos_spec)
 
     # inject fetch-delay jitter: futures land at random times relative to
     # the driver's ticks, racing the eager-drain and first-fetch paths
@@ -262,7 +275,15 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict) -> Optional[str]:
         while True:
             while pending and reqs[pending[0]][1].arrival_tick <= tick:
                 sched.submit(reqs[pending.pop(0)][0])
-            worked = sched._tick()
+            try:
+                worked = sched._tick()
+            except chaos_mod.ChaosFault:
+                # injected worker death: mirror the driver loop's crash
+                # handler (engine/scheduler._loop) — fail everything in
+                # flight loudly, reset state, keep serving
+                sched._fail_all("engine error")
+                sched._state = core.init_state()
+                worked = True
             tick += 1
             if tick > 20000:
                 return f"livelock: >{tick} ticks"
@@ -291,12 +312,21 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict) -> Optional[str]:
                 if not req.error:
                     return f"req {i}: oversized prompt not failed"
                 continue
-            if req.error:
-                return f"req {i}: unexpected error {req.error!r}"
             want = oracle(reqs[i][0].prompt_ids, sp.max_tokens, core.max_seq)
-            # token-level oracle: detokenize the emitted text back to ids
             got_text = "".join(s for s in items if s is not _STOP)
             want_text = tok.decode(want)
+            if req.error:
+                if chaos_spec is not None and req.error == "engine error":
+                    # failed by injected worker death: a LOUD typed error,
+                    # and nothing corrupt was ever streamed — the emitted
+                    # prefix must match the oracle exactly as far as it got
+                    if not want_text.startswith(got_text):
+                        return (f"req {i}: chaos-failed stream diverged "
+                                f"from oracle prefix before the injected "
+                                f"death ({len(got_text)} chars)")
+                    continue
+                return f"req {i}: unexpected error {req.error!r}"
+            # token-level oracle: detokenize the emitted text back to ids
             if got_text != want_text:
                 return (f"req {i}: stream diverged from solo oracle "
                         f"(prompt_len={sp.prompt_len} max={sp.max_tokens}, "
@@ -319,6 +349,8 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict) -> Optional[str]:
     finally:
         sched_mod._fetch = orig_fetch
         sched._fetcher.shutdown(wait=False)
+        if chaos_spec is not None:
+            chaos_mod.CHAOS.reset()
 
 
 def _gen_specs(rng: np.random.RandomState, core_kw: Dict) -> List[_Spec]:
@@ -354,18 +386,20 @@ def _core_kw(rng: np.random.RandomState) -> Dict:
         prefix_cache=bool(rng.rand() < 0.5))
 
 
-def _shrink(seed: int, specs: List[_Spec], core_kw: Dict, err: str) -> str:
+def _shrink(seed: int, specs: List[_Spec], core_kw: Dict, err: str,
+            chaos_spec: Optional[str] = None) -> str:
     """Greedy one-at-a-time removal: report the minimal failing workload."""
     changed = True
     while changed and len(specs) > 1:
         changed = False
         for i in range(len(specs)):
             cand = specs[:i] + specs[i + 1:]
-            if _run_episode(seed, cand, core_kw):
+            if _run_episode(seed, cand, core_kw, chaos_spec=chaos_spec):
                 specs, changed = cand, True
                 break
-    final = _run_episode(seed, specs, core_kw) or err
-    return (f"{final}\n  seed={seed} core={core_kw}\n  minimal workload: "
+    final = _run_episode(seed, specs, core_kw, chaos_spec=chaos_spec) or err
+    return (f"{final}\n  seed={seed} core={core_kw} chaos={chaos_spec!r}\n"
+            f"  minimal workload: "
             + "\n  ".join(map(repr, specs)))
 
 
@@ -386,3 +420,39 @@ def test_scheduler_fuzz_invariants():
     elapsed = time.perf_counter() - t0
     # the harness itself must stay fast enough for CI (<60 s target)
     assert elapsed < 120, f"fuzz run too slow for CI: {elapsed:.0f}s"
+
+
+CHAOS_EPISODES = 120
+
+# the per-episode fault menus: forced page exhaustion (pool-pressure
+# preemption storms), tick stalls (1 ms — schedule pressure, not wall
+# time), and rare injected worker death (the driver crash path)
+_CHAOS_MENUS = (
+    "page.exhaust=0.3",
+    "page.exhaust=0.15,tick.stall=0.05/0.001",
+    "worker.die=0.002,page.exhaust=0.1",
+)
+
+
+def test_scheduler_fuzz_chaos_invariants():
+    """The ISSUE-10 chaos matrix at the scheduler layer: under injected
+    page exhaustion, tick stalls, and worker death, every stream either
+    completes token-identical to its solo oracle or terminates with the
+    loud typed "engine error" (its emitted text an exact oracle prefix) —
+    never hangs, never silently truncates, and the page/slot pools stay
+    conserved through forced preemption storms and driver resets."""
+    master = np.random.RandomState(0xDEFEC8)
+    t0 = time.perf_counter()
+    for ep in range(CHAOS_EPISODES):
+        seed = int(master.randint(0, 2**31))
+        rng = np.random.RandomState(seed)
+        core_kw = _core_kw(rng)
+        specs = _gen_specs(rng, core_kw)
+        chaos_spec = _CHAOS_MENUS[int(rng.randint(0, len(_CHAOS_MENUS)))]
+        err = _run_episode(seed, specs, core_kw, chaos_spec=chaos_spec)
+        if err:
+            pytest.fail(f"chaos episode {ep}: "
+                        + _shrink(seed, specs, core_kw, err,
+                                  chaos_spec=chaos_spec))
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 120, f"chaos fuzz too slow for CI: {elapsed:.0f}s"

@@ -628,6 +628,97 @@ def test_parse_error_is_a_finding():
 # ---------------------------------------------------------------------------
 # devtime-fence
 # ---------------------------------------------------------------------------
+# retry-discipline
+# ---------------------------------------------------------------------------
+
+def test_retry_discipline_flags_unbounded_spin_retry():
+    src = """
+    def connect_forever(sock):
+        while True:
+            try:
+                return sock.connect()
+            except Exception:
+                continue
+    """
+    fnd = findings_for(src, only="retry-discipline")
+    assert len(fnd) == 1
+    assert "while True" in fnd[0].message
+
+
+def test_retry_discipline_flags_network_retry_without_backoff():
+    src = """
+    import requests
+
+    def fetch(url):
+        for attempt in range(4):
+            try:
+                return requests.get(url, timeout=5)
+            except Exception:
+                pass
+    """
+    fnd = findings_for(src, only="retry-discipline")
+    assert len(fnd) == 1
+    assert "backoff" in fnd[0].message
+
+
+def test_retry_discipline_clean_with_backoff_or_policy():
+    src = """
+    import time
+    import requests
+
+    def fetch_backoff(url):
+        for attempt in range(4):
+            try:
+                return requests.get(url, timeout=5)
+            except Exception:
+                time.sleep(min(2 ** attempt, 30))
+
+    def fetch_policy(url, policy):
+        for attempt in range(4):
+            if attempt and not policy.before_retry(attempt):
+                break
+            try:
+                return requests.get(url, timeout=5)
+            except Exception:
+                pass
+    """
+    assert findings_for(src, only="retry-discipline") == []
+
+
+def test_retry_discipline_exempts_pump_and_reprompt_loops():
+    # a queue consumer skipping bad items is not a retry loop; an LLM
+    # re-prompt loop (no HTTP in the try) is feedback, not transport retry;
+    # a handler that DELIVERS the error to a waiter is a pump too
+    src = """
+    def consume(q):
+        while True:
+            item = q.get()
+            try:
+                handle(item)
+            except Exception:
+                continue
+
+    def reprompt(llm, msg):
+        for attempt in range(3):
+            try:
+                return parse(llm.chat(msg))
+            except Exception:
+                msg = msg + " (fix the JSON)"
+
+    def dispatcher(pending):
+        while True:
+            batch = take(pending)
+            try:
+                run(batch)
+            except Exception as exc:
+                for p in batch:
+                    p.event.set()
+                continue
+    """
+    assert findings_for(src, only="retry-discipline") == []
+
+
+# ---------------------------------------------------------------------------
 
 def test_devtime_fence_flags_both_fence_forms():
     src = """
@@ -675,6 +766,8 @@ def test_every_registered_rule_has_a_firing_fixture():
         "import requests\nx = requests.get('u')\n",
         "try:\n    pass\nexcept Exception:\n    pass\n",
         "import jax\njax.block_until_ready(x)\n",
+        "while True:\n    try:\n        connect()\n"
+        "    except Exception:\n        continue\n",
     ]
     for src in snippets:
         fired |= {f.rule for f in analyze_source("s.py", src)}
