@@ -37,6 +37,16 @@ bench-disagg:
 bench-chaos:
 	$(TEST_ENV) python bench.py --chaos
 
+# Decode roofline round: the ROADMAP item-2 ledger loop — decode phases +
+# the APP_DEVTIME=on attribution pass; emits one JSON line with
+# spec_tokens_per_step / padding_waste_frac / hbm_weight_read_util /
+# devtime_by_program (docs/performance.md "Decode roofline"). Runs the
+# tiny CPU config under TEST_ENV; run `python bench.py --roofline` in the
+# default env for the real chip.
+.PHONY: bench-roofline
+bench-roofline:
+	$(TEST_ENV) python bench.py --roofline
+
 dryrun:
 	$(TEST_ENV) XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	  python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"

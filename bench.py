@@ -337,6 +337,16 @@ def _kernel_microbench(on_tpu: bool, reps: int = None) -> dict:
     covering all rows. Every timed quantity is host-observed (a value fetch
     closes each rep — block_until_ready lies over the tunnel), and times
     are medians over reps of pre-compiled callables.
+
+    Each mix runs over BOTH pool dtypes (``mixes`` = bf16/f32 pool,
+    ``mixes_int8`` = int8 pool + f32 per-token-per-head scales), so the
+    quantized ragged-kernel read is MEASURED, not assumed. Note the
+    asymmetry the int8 numbers expose: the paged/ragged kernels read int8
+    pages natively (the dequant is algebra folded past the dots — scores
+    and probabilities are row-scaled, K/V elements never dequantize), while
+    the two-dispatch path's chunk attention runs over a dense gather that
+    DOES dequantize per element (the XLA-fallback read shape,
+    kv_cache._gather_dense).
     """
     import numpy as np
     from generativeaiexamples_tpu.ops import pallas as pallas_ops
@@ -356,6 +366,17 @@ def _kernel_microbench(on_tpu: bool, reps: int = None) -> dict:
     r_ = lambda shape: jnp.asarray(rng.standard_normal(shape), dtype)
     k_pages = r_((P, ps, KV * HD))
     v_pages = r_((P, ps, KV * HD))
+    # int8 pool: per-token-per-head symmetric scales in the engine's
+    # (rows, KV, page) scale layout (kv_cache._kv_quantize + transpose)
+    def quantize_pool(pages):
+        shaped = np.asarray(pages, np.float32).reshape(P, ps, KV, HD)
+        s = np.max(np.abs(shaped), axis=-1) / 127.0          # (P, ps, KV)
+        safe = np.maximum(s, 1e-10)
+        q = np.clip(np.round(shaped / safe[..., None]), -127, 127)
+        return (jnp.asarray(q.reshape(P, ps, KV * HD), jnp.int8),
+                jnp.asarray(s.transpose(0, 2, 1), jnp.float32))  # (P,KV,ps)
+    k_q, k_s = quantize_pool(k_pages)
+    v_q, v_s = quantize_pool(v_pages)
     table = jnp.asarray(
         rng.permutation(np.arange(1, B * maxp + 1)).reshape(B, maxp),
         jnp.int32)
@@ -377,58 +398,79 @@ def _kernel_microbench(on_tpu: bool, reps: int = None) -> dict:
             walls.append(time.perf_counter() - t0)
         return statistics.median(walls)
 
-    paged = jax.jit(lambda q, lens: pallas_ops.paged_decode(
-        q, k_pages, v_pages, table, lens))
-    ragged = jax.jit(lambda q, tb, lens, p0, qn: pallas_ops.
-                     ragged_paged_attention(q, k_pages, v_pages, tb, lens,
-                                            p0, qn))
+    def run_mixes(kp, vp, scales) -> dict:
+        ks, vs = scales if scales is not None else (None, None)
+        paged = jax.jit(lambda q, lens: pallas_ops.paged_decode(
+            q, kp, vp, table, lens, k_scales=ks, v_scales=vs))
+        ragged = jax.jit(lambda q, tb, lens, p0, qn: pallas_ops.
+                         ragged_paged_attention(q, kp, vp, tb, lens,
+                                                p0, qn, k_scales=ks,
+                                                v_scales=vs))
 
-    def chunk_prefill(qc):
-        # the two-dispatch engine's chunk attention: dense gather + flash
-        k_dense = k_pages[chunk_row].reshape(1, maxp * ps, KV, HD)
-        v_dense = v_pages[chunk_row].reshape(1, maxp * ps, KV, HD)
-        return pallas_ops.flash_prefill(
-            qc.reshape(1, C, H, HD), k_dense, v_dense,
-            kv_valid_through=jnp.asarray([C], jnp.int32))
-    chunk_fn = jax.jit(chunk_prefill)
+        def chunk_prefill(qc):
+            # the two-dispatch engine's chunk attention: dense gather +
+            # flash — for an int8 pool this gather DEQUANTIZES per element
+            # (exactly what kv_cache._gather_dense does on the fallback)
+            if scales is not None:
+                sT = lambda sc: (sc[chunk_row].transpose(0, 2, 1)
+                                 .reshape(1, maxp * ps, KV))
+                k_dense = (kp[chunk_row].reshape(1, maxp * ps, KV, HD)
+                           .astype(jnp.float32) * sT(ks)[..., None]
+                           ).astype(dtype)
+                v_dense = (vp[chunk_row].reshape(1, maxp * ps, KV, HD)
+                           .astype(jnp.float32) * sT(vs)[..., None]
+                           ).astype(dtype)
+            else:
+                k_dense = kp[chunk_row].reshape(1, maxp * ps, KV, HD)
+                v_dense = vp[chunk_row].reshape(1, maxp * ps, KV, HD)
+            return pallas_ops.flash_prefill(
+                qc.reshape(1, C, H, HD), k_dense, v_dense,
+                kv_valid_through=jnp.asarray([C], jnp.int32))
+        chunk_fn = jax.jit(chunk_prefill)
 
-    results = {}
-    for name, n_active in (("decode_only", B), ("mixed", B),
-                           ("sparse_mixed", max(1, B // 4))):
-        with_chunk = name != "decode_only"
-        active = jnp.arange(B) < n_active
-        lens = jnp.where(active, lens_full, 0)
-        sep = timed(paged, q_dec, jnp.maximum(lens, 1))
-        if with_chunk:
-            sep += timed(chunk_fn, q_ch)
-        # ragged: decode rows (q_num = active?1:0) + chunk rows
-        q_rows = jnp.concatenate(
-            [jnp.pad(q_dec, ((0, 0), (0, Qb - 1), (0, 0), (0, 0)))]
-            + ([q_ch] if with_chunk else []))
-        tb = jnp.concatenate(
-            [table] + ([jnp.broadcast_to(chunk_row[None],
-                                         (C // Qb, maxp))] if with_chunk
-                       else []))
-        jr = jnp.arange(C // Qb, dtype=jnp.int32)
-        lens_r = jnp.concatenate(
-            [jnp.maximum(lens, 1)]
-            + ([jnp.full((C // Qb,), C, jnp.int32)] if with_chunk else []))
-        p0 = jnp.concatenate(
-            [jnp.maximum(lens, 1) - 1] + ([jr * Qb] if with_chunk else []))
-        qn = jnp.concatenate(
-            [active.astype(jnp.int32)]
-            + ([jnp.full((C // Qb,), Qb, jnp.int32)] if with_chunk else []))
-        rag = timed(ragged, q_rows, tb, lens_r, p0, qn)
-        results[name] = {
-            "separate_ms": round(sep * 1e3, 3),
-            "ragged_ms": round(rag * 1e3, 3),
-            "ragged_speedup": round(sep / rag, 3) if rag else None,
-        }
+        results = {}
+        for name, n_active in (("decode_only", B), ("mixed", B),
+                               ("sparse_mixed", max(1, B // 4))):
+            with_chunk = name != "decode_only"
+            active = jnp.arange(B) < n_active
+            lens = jnp.where(active, lens_full, 0)
+            sep = timed(paged, q_dec, jnp.maximum(lens, 1))
+            if with_chunk:
+                sep += timed(chunk_fn, q_ch)
+            # ragged: decode rows (q_num = active?1:0) + chunk rows
+            q_rows = jnp.concatenate(
+                [jnp.pad(q_dec, ((0, 0), (0, Qb - 1), (0, 0), (0, 0)))]
+                + ([q_ch] if with_chunk else []))
+            tb = jnp.concatenate(
+                [table] + ([jnp.broadcast_to(chunk_row[None],
+                                             (C // Qb, maxp))] if with_chunk
+                           else []))
+            jr = jnp.arange(C // Qb, dtype=jnp.int32)
+            lens_r = jnp.concatenate(
+                [jnp.maximum(lens, 1)]
+                + ([jnp.full((C // Qb,), C, jnp.int32)] if with_chunk
+                   else []))
+            p0 = jnp.concatenate(
+                [jnp.maximum(lens, 1) - 1]
+                + ([jr * Qb] if with_chunk else []))
+            qn = jnp.concatenate(
+                [active.astype(jnp.int32)]
+                + ([jnp.full((C // Qb,), Qb, jnp.int32)] if with_chunk
+                   else []))
+            rag = timed(ragged, q_rows, tb, lens_r, p0, qn)
+            results[name] = {
+                "separate_ms": round(sep * 1e3, 3),
+                "ragged_ms": round(rag * 1e3, 3),
+                "ragged_speedup": round(sep / rag, 3) if rag else None,
+            }
+        return results
+
     return {
         "shapes": {"slots": B, "page": ps, "heads": H, "kv_heads": KV,
                    "head_dim": HD, "chunk": C, "q_block": Qb, "reps": reps},
         "device": str(jax.devices()[0]),
-        "mixes": results,
+        "mixes": run_mixes(k_pages, v_pages, None),
+        "mixes_int8": run_mixes(k_q, v_q, (k_s, v_s)),
     }
 
 
@@ -568,6 +610,161 @@ def run_disagg_round(n_workers: int = 3, n_requests: int = 12,
         for p in procs:
             if p.poll() is None:
                 os.killpg(p.pid, signal.SIGKILL)
+
+
+def run_roofline_round() -> dict:
+    """Decode roofline round (`bench.py --roofline` / `make bench-roofline`):
+    ROADMAP item 2's measure→close→re-measure loop as ONE JSON line.
+
+    Runs only the phases that exercise the decode path — a 2x-oversubscribed
+    throughput phase plus the APP_DEVTIME=on attribution pass — and reports
+    the roofline scoreboard next to the levers' own signals: the adaptive
+    spec-width controller's input (``spec_tokens_per_step``, the
+    ``spec_accept_len`` histogram) and the ladder rungs it can pick, the
+    batch-width ladder's scoreboard (``padding_waste_frac`` from the
+    ledger's padded-vs-useful counts), ``mfu`` / ``hbm_weight_read_util``
+    from the shared perfmodel, mid-serving ``recompiles_total`` (must stay
+    0 across ladder transitions), and ``devtime_by_program`` proving where
+    the remaining gap lives. Knobs for A/B sweeps: BENCH_SPEC_ADAPTIVE,
+    BENCH_WIDTH_LADDER (on|off), BENCH_SPEC_DRAFT, BENCH_QUANT.
+    """
+    import os
+    import random as _rnd
+
+    on_tpu = jax.default_backend() == "tpu"
+    quant = os.environ.get("BENCH_QUANT", "int8" if on_tpu else "none")
+    spec_draft = int(os.environ.get("BENCH_SPEC_DRAFT", "4"))
+    adaptive = os.environ.get("BENCH_SPEC_ADAPTIVE", "on")
+    ladder = os.environ.get("BENCH_WIDTH_LADDER", "on")
+    common = dict(quant=quant,
+                  spec_decode="on" if spec_draft else "off",
+                  spec_draft=max(spec_draft, 0) or 1,
+                  spec_adaptive=adaptive, decode_width_ladder=ladder)
+    if on_tpu:
+        model_cfg = llama.LlamaConfig(
+            vocab_size=128256, dim=3072, n_layers=28, n_heads=24,
+            n_kv_heads=8, hidden_dim=8192, head_dim=128,
+            tie_embeddings=True, dtype="bfloat16")
+        ecfg = EngineConfig(max_batch_size=16, max_seq_len=1536,
+                            page_size=128, prefill_chunk=512,
+                            prefill_group=8, prefill_hold_chunks=32,
+                            kv_quant="int8" if quant == "int8" else "none",
+                            **common)
+        thr_prompts = [480] * 20 + [1200] * 6 + [96] * 6
+        max_tokens, warm_lens = 96, (128, 480, 1200)
+    else:
+        model_cfg = llama.LlamaConfig.tiny(vocab_size=300)
+        ecfg = EngineConfig(max_batch_size=4, max_seq_len=512,
+                            page_size=16, prefill_chunk=32, **common)
+        thr_prompts = [24] * 6 + [70] * 2
+        max_tokens, warm_lens = 12, (24, 70)
+
+    tok = ByteTokenizer()
+    params = llama.init_params(jax.random.PRNGKey(0), model_cfg)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    core = EngineCore(model_cfg, ecfg, params, eos_id=tok.eos_id)
+    core.warmup()
+    sched = Scheduler(core, tok)
+    sched.start()
+
+    # the main bench's prompt shape: a shared two-page prefix + a
+    # pseudo-random body, so the drafter and cache see realistic structure
+    prefix = [32 + (i * 7) % 90 for i in range(2 * ecfg.page_size)]
+    counter = [0]
+
+    def make_req(n_prompt: int) -> Request:
+        counter[0] += 1
+        body_rng = _rnd.Random(20_000 + counter[0])
+        n_body = max(1, n_prompt - len(prefix))
+        ids = (prefix[:max(0, n_prompt - n_body)]
+               + [32 + body_rng.randrange(90) for _ in range(n_body)])
+        return Request(prompt_ids=ids, max_tokens=max_tokens,
+                       temperature=0.0)
+
+    warm = [make_req(n) for n in warm_lens]
+    for req in warm:
+        sched.submit(req)
+    for req in warm:
+        for _ in sched.iter_text(req):
+            pass
+
+    recomp0 = REGISTRY.counter("engine_recompiles_total").value
+    steps0 = REGISTRY.counter("decode_steps").value
+    gen0 = REGISTRY.counter("tokens_generated").value
+    spec0 = REGISTRY.counter("spec_bonus_tokens").value
+    base0 = REGISTRY.counter("spec_base_steps").value
+    thr_reqs = [make_req(n) for n in thr_prompts]
+    wall = _run_load(sched, thr_reqs)
+    decode_steps = REGISTRY.counter("decode_steps").value - steps0
+    emitted = REGISTRY.counter("tokens_generated").value - gen0
+    spec_bonus = REGISTRY.counter("spec_bonus_tokens").value - spec0
+    spec_base = REGISTRY.counter("spec_base_steps").value - base0
+
+    # attribution pass: mode=on fences every dispatch — full per-program
+    # split without perturbing the timed phase above
+    prior_mode = DEVTIME.mode
+    DEVTIME.reset(keep_warm=True)
+    DEVTIME.configure(mode="on")
+    DEVTIME.attach_perf(perfmodel.PerfModel.build(
+        n_params, ecfg.quant,
+        jax.dtypes.canonicalize_dtype(model_cfg.jdtype).itemsize,
+        device=jax.devices()[0]))
+    att_reqs = [make_req(n)
+                for n in thr_prompts[:max(4, ecfg.max_batch_size)]]
+    att_wall = _run_load(sched, att_reqs)
+    dt_snap = DEVTIME.snapshot()
+    DEVTIME.configure(mode=prior_mode)
+    flight_now = sched._flight_fields()
+    sched.stop()
+
+    errors = [r.error for r in thr_reqs + att_reqs if r.error]
+    if errors:
+        raise RuntimeError(f"roofline round failed requests: {errors[:3]}")
+
+    dt_by_prog: dict = {}
+    for row in dt_snap["programs"]:
+        agg = dt_by_prog.setdefault(row["program"],
+                                    {"count": 0, "device_s": 0.0,
+                                     "tokens": 0, "padded_tokens": 0})
+        agg["count"] += row["count"]
+        agg["device_s"] = round(agg["device_s"] + row["device_s"], 4)
+        agg["tokens"] += row["tokens"]
+        agg["padded_tokens"] += row["padded_tokens"]
+    accept_h = REGISTRY.histogram("spec_accept_len")
+    gen_tokens = sum(r.completion_tokens for r in thr_reqs)
+    prompt_tokens = sum(len(r.prompt_ids) for r in thr_reqs)
+    analytic = analytic_totals(
+        n_params, ecfg.quant,
+        jax.dtypes.canonicalize_dtype(model_cfg.jdtype).itemsize,
+        prompt_tokens, gen_tokens, int(decode_steps), wall,
+        device=jax.devices()[0])
+    return {
+        "gen_tok_s_2x_load": round(gen_tokens / wall, 1) if wall else 0.0,
+        "decode_steps": int(decode_steps),
+        "spec_adaptive": adaptive,
+        "spec_widths": list(getattr(core, "spec_widths", ())),
+        "decode_widths": list(getattr(core, "decode_widths", ())),
+        "spec_bonus_frac": round(spec_bonus / emitted, 4) if emitted else 0,
+        "spec_tokens_per_step": (round((spec_base + spec_bonus) / spec_base,
+                                       3) if spec_base else 1.0),
+        "spec_accept_len_mean": (round(accept_h.sum / accept_h.count, 3)
+                                 if accept_h.count else None),
+        "padding_waste_frac": flight_now["padding_waste_frac"],
+        "mixed_dispatch_frac": flight_now["mixed_dispatch_frac"],
+        "ragged_row_util": flight_now["ragged_row_util"],
+        "mfu": (round(analytic["mfu"], 4)
+                if analytic["mfu"] is not None else None),
+        "hbm_weight_read_util": (round(analytic["hbm_weight_read_util"], 4)
+                                 if analytic["hbm_weight_read_util"]
+                                 is not None else None),
+        "devtime_wall_s": round(att_wall, 4),
+        "devtime_by_program": dt_by_prog,
+        "devtime_padding_waste_frac": dt_snap["padding_waste_frac"],
+        "recompiles_total": dt_snap["recompiles_total"],
+        "recompiles_delta": int(
+            REGISTRY.counter("engine_recompiles_total").value - recomp0),
+        "device": str(jax.devices()[0]),
+    }
 
 
 CHAOS_SEED = 1337
@@ -749,6 +946,12 @@ def main() -> None:
     if "--kernel-bench" in sys.argv:
         print(json.dumps({"metric": "ragged_kernel_bench",
                           **_kernel_microbench(on_tpu)}))
+        return
+    if "--roofline" in sys.argv:
+        # decode roofline round (`make bench-roofline`): the ROADMAP item-2
+        # ledger loop — decode phases + attribution pass, one JSON line
+        print(json.dumps({"metric": "decode_roofline",
+                          **run_roofline_round()}))
         return
     if "--chaos" in sys.argv:
         # chaos resilience round (`make bench-chaos`): goodput + p99 TTFT
@@ -1092,22 +1295,30 @@ def main() -> None:
         "mixed_phase_dispatch": "on" if core.mixed_supported else "off",
         "mixed_dispatch_frac": flight_now["mixed_dispatch_frac"],
         "ragged_row_util": flight_now["ragged_row_util"],
-        # ragged vs separate dispatches at a few raggedness mixes (the
-        # kernel microbench; `python bench.py --kernel-bench` for the
-        # standalone mode). Skipped under BENCH_FAST: its ~5 fresh compiles
-        # defeat the quick-iteration mode's purpose.
-        "kernel_bench": None if fast else _kernel_microbench(
-            on_tpu, reps=None if on_tpu else 2)["mixes"],
+        # ragged vs separate dispatches at a few raggedness mixes, over
+        # BOTH pool dtypes (the kernel microbench; `python bench.py
+        # --kernel-bench` for the standalone mode). Skipped under
+        # BENCH_FAST: its fresh compiles defeat the quick-iteration mode.
+        "kernel_bench": None if fast else {
+            k: v for k, v in _kernel_microbench(
+                on_tpu, reps=None if on_tpu else 2).items()
+            if k in ("mixes", "mixes_int8")},
         # per-step distributions from the flight recorder ring (windowed to
         # the throughput phase) — batch_occupancy above is the phase MEAN,
         # these show how the fill/queue actually moved through the phase
         **flight_stats,
         # speculation transparency: fraction of throughput-phase tokens
         # that were accepted drafts, and mean tokens per participating
-        # step-slot (1.0 = no speculation wins)
+        # step-slot (1.0 = no speculation wins). The width ladders the
+        # adaptive controller and batch-width picker can choose from, and
+        # the ledger's padded-vs-useful waste fraction, ride alongside —
+        # the decode-roofline levers' own scoreboard (ROADMAP item 2)
         "spec_bonus_frac": round(spec_bonus / emitted, 4) if emitted else 0,
         "spec_tokens_per_step": (round((spec_base + spec_bonus) / spec_base, 3)
                                  if spec_base else 1.0),
+        "spec_widths": list(getattr(core, "spec_widths", ())),
+        "decode_widths": list(getattr(core, "decode_widths", ())),
+        "padding_waste_frac": flight_now["padding_waste_frac"],
         # prefix-cache coverage of the THROUGHPUT phase's prompt tokens
         # (same delta window as the spec/occupancy metrics above)
         "prefix_hit_frac": (round(pfx_hits / prompt_tokens, 4)

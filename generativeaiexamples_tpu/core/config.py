@@ -265,8 +265,11 @@ class EngineConfig:
     quant: str = configfield("quant", default="none", help_txt="Weight quantization: none | int8 (per-channel weight-only; halves weight HBM reads — the decode bottleneck — and fits 8B-class weights on one v5e chip).")
     kv_quant: str = configfield("kv_quant", default="none", help_txt="KV-cache quantization: none | int8 (per-token-per-head scales, dequant folded past the attention dots — TRT-LLM kv-cache-quant parity). Halves the pool's HBM footprint and measured +5% decode throughput on v5e (round 4).")
     spec_decode: str = configfield("spec_decode", default="on", help_txt="Prompt-lookup speculative decoding: on | off. Each decode step drafts spec_draft tokens from the request's own token history (n-gram continuation — RAG outputs quote their context) and verifies them in one widened step; decode is weight-read-bound, so accepted drafts are nearly free tokens. Output is token-identical to non-speculative decoding (exact-match acceptance under the per-request seed).")
-    spec_draft: int = configfield("spec_draft", default=4, help_txt="Drafted tokens verified per decode step when spec_decode=on (the widened step processes 1+spec_draft positions per slot).")
+    spec_draft: int = configfield("spec_draft", default=4, help_txt="Drafted tokens verified per decode step when spec_decode=on (the widened step processes 1+spec_draft positions per slot). With spec_adaptive=on this is the CEILING of the width ladder, not a fixed width.")
     spec_ngram: int = configfield("spec_ngram", default=2, help_txt="Suffix n-gram length matched against the request's history to locate a draft continuation.")
+    spec_adaptive: str = configfield("spec_adaptive", default="on", help_txt="Acceptance-tuned speculative width: on (default) | off. Each slot's draft length is capped by a trailing acceptance EMA (fed by the spec_accept_len signal) and the dispatch compiles at the smallest pow2-ish width-ladder rung covering every slot's cap — warmup pre-compiles every rung, so width changes never recompile mid-serving. Output is token-identical to the static width by construction (exact-match acceptance under the per-request seed); only wasted/won verify positions change. off = every dispatch runs the full 1+spec_draft width (the pre-r06 behavior).")
+    spec_draft_max: int = configfield("spec_draft_max", default=0, help_txt="Ceiling of the adaptive width ladder in drafted tokens; 0 = auto (2 x spec_draft when spec_adaptive=on, else spec_draft). High-acceptance slots (quoting RAG answers) climb past the configured spec_draft up to this ceiling — the r05 static draft was wrong in BOTH directions.")
+    decode_width_ladder: str = configfield("decode_width_ladder", default="on", help_txt="Batch-width ladder for PURE-decode dispatches: on (default) | off. At low occupancy the decode program runs at the smallest pre-compiled width rung covering the highest live slot (slots are allocated lowest-id-first so the live set compacts), shrinking the padded (batch x spec_width) token block the ledger reports as engine_padding_waste_frac. Mixed-phase dispatches keep the full width (their padding is already filled by fused prefill chunks). Warmup pre-compiles every rung; ladder transitions never recompile mid-serving.")
     max_adapters: int = configfield("max_adapters", default=4, help_txt="Resident LoRA adapter slots for per-request multi-adapter serving (slot 0 is the base model). Requests select an adapter by registered name (OpenAI `model` field); one decode batch mixes adapters freely.")
     model_family: str = configfield("model_family", default="llama3-8b", help_txt="Served model architecture (models.model_configs name, same names as the train CLI); APP_LLM_MODEL_NAME stays the cosmetic OpenAI model id.")
     long_prefill: str = configfield("long_prefill", default="auto", help_txt="Sequence-parallel whole-prompt prefill for multi-chunk prompts: auto (when the mesh has a seq axis) | off. One ring-attention pass replaces the chunk loop; decode does not interleave during it, but the pass is seq-axis-times faster.")

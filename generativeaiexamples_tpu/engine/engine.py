@@ -231,8 +231,44 @@ class EngineCore:
         if engine_cfg.spec_draft < 0:
             raise ValueError(f"spec_draft ({engine_cfg.spec_draft}) must be "
                              ">= 0 (0 disables drafting)")
-        self.spec_width = (1 + engine_cfg.spec_draft
-                           if engine_cfg.spec_decode == "on" else 1)
+        # acceptance-tuned speculative width (ROADMAP item 2): the dispatch
+        # width is chosen per tick from a pow2-ish DRAFT ladder (1, 2, 4,
+        # …, spec_draft_max) instead of always running the static
+        # spec_draft — the scheduler caps each slot's draft by its
+        # trailing acceptance EMA and picks the smallest rung covering
+        # every cap; high-acceptance slots climb PAST the configured draft
+        # up to the ceiling (the r05 static draft was wrong in both
+        # directions). Every rung is a separate XLA compile, so the ladder
+        # stays small and warmup pre-compiles all of it (zero mid-serving
+        # recompiles, test-pinned). spec_width is the CEILING (1 + the
+        # widest draft) — q_block sizing and the scheduler's page-growth
+        # horizon derive from it.
+        adaptive = str(getattr(engine_cfg, "spec_adaptive", "on")
+                       or "on").strip().lower()
+        if adaptive not in ("on", "off"):
+            raise ValueError(f"engine.spec_adaptive must be on|off, "
+                             f"got {adaptive!r}")
+        dmax = int(getattr(engine_cfg, "spec_draft_max", 0) or 0)
+        if dmax < 0:
+            raise ValueError(f"spec_draft_max ({dmax}) must be >= 0")
+        if engine_cfg.spec_decode != "on" or engine_cfg.spec_draft == 0:
+            self.spec_width = 1
+            self.spec_widths = (1,)
+        elif adaptive == "off":
+            self.spec_width = 1 + engine_cfg.spec_draft
+            self.spec_widths = (self.spec_width,)
+        else:
+            dmax = dmax or 2 * engine_cfg.spec_draft
+            if dmax < engine_cfg.spec_draft:
+                raise ValueError(
+                    f"spec_draft_max ({dmax}) must cover spec_draft "
+                    f"({engine_cfg.spec_draft})")
+            drafts, d = {engine_cfg.spec_draft, dmax}, 1
+            while d < dmax:
+                drafts.add(d)
+                d *= 2
+            self.spec_width = 1 + dmax
+            self.spec_widths = tuple(sorted(1 + d for d in drafts))
         self.max_pages_per_slot = -(-self.max_seq // self.page_size)
         # total physical pages: 0 = full slot capacity (+ null page 0)
         self.num_pages = (engine_cfg.num_pages or
@@ -245,6 +281,29 @@ class EngineCore:
             b *= 2
         buckets.append(self.chunk)
         self.buckets = tuple(buckets)
+
+        # ledger-driven decode batch-width ladder (ROADMAP item 2): the
+        # pure-decode program also compiles at narrower slot widths (same
+        # pattern as group_buckets), so a dispatch over 3 live slots of a
+        # 16-slot engine stops padding a (16 x W) token block — the waste
+        # the devtime ledger's padded-vs-useful counts price as
+        # engine_padding_waste_frac. Rungs: the full batch plus up to two
+        # pow2 sub-widths (floor 2); the scheduler allocates slots
+        # lowest-id-first so the live set compacts into the narrow rungs.
+        ladder = str(getattr(engine_cfg, "decode_width_ladder", "on")
+                     or "on").strip().lower()
+        if ladder not in ("on", "off"):
+            raise ValueError(f"engine.decode_width_ladder must be on|off, "
+                             f"got {ladder!r}")
+        if ladder == "off" or self.batch <= 2:
+            self.decode_widths = (self.batch,)
+        else:
+            # two rungs keep the warmup grid bounded: the full batch plus
+            # the largest pow2 strictly below it (half, for pow2 batches)
+            p = 1
+            while p * 2 < self.batch:
+                p *= 2
+            self.decode_widths = tuple(sorted({self.batch, p}))
 
         # ---- mixed-phase dispatch gate (ragged paged attention) ----------
         # Resolved ONCE here, failing loudly — the config gate must never
@@ -429,9 +488,9 @@ class EngineCore:
         self._chunk_last_fn = jax.jit(self._chunk_last_impl,
                                       donate_argnums=dn)
         self._decode_fn = jax.jit(self._decode_impl, donate_argnums=dn,
-                                  static_argnums=(9, 10, 11))
+                                  static_argnums=(10, 11, 12, 13, 14))
         self._mixed_fn = jax.jit(self._mixed_impl, donate_argnums=dn,
-                                 static_argnums=(22, 23, 24))
+                                 static_argnums=(24, 25, 26, 27))
         self._activate_fn = jax.jit(self._activate_impl, donate_argnums=dn)
         self._release_fn = jax.jit(self._release_impl, donate_argnums=dn)
         self._seed_hist_fn = jax.jit(self._seed_history_impl,
@@ -448,6 +507,31 @@ class EngineCore:
         return perfmodel.PerfModel(
             n_params=self.n_params, param_bytes=self.param_bytes,
             peak_flops=peak_flops, peak_bw=peak_bw)
+
+    # ------------------------------------------------- ledger bucket names
+
+    def decode_bucket(self, steps: int, spec_width: Optional[int] = None,
+                      width: Optional[int] = None) -> str:
+        """Canonical devtime-ledger bucket of a pure-decode compile unit.
+        Width parts appear ONLY when the corresponding ladder has more than
+        one rung (a single-rung engine's keys stay the historical
+        ``s<K>``), so the scheduler's commits and warmup's mark_warm can
+        never fork the key space."""
+        parts = [f"s{steps}"]
+        if len(self.spec_widths) > 1:
+            parts.append(f"w{spec_width or self.spec_widths[-1]}")
+        if len(self.decode_widths) > 1:
+            parts.append(f"b{width or self.batch}")
+        return "".join(parts)
+
+    def mixed_bucket(self, group: int, steps: int) -> str:
+        """Canonical ledger bucket of a mixed-phase compile unit. Mixed
+        dispatches always run the full batch width AND the ceiling spec
+        width: fused chunks already fill the rows a narrow batch rung
+        would cut, and under pallas the ragged kernel pads every decode
+        row to q_block regardless of W — narrowing would only cut
+        accepted drafts, never padding. One compile per (G, K)."""
+        return f"g{group}s{steps}"
 
     # ------------------------------------------------------------------ state
 
@@ -1071,19 +1155,34 @@ class EngineCore:
                 # scheduler gates it off): skip the whole decode/mixed
                 # compile grid — most of a unified worker's warmup time
                 continue
+            # every (steps x spec-width x batch-width) rung the adaptive
+            # controllers can pick — width-ladder transitions must never
+            # pay an XLA compile mid-serving (test-pinned). The grammar
+            # variant compiles at the CEILING width and full batch only
+            # (the scheduler pins grammared dispatches there — a minority
+            # of traffic is not worth ladder x grammar compiles).
             for steps in steps_list:
-                state, out = self.decode(state, table, steps,
-                                         use_grammar=bool(gs))
-                last_out = out["packed"]
+                if gs:
+                    state, out = self.decode(state, table, steps,
+                                             use_grammar=True)
+                    last_out = out["packed"]
+                    continue
+                for wi in self.spec_widths:
+                    for bw in self.decode_widths:
+                        state, out = self.decode(state, table, steps,
+                                                 spec_width=wi, width=bw)
+                        last_out = out["packed"]
             if self.mixed_supported:
                 # the mixed-phase program at EVERY depth the adaptive
                 # scheduler can pick, in BOTH grammar modes — a grammared
                 # slot decoding when a plain prompt is admitted dispatches
                 # decode_mixed(use_grammar=True), which must not pay its
-                # compile mid-serving. ``is_last`` rides as data (one
-                # compile serves mid/final chunks); the single-chunk and
-                # full-group buckets warm here, intermediate buckets
-                # compile lazily like narrower page-pressure depths
+                # compile mid-serving. ``is_last`` and ``gram_states``
+                # ride as data (one compile serves any mid/final/grammared
+                # mix); spec/batch width ladders do NOT apply to mixed
+                # (see mixed_bucket); the single-chunk and full-group
+                # buckets warm here, intermediate buckets compile lazily
+                # like narrower page-pressure depths
                 for g in sorted({1, self.group_buckets[-1]}):
                     items = [PrefillItem(
                         chunk_ids=[1] * min(4, self.chunk),
@@ -1115,11 +1214,19 @@ class EngineCore:
             if self.role == "prefill":
                 continue
             for steps in steps_list:
-                DEVTIME.mark_warm(f"decode{suffix}", f"s{steps}")
+                if gs:
+                    DEVTIME.mark_warm(f"decode{suffix}",
+                                      self.decode_bucket(steps))
+                    continue
+                for wi in self.spec_widths:
+                    for bw in self.decode_widths:
+                        DEVTIME.mark_warm(f"decode{suffix}",
+                                          self.decode_bucket(steps, wi, bw))
             if self.mixed_supported:
                 for g in sorted({1, self.group_buckets[-1]}):
                     for steps in steps_list:
-                        DEVTIME.mark_warm(f"mixed{suffix}", f"g{g}s{steps}")
+                        DEVTIME.mark_warm(f"mixed{suffix}",
+                                          self.mixed_bucket(g, steps))
         # the throwaway pool frees here; callers init the real state after
 
     # --------------------------------------------------------- slot lifecycle
@@ -1413,17 +1520,24 @@ class EngineCore:
 
     def _decode_step_fn(self, params, adapters, page_table, gram_table,
                         gram_accept, gram_dist, tok_bytes, tok_lens,
-                        use_grammar: bool, want_top: bool):
+                        use_grammar: bool, want_top: bool,
+                        spec_width: Optional[int] = None,
+                        batch: Optional[int] = None, draft_cap=None):
         """Build the one-decode-step body shared by the pure-decode scan
         (`_decode_impl`) and the mixed-phase program (`_mixed_impl`).
         Returns ``step(state, forward=None) -> (state, out)`` with out
         leaves shaped (W, B); ``forward`` overrides the model call of THIS
         step — the mixed program injects kv_cache.mixed_step as step 0's
-        forward so a prefill chunk rides the same dispatch."""
+        forward so a prefill chunk rides the same dispatch. ``spec_width``
+        (static) selects a width-ladder rung; ``batch`` (static) the slot
+        width this program runs over (< self.batch for a narrow-rung
+        pure-decode dispatch — the state/table the caller passes are
+        already sliced); ``draft_cap`` is the traced (batch,) per-slot
+        draft budget of the adaptive controller (None = uncapped)."""
         from generativeaiexamples_tpu.ops.sampling import (
             sample_logits_per_slot, token_logprob)
-        W = self.spec_width
-        B = self.batch
+        W = spec_width or self.spec_width
+        B = batch or self.batch
         batch_ix = jnp.arange(B, dtype=jnp.int32)
 
         def hist_append(history, active, cols, vals):
@@ -1521,6 +1635,11 @@ class EngineCore:
             L = state.cache.lengths
             draft, dlen = draft_lookup(state.history, L, W - 1,
                                        self.cfg.spec_ngram)
+            if draft_cap is not None:
+                # adaptive spec width: the controller's per-slot draft
+                # budget rides as traced data — capping only voids drafted
+                # positions, so the emitted stream stays token-identical
+                dlen = jnp.minimum(dlen, draft_cap)
             if use_grammar:
                 # constrained slots decode sequentially (the DFA advances
                 # one sampled token at a time); their drafts are voided
@@ -1621,31 +1740,89 @@ class EngineCore:
 
         return step_wide if W > 1 else step_narrow
 
+    def _slice_state(self, state: DecodeState, width: int
+                     ) -> DecodeState:
+        """Narrow-rung view of the per-slot state: every (B, …) leaf (and
+        the cache's lengths) sliced to the first ``width`` slots. The KV
+        pools themselves are slot-agnostic (physical pages) and ride whole."""
+        sl = lambda a: a[:width]
+        return DecodeState(
+            cache=dataclasses.replace(state.cache,
+                                      lengths=sl(state.cache.lengths)),
+            tokens=sl(state.tokens), active=sl(state.active),
+            generated=sl(state.generated), max_gen=sl(state.max_gen),
+            temperature=sl(state.temperature), top_k=sl(state.top_k),
+            top_p=sl(state.top_p), rngs=sl(state.rngs),
+            gram_state=sl(state.gram_state),
+            last_logprob=sl(state.last_logprob), history=sl(state.history),
+            adapter_ix=sl(state.adapter_ix))
+
+    def _merge_state(self, full: DecodeState, narrow: DecodeState,
+                     width: int) -> DecodeState:
+        """Scatter a narrow-rung run's per-slot results back into the full
+        state (slots >= width were untouched by construction — the width
+        rung covers every live slot)."""
+        up = lambda f, n: f.at[:width].set(n)
+        return DecodeState(
+            cache=dataclasses.replace(
+                narrow.cache,
+                lengths=up(full.cache.lengths, narrow.cache.lengths)),
+            tokens=up(full.tokens, narrow.tokens),
+            active=up(full.active, narrow.active),
+            generated=up(full.generated, narrow.generated),
+            max_gen=up(full.max_gen, narrow.max_gen),
+            temperature=up(full.temperature, narrow.temperature),
+            top_k=up(full.top_k, narrow.top_k),
+            top_p=up(full.top_p, narrow.top_p),
+            rngs=up(full.rngs, narrow.rngs),
+            gram_state=up(full.gram_state, narrow.gram_state),
+            last_logprob=up(full.last_logprob, narrow.last_logprob),
+            history=up(full.history, narrow.history),
+            adapter_ix=up(full.adapter_ix, narrow.adapter_ix))
+
     def _decode_impl(self, state: DecodeState, params, adapters, page_table,
                      gram_table, gram_accept, gram_dist, tok_bytes, tok_lens,
-                     steps: int, use_grammar: bool, want_top: bool
+                     draft_cap, steps: int, use_grammar: bool,
+                     want_top: bool, spec_width: int, width: int
                      ) -> Tuple[DecodeState, Dict[str, Any]]:
+        full = state
+        narrow = width < self.batch
+        if narrow:
+            # batch-width ladder rung: run the scan over the first `width`
+            # slots only — the scheduler guarantees every live slot is
+            # below the rung (lowest-id-first allocation) — then scatter
+            # the per-slot results back into the full state
+            state = self._slice_state(state, width)
+            page_table = page_table[:width]
+            draft_cap = draft_cap[:width] if draft_cap is not None else None
         step = self._decode_step_fn(params, adapters, page_table, gram_table,
                                     gram_accept, gram_dist, tok_bytes,
-                                    tok_lens, use_grammar, want_top)
+                                    tok_lens, use_grammar, want_top,
+                                    spec_width=spec_width, batch=width,
+                                    draft_cap=draft_cap)
         # K fused steps per dispatch: the host syncs once per K (or K·W
         # with speculation) tokens/slot, which is what makes decode
         # dispatch-latency-proof (SURVEY hard-part #3; essential over the
         # tunneled single-chip dev setup, still a win on local PCIe/ICI-
-        # attached hosts). outs arrays are (K, W, B).
+        # attached hosts). outs arrays are (K, W, width).
         state, outs = jax.lax.scan(lambda s, _: step(s), state, None,
                                    length=steps)
-        return state, self._pack_decode_outs(outs, steps, want_top)
+        if narrow:
+            state = self._merge_state(full, state, width)
+        return state, self._pack_decode_outs(outs, steps, want_top,
+                                             spec_width)
 
     def _pack_decode_outs(self, outs: Dict[str, Any], steps: int,
-                          want_top: bool) -> Dict[str, Any]:
+                          want_top: bool, spec_width: Optional[int] = None
+                          ) -> Dict[str, Any]:
         # one contiguous int32 block so the host fetches the whole dispatch
         # result in a single transfer (a pytree device_get pays one round
         # trip PER LEAF — 5x the latency on a remote-attached chip);
         # float rows ride as raw bits (bitcast), not int casts. Micro-rows
-        # are (step, position) pairs flattened in order.
-        B = self.batch
-        W = self.spec_width
+        # are (step, position) pairs flattened in order. B is the dispatch's
+        # slot width (< self.batch on a narrow batch-width rung).
+        B = outs["sampled"].shape[-1]
+        W = spec_width or self.spec_width
         R = steps * W
 
         def as_row(k):
@@ -1673,20 +1850,32 @@ class EngineCore:
 
     def _activate_group(self, state: DecodeState, logits, slots, is_last,
                         start_pos, chunk_len, generated, max_gen,
-                        temperature, top_k, top_p, seeds) -> DecodeState:
+                        temperature, top_k, top_p, seeds, gram_states,
+                        gram_table, gram_accept, gram_dist, tok_bytes,
+                        tok_lens, use_grammar: bool) -> DecodeState:
         """Grouped on-device first-token sample + slot activation for the
         ``is_last`` rows of a mixed dispatch — `_group_impl`'s activation
-        tail, minus grammar (the scheduler keeps grammared finals on the
-        grouped prefill program, whose fused first token samples under the
-        DFA). Rows with is_last False — and padding rows, slot == batch —
-        drop every scatter, so one compile serves any mid/final mix."""
+        tail. With ``use_grammar`` (static) the fused first token samples
+        under each row's DFA state and the advanced state is scattered
+        into DecodeState.gram_state, exactly as the grouped prefill
+        program does — grammared finals ride the mixed fast path instead
+        of forcing a separate dispatch. Rows with is_last False — and
+        padding rows, slot == batch — drop every scatter, so one compile
+        serves any mid/final mix."""
         from generativeaiexamples_tpu.ops.sampling import (
             sample_logits_per_slot, token_logprob)
+        raw = logits   # pre-mask: logprobs report the model distribution
+        if use_grammar:
+            from generativeaiexamples_tpu.ops.sampling import (
+                grammar_advance, grammar_mask)
+            logits = grammar_mask(logits, gram_states, max_gen - generated,
+                                  self.eos_id, gram_table, gram_accept,
+                                  gram_dist, tok_bytes, tok_lens)
         bases = jax.vmap(jax.random.PRNGKey)(seeds)           # (G, 2)
         subs = jax.vmap(jax.random.fold_in)(bases, generated - 1)
         toks = sample_logits_per_slot(subs, logits, temperature, top_k,
                                       top_p)
-        lps = token_logprob(logits, toks)
+        lps = token_logprob(raw, toks)
         alive = is_last & (toks != self.eos_id) & (generated < max_gen)
         act_slots = jnp.where(is_last, slots, jnp.int32(self.batch))
         upd = lambda arr, val: arr.at[act_slots].set(val, mode="drop")
@@ -1695,6 +1884,13 @@ class EngineCore:
         tok_col = jnp.minimum(start_pos + chunk_len, self.max_seq - 1)
         hist = state.history.at[act_slots, tok_col].set(toks, mode="drop")
         zeros = jnp.zeros_like(slots)
+        if use_grammar:
+            nxt = grammar_advance(gram_states, toks, gram_table, tok_bytes,
+                                  tok_lens)
+        else:
+            # still scatter: activation must CLEAR a previous occupant's
+            # DFA state (gram_states is all zeros in this program variant)
+            nxt = gram_states
         return dataclasses.replace(
             state,
             tokens=upd(state.tokens, toks),
@@ -1705,9 +1901,7 @@ class EngineCore:
             top_k=upd(state.top_k, top_k),
             top_p=upd(state.top_p, top_p),
             rngs=upd(state.rngs, bases),
-            # activation clears a previous occupant's DFA state (mixed
-            # chunk tails are unconstrained by construction)
-            gram_state=upd(state.gram_state, zeros),
+            gram_state=upd(state.gram_state, nxt),
             last_logprob=upd(state.last_logprob, lps),
             history=hist,
             adapter_ix=upd(state.adapter_ix, zeros),
@@ -1717,8 +1911,9 @@ class EngineCore:
                     gram_table, gram_accept, gram_dist, tok_bytes, tok_lens,
                     tokens, page_rows, slots, len_slots, start_pos,
                     chunk_len, is_last, generated, max_gen, temperature,
-                    top_k, top_p, seeds, steps: int, use_grammar: bool,
-                    want_top: bool) -> Tuple[DecodeState, Dict[str, Any]]:
+                    top_k, top_p, seeds, gram_states, draft_cap, steps: int,
+                    use_grammar: bool, want_top: bool, spec_width: int
+                    ) -> Tuple[DecodeState, Dict[str, Any]]:
         """The MIXED-PHASE program: `steps` fused decode steps where step 0's
         forward ALSO prefills up to G chunks from DISTINCT prefilling slots
         (kv_cache.mixed_step) — prefill stops being a separate dispatch, so
@@ -1731,12 +1926,15 @@ class EngineCore:
         activation AFTER the scan, so fresh slots start decoding next
         dispatch exactly as on the two-dispatch path. ``is_last`` rides as
         data, so one compile per group bucket serves any mid/final mix.
-        Chunk tails are unconstrained (grammared finals keep the grouped
-        prefill path — the scheduler routes them there)."""
+        Grammared finals ride too: ``gram_states`` is traced data and the
+        activation tail samples/advances under the DFA exactly as the
+        grouped prefill program does (`_activate_group`)."""
         step = self._decode_step_fn(params, adapters, page_table, gram_table,
                                     gram_accept, gram_dist, tok_bytes,
-                                    tok_lens, use_grammar, want_top)
-        W = self.spec_width
+                                    tok_lens, use_grammar, want_top,
+                                    spec_width=spec_width, batch=self.batch,
+                                    draft_cap=draft_cap)
+        W = spec_width
         cell: Dict[str, Any] = {}
 
         if W > 1:
@@ -1792,12 +1990,16 @@ class EngineCore:
         state = self._activate_group(state, cell["chunk_logits"], slots,
                                      is_last, start_pos, chunk_len,
                                      generated, max_gen, temperature,
-                                     top_k, top_p, seeds)
-        return state, self._pack_decode_outs(outs, steps, want_top)
+                                     top_k, top_p, seeds, gram_states,
+                                     gram_table, gram_accept, gram_dist,
+                                     tok_bytes, tok_lens, use_grammar)
+        return state, self._pack_decode_outs(outs, steps, want_top,
+                                             spec_width)
 
     def decode_mixed(self, state: DecodeState, page_table: jax.Array,   # tpulint: hot-path
                      steps: int, items, use_grammar: bool = False,
-                     want_top: bool = False
+                     want_top: bool = False, *,
+                     spec_width: Optional[int] = None, draft_cap=None
                      ) -> Tuple[DecodeState, Dict[str, Any]]:
         """One mixed-phase dispatch: ``steps`` fused decode steps PLUS up to
         ``prefill_group`` prefill chunks from DISTINCT prefilling jobs
@@ -1829,6 +2031,7 @@ class EngineCore:
         top_k = np.zeros((G,), np.int32)
         top_p = np.ones((G,), np.float32)
         seeds = np.zeros((G,), np.int32)
+        gram_states = np.zeros((G,), np.int32)
         for i, it in enumerate(items):
             n = len(it.chunk_ids)
             if n > C:
@@ -1846,6 +2049,7 @@ class EngineCore:
             top_k[i] = it.top_k
             top_p[i] = it.top_p
             seeds[i] = it.seed
+            gram_states[i] = it.gram_state
         # lengths-scatter dedup, as in prefill_group (the packer sends one
         # chunk per DISTINCT slot, so this is normally the identity — kept
         # so a buggy caller cannot trigger nondeterministic scatters)
@@ -1856,6 +2060,12 @@ class EngineCore:
         for i in range(len(items)):
             if newest.get(int(slots[i])) != i:
                 len_slots[i] = self.batch
+        W = spec_width or self.spec_widths[-1]
+        if W not in self.spec_widths:
+            raise ValueError(f"spec_width {W} is not a ladder rung "
+                             f"{self.spec_widths}")
+        if draft_cap is None:
+            draft_cap = np.full((self.batch,), W - 1, np.int32)
         return self._mixed_fn(
             state, self.params, self.adapters, page_table,
             *self._gram_args(use_grammar), jnp.asarray(tokens),
@@ -1864,12 +2074,15 @@ class EngineCore:
             jnp.asarray(chunk_len), jnp.asarray(is_last),
             jnp.asarray(generated), jnp.asarray(max_gen),
             jnp.asarray(temperature), jnp.asarray(top_k),
-            jnp.asarray(top_p), jnp.asarray(seeds), steps, use_grammar,
-            want_top)
+            jnp.asarray(top_p), jnp.asarray(seeds),
+            jnp.asarray(gram_states, jnp.int32),
+            jnp.asarray(draft_cap, jnp.int32), steps, use_grammar,
+            want_top, W)
 
     def decode(self, state: DecodeState, page_table: jax.Array,
                steps: int = 1, use_grammar: bool = False,
-               want_top: bool = False
+               want_top: bool = False, *, spec_width: Optional[int] = None,
+               width: Optional[int] = None, draft_cap=None
                ) -> Tuple[DecodeState, Dict[str, Any]]:
         """Run ``steps`` fused decode steps over all slots; ``page_table``
         from `put_table`. Out arrays are stacked (steps, B); ``input_tokens``
@@ -1877,7 +2090,23 @@ class EngineCore:
         host-synced at admission) is recoverable from the same sync.
         ``use_grammar`` (compiled separately) applies constrained-decoding
         masks for slots whose gram_state > 0; ``want_top`` (also a separate
-        compile) appends TOP_LP top-logprob rows to the packed block."""
+        compile) appends TOP_LP top-logprob rows to the packed block.
+        ``spec_width`` / ``width`` select a speculative-width and a
+        batch-width ladder rung (each a separate compile, all warmed);
+        ``draft_cap`` is the adaptive controller's per-slot draft budget
+        (traced data — no compile impact). Defaults reproduce the static
+        full-width dispatch exactly."""
+        W = spec_width or self.spec_widths[-1]
+        if W not in self.spec_widths:
+            raise ValueError(f"spec_width {W} is not a ladder rung "
+                             f"{self.spec_widths}")
+        bw = width or self.batch
+        if bw not in self.decode_widths:
+            raise ValueError(f"width {bw} is not a ladder rung "
+                             f"{self.decode_widths}")
+        if draft_cap is None:
+            draft_cap = np.full((self.batch,), W - 1, np.int32)
         return self._decode_fn(state, self.params, self.adapters, page_table,
-                               *self._gram_args(use_grammar), steps,
-                               use_grammar, want_top)
+                               *self._gram_args(use_grammar),
+                               jnp.asarray(draft_cap, jnp.int32), steps,
+                               use_grammar, want_top, W, bw)

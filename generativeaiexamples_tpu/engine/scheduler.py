@@ -27,7 +27,9 @@ never silently truncated.
 
 from __future__ import annotations
 
+import heapq
 import logging
+import math
 import queue
 import threading
 import time
@@ -212,6 +214,11 @@ class _Job:
     # KV-handoff payload for admit-with-prefilled-KV (submit_prefilled):
     # imported at admission instead of running prefill chunks
     preload: Optional[dict] = None
+    # trailing acceptance EMA (drafts accepted per widened step) — the
+    # adaptive spec-width controller's per-slot signal; seeded from the
+    # scheduler-global EMA at admission so fresh slots start where the
+    # workload's recent acceptance actually sits
+    spec_ema: float = -1.0
 
 
 class Scheduler:
@@ -242,8 +249,28 @@ class Scheduler:
         self._caching = hasattr(self._alloc, "match")
         self._cache_seed = 0
         # speculative decoding widens every decode step to W positions per
-        # slot (page growth and in-flight accounting are in POSITIONS)
+        # slot (page growth and in-flight accounting are in POSITIONS).
+        # _spec_w is the CEILING width; with an adaptive ladder
+        # (core.spec_widths, >1 rung) each dispatch picks the smallest
+        # rung covering every slot's acceptance-tuned draft cap.
         self._spec_w = getattr(core, "spec_width", 1)
+        self._spec_widths = tuple(getattr(core, "spec_widths",
+                                          (self._spec_w,)))
+        # decode batch-width ladder (core.decode_widths): pure-decode
+        # dispatches run at the smallest rung covering the highest live
+        # slot; slot allocation below is lowest-id-first (heap) so the
+        # live set compacts into the narrow rungs
+        self._decode_widths = tuple(getattr(core, "decode_widths",
+                                            (core.batch,)))
+        # scheduler-global acceptance EMA: seeds fresh slots' controllers.
+        # Seeded at spec_draft/2 so a fresh slot's cap (= ceil(2 x ema))
+        # is exactly the CONFIGURED static draft — rungs past it are
+        # earned by measured acceptance, never assumed (an assumed-wide
+        # start was measured hoarding the page-growth horizon's pool
+        # slack and starving skip-ahead admission).
+        cfg_draft = int(getattr(getattr(core, "cfg", None), "spec_draft",
+                                max(self._spec_w - 1, 0)) or 0)
+        self._spec_ema_global = min(cfg_draft, self._spec_w - 1) / 2.0
         self._table = np.zeros((core.batch, core.max_pages_per_slot), np.int32)
         self._table_dev: Optional[jax.Array] = None
         self._inflight: Deque[tuple] = deque()   # dispatched, not yet synced
@@ -458,7 +485,10 @@ class Scheduler:
     def _release(self, job: _Job) -> None:
         """Return the job's slot and pages to the pools."""
         if job.slot >= 0:
-            self._free.append(job.slot)
+            # min-heap: admission reuses the LOWEST free slot id first, so
+            # live slots compact toward 0 and the decode batch-width
+            # ladder's narrow rungs actually cover them
+            heapq.heappush(self._free, job.slot)
             self._table[job.slot, :] = 0
             self._table_dev = None
             job.slot = -1
@@ -774,7 +804,7 @@ class Scheduler:
                 except ValueError:
                     self._alloc.free(pages)
                     continue
-            slot = self._free.pop()
+            slot = heapq.heappop(self._free)   # lowest id first (see _release)
             job.slot = slot
             job.pages = pages
             job.prefilled = shared
@@ -1180,12 +1210,18 @@ class Scheduler:
 
     # -- decode -------------------------------------------------------------
 
-    def _grow_pages(self, steps: int) -> int:
+    def _grow_pages(self, steps: int, spec_w: Optional[int] = None) -> int:
         """Give every active slot pages for its next writes, targeting a
         ``steps``-deep dispatch. Preemption (youngest first) only kicks in
         when even ONE step cannot be covered; mere horizon pressure instead
         shrinks the dispatch depth. Returns the number of fused steps every
-        surviving slot has pages for (>= 1)."""
+        surviving slot has pages for (>= 1). ``spec_w`` is the PLANNED
+        dispatch width (defaults to the ceiling) — with the adaptive
+        ladder the horizon tracks the width actually dispatched, not the
+        widest rung, so a wide ceiling cannot hoard pool slack it will
+        never write (under-coverage is still safe either way: the kernel
+        clamps acceptance to the covered span)."""
+        spec_w = spec_w or self._spec_w
         effective = steps
         for slot in list(self._slots):
             job = self._slots.get(slot)
@@ -1206,8 +1242,7 @@ class Scheduler:
                 # correctness.
                 next_write = job.total_len + self._pending_steps
                 target = min(
-                    self.core.pages_for(next_write + steps * self._spec_w
-                                        - 1),
+                    self.core.pages_for(next_write + steps * spec_w - 1),
                     self.core.max_pages_per_slot)
                 minimum = min(self.core.pages_for(next_write),
                               self.core.max_pages_per_slot)
@@ -1238,7 +1273,7 @@ class Scheduler:
                 next_write = job.total_len + self._pending_steps
                 covered = len(job.pages) * self.core.page_size - next_write
                 effective = max(1, min(effective,
-                                       covered // self._spec_w))
+                                       covered // spec_w))
             # at full table capacity the device-side out_of_cache guard ends
             # the slot before it could outrun its row — no clamp needed
         # round down to a power of two: `steps` is a compile-time constant of
@@ -1331,15 +1366,16 @@ class Scheduler:
         (engine.decode_mixed)? The packing policy is the existing chunked-
         prefill sizing; what stays on the two-dispatch path: jobs the
         sequence-parallel long pass will claim, adapter'd jobs (the mixed
-        forward runs base weights only), grammared FINAL chunks (their
-        fused first token must sample under the DFA, which only the grouped
-        prefill program wires up), prefill_only handoff jobs (their export
-        path stays on the grouped program), and the BULK of very long
-        prompts — the mixed program fuses one chunk per job per dispatch
-        while the grouped path moves up to prefill_group chunks per tick,
-        so a prompt with more than a group of chunks left would prefill
-        group-times slower fused; it takes the grouped path until its tail
-        fits one group."""
+        forward runs base weights only), prefill_only handoff jobs (their
+        export path stays on the grouped program), and the BULK of very
+        long prompts — the mixed program fuses one chunk per job per
+        dispatch while the grouped path moves up to prefill_group chunks
+        per tick, so a prompt with more than a group of chunks left would
+        prefill group-times slower fused; it takes the grouped path until
+        its tail fits one group. Grammared FINAL chunks ride too (r06):
+        the mixed activation tail samples the fused first token under the
+        DFA exactly as the grouped program does — constrained decoding no
+        longer pays a separate-dispatch tax."""
         req = job.request
         if job.adapter_ix or req.adapter:
             return False
@@ -1349,9 +1385,6 @@ class Scheduler:
             return False
         remaining = len(job.ids) - job.prefilled
         if remaining > max(1, self.core.cfg.prefill_group) * self.core.chunk:
-            return False
-        last = remaining <= self.core.chunk
-        if last and req.grammar is not None:
             return False
         return True
 
@@ -1383,14 +1416,106 @@ class Scheduler:
                 job.prefill_started = time.perf_counter()
                 if req.prefill_start_at is None:
                     req.prefill_start_at = job.prefill_started
+            # grammared finals sample their fused first token under the
+            # DFA inside the mixed program (engine._activate_group) — the
+            # same registration/walk the grouped path runs
+            gram_state = self._gram_state_for(job) if last else 0
             items.append(PrefillItem(
                 chunk_ids=chunk_ids, page_row=self._table[job.slot],
                 slot=job.slot, start_pos=start, is_last=last,
                 generated=len(job.gen_ids) + 1, max_gen=req.max_tokens,
                 temperature=req.temperature, top_k=req.top_k,
-                top_p=req.top_p, seed=req.seed or 0))
+                top_p=req.top_p, gram_state=gram_state,
+                seed=req.seed or 0))
             metas.append((job, last))
         return items, metas
+
+    # EMA smoothing of the acceptance signal, and the headroom multiplier
+    # between the trailing accepted-drafts mean and the offered draft
+    # width: cap = ceil(headroom x ema). Headroom > 1 lets a slot whose
+    # drafts all land climb back up the ladder (ema == d → cap > d).
+    _SPEC_EMA_ALPHA = 0.3
+    _SPEC_HEADROOM = 2.0
+
+    def _choose_draft(self, job: _Job) -> int:
+        """Acceptance-tuned draft budget for one slot: the smallest ladder
+        draft covering headroom x trailing-acceptance, so a slot whose
+        drafts keep missing stops paying full-width verify positions while
+        a quoting slot keeps the whole ladder. Exact-match acceptance makes
+        any cap token-identical — this tunes waste, never content."""
+        if job.spec_ema < 0:
+            job.spec_ema = self._spec_ema_global
+        want = math.ceil(self._SPEC_HEADROOM * job.spec_ema)
+        top = self._spec_w - 1
+        for w in self._spec_widths:
+            if w - 1 >= want:
+                return min(w - 1, top)
+        return top
+
+    def _spec_plan(self):
+        """(dispatch spec width, per-slot draft caps) for THIS dispatch:
+        caps from each slot's acceptance EMA, width = the smallest ladder
+        rung covering every cap (one compile per rung, all warmed).
+        Returns (ceiling, None) when the engine has no adaptive ladder —
+        the static pre-r06 dispatch, bit-for-bit."""
+        if self._spec_w <= 1 or len(self._spec_widths) <= 1:
+            return self._spec_w, None
+        caps = np.zeros((self.core.batch,), np.int32)
+        top = 0
+        for slot, job in self._slots.items():
+            d = self._choose_draft(job)
+            caps[slot] = d
+            top = max(top, d)
+        w_disp = next((w for w in self._spec_widths if w >= 1 + top),
+                      self._spec_widths[-1])
+        return w_disp, caps
+
+    def _note_acceptance(self, out: Dict[str, np.ndarray], steps: int,
+                         w_disp: int, active_map: Dict[int, "_Job"]) -> None:
+        """Feed the adaptive controller + the spec telemetry from one
+        landed dispatch: per widened step, the accepted-draft length
+        (emitted tokens - 1) updates the slot's EMA and the scrapeable
+        ``spec_accept_len`` histogram (the controller's input signal)."""
+        if w_disp <= 1:
+            return
+        em = out["emitted"].reshape(-1, w_disp, out["emitted"].shape[1])
+        per_step = em.sum(axis=1)                      # (steps, B)
+        REGISTRY.counter("spec_bonus_tokens").inc(
+            int(np.maximum(per_step - 1, 0).sum()))
+        REGISTRY.counter("spec_base_steps").inc(int((per_step > 0).sum()))
+        accept_h = REGISTRY.histogram("spec_accept_len")
+        a = self._SPEC_EMA_ALPHA
+        for slot, job in active_map.items():
+            if job.gram_on:
+                # constrained slots decode sequentially (their drafts are
+                # voided in the engine) — their structural 0-acceptance is
+                # not a property of the workload's draftability and must
+                # not depress the controller's signal or the global seed
+                continue
+            col = per_step[:, slot]
+            live = col > 0
+            n_live = int(live.sum())
+            if not n_live:
+                continue
+            accepted = col[live] - 1                   # drafts accepted
+            for v in accepted:
+                accept_h.observe(float(v))
+            mean = float(accepted.mean())
+            if job.spec_ema < 0:
+                job.spec_ema = self._spec_ema_global
+            job.spec_ema = (1 - a) * job.spec_ema + a * mean
+            self._spec_ema_global = ((1 - a) * self._spec_ema_global
+                                     + a * mean)
+
+    def _decode_width(self) -> int:
+        """Batch-width ladder rung for a PURE decode dispatch: the smallest
+        pre-compiled width covering the highest live slot (lowest-id-first
+        allocation compacts the live set). Mixed dispatches keep the full
+        width — their rows are already filled by fused chunks."""
+        if len(self._decode_widths) <= 1 or not self._slots:
+            return self.core.batch
+        hi = max(self._slots) + 1
+        return next(w for w in self._decode_widths if w >= hi)
 
     def _dispatch_decode(self, try_mixed: bool = False) -> None:   # tpulint: hot-path
         """Issue one K-step decode dispatch without waiting for its result
@@ -1400,7 +1525,11 @@ class Scheduler:
         chip). Freshly-activated slots are snapshotted with the dispatch so
         their fused-prefill first token is resolved against the right step-0
         input."""
-        steps = self._grow_pages(self._steps)
+        # plan the spec width FIRST: the page-grow horizon tracks the
+        # width actually dispatched, not the ladder ceiling (a 2x-wide
+        # ceiling must not hoard pool slack it will never write into)
+        w_plan, _caps_plan = self._spec_plan()
+        steps = self._grow_pages(self._steps, w_plan)
         if not self._slots:
             return
         packed_chunks = self._pack_mixed_chunks() if try_mixed else None
@@ -1412,11 +1541,27 @@ class Scheduler:
         use_grammar = any(j.gram_on for j in self._slots.values())
         want_top = any(j.request.logprobs and j.request.top_logprobs > 0
                        for j in self._slots.values())
+        # adaptive spec width: per-slot draft caps + the covering ladder
+        # rung, re-planned AFTER _grow_pages (page-pressure preemption may
+        # have evicted slots; fewer slots never widen the rung, so the
+        # grown horizon stays sufficient). Static pre-r06 call shape when
+        # the core has no ladder, so FakeCore / older cores see the
+        # unchanged signature.
+        w_disp, caps = self._spec_plan()
         if packed_chunks is not None:
             # mixed-phase dispatch: every prefilling job's next chunk rides
             # the decode program as extra ragged rows — active slots'
-            # decode tick is not stalled by a separate prefill dispatch
+            # decode tick is not stalled by a separate prefill dispatch.
+            # Grammared finals carry their DFA state as a ragged-row
+            # attribute, so constrained jobs ride this path too. Mixed
+            # always runs the CEILING spec width, uncapped (the ragged
+            # kernel pads rows to q_block regardless — a cap would only
+            # cut accepted drafts) and the full batch width.
             items, mixed_metas = packed_chunks
+            if any(it.gram_state for it in items):
+                use_grammar = True
+            w_disp, caps = self._spec_w, None
+            width = self.core.batch
             self._state, out = self.core.decode_mixed(
                 self._state, self._table_device(), steps, items, use_grammar,
                 want_top)
@@ -1424,9 +1569,24 @@ class Scheduler:
             REGISTRY.counter("mixed_dispatches").inc()
             REGISTRY.counter("prefill_chunks").inc(len(items))
         else:
+            if use_grammar or want_top:
+                # minority program variants stay at the ceiling width and
+                # full batch — warmup does not cross the ladders with them
+                # (bounded compile grid; see EngineCore.warmup)
+                w_disp, caps = self._spec_w, None
+                width = self.core.batch
+            else:
+                # batch-width ladder: the narrowest pre-compiled rung
+                # covering every live slot — at low occupancy the padded
+                # (batch x W) token block shrinks with the live set
+                width = self._decode_width()
+            width_kw = ({} if caps is None
+                        else {"spec_width": w_disp, "draft_cap": caps})
+            if width != self.core.batch:
+                width_kw["width"] = width
             self._state, out = self.core.decode(
                 self._state, self._table_device(), steps, use_grammar,
-                want_top)
+                want_top, **width_kw)
         self._decode_dispatches += 1
         # kernel occupancy of this dispatch's query rows: active query
         # positions over padded positions. Fused chunks pad to the full
@@ -1435,8 +1595,13 @@ class Scheduler:
         # engine's padded row width (q_block under the ragged kernel,
         # spec_w under the XLA fallback) — the gauge must report what the
         # kernel actually ran
-        active_q = len(self._slots) * self._spec_w
-        padded_q = self.core.batch * self._spec_w
+        if caps is None:
+            active_q = len(self._slots) * w_disp
+        else:
+            # adaptive widths: each slot's useful positions are its own
+            # 1 + draft_cap, not the dispatch ceiling
+            active_q = sum(1 + int(caps[s]) for s in self._slots)
+        padded_q = width * w_disp
         if packed_chunks is not None:
             row_q = getattr(self.core, "mixed_row_queries", self._spec_w)
             g_bucket = next(b for b in self.core.group_buckets
@@ -1457,20 +1622,29 @@ class Scheduler:
         # With APP_DEVTIME=off this only counts; no fence is ever taken.
         suffix = (("+gram" if use_grammar else "")
                   + ("+top" if want_top else ""))
+        if caps is None:
+            dec_useful = steps * len(self._slots) * w_disp
+        else:
+            dec_useful = steps * sum(1 + int(caps[s]) for s in self._slots)
         if packed_chunks is not None:
+            bucket = (self.core.mixed_bucket(g_bucket, steps)
+                      if hasattr(self.core, "mixed_bucket")
+                      else f"g{g_bucket}s{steps}")
             DEVTIME.commit(
-                f"mixed{suffix}", f"g{g_bucket}s{steps}", out["packed"],
-                t0=t0,
-                tokens=(steps * len(self._slots) * self._spec_w
+                f"mixed{suffix}", bucket, out["packed"], t0=t0,
+                tokens=(dec_useful
                         + sum(len(it.chunk_ids) for it in items)),
-                padded_tokens=(steps * self.core.batch * self._spec_w
+                padded_tokens=(steps * self.core.batch * w_disp
                                + g_bucket * self.core.chunk),
                 weight_passes=float(steps))
         else:
+            bucket = (self.core.decode_bucket(steps, w_disp, width)
+                      if hasattr(self.core, "decode_bucket")
+                      else f"s{steps}")
             DEVTIME.commit(
-                f"decode{suffix}", f"s{steps}", out["packed"], t0=t0,
-                tokens=steps * len(self._slots) * self._spec_w,
-                padded_tokens=steps * self.core.batch * self._spec_w,
+                f"decode{suffix}", bucket, out["packed"], t0=t0,
+                tokens=dec_useful,
+                padded_tokens=steps * width * w_disp,
                 weight_passes=float(steps))
         # hand the result to a fetcher thread NOW: the device→host round
         # trip (~100 ms over a remote-attached chip) overlaps further
@@ -1485,10 +1659,10 @@ class Scheduler:
         # in-flight accounting is in POSITIONS (steps × speculative width);
         # (issue instant, steps) rides along for the watchdog's hung-
         # dispatch bound (engine/watchdog.py reads the head entry's age)
-        self._inflight.append((steps * self._spec_w, packed, fresh,
+        self._inflight.append((steps * w_disp, packed, fresh,
                                dict(self._slots),
                                (time.monotonic(), steps)))
-        self._pending_steps += steps * self._spec_w
+        self._pending_steps += steps * w_disp
         REGISTRY.counter("decode_steps").inc(steps)
         if packed_chunks is not None:
             # the fused chunks' writes are now dispatched: advance each
@@ -1515,7 +1689,7 @@ class Scheduler:
         # the watchdog's hung-dispatch bound has to see (popping first
         # would hide a wedged dispatch and degrade detection to the much
         # coarser tick-stall heartbeat)
-        positions, packed, fresh, active_map, _issued = self._inflight[0]
+        positions, packed, fresh, active_map, issued = self._inflight[0]
         # one transfer per dispatch, already in flight on the fetcher thread
         t0 = time.perf_counter()
         out = unpack_decode_out(packed.result())
@@ -1524,16 +1698,11 @@ class Scheduler:
         REGISTRY.histogram("sync_wait_s").observe(time.perf_counter() - t0)
         now = time.perf_counter()
         REGISTRY.counter("tokens_generated").inc(int(out["emitted"].sum()))
-        if self._spec_w > 1:
-            # acceptance telemetry: tokens beyond one per (step, slot) are
-            # speculation wins
-            em = out["emitted"].reshape(-1, self._spec_w,
-                                        out["emitted"].shape[1])
-            per_step = em.sum(axis=1)
-            REGISTRY.counter("spec_bonus_tokens").inc(
-                int(np.maximum(per_step - 1, 0).sum()))
-            REGISTRY.counter("spec_base_steps").inc(
-                int((per_step > 0).sum()))
+        # acceptance telemetry + the adaptive-width controller's EMA feed;
+        # the dispatch's OWN width (positions / steps — ladder rungs vary
+        # per dispatch), never the engine ceiling
+        self._note_acceptance(out, issued[1], positions // issued[1],
+                              active_map)
         for slot, job in fresh:
             if self._slots.get(slot) is not job:
                 continue  # preempted while in flight; resume re-samples
@@ -1596,6 +1765,11 @@ class Scheduler:
                 self._mixed_dispatches / self._decode_dispatches, 4)
                 if self._decode_dispatches else 0.0,
             "ragged_row_util": round(self._ragged_row_util, 4),
+            # padded-vs-useful token fraction over the ledger's trailing
+            # window (observability/devtime.py) — what the batch-width and
+            # spec-width ladders exist to shrink; mirrored to the
+            # flight_padding_waste_frac gauge like every numeric field
+            "padding_waste_frac": round(DEVTIME.padding_waste(), 4),
             # devtime plane: mid-serving XLA recompiles so far (the cliff
             # counter, engine_recompiles_total) and the device+queue+issue
             # seconds the ledger has attributed to named programs — both

@@ -179,12 +179,22 @@ class DevtimeLedger:
         # global trailing window of (weight_passes, device_s) for the
         # engine_hbm_read_util gauge (weight-bearing programs only)
         self._bw_window: deque = deque(maxlen=_WINDOW)
+        # global trailing window of (useful, padded) token counts for the
+        # engine_padding_waste_frac gauge — a CENSUS (every commit with a
+        # padded count reports, no fence involved), so the gauge is live
+        # even in the zero-fence off mode. Running totals keep the
+        # per-commit cost O(1) (the window evicts at fixed maxlen) — the
+        # off mode's counting-only cheapness must hold on the hot path.
+        self._pad_window: deque = deque(maxlen=_WINDOW)
+        self._pad_useful = 0.0
+        self._pad_padded = 0.0
         # tests may redirect the recompile hazard away from the global SLO
         self.hazard_sink: Optional[Callable[[str, Dict[str, Any]], None]] = None
         # the metric families exist (0-valued) from process start, so a
         # scrape before the first dispatch still sees the catalog
         REGISTRY.counter("engine_recompiles_total")
         REGISTRY.gauge("engine_hbm_read_util")
+        REGISTRY.gauge("engine_padding_waste_frac")
 
     # ------------------------------------------------------------ lifecycle
 
@@ -243,6 +253,9 @@ class DevtimeLedger:
             self._commits = 0
             self._marker = None
             self._bw_window.clear()
+            self._pad_window.clear()
+            self._pad_useful = 0.0
+            self._pad_padded = 0.0
             if not keep_warm:
                 self._warm.clear()
                 self._serving = False
@@ -313,6 +326,20 @@ class DevtimeLedger:
             entry.tokens += tokens
             entry.padded_tokens += padded_tokens
             entry.weight_passes += weight_passes
+            pad_frac = None
+            if padded_tokens:
+                # census padding accounting (no fence): the live
+                # engine_padding_waste_frac gauge the batch-width /
+                # spec-width ladders are steered against
+                if len(self._pad_window) == self._pad_window.maxlen:
+                    old_u, old_p = self._pad_window[0]
+                    self._pad_useful -= old_u
+                    self._pad_padded -= old_p
+                self._pad_window.append((tokens, padded_tokens))
+                self._pad_useful += tokens
+                self._pad_padded += padded_tokens
+                if self._pad_padded:
+                    pad_frac = 1.0 - self._pad_useful / self._pad_padded
             if timed:
                 # issue seconds only for TIMED commits: attributed_s() sums
                 # device+queue+issue, and mixing census issue time with
@@ -349,6 +376,9 @@ class DevtimeLedger:
                 event = None
         # metrics + hazards OUTSIDE the lock (REGISTRY has its own locks;
         # the SLO sink may take the tracker's)
+        if pad_frac is not None:
+            REGISTRY.gauge("engine_padding_waste_frac").set(
+                round(pad_frac, 4))
         if timed:
             # sampled mode extrapolates by the stride so the Prometheus
             # counter tracks attributed seconds, not 1/N of them
@@ -428,6 +458,15 @@ class DevtimeLedger:
             return sum(e.device_s + e.queue_s + e.issue_s
                        for e in self._entries.values())
 
+    def padding_waste(self) -> float:
+        """Padded-token fraction NOT carrying useful positions over the
+        trailing commit window (0.0 with no data) — the flight recorder's
+        ``padding_waste_frac`` field and the batch-width ladder's
+        scoreboard read this."""
+        with self._lock:
+            pad_u, pad_p = self._pad_useful, self._pad_padded
+        return (1.0 - pad_u / pad_p) if pad_p else 0.0
+
     def snapshot(self) -> Dict[str, Any]:
         """The ``GET /debug/devtime`` body."""
         with self._lock:
@@ -437,6 +476,7 @@ class DevtimeLedger:
             perf = self._perf
             mode, sample_n = self._mode, self._sample_n
             serving = self._serving
+            pad_u, pad_p = self._pad_useful, self._pad_padded
         totals = {
             "count": sum(r["count"] for r in rows),
             "timed": sum(r["timed"] for r in rows),
@@ -447,6 +487,8 @@ class DevtimeLedger:
         out: Dict[str, Any] = {
             "mode": mode, "sample_n": sample_n, "serving": serving,
             "programs": rows, "totals": totals,
+            "padding_waste_frac": (round(1.0 - pad_u / pad_p, 4)
+                                   if pad_p else 0.0),
             "recompiles_total": REGISTRY.counter(
                 "engine_recompiles_total").value,
         }

@@ -18,6 +18,15 @@ generated+i, and drafts are accepted while they equal those samples. The
 emitted stream is therefore token-for-token what sequential decoding with
 the same keys would produce — speculation changes wall clock, never
 content (modulo the usual batched-matmul rounding of logits).
+
+That exact-match property is ALSO what makes the adaptive width ladder
+(engine.spec_adaptive — per-slot draft caps from a trailing acceptance
+EMA) token-identical by construction: clamping ``draft_len`` to any cap
+in [0, n_draft] only changes HOW MANY drafted positions are verified per
+step, never which token each position resolves to — position i's sample
+depends only on the accepted prefix and the request's key for index
+generated+i, both invariant under the cap. The engine applies the cap as
+``dlen = min(dlen, draft_cap)`` before :func:`acceptance`.
 """
 
 from __future__ import annotations

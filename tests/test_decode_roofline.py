@@ -1,0 +1,323 @@
+"""Decode roofline campaign (ISSUE 11): the three levers, pinned.
+
+  * **Adaptive speculative width** — per-slot draft caps from a trailing
+    acceptance EMA, dispatch width from a pow2-ish ladder: streams must be
+    token-identical to spec-off AND to the static full width (acceptance
+    is exact-match under the per-request seed, so capping drafts tunes
+    waste, never content), on both the XLA fallback and the pallas/int8
+    kernel path.
+  * **Grammar on the mixed-phase fast path** — grammared final chunks now
+    ride the decode dispatch (gram_state as a ragged-row attribute;
+    engine._activate_group samples/advances under the DFA): streams must
+    be token-identical to the grouped-prefill path and still
+    schema-valid, and mixed_dispatch_frac must no longer collapse to 0
+    when a grammared job is live.
+  * **Batch-width ladder** — pure-decode dispatches at the narrowest
+    pre-compiled rung covering the live slots; compile-watch must report
+    ZERO mid-serving recompiles across spec-width and batch-width ladder
+    transitions (warmup owns the whole grid).
+
+Plus the satellite observability surfaces: the ``spec_accept_len``
+histogram (the controller's input signal) and the
+``engine_padding_waste_frac`` gauge / flight-recorder field, and the
+kernel microbench's int8-pool mixes.
+"""
+
+import importlib.util
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.core.config import EngineConfig
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.engine.engine import EngineCore
+from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
+from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.observability.devtime import DEVTIME
+
+from test_scheduler_fuzz import FakeCore
+
+
+@pytest.fixture(scope="module")
+def served():
+    cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    params = llama.init_params(jax.random.PRNGKey(5), cfg)
+    return cfg, params, ByteTokenizer()
+
+
+def _core(served, **kw):
+    cfg, params, tok = served
+    ecfg = EngineConfig(max_batch_size=4, max_seq_len=256, page_size=8,
+                        prefill_chunk=16, **kw)
+    return EngineCore(cfg, ecfg, params, eos_id=tok.eos_id)
+
+
+def _run_all(sched, reqs):
+    for r in reqs:
+        sched.submit(r)
+    while sched._tick():
+        pass
+    out = []
+    for r in reqs:
+        assert r.error is None, r.error
+        parts = []
+        while not r.out_queue.empty():
+            item = r.out_queue.get_nowait()
+            if isinstance(item, str):
+                parts.append(item)
+        out.append("".join(parts))
+    return out
+
+
+# repetitive RAG-flavored prompt (drafts get accepted) + an unrelated one
+# (drafts keep missing — the controller must narrow that slot's cap)
+_QUOTE = ("the retrieved context says: alpha beta gamma delta. "
+          "the retrieved context says: alpha beta gamma delta. "
+          "question: repeat the context. answer: the retrieved")
+
+
+# ---------------------------------------------------- adaptive spec width
+
+@pytest.mark.parametrize("attn_impl,kv_quant",
+                         [("xla", "none"), ("pallas", "int8")])
+def test_adaptive_spec_width_streams_token_identical(served, attn_impl,
+                                                     kv_quant):
+    """Adaptive width == static spec_draft=4 == spec off, token for token,
+    on the XLA fallback AND the pallas/int8 pool — while the controller
+    demonstrably varies the per-slot caps (its whole point)."""
+    cfg, params, tok = served
+    import dataclasses
+    cfg = dataclasses.replace(cfg, attn_impl=attn_impl)
+
+    def build(**kw):
+        ecfg = EngineConfig(max_batch_size=4, max_seq_len=256, page_size=8,
+                            prefill_chunk=16, kv_quant=kv_quant, **kw)
+        return EngineCore(cfg, ecfg, params, eos_id=tok.eos_id)
+
+    mk = lambda: [Request(prompt_ids=tok.encode(_QUOTE, add_bos=True),
+                          max_tokens=32, temperature=0.0),
+                  Request(prompt_ids=tok.encode("unrelated short one",
+                                                add_bos=True),
+                          max_tokens=28, temperature=0.0),
+                  Request(prompt_ids=tok.encode(_QUOTE, add_bos=True),
+                          max_tokens=20, temperature=0.9, seed=17)]
+
+    base = _run_all(Scheduler(build(spec_decode="off"), tok), mk())
+    static = _run_all(
+        Scheduler(build(spec_decode="on", spec_adaptive="off"), tok), mk())
+    core = build(spec_decode="on", spec_adaptive="on")
+    # drafts {1, 2, 4, 8}: pow2 rungs up to the auto ceiling 2 x spec_draft
+    assert core.spec_widths == (2, 3, 5, 9)
+    assert core.spec_width == 9
+    sched = Scheduler(core, tok)
+    seen_caps = []
+    orig = core.decode
+
+    def spying_decode(state, table, steps, use_grammar=False,
+                      want_top=False, **kw):
+        if kw.get("draft_cap") is not None:
+            seen_caps.append(np.array(kw["draft_cap"]))
+        return orig(state, table, steps, use_grammar, want_top, **kw)
+
+    core.decode = spying_decode
+    adaptive = _run_all(sched, mk())
+    assert static == base
+    assert adaptive == base
+    assert seen_caps, "adaptive engine never passed draft caps"
+    # the controller actually narrowed at least one slot below the static
+    # draft width at some point (the unrelated prompt's drafts miss) …
+    assert any(c.min() < core.cfg.spec_draft for c in seen_caps), \
+        "acceptance EMA never narrowed any slot's draft cap"
+    # … and the ladder extends PAST the static draft for slots that earn
+    # it: a fully-accepting slot's EMA climbs to the ceiling rung
+    # (deterministic controller check — in-vivo climb depends on the
+    # random tiny model's actual acceptance)
+    from generativeaiexamples_tpu.engine.scheduler import _Job
+    hot = _Job(request=Request(prompt_ids=[1]), detok=None, ids=[1])
+    hot.spec_ema = float(core.cfg.spec_draft)      # accepting everything
+    assert sched._choose_draft(hot) > core.cfg.spec_draft
+    cold = _Job(request=Request(prompt_ids=[1]), detok=None, ids=[1])
+    cold.spec_ema = 0.2
+    assert sched._choose_draft(cold) == 1
+
+
+def test_spec_accept_len_histogram_is_scrapeable(served):
+    """The adaptive controller's input signal rides /metrics: per widened
+    step, the accepted-draft length lands in the spec_accept_len
+    histogram."""
+    cfg, params, tok = served
+    h = REGISTRY.histogram("spec_accept_len")
+    n0 = h.count
+    _run_all(Scheduler(_core(served, spec_decode="on"), tok),
+             [Request(prompt_ids=tok.encode(_QUOTE, add_bos=True),
+                      max_tokens=24, temperature=0.0)])
+    assert h.count > n0, "no accepted-draft lengths observed"
+    assert "spec_accept_len" in REGISTRY.render_prometheus()
+
+
+# ------------------------------------------- grammar on the mixed fast path
+
+def _run_grammar_workload(served, mixed: str):
+    """Two plain streams decoding, then a grammared job admitted
+    mid-decode. Hand-driven ticks; returns (texts, sched, gram_rode_mixed,
+    prefill_stalls)."""
+    from generativeaiexamples_tpu.engine import grammar as grammar_mod
+
+    cfg, params, tok = served
+    ecfg = EngineConfig(max_batch_size=4, max_seq_len=256, prefill_chunk=16,
+                        page_size=16, spec_decode="on", spec_draft=2,
+                        prefill_hold_chunks=0, mixed_phase_dispatch=mixed,
+                        decode_steps_per_dispatch=2)
+    core = EngineCore(cfg, ecfg, params, eos_id=tok.eos_id)
+    sched = Scheduler(core, tok)
+    reqs = [Request(prompt_ids=tok.encode("hello wor"), max_tokens=40,
+                    temperature=0.0),
+            Request(prompt_ids=tok.encode("abcdefgh"), max_tokens=40,
+                    temperature=0.0)]
+    for r in reqs:
+        sched.submit(r)
+    for _ in range(4):
+        sched._tick()
+    g = grammar_mod.Grammar.from_schema({"type": "boolean"})
+    gram_req = Request(prompt_ids=tok.encode("json please:", add_bos=True),
+                       max_tokens=12, temperature=0.0, grammar=g)
+    reqs.append(gram_req)
+    sched.submit(gram_req)
+    gram_rode_mixed = [False]
+    stalls = [0]
+    orig_mixed = core.decode_mixed
+    orig_prefill = sched._prefill_step
+
+    def spying_mixed(state, table, steps, items, *a, **kw):
+        its = items if isinstance(items, list) else [items]
+        if any(it.gram_state for it in its):
+            gram_rode_mixed[0] = True
+        return orig_mixed(state, table, steps, items, *a, **kw)
+
+    def spying_prefill():
+        if sched._slots:
+            stalls[0] += 1
+        return orig_prefill()
+
+    core.decode_mixed = spying_mixed
+    sched._prefill_step = spying_prefill
+    for _ in range(300):
+        sched._tick()
+        if all(r.finished_at is not None for r in reqs):
+            break
+    texts = []
+    for r in reqs:
+        assert r.error is None, r.error
+        assert r.finished_at is not None, "request did not finish"
+        parts = []
+        while not r.out_queue.empty():
+            item = r.out_queue.get()
+            if isinstance(item, str):
+                parts.append(item)
+        texts.append("".join(parts))
+    return texts, sched, gram_rode_mixed[0], stalls[0]
+
+
+def test_grammared_job_rides_mixed_fast_path_token_identical(served):
+    """A grammared job admitted mid-decode rides the mixed dispatch (its
+    final chunk carries gram_state as a ragged-row attribute), the stream
+    is token-identical to the grouped-prefill path, output stays
+    schema-valid, and mixed_dispatch_frac no longer drops to 0."""
+    texts_on, sched_on, gram_mixed_on, stalls_on = _run_grammar_workload(
+        served, "on")
+    assert gram_mixed_on, "grammared final chunk never rode a mixed dispatch"
+    assert stalls_on == 0, "separate prefill dispatches while decode live"
+    assert sched_on._flight_fields()["mixed_dispatch_frac"] > 0
+    assert texts_on[2].strip() in ("true", "false")
+
+    texts_off, _, gram_mixed_off, stalls_off = _run_grammar_workload(
+        served, "off")
+    assert not gram_mixed_off
+    assert stalls_off > 0
+    assert texts_on == texts_off   # token-identical, both paths
+    # token-level enforcement was ACTIVE on the mixed path, not degraded
+    assert texts_off[2].strip() in ("true", "false")
+
+
+# ------------------------------------------------ ladders: zero recompiles
+
+def test_ladder_transitions_compile_watch_zero_recompiles(served):
+    """Warmup owns the whole (steps x spec-width x batch-width) grid:
+    serving traffic that transitions across both ladders mid-stream must
+    trigger ZERO mid-serving recompiles (the compile-watch counter), while
+    multiple distinct rung buckets demonstrably dispatched."""
+    cfg, params, tok = served
+    ecfg = EngineConfig(max_batch_size=4, max_seq_len=128, page_size=8,
+                        prefill_chunk=16, spec_decode="on", spec_draft=4,
+                        spec_adaptive="on", decode_width_ladder="on",
+                        decode_steps_per_dispatch=2, prefill_hold_chunks=0)
+    core = EngineCore(cfg, ecfg, params, eos_id=tok.eos_id)
+    assert len(core.spec_widths) > 1 and len(core.decode_widths) > 1
+    DEVTIME.reset()
+    try:
+        core.warmup()
+        sched = Scheduler(core, tok)
+        DEVTIME.mark_serving()    # what Scheduler.start() does on the driver
+        base = REGISTRY.counter("engine_recompiles_total").value
+        # one lone stream (narrow rung) …
+        _run_all(sched, [Request(prompt_ids=tok.encode("solo stream",
+                                                       add_bos=True),
+                                 max_tokens=16, temperature=0.0)])
+        # … then a full batch (wide rung), then drain back down
+        _run_all(sched, [Request(prompt_ids=tok.encode(f"req number {i}",
+                                                       add_bos=True),
+                                 max_tokens=10 + 4 * i, temperature=0.0)
+                         for i in range(4)])
+        assert REGISTRY.counter("engine_recompiles_total").value == base, \
+            "ladder transition paid a mid-serving recompile"
+        buckets = {r["bucket"] for r in DEVTIME.snapshot()["programs"]
+                   if r["program"] == "decode"}
+        assert len(buckets) >= 2, \
+            f"no ladder transition actually dispatched: {buckets}"
+    finally:
+        DEVTIME.reset()
+
+
+# -------------------------------------------------- padding-waste surfaces
+
+def test_padding_waste_gauge_snapshot_and_flight_field():
+    DEVTIME.reset()
+    try:
+        DEVTIME.commit("decode", "s2w3b4", tokens=24, padded_tokens=48)
+        assert DEVTIME.padding_waste() == pytest.approx(0.5)
+        assert REGISTRY.gauge("engine_padding_waste_frac").value == \
+            pytest.approx(0.5)
+        assert DEVTIME.snapshot()["padding_waste_frac"] == pytest.approx(0.5)
+        assert "engine_padding_waste_frac" in REGISTRY.render_prometheus()
+        # the flight recorder carries the same number per sample
+        sched = Scheduler(FakeCore(batch=2, max_seq=64, page_size=8,
+                                   chunk=16, steps=2), ByteTokenizer())
+        fields = sched._flight_fields()
+        assert fields["padding_waste_frac"] == pytest.approx(0.5)
+    finally:
+        DEVTIME.reset()
+
+
+# ------------------------------------------------- kernel bench int8 mixes
+
+def _load_bench():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(root, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_kernel_bench_reports_int8_pool_mixes():
+    """`bench.py --kernel-bench` measures the quantized ragged-kernel read
+    (int8 pages + f32 scales) at every raggedness mix, next to the fp
+    pool — quantized reads are measured, not assumed."""
+    out = _load_bench()._kernel_microbench(False, reps=1)
+    for key in ("mixes", "mixes_int8"):
+        assert set(out[key]) == {"decode_only", "mixed", "sparse_mixed"}
+        for mix in out[key].values():
+            assert mix["separate_ms"] > 0 and mix["ragged_ms"] > 0

@@ -97,7 +97,8 @@ class FakeCore:
     """Pure-numpy stand-in for EngineCore with REAL paged-read semantics."""
 
     def __init__(self, batch=4, max_seq=64, page_size=8, num_pages=0,
-                 chunk=16, steps=4, steps_max=0, group=4, prefix_cache=False):
+                 chunk=16, steps=4, steps_max=0, group=4, prefix_cache=False,
+                 width_ladder=False):
         self.batch, self.max_seq = batch, max_seq
         self.page_size, self.chunk = page_size, chunk
         self.max_pages_per_slot = -(-max_seq // page_size)
@@ -106,6 +107,12 @@ class FakeCore:
         self.donates_state = False
         self.supports_long_prefill = False
         self.prefix_cache = prefix_cache
+        if width_ladder and batch > 2:
+            # decode batch-width ladder (engine.decode_widths): the
+            # scheduler dispatches at the narrowest rung covering the
+            # highest live slot, and rung transitions happen mid-stream as
+            # slots fill and drain — the fuzz menu exercises exactly that
+            self.decode_widths = (2, batch)
         self.cfg = SimpleNamespace(
             decode_steps_per_dispatch=steps, decode_steps_max=steps_max,
             prefill_group=group, long_prefill="off", prefill_hold_chunks=8,
@@ -189,9 +196,14 @@ class FakeCore:
         return st, toks
 
     def decode(self, st: _FakeState, table: np.ndarray, steps: int = 1,
-               use_grammar: bool = False, want_top: bool = False) -> tuple:
+               use_grammar: bool = False, want_top: bool = False,
+               width: int = 0) -> tuple:
         st = self._clone(st)
-        B, ps = self.batch, self.page_size
+        B, ps = (width or self.batch), self.page_size
+        # a narrow batch-width rung must cover every live slot — the
+        # scheduler's lowest-id-first allocation guarantees it; a slot at
+        # or past the rung would silently stall here, which the episode
+        # invariants catch as a livelock/diverged stream
         # 7 rows: the scheduler's unpack expects the logprob rows too
         # (they carry 0.0 bits here — the fake model has no distribution)
         out = np.zeros((7, steps, B), np.int32)
@@ -383,7 +395,11 @@ def _core_kw(rng: np.random.RandomState) -> Dict:
         steps=int(rng.choice([2, 4])),
         steps_max=int(rng.choice([0, 8])),
         group=int(rng.choice([1, 2, 4])),
-        prefix_cache=bool(rng.rand() < 0.5))
+        prefix_cache=bool(rng.rand() < 0.5),
+        # decode batch-width ladder: rung transitions mid-stream as slots
+        # fill/drain (r06 menu entry — the width picker races admissions,
+        # preemptions, and in-flight results here)
+        width_ladder=bool(rng.rand() < 0.5))
 
 
 def _shrink(seed: int, specs: List[_Spec], core_kw: Dict, err: str,
