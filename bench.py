@@ -49,6 +49,7 @@ from generativeaiexamples_tpu.core.config import EngineConfig
 from generativeaiexamples_tpu.core.metrics import REGISTRY
 from generativeaiexamples_tpu.observability.devtime import DEVTIME
 from generativeaiexamples_tpu.observability.flight import FLIGHT
+from generativeaiexamples_tpu.observability.usage import USAGE
 from generativeaiexamples_tpu.engine.engine import EngineCore
 from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
 from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
@@ -592,6 +593,16 @@ def run_disagg_round(n_workers: int = 3, n_requests: int = 12,
         h_cnt = handoff_h.count - h_cnt0
         handoff_ms = (round((handoff_h.sum - h_sum0) / h_cnt * 1e3, 2)
                       if h_cnt else 0.0)
+        # KV transport weight (ROADMAP item 1's HTTP-base64 seam) as a
+        # metric trend: p50 payload bytes from the router-side histogram
+        # this round's dispatches fed (server/failover.py observes it per
+        # prefill handoff)
+        payload_h = REGISTRY.histogram("router_kv_payload_bytes")
+        kv_payload_p50 = round(payload_h.percentile(50), 1)
+        # the fleet view the router aggregated from its probe cycle —
+        # per-worker role/occupancy/prefix-hit cards + fleet-summed tenant
+        # rollups (usage plane; baselined in the round JSON from r06 on)
+        fleet = router.fleet()
         return {
             "n_workers": n_workers,
             "topology": describe_topology(roles),
@@ -603,6 +614,8 @@ def run_disagg_round(n_workers: int = 3, n_requests: int = 12,
             # windowed percentile; the mean excludes the warm request)
             "handoff_ms": handoff_ms,
             "router_imbalance": round(imbalance, 4),
+            "kv_payload_bytes_p50": kv_payload_p50,
+            "fleet": fleet,
             "transport": "http-json-b64",
             "workers_backend": "tiny-cpu",
         }
@@ -763,6 +776,11 @@ def run_roofline_round() -> dict:
         "recompiles_total": dt_snap["recompiles_total"],
         "recompiles_delta": int(
             REGISTRY.counter("engine_recompiles_total").value - recomp0),
+        # usage plane (observability/usage.py): the round's per-tenant
+        # rollup — bench traffic is untenanted, so it baselines the "anon"
+        # vector (tokens, device-seconds via the attribution pass's rates,
+        # KV page-seconds) for r06
+        "usage_by_tenant": USAGE.rollup(),
         "device": str(jax.devices()[0]),
     }
 
@@ -1188,6 +1206,7 @@ def main() -> None:
             disagg = {"disagg_ttft_p50_s": d["disagg_ttft_p50_s"],
                       "handoff_ms": d["handoff_ms"],
                       "router_imbalance": d["router_imbalance"],
+                      "kv_payload_bytes_p50": d["kv_payload_bytes_p50"],
                       "disagg": d}
         except Exception as exc:
             # the single-chip numbers are still valid — report the phase
@@ -1333,9 +1352,13 @@ def main() -> None:
         "lora_tok_s_chip": round(lora_tok_s, 1),
         "embed_docs_s": round(emb_docs_s, 1),
         "rerank_pairs_s": round(rerank_pairs_s, 1),
+        # usage plane: this round's per-tenant rollup (bench traffic is
+        # untenanted → the "anon" vector), so cost-attribution fields land
+        # baselined in the trajectory from r06 on
+        "usage_by_tenant": USAGE.rollup(),
         # disaggregated serving round (present when >1 device or
-        # BENCH_DISAGG=1): router-observed TTFT, KV-handoff latency, and
-        # decode-replica dispatch imbalance
+        # BENCH_DISAGG=1): router-observed TTFT, KV-handoff latency,
+        # payload weight, and decode-replica dispatch imbalance
         **disagg,
         "device": str(jax.devices()[0]),
     }))

@@ -453,6 +453,89 @@ def check_retry_discipline(ctx: ModuleContext) -> Iterable[Finding]:
 
 
 # --------------------------------------------------------------------------
+# metric-cardinality
+# --------------------------------------------------------------------------
+
+# registry factory methods that mint a labeled time series per label SET
+_METRIC_FACTORIES = frozenset({"counter", "gauge", "histogram"})
+
+# identifiers whose value space is per-request / caller-controlled: using
+# one as a label value mints a fresh Prometheus series per request until
+# the process OOMs the scrape. Matched EXACTLY against the last dotted
+# component (request_id, rid, ...) — "worker"/"url"/"tenant" stay clean
+# (pool-bounded, or capped by the usage plane's overflow bucket).
+_UNBOUNDED_LABEL_NAMES = frozenset({
+    "request_id", "rid", "trace_id", "span_id", "session_id",
+    "prompt", "prompt_ids", "query", "text", "message", "content",
+})
+# call results that are unbounded by construction
+_UNBOUNDED_CALLS = frozenset({"uuid.uuid4", "uuid.uuid1", "uuid4", "uuid1",
+                              "time.time", "time.monotonic",
+                              "time.perf_counter"})
+
+
+def _registryish(expr: ast.AST) -> bool:
+    name = dotted_name(expr)
+    return name is not None and "registry" in name.lower()
+
+
+def _unbounded_label_value(value: ast.AST) -> Optional[str]:
+    """Why this label-value expression mints unbounded series, or None.
+    Walks the whole expression — f-strings, str()/format() wrappers, and
+    attribute chains all count; the hazard is the identifier inside."""
+    for node in ast.walk(value):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            name = dotted_name(node)
+            if name is not None \
+                    and name.rsplit(".", 1)[-1] in _UNBOUNDED_LABEL_NAMES:
+                return f"`{name}` is a per-request value"
+        if isinstance(node, ast.Call) \
+                and call_name(node) in _UNBOUNDED_CALLS:
+            return f"`{call_name(node)}()` mints a fresh value per call"
+    return None
+
+
+@rule("metric-cardinality", "error",
+      "Metric label value derived from a request id, prompt text, or "
+      "another unbounded per-request string — every distinct value mints "
+      "a new time series, growing the registry (and every scrape) without "
+      "bound")
+def check_metric_cardinality(ctx: ModuleContext) -> Iterable[Finding]:
+    """Fires on ``REGISTRY.counter/gauge/histogram(..., labels={...})``
+    (any receiver whose dotted name contains "registry") where a label
+    VALUE references a per-request identifier (request_id, trace_id,
+    prompt, ...) or an unbounded call (uuid4, time.*). Bounded label
+    sources — worker URLs (pool-sized), finish causes (enum), tenant ids
+    (capped by the usage plane's ``"other"`` overflow bucket) — pass.
+    The failure mode is exactly what observability/usage.py's
+    cardinality cap exists to prevent; this rule keeps the next labeled
+    metric from reintroducing it."""
+    for node in ctx.walk():
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute) \
+                or node.func.attr not in _METRIC_FACTORIES \
+                or not _registryish(node.func.value):
+            continue
+        labels = next((kw.value for kw in node.keywords
+                       if kw.arg == "labels"), None)
+        if not isinstance(labels, ast.Dict):
+            continue
+        for key_node, value in zip(labels.keys, labels.values):
+            why = _unbounded_label_value(value)
+            if why is None:
+                continue
+            key = (repr(key_node.value)
+                   if isinstance(key_node, ast.Constant) else "<label>")
+            yield Finding(
+                ctx.path, value.lineno, "metric-cardinality", "error",
+                f"label {key} on `{node.func.attr}` uses an unbounded "
+                f"value ({why}) — every distinct value is a new time "
+                "series; use a bounded enum, a capped id space "
+                "(observability/usage.py's tenant cap), or attach the id "
+                "as an exemplar/log field instead")
+
+
+# --------------------------------------------------------------------------
 # except-swallow
 # --------------------------------------------------------------------------
 

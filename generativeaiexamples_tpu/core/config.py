@@ -80,6 +80,18 @@ def env_float(name: str, default: float) -> float:
     return default
 
 
+def env_int(name: str, default: int) -> int:
+    """An int env knob with a warn-and-default parse (the usage plane's
+    APP_USAGE_MAX_TENANTS cardinality cap reads through this)."""
+    raw = os.environ.get(name, "")
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            logger.warning("ignoring non-numeric %s=%r", name, raw)
+    return default
+
+
 def configfield(name: str, *, default: Any = MISSING, default_factory: Any = MISSING,
                 help_txt: str = "") -> Any:
     """Declare a documented config field (ref: configuration_wizard.py:42-63).

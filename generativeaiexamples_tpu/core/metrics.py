@@ -217,6 +217,19 @@ class MetricsRegistry:
                 self._histograms[key] = Histogram(name, labels=labels)
             return self._histograms[key]
 
+    def family(self, name: str) -> Dict[LabelKey, float]:
+        """Every live series of one counter/gauge family, keyed by its
+        label set — the read surface label-bounded aggregations (the
+        usage plane's per-worker MFU card, the cardinality-cap tests)
+        use instead of groping through a full snapshot()."""
+        with self._lock:
+            out: Dict[LabelKey, float] = {
+                lk: c.value for (n, lk), c in self._counters.items()
+                if n == name}
+            out.update({lk: g.value for (n, lk), g in self._gauges.items()
+                        if n == name})
+        return out
+
     def snapshot(self) -> Dict[str, object]:
         """JSON metrics blob. Counters carry both a lifetime ``_per_s`` and
         a ``_rate_per_s`` windowed over the interval since the previous

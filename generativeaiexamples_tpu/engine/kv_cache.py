@@ -283,7 +283,11 @@ def _np_dtype(name: str):
 
 def encode_kv_payload(payload: dict) -> dict:
     """Host KV-handoff payload (numpy buffers) → JSON-safe dict: arrays
-    become {b64, dtype, shape} triples, everything else passes through."""
+    become {b64, dtype, shape} triples, everything else passes through.
+    The passthrough is a contract: sampling state, SLO class, and the
+    usage plane's ``tenant`` identity (observability/usage.py — the
+    decode replica must bill the same tenant the prefill worker did)
+    all ride the wire as plain scalar keys."""
     import base64
     import numpy as _np
     out = {}

@@ -46,6 +46,7 @@ import numpy as np
 from generativeaiexamples_tpu.core.metrics import REGISTRY
 from generativeaiexamples_tpu.observability import chaos as chaos_mod
 from generativeaiexamples_tpu.observability import slo as slo_mod
+from generativeaiexamples_tpu.observability import usage as usage_mod
 from generativeaiexamples_tpu.observability.devtime import DEVTIME, pow2_bucket
 from generativeaiexamples_tpu.observability.flight import FLIGHT, REQUEST_LOG
 from generativeaiexamples_tpu.engine.engine import (
@@ -137,6 +138,15 @@ class Request:
     trace_id: str = ""
     slo_outcome: Optional[str] = None
     slo: Optional[dict] = None
+    # Usage plane (observability/usage.py): the tenant identity this
+    # request bills to — extracted from X-Tenant-Id / API-key headers in
+    # the serving layer ("" bills as "anon"), riding the KV-handoff
+    # payload so a disaggregated chat's prefill and decode legs land
+    # under ONE tenant; kv_page_seconds accumulates pages-held x wall
+    # seconds, stamped by the scheduler at alloc/grow/release/export
+    # (preemption resumes keep accumulating on the same request).
+    tenant: str = ""
+    kv_page_seconds: float = 0.0
     # Disaggregated serving (engine roles): prefill_only requests run
     # chunked prefill and END at the first sampled token — instead of
     # decoding, the scheduler exports the slot's KV pages + sampling state
@@ -219,6 +229,10 @@ class _Job:
     # scheduler-global EMA at admission so fresh slots start where the
     # workload's recent acceptance actually sits
     spec_ema: float = -1.0
+    # page-second clock (usage plane): perf_counter of the last page-count
+    # change while this job holds pages; 0.0 = not holding (billing
+    # stopped). _bill_pages accumulates pages x elapsed into the request.
+    page_clock: float = 0.0
 
 
 class Scheduler:
@@ -418,6 +432,15 @@ class Scheduler:
         slot fill with every probe it already makes (server/failover.py)."""
         with self._lock:
             waiting = len(self._pending)
+        # per-replica prefix-cache coverage (ROADMAP items 1/3): the hit
+        # fraction is per-REPLICA today — at N replicas random routing
+        # divides it by N, which is exactly why the router's affinity work
+        # needs this signal per worker. Rides /health with every probe
+        # and mirrors to the prefix_hit_frac gauge on /metrics.
+        hits = REGISTRY.counter("prefix_hit_tokens").value
+        prompted = REGISTRY.counter("prefix_prompt_tokens").value
+        hit_frac = round(hits / prompted, 4) if prompted else 0.0
+        REGISTRY.gauge("prefix_hit_frac").set(hit_frac)
         return {
             "engine_role": self._role,
             "running": len(self._slots),
@@ -426,6 +449,7 @@ class Scheduler:
             "batch": int(getattr(self.core, "batch", 0) or 0),
             "kv_pages_free": int(getattr(self._alloc, "available", 0)),
             "inflight_dispatches": len(self._inflight),
+            "prefix_hit_frac": hit_frac,
         }
 
     def iter_text(self, request: Request) -> Iterator[str]:
@@ -469,6 +493,13 @@ class Scheduler:
             REGISTRY.counter("requests_finished",
                              labels={"finish": "error"}).inc()
             slo_mod.SLO.observe(job.request)
+            # page-second clocks close BEFORE the pool rebuild below: a
+            # driver reset must not leave a job billing pages the fresh
+            # allocator no longer tracks (conservation through resets —
+            # the fuzz harness asserts the bound)
+            self._bill_pages(job)
+            job.page_clock = 0.0
+            usage_mod.USAGE.bill_request(job.request)
             REQUEST_LOG.record(job.request)
             job.request.out_queue.put(_STOP)
             job.pages = []
@@ -482,8 +513,23 @@ class Scheduler:
         self._first_fetches = []
         self._pending_steps = 0
 
+    def _bill_pages(self, job: _Job) -> None:
+        """Accumulate the job's KV page-seconds (pages held x wall) into
+        its request and restamp the clock — called at EVERY page-count
+        change (admission alloc, decode growth, release/export) so the
+        usage plane's page-second vector integrates exactly the pages
+        this job actually occupied. A stopped clock (0.0) only restamps:
+        admission uses that to start billing."""
+        now = time.perf_counter()
+        if job.page_clock and job.pages:
+            job.request.kv_page_seconds += (len(job.pages)
+                                            * (now - job.page_clock))
+        job.page_clock = now
+
     def _release(self, job: _Job) -> None:
         """Return the job's slot and pages to the pools."""
+        self._bill_pages(job)
+        job.page_clock = 0.0      # billing stops with the hold
         if job.slot >= 0:
             # min-heap: admission reuses the LOWEST free slot id first, so
             # live slots compact toward 0 and the decode batch-width
@@ -536,6 +582,13 @@ class Scheduler:
         # the /debug/requests timeline and the breach record a client can
         # fetch right after [DONE] already carry the verdict
         slo_mod.SLO.observe(req)
+        # bill the usage ledger with the same happens-before discipline —
+        # page-seconds close out first (the job still holds its pages
+        # here; _release below would otherwise bill the final window
+        # AFTER the request was already recorded)
+        self._bill_pages(job)
+        job.page_clock = 0.0
+        usage_mod.USAGE.bill_request(req)
         REQUEST_LOG.record(req)
         req.out_queue.put(_STOP)
         # decode-written pages join the prefix cache before release: a
@@ -550,6 +603,11 @@ class Scheduler:
         REGISTRY.counter("requests_failed").inc()
         REGISTRY.counter("requests_finished", labels={"finish": "error"}).inc()
         slo_mod.SLO.observe(job.request)
+        # close out page-seconds before billing: failure paths that still
+        # hold pages (kv-export failure) release AFTER this call
+        self._bill_pages(job)
+        job.page_clock = 0.0
+        usage_mod.USAGE.bill_request(job.request)
         REQUEST_LOG.record(job.request)
         job.request.out_queue.put(_STOP)
 
@@ -807,6 +865,11 @@ class Scheduler:
             slot = heapq.heappop(self._free)   # lowest id first (see _release)
             job.slot = slot
             job.pages = pages
+            # start the page-second clock (usage plane): the request now
+            # occupies pool pages; growth/release restamp as the count
+            # changes. A preemption resume restarts here — its request
+            # keeps accumulating across holds.
+            self._bill_pages(job)
             job.prefilled = shared
             job.total_len = shared
             job.shared = shared
@@ -1172,6 +1235,11 @@ class Scheduler:
             "top_p": float(req.top_p),
             "stop": list(req.stop),
             "slo_class": req.slo_class,
+            # usage plane: the tenant identity rides the handoff so the
+            # decode replica bills this logical chat's decode leg to the
+            # SAME tenant the prefill leg billed (the wire encode passes
+            # non-array keys through untouched)
+            "tenant": req.tenant,
         })
         req.handoff = payload
         req.finish_reason = "handoff"
@@ -1250,6 +1318,9 @@ class Scheduler:
                     break
                 got = self._alloc_pages(1)
                 if got is not None:
+                    # bill the held window at the OLD page count before
+                    # the count changes (usage-plane page-seconds)
+                    self._bill_pages(job)
                     self._table[slot, len(job.pages)] = got[0]
                     job.pages.extend(got)
                     self._table_dev = None

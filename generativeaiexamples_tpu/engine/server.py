@@ -49,6 +49,7 @@ from generativeaiexamples_tpu.observability import chaos as chaos_mod
 from generativeaiexamples_tpu.observability import flight as flight_mod
 from generativeaiexamples_tpu.observability import otel
 from generativeaiexamples_tpu.observability import slo as slo_mod
+from generativeaiexamples_tpu.observability import usage as usage_mod
 from generativeaiexamples_tpu.server.common import (
     MAX_TOKENS_CAP, StreamDrain, add_debug_routes, metrics_handler,
     parse_stop, sse_done, sse_write,
@@ -184,6 +185,13 @@ class ModelServer:
         body = {"message": "Service is up.",
                 "slo_pressure": slo_mod.SLO.pressure(),
                 **stats}
+        # fleet usage plane (observability/usage.py): the per-tenant
+        # rollup and chip-utilization card piggyback on the probe cycle
+        # the routing frontend already runs — /debug/fleet on the router
+        # aggregates exactly these fields across workers. Both are
+        # bounded (tenant cardinality cap; fixed-size card).
+        body["usage_by_tenant"] = usage_mod.USAGE.rollup()
+        body["perf"] = usage_mod.worker_perf_card()
         if self.watchdog is not None:
             body["watchdog"] = self.watchdog.status()
             if not self.watchdog.serving_ok():
@@ -506,6 +514,8 @@ class ModelServer:
                 if rid_in:
                     slo_fields["request_id"] = rid_in
                 req = Request(prompt_ids=list(prompt_ids), prefill_only=True,
+                              tenant=usage_mod.tenant_from_headers(
+                                  request.headers),
                               **slo_fields, **sampling)
                 request["engine_request"] = req
                 self.scheduler.submit(req)
@@ -567,7 +577,12 @@ class ModelServer:
                 rid_in = inbound_request_id(request.headers)
                 if rid_in:
                     slo_fields["request_id"] = rid_in
+                # one tenant across the disagg route: explicit header →
+                # payload tenant → key hash (usage.handoff_tenant owns
+                # the precedence and its rationale)
+                tenant = usage_mod.handoff_tenant(request.headers, payload)
                 req = Request(
+                    tenant=tenant,
                     prompt_ids=[int(t)
                                 for t in payload.get("prompt_ids", [])],
                     max_tokens=int(payload.get("max_tokens", 128)),
@@ -661,6 +676,7 @@ class ModelServer:
         # be attributed to the base model by client-side accounting)
         model = adapter or self.model_name
         slo_fields = self._parse_slo(request)
+        tenant = usage_mod.tenant_from_headers(request.headers)
 
         rid_in = inbound_request_id(request.headers)
 
@@ -676,7 +692,7 @@ class ModelServer:
                 kw["request_id"] = rid_in if i == 0 else f"{rid_in}.{i}"
             return Request(prompt_ids=list(prompt_ids), grammar=grammar,
                            grammar_prefix=grammar_prefix, adapter=adapter,
-                           **slo_fields, **kw)
+                           tenant=tenant, **slo_fields, **kw)
 
         reqs = [make_req(i) for i in range(n)]
         req = reqs[0]

@@ -8,7 +8,8 @@ propagation, exporters pluggable (console, in-memory for tests, JSONL file).
 Sibling planes: ``flight`` (scheduler-state ring + request timelines),
 ``slo`` (budgets, burn rates, shed/hazard pressure), ``devtime`` (the
 per-dispatch device-time ledger + compile-watch — which program burned the
-chip, live), ``profiling`` (jax device traces).
+chip, live), ``usage`` (the per-tenant cost-attribution ledger — who spent
+it, fleet-wide), ``profiling`` (jax device traces).
 """
 
 from generativeaiexamples_tpu.observability.bootstrap import (  # noqa: F401
@@ -26,6 +27,11 @@ from generativeaiexamples_tpu.observability.flight import (  # noqa: F401
     install_signal_dump,
     timeline,
     timeline_attributes,
+)
+from generativeaiexamples_tpu.observability.usage import (  # noqa: F401
+    USAGE,
+    UsageLedger,
+    tenant_from_headers,
 )
 from generativeaiexamples_tpu.observability.otel import (  # noqa: F401
     ConsoleSpanExporter,

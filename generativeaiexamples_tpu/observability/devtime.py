@@ -106,7 +106,7 @@ class _Entry:
     __slots__ = ("program", "bucket", "count", "timed", "device_s", "queue_s",
                  "issue_s", "tokens", "padded_tokens", "timed_tokens",
                  "weight_passes", "first_seen_unix", "first_timed_s",
-                 "window")
+                 "last_timed_mono", "window")
 
     def __init__(self, program: str, bucket: str) -> None:
         self.program = program
@@ -122,6 +122,7 @@ class _Entry:
         self.weight_passes = 0.0   # full weight-set HBM reads
         self.first_seen_unix = time.time()
         self.first_timed_s: Optional[float] = None
+        self.last_timed_mono: Optional[float] = None
         # trailing timed (tokens, device_s, weight_passes) for live gauges
         self.window: deque = deque(maxlen=_WINDOW)
 
@@ -188,6 +189,11 @@ class DevtimeLedger:
         self._pad_window: deque = deque(maxlen=_WINDOW)
         self._pad_useful = 0.0
         self._pad_padded = 0.0
+        # monotonic of the newest TIMED commit: consumers of the live
+        # gauges (the usage plane's worker card) read the age to judge
+        # staleness — gauges hold their last value while idle, they do
+        # not decay
+        self._last_timed_mono: Optional[float] = None
         # tests may redirect the recompile hazard away from the global SLO
         self.hazard_sink: Optional[Callable[[str, Dict[str, Any]], None]] = None
         # the metric families exist (0-valued) from process start, so a
@@ -256,6 +262,7 @@ class DevtimeLedger:
             self._pad_window.clear()
             self._pad_useful = 0.0
             self._pad_padded = 0.0
+            self._last_timed_mono = None
             if not keep_warm:
                 self._warm.clear()
                 self._serving = False
@@ -356,6 +363,8 @@ class DevtimeLedger:
                 entry.device_s += device_s
                 entry.queue_s += queue_s
                 entry.timed_tokens += tokens
+                entry.last_timed_mono = time.monotonic()
+                self._last_timed_mono = entry.last_timed_mono
                 if entry.first_timed_s is None:
                     entry.first_timed_s = device_s
                 entry.window.append((tokens, device_s, weight_passes))
@@ -457,6 +466,53 @@ class DevtimeLedger:
         with self._lock:
             return sum(e.device_s + e.queue_s + e.issue_s
                        for e in self._entries.values())
+
+    def last_timed_age_s(self) -> Optional[float]:
+        """Seconds since the newest timed commit (None = never timed) —
+        how stale the live MFU/HBM gauges are: they hold their last
+        trailing-window value while the engine idles, so a consumer must
+        pair the value with this age."""
+        with self._lock:
+            last = self._last_timed_mono
+        return None if last is None else max(0.0, time.monotonic() - last)
+
+    def fresh_programs(self, max_age_s: float = 60.0) -> set:
+        """Programs with a timed commit inside the trailing window — the
+        per-program gauges (``engine_mfu{program}``) HOLD their last
+        value forever, so consumers aggregating across programs (the
+        usage plane's worker card) must restrict to programs that are
+        actually still dispatching or a one-off prefill burst's MFU
+        would read as 'current' all day."""
+        now = time.monotonic()
+        with self._lock:
+            return {e.program for e in self._entries.values()
+                    if e.last_timed_mono is not None
+                    and now - e.last_timed_mono <= max_age_s}
+
+    def phase_rates(self) -> Dict[str, Optional[float]]:
+        """Timed device-seconds per useful token for the two model-forward
+        program families — ``prefill`` (prefill / prefill_long) and
+        ``decode`` (decode / mixed variants) — the proration join the
+        usage plane (observability/usage.py) bills requests with.  Rates
+        come from TIMED dispatches only (device_s over timed_tokens, both
+        recorded by the same sampled commits), so sample-mode stride
+        never skews the ratio; a family with no timed samples (the
+        default off mode) reports None and billing falls back to token
+        counts."""
+        sums = {"prefill": [0.0, 0.0], "decode": [0.0, 0.0]}
+        with self._lock:
+            for entry in self._entries.values():
+                if not entry.timed or not entry.timed_tokens:
+                    continue
+                if entry.program.startswith("prefill"):
+                    fam = sums["prefill"]
+                elif entry.program.startswith(("decode", "mixed")):
+                    fam = sums["decode"]
+                else:
+                    continue
+                fam[0] += entry.device_s
+                fam[1] += entry.timed_tokens
+        return {k: (s / t if t else None) for k, (s, t) in sums.items()}
 
     def padding_waste(self) -> float:
         """Padded-token fraction NOT carrying useful positions over the

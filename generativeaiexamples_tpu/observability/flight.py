@@ -217,6 +217,9 @@ def timeline(req: Any) -> Dict[str, Any]:
         "prompt_tokens": len(getattr(req, "prompt_ids", []) or []),
         "finish": getattr(req, "finish_reason", None),
         "error": getattr(req, "error", None),
+        # usage plane (observability/usage.py): the tenant the request
+        # billed to — /debug/requests timelines join /debug/usage rows
+        "tenant": getattr(req, "tenant", "") or "anon",
         # SLO plane (observability/slo.py): the scheduler judges attainment
         # BEFORE recording, so timelines, breach records, and
         # slo_requests_total agree per request

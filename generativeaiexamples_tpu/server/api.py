@@ -36,6 +36,7 @@ from generativeaiexamples_tpu.core.metrics import REGISTRY
 from generativeaiexamples_tpu.core.tracing import instrumentation_wrapper
 from generativeaiexamples_tpu.observability import otel
 from generativeaiexamples_tpu.observability import slo as slo_mod
+from generativeaiexamples_tpu.observability import usage as usage_mod
 from generativeaiexamples_tpu.server.base import BaseExample
 from generativeaiexamples_tpu.server import guardrails as guardrails_mod
 from generativeaiexamples_tpu.server.common import (
@@ -157,6 +158,11 @@ class ChainServer:
                 text=json.dumps({"error": str(exc)}))
         deadline_ms: Optional[float] = (
             None if deadline_s is None else deadline_s * 1000.0)
+        # usage plane (observability/usage.py): the tenant identity from
+        # X-Tenant-Id / API-key headers rides the admission context, so
+        # every downstream engine dispatch (the failover router's
+        # prefill/handoff/retry legs included) bills the same tenant
+        tenant = usage_mod.tenant_from_headers(request.headers)
 
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
@@ -174,7 +180,8 @@ class ChainServer:
             # contextvars set in the handler coroutine don't cross threads.
             token = otel.set_request_id(rid)
             try:
-                with slo_mod.admission(slo_class, deadline_ms=deadline_ms):
+                with slo_mod.admission(slo_class, deadline_ms=deadline_ms), \
+                        usage_mod.tenant_scope(tenant):
                     yield from self._guarded_chain(query, history, use_kb,
                                                    settings)
             finally:

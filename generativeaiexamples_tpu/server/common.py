@@ -173,6 +173,38 @@ async def deadletter_handler(request: web.Request) -> web.Response:
     return web.json_response(dead_letter_payload())
 
 
+async def usage_handler(request: web.Request) -> web.Response:
+    """Per-tenant usage ledger of THIS process (observability/usage.py):
+    the full resource vectors — queue/prefill/decode seconds, tokens,
+    KV page-seconds, retries/hedges — with the cardinality-cap state and
+    the billing basis (devtime proration vs token fallback)."""
+    from generativeaiexamples_tpu.observability.usage import USAGE
+    return web.json_response(USAGE.snapshot())
+
+
+async def fleet_handler(request: web.Request) -> web.Response:
+    """Fleet view from the process's routing frontend (server/failover.py):
+    per-worker role/load/cache/chip cards from the probe cycle plus the
+    fleet-summed per-tenant rollups. Processes without a router (a lone
+    engine worker) answer with their own single-worker equivalent: local
+    usage + perf, no probes."""
+    from generativeaiexamples_tpu.observability import usage as usage_mod
+    from generativeaiexamples_tpu.server import failover as failover_mod
+    router = failover_mod.current_router()
+    if router is None:
+        return web.json_response({
+            "workers": {},
+            "note": "no routing frontend in this process; local view only",
+            "tenants": usage_mod.USAGE.rollup(),
+            "local_perf": usage_mod.worker_perf_card(),
+        })
+    # fleet() may re-probe stale workers over HTTP — keep it off the
+    # event loop
+    loop = asyncio.get_running_loop()
+    body = await loop.run_in_executor(None, router.fleet)
+    return web.json_response(body)
+
+
 async def slo_handler(request: web.Request) -> web.Response:
     """Per-class SLO attainment, burn rates, pressure, recent breaches
     (observability/slo.py) — the operator view of 'are we keeping our
@@ -208,6 +240,11 @@ def add_debug_routes(app: web.Application) -> None:
         # event agents' dead-letter ring (docs/robustness.md)
         web.get("/debug/chaos", chaos_handler),
         web.get("/debug/deadletter", deadletter_handler),
+        # fleet usage plane: this process's per-tenant ledger, and the
+        # router's cross-worker aggregation (docs/observability.md
+        # "Who spent the chip")
+        web.get("/debug/usage", usage_handler),
+        web.get("/debug/fleet", fleet_handler),
     ])
 
 

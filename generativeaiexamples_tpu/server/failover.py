@@ -56,9 +56,32 @@ from generativeaiexamples_tpu.core.metrics import REGISTRY
 from generativeaiexamples_tpu.observability import chaos as chaos_mod
 from generativeaiexamples_tpu.observability import otel
 from generativeaiexamples_tpu.observability import slo as slo_mod
+from generativeaiexamples_tpu.observability import usage as usage_mod
 from generativeaiexamples_tpu.server import resilience
 
 logger = logging.getLogger(__name__)
+
+# the process's routing frontend, registered at FailoverLLM construction:
+# GET /debug/fleet (server/common.py) answers from whichever router this
+# process last built — the fleet view lives where the probes live
+_ROUTER: Optional["FailoverLLM"] = None
+
+
+def register_router(router: Optional["FailoverLLM"]) -> None:
+    global _ROUTER
+    _ROUTER = router
+
+
+def current_router() -> Optional["FailoverLLM"]:
+    return _ROUTER
+
+# numeric per-worker /health fields the router re-exports on its OWN
+# /metrics as `fleet_worker_<field>{worker="<url>"}` gauges — the
+# federated view: one scrape of the router answers "which replica holds
+# the cache / burns the chip" without scraping N workers
+_FLEET_GAUGE_FIELDS = ("occupancy", "prefix_hit_frac", "mfu",
+                       "hbm_read_util", "padding_waste_frac", "recompiles",
+                       "waiting", "kv_pages_free")
 
 _PRESSURE_GAUGE = {"ok": 0, "warn": 1, "critical": 2}
 # least-loaded scoring: an alive-but-burning worker yields to a healthy one
@@ -95,6 +118,15 @@ class _Worker:
         # slo.py rides the liveness body): "" until first probed. A worker
         # can be alive-but-burning — the pool surfaces that distinction.
         self.slo_pressure = ""
+        # fleet usage plane (observability/usage.py): the per-tenant
+        # rollup, chip-utilization card, prefix-cache coverage, and
+        # watchdog state the worker's /health body piggybacks on the
+        # probes this pool already makes — /debug/fleet aggregates these
+        self.kv_pages_free = 0
+        self.prefix_hit_frac = 0.0
+        self.perf: Dict[str, object] = {}
+        self.usage: Dict[str, Dict[str, float]] = {}
+        self.watchdog: Optional[Dict[str, object]] = None
 
     def healthy(self, timeout: float = 2.0) -> bool:
         try:
@@ -112,12 +144,28 @@ class _Worker:
                         self.batch = int(body.get("batch", 0) or 0)
                         self.slo_pressure = str(
                             body.get("slo_pressure", "") or "")
+                        # fleet piggyback: usage/cache/perf rollups ride
+                        # the same probe (engine/server.py health)
+                        self.kv_pages_free = int(
+                            body.get("kv_pages_free", 0) or 0)
+                        self.prefix_hit_frac = float(
+                            body.get("prefix_hit_frac", 0.0) or 0.0)
+                        perf = body.get("perf")
+                        self.perf = dict(perf) if isinstance(perf, dict) \
+                            else {}
+                        rollup = body.get("usage_by_tenant")
+                        self.usage = dict(rollup) \
+                            if isinstance(rollup, dict) else {}
+                        wd = body.get("watchdog")
+                        self.watchdog = dict(wd) if isinstance(wd, dict) \
+                            else None
                     except (ValueError, UnicodeDecodeError, TypeError) as exc:
                         logger.debug("health body from %s unparsable: %s",
                                      self.url, exc)
                         self.role = self.role or "unified"
                     self.probed_at = time.monotonic()
                     self.dispatched = 0
+                    self._export_fleet_gauges()
                     if self.slo_pressure in _PRESSURE_GAUGE:
                         # per-worker pressure on the POOL CLIENT's own
                         # /metrics (0/1/2) — the operator view of
@@ -136,6 +184,59 @@ class _Worker:
             # for — debug keeps the recovery loop quiet but traceable
             logger.debug("health probe %s failed: %s", self.url, exc)
             return False
+
+    @property
+    def occupancy(self) -> float:
+        """Live slot fill from the last probe (running / batch)."""
+        return self.running / self.batch if self.batch else 0.0
+
+    def card(self, now: Optional[float] = None) -> Dict[str, object]:
+        """This worker's row of the fleet view (/debug/fleet): role,
+        load, cache affinity, chip utilization, watchdog state, and the
+        per-tenant usage rollup — everything the probe cycle carried."""
+        now = time.monotonic() if now is None else now
+        return {
+            "role": self.role or "unified",
+            "down": self.down_until > now,
+            "probe_age_s": (round(now - self.probed_at, 3)
+                            if self.probed_at else None),
+            "score": round(self.score, 4),
+            "occupancy": round(self.occupancy, 4),
+            "running": self.running,
+            "prefilling": self.prefilling,
+            "waiting": self.waiting,
+            "batch": self.batch,
+            "kv_pages_free": self.kv_pages_free,
+            "prefix_hit_frac": self.prefix_hit_frac,
+            "slo_pressure": self.slo_pressure,
+            "dispatched": self.total_dispatched,
+            "watchdog": self.watchdog,
+            **{k: self.perf.get(k) for k in ("mfu", "hbm_read_util",
+                                             "measured_age_s",
+                                             "padding_waste_frac",
+                                             "recompiles")},
+            "usage_by_tenant": self.usage,
+        }
+
+    def _export_fleet_gauges(self) -> None:
+        """Mirror this worker's numeric probe fields onto the ROUTER
+        process's /metrics as `fleet_worker_<field>{worker=...}` — the
+        federated re-export (label cardinality bounded by the pool
+        size). Runs on every good probe, so the gauges track the same
+        refresh cycle the routing decisions use."""
+        card = self.card()
+        for field in _FLEET_GAUGE_FIELDS:
+            value = card.get(field)
+            if isinstance(value, bool) or not isinstance(value,
+                                                         (int, float)):
+                continue
+            REGISTRY.gauge(f"fleet_worker_{field}",
+                           labels={"worker": self.url}).set(value)
+        # liveness marker: the other fleet_worker_* gauges HOLD their
+        # last probed value (a dead worker's series would otherwise read
+        # as a healthy one forever) — scrape consumers must join on this
+        REGISTRY.gauge("fleet_worker_up",
+                       labels={"worker": self.url}).set(1.0)
 
     @property
     def score(self) -> float:
@@ -195,6 +296,8 @@ class FailoverLLM:
         # concurrent chat threads; health probes stay outside it (HTTP
         # under a lock is a tpulint-enforced hazard)
         self._lock = threading.Lock()
+        # the fleet view (GET /debug/fleet) answers from this router
+        register_router(self)
 
     # ------------------------------------------------------------- selection
 
@@ -212,13 +315,63 @@ class FailoverLLM:
                     self._mark_down(w)
             self._discovered = True
 
-    def topology(self) -> Dict[str, List[str]]:
-        """Discovered role → worker-URL map (bench + debugging surface)."""
+    def topology(self, detail: bool = False) -> Dict[str, list]:
+        """Discovered role → worker map (bench + debugging surface).
+        Default shape is role → [url, ...]; ``detail=True`` lists each
+        worker's routing card instead — load, ``prefix_hit_frac`` (the
+        item-1 affinity signal, per replica), chip utilization — so the
+        affinity work reads its signal off the same surface."""
         self._ensure_roles()
-        out: Dict[str, List[str]] = {}
+        out: Dict[str, list] = {}
+        now = time.monotonic()
         for w in self._workers:
-            out.setdefault(w.role or "unified", []).append(w.url)
+            entry = {"url": w.url, **w.card(now)} if detail else w.url
+            out.setdefault(w.role or "unified", []).append(entry)
         return out
+
+    def fleet(self, max_probe_age_s: Optional[float] = None
+              ) -> Dict[str, object]:
+        """The ``GET /debug/fleet`` body: every worker's probe card
+        (role, occupancy, MFU, padding waste, prefix-hit frac,
+        recompiles, watchdog state) plus the FLEET-SUMMED per-tenant
+        usage rollup — one logical chat's prefill-worker and
+        decode-replica legs land in one tenant row (usage rides the
+        handoff, so both workers bill the same key).
+
+        Probes refresh lazily on the serving path; a fleet read
+        re-probes only workers whose view is older than
+        ``max_probe_age_s`` (default: the router's refresh interval), so
+        polling /debug/fleet during an incident costs at most one probe
+        round, not a stampede."""
+        self._ensure_roles()
+        stale_after = (self.refresh_s if max_probe_age_s is None
+                       else max_probe_age_s)
+        now = time.monotonic()
+        for w in self._workers:
+            if w.down_until > now:
+                continue
+            if now - w.probed_at > stale_after and not w.healthy():
+                self._mark_down(w)
+        now = time.monotonic()
+        workers = {w.url: w.card(now) for w in self._workers}
+        up = [c for c in workers.values() if not c["down"]]
+        return {
+            "workers": workers,
+            "roles": {role: [w.url for w in self._workers
+                             if (w.role or "unified") == role]
+                      for role in {(w.role or "unified")
+                                   for w in self._workers}},
+            # summed over EVERY worker's last-known rollup, down ones
+            # included: the vectors are cumulative, so dropping a
+            # circuit-broken worker would make fleet totals DIP during
+            # the outage and jump back on recovery — a differencing
+            # consumer (quota accounting) would see phantom swings
+            "tenants": usage_mod.merge_rollups(
+                c.get("usage_by_tenant") or {} for c in workers.values()),
+            "workers_up": len(up),
+            "workers_down": len(workers) - len(up),
+            "generated_unix": round(time.time(), 3),
+        }
 
     def dispatch_counts(self) -> Dict[str, Dict[str, object]]:
         """Per-worker lifetime dispatch counts + roles (bench reads the
@@ -318,6 +471,10 @@ class FailoverLLM:
         # once the cooldown expires the worker is HALF-OPEN: one canary
         # health probe (single-flight) must pass before traffic returns
         w.half_open = True
+        # the federated gauges keep the worker's last probed values; the
+        # up marker flips so a scrape can tell stale-because-dead from
+        # live (the /debug/fleet card carries the same `down` flag)
+        REGISTRY.gauge("fleet_worker_up", labels={"worker": w.url}).set(0.0)
         logger.warning("engine worker %s marked down for %.0fs", w.url,
                        self.cooldown_s)
 
@@ -357,6 +514,13 @@ class FailoverLLM:
         token."""
         headers = slo_mod.outbound_headers()
         headers["X-Request-Id"] = rid
+        # usage plane: the ambient tenant identity (set by the chain
+        # server from the inbound request) rides EVERY dispatch of a
+        # logical request — prefill, handoff, retries, hedges — so each
+        # worker bills the same tenant
+        tenant = usage_mod.current_tenant()
+        if tenant:
+            headers["X-Tenant-Id"] = tenant
         otel.inject_traceparent(headers, span=span)
         return headers
 
@@ -407,14 +571,17 @@ class FailoverLLM:
     def _chat_unified(self, messages, max_tokens, temperature, top_p,
                       top_k, response_format,
                       emitted: Optional[List[str]] = None,
-                      rid: Optional[str] = None, span=None) -> Iterator[str]:
+                      rid: Optional[str] = None, span=None,
+                      attempt_base: int = 0) -> Iterator[str]:
         """The round-3 failover path over unified/decode workers, selection
         upgraded from round-robin to least-loaded. ``emitted`` carries a
         prefix already delivered to the consumer (a disaggregated route
         falling back mid-stream) — it rides as ``continue_text`` so the
         stream resumes instead of restarting. ``rid``/``span`` ride from
         the calling route so retries and fallbacks keep one request id and
-        one trace."""
+        one trace; ``attempt_base`` carries the calling route's spent
+        attempts so a fallback's first dispatch still bills as the
+        logical request's retry (usage plane)."""
         import httpx
 
         emitted = [] if emitted is None else emitted
@@ -431,6 +598,10 @@ class FailoverLLM:
             if w is None:
                 last_err = RuntimeError("no unified/decode worker up")
                 continue
+            if attempt + attempt_base:
+                # billed only once a worker is actually dispatched to —
+                # a total outage burns no fleet capacity, so it bills none
+                usage_mod.USAGE.bill_retry()
             payload = self._payload(messages, max_tokens, temperature,
                                     top_p, top_k, response_format, emitted,
                                     stream=True)
@@ -506,12 +677,17 @@ class FailoverLLM:
                                                   temperature, top_p, top_k,
                                                   response_format,
                                                   emitted=emitted,
-                                                  rid=rid, span=span)
+                                                  rid=rid, span=span,
+                                                  attempt_base=attempt)
                     return
                 pw = self._pick(("prefill",))
                 if pw is None:
                     last_err = RuntimeError("no prefill worker up")
                     continue
+                if attempt:
+                    # a retry bills once it reaches a worker (see the
+                    # unified loop) — attempts that found no one up don't
+                    usage_mod.USAGE.bill_retry()
                 payload = self._payload(messages, max_tokens, temperature,
                                         top_p, top_k, response_format,
                                         emitted, stream=False)
@@ -534,6 +710,12 @@ class FailoverLLM:
                     last_err = exc
                     self._mark_down(pw)
                     continue
+                # the KV transport's weight as a metric TREND, not just a
+                # span attribute: ROADMAP item 1's HTTP-base64 seam is
+                # priced per request on /metrics (bench.py reports the
+                # p50 in the disagg round JSON)
+                REGISTRY.histogram("router_kv_payload_bytes").observe(
+                    float(len(resp.content)))
                 if span is not None:
                     span.set_attribute("router.attempts", attempt + 1)
                     span.set_attribute("router.prefill_worker", pw.url)
@@ -651,10 +833,14 @@ class FailoverLLM:
         # nothing — and dropping the deadline header would disable
         # deadline accounting on every hedged-mode request
         headers = self._headers(rid, span)
+        # tenant captured here for the same reason: the hedge-billing
+        # call below runs on the hedge thread's empty context
+        tenant = usage_mod.current_tenant()
 
         def open_one(w: _Worker):
             if w is not cands[0]:
                 self._charge(w)   # the hedge leg launched: NOW it counts
+                usage_mod.USAGE.bill_hedge(tenant or None)
             if chaos_mod.CHAOS.enabled:
                 chaos_mod.CHAOS.http_fault("router.handoff")
             cm = httpx.stream("POST", f"{w.url}/v1/kv/handoff",
@@ -712,6 +898,8 @@ class FailoverLLM:
             if w is None:
                 last_err = RuntimeError("no unified/decode worker up")
                 continue
+            if attempt:
+                usage_mod.USAGE.bill_retry()
             try:
                 if chaos_mod.CHAOS.enabled:
                     chaos_mod.CHAOS.http_fault("router.tools")

@@ -221,6 +221,11 @@ class _FakeWorker:
         self.delay = delay           # seconds before serving any POST
         self.reject_handoffs = reject_handoffs   # first N handoffs get 409
         self.hits = {"health": 0, "prefill": 0, "handoff": 0, "chat": 0}
+        # last request headers seen per endpoint key — the usage-plane
+        # tests assert the router forwards X-Tenant-Id on every dispatch
+        self.headers: dict = {}
+        # extra canned fields merged into the /health body (fleet rollups)
+        self.health_extra: dict = {}
         worker = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -251,11 +256,16 @@ class _FakeWorker:
                     "message": "up", "engine_role": worker.role,
                     "running": worker.running, "prefilling": 0,
                     "waiting": worker.waiting, "batch": worker.batch,
-                    "slo_pressure": worker.pressure}).encode(),
+                    "slo_pressure": worker.pressure,
+                    **worker.health_extra}).encode(),
                     "application/json")
 
             def do_POST(self):
                 self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                ep = ("prefill" if self.path == "/v1/kv/prefill"
+                      else "handoff" if self.path == "/v1/kv/handoff"
+                      else "chat")
+                worker.headers[ep] = dict(self.headers)
                 if worker.delay:
                     time.sleep(worker.delay)
                 if self.path == "/v1/kv/handoff" and worker.reject_handoffs:

@@ -274,6 +274,7 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
         return orig_fetch(arr, metric)
 
     sched_mod._fetch = jittery_fetch
+    t_wall0 = time.perf_counter()
     try:
         reqs = []
         for sp in specs:
@@ -357,6 +358,25 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
             return f"slot leak: free={sorted(sched._free)}"
         if sched._slots or sched._prefilling or sched._pending:
             return "jobs left in scheduler after drain"
+        # page-second conservation (usage plane, observability/usage.py):
+        # billed pages-held x wall must never exceed what the pool could
+        # physically supply over the episode — a clock left open across a
+        # preemption or a driver reset (the worker.die chaos menu) would
+        # overshoot this bound; a stamp skipped at release would undershoot
+        # the per-request positivity check below
+        wall = time.perf_counter() - t_wall0
+        total_page_s = sum(r.kv_page_seconds for r, _ in reqs)
+        if total_page_s > wall * core.num_pages * 1.01 + 1e-6:
+            return (f"page-seconds overshoot: billed {total_page_s:.4f}s "
+                    f"> pool capacity {wall * core.num_pages:.4f}s "
+                    f"(wall={wall:.4f}s pages={core.num_pages})")
+        for i, (req, sp) in enumerate(reqs):
+            if req.kv_page_seconds < 0:
+                return f"req {i}: negative page-seconds"
+            if req.completion_tokens and req.kv_page_seconds <= 0:
+                # it streamed tokens, so it HELD pages across dispatches
+                return (f"req {i}: emitted {req.completion_tokens} tokens "
+                        f"but billed zero page-seconds")
         return None
     finally:
         sched_mod._fetch = orig_fetch
@@ -447,6 +467,10 @@ _CHAOS_MENUS = (
     "page.exhaust=0.3",
     "page.exhaust=0.15,tick.stall=0.05/0.001",
     "worker.die=0.002,page.exhaust=0.1",
+    # r06 usage-plane menu: heavy preemption churn + more frequent driver
+    # resets — the page-second conservation invariant must hold through
+    # both (clocks close at _release, _fail, and the _fail_all reset path)
+    "worker.die=0.004,page.exhaust=0.25",
 )
 
 

@@ -719,6 +719,56 @@ def test_retry_discipline_exempts_pump_and_reprompt_loops():
 
 
 # ---------------------------------------------------------------------------
+# metric-cardinality
+# ---------------------------------------------------------------------------
+
+def test_metric_cardinality_flags_unbounded_label_values():
+    src = """
+    import uuid
+    from generativeaiexamples_tpu.core.metrics import REGISTRY
+
+    def bill(req, rid):
+        REGISTRY.counter("reqs", labels={"rid": rid}).inc()
+        REGISTRY.histogram("lat", labels={"req": req.request_id}).observe(1)
+        REGISTRY.gauge("g", labels={"id": f"req-{req.trace_id}"}).set(1)
+        REGISTRY.counter("c", labels={"call": str(uuid.uuid4())}).inc()
+    """
+    fnd = findings_for(src, only="metric-cardinality")
+    assert [f.line for f in fnd] == [6, 7, 8, 9]
+    assert "new time series" in fnd[0].message
+    assert "request_id" in fnd[1].message
+
+
+def test_metric_cardinality_clean_on_bounded_labels():
+    # pool-bounded worker URLs, enum finish causes, and cap-bounded
+    # tenant keys are the legitimate label sources the tree uses; a
+    # labels dict on a non-registry object is out of scope
+    src = """
+    from generativeaiexamples_tpu.core.metrics import REGISTRY
+
+    def observe(self, w, finish, tenant):
+        REGISTRY.counter("d", labels={"worker": w.url, "role": w.role}).inc()
+        REGISTRY.counter("f", labels={"finish": finish}).inc()
+        REGISTRY.counter("u", labels={"tenant": tenant, "dir": "in"}).inc(3)
+        self.tracker.counter("x", labels={"rid": self.rid})
+    """
+    assert findings_for(src, only="metric-cardinality") == []
+
+
+def test_metric_cardinality_suppressible_with_reason():
+    src = """
+    from generativeaiexamples_tpu.core.metrics import REGISTRY
+
+    def record(rid):
+        REGISTRY.counter("one_off", labels={"rid": rid}).inc()   # tpulint: disable=metric-cardinality -- bounded: test harness mints 3 ids
+    """
+    sup = Suppressions(textwrap.dedent(src))
+    fnd = [f for f in findings_for(src, only="metric-cardinality")
+           if not sup.is_suppressed(f.rule, f.line)]
+    assert fnd == []
+
+
+# ---------------------------------------------------------------------------
 
 def test_devtime_fence_flags_both_fence_forms():
     src = """
@@ -768,6 +818,8 @@ def test_every_registered_rule_has_a_firing_fixture():
         "import jax\njax.block_until_ready(x)\n",
         "while True:\n    try:\n        connect()\n"
         "    except Exception:\n        continue\n",
+        "def f(rid):\n"
+        "    REGISTRY.counter('c', labels={'rid': rid}).inc()\n",
     ]
     for src in snippets:
         fired |= {f.rule for f in analyze_source("s.py", src)}

@@ -1,0 +1,664 @@
+"""Fleet usage plane (observability/usage.py): per-tenant cost attribution.
+
+Billing correctness is checked against HAND-COMPUTED resource vectors over
+the deterministic FakeCore scheduler harness (tests/test_scheduler_fuzz.py
+— real Scheduler, pure-numpy core, no compile cost): token counts must be
+exact, queue/page-second integrals bounded and positive, and the
+devtime-off token fallback vs devtime-on proration split must behave as
+documented.  The disaggregated-route invariant — ONE logical chat's
+prefill-worker and decode-replica legs bill the SAME tenant — runs
+in-process over a prefill-role and a decode-role scheduler pair joined by
+a real ``Request.handoff`` payload, and at the HTTP layer over the fake
+worker harness from tests/test_failover.py (the router must forward
+``X-Tenant-Id`` on every dispatch).  The cardinality cap (overflow into
+``"other"``) is enforced on both the ledger and the Prometheus label
+space.
+"""
+
+import asyncio
+import json
+import queue
+import time
+
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler, _STOP
+from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+from generativeaiexamples_tpu.observability import usage as usage_mod
+from generativeaiexamples_tpu.observability.devtime import DEVTIME
+from generativeaiexamples_tpu.observability.usage import (
+    USAGE, UsageLedger, merge_rollups, tenant_from_headers)
+from generativeaiexamples_tpu.server import failover as failover_mod
+from generativeaiexamples_tpu.server.failover import FailoverLLM
+
+from tests.test_failover import MESSAGES, _FakeWorker, _fake_pool
+from tests.test_scheduler_fuzz import EOS, FakeCore, oracle
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+
+def _drive(sched: Scheduler, max_ticks: int = 20000) -> None:
+    """Drive Scheduler._tick on the test thread until fully drained
+    (the fuzz harness's loop shape — in-flight futures land on fetcher
+    threads, so idle ticks must spin briefly, not assert instantly)."""
+    idle = 0
+    for _ in range(max_ticks):
+        if sched._tick():
+            idle = 0
+            continue
+        idle += 1
+        if idle > 50:
+            return
+        time.sleep(0.0005)
+    raise AssertionError("scheduler livelocked")
+
+
+def _join(req: Request) -> str:
+    parts = []
+    while True:
+        item = req.out_queue.get(timeout=10)
+        if item is _STOP:
+            return "".join(parts)
+        parts.append(item)
+
+
+def _prompt(n: int, family: int = 0):
+    return [32 + (i * 11 + family * 7) % 150 for i in range(n)]
+
+
+def _hdr(headers: dict, name: str):
+    """Case-insensitive header lookup (transports may re-case names)."""
+    return {k.lower(): v for k, v in headers.items()}.get(name.lower())
+
+
+@pytest.fixture()
+def fresh_usage():
+    USAGE.reset()
+    yield USAGE
+    USAGE.reset()
+
+
+# ---------------------------------------------------------------------------
+# identity extraction
+# ---------------------------------------------------------------------------
+
+def test_handoff_tenant_payload_outranks_key_hash():
+    """One chat, one tenant: on the handoff admission the PAYLOAD tenant
+    (stamped by the prefill worker) outranks an API-key hash — an
+    auth-fronted decode worker must not split the chat's legs across two
+    tenant keys. The explicit header still wins over everything."""
+    payload = {"tenant": "acme"}
+    assert usage_mod.handoff_tenant(
+        {"Authorization": "Bearer sk-1"}, payload) == "acme"
+    assert usage_mod.handoff_tenant(
+        {"X-Tenant-Id": "beta", "Authorization": "Bearer sk-1"},
+        payload) == "beta"
+    assert usage_mod.handoff_tenant({}, {}) == "anon"
+    assert usage_mod.handoff_tenant(
+        {"Authorization": "Bearer sk-1"}, {}).startswith("key-")
+
+
+def test_tenant_from_headers_precedence_and_hashing():
+    assert tenant_from_headers({"X-Tenant-Id": "Acme-1"}) == "Acme-1"
+    # label-unsafe characters are stripped, length capped
+    assert tenant_from_headers({"X-Tenant-Id": 'ac me"{x}'}) == "acmex"
+    # a caller CLAIMING a sentinel bucket name is escaped — real traffic
+    # must never alias the overflow/default rows (a customer named
+    # "other" would absorb every folded tenant's bills)
+    assert tenant_from_headers({"X-Tenant-Id": "other"}) == "t_other"
+    assert tenant_from_headers({"X-Tenant-Id": "anon"}) == "t_anon"
+    # ...and the escape is idempotent across the handoff round-trip
+    assert usage_mod.sanitize_tenant("t_other") == "t_other"
+    # API keys hash to a short stable digest — the raw key never becomes
+    # a label value or a debug-surface string
+    k1 = tenant_from_headers({"Authorization": "Bearer sk-secret-123"})
+    k2 = tenant_from_headers({"Authorization": "Bearer sk-secret-123"})
+    k3 = tenant_from_headers({"X-Api-Key": "sk-other"})
+    assert k1 == k2 and k1.startswith("key-") and "secret" not in k1
+    assert k3 != k1
+    assert tenant_from_headers({}) == "anon"
+    assert tenant_from_headers({}, default="") == ""
+
+
+# ---------------------------------------------------------------------------
+# billing correctness (FakeCore scheduler, devtime off = token fallback)
+# ---------------------------------------------------------------------------
+
+def test_billing_vector_matches_hand_computed(fresh_usage):
+    core = FakeCore(batch=4, max_seq=64, page_size=8, chunk=16)
+    sched = Scheduler(core, ByteTokenizer())
+    t0 = time.perf_counter()
+    try:
+        specs = [("acme", 12, 8), ("acme", 20, 6), ("beta", 9, 10)]
+        reqs = []
+        for i, (tenant, plen, max_toks) in enumerate(specs):
+            r = Request(prompt_ids=_prompt(plen, family=i), tenant=tenant,
+                        max_tokens=max_toks, temperature=0.0)
+            reqs.append(r)
+            sched.submit(r)
+        _drive(sched)
+        texts = [_join(r) for r in reqs]
+        wall = time.perf_counter() - t0
+    finally:
+        sched._fetcher.shutdown(wait=False)
+
+    tok = ByteTokenizer()
+    want = {}
+    for (tenant, plen, max_toks), r, text in zip(specs, reqs, texts):
+        ids = oracle(r.prompt_ids, max_toks, core.max_seq)
+        assert text == tok.decode(ids)
+        agg = want.setdefault(tenant, {"req": 0, "tok_in": 0, "tok_out": 0})
+        agg["req"] += 1
+        agg["tok_in"] += plen
+        agg["tok_out"] += len(ids)
+
+    snap = USAGE.snapshot()
+    # devtime is off: no timed prefill/decode samples to prorate — the
+    # vector falls back to token counts as the cost basis
+    assert snap["basis"] == "tokens"
+    assert set(snap["tenants"]) == {"acme", "beta"}
+    for tenant, exp in want.items():
+        vec = snap["tenants"][tenant]
+        assert vec["requests"] == exp["req"]
+        assert vec["tokens_in"] == exp["tok_in"]
+        assert vec["tokens_out"] == exp["tok_out"]
+        assert vec["prefill_device_s"] == 0.0
+        assert vec["decode_device_s"] == 0.0
+        assert vec["errors"] == 0
+        # the request HELD pool pages while serving, and never more than
+        # the pool could supply over the episode
+        assert 0 < vec["kv_page_s"] <= wall * core.num_pages
+        assert 0 <= vec["queue_s"] <= wall
+    # bounded per-tenant Prometheus families billed alongside
+    fam = REGISTRY.family("usage_requests_total")
+    labeled = {dict(lk).get("tenant") for lk in fam}
+    assert {"acme", "beta"} <= labeled
+
+
+def test_anon_default_and_request_log_tenant(fresh_usage):
+    from generativeaiexamples_tpu.observability.flight import REQUEST_LOG
+
+    core = FakeCore(batch=2, max_seq=64, page_size=8)
+    sched = Scheduler(core, ByteTokenizer())
+    try:
+        r = Request(prompt_ids=_prompt(6), max_tokens=4, temperature=0.0)
+        sched.submit(r)
+        _drive(sched)
+        _join(r)
+    finally:
+        sched._fetcher.shutdown(wait=False)
+    assert set(USAGE.snapshot()["tenants"]) == {"anon"}
+    # the /debug/requests timeline carries the tenant field (satellite:
+    # REQUEST_LOG entries join /debug/usage rows)
+    rec = REQUEST_LOG.get(r.request_id)
+    assert rec is not None and rec["tenant"] == "anon"
+
+
+# ---------------------------------------------------------------------------
+# devtime-on proration
+# ---------------------------------------------------------------------------
+
+def test_devtime_on_prorates_device_seconds(fresh_usage):
+    prior = DEVTIME.mode
+    DEVTIME.reset(keep_warm=True)
+    DEVTIME.configure(mode="on")
+    core = FakeCore(batch=2, max_seq=64, page_size=8)
+    sched = Scheduler(core, ByteTokenizer())
+    try:
+        r = Request(prompt_ids=_prompt(18), tenant="acme", max_tokens=8,
+                    temperature=0.0)
+        sched.submit(r)
+        _drive(sched)
+        text = _join(r)
+        assert text    # really generated
+    finally:
+        sched._fetcher.shutdown(wait=False)
+        DEVTIME.configure(mode=prior)
+
+    try:
+        rates = DEVTIME.phase_rates()
+        assert rates["prefill"] is not None and rates["prefill"] > 0
+        assert rates["decode"] is not None and rates["decode"] > 0
+        snap = USAGE.snapshot()
+        assert snap["basis"] == "devtime"
+        vec = snap["tenants"]["acme"]
+        # prorated: tokens x the family's measured seconds-per-token rate
+        # (rates keep moving while dispatches land, so bound, don't pin)
+        assert vec["prefill_device_s"] > 0
+        assert vec["decode_device_s"] > 0
+        assert (vec["prefill_device_s"]
+                <= len(r.prompt_ids) * rates["prefill"] * 10)
+        assert (vec["decode_device_s"]
+                <= r.completion_tokens * rates["decode"] * 10)
+    finally:
+        # drop the timed entries so later token-fallback tests (and other
+        # test modules) see an untimed ledger again
+        DEVTIME.reset(keep_warm=True)
+
+
+# ---------------------------------------------------------------------------
+# cardinality cap
+# ---------------------------------------------------------------------------
+
+def test_cardinality_cap_folds_overflow_into_other():
+    led = UsageLedger(max_tenants=3)
+
+    class R:
+        prompt_ids = [1, 2, 3]
+        completion_tokens = 2
+        prefix_hit_tokens = 0
+        kv_page_seconds = 0.1
+        submitted_at = 0.0
+        admitted_at = 0.01
+        error = None
+        finish_reason = "eos"
+
+    keys = []
+    for i in range(10):
+        r = R()
+        r.tenant = f"capfold-{i}"
+        keys.append(led.bill_request(r))
+    snap = led.snapshot()
+    # the label/key space is BOUNDED: 3 admitted + the overflow bucket
+    assert snap["n_tenants"] == 4
+    assert set(snap["tenants"]) == {"capfold-0", "capfold-1", "capfold-2",
+                                    "other"}
+    assert snap["overflowed"] == 7
+    assert keys[:3] == ["capfold-0", "capfold-1", "capfold-2"]
+    assert set(keys[3:]) == {"other"}
+    # overflow bucket accumulated everything past the cap
+    assert snap["tenants"]["other"]["requests"] == 7
+    assert snap["tenants"]["other"]["tokens_out"] == 14
+    # EXISTING tenants keep billing under their own key after the cap
+    r = R()
+    r.tenant = "capfold-1"
+    assert led.bill_request(r) == "capfold-1"
+    # ...and the Prometheus label space never saw the folded ids
+    labeled = {dict(lk).get("tenant")
+               for lk in REGISTRY.family("usage_requests_total")}
+    assert {"capfold-0", "capfold-1", "capfold-2", "other"} <= labeled
+    assert not any(t and t.startswith("capfold-") and
+                   int(t.split("-")[1]) >= 3 for t in labeled if t)
+
+
+def test_cap_reads_env_knob(monkeypatch):
+    monkeypatch.setenv("APP_USAGE_MAX_TENANTS", "5")
+    assert UsageLedger().max_tenants == 5
+    monkeypatch.setenv("APP_USAGE_MAX_TENANTS", "nonsense")
+    assert UsageLedger().max_tenants == 64     # warn-and-default parse
+    monkeypatch.delenv("APP_USAGE_MAX_TENANTS")
+    assert UsageLedger().max_tenants == 64
+
+
+def test_anon_and_other_always_admitted_past_cap():
+    led = UsageLedger(max_tenants=1)
+
+    class R:
+        prompt_ids = []
+        completion_tokens = 0
+        prefix_hit_tokens = 0
+        kv_page_seconds = 0.0
+        submitted_at = None
+        admitted_at = None
+        error = None
+        finish_reason = "eos"
+
+    r = R()
+    r.tenant = "solo"
+    assert led.bill_request(r) == "solo"
+    r.tenant = ""          # default identity never folds into "other"
+    assert led.bill_request(r) == "anon"
+    r.tenant = "late"
+    assert led.bill_request(r) == "other"
+
+
+# ---------------------------------------------------------------------------
+# one tenant across the disaggregated route (in-process schedulers)
+# ---------------------------------------------------------------------------
+
+class DisaggFakeCore(FakeCore):
+    """FakeCore + the KV-handoff surface (export/validate/import/activate)
+    so a prefill-role and a decode-role scheduler can run the REAL
+    Request.handoff path in-process — pure numpy, no compile cost."""
+
+    role = "unified"
+
+    def export_slot_kv(self, st, pages, n_tokens):
+        return {"n_pages": len(pages), "n_tokens": int(n_tokens),
+                "page_size": self.page_size,
+                "page_rows": [st.pool[p].tolist() for p in pages]}
+
+    def validate_handoff(self, payload):
+        if int(payload.get("page_size", -1)) != self.page_size:
+            raise ValueError("handoff page_size mismatch")
+        if "page_rows" not in payload:
+            raise ValueError("handoff payload missing page rows")
+
+    def import_slot_kv(self, st, slot, pages, payload):
+        st = self._clone(st)
+        for page, row in zip(pages, payload["page_rows"]):
+            st.pool[page] = np.asarray(row, np.int32)
+        st.lengths[slot] = int(payload["n_tokens"])
+        return st
+
+    def activate(self, st, slot, first, generated, max_gen, temperature,
+                 top_k, top_p, seed=0):
+        st = self._clone(st)
+        st.tokens[slot] = int(first)
+        st.active[slot] = True
+        st.generated[slot] = int(generated)
+        st.max_gen[slot] = int(max_gen)
+        return st
+
+
+def test_one_tenant_bills_across_disagg_route(fresh_usage):
+    """THE acceptance invariant: a chat driven prefill worker → KV handoff
+    → decode replica bills queue seconds, tokens, and KV page-seconds to
+    ONE tenant — the prefill leg contributes tokens_in (+ a handoff), the
+    decode leg tokens_out, both contribute page-seconds — and the stream
+    is token-identical to the solo oracle."""
+    prompt = _prompt(20)
+    max_tokens = 8
+
+    pf_core = DisaggFakeCore(batch=2, max_seq=64, page_size=8)
+    pf_core.role = "prefill"
+    pf_sched = Scheduler(pf_core, ByteTokenizer())
+    req_a = Request(prompt_ids=list(prompt), tenant="acme",
+                    max_tokens=max_tokens, temperature=0.0,
+                    prefill_only=True)
+    try:
+        pf_sched.submit(req_a)
+        _drive(pf_sched)
+        assert _join(req_a) == ""          # handoff streams no text
+    finally:
+        pf_sched._fetcher.shutdown(wait=False)
+    assert req_a.error is None
+    assert req_a.finish_reason == "handoff"
+    payload = req_a.handoff
+    assert payload is not None
+    # the tenant identity rides the handoff payload itself
+    assert payload["tenant"] == "acme"
+    assert payload["prompt_ids"] == prompt
+
+    dec_core = DisaggFakeCore(batch=2, max_seq=64, page_size=8)
+    dec_core.role = "decode"
+    dec_sched = Scheduler(dec_core, ByteTokenizer())
+    req_b = Request(prompt_ids=list(payload["prompt_ids"]),
+                    tenant=str(payload["tenant"]),
+                    max_tokens=int(payload["max_tokens"]),
+                    temperature=0.0, seed=int(payload["seed"]))
+    try:
+        dec_sched.submit_prefilled(req_b, payload)
+        _drive(dec_sched)
+        text = _join(req_b)
+    finally:
+        dec_sched._fetcher.shutdown(wait=False)
+    assert req_b.error is None
+    want = oracle(prompt, max_tokens, dec_core.max_seq)
+    assert text == ByteTokenizer().decode(want)
+
+    snap = USAGE.snapshot()
+    # ONE tenant row holds both legs (both schedulers share the
+    # process-global ledger — exactly what the fleet sum does over HTTP)
+    assert set(snap["tenants"]) == {"acme"}
+    vec = snap["tenants"]["acme"]
+    assert vec["requests"] == 2
+    assert vec["handoffs"] == 1
+    # the prompt bills ONCE (prefill leg); the decode leg imported its KV
+    assert vec["tokens_in"] == len(prompt)
+    assert vec["tokens_out"] == len(want)
+    # both legs held pool pages
+    assert vec["kv_page_s"] > 0
+    assert req_a.kv_page_seconds > 0 and req_b.kv_page_seconds > 0
+    assert vec["queue_s"] >= 0
+
+
+def test_disagg_route_billing_visible_in_fleet_rollup(fresh_usage,
+                                                      monkeypatch):
+    """The acceptance criterion end-to-end: one chat driven prefill
+    worker → KV handoff → decode replica, each leg billing its OWN
+    worker ledger (as two processes would); the fake-HTTP workers serve
+    those real rollups on /health, and the router's /debug/fleet view
+    sums them into ONE tenant row carrying queue seconds, tokens, and KV
+    page-seconds from BOTH legs."""
+    prompt = _prompt(16, family=2)
+    max_tokens = 6
+    ledger_pf, ledger_dec = UsageLedger(), UsageLedger()
+
+    def run_leg(ledger, core_role, make_req, submit):
+        monkeypatch.setattr(usage_mod, "USAGE", ledger)
+        core = DisaggFakeCore(batch=2, max_seq=64, page_size=8)
+        core.role = core_role
+        sched = Scheduler(core, ByteTokenizer())
+        req = make_req()
+        try:
+            submit(sched, req)
+            _drive(sched)
+            text = _join(req)
+        finally:
+            sched._fetcher.shutdown(wait=False)
+        assert req.error is None
+        return req, text
+
+    req_a, _ = run_leg(
+        ledger_pf, "prefill",
+        lambda: Request(prompt_ids=list(prompt), tenant="acme",
+                        max_tokens=max_tokens, temperature=0.0,
+                        prefill_only=True),
+        lambda s, r: s.submit(r))
+    payload = req_a.handoff
+    req_b, text = run_leg(
+        ledger_dec, "decode",
+        lambda: Request(prompt_ids=list(payload["prompt_ids"]),
+                        tenant=str(payload["tenant"]),
+                        max_tokens=int(payload["max_tokens"]),
+                        temperature=0.0, seed=int(payload["seed"])),
+        lambda s, r: s.submit_prefilled(r, payload))
+    monkeypatch.setattr(usage_mod, "USAGE", USAGE)
+    want = oracle(prompt, max_tokens, 64)
+    assert text == ByteTokenizer().decode(want)
+
+    pw = _FakeWorker("prefill")
+    dw = _FakeWorker("decode")
+    pw.health_extra = {"usage_by_tenant": ledger_pf.rollup()}
+    dw.health_extra = {"usage_by_tenant": ledger_dec.rollup()}
+    with _fake_pool(pw, dw):
+        pool = FailoverLLM([pw.url, dw.url], "tiny", refresh_s=60.0)
+        fleet = pool.fleet()
+    row = fleet["tenants"]["acme"]
+    # the fleet-summed vector: both legs' requests, the prompt counted
+    # ONCE (prefill leg), the completion from the decode leg, page-time
+    # from both
+    assert row["req"] == 2
+    assert row["tok_in"] == len(prompt)
+    assert row["tok_out"] == len(want)
+    assert row["kv_page_s"] > 0
+    assert row["kv_page_s"] == pytest.approx(
+        round(req_a.kv_page_seconds, 4) + round(req_b.kv_page_seconds, 4),
+        abs=1e-3)
+    assert row["queue_s"] >= 0
+    # per-worker cards keep the split inspectable
+    assert fleet["workers"][pw.url]["usage_by_tenant"]["acme"]["tok_in"] \
+        == len(prompt)
+    assert fleet["workers"][dw.url]["usage_by_tenant"]["acme"]["tok_out"] \
+        == len(want)
+
+
+def test_devtime_off_disagg_fallback_still_bills_tokens(fresh_usage):
+    """APP_DEVTIME=off half of the acceptance criterion: the same route
+    with no timed samples bills the token vector (basis 'tokens') with
+    zero device-seconds — never nothing."""
+    assert DEVTIME.mode == "off"
+    prompt = _prompt(12, family=1)
+    pf_core = DisaggFakeCore(batch=2, max_seq=64, page_size=8)
+    pf_core.role = "prefill"
+    pf_sched = Scheduler(pf_core, ByteTokenizer())
+    req_a = Request(prompt_ids=list(prompt), tenant="acme", max_tokens=5,
+                    temperature=0.0, prefill_only=True)
+    try:
+        pf_sched.submit(req_a)
+        _drive(pf_sched)
+        _join(req_a)
+    finally:
+        pf_sched._fetcher.shutdown(wait=False)
+    snap = USAGE.snapshot()
+    assert snap["basis"] == "tokens"
+    vec = snap["tenants"]["acme"]
+    assert vec["tokens_in"] == len(prompt)
+    assert vec["prefill_device_s"] == 0.0 and vec["decode_device_s"] == 0.0
+    assert vec["kv_page_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# the router: tenant forwarding + fleet aggregation (fake-HTTP harness)
+# ---------------------------------------------------------------------------
+
+def test_router_forwards_tenant_header_on_every_disagg_dispatch():
+    """The identity must ride the WHOLE disaggregated route: the router
+    stamps X-Tenant-Id on the prefill dispatch AND the handoff dispatch,
+    so both workers bill the same tenant."""
+    with _fake_pool(_FakeWorker("prefill"),
+                    _FakeWorker("decode", text="ok")) as (pw, dw):
+        pool = FailoverLLM([pw.url, dw.url], "tiny", refresh_s=60.0)
+        with usage_mod.tenant_scope("acme"):
+            text = "".join(pool.chat(MESSAGES, max_tokens=8))
+        assert text == "ok"
+        assert _hdr(pw.headers["prefill"], "X-Tenant-Id") == "acme"
+        assert _hdr(dw.headers["handoff"], "X-Tenant-Id") == "acme"
+        # payload-weight satellite: the KV transport's bytes landed on the
+        # router_kv_payload_bytes histogram (a metric trend, not a trace)
+        assert REGISTRY.histogram("router_kv_payload_bytes").count >= 1
+
+
+def test_router_unified_dispatch_carries_tenant_too():
+    with _fake_pool(_FakeWorker("unified", text="hi")) as (w,):
+        pool = FailoverLLM([w.url], "tiny", refresh_s=60.0)
+        with usage_mod.tenant_scope("beta"):
+            assert "".join(pool.chat(MESSAGES, max_tokens=8)) == "hi"
+        assert _hdr(w.headers["chat"], "X-Tenant-Id") == "beta"
+        # no ambient tenant → no header (engine defaults to "anon")
+        assert "".join(pool.chat(MESSAGES, max_tokens=8)) == "hi"
+        assert _hdr(w.headers["chat"], "X-Tenant-Id") is None
+
+
+def test_fleet_view_aggregates_rollups_and_exports_worker_gauges():
+    """/debug/fleet: per-worker cards from the probe cycle plus the
+    FLEET-SUMMED tenant rollups — a disagg chat's prefill-worker and
+    decode-replica usage lands in one tenant row; numeric per-worker
+    fields re-export on the router's /metrics with a worker label."""
+    pw = _FakeWorker("prefill", running=1)
+    dw = _FakeWorker("decode", text="ab", running=2)
+    pw.health_extra = {
+        "prefix_hit_frac": 0.1,
+        "perf": {"mfu": 0.05, "padding_waste_frac": 0.2, "recompiles": 0},
+        "usage_by_tenant": {"acme": {"req": 1, "tok_in": 20, "tok_out": 0,
+                                     "device_s": 0.5, "kv_page_s": 1.0}},
+    }
+    dw.health_extra = {
+        "prefix_hit_frac": 0.38,
+        "perf": {"mfu": 0.19, "padding_waste_frac": 0.1, "recompiles": 1},
+        "usage_by_tenant": {"acme": {"req": 1, "tok_in": 0, "tok_out": 8,
+                                     "device_s": 0.25, "kv_page_s": 2.0},
+                            "beta": {"req": 2, "tok_in": 9, "tok_out": 4,
+                                     "device_s": 0.1, "kv_page_s": 0.5}},
+    }
+    with _fake_pool(pw, dw):
+        pool = FailoverLLM([pw.url, dw.url], "tiny", refresh_s=60.0)
+        fleet = pool.fleet()
+        assert fleet["workers_up"] == 2 and fleet["workers_down"] == 0
+        cards = fleet["workers"]
+        assert cards[pw.url]["role"] == "prefill"
+        assert cards[dw.url]["prefix_hit_frac"] == 0.38
+        assert cards[dw.url]["mfu"] == 0.19
+        assert cards[dw.url]["recompiles"] == 1
+        # the fleet-summed tenant rollup: acme's prefill + decode legs
+        # merged into ONE row
+        assert fleet["tenants"]["acme"] == {
+            "req": 2, "tok_in": 20, "tok_out": 8,
+            "device_s": 0.75, "kv_page_s": 3.0}
+        assert fleet["tenants"]["beta"]["req"] == 2
+        assert set(fleet["roles"]) == {"prefill", "decode"}
+        # federated /metrics: worker families with a worker label on the
+        # ROUTER process's registry
+        fam = REGISTRY.family("fleet_worker_prefix_hit_frac")
+        by_worker = {dict(lk)["worker"]: v for lk, v in fam.items()}
+        assert by_worker[dw.url] == 0.38
+        assert by_worker[pw.url] == 0.1
+        assert {dict(lk)["worker"]: v
+                for lk, v in REGISTRY.family("fleet_worker_mfu").items()
+                }[dw.url] == 0.19
+        # detailed topology carries the affinity signal per replica
+        topo = pool.topology(detail=True)
+        assert topo["decode"][0]["prefix_hit_frac"] == 0.38
+        # ...while the default shape stays the role → [url] contract
+        assert pool.topology() == {"prefill": [pw.url], "decode": [dw.url]}
+        # liveness markers ride next to the held-value gauges
+        ups = {dict(lk)["worker"]: v
+               for lk, v in REGISTRY.family("fleet_worker_up").items()}
+        assert ups[pw.url] == 1.0 and ups[dw.url] == 1.0
+
+        # a dead worker: its gauges HOLD but the up marker flips, and the
+        # fleet tenant sum keeps its last-known (cumulative) rollup — no
+        # phantom dip while it is circuit-broken
+        dw.alive = False
+        fleet2 = pool.fleet(max_probe_age_s=0.0)
+        assert fleet2["workers"][dw.url]["down"] is True
+        assert fleet2["workers_down"] == 1
+        assert fleet2["tenants"]["acme"]["req"] == 2     # unchanged sum
+        ups = {dict(lk)["worker"]: v
+               for lk, v in REGISTRY.family("fleet_worker_up").items()}
+        assert ups[dw.url] == 0.0 and ups[pw.url] == 1.0
+
+
+def test_debug_usage_and_fleet_handlers(fresh_usage):
+    """The debug endpoints registered by add_debug_routes answer with the
+    ledger snapshot and the router's fleet view (or the local fallback
+    when no router lives in the process)."""
+    from generativeaiexamples_tpu.server import common
+
+    class R:
+        prompt_ids = [1, 2]
+        completion_tokens = 3
+        prefix_hit_tokens = 0
+        kv_page_seconds = 0.5
+        submitted_at = 0.0
+        admitted_at = 0.1
+        error = None
+        finish_reason = "eos"
+        tenant = "acme"
+
+    USAGE.bill_request(R())
+    body = json.loads(asyncio.run(common.usage_handler(None)).body)
+    assert body["tenants"]["acme"]["tokens_out"] == 3
+    assert body["max_tenants"] >= 1
+
+    with _fake_pool(_FakeWorker("unified", text="x")) as (w,):
+        pool = FailoverLLM([w.url], "tiny", refresh_s=60.0)
+        assert failover_mod.current_router() is pool
+        fleet = json.loads(asyncio.run(common.fleet_handler(None)).body)
+        assert w.url in fleet["workers"]
+    # no-router fallback: the local view still answers
+    failover_mod.register_router(None)
+    try:
+        local = json.loads(asyncio.run(common.fleet_handler(None)).body)
+        assert local["workers"] == {}
+        assert local["tenants"]["acme"]["tok_out"] == 3
+    finally:
+        failover_mod.register_router(None)
+
+
+def test_merge_rollups_tolerates_malformed_worker_bodies():
+    merged = merge_rollups([
+        {"a": {"req": 1}},
+        "not-a-dict",                       # unparsable worker body
+        {"a": "nope", "b": {"req": 2, "note": "text"}},
+        None,
+    ])
+    assert merged["a"] == {"req": 1.0}
+    assert merged["b"] == {"req": 2.0}     # non-numeric fields dropped
