@@ -506,17 +506,24 @@ def run_disagg_round(n_workers: int = 3, n_requests: int = 12,
     the routing frontend — the multichip phase made real (ROADMAP item 1).
 
     Spawns ``n_workers`` tiny engine servers with roles from
-    parallel/topology.plan_engine_roles (1 prefill : 2 decode at the
-    default pool size), fronts them with server/failover.FailoverLLM, and
+    parallel/topology.plan_engine_roles (prefill share tuned from the
+    previous round's data when present, env-overridable — no longer a
+    hardcoded 1:2), fronts them with server/failover.FailoverLLM, and
     drives concurrent chats through the prefill → KV-handoff → decode
     route. Reported numbers are host-observed at the ROUTER (the client's
     vantage): ``disagg_ttft_p50_s`` is call→first-delta, ``handoff_ms``
     the p50 of prefill-payload-in-hand → decode-stream-open, and
     ``router_imbalance`` the (max-min)/mean spread of per-decode-replica
-    dispatch counts (0 = perfectly balanced). Workers run the
-    deterministic tiny model on CPU — this phase measures the
-    TOPOLOGY/ROUTING plane (role discovery, export/import, least-loaded
-    spread), not chip arithmetic; the single-chip phases above own that.
+    dispatch counts (0 = perfectly balanced).
+
+    The KV TRANSPORT is A/B'd: the main phase runs the binary zero-copy
+    frame (core/kv_wire.py), a second phase forces the JSON base64 compat
+    wire through the same pool — ``wire.binary`` / ``wire.json_b64``
+    carry each form's ``handoff_ms_p50`` + ``kv_payload_bytes_p50``, so
+    every round prices the transport next to the topology. Workers run
+    the deterministic tiny model on CPU — this phase measures the
+    TOPOLOGY/ROUTING/TRANSPORT plane, not chip arithmetic; the
+    single-chip phases above own that.
     """
     import os
     import signal
@@ -524,11 +531,13 @@ def run_disagg_round(n_workers: int = 3, n_requests: int = 12,
     import subprocess
     import threading
 
+    from generativeaiexamples_tpu.core.metrics import REGISTRY
     from generativeaiexamples_tpu.parallel.topology import (
-        describe_topology, plan_engine_roles)
+        describe_topology, plan_engine_roles, tuned_prefill_share)
     from generativeaiexamples_tpu.server.failover import FailoverLLM
 
-    roles = plan_engine_roles(n_workers)
+    share, share_source = tuned_prefill_share()
+    roles = plan_engine_roles(n_workers, share)
 
     procs, ports = [], []
     try:
@@ -551,54 +560,87 @@ def run_disagg_round(n_workers: int = 3, n_requests: int = 12,
             _bench_wait_health(port, health_timeout)
 
         urls = [f"http://127.0.0.1:{p}" for p in ports]
-        router = FailoverLLM(urls, "tiny-llama-test", cooldown_s=5.0)
-        messages = [{"role": "user", "content": "list the pump voltages"}]
 
-        def one(i: int, record) -> None:
-            t0 = time.perf_counter()
-            first = None
-            for delta in router.chat(messages, max_tokens=max_tokens,
-                                     temperature=0.0):
-                if first is None:
-                    first = time.perf_counter() - t0
-            record.append((first, time.perf_counter() - t0))
+        # a prompt long enough that page payloads, not HTTP framing,
+        # dominate the wire comparison (the production claim is about
+        # multi-MB 512-token payloads, not 2-page toys). DISTINCT leading
+        # content per request: the router's prefix affinity deliberately
+        # pins same-prefix conversations to one replica, so a
+        # single-prompt burst would measure stickiness, not spread —
+        # router_imbalance is about how MIXED traffic balances
+        def messages_for(i: int) -> list:
+            return [{"role": "user",
+                     "content": f"conversation {i}: list the pump "
+                                "voltages and explain each reading "
+                                "in order " * 8}]
 
-        warm: list = []
-        one(0, warm)                      # compile/bucket paths, untimed
-        from generativeaiexamples_tpu.core.metrics import REGISTRY
         handoff_h = REGISTRY.histogram("router_handoff_s")
-        # window every reported number to the TIMED phase: the warm
-        # request's compile-dominated handoff must not bias the stats
-        # (sum/count differencing, same as the dispatch-count deltas)
-        h_sum0, h_cnt0 = handoff_h.sum, handoff_h.count
+        payload_h = REGISTRY.histogram("router_kv_payload_bytes")
+
+        def run_phase(router, n: int) -> dict:
+            """Drive ``n`` concurrent chats; report this phase's TTFTs +
+            per-wire handoff/payload percentiles (histogram tail deltas —
+            the histograms are process-global, the window is the phase)."""
+            def one(i: int, record) -> None:
+                t0 = time.perf_counter()
+                first = None
+                for delta in router.chat(messages_for(i),
+                                         max_tokens=max_tokens,
+                                         temperature=0.0):
+                    if first is None:
+                        first = time.perf_counter() - t0
+                record.append((first, time.perf_counter() - t0))
+
+            h0, p0 = handoff_h.count, payload_h.count
+            done: list = []
+            threads = [threading.Thread(target=one, args=(i, done))
+                       for i in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            ttfts = sorted(f for f, _ in done if f is not None)
+            if len(ttfts) != n:
+                raise RuntimeError(
+                    f"disagg phase lost requests: {len(ttfts)} of {n} "
+                    f"streamed a first token")
+            handoffs = handoff_h.tail(handoff_h.count - h0)
+            payloads = payload_h.tail(payload_h.count - p0)
+            return {
+                "n": n,
+                "ttfts": ttfts,
+                "handoff_ms_p50": (round(stats.median(handoffs) * 1e3, 2)
+                                   if handoffs else 0.0),
+                "kv_payload_bytes_p50": (round(stats.median(payloads), 1)
+                                         if payloads else 0.0),
+            }
+
+        router = FailoverLLM(urls, "tiny-llama-test", cooldown_s=5.0)
+        warm: list = []
+        t0 = time.perf_counter()
+        for delta in router.chat(messages_for(-1), max_tokens=max_tokens,
+                                 temperature=0.0):
+            if not warm:
+                warm.append(time.perf_counter() - t0)
         base = {u: v["dispatched"] for u, v in
                 router.dispatch_counts().items()}
-        done: list = []
-        threads = [threading.Thread(target=one, args=(i, done))
-                   for i in range(n_requests)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        ttfts = sorted(f for f, _ in done if f is not None)
-        if len(ttfts) != n_requests:
-            raise RuntimeError(f"disagg round lost requests: {len(ttfts)} "
-                               f"of {n_requests} streamed a first token")
+        # phase 1 — the serving default: binary zero-copy frames
+        binary = run_phase(router, n_requests)
+        # phase 2 — the PR 6 compat wire forced through the same pool:
+        # the A/B that prices the transport (same workers, same prompts)
+        router_json = FailoverLLM(urls, "tiny-llama-test", cooldown_s=5.0,
+                                  kv_wire="json")
+        json_b64 = run_phase(router_json, max(4, n_requests // 2))
         counts = router.dispatch_counts()
         dec = [counts[u]["dispatched"] - base.get(u, 0)
                for u in counts if counts[u]["role"] == "decode"]
         mean = (sum(dec) / len(dec)) if dec else 0.0
         imbalance = ((max(dec) - min(dec)) / mean
                      if dec and mean > 0 else 0.0)
-        h_cnt = handoff_h.count - h_cnt0
-        handoff_ms = (round((handoff_h.sum - h_sum0) / h_cnt * 1e3, 2)
-                      if h_cnt else 0.0)
-        # KV transport weight (ROADMAP item 1's HTTP-base64 seam) as a
-        # metric trend: p50 payload bytes from the router-side histogram
-        # this round's dispatches fed (server/failover.py observes it per
-        # prefill handoff)
-        payload_h = REGISTRY.histogram("router_kv_payload_bytes")
-        kv_payload_p50 = round(payload_h.percentile(50), 1)
+        ttfts = binary["ttfts"]
+        ratio = (round(binary["kv_payload_bytes_p50"]
+                       / json_b64["kv_payload_bytes_p50"], 4)
+                 if json_b64["kv_payload_bytes_p50"] else 0.0)
         # the fleet view the router aggregated from its probe cycle —
         # per-worker role/occupancy/prefix-hit cards + fleet-summed tenant
         # rollups (usage plane; baselined in the round JSON from r06 on)
@@ -606,17 +648,25 @@ def run_disagg_round(n_workers: int = 3, n_requests: int = 12,
         return {
             "n_workers": n_workers,
             "topology": describe_topology(roles),
+            "prefill_share": round(share, 4),
+            "prefill_share_source": share_source,
             "workers": {u: counts[u] for u in counts},
             "n_requests": n_requests,
             "disagg_ttft_p50_s": round(stats.median(ttfts), 4),
             "disagg_ttft_max_s": round(ttfts[-1], 4),
-            # mean over the timed phase's handoffs (the histogram has no
-            # windowed percentile; the mean excludes the warm request)
-            "handoff_ms": handoff_ms,
+            # primary (binary-wire) phase numbers keep the historical
+            # field names; the per-wire A/B sits under "wire"
+            "handoff_ms": binary["handoff_ms_p50"],
             "router_imbalance": round(imbalance, 4),
-            "kv_payload_bytes_p50": kv_payload_p50,
+            "kv_payload_bytes_p50": binary["kv_payload_bytes_p50"],
+            "wire": {
+                "binary": {k: v for k, v in binary.items() if k != "ttfts"},
+                "json_b64": {k: v for k, v in json_b64.items()
+                             if k != "ttfts"},
+            },
+            "kv_payload_binary_over_b64": ratio,
             "fleet": fleet,
-            "transport": "http-json-b64",
+            "transport": "binary-frames (json-b64 fallback)",
             "workers_backend": "tiny-cpu",
         }
     finally:

@@ -1,0 +1,289 @@
+"""KV-handoff wire codecs: the binary zero-copy frame and the JSON-base64
+compat form.
+
+The disaggregated route (PR 6) ships a prefilled slot's KV pages between
+engine workers. The original wire was JSON-base64 — every byte inflated
+4/3 by base64 AND copied twice (bytes → b64 str → JSON str), ~60 MB per
+512-token prompt on 3B models. This module is the transport fix (ROADMAP
+item 1): a framed octet-stream body whose array payloads are written and
+read as RAW bytes —
+
+    ``KVW1`` magic | u32 header length | JSON header | aligned segments
+
+The header carries everything that is not bulk data: the payload's scalar
+passthrough (geometry, sampling state, SLO class, the usage plane's
+``tenant``, grammar state — every non-array key, verbatim) plus one
+descriptor per array segment: dtype, shape, byte offset/length into the
+segment area, and a crc32. Decoding never copies a segment: each array is
+an ``np.frombuffer`` view into the request body (read-only — importers
+must tolerate that; the engine's device upload does). Encoding writes each
+array's buffer once, with no base64 and no per-byte JSON walk.
+
+Integrity is explicit, not hoped for: the header length-prefixes every
+segment and carries its crc32, and :func:`decode_kv_frames` verifies both
+BEFORE the payload reaches ``validate_handoff`` — a truncated or garbled
+body is a loud :class:`KVWireError` (HTTP 400 at the serving layer), never
+silently-scattered garbage KV. This matters more on the binary wire than
+it did on JSON: flipped bits in a base64 body usually break the JSON
+parse, while flipped bits in a raw segment would otherwise still be a
+shape-valid buffer.
+
+Deliberately numpy-only (no jax import): the routing frontend
+(server/failover.py) lives in chain-server processes and transcodes
+between wire forms for mixed-version pools — it must not drag the engine
+stack in. ``np.ascontiguousarray`` materializes device (jax) arrays via
+``__array__`` without this module ever naming jax, which is how the
+engine's device-native export payloads meet the wire.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import struct
+import zlib
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+# array-valued keys of a handoff payload; everything else is scalar
+# passthrough (the contract encode_kv_payload always had)
+PAYLOAD_ARRAYS = ("k", "v", "k_s", "v_s")
+
+# int-list keys the BINARY frame packs as narrow integer segments instead
+# of JSON text (a 512-token prompt is ~2.5 KB of ", 123" in the header vs
+# ~1 KB of uint16) — decoded back to plain Python lists, so consumers
+# never see the difference. The JSON wire keeps them as scalar
+# passthrough (compat form, byte-stable with PR 6).
+_PACKED_INT_LISTS = ("prompt_ids",)
+
+# the binary frame's content type: /v1/kv/prefill serves it when the
+# client's Accept names it; /v1/kv/handoff accepts it as a request body.
+# Workers advertise support via the /health body's ``kv_wire`` list, so a
+# router never sends a frame to a worker that would 400 it.
+KV_FRAMES_CONTENT_TYPE = "application/x-kv-frames"
+
+_MAGIC = b"KVW1"
+_PREFIX = struct.Struct("<4sI")     # magic, header byte length
+_ALIGN = 64                         # segment alignment (dtype-safe views)
+_MAX_HEADER = 16 * 1024 * 1024      # a header is metadata, never bulk data
+
+
+class KVWireError(ValueError):
+    """A wire body that cannot be decoded safely: truncated, misframed, or
+    failing its crc32. The serving layer answers 400 — loud, before any
+    byte reaches the pool."""
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """np.dtype for a payload's dtype string, including the ml_dtypes
+    extension types numpy cannot resolve by name (bfloat16)."""
+    if name == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+# --------------------------------------------------------------- JSON form
+
+def encode_kv_payload(payload: dict) -> dict:
+    """Host KV-handoff payload → JSON-safe dict: arrays become {b64,
+    dtype, shape} triples, everything else passes through. The passthrough
+    is a contract: sampling state, SLO class, grammar state, and the usage
+    plane's ``tenant`` identity all ride the wire as plain scalar keys.
+    This is the COMPAT wire — 4/3 inflation and two byte copies per array;
+    new routes negotiate :func:`encode_kv_frames` instead."""
+    out = {}
+    for key, value in payload.items():
+        if key in PAYLOAD_ARRAYS and value is not None:
+            arr = np.ascontiguousarray(value)
+            out[key] = {"b64": base64.b64encode(arr.tobytes()).decode("ascii"),
+                        "dtype": str(arr.dtype),
+                        "shape": list(arr.shape)}
+        else:
+            out[key] = value
+    return out
+
+
+def decode_kv_payload(wire: dict) -> dict:
+    """Inverse of :func:`encode_kv_payload`."""
+    out = {}
+    for key, value in wire.items():
+        if (key in PAYLOAD_ARRAYS and isinstance(value, dict)
+                and "b64" in value):
+            buf = base64.b64decode(value["b64"])
+            out[key] = np.frombuffer(
+                buf, dtype=_np_dtype(value["dtype"])).reshape(value["shape"])
+        else:
+            out[key] = value
+    return out
+
+
+# ------------------------------------------------------------- binary form
+
+def encode_kv_frames(payload: Dict[str, Any]) -> bytes:
+    """Handoff payload → one framed octet-stream body (see module doc).
+
+    Array values may be numpy or device (jax) arrays — each is
+    materialized contiguously exactly once and its bytes written RAW into
+    the segment area. Non-array keys must be JSON-serializable (they
+    always were — they rode the JSON wire's passthrough)."""
+    metas = []
+    segments = []
+    offset = 0
+    values = {key: payload.get(key) for key in PAYLOAD_ARRAYS}
+    for key in _PACKED_INT_LISTS:
+        ids = payload.get(key)
+        if ids is None:
+            continue
+        arr = np.asarray(ids)
+        # narrowest lossless integer dtype: token ids are non-negative
+        # and bounded by the vocab, so uint16 covers most models
+        values[key] = arr.astype(
+            np.uint16 if arr.size == 0 or (arr.min() >= 0
+                                           and arr.max() < 1 << 16)
+            else np.int32)
+    for key in (*PAYLOAD_ARRAYS, *_PACKED_INT_LISTS):
+        value = values.get(key)
+        if value is None:
+            continue
+        # one host materialization per array (THE device→host copy for
+        # device-native export payloads), then tobytes — not the buffer
+        # protocol, because extension dtypes (bfloat16) have no PEP-3118
+        # format; one memcpy per array is noise next to the base64 4/3
+        # inflation + per-byte JSON walk this replaces
+        arr = np.ascontiguousarray(value)
+        data = arr.tobytes()
+        pad = (-offset) % _ALIGN
+        offset += pad
+        metas.append({"key": key, "dtype": str(arr.dtype),
+                      "shape": list(arr.shape), "off": offset,
+                      "nbytes": len(data),
+                      "crc32": zlib.crc32(data) & 0xFFFFFFFF})
+        segments.append((pad, data))
+        offset += len(data)
+    header = json.dumps({
+        "v": 1,
+        "meta": {key: value for key, value in payload.items()
+                 if key not in PAYLOAD_ARRAYS
+                 and not (key in _PACKED_INT_LISTS
+                          and values.get(key) is not None)},
+        "arrays": metas,
+        "data_bytes": offset,
+    }).encode("utf-8")
+    parts = [_PREFIX.pack(_MAGIC, len(header)), header]
+    for pad, data in segments:
+        if pad:
+            parts.append(b"\x00" * pad)
+        parts.append(data)
+    return b"".join(parts)
+
+
+def is_kv_frames(body: bytes, content_type: str = "") -> bool:
+    """Cheap sniff: does ``body`` carry the binary frame? Content type
+    wins when present; the magic covers clients that forgot to set it."""
+    if content_type and content_type.split(";")[0].strip().lower() \
+            == KV_FRAMES_CONTENT_TYPE:
+        return True
+    return bytes(body[:4]) == _MAGIC
+
+
+def _read_header(body) -> tuple:
+    view = memoryview(body)
+    if len(view) < _PREFIX.size:
+        raise KVWireError(
+            f"kv frame truncated: {len(view)} bytes is shorter than the "
+            f"{_PREFIX.size}-byte frame prefix")
+    magic, hlen = _PREFIX.unpack_from(view, 0)
+    if magic != _MAGIC:
+        raise KVWireError(f"kv frame magic mismatch: {bytes(magic)!r}")
+    if not 0 < hlen <= _MAX_HEADER:
+        raise KVWireError(f"kv frame header length {hlen} outside bounds")
+    if len(view) < _PREFIX.size + hlen:
+        raise KVWireError(
+            f"kv frame truncated inside the header: body holds "
+            f"{len(view)} bytes, header claims {hlen}")
+    try:
+        header = json.loads(bytes(view[_PREFIX.size:_PREFIX.size + hlen]))
+    except ValueError as exc:
+        raise KVWireError(f"kv frame header is not JSON: {exc}")
+    if not isinstance(header, dict) or "arrays" not in header:
+        raise KVWireError("kv frame header missing its array table")
+    return header, view, _PREFIX.size + hlen
+
+
+def peek_kv_frames_meta(body) -> Dict[str, Any]:
+    """The frame's scalar passthrough WITHOUT touching (or validating) the
+    segment area — the router reads n_pages/tenant for span attributes
+    off a multi-MB body it otherwise just relays."""
+    header, _, _ = _read_header(body)
+    meta = header.get("meta")
+    return dict(meta) if isinstance(meta, dict) else {}
+
+
+def decode_kv_frames(body, verify: bool = True) -> Dict[str, Any]:
+    """Framed body → handoff payload dict. Array values are READ-ONLY
+    ``np.frombuffer`` views into ``body`` — zero copies; the caller owns
+    keeping ``body`` alive as long as the arrays (numpy holds a reference,
+    so a plain ``bytes`` body takes care of itself).
+
+    Every segment is bounds-checked against the real body length and (by
+    default) crc32-verified BEFORE anything is returned — truncation and
+    bit corruption both raise :class:`KVWireError` here, upstream of
+    ``validate_handoff``'s geometry checks."""
+    header, view, data_start = _read_header(body)
+    data = view[data_start:]
+    claimed = int(header.get("data_bytes", -1))
+    if claimed != len(data):
+        raise KVWireError(
+            f"kv frame truncated: segment area holds {len(data)} bytes, "
+            f"header claims {claimed}")
+    out: Dict[str, Any] = dict(header.get("meta") or {})
+    for desc in header["arrays"]:
+        key = desc.get("key")
+        if key not in PAYLOAD_ARRAYS and key not in _PACKED_INT_LISTS:
+            raise KVWireError(f"kv frame names unknown array {key!r}")
+        off, nbytes = int(desc["off"]), int(desc["nbytes"])
+        if off < 0 or nbytes < 0 or off + nbytes > len(data):
+            raise KVWireError(
+                f"kv frame segment {key!r} [{off}:{off + nbytes}] falls "
+                f"outside the {len(data)}-byte segment area")
+        seg = data[off:off + nbytes]
+        if verify:
+            crc = zlib.crc32(seg) & 0xFFFFFFFF
+            if crc != int(desc.get("crc32", -1)):
+                raise KVWireError(
+                    f"kv frame segment {key!r} failed its crc32 "
+                    f"({crc:#010x} != {int(desc.get('crc32', -1)):#010x}) "
+                    f"— corrupted in transit")
+        dtype = _np_dtype(str(desc["dtype"]))
+        shape = tuple(int(s) for s in desc["shape"])
+        want = int(np.prod(shape)) * dtype.itemsize if shape else \
+            dtype.itemsize
+        if want != nbytes:
+            raise KVWireError(
+                f"kv frame segment {key!r}: {nbytes} bytes cannot hold "
+                f"shape {shape} of {dtype}")
+        arr = np.frombuffer(seg, dtype=dtype).reshape(shape)
+        # packed int lists come back as the plain Python lists they were
+        # — consumers (validate_handoff, the scheduler, transcoding)
+        # never see the packing
+        out[key] = arr.tolist() if key in _PACKED_INT_LISTS else arr
+    return out
+
+
+def transcode_to_json(body) -> dict:
+    """Binary frame → the JSON-base64 wire dict, for relaying a new
+    prefill worker's payload to an old decode worker (router compat path).
+    Validates the frame on the way — a router must not launder a corrupt
+    frame into a shape-valid JSON body."""
+    return encode_kv_payload(decode_kv_frames(body))
+
+
+def encode_for_wire(payload: Dict[str, Any], binary: bool):
+    """One switch for the serving layer: returns ``(body_bytes,
+    content_type)`` in the negotiated form."""
+    if binary:
+        return encode_kv_frames(payload), KV_FRAMES_CONTENT_TYPE
+    return (json.dumps(encode_kv_payload(payload)).encode("utf-8"),
+            "application/json")

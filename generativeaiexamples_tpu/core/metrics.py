@@ -163,6 +163,16 @@ class Histogram:
             idx = min(len(self._sorted) - 1, int(q / 100.0 * len(self._sorted)))
             return self._sorted[idx]
 
+    def tail(self, n: int) -> List[float]:
+        """The newest ``n`` observations, oldest first (bounded by the
+        reservoir). Lets a bench window per-phase percentiles out of one
+        histogram by differencing counts — the A/B consumer the KV-wire
+        round uses; the exposition surfaces stay sum/count/percentile."""
+        if n <= 0:
+            return []
+        with self._lock:
+            return list(self._ring)[-n:]
+
     @property
     def count(self) -> int:
         return self._count

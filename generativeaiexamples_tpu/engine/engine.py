@@ -1232,7 +1232,7 @@ class EngineCore:
     # --------------------------------------------------------- slot lifecycle
 
     def _activate_impl(self, state: DecodeState, slot, token, generated,
-                       max_gen, temperature, top_k, top_p, seed
+                       max_gen, temperature, top_k, top_p, seed, gram_state
                        ) -> DecodeState:
         upd = lambda arr, val: arr.at[slot].set(val)
         hist = state.history.at[
@@ -1249,20 +1249,28 @@ class EngineCore:
             top_k=upd(state.top_k, top_k),
             top_p=upd(state.top_p, top_p),
             rngs=upd(state.rngs, jax.random.PRNGKey(seed)),
-            gram_state=upd(state.gram_state, jnp.int32(0)),  # no leakage
+            # 0 (the default) clears any previous occupant's DFA state (no
+            # leakage); a handed-off grammared request instead resumes at
+            # the host-walked state the prefill worker's first token
+            # reached (scheduler._admit_prefilled)
+            gram_state=upd(state.gram_state, gram_state),
             last_logprob=upd(state.last_logprob, jnp.float32(0.0)),
             adapter_ix=upd(state.adapter_ix, jnp.int32(0)),
         )
 
     def activate(self, state: DecodeState, slot: int, token: int,
                  generated: int, max_gen: int, temperature: float, top_k: int,
-                 top_p: float, seed: int = 0) -> DecodeState:
+                 top_p: float, seed: int = 0,
+                 gram_state: int = 0) -> DecodeState:
         """Start decoding a prefilled slot (its lengths were set by the last
-        chunk; ``generated`` counts tokens already produced, >=1)."""
+        chunk; ``generated`` counts tokens already produced, >=1).
+        ``gram_state`` seeds the slot's constrained-decoding DFA state
+        (flat, THIS engine's grammar stack) — the KV handoff's grammar
+        continuation; 0 = unconstrained, and clears the slot either way."""
         return self._activate_fn(
             state, jnp.int32(slot), jnp.int32(token), jnp.int32(generated),
             jnp.int32(max_gen), jnp.float32(temperature), jnp.int32(top_k),
-            jnp.float32(top_p), jnp.int32(seed))
+            jnp.float32(top_p), jnp.int32(seed), jnp.int32(gram_state))
 
     # ------------------------------------------------- multi-LoRA serving
 
@@ -1390,16 +1398,26 @@ class EngineCore:
     def _export_impl(self, state: DecodeState, page_ids):
         return kv_cache.export_pages(state.cache, page_ids, self.num_pages)
 
-    def export_slot_kv(self, state: DecodeState, pages, length) -> dict:   # tpulint: hot-path
-        """Gather a prefilled slot's live pages into a dense, host-side
-        handoff payload (kv_cache.export_pages) — the prefill worker's half
-        of disaggregated serving. Dtype-preserving: an int8 pool ships int8
-        values + f32 scales, never a dequantized copy. Blocks on one
-        device→host fetch of the gathered buffer (the prefill role's
-        per-request sync point, the analogue of the unified engine's TTFT
-        fetch). Returns geometry metadata + (L, n_pages, …) numpy buffers;
-        the serving layer base64s them for the HTTP plane
-        (kv_cache.encode_kv_payload)."""
+    def export_slot_kv(self, state: DecodeState, pages, length,
+                       fetch: bool = False) -> dict:   # tpulint: hot-path
+        """Gather a prefilled slot's live pages into a dense handoff
+        payload (kv_cache.export_pages) — the prefill worker's half of
+        disaggregated serving. Dtype-preserving: an int8 pool ships int8
+        values + f32 scales, never a dequantized copy.
+
+        DEVICE-NATIVE by default (``fetch=False``): the payload's array
+        values stay jax arrays — the gather is dispatched (in-order, so
+        page reuse after release cannot race it: its outputs are fresh
+        buffers) but the driver thread never blocks on a device→host
+        copy. An in-process consumer (``import_slot_kv`` on a decode
+        scheduler sharing this host/mesh — the bench's co-hosted roles,
+        the tiered-cache demotion path) scatters the device buffers
+        straight back in, skipping the host roundtrip entirely; the HTTP
+        plane instead materializes them exactly once at wire-encode time
+        (core/kv_wire.encode_for_wire — the one deliberate copy-out per
+        remotely-handed-off request, now off the driver thread).
+        ``fetch=True`` restores the old blocking host export (numpy
+        buffers in the payload)."""
         n_exp = max(1, -(-int(length) // self.page_size))
         b = self._export_bucket(n_exp)
         ids = np.zeros((b,), np.int32)
@@ -1410,6 +1428,10 @@ class EngineCore:
         def trim(a):
             if a is None:
                 return None
+            if not fetch:
+                # device-native: reshape/slice stay lazy device views;
+                # whoever needs host bytes pays the copy there
+                return a.reshape((L, b) + a.shape[1:])[:, :n_exp]
             # tpulint: disable=trace-hazard -- the export IS the copy-out:
             # one deliberate device->host fetch per handed-off request (the
             # prefill role's per-request sync point, documented above)
@@ -1501,6 +1523,17 @@ class EngineCore:
         def pad(a):
             if a is None:
                 return None
+            if isinstance(a, jax.Array):
+                # device-native shortcut: an export from a scheduler
+                # sharing this host/mesh arrives as device arrays — pad
+                # and reshape on device, no host roundtrip at all
+                if a.shape[1] < b:
+                    a = jnp.pad(a, ((0, 0), (0, b - a.shape[1]))
+                                + ((0, 0),) * (a.ndim - 2))
+                return a.reshape((L * b,) + a.shape[2:])
+            # host path: `a` may be a READ-ONLY np.frombuffer view into
+            # the wire body (core/kv_wire.decode_kv_frames) — never write
+            # into it; both branches below only read
             a = np.asarray(a)
             if a.shape[1] < b:
                 a = np.concatenate(

@@ -266,56 +266,19 @@ def import_pages(cache: PagedKVCache, page_ids: jnp.ndarray,   # tpulint: hot-pa
     return PagedKVCache(k=new_k, v=new_v, lengths=lengths)
 
 
-# the JSON wire format of a handoff payload: these array fields ride as
-# base64 alongside the scalar metadata (engine/server.py /v1/kv/handoff)
-_PAYLOAD_ARRAYS = ("k", "v", "k_s", "v_s")
-
-
-def _np_dtype(name: str):
-    """np.dtype for a payload's dtype string, including the ml_dtypes
-    extension types numpy cannot resolve by name (bfloat16)."""
-    import numpy as _np
-    if name == "bfloat16":
-        import ml_dtypes
-        return _np.dtype(ml_dtypes.bfloat16)
-    return _np.dtype(name)
-
-
-def encode_kv_payload(payload: dict) -> dict:
-    """Host KV-handoff payload (numpy buffers) → JSON-safe dict: arrays
-    become {b64, dtype, shape} triples, everything else passes through.
-    The passthrough is a contract: sampling state, SLO class, and the
-    usage plane's ``tenant`` identity (observability/usage.py — the
-    decode replica must bill the same tenant the prefill worker did)
-    all ride the wire as plain scalar keys."""
-    import base64
-    import numpy as _np
-    out = {}
-    for key, value in payload.items():
-        if key in _PAYLOAD_ARRAYS and value is not None:
-            arr = _np.ascontiguousarray(value)
-            out[key] = {"b64": base64.b64encode(arr.tobytes()).decode("ascii"),
-                        "dtype": str(arr.dtype),
-                        "shape": list(arr.shape)}
-        else:
-            out[key] = value
-    return out
-
-
-def decode_kv_payload(wire: dict) -> dict:
-    """Inverse of :func:`encode_kv_payload`."""
-    import base64
-    import numpy as _np
-    out = {}
-    for key, value in wire.items():
-        if (key in _PAYLOAD_ARRAYS and isinstance(value, dict)
-                and "b64" in value):
-            buf = base64.b64decode(value["b64"])
-            out[key] = _np.frombuffer(
-                buf, dtype=_np_dtype(value["dtype"])).reshape(value["shape"])
-        else:
-            out[key] = value
-    return out
+# The wire codecs live in core/kv_wire.py (numpy-only, so the routing
+# frontend can transcode without importing the engine stack): the binary
+# zero-copy frame (encode/decode_kv_frames) is the serving wire, the JSON
+# base64 form below is the compat fallback. Re-exported here because this
+# module is the handoff's home and existing callers import from it. The
+# scalar passthrough is a contract either way: sampling state, SLO class,
+# grammar state, and the usage plane's ``tenant`` identity
+# (observability/usage.py — the decode replica must bill the same tenant
+# the prefill worker did) all ride the wire as plain scalar keys.
+from generativeaiexamples_tpu.core.kv_wire import (  # noqa: F401
+    KV_FRAMES_CONTENT_TYPE, KVWireError, decode_kv_frames, decode_kv_payload,
+    encode_kv_frames, encode_kv_payload, is_kv_frames,
+)
 
 
 class PageAllocator:

@@ -119,6 +119,12 @@ class Request:
     # into one valid document.
     grammar: Optional[object] = None
     grammar_prefix: str = ""
+    # (kind, payload) constructor spec of `grammar` — e.g. ("schema",
+    # schema-json) — stamped by the serving layer so a prefill_only
+    # request can ship the grammar ACROSS the KV handoff as two scalar
+    # strings (the decode replica recompiles via its _grammar_for cache).
+    # None = nothing rides the wire (plain unconstrained handoff).
+    grammar_spec: Optional[tuple] = None
     # set by the scheduler once the grammar-attachment decision is made
     # (final prefill chunk): True = token-level enforcement active, False =
     # degraded to unconstrained (slots pinned / unsupported), None = not
@@ -947,9 +953,22 @@ class Scheduler:
                 # cache hits)
                 self._state = self.core.seed_history(self._state, job.slot,
                                                      job.ids)
+            gs = 0
+            if req.grammar is not None:
+                # grammar rode the handoff: register it on THIS engine's
+                # stack and walk prefix bytes + the remotely-sampled first
+                # token host-side — the slot activates at exactly the DFA
+                # state the prefill worker's fused sample reached, and
+                # decode continues token-level constrained (no more
+                # prompt+parse degradation on disaggregated routes). A
+                # rejecting walk (the prefill side degraded and sampled
+                # off-grammar) or pinned slots fall back to unconstrained.
+                gs = self._gram_state_for(job, extra=(first,))
+            kw = {"gram_state": gs} if gs else {}   # fakes predate the kwarg
             self._state = self.core.activate(
                 self._state, job.slot, first, gen, req.max_tokens,
-                req.temperature, req.top_k, req.top_p, seed=req.seed or 0)
+                req.temperature, req.top_k, req.top_p,
+                seed=req.seed or 0, **kw)
             self._slots[job.slot] = job
         if self._emit_token(job, first,
                             float(payload.get("first_logprob") or 0.0)):
@@ -1080,9 +1099,11 @@ class Scheduler:
             self._enter_decode(job)
         return len(items)
 
-    def _gram_state_for(self, job: _Job) -> int:
+    def _gram_state_for(self, job: _Job, extra: tuple = ()) -> int:
         """Flat DFA start state for a grammared job's fused first token
-        (0 = unconstrained). Resumes re-walk the tokens already emitted.
+        (0 = unconstrained). Resumes re-walk the tokens already emitted;
+        ``extra`` appends tokens not yet in ``gen_ids`` — the KV handoff's
+        remotely-sampled first token, walked before the slot activates.
         Registration failure (unsupported schema, grammar slots pinned)
         degrades to unconstrained — the serving layer's prompt+parse path
         still applies, so the guarantee is strictly additive."""
@@ -1102,8 +1123,9 @@ class Scheduler:
                                 + list(self._pending))
                       if j.request.grammar is not None}
             prefix = job.request.grammar_prefix.encode("utf-8")
-            if job.gen_ids or prefix:
-                state = self.core.walk_grammar(grammar, job.gen_ids, active,
+            tokens = list(job.gen_ids) + list(extra)
+            if tokens or prefix:
+                state = self.core.walk_grammar(grammar, tokens, active,
                                                prefix=prefix)
             else:
                 state = self.core.register_grammar(grammar, active)
@@ -1211,15 +1233,26 @@ class Scheduler:
         export_s = time.perf_counter() - t0
         REGISTRY.histogram("kv_export_s").observe(export_s)
         REGISTRY.counter("kv_handoff_exports").inc()
-        # the export's device_get already synced — a pre-measured commit,
-        # no extra fence in any mode; bucket mirrors the engine's export
-        # compile unit (_export_bucket: pow2 CLAMPED at the slot's page
-        # capacity — an unclamped key would name a program that never
-        # compiles)
+        # the export is DEVICE-NATIVE now (engine.export_slot_kv keeps jax
+        # arrays; the wire encode pays the one host copy later, off this
+        # thread), so the gather is timed like any other dispatch: marker-
+        # fenced when sampled, zero fences in off mode. export_s therefore
+        # measures dispatch issue, not the copy-out — the serving layer
+        # reports the materialize separately (kv_fetch_s). Bucket mirrors
+        # the engine's export compile unit (_export_bucket: pow2 CLAMPED
+        # at the slot's page capacity — an unclamped key would name a
+        # program that never compiles).
         pb = min(pow2_bucket(int(payload.get("n_pages", 1))),
                  int(getattr(self.core, "max_pages_per_slot", 1 << 30)))
-        DEVTIME.commit("kv_export", f"p{pb}", device_s=export_s,
-                       tokens=len(job.ids), mfu=False)
+        marker = payload.get("k")
+        if marker is not None and hasattr(marker, "block_until_ready"):
+            DEVTIME.commit("kv_export", f"p{pb}", marker, t0=t0,
+                           tokens=len(job.ids), mfu=False, retain=False)
+        else:
+            # host export (fetch=True callers / fakes): the fetch already
+            # synced, the wall IS the device+copy time — pre-measured
+            DEVTIME.commit("kv_export", f"p{pb}", device_s=export_s,
+                           tokens=len(job.ids), mfu=False)
         # riding the payload, the downstream kv_prefill span attributes the
         # export's device time per request (and the decode side ignores it)
         payload["export_s"] = round(export_s, 6)
@@ -1241,6 +1274,22 @@ class Scheduler:
             # non-array keys through untouched)
             "tenant": req.tenant,
         })
+        if req.grammar_spec:
+            # constrained decoding rides the handoff: the serving layer
+            # stamped the grammar's CONSTRUCTOR spec (kind + payload —
+            # compact, cacheable via _grammar_for on the decode side) and
+            # this worker's fused final chunk sampled the first token
+            # under the DFA mask (gram_on). The decode replica recompiles
+            # the grammar, walks prefix bytes + this first token host-
+            # side, and activates its slot at the reached state — the
+            # PR 6 prompt+parse degradation is gone. grammar_attached
+            # records whether enforcement was live HERE: a prefill-side
+            # degrade (slots pinned) must not be laundered into a
+            # token-level guarantee downstream.
+            payload["grammar_kind"], payload["grammar_payload"] = \
+                req.grammar_spec
+            payload["grammar_prefix"] = req.grammar_prefix
+            payload["grammar_attached"] = bool(job.gram_on)
         req.handoff = payload
         req.finish_reason = "handoff"
         del self._slots[job.slot]

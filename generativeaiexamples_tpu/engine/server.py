@@ -38,6 +38,8 @@ from typing import Any, Dict, List, Optional
 
 from aiohttp import web
 
+from generativeaiexamples_tpu.core import kv_wire as kv_wire_mod
+from generativeaiexamples_tpu.core.metrics import REGISTRY
 from generativeaiexamples_tpu.engine import grammar as grammar_mod
 from generativeaiexamples_tpu.engine import kv_cache as kv_cache_mod
 from generativeaiexamples_tpu.engine import tools as tools_mod
@@ -184,6 +186,11 @@ class ModelServer:
             logging.getLogger(__name__).debug("load_stats failed: %s", exc)
         body = {"message": "Service is up.",
                 "slo_pressure": slo_mod.SLO.pressure(),
+                # KV-wire capability advert: the routing frontend reads
+                # this off the probes it already makes and never sends a
+                # binary frame to a worker that would 400 it (old engines
+                # carry no field → JSON wire, the PR 6 behavior)
+                "kv_wire": ["binary", "json"],
                 **stats}
         # fleet usage plane (observability/usage.py): the per-tenant
         # rollup and chip-utilization card piggyback on the probe cycle
@@ -412,6 +419,41 @@ class ModelServer:
                 "response_format": response_format, "json_mode": json_mode,
                 "use_tools": use_tools, "forced_name": name}
 
+    @staticmethod
+    def _grammar_for_prep(prep: Dict[str, Any]):
+        """On-device constrained decoding whenever the output contract is
+        unambiguous: a forced/required tool call, or JSON mode without
+        tools (tool_choice "auto" may legally answer in prose, so it
+        stays prompt+parse). The prompt contract is ALWAYS also injected
+        — the mask guarantees validity, the prompt guides content.
+
+        Returns ``(grammar, (kind, payload) | None)`` — the spec is the
+        grammar's constructor arguments, compact enough to ride the KV
+        handoff's scalar passthrough so a decode replica can recompile
+        the SAME grammar through its own ``_grammar_for`` cache. One
+        copy of this decision, shared by /v1/chat/completions and
+        /v1/kv/prefill, so unified and disaggregated routes cannot
+        drift on WHEN enforcement applies."""
+        tools = prep["tools"]
+        name = prep["forced_name"]
+        spec = None
+        if prep["use_tools"] and (prep["tool_choice"] == "required" or name):
+            spec = ("tools", json.dumps({"tools": tools, "forced": name}))
+        elif prep["json_mode"] and not prep["use_tools"]:
+            if prep["response_format"].get("type") == "json_schema":
+                schema = prep["response_format"].get(
+                    "json_schema", {}).get("schema", {})
+                # NOT sort_keys: property order is part of the enforced
+                # language (fixed-order members) and must match the order
+                # the prompt shows the model
+                spec = ("schema", json.dumps(schema))
+            else:
+                spec = ("json", "")
+        if spec is None:
+            return None, None
+        grammar = _grammar_for(*spec)
+        return grammar, (spec if grammar is not None else None)
+
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
         self._require_decode_capable()
         await self._chaos_gate("engine.chat")
@@ -419,30 +461,9 @@ class ModelServer:
         prep = self._prepare_chat(body)
         messages = prep["messages"]
         tools = prep["tools"]
-        tool_choice = prep["tool_choice"]
-        response_format = prep["response_format"]
         json_mode = prep["json_mode"]
         use_tools = prep["use_tools"]
-        name = prep["forced_name"]
-        # On-device constrained decoding whenever the output contract is
-        # unambiguous: a forced/required tool call, or JSON mode without
-        # tools (tool_choice "auto" may legally answer in prose, so it
-        # stays prompt+parse). The prompt contract is ALWAYS also injected
-        # — the mask guarantees validity, the prompt guides content.
-        grammar = None
-        if use_tools and (tool_choice == "required" or name):
-            grammar = _grammar_for("tools", json.dumps(
-                {"tools": tools, "forced": name}))
-        elif json_mode and not use_tools:
-            if response_format.get("type") == "json_schema":
-                schema = response_format.get("json_schema", {}).get(
-                    "schema", {})
-                # NOT sort_keys: property order is part of the enforced
-                # language (fixed-order members) and must match the order
-                # the prompt shows the model
-                grammar = _grammar_for("schema", json.dumps(schema))
-            else:
-                grammar = _grammar_for("json", "")
+        grammar, _gspec = self._grammar_for_prep(prep)
         prompt_ids = self.scheduler.tokenizer.apply_chat_template(messages)
         cont = str(body.get("continue_text") or "")
         if cont:
@@ -469,35 +490,54 @@ class ModelServer:
 
     # ------------------------------------------- KV handoff (disaggregation)
 
-    def _prompt_ids_from_body(self, body: Dict[str, Any]) -> list:
-        """Render a /v1/kv/prefill request body to prompt ids: chat
-        messages run the SAME preparation pipeline as /v1/chat/completions
-        (`_prepare_chat` — one copy, so the endpoints cannot drift;
-        token-level grammars still do NOT ride the handoff — constrained
-        decoding on disaggregated routes degrades to prompt+parse,
-        documented in docs/performance.md); a raw ``prompt`` is encoded
-        directly. ``continue_text`` appends an emitted prefix for
-        mid-stream failover resumes, exactly as the unified resume path
-        does."""
+    def _prompt_ids_from_body(self, body: Dict[str, Any]) -> tuple:
+        """Render a /v1/kv/prefill request body to ``(prompt_ids, grammar,
+        grammar_spec, continue_text)``: chat messages run the SAME
+        preparation pipeline as /v1/chat/completions (`_prepare_chat` +
+        `_grammar_for_prep` — one copy each, so the endpoints cannot
+        drift); a raw ``prompt`` is encoded directly (no grammar).
+        ``continue_text`` appends an emitted prefix for mid-stream
+        failover resumes, exactly as the unified resume path does — the
+        grammar walks it before the first masked sample, and the walked
+        state later rides the handoff."""
+        grammar = gspec = None
         if body.get("messages"):
             prep = self._prepare_chat(body)
             prompt_ids = self.scheduler.tokenizer.apply_chat_template(
                 prep["messages"])
+            grammar, gspec = self._grammar_for_prep(prep)
         else:
             prompt_ids = self.scheduler.tokenizer.encode(
                 str(body.get("prompt", "")), add_bos=True)
         cont = str(body.get("continue_text") or "")
         if cont:
             prompt_ids = prompt_ids + self.scheduler.tokenizer.encode(cont)
-        return prompt_ids
+        return prompt_ids, grammar, gspec, cont
+
+    @staticmethod
+    def _wants_kv_frames(request: web.Request) -> bool:
+        """Content negotiation for /v1/kv/prefill: the binary frame is
+        served only to clients whose Accept names it — an old router that
+        sends no Accept (or ``application/json``) keeps getting the JSON
+        base64 wire, byte-compatible with PR 6."""
+        return (kv_cache_mod.KV_FRAMES_CONTENT_TYPE
+                in request.headers.get("Accept", ""))
 
     async def kv_prefill(self, request: web.Request) -> web.Response:
         """Run chunked prefill for a request and return the exported KV
-        pages + sampling state as a JSON handoff payload — the prefill
-        half of disaggregated serving. Any role can serve this (a unified
-        worker is a valid prefill source); the payload POSTs to a decode
-        worker's /v1/kv/handoff, which imports it and streams the
-        completion."""
+        pages + sampling state as a handoff payload — the prefill half of
+        disaggregated serving. Any role can serve this (a unified worker
+        is a valid prefill source); the payload POSTs to a decode worker's
+        /v1/kv/handoff, which imports it and streams the completion.
+
+        The wire is content-negotiated: ``Accept:
+        application/x-kv-frames`` gets the binary zero-copy frame
+        (core/kv_wire.py — raw array segments, no base64 inflation, crc32
+        per segment); everything else gets the JSON base64 compat form.
+        Constrained-decoding grammars now ride the payload's scalar
+        passthrough (kind + payload spec + walked state semantics in
+        scheduler._export_handoff), so disaggregated routes keep
+        token-level enforcement instead of degrading to prompt+parse."""
         await self._chaos_gate("engine.kv_prefill")
         body = await request.json()
         parent = otel.extract_traceparent(dict(request.headers))
@@ -505,7 +545,8 @@ class ModelServer:
             with otel.get_tracer("engine").span(
                     "engine:kv_prefill",
                     attributes={"http.path": str(request.path)}) as span:
-                prompt_ids = self._prompt_ids_from_body(body)
+                prompt_ids, grammar, gspec, cont = \
+                    self._prompt_ids_from_body(body)
                 sampling = self._parse_sampling(body)
                 sampling.pop("logprobs", None)
                 sampling.pop("top_logprobs", None)
@@ -514,6 +555,8 @@ class ModelServer:
                 if rid_in:
                     slo_fields["request_id"] = rid_in
                 req = Request(prompt_ids=list(prompt_ids), prefill_only=True,
+                              grammar=grammar, grammar_spec=gspec,
+                              grammar_prefix=cont,
                               tenant=usage_mod.tenant_from_headers(
                                   request.headers),
                               **slo_fields, **sampling)
@@ -532,25 +575,44 @@ class ModelServer:
                     # corrupt payloads can never become served garbage KV
                     handoff = chaos_mod.CHAOS.corrupt_kv(
                         handoff, site="engine.kv_prefill")
-                wire = kv_cache_mod.encode_kv_payload(handoff)
-                payload_body = json.dumps(wire).encode("utf-8")
+                binary = self._wants_kv_frames(request)
+                t_fetch = time.perf_counter()
+                # the encode materializes the device-native export (THE
+                # one host copy-out of a remotely-handed-off request) and
+                # walks megabytes — run it off the event loop so other
+                # streams keep pumping
+                loop = asyncio.get_running_loop()
+                payload_body, ctype = await loop.run_in_executor(
+                    None, kv_wire_mod.encode_for_wire, handoff, binary)
+                fetch_s = time.perf_counter() - t_fetch
+                REGISTRY.histogram("kv_fetch_s").observe(fetch_s)
+                if chaos_mod.CHAOS.enabled and binary:
+                    # wire-level corruption (truncated / bit-garbled BINARY
+                    # bodies): the decode side must 400 these at frame
+                    # validation (crc32/length) BEFORE validate_handoff —
+                    # raw segments would otherwise still be shape-valid
+                    payload_body = chaos_mod.CHAOS.corrupt_wire(
+                        payload_body, site="engine.kv_prefill.wire")
                 if otel.tracing_enabled():
                     # the disagg-route trace's prefill leg: how big the KV
-                    # payload is, how many pages move, what the export's
-                    # device copy-out cost, and the queue-vs-device split
-                    # from the request timeline
+                    # payload is ON THE NEGOTIATED WIRE, how many pages
+                    # move, the export dispatch + host materialize costs,
+                    # and the queue-vs-device split from the timeline
                     span.set_attribute("kv.payload_bytes", len(payload_body))
+                    span.set_attribute("kv.wire",
+                                       "binary" if binary else "json-b64")
                     span.set_attribute("kv.pages",
                                        int(req.handoff.get("n_pages", 0)))
                     span.set_attribute(
                         "kv.export_device_s",
                         float(req.handoff.get("export_s", 0.0)))
+                    span.set_attribute("kv.fetch_s", round(fetch_s, 6))
                     for key, value in flight_mod.timeline_attributes(
                             req).items():
                         span.set_attribute(key, value)
                 return web.Response(
                     body=payload_body,
-                    content_type="application/json",
+                    content_type=ctype,
                     headers={"X-Request-Id": req.request_id})
 
     async def kv_handoff(self, request: web.Request) -> web.StreamResponse:
@@ -562,12 +624,25 @@ class ModelServer:
         self._require_decode_capable()
         await self._chaos_gate("engine.kv_handoff")
         raw = await request.read()
-        try:
-            body = json.loads(raw)
-            payload = kv_cache_mod.decode_kv_payload(body)
-        except Exception as exc:
-            raise web.HTTPBadRequest(text=json.dumps(
-                {"error": f"undecodable handoff payload: {exc}"}))
+        body: Dict[str, Any] = {}
+        if kv_wire_mod.is_kv_frames(raw, request.content_type or ""):
+            # binary zero-copy wire: frame bounds + per-segment crc32
+            # verify BEFORE anything reaches the pool — a truncated or
+            # bit-garbled body is a loud 400 here, never scattered KV
+            # (raw segments are shape-valid garbage; the JSON wire got
+            # this check for free from the b64/JSON parse)
+            try:
+                payload = kv_wire_mod.decode_kv_frames(raw)
+            except kv_wire_mod.KVWireError as exc:
+                raise web.HTTPBadRequest(text=json.dumps(
+                    {"error": f"undecodable handoff frame: {exc}"}))
+        else:
+            try:
+                body = json.loads(raw)
+                payload = kv_cache_mod.decode_kv_payload(body)
+            except Exception as exc:
+                raise web.HTTPBadRequest(text=json.dumps(
+                    {"error": f"undecodable handoff payload: {exc}"}))
         parent = otel.extract_traceparent(dict(request.headers))
         with otel.use_parent(parent):
             with otel.get_tracer("engine").span(
@@ -581,6 +656,22 @@ class ModelServer:
                 # payload tenant → key hash (usage.handoff_tenant owns
                 # the precedence and its rationale)
                 tenant = usage_mod.handoff_tenant(request.headers, payload)
+                # grammar continuation: the payload's scalar passthrough
+                # carries the grammar's constructor spec — recompile it
+                # through the same compile-once cache the chat endpoint
+                # uses; the scheduler walks prefix + first token and
+                # activates the slot at that DFA state (no prompt+parse
+                # degradation on disaggregated routes anymore). ONLY when
+                # the prefill leg actually enforced it (grammar_attached):
+                # a degraded prefill sampled its first token UNCONSTRAINED,
+                # and attaching from token 2 here would launder that into
+                # a token-level guarantee the stream never had — the whole
+                # request stays prompt+parse, as the unified degrade does.
+                grammar = None
+                gram_kind = str(payload.get("grammar_kind") or "")
+                if gram_kind and payload.get("grammar_attached"):
+                    grammar = _grammar_for(
+                        gram_kind, str(payload.get("grammar_payload") or ""))
                 req = Request(
                     tenant=tenant,
                     prompt_ids=[int(t)
@@ -591,6 +682,8 @@ class ModelServer:
                     top_p=float(payload.get("top_p", 1.0)),
                     stop=parse_stop(payload.get("stop")),
                     seed=int(payload.get("seed", 0)),
+                    grammar=grammar,
+                    grammar_prefix=str(payload.get("grammar_prefix") or ""),
                     **slo_fields)
                 try:
                     self.scheduler.submit_prefilled(req, payload)
@@ -598,7 +691,8 @@ class ModelServer:
                     raise web.HTTPConflict(text=json.dumps(
                         {"error": str(exc)}))
                 request["engine_request"] = req
-                model = str(body.get("model") or self.model_name)
+                model = str(payload.get("model") or body.get("model")
+                            or self.model_name)
                 rid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
                 resp = await self._sse_response(request)
                 await sse_write(resp, _chunk(model, rid,
@@ -618,6 +712,8 @@ class ModelServer:
                     # attrs (queue wait vs prefill→first-token = the
                     # queue-vs-device split of this worker)
                     span.set_attribute("kv.payload_bytes", len(raw))
+                    span.set_attribute(
+                        "kv.wire", "binary" if not body else "json-b64")
                     span.set_attribute("kv.pages",
                                        int(payload.get("n_pages", 0)))
                     if req.kv_import_s is not None:

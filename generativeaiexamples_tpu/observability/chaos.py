@@ -35,8 +35,13 @@ recover" schedules):
   * ``kv.truncate`` — drop the last page row of an exported KV handoff
                       payload (the decode side MUST 409 loudly —
                       ``validate_handoff`` cross-checks buffer shapes);
+                      on the BINARY wire (:meth:`ChaosPlane.corrupt_wire`)
+                      also truncates the encoded frame's tail — the frame
+                      length prefix must 400 it;
   * ``kv.garble``   — corrupt the payload's geometry metadata
-                      (page_size), same loud-409 contract;
+                      (page_size), same loud-409 contract; on the binary
+                      wire, flips bits inside a raw array segment — only
+                      the per-segment crc32 can catch that (loud 400);
   * ``tick.stall``  — sleep ``param`` seconds inside a scheduler tick
                       (what the engine watchdog exists to detect);
   * ``page.exhaust``— force a KV page allocation to fail (pool-pressure
@@ -309,6 +314,34 @@ class ChaosPlane:
             out["page_size"] = int(out.get("page_size", 0) or 0) + 1
             return out
         return payload
+
+    def corrupt_wire(self, body: bytes, site: str = "kv.wire") -> bytes:
+        """Maybe corrupt an ENCODED binary KV frame (prefill side, AFTER
+        wire encoding — the transport-level counterpart of
+        :meth:`corrupt_kv`). Truncation drops the body's tail; garbling
+        flips bits inside the segment area. Either way the decode side's
+        frame validation (core/kv_wire.decode_kv_frames: length prefix +
+        per-segment crc32) must refuse with a loud 400 BEFORE
+        ``validate_handoff`` — raw binary segments stay shape-valid under
+        bit flips, so without the crc this fault class would be served as
+        silent garbage KV (the JSON wire gets its equivalent check free
+        from the b64/JSON parse)."""
+        if not self._on:
+            return body
+        if self._decide("kv.truncate") is not None:
+            self._record("kv.truncate", site)
+            return body[:max(8, len(body) - max(1, len(body) // 4))]
+        if self._decide("kv.garble") is not None:
+            self._record("kv.garble", site)
+            # flip bytes at 3/4 depth: for any real payload that lands in
+            # an array segment (headers are a few hundred bytes of a
+            # multi-KB body), which only the crc32 can catch
+            out = bytearray(body)
+            pos = (len(out) * 3) // 4
+            for i in range(pos, min(pos + 8, len(out))):
+                out[i] ^= 0xFF
+            return bytes(out)
+        return body
 
     def tick_fault(self, site: str = "scheduler") -> None:
         """Scheduler-tick fault (engine/scheduler._tick): a stall (sleep —

@@ -13,25 +13,155 @@ Prefill:decode sizing. Prefill is compute-bound (one prompt saturates a
 chip's MXU), decode is weight-read-bound and batches across requests, so
 decode replicas want the larger share of a pool; ~1/3 prefill is the
 RAGO-style starting split for chat-shaped traffic (long prompts, short
-answers skew higher; the router's least-loaded scoring absorbs the error
-within a role).
+answers skew higher). The split is MEASURED, not hardcoded (the
+Gemma-on-TPU topology study, arxiv 2605.25645, frames it as a workload
+property): :func:`tuned_prefill_share` derives it from the latest
+``make bench-disagg`` round JSON — per-role worker utilization from the
+round's fleet snapshot, confidence-damped by ``router_imbalance`` (a
+decode pool whose replicas were unevenly hit is noisy evidence) — with
+``APP_PREFILL_SHARE`` as the operator override. The router's least-loaded
+scoring absorbs the residual error within a role either way.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List
+import glob
+import json
+import logging
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_PREFILL_SHARE = 1.0 / 3.0
+# derived shares clamp here: a bench round must never plan a pool with a
+# starved role (plan_engine_roles keeps >= 1 of each regardless)
+_SHARE_LO, _SHARE_HI = 0.15, 0.6
+
+_ROUND_GLOBS = ("MULTICHIP_r*.json", "BENCH_r*.json")
+_ROUND_NUM = re.compile(r"_r(\d+)\.json$")
+
+
+def _latest_round_with_disagg(search_dir: str) -> Optional[Tuple[str, dict]]:
+    """Newest (highest round number) bench JSON in ``search_dir`` carrying
+    a disaggregated round — either the standalone `make bench-disagg` line
+    (top-level ``workers``/``router_imbalance``) or a main round embedding
+    it under ``"disagg"``."""
+    candidates: List[Tuple[int, str]] = []
+    for pattern in _ROUND_GLOBS:
+        for path in glob.glob(os.path.join(search_dir, pattern)):
+            m = _ROUND_NUM.search(path)
+            if m:
+                candidates.append((int(m.group(1)), path))
+    for _, path in sorted(candidates, reverse=True):
+        try:
+            with open(path) as fh:
+                data = json.load(fh)
+        except (OSError, ValueError) as exc:
+            logger.debug("skipping unreadable bench round %s: %s", path, exc)
+            continue
+        if not isinstance(data, dict):
+            continue
+        # the driver wraps bench's JSON line under "parsed"; the main
+        # round embeds the disagg phase under "disagg"; the standalone
+        # `make bench-disagg` line IS the round — accept all three shapes
+        for container in (data, data.get("parsed")):
+            if not isinstance(container, dict):
+                continue
+            round_ = container.get("disagg") if isinstance(
+                container.get("disagg"), dict) else container
+            if isinstance(round_, dict) and "router_imbalance" in round_:
+                return path, round_
+    return None
+
+
+def _share_from_round(round_: dict) -> Optional[float]:
+    """Per-role load balance from the round's fleet snapshot: the share
+    that would equalize per-worker utilization, given what this round's
+    workers reported. Returns None when the snapshot carries no usable
+    signal (no fleet cards, or every worker idle by probe time)."""
+    fleet = round_.get("fleet")
+    workers = fleet.get("workers") if isinstance(fleet, dict) else None
+    if not isinstance(workers, dict):
+        return None
+    load: Dict[str, List[float]] = {"prefill": [], "decode": []}
+    for card in workers.values():
+        if not isinstance(card, dict):
+            continue
+        role = str(card.get("role", ""))
+        if role not in load:
+            continue
+        batch = float(card.get("batch") or 0) or 1.0
+        depth = (float(card.get("running") or 0)
+                 + float(card.get("prefilling") or 0)
+                 + float(card.get("waiting") or 0))
+        load[role].append(depth / batch)
+    if not load["prefill"] or not load["decode"]:
+        return None
+    # summed per-worker utilization = each role's total outstanding work
+    # in batch units; equalizing per-worker load assigns workers
+    # proportionally to it
+    work_pf = sum(load["prefill"])
+    work_dec = sum(load["decode"])
+    total = work_pf + work_dec
+    if total <= 0:
+        return None            # idle-by-probe-time snapshot: no signal
+    return work_pf / total
+
+
+def tuned_prefill_share(default: float = DEFAULT_PREFILL_SHARE,
+                        search_dir: Optional[str] = None
+                        ) -> Tuple[float, str]:
+    """Resolve the pool's prefill share: ``(share, source)``.
+
+    Precedence: ``APP_PREFILL_SHARE`` (operator override, loud ValueError
+    on junk) → the latest bench-disagg round's per-role load balance,
+    damped toward ``default`` by ``router_imbalance`` (an unevenly-hit
+    decode pool is weak evidence about the ROLE split) → ``default``.
+    ``source`` names what decided ("env", "bench:<file>", "default") so
+    the round JSON can record how its own topology was chosen."""
+    env = os.environ.get("APP_PREFILL_SHARE", "").strip()
+    if env:
+        share = float(env)
+        if not 0.0 < share < 1.0:
+            raise ValueError(
+                f"APP_PREFILL_SHARE must be in (0, 1), got {share}")
+        return share, "env"
+    search_dir = (search_dir
+                  or os.environ.get("APP_BENCH_DIR", "").strip()
+                  or os.getcwd())
+    found = _latest_round_with_disagg(search_dir)
+    if found is None:
+        return default, "default"
+    path, round_ = found
+    derived = _share_from_round(round_)
+    if derived is None:
+        return default, "default"
+    imbalance = float(round_.get("router_imbalance", 0.0) or 0.0)
+    confidence = max(0.0, 1.0 - min(1.0, imbalance))
+    share = default + (derived - default) * confidence
+    share = min(max(share, _SHARE_LO), _SHARE_HI)
+    return share, f"bench:{os.path.basename(path)}"
 
 
 def plan_engine_roles(n_workers: int,
-                      prefill_share: float = 1.0 / 3.0) -> List[str]:
+                      prefill_share: Optional[float] = None) -> List[str]:
     """Role per worker for an ``n_workers`` pool.
 
     One worker stays unified (disaggregation needs at least one of each
     role to beat it); larger pools split ``prefill_share`` of workers to
     prefill, the rest to decode, always keeping at least one of each.
+    ``prefill_share=None`` resolves through :func:`tuned_prefill_share`
+    (env override → bench-disagg data → the 1/3 default); pass a value to
+    pin it explicitly.
     """
     if n_workers < 1:
         raise ValueError(f"need at least one worker, got {n_workers}")
+    if prefill_share is None:
+        prefill_share, source = tuned_prefill_share()
+        if source != "default":
+            logger.info("prefill share %.3f from %s", prefill_share, source)
     if not 0.0 < prefill_share < 1.0:
         raise ValueError(f"prefill_share must be in (0, 1), "
                          f"got {prefill_share}")

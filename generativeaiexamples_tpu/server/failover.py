@@ -41,6 +41,7 @@ URL list (chains/llm_client.py get_llm) — zero changes to any chain.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
@@ -50,6 +51,7 @@ import urllib.request
 import uuid
 from typing import Dict, Iterator, List, Optional, Sequence
 
+from generativeaiexamples_tpu.core import kv_wire as kv_wire_mod
 from generativeaiexamples_tpu.core.config import env_float as _env_float
 from generativeaiexamples_tpu.core.config import http_timeout
 from generativeaiexamples_tpu.core.metrics import REGISTRY
@@ -124,6 +126,11 @@ class _Worker:
         # probes this pool already makes — /debug/fleet aggregates these
         self.kv_pages_free = 0
         self.prefix_hit_frac = 0.0
+        # KV-wire capability advert (engine/server.py health): True once
+        # the worker declares it accepts the binary frame on
+        # /v1/kv/handoff. Workers predating the binary wire carry no
+        # field → False → the router relays/transcodes to JSON base64.
+        self.kv_binary = False
         self.perf: Dict[str, object] = {}
         self.usage: Dict[str, Dict[str, float]] = {}
         self.watchdog: Optional[Dict[str, object]] = None
@@ -150,6 +157,9 @@ class _Worker:
                             body.get("kv_pages_free", 0) or 0)
                         self.prefix_hit_frac = float(
                             body.get("prefix_hit_frac", 0.0) or 0.0)
+                        wire = body.get("kv_wire")
+                        self.kv_binary = (isinstance(wire, (list, tuple))
+                                          and "binary" in wire)
                         perf = body.get("perf")
                         self.perf = dict(perf) if isinstance(perf, dict) \
                             else {}
@@ -259,7 +269,9 @@ class FailoverLLM:
                  cooldown_s: Optional[float] = None, max_attempts: int = 4,
                  refresh_s: Optional[float] = None,
                  hedge_s: Optional[float] = None,
-                 policy: Optional[resilience.ResiliencePolicy] = None) -> None:
+                 policy: Optional[resilience.ResiliencePolicy] = None,
+                 kv_wire: Optional[str] = None,
+                 affinity_slack: Optional[float] = None) -> None:
         if not urls:
             raise ValueError("FailoverLLM needs at least one worker URL")
         self._workers = [_Worker(u) for u in urls]
@@ -270,6 +282,30 @@ class FailoverLLM:
         if refresh_s is None:
             refresh_s = _env_float("APP_ROUTER_REFRESH_S", 2.0)
         self.refresh_s = refresh_s
+        # KV transport negotiation (core/kv_wire.py): "auto" (default)
+        # requests the binary zero-copy frame from prefill workers and
+        # relays it verbatim to binary-capable decode replicas,
+        # transcoding to JSON base64 only for workers that never
+        # advertised the frame; "json" forces the PR 6 compat wire
+        # everywhere (bench A/Bs the two); "binary" refuses to transcode
+        # (mixed-version pools fail loudly instead of silently paying
+        # base64 — an operator assertion, not a serving default).
+        self.kv_wire = (kv_wire if kv_wire is not None
+                        else os.environ.get("APP_ROUTER_KV_WIRE",
+                                            "auto").strip().lower() or "auto")
+        if self.kv_wire not in ("auto", "json", "binary"):
+            raise ValueError(f"kv_wire must be auto|json|binary, "
+                             f"got {self.kv_wire!r}")
+        # prefix-affinity stickiness: same-prefix conversations rendezvous-
+        # hash to a preferred replica (see _pick); the slack bounds how
+        # much WORSE (in least-loaded score units ≈ batches of queue
+        # depth) the preferred replica may look before load wins.
+        # Negative disables affinity outright.
+        self.affinity_slack = (
+            affinity_slack if affinity_slack is not None
+            else _env_float("APP_ROUTER_AFFINITY_SLACK", 1.0))
+        self.affinity_chars = int(_env_float("APP_ROUTER_AFFINITY_CHARS",
+                                             512.0))
         # hedged KV-handoff opens (server/resilience.hedged_call): when the
         # primary decode replica hasn't opened the stream within hedge_s,
         # dispatch the SAME payload to the second-least-loaded replica and
@@ -380,9 +416,51 @@ class FailoverLLM:
                         "dispatched": w.total_dispatched}
                 for w in self._workers}
 
+    def _affinity_key(self, messages: Sequence[Dict]) -> str:
+        """Stable key over the conversation's LEADING PREFIX BLOCKS — the
+        part of the prompt whose KV a replica's prefix cache would hold.
+        Keyed on the OPENING — every message up to and INCLUDING the
+        first user message (truncated to ``affinity_chars``): a
+        returning conversation grows at the TAIL, so turn 1 ([user1],
+        or [system, user1]) and every later turn ([…, asst1, user2])
+        truncate to the SAME head — and conversations sharing a long
+        system prompt + opening collide deliberately (their shared
+        prefix is exactly what one replica's cache can serve; the slack
+        bounds the pileup). The volatile latest turn must never enter
+        the key — hashing the whole serialization (or a fixed message
+        COUNT) would remap a conversation between turns. Returns ""
+        when affinity is disabled."""
+        if self.affinity_slack < 0:
+            return ""
+        try:
+            head_msgs = []
+            for m in messages:
+                head_msgs.append(m)
+                if str(m.get("role", "")) == "user":
+                    break
+            head = json.dumps([[str(m.get("role", "")),
+                                str(m.get("content", ""))]
+                               for m in head_msgs])[:self.affinity_chars]
+        except Exception:   # tpulint: disable=except-swallow -- non-dict message shapes (tool parts) just forgo stickiness; routing correctness never depends on the key
+            return ""
+        return hashlib.blake2b(head.encode("utf-8", "replace"),
+                               digest_size=8).hexdigest()
+
+    @staticmethod
+    def _rendezvous(key: str, workers: List[_Worker]) -> _Worker:
+        """Highest-random-weight (rendezvous) hash: every router in a
+        fleet maps ``key`` to the same preferred worker with no shared
+        state, and removing a worker only remaps the keys that pointed at
+        it — the property that keeps prefix caches warm through pool
+        changes (a modulo ring would reshuffle nearly everything)."""
+        return max(workers,
+                   key=lambda w: hashlib.blake2b(
+                       f"{key}|{w.url}".encode(), digest_size=8).digest())
+
     def _pick(self, roles: Sequence[str],
               exclude: Sequence[str] = (),
-              charge: bool = True) -> Optional[_Worker]:   # tpulint: hot-path
+              charge: bool = True,
+              affinity_key: str = "") -> Optional[_Worker]:   # tpulint: hot-path
         """Least-loaded healthy worker among ``roles``. Stale load views
         refresh via /health on the way (bounded by the probe timeout);
         circuit-broken workers re-probe only once their cooldown expires
@@ -390,7 +468,16 @@ class FailoverLLM:
         selects WITHOUT counting a dispatch — for a hedge candidate that
         only launches if the primary is slow; the actual launch charges
         it via :meth:`_charge` so scores and router_dispatches never
-        record dispatches that didn't happen."""
+        record dispatches that didn't happen.
+
+        ``affinity_key`` adds prefix-cache stickiness (ROADMAP item 1/3):
+        the key's rendezvous-preferred worker wins over the least-loaded
+        one as long as its score is within ``affinity_slack`` — scaled up
+        by the replica's live ``prefix_hit_frac`` gauge (a replica
+        demonstrably serving its cache earns more slack, because sending
+        its conversations elsewhere costs a full re-prefill). Past the
+        slack, load wins: affinity must never starve the least-loaded
+        invariant (``router_affinity_total{outcome}`` counts both)."""
         self._ensure_roles()
         now = time.monotonic()
         cands = [w for w in self._workers
@@ -436,11 +523,23 @@ class FailoverLLM:
                     up.append(w)
         if not up:
             return None
+        affinity_outcome = ""
         with self._lock:
             best = min(up, key=lambda w: w.score)
+            if affinity_key and len(up) > 1:
+                pref = self._rendezvous(affinity_key, up)
+                slack = self.affinity_slack * (1.0 + pref.prefix_hit_frac)
+                if pref.score <= best.score + slack:
+                    best = pref
+                    affinity_outcome = "pinned"
+                else:
+                    affinity_outcome = "overridden"
             if charge:
                 best.dispatched += 1
                 best.total_dispatched += 1
+        if affinity_outcome:
+            REGISTRY.counter("router_affinity_total",
+                             labels={"outcome": affinity_outcome}).inc()
         if charge:
             REGISTRY.counter("router_dispatches",
                              labels={"worker": best.url,
@@ -484,12 +583,12 @@ class FailoverLLM:
              temperature: float = 0.7, top_p: float = 1.0,
              top_k: int = 0, response_format: Dict = None) -> Iterator[str]:
         """Streaming chat that survives worker death mid-generation and
-        serves disaggregated when the pool topology allows. On a unified
-        pool, ``response_format`` rides through to the engine — under a
-        json_schema grammar the resumed stream is byte-exact (the engine
-        walks the grammar over the continuation prefix). On disaggregated
-        routes constrained decoding degrades to prompt+parse (the grammar
-        state does not ride the handoff — docs/performance.md).
+        serves disaggregated when the pool topology allows.
+        ``response_format`` rides through to the engine on BOTH routes —
+        under a json_schema grammar the resumed stream is byte-exact (the
+        engine walks the grammar over the continuation prefix), and on
+        disaggregated routes the grammar spec + walked state now ride the
+        KV handoff's scalar passthrough (docs/performance.md).
 
         One ``X-Request-Id`` is minted per logical request and stamped on
         EVERY dispatch this call makes — the prefill→handoff pair, every
@@ -497,13 +596,15 @@ class FailoverLLM:
         timeline for the request shares the router's key."""
         rid = uuid.uuid4().hex[:12]
         self._policy.note_request()   # first attempt: retry-budget deposit
+        akey = self._affinity_key(messages)
         if self._has_disagg():
             yield from self._chat_disagg(messages, max_tokens, temperature,
-                                         top_p, top_k, response_format, rid)
+                                         top_p, top_k, response_format, rid,
+                                         akey)
         else:
             yield from self._chat_unified(messages, max_tokens, temperature,
                                           top_p, top_k, response_format,
-                                          rid=rid)
+                                          rid=rid, affinity_key=akey)
 
     def _headers(self, rid: str,
                  span: Optional[otel.Span] = None) -> Dict[str, str]:
@@ -572,7 +673,8 @@ class FailoverLLM:
                       top_k, response_format,
                       emitted: Optional[List[str]] = None,
                       rid: Optional[str] = None, span=None,
-                      attempt_base: int = 0) -> Iterator[str]:
+                      attempt_base: int = 0,
+                      affinity_key: str = "") -> Iterator[str]:
         """The round-3 failover path over unified/decode workers, selection
         upgraded from round-robin to least-loaded. ``emitted`` carries a
         prefix already delivered to the consumer (a disaggregated route
@@ -594,7 +696,8 @@ class FailoverLLM:
                 # SLO deadline cannot survive the backoff — shed, not
                 # retried (retries_denied_total{pool,reason})
                 break
-            w = self._pick(("unified", "decode", ""))
+            w = self._pick(("unified", "decode", ""),
+                           affinity_key=affinity_key)
             if w is None:
                 last_err = RuntimeError("no unified/decode worker up")
                 continue
@@ -637,7 +740,8 @@ class FailoverLLM:
             f"{last_err}")
 
     def _chat_disagg(self, messages, max_tokens, temperature, top_p,
-                     top_k, response_format, rid: str) -> Iterator[str]:   # tpulint: hot-path
+                     top_k, response_format, rid: str,
+                     affinity_key: str = "") -> Iterator[str]:   # tpulint: hot-path
         """Two-phase disaggregated serving: prefill (KV export) on the
         least-loaded prefill worker, decode on the least-loaded decode
         replica. A failure in either phase circuit-breaks that worker and
@@ -678,9 +782,16 @@ class FailoverLLM:
                                                   response_format,
                                                   emitted=emitted,
                                                   rid=rid, span=span,
-                                                  attempt_base=attempt)
+                                                  attempt_base=attempt,
+                                                  affinity_key=affinity_key)
                     return
-                pw = self._pick(("prefill",))
+                # affinity applies to BOTH phases: today the prefix cache
+                # that skips recompute lives on the PREFILL worker (decode
+                # imports KV into fresh pages), so a returning
+                # conversation must land on the prefill worker holding its
+                # history; the decode pin (below) keeps the conversation's
+                # decode-side placement stable for the item-3 KV tier
+                pw = self._pick(("prefill",), affinity_key=affinity_key)
                 if pw is None:
                     last_err = RuntimeError("no prefill worker up")
                     continue
@@ -695,15 +806,55 @@ class FailoverLLM:
                 try:
                     if chaos_mod.CHAOS.enabled:
                         chaos_mod.CHAOS.http_fault("router.prefill")
+                    pf_headers = self._headers(rid, span)
+                    if self.kv_wire != "json":
+                        # negotiate the binary zero-copy frame; an old
+                        # prefill worker ignores the Accept and answers
+                        # JSON base64 — both decode below
+                        pf_headers["Accept"] = \
+                            kv_wire_mod.KV_FRAMES_CONTENT_TYPE
                     resp = httpx.post(f"{pw.url}/v1/kv/prefill",
                                       json=payload,
-                                      headers=self._headers(rid, span),
+                                      headers=pf_headers,
                                       timeout=http_timeout(120.0))
                     if resp.status_code >= 500:
                         raise httpx.TransportError(
                             f"HTTP {resp.status_code}")
                     resp.raise_for_status()   # 4xx: deterministic — raise
-                    handoff = resp.json()
+                    handoff_body = resp.content
+                    handoff_binary = kv_wire_mod.is_kv_frames(
+                        handoff_body,
+                        resp.headers.get("content-type", ""))
+                    # scalar metadata for span attrs only: the binary peek
+                    # reads the header, never the segment megabytes; the
+                    # JSON compat body is parsed ONLY when tracing wants
+                    # kv.pages — the body itself is relayed verbatim, and
+                    # a per-request multi-MB json parse for an attribute
+                    # nobody records would be pure overhead
+                    handoff_meta: Dict = {}
+                    if handoff_binary:
+                        handoff_meta = kv_wire_mod.peek_kv_frames_meta(
+                            handoff_body)
+                    elif span is not None:
+                        handoff_meta = resp.json()
+                    if self.kv_wire == "binary" and not handoff_binary:
+                        # the operator asserted a homogeneous binary pool;
+                        # an old prefill worker answering JSON violates it
+                        # DETERMINISTICALLY — fail the request loudly now
+                        # instead of burning max_attempts prefills
+                        raise RuntimeError(
+                            f"kv_wire=binary but prefill worker {pw.url} "
+                            f"answered the JSON wire (no frame support)")
+                except kv_wire_mod.KVWireError as exc:
+                    # a frame the prefill worker produced but this router
+                    # cannot parse is payload-suspect, not worker-death:
+                    # count it with the handoff rejects and re-run the
+                    # route for a fresh prefill
+                    REGISTRY.counter("router_handoff_rejects_total").inc()
+                    logger.warning("unparsable kv frame from %s; "
+                                   "re-prefilling: %s", pw.url, exc)
+                    last_err = exc
+                    continue
                 except (httpx.TransportError, httpx.StreamError,
                         json.JSONDecodeError, ConnectionError,
                         OSError) as exc:
@@ -711,21 +862,27 @@ class FailoverLLM:
                     self._mark_down(pw)
                     continue
                 # the KV transport's weight as a metric TREND, not just a
-                # span attribute: ROADMAP item 1's HTTP-base64 seam is
-                # priced per request on /metrics (bench.py reports the
-                # p50 in the disagg round JSON)
+                # span attribute: what actually crossed the wire (binary
+                # frame or JSON base64), priced per request on /metrics
+                # (bench.py reports both wire forms in the disagg round)
                 REGISTRY.histogram("router_kv_payload_bytes").observe(
-                    float(len(resp.content)))
+                    float(len(handoff_body)))
                 if span is not None:
                     span.set_attribute("router.attempts", attempt + 1)
                     span.set_attribute("router.prefill_worker", pw.url)
                     span.set_attribute("router.prefill_s",
                                        round(time.monotonic() - t_pf, 6))
                     span.set_attribute("kv.payload_bytes",
-                                       len(resp.content))
-                    span.set_attribute("kv.pages",
-                                       int(handoff.get("n_pages", 0) or 0))
-                dw = self._pick(("decode",))
+                                       len(handoff_body))
+                    span.set_attribute("kv.wire", "binary" if handoff_binary
+                                       else "json-b64")
+                    span.set_attribute(
+                        "kv.pages", int(handoff_meta.get("n_pages", 0) or 0))
+                # prefix-affinity stickiness: the conversation's leading-
+                # block key pins a returning chat to the decode replica
+                # whose prefix cache already holds its history (within the
+                # least-loaded slack — _pick documents the trade)
+                dw = self._pick(("decode",), affinity_key=affinity_key)
                 if dw is None:
                     last_err = RuntimeError("no decode worker up")
                     continue
@@ -743,23 +900,32 @@ class FailoverLLM:
                 t0 = time.monotonic()
                 winner = dw
                 try:
-                    cm, dresp, winner = self._open_handoff(cands, handoff,
-                                                           rid, span)
+                    cm, dresp, winner = self._open_handoff(
+                        cands, handoff_body, handoff_binary, rid, span)
                 except httpx.HTTPStatusError as exc:
                     if exc.response is not None \
-                            and exc.response.status_code == 409:
-                        # the decode pool REFUSED the payload (geometry/
-                        # dtype validation — e.g. a corrupted handoff):
-                        # the payload itself is suspect, the worker is
-                        # fine. Re-run the route for a FRESH prefill
-                        # instead of circuit-breaking a healthy replica.
+                            and exc.response.status_code in (400, 409):
+                        # the decode pool REFUSED the payload — 409 from
+                        # geometry/dtype validation, 400 from binary-frame
+                        # validation (truncation, crc32): the payload
+                        # itself is suspect, the worker is fine. Re-run
+                        # the route for a FRESH prefill instead of
+                        # circuit-breaking a healthy replica.
                         REGISTRY.counter("router_handoff_rejects_total").inc()
                         logger.warning("decode pool rejected handoff "
-                                       "payload (409); re-prefilling: %s",
-                                       exc)
+                                       "payload (%d); re-prefilling: %s",
+                                       exc.response.status_code, exc)
                         last_err = exc
                         continue
                     raise
+                except kv_wire_mod.KVWireError as exc:
+                    # transcoding for a JSON-only replica found the frame
+                    # corrupt: same payload-suspect contract as the 400
+                    REGISTRY.counter("router_handoff_rejects_total").inc()
+                    logger.warning("kv frame failed transcode validation; "
+                                   "re-prefilling: %s", exc)
+                    last_err = exc
+                    continue
                 except (httpx.TransportError, httpx.StreamError,
                         json.JSONDecodeError, ConnectionError,
                         OSError) as exc:
@@ -817,14 +983,22 @@ class FailoverLLM:
         finally:
             otel.end_span(span)
 
-    def _open_handoff(self, cands: List[_Worker], handoff: Dict,
-                      rid: str, span):
+    def _open_handoff(self, cands: List[_Worker], handoff_body: bytes,
+                      handoff_binary: bool, rid: str, span):
         """Open a /v1/kv/handoff SSE stream on one of ``cands`` and return
         ``(context_manager, response, worker)`` with the response already
         status-checked. One candidate = a plain open; two = a hedged open
         (resilience.hedged_call): the secondary launches only if the
         primary hasn't opened within ``hedge_s``, first success streams,
-        the straggler's stream is closed the moment it lands."""
+        the straggler's stream is closed the moment it lands.
+
+        The payload relays in whatever wire form the PREFILL worker
+        produced — the router never re-parses the megabytes. The one
+        exception is a binary frame bound for a replica that never
+        advertised frame support (``kv_wire`` on /health): under
+        ``kv_wire="auto"`` it is transcoded to the JSON base64 compat
+        form once, shared across hedge legs; under ``"binary"`` the
+        mismatch raises (the operator asserted a homogeneous pool)."""
         import httpx
 
         # headers are built on the CALLER's thread: hedged legs run on
@@ -837,15 +1011,46 @@ class FailoverLLM:
         # call below runs on the hedge thread's empty context
         tenant = usage_mod.current_tenant()
 
+        if handoff_binary and self.kv_wire == "binary" \
+                and not all(w.kv_binary for w in cands):
+            # the operator asserted a homogeneous binary pool: a JSON-only
+            # replica in the candidate set is a deterministic topology
+            # violation — RuntimeError propagates (no payload-suspect
+            # retry loop, no silent transcode)
+            raise RuntimeError(
+                "kv_wire=binary but a selected decode replica never "
+                "advertised frame support — transcode refused")
+
+        transcoded: Dict[str, bytes] = {}
+        transcode_lock = threading.Lock()
+
+        def body_for(w: _Worker):
+            if not handoff_binary or w.kv_binary:
+                return (handoff_body,
+                        kv_wire_mod.KV_FRAMES_CONTENT_TYPE
+                        if handoff_binary else "application/json")
+            # LAZY transcode for a legacy replica, at the moment its leg
+            # actually dispatches — a hedge candidate that never launches
+            # must not cost a megabyte re-encode per request (validates
+            # the frame on the way; KVWireError → payload-suspect retry)
+            with transcode_lock:
+                if "json" not in transcoded:
+                    transcoded["json"] = json.dumps(
+                        kv_wire_mod.transcode_to_json(
+                            handoff_body)).encode("utf-8")
+                    REGISTRY.counter("router_kv_transcodes_total").inc()
+            return transcoded["json"], "application/json"
+
         def open_one(w: _Worker):
             if w is not cands[0]:
                 self._charge(w)   # the hedge leg launched: NOW it counts
                 usage_mod.USAGE.bill_hedge(tenant or None)
             if chaos_mod.CHAOS.enabled:
                 chaos_mod.CHAOS.http_fault("router.handoff")
+            body, ctype = body_for(w)
             cm = httpx.stream("POST", f"{w.url}/v1/kv/handoff",
-                              json=handoff,
-                              headers=headers,
+                              content=body,
+                              headers={**headers, "Content-Type": ctype},
                               timeout=http_timeout(120.0))
             resp = cm.__enter__()
             try:
@@ -864,8 +1069,12 @@ class FailoverLLM:
             # a losing leg's TRANSPORT failure must still circuit-break
             # that worker — the winner masking it would leave a hard-down
             # primary in rotation (lowest score, re-picked every request).
-            # A 409 stays un-broken: the payload is suspect, not the worker.
-            if not isinstance(exc, httpx.HTTPStatusError):
+            # A 409 stays un-broken (the payload is suspect, not the
+            # worker), and so does a lazy-transcode KVWireError (a corrupt
+            # FRAME failing validation on this leg's thread says nothing
+            # about the replica it was bound for).
+            if not isinstance(exc, (httpx.HTTPStatusError,
+                                    kv_wire_mod.KVWireError)):
                 self._mark_down(cands[ix])
 
         result, _ix = resilience.hedged_call(

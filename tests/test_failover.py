@@ -224,7 +224,14 @@ class _FakeWorker:
         # last request headers seen per endpoint key — the usage-plane
         # tests assert the router forwards X-Tenant-Id on every dispatch
         self.headers: dict = {}
-        # extra canned fields merged into the /health body (fleet rollups)
+        # last raw request body per endpoint key — the KV-wire tests
+        # assert what form (binary frame vs JSON) the router relayed
+        self.bodies: dict = {}
+        # canned /v1/kv/prefill response override: (body_bytes, ctype);
+        # None keeps the legacy JSON fake (an "old" prefill worker)
+        self.prefill_response = None
+        # extra canned fields merged into the /health body (fleet rollups,
+        # kv_wire capability adverts)
         self.health_extra: dict = {}
         worker = self
 
@@ -261,11 +268,13 @@ class _FakeWorker:
                     "application/json")
 
             def do_POST(self):
-                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
                 ep = ("prefill" if self.path == "/v1/kv/prefill"
                       else "handoff" if self.path == "/v1/kv/handoff"
                       else "chat")
                 worker.headers[ep] = dict(self.headers)
+                worker.bodies[ep] = body
                 if worker.delay:
                     time.sleep(worker.delay)
                 if self.path == "/v1/kv/handoff" and worker.reject_handoffs:
@@ -280,6 +289,9 @@ class _FakeWorker:
                     return
                 if self.path == "/v1/kv/prefill":
                     worker.hits["prefill"] += 1
+                    if worker.prefill_response is not None:
+                        self._reply(*worker.prefill_response)
+                        return
                     self._reply(json.dumps(
                         {"fake_payload_from": worker.role}).encode(),
                         "application/json")
@@ -427,8 +439,12 @@ def test_router_hedged_handoff_wins_on_slow_replica():
     slow = _FakeWorker("decode", text="slow", delay=1.0)
     fast = _FakeWorker("decode", text="fast", running=1)   # scored second
     with _fake_pool(_FakeWorker("prefill"), slow, fast) as (pw, _, __):
+        # affinity off: this test pins WHICH replica is primary by load
+        # score alone (prefix stickiness is allowed to override that
+        # within its slack — tested separately)
         pool = FailoverLLM([pw.url, slow.url, fast.url], "tiny",
-                           refresh_s=60.0, hedge_s=0.05)
+                           refresh_s=60.0, hedge_s=0.05,
+                           affinity_slack=-1.0)
         wins0 = REGISTRY.counter("hedge_wins_total",
                                  labels={"pool": "router_handoff"}).value
         text = "".join(pool.chat(MESSAGES, max_tokens=8))

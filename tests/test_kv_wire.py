@@ -1,0 +1,595 @@
+"""Zero-copy KV transport + prefix-affinity routing (ROADMAP item 1).
+
+The tentpole contracts of the binary KV wire:
+
+  * the framed octet-stream body (core/kv_wire.py) round-trips a handoff
+    payload bit-exactly for BOTH pool dtypes, with the scalar passthrough
+    intact, and handoff streams decoded from it are TOKEN-IDENTICAL to
+    the unified path — same bar the JSON wire was held to in PR 6;
+  * integrity is loud: truncated or bit-garbled frames raise
+    (length-prefix + per-segment crc32) BEFORE validate_handoff, and the
+    chaos plane's kv.truncate/kv.garble corrupt the binary wire too;
+  * ``np.frombuffer`` read-only views feed the import scatter without a
+    crash and without a defensive copy;
+  * the export is DEVICE-NATIVE: payload arrays stay jax Arrays until a
+    wire encoder materializes them — in-process handoffs never touch the
+    host;
+  * grammar state rides the handoff: constrained decoding on the
+    disaggregated route is token-identical to unified AND schema-valid
+    (the PR 6 prompt+parse degradation is gone);
+  * the router content-negotiates: new↔new relays frames verbatim,
+    new→old transcodes to JSON base64, forced-json never sends frames —
+    and rendezvous prefix affinity pins same-prefix chats to one decode
+    replica without starving the least-loaded invariant;
+  * ``plan_engine_roles`` derives its prefill share from bench-disagg
+    round data (env-overridable) instead of the hardcoded 1:2.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.core import kv_wire
+from generativeaiexamples_tpu.engine import kv_cache
+from generativeaiexamples_tpu.engine.scheduler import Request
+from tests.test_disagg import _drive, _mk_sched, _text
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+
+    from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+    from generativeaiexamples_tpu.models import llama
+    cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    params = llama.init_params(jax.random.PRNGKey(5), cfg)
+    return cfg, params, ByteTokenizer()
+
+
+# ------------------------------------------------------------- frame codec
+
+def _fake_payload(dtype="float32"):
+    rng = np.random.default_rng(3)
+    if dtype == "int8":
+        k = rng.integers(-127, 127, (4, 16, 32)).astype(np.int8)
+        extra = {"k_s": rng.random((4, 8, 16)).astype(np.float32),
+                 "v_s": rng.random((4, 8, 16)).astype(np.float32)}
+    else:
+        import ml_dtypes
+        k = rng.random((4, 16, 32)).astype(
+            ml_dtypes.bfloat16 if dtype == "bfloat16" else dtype)
+        extra = {"k_s": None, "v_s": None}
+    return {"version": 1, "length": 20, "n_pages": 2, "page_size": 16,
+            "kv_dtype": dtype, "tenant": "t_acme", "seed": 41,
+            "stop": ["\n\n"], "prompt_ids": [1, 2, 3],
+            "k": k, "v": k.copy(), **extra}
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int8"])
+def test_frames_roundtrip_bit_exact(dtype):
+    payload = _fake_payload(dtype)
+    body = kv_wire.encode_kv_frames(payload)
+    out = kv_wire.decode_kv_frames(body)
+    for key in ("version", "length", "n_pages", "page_size", "kv_dtype",
+                "tenant", "seed", "stop", "prompt_ids"):
+        assert out[key] == payload[key]
+    for key in kv_wire.PAYLOAD_ARRAYS:
+        if payload.get(key) is None:
+            assert out.get(key) is None
+            continue
+        assert str(out[key].dtype) == str(payload[key].dtype)
+        np.testing.assert_array_equal(
+            np.asarray(out[key], np.float32) if dtype == "bfloat16"
+            else out[key],
+            np.asarray(payload[key], np.float32) if dtype == "bfloat16"
+            else payload[key])
+        # zero-copy contract: decoded arrays are read-only views into the
+        # body, not copies
+        assert not out[key].flags.writeable
+    # binary beats base64 on the bytes that matter: same payload, JSON
+    # form (b64 inflation + per-byte JSON) vs raw segments
+    json_bytes = len(json.dumps(
+        kv_wire.encode_kv_payload(payload)).encode())
+    assert len(body) < json_bytes
+    # the peek reads scalars without touching segments
+    meta = kv_wire.peek_kv_frames_meta(body)
+    assert meta["tenant"] == "t_acme" and "k" not in meta
+
+
+def test_frames_integrity_is_loud():
+    body = kv_wire.encode_kv_frames(_fake_payload())
+    # truncation: every cut depth fails the length prefix or a segment
+    for cut in (3, 7, len(body) // 2, len(body) - 1):
+        with pytest.raises(kv_wire.KVWireError):
+            kv_wire.decode_kv_frames(body[:cut])
+    # bit corruption inside a segment: only the crc32 can catch this —
+    # the buffer stays shape-valid, which is exactly why the header
+    # carries one per segment
+    garbled = bytearray(body)
+    garbled[-9] ^= 0x40
+    with pytest.raises(kv_wire.KVWireError, match="crc32"):
+        kv_wire.decode_kv_frames(bytes(garbled))
+    # wrong magic / not a frame at all
+    assert not kv_wire.is_kv_frames(b'{"k": 1}')
+    with pytest.raises(kv_wire.KVWireError):
+        kv_wire.decode_kv_frames(b"NOPE" + body[4:])
+    # content-type sniff agrees with the magic sniff
+    assert kv_wire.is_kv_frames(body)
+    assert kv_wire.is_kv_frames(b"", kv_wire.KV_FRAMES_CONTENT_TYPE)
+
+
+def test_transcode_to_json_matches_direct_encode():
+    payload = _fake_payload("int8")
+    via_frames = kv_wire.transcode_to_json(
+        kv_wire.encode_kv_frames(payload))
+    direct = kv_wire.encode_kv_payload(payload)
+    assert json.dumps(via_frames, sort_keys=True) == \
+        json.dumps(direct, sort_keys=True)
+
+
+def test_chaos_corrupts_binary_wire_loudly():
+    """kv.truncate / kv.garble extended to the encoded frame: whatever the
+    injector does to the bytes, decode refuses loudly (the served-garbage
+    outcome is impossible), and the same seed replays the same schedule."""
+    from generativeaiexamples_tpu.observability.chaos import CHAOS
+    body = kv_wire.encode_kv_frames(_fake_payload())
+    try:
+        for fault in ("kv.truncate", "kv.garble"):
+            CHAOS.configure(mode="on", seed=7, spec=f"{fault}=1.0")
+            first = CHAOS.corrupt_wire(body, site="test")
+            assert first != body
+            with pytest.raises(kv_wire.KVWireError):
+                kv_wire.decode_kv_frames(first)
+            CHAOS.configure(mode="on", seed=7, spec=f"{fault}=1.0")
+            assert CHAOS.corrupt_wire(body, site="test") == first
+        # off mode: byte-identical passthrough
+        CHAOS.configure(mode="off", spec="")
+        assert CHAOS.corrupt_wire(body, site="test") is body
+    finally:
+        CHAOS.reset()
+
+
+# ------------------------------------- engine: binary wire token identity
+
+@pytest.mark.parametrize("attn,kv_quant,spec",
+                         [("xla", "none", "on"), ("pallas", "int8", "off")])
+def test_handoff_binary_wire_token_identical(tiny, attn, kv_quant, spec):
+    """The acceptance bar, on the NEW wire: prefill-role export →
+    binary-frame round trip → decode-role import streams the same tokens
+    as the unified path, for both pool dtypes. Along the way this pins
+    the device-native export (payload arrays are jax Arrays — no host
+    fetch on the driver thread) and the read-only-import contract (the
+    decoded frame's frombuffer views feed the scatter as-is)."""
+    import jax
+
+    cfg, params, tok = tiny
+    prompt = tok.encode("the quick brown fox jumps over the lazy dog")
+    kw = dict(max_tokens=12, temperature=0.7, seed=123)
+
+    dec = _mk_sched(cfg, params, tok, "decode", attn, kv_quant, spec)
+    ref = Request(prompt_ids=list(prompt), **kw)
+    dec.submit(ref)
+    _drive(dec, [ref])
+    assert ref.error is None, ref.error
+    ref_text = _text(ref)
+    assert ref_text
+
+    pre = _mk_sched(cfg, params, tok, "prefill", attn, kv_quant, spec)
+    rp = Request(prompt_ids=list(prompt), prefill_only=True, **kw)
+    pre.submit(rp)
+    _drive(pre, [rp])
+    assert rp.error is None, rp.error
+    # device-native export: the payload ships device arrays; nothing
+    # fetched them on the scheduler thread
+    assert isinstance(rp.handoff["k"], jax.Array)
+    if kv_quant == "int8":
+        assert isinstance(rp.handoff["k_s"], jax.Array)
+
+    body = kv_wire.encode_kv_frames(rp.handoff)
+    json_bytes = len(json.dumps(
+        kv_wire.encode_kv_payload(rp.handoff)).encode())
+    # the 4/3 inflation is gone: the acceptance criterion's 0.75x bound
+    assert len(body) <= 0.75 * json_bytes + 2048, (len(body), json_bytes)
+    payload = kv_wire.decode_kv_frames(body)
+    assert not payload["k"].flags.writeable    # frombuffer view, not copy
+
+    rd = Request(prompt_ids=list(payload["prompt_ids"]), **kw)
+    dec.submit_prefilled(rd, payload)
+    _drive(dec, [rd])
+    assert rd.error is None, rd.error
+    assert _text(rd) == ref_text
+
+
+def test_inprocess_device_native_handoff_skips_host(tiny):
+    """Prefill and decode schedulers sharing one process/mesh hand the
+    payload over WITHOUT any wire: the device arrays go straight into
+    import_pages — the in-process shortcut behind the same
+    export/import interface."""
+    import jax
+
+    cfg, params, tok = tiny
+    prompt = tok.encode("voltage report for pump four")
+    kw = dict(max_tokens=10, temperature=0.0, seed=9)
+
+    dec = _mk_sched(cfg, params, tok, "decode")
+    ref = Request(prompt_ids=list(prompt), **kw)
+    dec.submit(ref)
+    _drive(dec, [ref])
+    ref_text = _text(ref)
+
+    pre = _mk_sched(cfg, params, tok, "prefill")
+    rp = Request(prompt_ids=list(prompt), prefill_only=True, **kw)
+    pre.submit(rp)
+    _drive(pre, [rp])
+    assert isinstance(rp.handoff["k"], jax.Array)
+    rd = Request(prompt_ids=list(rp.handoff["prompt_ids"]), **kw)
+    dec.submit_prefilled(rd, rp.handoff)   # the payload, no wire at all
+    _drive(dec, [rd])
+    assert rd.error is None and _text(rd) == ref_text
+
+
+def test_grammar_rides_the_handoff(tiny):
+    """Constrained decoding across the disaggregated route: the grammar
+    spec + prefix ride the payload's scalar passthrough, the decode side
+    walks the DFA over the remotely-sampled first token, and the stream
+    is token-identical to the unified grammared request AND
+    schema-valid — the documented PR 6 caveat is closed."""
+    from generativeaiexamples_tpu.engine import grammar as grammar_mod
+    from tests.test_constrained import validates
+
+    cfg, params, tok = tiny
+    schema = {"type": "array", "items": {"type": "integer"}, "minItems": 1}
+    spec = ("schema", json.dumps(schema))
+    prompt = tok.encode("reply with a JSON array of integers")
+    kw = dict(max_tokens=24, temperature=1.0, seed=77)
+
+    dec = _mk_sched(cfg, params, tok, "decode")
+    ref = Request(prompt_ids=list(prompt),
+                  grammar=grammar_mod.Grammar.from_schema(schema), **kw)
+    dec.submit(ref)
+    _drive(dec, [ref])
+    assert ref.error is None and ref.grammar_attached is True
+    ref_text = _text(ref)
+    assert validates(json.loads(ref_text), schema), ref_text
+
+    pre = _mk_sched(cfg, params, tok, "prefill")
+    rp = Request(prompt_ids=list(prompt), prefill_only=True,
+                 grammar=grammar_mod.Grammar.from_schema(schema),
+                 grammar_spec=spec, **kw)
+    pre.submit(rp)
+    _drive(pre, [rp])
+    assert rp.error is None, rp.error
+    # the grammar rode the export as scalars
+    assert rp.handoff["grammar_kind"] == "schema"
+    assert rp.handoff["grammar_attached"] is True
+
+    payload = kv_wire.decode_kv_frames(
+        kv_wire.encode_kv_frames(rp.handoff))
+    # the decode side reconstructs the grammar exactly as the server
+    # does: recompile from the spec that rode the wire
+    rd = Request(prompt_ids=list(payload["prompt_ids"]),
+                 grammar=grammar_mod.Grammar.from_schema(
+                     json.loads(payload["grammar_payload"])), **kw)
+    dec.submit_prefilled(rd, payload)
+    _drive(dec, [rd])
+    assert rd.error is None, rd.error
+    assert rd.grammar_attached is True
+    rd_text = _text(rd)
+    assert rd_text == ref_text
+    assert validates(json.loads(rd_text), schema), rd_text
+
+
+# ------------------------------------------------- server HTTP negotiation
+
+def test_server_negotiates_wire_and_rejects_corrupt_frames(tiny):
+    """One e2e pass over the REAL endpoints: /v1/kv/prefill answers
+    binary to a frames-Accept and JSON to a legacy client (old client →
+    new server); /v1/kv/handoff accepts both bodies and streams
+    token-identical text; a truncated and a garbled binary body both
+    400 loudly before touching the pool."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from generativeaiexamples_tpu.engine.server import ModelServer
+
+    cfg, params, tok = tiny
+    pre = _mk_sched(cfg, params, tok, "prefill")
+    dec = _mk_sched(cfg, params, tok, "decode")
+    pre.start()
+    dec.start()
+    try:
+        pre_srv = ModelServer(pre, "tiny")
+        dec_srv = ModelServer(dec, "tiny")
+        body = {"messages": [{"role": "user",
+                              "content": "list the pump voltages"}],
+                "max_tokens": 10, "temperature": 0.0, "seed": 5}
+
+        async def drive():
+            pc = TestClient(TestServer(pre_srv.app))
+            dcli = TestClient(TestServer(dec_srv.app))
+            await pc.start_server()
+            await dcli.start_server()
+            try:
+                # health advertises the capability the router keys on
+                health = await (await pc.get("/health")).json()
+                assert "binary" in health.get("kv_wire", [])
+                # new client: binary negotiated
+                resp = await pc.post(
+                    "/v1/kv/prefill", json=body,
+                    headers={"Accept": kv_wire.KV_FRAMES_CONTENT_TYPE})
+                assert resp.status == 200
+                assert resp.content_type == kv_wire.KV_FRAMES_CONTENT_TYPE
+                frame = await resp.read()
+                assert kv_wire.is_kv_frames(frame)
+                # old client: same endpoint, no Accept → JSON base64
+                resp_old = await pc.post("/v1/kv/prefill", json=body)
+                assert resp_old.content_type == "application/json"
+                legacy = await resp_old.json()
+                assert "b64" in legacy["k"]
+                assert len(frame) < len(json.dumps(legacy).encode())
+
+                async def stream_handoff(payload_body, ctype):
+                    resp = await dcli.post(
+                        "/v1/kv/handoff", data=payload_body,
+                        headers={"Content-Type": ctype})
+                    assert resp.status == 200, await resp.text()
+                    text = []
+                    raw = (await resp.read()).decode()
+                    for line in raw.splitlines():
+                        if line.startswith("data: ") \
+                                and line != "data: [DONE]":
+                            chunk = json.loads(line[6:])
+                            delta = chunk["choices"][0].get(
+                                "delta", {}).get("content")
+                            assert not chunk.get("error"), chunk
+                            if delta:
+                                text.append(delta)
+                    return "".join(text)
+
+                # corrupt frames 400 BEFORE any import
+                r = await dcli.post(
+                    "/v1/kv/handoff", data=frame[:len(frame) // 2],
+                    headers={"Content-Type":
+                             kv_wire.KV_FRAMES_CONTENT_TYPE})
+                assert r.status == 400
+                assert "frame" in (await r.text())
+                garbled = bytearray(frame)
+                garbled[-17] ^= 0x01
+                r = await dcli.post(
+                    "/v1/kv/handoff", data=bytes(garbled),
+                    headers={"Content-Type":
+                             kv_wire.KV_FRAMES_CONTENT_TYPE})
+                assert r.status == 400
+                # both wires stream the SAME text (new client → new
+                # server relays the frame; old client posts the JSON)
+                t_bin = await stream_handoff(
+                    frame, kv_wire.KV_FRAMES_CONTENT_TYPE)
+                t_json = await stream_handoff(
+                    json.dumps(legacy).encode(), "application/json")
+                assert t_bin and t_bin == t_json
+                return True
+            finally:
+                await pc.close()
+                await dcli.close()
+
+        assert asyncio.run(drive())
+    finally:
+        pre.stop()
+        dec.stop()
+
+
+# --------------------------------------------------- router wire + affinity
+
+def _mk_frame_payload() -> bytes:
+    return kv_wire.encode_kv_frames(_fake_payload())
+
+
+def test_router_relays_frames_to_capable_replicas():
+    """new router → new workers: the prefill Accept asks for frames and
+    the decode dispatch relays the frame VERBATIM (no transcode, no
+    parse of the segment bytes)."""
+    from tests.test_failover import _FakeWorker, _fake_pool
+    from generativeaiexamples_tpu.server.failover import FailoverLLM
+
+    frame = _mk_frame_payload()
+    pw = _FakeWorker("prefill")
+    pw.prefill_response = (frame, kv_wire.KV_FRAMES_CONTENT_TYPE)
+    dw = _FakeWorker("decode", text="ok")
+    dw.health_extra = {"kv_wire": ["binary", "json"]}
+    with _fake_pool(pw, dw):
+        pool = FailoverLLM([pw.url, dw.url], "tiny", refresh_s=60.0)
+        text = "".join(pool.chat(
+            [{"role": "user", "content": "hi"}], max_tokens=8))
+        assert text == "ok"
+        assert kv_wire.KV_FRAMES_CONTENT_TYPE in \
+            pw.headers["prefill"].get("Accept", "")
+        assert dw.bodies["handoff"] == frame
+        assert dw.headers["handoff"]["Content-Type"] == \
+            kv_wire.KV_FRAMES_CONTENT_TYPE
+
+
+def test_router_transcodes_frames_for_legacy_replica():
+    """new router → old decode worker: no kv_wire advert on /health, so
+    the frame transcodes to the JSON base64 form the old worker parses
+    (the compat matrix's new-client→old-server cell)."""
+    from tests.test_failover import _FakeWorker, _fake_pool
+    from generativeaiexamples_tpu.server.failover import FailoverLLM
+
+    frame = _mk_frame_payload()
+    pw = _FakeWorker("prefill")
+    pw.prefill_response = (frame, kv_wire.KV_FRAMES_CONTENT_TYPE)
+    dw = _FakeWorker("decode", text="ok")      # no advert: legacy worker
+    with _fake_pool(pw, dw):
+        pool = FailoverLLM([pw.url, dw.url], "tiny", refresh_s=60.0)
+        text = "".join(pool.chat(
+            [{"role": "user", "content": "hi"}], max_tokens=8))
+        assert text == "ok"
+        assert dw.headers["handoff"]["Content-Type"] == "application/json"
+        sent = json.loads(dw.bodies["handoff"])
+        assert sent["k"]["b64"]                 # decodable legacy form
+        np.testing.assert_array_equal(
+            kv_wire.decode_kv_payload(sent)["k"], _fake_payload()["k"])
+
+
+def test_router_forced_json_never_asks_for_frames():
+    """kv_wire="json" (the bench A/B arm): no frames Accept on prefill,
+    JSON relayed as-is — byte-compatible with the PR 6 route."""
+    from tests.test_failover import _FakeWorker, _fake_pool
+    from generativeaiexamples_tpu.server.failover import FailoverLLM
+
+    pw = _FakeWorker("prefill")
+    dw = _FakeWorker("decode", text="ok")
+    dw.health_extra = {"kv_wire": ["binary", "json"]}
+    with _fake_pool(pw, dw):
+        pool = FailoverLLM([pw.url, dw.url], "tiny", refresh_s=60.0,
+                           kv_wire="json")
+        assert "".join(pool.chat(
+            [{"role": "user", "content": "hi"}], max_tokens=8)) == "ok"
+        assert kv_wire.KV_FRAMES_CONTENT_TYPE not in \
+            pw.headers["prefill"].get("Accept", "")
+        assert dw.headers["handoff"]["Content-Type"] == "application/json"
+
+
+def test_router_affinity_pins_same_prefix_chats():
+    """Same-prefix conversations rendezvous to ONE decode replica (the
+    prefix_hit_frac divides-by-N failure mode closed), the pick is
+    stable across router instances (stateless rendezvous), and a
+    DIFFERENT prefix is free to land elsewhere."""
+    from tests.test_failover import _FakeWorker, _fake_pool
+    from generativeaiexamples_tpu.server.failover import FailoverLLM
+
+    decodes = [_FakeWorker("decode", text="x") for _ in range(3)]
+    pw = _FakeWorker("prefill")
+    with _fake_pool(pw, *decodes):
+        urls = [pw.url] + [d.url for d in decodes]
+        convo = [{"role": "system", "content": "you are a pump assistant"},
+                 {"role": "user", "content": "report voltages"}]
+        pool = FailoverLLM(urls, "tiny", refresh_s=60.0)
+        for turn in range(4):
+            # the conversation GROWS but its leading blocks are stable —
+            # every turn must land on the same replica
+            assert "".join(pool.chat(
+                convo + [{"role": "user", "content": f"turn {turn}"}],
+                max_tokens=8))
+        hits = [d.hits["handoff"] for d in decodes]
+        assert sorted(hits) == [0, 0, 4], hits
+        pinned = decodes[hits.index(4)]
+        # stateless: a second router (another chain-server process) maps
+        # the same conversation to the same replica
+        pool2 = FailoverLLM(urls, "tiny", refresh_s=60.0)
+        assert "".join(pool2.chat(convo, max_tokens=8))
+        assert pinned.hits["handoff"] == 5
+
+
+def test_affinity_key_stable_across_turns():
+    """The key covers messages up to and INCLUDING the first user
+    message: turn 1 and turn N of one conversation map to the same key,
+    with or without a system prompt — a fixed message count would remap
+    a no-system conversation between its first and second turn."""
+    from generativeaiexamples_tpu.server.failover import FailoverLLM
+
+    pool = FailoverLLM(["http://x:1"], "tiny")
+    u1 = {"role": "user", "content": "first question"}
+    a1 = {"role": "assistant", "content": "an answer"}
+    u2 = {"role": "user", "content": "follow-up"}
+    sys_ = {"role": "system", "content": "you are a pump assistant"}
+    assert pool._affinity_key([u1]) == pool._affinity_key([u1, a1, u2])
+    assert pool._affinity_key([sys_, u1]) == \
+        pool._affinity_key([sys_, u1, a1, u2])
+    assert pool._affinity_key([u1]) != pool._affinity_key(
+        [{"role": "user", "content": "a different conversation"}])
+
+
+def test_router_affinity_yields_to_load():
+    """The least-loaded invariant survives: once the preferred replica's
+    score exceeds the slack, traffic overflows to the healthy one
+    (affinity must never starve the pool under skewed pressure)."""
+    from tests.test_failover import _FakeWorker, _fake_pool
+    from generativeaiexamples_tpu.server.failover import FailoverLLM
+
+    decodes = [_FakeWorker("decode", text="x") for _ in range(2)]
+    pw = _FakeWorker("prefill")
+    convo = [{"role": "user", "content": "sticky conversation"}]
+    with _fake_pool(pw, *decodes):
+        urls = [pw.url] + [d.url for d in decodes]
+        pool = FailoverLLM(urls, "tiny", refresh_s=60.0)
+        assert "".join(pool.chat(convo, max_tokens=8))
+        pinned = decodes[0] if decodes[0].hits["handoff"] else decodes[1]
+        other = decodes[1] if pinned is decodes[0] else decodes[0]
+        # drown the preferred replica: deep queue + critical pressure
+        pinned.running, pinned.waiting, pinned.pressure = 8, 9, "critical"
+        pool2 = FailoverLLM(urls, "tiny", refresh_s=60.0)
+        for _ in range(3):
+            assert "".join(pool2.chat(convo, max_tokens=8))
+        assert other.hits["handoff"] == 3
+        assert pinned.hits["handoff"] == 1     # only the pre-skew chat
+
+
+# ------------------------------------------------- tuned prefill:decode split
+
+def test_tuned_prefill_share_env_override(monkeypatch):
+    from generativeaiexamples_tpu.parallel import topology
+
+    monkeypatch.setenv("APP_PREFILL_SHARE", "0.5")
+    share, source = topology.tuned_prefill_share()
+    assert (share, source) == (0.5, "env")
+    assert topology.plan_engine_roles(4) == \
+        ["prefill", "prefill", "decode", "decode"]
+    monkeypatch.setenv("APP_PREFILL_SHARE", "1.5")
+    with pytest.raises(ValueError):
+        topology.tuned_prefill_share()
+
+
+def _round_json(imbalance, pf_load, dec_loads):
+    workers = {"http://p:1": {"role": "prefill", "batch": 8,
+                              "running": pf_load, "prefilling": 0,
+                              "waiting": 0}}
+    for i, load in enumerate(dec_loads):
+        workers[f"http://d:{i}"] = {"role": "decode", "batch": 8,
+                                    "running": load, "prefilling": 0,
+                                    "waiting": 0}
+    return {"metric": "disagg_serving", "router_imbalance": imbalance,
+            "fleet": {"workers": workers}}
+
+
+def test_tuned_prefill_share_from_bench_round(tmp_path, monkeypatch):
+    from generativeaiexamples_tpu.parallel import topology
+
+    monkeypatch.delenv("APP_PREFILL_SHARE", raising=False)
+    # prefill workers drowning (8/8) while decode idles → share rises
+    (tmp_path / "MULTICHIP_r07.json").write_text(
+        json.dumps(_round_json(0.0, pf_load=8, dec_loads=[1, 1])))
+    share, source = topology.tuned_prefill_share(search_dir=str(tmp_path))
+    assert source == "bench:MULTICHIP_r07.json"
+    assert share > topology.DEFAULT_PREFILL_SHARE
+    # a NEWER round wins, and full imbalance (noisy decode spread)
+    # collapses confidence back to the default
+    (tmp_path / "MULTICHIP_r08.json").write_text(
+        json.dumps(_round_json(1.0, pf_load=8, dec_loads=[8, 0])))
+    share2, source2 = topology.tuned_prefill_share(
+        search_dir=str(tmp_path))
+    assert source2 == "bench:MULTICHIP_r08.json"
+    assert share2 == pytest.approx(topology.DEFAULT_PREFILL_SHARE)
+    # idle snapshot = no signal = default
+    (tmp_path / "MULTICHIP_r09.json").write_text(
+        json.dumps(_round_json(0.0, pf_load=0, dec_loads=[0, 0])))
+    assert topology.tuned_prefill_share(
+        search_dir=str(tmp_path)) == (topology.DEFAULT_PREFILL_SHARE,
+                                      "default")
+
+
+def test_plan_engine_roles_defaults_hold(monkeypatch, tmp_path):
+    from generativeaiexamples_tpu.parallel import topology
+
+    monkeypatch.delenv("APP_PREFILL_SHARE", raising=False)
+    monkeypatch.setenv("APP_BENCH_DIR", str(tmp_path))  # no rounds: default
+    assert topology.plan_engine_roles(1) == ["unified"]
+    assert topology.plan_engine_roles(3) == ["prefill", "decode", "decode"]
+    with pytest.raises(ValueError):
+        topology.plan_engine_roles(0)
+    with pytest.raises(ValueError):
+        topology.plan_engine_roles(3, 1.5)
