@@ -864,6 +864,7 @@ def run_chaos_round(n_workers: int = 2, n_requests: int = 16,
     sides' injected-fault counts. Workers run the deterministic tiny
     model on CPU — the phase measures the CONTROL plane under faults,
     not chip arithmetic."""
+    import logging
     import os
     import signal
     import statistics as stats
@@ -974,7 +975,87 @@ def run_chaos_round(n_workers: int = 2, n_requests: int = 16,
                 return None
             return round(vals[min(int(q * len(vals)), len(vals) - 1)], 4)
 
+        # -- evacuation scenario (live migration A/B) --------------------
+        # Kill worker[0] mid-stream the GRACEFUL way (drain?evacuate=1)
+        # with the router's snapshot resume on vs off: the on-arm should
+        # recover displaced streams by TRANSFER (router_resume_total
+        # mode=snapshot), the off-arm by continue_text re-prefill —
+        # resume_reprefill_frac and the goodput delta price exactly that
+        # recovery difference. Router-side chaos is off here (reset
+        # above): the phase measures the migration plane, not transport
+        # flakiness on top of it.
+        def evac_arm(snapshot_on: bool) -> dict:
+            os.environ["APP_ROUTER_SNAPSHOT_RESUME"] = (
+                "on" if snapshot_on else "off")
+            try:
+                arm_router = FailoverLLM(urls, "tiny-llama-test",
+                                         cooldown_s=1.0)
+            finally:
+                os.environ.pop("APP_ROUTER_SNAPSHOT_RESUME", None)
+            resume0 = {m: REGISTRY.counter(
+                "router_resume_total", labels={"mode": m}).value
+                for m in ("snapshot", "reprefill")}
+            arm_done: list = []
+
+            def arm_one(i: int) -> None:
+                t0 = time.perf_counter()
+                ok = True
+                try:
+                    with slo_mod.admission("interactive",
+                                           deadline_ms=deadline_ms):
+                        for _ in arm_router.chat(messages, max_tokens=96,
+                                                 temperature=0.0):
+                            pass
+                except Exception:
+                    ok = False
+                arm_done.append((ok, time.perf_counter() - t0))
+
+            arm_threads = [threading.Thread(target=arm_one, args=(i,))
+                           for i in range(max(4, n_requests // 2))]
+            for t in arm_threads:
+                t.start()
+            time.sleep(0.3)   # let streams open on both workers
+            try:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{ports[0]}/debug/drain?evacuate=1",
+                    method="POST"), timeout=30).read()
+            except Exception as exc:
+                logging.getLogger(__name__).warning(
+                    "bench drain request failed: %s", exc)
+            for t in arm_threads:
+                t.join()
+            try:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{ports[0]}/debug/drain?off=1",
+                    method="POST"), timeout=10).read()
+            except Exception as exc:
+                logging.getLogger(__name__).warning(
+                    "bench undrain request failed: %s", exc)
+            resumes = {m: int(REGISTRY.counter(
+                "router_resume_total", labels={"mode": m}).value
+                - resume0[m]) for m in resume0}
+            total_resumes = sum(resumes.values())
+            good = sum(1 for ok, wall in arm_done
+                       if ok and wall <= deadline_ms / 1000.0)
+            return {
+                "snapshot_resume": "on" if snapshot_on else "off",
+                "n_streams": len(arm_threads),
+                "goodput_frac": round(good / len(arm_threads), 4),
+                "resumes": resumes,
+                "resume_reprefill_frac": (
+                    round(resumes["reprefill"] / total_resumes, 4)
+                    if total_resumes else None),
+            }
+
+        evac_on = evac_arm(True)
+        evac_off = evac_arm(False)
+
         return {
+            "evacuation": {"on": evac_on, "off": evac_off},
+            # the serving default's recovery split + what migration buys
+            "resume_reprefill_frac": evac_on["resume_reprefill_frac"],
+            "evac_goodput_delta": round(
+                evac_on["goodput_frac"] - evac_off["goodput_frac"], 4),
             "n_workers": n_workers,
             "n_requests": n_requests,
             "seed": CHAOS_SEED,

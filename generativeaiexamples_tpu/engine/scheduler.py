@@ -30,11 +30,12 @@ from __future__ import annotations
 import heapq
 import logging
 import math
+import os
 import queue
 import threading
 import time
 import uuid
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Deque, Dict, Iterator, List, Optional, Sequence
@@ -52,6 +53,7 @@ from generativeaiexamples_tpu.observability.flight import FLIGHT, REQUEST_LOG
 from generativeaiexamples_tpu.engine.engine import (
     DecodeState, EngineCore, bits_to_f32, unpack_decode_out)
 from generativeaiexamples_tpu.engine.prefix_cache import chain_hashes
+from generativeaiexamples_tpu.engine.spill import KVSpillPool, spill_budget_bytes
 from generativeaiexamples_tpu.engine.tokenizer import IncrementalDetokenizer, Tokenizer
 
 logger = logging.getLogger(__name__)
@@ -181,6 +183,14 @@ class Request:
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
     preemptions: int = 0
+    # resume-mode accounting next to `preemptions` (/debug/requests
+    # timelines distinguish transfer recovery from recompute recovery):
+    # spill_resumes counts page-exhaust preemptions promoted back from
+    # the host spill pool (zero re-prefill); snapshot_resumes counts
+    # mid-decode snapshot admissions on THIS worker (the request was
+    # evacuated from a peer and resumed here token-identically)
+    spill_resumes: int = 0
+    snapshot_resumes: int = 0
     prefix_hit_tokens: int = 0
     completion_tokens: int = 0
     error: Optional[str] = None
@@ -230,6 +240,11 @@ class _Job:
     # KV-handoff payload for admit-with-prefilled-KV (submit_prefilled):
     # imported at admission instead of running prefill chunks
     preload: Optional[dict] = None
+    # host-spilled snapshot of a page-exhaust-preempted slot (engine/
+    # spill.py): the payload's host buffers re-import at re-admission
+    # (_admit_spilled) instead of re-prefilling — the job keeps its live
+    # detok/stop/grammar state, only the KV pages moved
+    spill: Optional[dict] = None
     # trailing acceptance EMA (drafts accepted per widened step) — the
     # adaptive spec-width controller's per-slot signal; seeded from the
     # scheduler-global EMA at admission so fresh slots start where the
@@ -331,6 +346,29 @@ class Scheduler:
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self._wake = threading.Event()
+        # host-spill preemption (engine/spill.py): with a byte budget
+        # armed, page-exhaust preemption demotes the victim's pages to
+        # host RAM instead of freeing-and-recomputing them; 0 = off.
+        budget = spill_budget_bytes(getattr(core, "cfg", None))
+        self._spill: Optional[KVSpillPool] = (
+            KVSpillPool(budget) if budget > 0
+            and hasattr(core, "export_slot_kv")
+            and hasattr(core, "import_slot_kv") else None)
+        # live-migration evacuation (drain/SIGTERM/watchdog-trip): callers
+        # queue a request, the DRIVER thread (owner of _state) performs it
+        # inside _tick, parking each live slot's mid-decode snapshot in the
+        # outbox for the router to pull (/v1/kv/evacuation/<rid>). The
+        # outbox is count-capped AND TTL'd: device-native snapshots pin
+        # real HBM (dense KV copies), and an unpulled entry — resume
+        # disabled router-side, no router at all, a watchdog-recovered
+        # worker that keeps serving — must not hold device memory forever
+        # on exactly the worker that just tripped under pressure.
+        self._evac_lock = threading.Lock()
+        self._evac_reqs: List[dict] = []
+        self._evac_outbox: "OrderedDict[str, tuple]" = OrderedDict()
+        self._evac_outbox_cap = 64
+        self._evac_ttl_s = float(os.environ.get("APP_EVAC_TTL_S", "")
+                                 or 120.0)
         # tick heartbeat for the engine watchdog (engine/watchdog.py): the
         # driver stamps this every loop iteration; a sustained gap while
         # _running means the driver is wedged inside one tick
@@ -424,6 +462,22 @@ class Scheduler:
         job = _Job(request=request,
                    detok=IncrementalDetokenizer(self.tokenizer),
                    ids=list(request.prompt_ids))
+        if payload.get("resume"):
+            # mid-decode snapshot (export_live_slot on a peer): the
+            # payload's prompt_ids span EVERYTHING whose KV is written —
+            # the true prompt plus the tokens generated before the
+            # snapshot, split by prompt_len. The job's ids mirror the
+            # written KV (page math, history seeding, preemption rebuild
+            # all key off them); gen_ids reseed the generated prefix so
+            # grammar walks, stop accounting, and a later preemption of
+            # the RESUMED stream stay exactly as if it had decoded here
+            # from token 0.
+            full = [int(t) for t in payload.get("prompt_ids", [])]
+            plen = max(0, min(int(payload.get("prompt_len", len(full))),
+                              len(full)))
+            request.prompt_ids = full[:plen]
+            job.ids = list(full)
+            job.gen_ids = full[plen:]
         job.preload = dict(payload)
         with self._lock:
             self._pending.append(job)
@@ -505,6 +559,9 @@ class Scheduler:
             # the fuzz harness asserts the bound)
             self._bill_pages(job)
             job.page_clock = 0.0
+            # spilled host buffers die with their job (budget conservation
+            # through driver resets — fuzz-asserted)
+            self._drop_spill(job)
             usage_mod.USAGE.bill_request(job.request)
             REQUEST_LOG.record(job.request)
             job.request.out_queue.put(_STOP)
@@ -518,6 +575,13 @@ class Scheduler:
         self._inflight.clear()
         self._first_fetches = []
         self._pending_steps = 0
+        # unblock evacuation waiters: their jobs just failed loudly — a
+        # drain handler must not sit out its full timeout on a dead driver
+        with self._evac_lock:
+            waiters, self._evac_reqs = self._evac_reqs, []
+        for entry in waiters:
+            entry["result"] = {"error": reason}
+            entry["event"].set()
 
     def _bill_pages(self, job: _Job) -> None:
         """Accumulate the job's KV page-seconds (pages held x wall) into
@@ -601,6 +665,7 @@ class Scheduler:
         # follow-up turn whose templated prompt embeds this conversation
         # verbatim re-admits against them
         self._cache_insert(job, with_generated=True)
+        self._drop_spill(job)
         self._release(job)
 
     def _fail(self, job: _Job, reason: str) -> None:
@@ -613,6 +678,7 @@ class Scheduler:
         # hold pages (kv-export failure) release AFTER this call
         self._bill_pages(job)
         job.page_clock = 0.0
+        self._drop_spill(job)
         usage_mod.USAGE.bill_request(job.request)
         REQUEST_LOG.record(job.request)
         job.request.out_queue.put(_STOP)
@@ -669,10 +735,11 @@ class Scheduler:
         prefill pass skip reuse unless the cache covers most of the prompt
         — one ring pass beats re-chunking a nearly-uncovered prompt."""
         n = len(job.ids)
-        if job.preload is not None or not self._caching:
-            # handoff imports SCATTER into their pages — they must never be
-            # served shared (refcounted) prefix-cache pages, which other
-            # requests may be reading; always allocate fresh
+        if job.preload is not None or job.spill is not None \
+                or not self._caching:
+            # handoff/spill imports SCATTER into their pages — they must
+            # never be served shared (refcounted) prefix-cache pages, which
+            # other requests may be reading; always allocate fresh
             return self.core.pages_for(n), 0, []
         if job.hashed_len != n:
             # the chain seed namespaces by adapter: KV depends on the
@@ -902,6 +969,8 @@ class Scheduler:
             self._table_dev = None
             if job.preload is not None:
                 self._admit_prefilled(job)
+            elif job.spill is not None:
+                self._admit_spilled(job)
             else:
                 self._prefilling.append(job)
 
@@ -936,12 +1005,18 @@ class Scheduler:
         REGISTRY.counter("kv_handoff_imports").inc()
         first = int(payload.get("first_token", self.core.eos_id))
         gen = max(1, int(payload.get("generated", 1)))
-        if req.first_token_at is None:
+        resume = bool(payload.get("resume"))
+        if resume:
+            REGISTRY.counter("snapshot_resumes").inc()
+            req.snapshot_resumes += 1
+        elif req.first_token_at is None:
             # the first token was sampled remotely; it reaches this
             # worker's client now — TTFT is honest end-to-end latency
+            # (a mid-stream snapshot resume instead streamed its first
+            # token long ago, on the evacuating worker — no TTFT here)
             req.first_token_at = now
             REGISTRY.histogram("ttft_s").observe(now - req.submitted_at)
-        if first == self.core.eos_id:
+        if first == self.core.eos_id and not resume:
             req.finish_reason = "eos"
             self._finish(job)
             return
@@ -963,6 +1038,8 @@ class Scheduler:
                 # prompt+parse degradation on disaggregated routes). A
                 # rejecting walk (the prefill side degraded and sampled
                 # off-grammar) or pinned slots fall back to unconstrained.
+                # Snapshot resumes walk their whole emitted history the
+                # same way (gen_ids was reseeded at submit).
                 gs = self._gram_state_for(job, extra=(first,))
             kw = {"gram_state": gs} if gs else {}   # fakes predate the kwarg
             self._state = self.core.activate(
@@ -970,6 +1047,9 @@ class Scheduler:
                 req.temperature, req.top_k, req.top_p,
                 seed=req.seed or 0, **kw)
             self._slots[job.slot] = job
+        if resume:
+            self._resume_stream_state(job, payload, first, alive)
+            return
         if self._emit_token(job, first,
                             float(payload.get("first_logprob") or 0.0)):
             if alive:
@@ -978,6 +1058,41 @@ class Scheduler:
                 self._finish(job)
             return
         if not alive:
+            req.finish_reason = "length"
+            self._finish(job)
+
+    def _resume_stream_state(self, job: _Job, payload: dict, first: int,
+                             alive: bool) -> None:
+        """Reconstitute a mid-decode snapshot's HOST stream state: replay
+        the emitted-token history through the fresh detokenizer (held
+        UTF-8 bytes continue exactly where the exporting worker stopped),
+        restore the stop-sequence holdback, and re-emit only the text the
+        CLIENT has not seen yet (``resume_chars`` — the router stamps how
+        many chars it delivered; a hard-death pull may lag the exporting
+        worker's emitted tokens, and that gap must reach the client, not
+        be discarded). The pending token joins ``gen_ids`` WITHOUT
+        streaming its text again."""
+        req = job.request
+        replay = "".join(job.detok.push(int(t))
+                         for t in list(job.gen_ids) + [first])
+        job.gen_ids.append(first)
+        job.total_len += 1
+        job.stop_buf = str(payload.get("stop_buf") or "")
+        # chars the exporting worker actually streamed = every delta it
+        # processed minus the holdback it was still sitting on
+        streamed = max(0, len(replay) - len(job.stop_buf))
+        sent = payload.get("resume_chars")
+        already = streamed if sent is None else max(0, min(int(sent),
+                                                           streamed))
+        gap = replay[already:streamed]
+        if gap:
+            req.out_queue.put(gap)
+        if not alive:
+            # the snapshot landed exactly at the generation budget (the
+            # exporting worker would normally have finished instead) —
+            # end cleanly; the replayed text was already streamed, so the
+            # detok tail must NOT flush again
+            job.stopped = True
             req.finish_reason = "length"
             self._finish(job)
 
@@ -1230,66 +1345,27 @@ class Scheduler:
             self._fail(job, f"kv export failed: {exc}")
             self._release(job)
             return
-        export_s = time.perf_counter() - t0
-        REGISTRY.histogram("kv_export_s").observe(export_s)
+        self._commit_export(payload, job, t0, tokens=len(job.ids))
         REGISTRY.counter("kv_handoff_exports").inc()
-        # the export is DEVICE-NATIVE now (engine.export_slot_kv keeps jax
-        # arrays; the wire encode pays the one host copy later, off this
-        # thread), so the gather is timed like any other dispatch: marker-
-        # fenced when sampled, zero fences in off mode. export_s therefore
-        # measures dispatch issue, not the copy-out — the serving layer
-        # reports the materialize separately (kv_fetch_s). Bucket mirrors
-        # the engine's export compile unit (_export_bucket: pow2 CLAMPED
-        # at the slot's page capacity — an unclamped key would name a
-        # program that never compiles).
-        pb = min(pow2_bucket(int(payload.get("n_pages", 1))),
-                 int(getattr(self.core, "max_pages_per_slot", 1 << 30)))
-        marker = payload.get("k")
-        if marker is not None and hasattr(marker, "block_until_ready"):
-            DEVTIME.commit("kv_export", f"p{pb}", marker, t0=t0,
-                           tokens=len(job.ids), mfu=False, retain=False)
-        else:
-            # host export (fetch=True callers / fakes): the fetch already
-            # synced, the wall IS the device+copy time — pre-measured
-            DEVTIME.commit("kv_export", f"p{pb}", device_s=export_s,
-                           tokens=len(job.ids), mfu=False)
-        # riding the payload, the downstream kv_prefill span attributes the
-        # export's device time per request (and the decode side ignores it)
-        payload["export_s"] = round(export_s, 6)
         payload.update({
             "prompt_ids": [int(t) for t in job.ids],
             "first_token": int(first),
             "first_logprob": float(lp) if lp is not None else 0.0,
             "generated": len(job.gen_ids) + 1,
-            "seed": int(req.seed or 0),
-            "max_tokens": int(req.max_tokens),
-            "temperature": float(req.temperature),
-            "top_k": int(req.top_k),
-            "top_p": float(req.top_p),
-            "stop": list(req.stop),
-            "slo_class": req.slo_class,
-            # usage plane: the tenant identity rides the handoff so the
-            # decode replica bills this logical chat's decode leg to the
-            # SAME tenant the prefill leg billed (the wire encode passes
-            # non-array keys through untouched)
-            "tenant": req.tenant,
+            # sampling/SLO/tenant + grammar scalar passthroughs — shared
+            # with the mid-decode snapshot (export_live_slot) so a knob
+            # added to one wire form cannot silently miss the other.
+            # Grammar semantics here: the serving layer stamped the
+            # CONSTRUCTOR spec (compact, cacheable via _grammar_for on
+            # the decode side) and this worker's fused final chunk
+            # sampled the first token under the DFA mask (gram_on); the
+            # decode replica recompiles, walks prefix + first token, and
+            # activates at the reached state. grammar_attached records
+            # whether enforcement was live HERE — a prefill-side degrade
+            # must not be laundered into a token-level guarantee.
+            **self._sampling_scalars(req),
         })
-        if req.grammar_spec:
-            # constrained decoding rides the handoff: the serving layer
-            # stamped the grammar's CONSTRUCTOR spec (kind + payload —
-            # compact, cacheable via _grammar_for on the decode side) and
-            # this worker's fused final chunk sampled the first token
-            # under the DFA mask (gram_on). The decode replica recompiles
-            # the grammar, walks prefix bytes + this first token host-
-            # side, and activates its slot at the reached state — the
-            # PR 6 prompt+parse degradation is gone. grammar_attached
-            # records whether enforcement was live HERE: a prefill-side
-            # degrade (slots pinned) must not be laundered into a
-            # token-level guarantee downstream.
-            payload["grammar_kind"], payload["grammar_payload"] = \
-                req.grammar_spec
-            payload["grammar_prefix"] = req.grammar_prefix
-            payload["grammar_attached"] = bool(job.gram_on)
+        payload.update(self._grammar_scalars(job))
         req.handoff = payload
         req.finish_reason = "handoff"
         del self._slots[job.slot]
@@ -1299,6 +1375,389 @@ class Scheduler:
         # activation time, release again here is a cheap no-op safeguard
         self._state = self.core.release(self._state, job.slot)
         self._finish(job)
+
+    # ---------------------------------------- live migration (evacuation)
+
+    def _commit_export(self, payload: dict, job: _Job, t0: float,
+                       tokens: int) -> None:
+        """Shared accounting tail of every KV export (prefill handoff and
+        mid-decode snapshot): the kv_export_s histogram, the devtime
+        ledger commit, and the payload's export_s attribution. The export
+        is DEVICE-NATIVE by default (engine.export_slot_kv keeps jax
+        arrays; the wire encode pays the one host copy later, off this
+        thread), so the gather is timed like any other dispatch —
+        marker-fenced when sampled, zero fences in off mode; export_s
+        measures dispatch issue, not the copy-out (kv_fetch_s covers
+        that). Bucket mirrors the engine's export compile unit
+        (_export_bucket: pow2 CLAMPED at the slot's page capacity — an
+        unclamped key would name a program that never compiles)."""
+        export_s = time.perf_counter() - t0
+        REGISTRY.histogram("kv_export_s").observe(export_s)
+        pb = min(pow2_bucket(int(payload.get("n_pages", 1))),
+                 int(getattr(self.core, "max_pages_per_slot", 1 << 30)))
+        marker = payload.get("k")
+        if marker is not None and hasattr(marker, "block_until_ready"):
+            DEVTIME.commit("kv_export", f"p{pb}", marker, t0=t0,
+                           tokens=tokens, mfu=False, retain=False)
+        else:
+            # host export (fetch=True callers / fakes): the fetch already
+            # synced, the wall IS the device+copy time — pre-measured
+            DEVTIME.commit("kv_export", f"p{pb}", device_s=export_s,
+                           tokens=tokens, mfu=False)
+        # riding the payload, the downstream kv_prefill span attributes
+        # the export's device time per request (decode side ignores it)
+        payload["export_s"] = round(export_s, 6)
+
+    def _sampling_scalars(self, req: Request) -> dict:
+        """The sampling/SLO/tenant scalar passthrough every exported
+        payload carries — one copy for the prefill handoff and the
+        mid-decode snapshot, so the two wire forms cannot drift."""
+        return {
+            "seed": int(req.seed or 0),
+            "max_tokens": int(req.max_tokens),
+            "temperature": float(req.temperature),
+            "top_k": int(req.top_k),
+            "top_p": float(req.top_p),
+            "stop": list(req.stop),
+            "slo_class": req.slo_class,
+            "tenant": req.tenant,
+        }
+
+    def _grammar_scalars(self, job: _Job) -> dict:
+        req = job.request
+        if not req.grammar_spec:
+            return {}
+        return {"grammar_kind": req.grammar_spec[0],
+                "grammar_payload": req.grammar_spec[1],
+                "grammar_prefix": req.grammar_prefix,
+                "grammar_attached": bool(job.gram_on)}
+
+    def _snapshot_eligible(self, job: _Job) -> bool:
+        """May this slot's live decode state be exported mid-stream?
+        Needs a resolved pending token (gen_ids non-empty, no fused first
+        token still in flight), base weights (the import side activates
+        at adapter slot 0 — an adapter'd snapshot would silently resume
+        on the wrong weights), and a drained dispatch pipeline (the host
+        view must equal the device view, or the snapshot would drop the
+        in-flight steps' tokens)."""
+        req = job.request
+        return (not req.prefill_only and not req.adapter
+                and bool(job.gen_ids) and not job.first_pending
+                and not job.stopped
+                and len(job.gen_ids) < req.max_tokens
+                and not self._inflight
+                and hasattr(self.core, "export_slot_kv"))
+
+    def export_live_slot(self, job: _Job, fetch: bool = False) -> dict:
+        """Generalize ``_export_handoff`` to a MID-DECODE slot: a snapshot
+        a peer replica resumes TOKEN-IDENTICALLY at the snapshot position,
+        not at token 0. The payload is the prefill handoff's shape plus
+        the mid-stream state: KV pages for every position already written
+        (``total_len - 1`` — the last emitted token is the pending next
+        input, its KV not yet fed back), the emitted-token history (rides
+        ``prompt_ids`` + ``prompt_len``), sampling seed + position (the
+        per-position ``fold_in`` keys make the resumed sample sequence
+        bit-equal), the stop-sequence holdback, and the grammar spec. The
+        caller must have verified :meth:`_snapshot_eligible`."""
+        req = job.request
+        written = job.total_len - 1
+        t0 = time.perf_counter()
+        payload = self.core.export_slot_kv(self._state, job.pages, written,
+                                           fetch=fetch)
+        self._commit_export(payload, job, t0, tokens=written)
+        payload.update({
+            "prompt_ids": ([int(t) for t in req.prompt_ids]
+                           + [int(t) for t in job.gen_ids[:-1]]),
+            "prompt_len": len(req.prompt_ids),
+            "first_token": int(job.gen_ids[-1]),
+            "first_logprob": 0.0,
+            "generated": len(job.gen_ids),
+            "resume": True,
+            "stop_buf": job.stop_buf,
+            **self._sampling_scalars(req),
+        })
+        payload.update(self._grammar_scalars(job))
+        return payload
+
+    def request_evacuation(self, rids: Optional[set] = None,
+                           wait_s: float = 30.0,
+                           reason: str = "drain",
+                           guard=None) -> dict:
+        """Queue an evacuation for the DRIVER thread (it owns the device
+        state) and optionally wait for the summary. ``rids`` limits the
+        sweep to specific request ids (the router's single-stream pull on
+        a broken connection); None evacuates everything live. Safe from
+        any thread; with ``wait_s=0`` returns immediately (SIGTERM /
+        watchdog-trip callers that must not block). ``guard`` is
+        re-evaluated by the driver at execution time — False cancels the
+        sweep (a watchdog-trip evacuation queued while the driver was
+        wedged must NOT kill every live stream after the transient
+        condition already cleared; the trip reason may be minutes
+        stale by the time the driver can act on it)."""
+        ev = threading.Event()
+        entry = {"rids": set(rids) if rids else None, "event": ev,
+                 "result": None, "reason": reason, "guard": guard}
+        with self._evac_lock:
+            self._evac_reqs.append(entry)
+        self._wake.set()
+        if wait_s and ev.wait(wait_s):
+            return entry["result"] or {}
+        return entry["result"] or {"queued": True, "reason": reason}
+
+    def _prune_outbox(self) -> None:
+        """Expire outbox entries past APP_EVAC_TTL_S (caller holds
+        _evac_lock). Insertion order == age order (OrderedDict)."""
+        now = time.monotonic()
+        while self._evac_outbox:
+            rid, (_payload, parked) = next(iter(self._evac_outbox.items()))
+            if now - parked <= self._evac_ttl_s:
+                break
+            self._evac_outbox.popitem(last=False)
+            REGISTRY.counter("evacuation_snapshots_expired").inc()
+            logger.warning("evacuation snapshot for %s expired unpulled "
+                           "after %.0fs; its stream can only resume via "
+                           "re-prefill now", rid, self._evac_ttl_s)
+
+    def take_evacuated(self, rid: str) -> Optional[dict]:
+        """Pop a parked snapshot from the evacuation outbox (the
+        /v1/kv/evacuation/<rid> pull — each snapshot is served once)."""
+        with self._evac_lock:
+            self._prune_outbox()
+            entry = self._evac_outbox.pop(rid, None)
+        return entry[0] if entry is not None else None
+
+    def evacuated_ids(self) -> List[str]:
+        with self._evac_lock:
+            self._prune_outbox()
+            return list(self._evac_outbox)
+
+    def _run_evacuations(self) -> bool:
+        """Driver-side: perform any queued evacuation requests (and age
+        out unpulled snapshots — expiry must not depend on a pull ever
+        arriving)."""
+        with self._evac_lock:
+            if self._evac_outbox:
+                self._prune_outbox()
+            if not self._evac_reqs:
+                return False
+            entries, self._evac_reqs = self._evac_reqs, []
+        for entry in entries:
+            try:
+                guard = entry.get("guard")
+                if guard is not None and not guard():
+                    logger.warning("evacuation (%s) canceled: its trigger "
+                                   "condition cleared before the driver "
+                                   "could act", entry["reason"])
+                    entry["result"] = {"canceled": True,
+                                       "reason": entry["reason"]}
+                    continue
+                entry["result"] = self._do_evacuate(entry["rids"],
+                                                    entry["reason"])
+            except Exception as exc:
+                logger.exception("evacuation failed")
+                entry["result"] = {"error": str(exc)}
+            finally:
+                entry["event"].set()
+        return True
+
+    def _do_evacuate(self, rids: Optional[set], reason: str) -> dict:
+        """Export every live slot's mid-decode snapshot into the outbox
+        and end its stream with finish_reason "evacuated" (the router
+        recognizes the marker, pulls the snapshot, and resumes on a peer
+        — server/failover.py). Slots that cannot be snapshotted (fused
+        first token still in flight, mid-prefill, adapter'd) end with the
+        same marker but NO snapshot: the router's pull 404s and falls
+        back to the ``continue_text`` re-prefill it always had — loud,
+        never silent truncation. ``engine_evacuations_total{outcome}``
+        counts both."""
+        # the host view must equal the device view before any export:
+        # in-flight dispatches carry tokens the snapshot must include
+        while self._inflight:
+            self._process_decode()
+        summary = {"reason": reason, "snapshot": [], "reprefill": []}
+
+        def count(outcome: str, req: Request) -> None:
+            REGISTRY.counter("engine_evacuations_total",
+                             labels={"outcome": outcome}).inc()
+            summary["snapshot" if outcome == "snapshot"
+                    else "reprefill"].append(req.request_id)
+
+        for slot, job in list(self._slots.items()):
+            req = job.request
+            if rids is not None and req.request_id not in rids:
+                continue
+            if self._slots.get(slot) is not job:
+                continue
+            if req.prefill_only:
+                continue   # awaiting its own KV export (handoff path)
+            payload = None
+            if self._snapshot_eligible(job):
+                try:
+                    payload = self.export_live_slot(job)
+                except Exception:
+                    logger.exception("snapshot export failed for %s; "
+                                     "falling back to re-prefill",
+                                     req.request_id)
+                    payload = None
+            del self._slots[slot]
+            self._state = self.core.release(self._state, slot)
+            # what this slot computed stays reusable locally either way
+            self._cache_insert(job, with_generated=True)
+            count("snapshot" if payload is not None else "reprefill", req)
+            self._finish_evacuated(job, payload)
+        for job in list(self._prefilling):
+            req = job.request
+            if rids is not None and req.request_id not in rids:
+                continue
+            self._prefilling.remove(job)
+            if job.slot >= 0:
+                self._state = self.core.release(self._state, job.slot)
+                self._cache_insert(job, with_generated=True)
+            count("reprefill", req)
+            self._finish_evacuated(job, None)
+        with self._lock:
+            pending = [j for j in self._pending
+                       if rids is None or j.request.request_id in rids]
+            for job in pending:
+                self._pending.remove(job)
+        for job in pending:
+            # a SPILLED pending job already holds a complete host-side
+            # snapshot — ship exactly that instead of degrading to
+            # re-prefill (the spill payload IS export_live_slot's shape)
+            payload = job.spill
+            if payload is not None:
+                self._drop_spill(job, outcome="evacuated")
+            count("snapshot" if payload is not None else "reprefill",
+                  job.request)
+            self._finish_evacuated(job, payload)
+        FLIGHT.event("evacuation", reason=reason,
+                     snapshots=len(summary["snapshot"]),
+                     reprefills=len(summary["reprefill"]))
+        return summary
+
+    def _finish_evacuated(self, job: _Job, payload: Optional[dict]) -> None:
+        """End an evacuated request's local stream: finish_reason
+        "evacuated" (never "error" — the router must treat it as
+        resumable, not a dead request), snapshot parked BEFORE the _STOP
+        release (a consumer that sees the stream end and immediately
+        pulls /v1/kv/evacuation/<rid> must find it), and NO detok flush
+        (held UTF-8 bytes re-emerge from the resume side's replay — a
+        flush here would stream bytes the oracle never produced)."""
+        req = job.request
+        req.finish_reason = "evacuated"
+        if payload is not None:
+            with self._evac_lock:
+                self._prune_outbox()
+                self._evac_outbox[req.request_id] = (payload,
+                                                     time.monotonic())
+                self._evac_outbox.move_to_end(req.request_id)
+                while len(self._evac_outbox) > self._evac_outbox_cap:
+                    self._evac_outbox.popitem(last=False)
+        req.slo_outcome = req.slo_outcome or "evacuated"
+        req.finished_at = time.perf_counter()
+        REGISTRY.counter("requests_finished",
+                         labels={"finish": "evacuated"}).inc()
+        slo_mod.SLO.observe(req)
+        self._bill_pages(job)
+        job.page_clock = 0.0
+        usage_mod.USAGE.bill_request(req)
+        REQUEST_LOG.record(req)
+        req.out_queue.put(_STOP)
+        self._release(job)
+
+    # ----------------------------------------------- host-spill preemption
+
+    def _drop_spill(self, job: _Job, outcome: str = "dropped") -> None:
+        """Return a dead spilled job's bytes to the pool budget."""
+        if job.spill is not None and self._spill is not None:
+            self._spill.release(job.request.request_id, outcome=outcome)
+        job.spill = None
+
+    def _spill_out(self, job: _Job) -> bool:   # tpulint: hot-path
+        """Demote a preemption victim's pages to the host spill pool
+        instead of freeing-and-recomputing them: ONE device→host transfer
+        now, one host→device transfer at promotion — zero prefill
+        programs, token-identical (the snapshot is export_live_slot's).
+        False = ineligible or over budget; the caller takes the recompute
+        path (same stream contract, just slower)."""
+        if self._spill is None or not self._snapshot_eligible(job) \
+                or self._slots.get(job.slot) is not job:
+            return False
+        if chaos_mod.CHAOS.enabled and chaos_mod.CHAOS.spill_fault():
+            return False   # injected pool exhaustion: recompute fallback
+        req = job.request
+        try:
+            payload = self.export_live_slot(job, fetch=True)
+        except Exception:
+            logger.exception("spill export failed for %s; recomputing",
+                             req.request_id)
+            return False
+        if not self._spill.admit(req.request_id, payload):
+            return False   # over APP_KV_SPILL_MB: recompute fallback
+        job.spill = payload
+        del self._slots[job.slot]
+        self._state = self.core.release(self._state, job.slot)
+        self._cache_insert(job, with_generated=True)
+        self._release(job)
+        # the job keeps its live detok/stop/grammar stream state — only
+        # the KV moved; ids mirror the written context + pending token so
+        # re-admission page math covers the next write
+        job.ids = list(req.prompt_ids) + list(job.gen_ids)
+        job.prefilled = 0
+        job.total_len = 0
+        job.prefill_started = 0.0
+        with self._lock:
+            self._pending.appendleft(job)
+        req.preemptions += 1
+        REGISTRY.counter("preemptions").inc()
+        logger.info("spilled request %s at %d generated tokens (%d bytes "
+                    "host)", req.request_id, len(job.gen_ids),
+                    self._spill.used_bytes)
+        return True
+
+    def _admit_spilled(self, job: _Job) -> None:   # tpulint: hot-path
+        """Promotion: re-import a spilled job's pages into its freshly
+        allocated ones and reactivate the slot at the snapshot position —
+        the resume dispatches ZERO prefill programs (the acceptance
+        criterion's devtime assertion) and the stream continues
+        token-identically. The job never left this scheduler, so detok,
+        stop holdback, and emitted text are already live; nothing is
+        re-emitted."""
+        req = job.request
+        payload = job.spill
+        job.spill = None
+        if self._spill is not None:
+            self._spill.release(req.request_id, outcome="promoted")
+        now = time.perf_counter()
+        try:
+            self._state = self.core.import_slot_kv(
+                self._state, job.slot, job.pages, payload)
+        except Exception as exc:
+            # a local promote cannot fail for wire reasons; anything here
+            # is a bug — fail the stream loudly, never serve garbage KV
+            logger.exception("spill promote failed for %s", req.request_id)
+            self._fail(job, f"kv spill promote failed: {exc}")
+            self._release(job)
+            return
+        job.prefilled = len(job.ids)
+        job.total_len = len(job.ids)
+        pb = min(pow2_bucket(int(payload.get("n_pages", 1))),
+                 int(getattr(self.core, "max_pages_per_slot", 1 << 30)))
+        DEVTIME.commit("kv_import", f"p{pb}", self._state.tokens, t0=now,
+                       tokens=int(payload.get("length", 0)), mfu=False,
+                       retain=False)
+        REGISTRY.counter("spill_resumes").inc()
+        req.spill_resumes += 1
+        if self._spec_w > 1 and hasattr(self.core, "seed_history"):
+            self._state = self.core.seed_history(self._state, job.slot,
+                                                 job.ids)
+        gs = self._gram_state_for(job) if req.grammar is not None else 0
+        kw = {"gram_state": gs} if gs else {}
+        self._state = self.core.activate(
+            self._state, job.slot, int(job.gen_ids[-1]), len(job.gen_ids),
+            req.max_tokens, req.temperature, req.top_k, req.top_p,
+            seed=req.seed or 0, **kw)
+        self._slots[job.slot] = job
 
     def _emit_token(self, job: _Job, tok: int, lp: Optional[float] = None,
                     top: Optional[list] = None) -> bool:
@@ -1413,7 +1872,15 @@ class Scheduler:
         return max(cands, key=lambda j: j.admit_seq)
 
     def _preempt(self, job: _Job) -> None:
-        """Recompute-preemption: free the slot, requeue prompt+generated."""
+        """Preemption under page pressure. With the host spill pool armed
+        (APP_KV_SPILL_MB), a decoding victim's pages DEMOTE to host RAM
+        and promote back at re-admission — one transfer each way instead
+        of a full re-prefill recompute (engine/spill.py). Everything
+        ineligible (mid-prefill, unresolved first token, adapter'd, pool
+        over budget) keeps the recompute path: free the slot, requeue
+        prompt+generated."""
+        if self._spill_out(job):
+            return
         if job.slot in self._slots and self._slots[job.slot] is job:
             del self._slots[job.slot]
         else:
@@ -1906,12 +2373,16 @@ class Scheduler:
         # loudly, state resets). Off = one attribute read, nothing more.
         if chaos_mod.CHAOS.enabled:
             chaos_mod.CHAOS.tick_fault()
+        # queued evacuations (drain/SIGTERM/watchdog-trip/router pull) run
+        # FIRST: the driver owns the device state, and an evacuating
+        # worker's remaining ticks should move streams out, not advance
+        # them further on a worker the router is already routing around
+        worked = self._run_evacuations()
         # continuous per-step telemetry: the ring the /debug/flight window,
         # SIGUSR1 dump, and bench.py occupancy stats all read. Idle ticks
         # sample too (the 50 ms wake loop keeps calling _tick), so a
         # post-incident window shows the queue draining to zero, not a gap.
         FLIGHT.maybe_sample(self._flight_fields)
-        worked = False
         # eager drain: any dispatch whose result already landed on the host
         # resolves NOW — first tokens stamp and done slots free without
         # waiting for the pipeline-depth backpressure point

@@ -97,13 +97,16 @@ def _finish_reason(req, default: str = "stop") -> str:
     """OpenAI finish_reason from the scheduler's recorded finish cause:
     "length" must be distinguishable from a stop-string / EOS end (the
     OpenAI contract clients use to detect budget truncation). ``default``
-    carries caller overrides like "tool_calls"."""
+    carries caller overrides like "tool_calls". "evacuated" passes
+    through verbatim — the routing frontend keys its snapshot-resume
+    recovery on exactly that marker (a masked "stop" would end the
+    client's stream mid-generation, silently truncated)."""
     if getattr(req, "error", None):
         return "error"
     if default == "tool_calls":
         return default   # a parsed tool call is complete regardless of cause
-    if getattr(req, "finish_reason", None) == "length":
-        return "length"
+    if getattr(req, "finish_reason", None) in ("length", "evacuated"):
+        return req.finish_reason
     return default
 
 
@@ -154,13 +157,22 @@ class ModelServer:
             # TensorBoard/Perfetto — no profiler-server tooling needed
             web.post("/debug/profile", self.debug_profile),
             # graceful drain (engine/watchdog.py): 503 on /health while
-            # in-flight streams finish; ?off=1 re-admits the worker
+            # in-flight streams finish; ?off=1 re-admits the worker;
+            # ?evacuate=1 additionally snapshots every live decode slot
+            # so streams MOVE to peers instead of finishing here
             web.post("/debug/drain", self.debug_drain),
+            # live-migration pull: a mid-decode snapshot parked by an
+            # evacuation (drain/SIGTERM/watchdog trip), or exported on
+            # demand for a still-live stream whose consumer died — the
+            # router resumes it token-identically on a peer replica
+            web.get("/v1/kv/evacuation/{rid}", self.kv_evacuation),
         ])
         self._profiling = False
         # /debug/flight + /debug/requests[/<id>] — the engine process is
         # where the scheduler lives, so these answer with live data here
-        add_debug_routes(self.app)
+        # (drain=False: this server's watchdog-arbitrated /debug/drain,
+        # registered above, owns the path)
+        add_debug_routes(self.app, drain=False)
 
     # ------------------------------------------------------------- endpoints
 
@@ -212,16 +224,66 @@ class ModelServer:
     async def debug_drain(self, request: web.Request) -> web.Response:
         """``POST /debug/drain`` starts a graceful drain (health 503, new
         traffic routes away, in-flight streams finish); ``?off=1`` lifts
-        it. 409 when no watchdog is attached (APP_WATCHDOG=off)."""
+        it. ``?evacuate=1`` additionally exports every live decode slot's
+        mid-decode snapshot (scheduler.request_evacuation): each stream
+        ends with finish_reason "evacuated" and its snapshot parks at
+        ``/v1/kv/evacuation/<rid>`` for the router to resume on a peer —
+        zero-re-prefill worker rotation. 409 when no watchdog is attached
+        (APP_WATCHDOG=off)."""
         if self.watchdog is None:
             raise web.HTTPConflict(text=json.dumps(
                 {"error": "no watchdog attached (APP_WATCHDOG=off); "
                           "drain needs the health arbiter"}))
         if request.query.get("off", "").strip() in ("1", "true", "on"):
             self.watchdog.undrain()
-        else:
-            self.watchdog.drain()
-        return web.json_response(self.watchdog.status())
+            return web.json_response(self.watchdog.status())
+        self.watchdog.drain()
+        body: Dict[str, Any] = dict(self.watchdog.status())
+        if request.query.get("evacuate", "").strip() in ("1", "true", "on") \
+                and hasattr(self.scheduler, "request_evacuation"):
+            loop = asyncio.get_running_loop()
+            # the export runs on the DRIVER thread; this waits off the
+            # event loop so other streams (and the snapshot pulls the
+            # router makes right after) keep pumping
+            body["evacuation"] = await loop.run_in_executor(
+                None, functools.partial(self.scheduler.request_evacuation,
+                                        reason="drain"))
+        return web.json_response(body)
+
+    async def kv_evacuation(self, request: web.Request) -> web.Response:
+        """``GET /v1/kv/evacuation/{rid}``: hand out one request's
+        mid-decode snapshot on the negotiated KV wire. Serves the parked
+        outbox entry from a prior evacuation, or — the hard-failover
+        case, where the router's stream died but this worker is still
+        alive — exports the still-live slot on demand (a single-rid
+        evacuation through the driver). Each snapshot is served ONCE
+        (the resume consumes the generation position; serving it twice
+        would fork the stream). 404 when the request is unknown or was
+        never snapshotable — the router falls back to re-prefill."""
+        rid = _RID_SAFE.sub("", str(request.match_info.get("rid", "")))[:64]
+        if not rid:
+            raise web.HTTPBadRequest(text=json.dumps(
+                {"error": "missing request id"}))
+        sched = self.scheduler
+        loop = asyncio.get_running_loop()
+        payload = (sched.take_evacuated(rid)
+                   if hasattr(sched, "take_evacuated") else None)
+        if payload is None and hasattr(sched, "request_evacuation"):
+            await loop.run_in_executor(
+                None, functools.partial(sched.request_evacuation,
+                                        rids={rid}, wait_s=15.0,
+                                        reason="pull"))
+            payload = sched.take_evacuated(rid)
+        if payload is None:
+            raise web.HTTPNotFound(text=json.dumps(
+                {"error": f"no evacuable state for request {rid!r} "
+                          f"(finished, never snapshotable, or already "
+                          f"pulled) — resume via re-prefill"}))
+        binary = self._wants_kv_frames(request)
+        body, ctype = await loop.run_in_executor(
+            None, kv_wire_mod.encode_for_wire, payload, binary)
+        return web.Response(body=body, content_type=ctype,
+                            headers={"X-Request-Id": rid})
 
     async def _chaos_gate(self, site: str) -> None:
         """Server-side chaos injection (observability/chaos.py) at the
@@ -656,6 +718,19 @@ class ModelServer:
                 # payload tenant → key hash (usage.handoff_tenant owns
                 # the precedence and its rationale)
                 tenant = usage_mod.handoff_tenant(request.headers, payload)
+                if payload.get("resume"):
+                    # snapshot resume: the router stamps how many chars it
+                    # already delivered to the client — the scheduler
+                    # re-emits only the gap (a hard-death pull can lag the
+                    # exporting worker's emitted tokens; absent header =
+                    # clean drain, everything was delivered)
+                    raw_chars = request.headers.get("X-Resume-Chars")
+                    if raw_chars is not None:
+                        try:
+                            payload["resume_chars"] = int(raw_chars)
+                        except ValueError:
+                            raise web.HTTPBadRequest(text=json.dumps(
+                                {"error": "X-Resume-Chars must be an int"}))
                 # grammar continuation: the payload's scalar passthrough
                 # carries the grammar's constructor spec — recompile it
                 # through the same compile-once cache the chat endpoint
@@ -1064,6 +1139,62 @@ class ModelServer:
         return resp
 
 
+def install_sigterm_drain(scheduler: Scheduler,
+                          watchdog: Optional[EngineWatchdog],
+                          grace_s: Optional[float] = None,
+                          exit_fn=None):
+    """SIGTERM → graceful drain + evacuation (the k8s/ supervisor
+    rotation path — before this, only SIGUSR1's flight dump was
+    installed and a TERM killed every live stream mid-token). The
+    handler flags the drain (health 503 → router routes away), queues a
+    NON-blocking full evacuation (the driver exports every live slot;
+    streams end "evacuated" and the router pulls their snapshots from
+    /v1/kv/evacuation while this process keeps serving HTTP), then exits
+    after ``APP_DRAIN_GRACE_S`` (default 10 s) — long enough for the
+    pulls, bounded so a rotation never hangs. Returns the handler (tests
+    drive it directly; ``exit_fn`` injects the exit)."""
+    import signal
+    import threading as _threading
+
+    if grace_s is None:
+        try:
+            grace_s = float(os.environ.get("APP_DRAIN_GRACE_S", "") or 10.0)
+        except ValueError:
+            grace_s = 10.0
+    exit_fn = exit_fn if exit_fn is not None else (lambda: os._exit(0))
+    log = logging.getLogger(__name__)
+    fired = {"done": False}
+
+    def _handler(signum, frame):   # pragma: no cover - exercised via tests calling it directly
+        if fired["done"]:
+            return   # a second TERM during the grace window is a no-op
+        fired["done"] = True
+        log.warning("SIGTERM: draining (+evacuating live streams); "
+                    "exiting in %.1fs", grace_s)
+        if watchdog is not None:
+            watchdog.drain()
+        if hasattr(scheduler, "request_evacuation"):
+            # non-blocking: the handler runs on the event-loop thread —
+            # blocking here would stall exactly the HTTP serving the
+            # router needs to PULL the snapshots
+            scheduler.request_evacuation(wait_s=0.0, reason="sigterm")
+
+        def _exit_after_grace():
+            time.sleep(grace_s)
+            log.warning("drain grace elapsed; exiting")
+            exit_fn()
+
+        _threading.Thread(target=_exit_after_grace, daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _handler)
+    except ValueError:
+        # not the main thread (embedded servers, tests): the caller can
+        # still invoke the returned handler explicitly
+        log.debug("not on the main thread; SIGTERM handler not installed")
+    return _handler
+
+
 def run_server(scheduler: Scheduler, model_name: str, host: str = "0.0.0.0",
                port: int = 8000) -> None:
     from generativeaiexamples_tpu.observability.bootstrap import (
@@ -1075,4 +1206,9 @@ def run_server(scheduler: Scheduler, model_name: str, host: str = "0.0.0.0",
         watchdog.start()
     server = ModelServer(scheduler, model_name, watchdog=watchdog)
     scheduler.start()
-    web.run_app(server.app, host=host, port=port, print=None)
+    # graceful rotation: SIGTERM drains + evacuates instead of killing
+    # live streams (SIGUSR1's flight dump is installed by
+    # init_observability above)
+    install_sigterm_drain(scheduler, watchdog)
+    web.run_app(server.app, host=host, port=port, print=None,
+                handle_signals=False)

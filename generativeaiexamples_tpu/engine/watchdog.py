@@ -90,6 +90,15 @@ class EngineWatchdog:
         self._clock = clock
         self.healthy = True
         self.draining = False
+        # live-migration on trip (APP_WATCHDOG_EVACUATE, default on): a
+        # trip queues a NON-blocking full evacuation — if/when the driver
+        # can still tick, every live slot's mid-decode snapshot parks for
+        # the router to resume on peers (scheduler.request_evacuation)
+        # instead of stranding in-flight KV on a sick worker. A wedged
+        # driver simply never serves the request, and the router's
+        # re-prefill fallback owns recovery (the hard-death path).
+        self.evacuate_on_trip = (os.environ.get(
+            "APP_WATCHDOG_EVACUATE", "").strip().lower() or "on") != "off"
         self._tripped: Dict[str, bool] = {}    # kind -> currently tripped
         self._running = False
         self._thread: Optional[threading.Thread] = None
@@ -165,6 +174,20 @@ class EngineWatchdog:
             logger.error("engine watchdog tripped: %s %s — /health now "
                          "answers 503 until the condition clears",
                          kind, detail)
+            if self.evacuate_on_trip and hasattr(self.scheduler,
+                                                 "request_evacuation"):
+                try:
+                    # guard: the DRIVER re-evaluates the conditions at the
+                    # instant it can act. A tick_stall trip is stale BY
+                    # CONSTRUCTION once the driver is ticking again (it
+                    # just stamped the heartbeat), and a transient
+                    # hung_dispatch that drained meanwhile must not kill
+                    # every live stream on a now-healthy worker.
+                    self.scheduler.request_evacuation(
+                        wait_s=0.0, reason=f"watchdog_{kind}",
+                        guard=self.condition_still_true)
+                except Exception as exc:
+                    logger.warning("trip evacuation request failed: %s", exc)
         self.healthy = False
 
     def _clear(self, kind: str) -> None:
@@ -210,6 +233,29 @@ class EngineWatchdog:
             self._clear("hung_dispatch")
         self.healthy = not any(self._tripped.values())
         return self.healthy
+
+    def condition_still_true(self) -> bool:
+        """Side-effect-free re-evaluation of the trip conditions (no
+        trip/clear/counter mutation — safe to call from the scheduler's
+        driver thread concurrently with the poll loop): is a tick stall
+        or hung dispatch true RIGHT NOW? Guards queued trip-evacuations
+        so a condition that cleared while the request waited cancels the
+        sweep instead of evacuating a healthy worker."""
+        sched = self.scheduler
+        now = self._clock()
+        last_tick = getattr(sched, "last_tick_mono", None)
+        if bool(getattr(sched, "_running", False)) and last_tick is not None \
+                and now - last_tick > self.tick_stall_s:
+            return True
+        try:
+            inflight = getattr(sched, "_inflight", None)
+            if inflight:
+                issued_at, steps = inflight[0][4]
+                if now - issued_at > self.dispatch_bound(steps):
+                    return True
+        except (IndexError, TypeError):
+            pass
+        return False
 
     def status(self) -> Dict[str, Any]:
         """The /health body's watchdog block."""

@@ -81,6 +81,7 @@ _FAULTS: Dict[str, float] = {
     "kv.garble": 0.0,
     "tick.stall": 0.05,
     "page.exhaust": 0.0,
+    "spill.exhaust": 0.0,
     "worker.die": 0.0,
 }
 
@@ -357,6 +358,18 @@ class ChaosPlane:
         if self._decide("worker.die") is not None:
             self._record("worker.die", site)
             raise ChaosWorkerDeath(f"chaos: worker death injected at {site}")
+
+    def spill_fault(self, site: str = "kv_spill") -> bool:
+        """Force the host spill pool to refuse a demotion (pool-exhaust on
+        demand): the scheduler treats True exactly like an over-budget
+        pool — the preemption falls back to the recompute path, which must
+        stay token-identical (the fuzz spill menus assert it)."""
+        if not self._on:
+            return False
+        if self._decide("spill.exhaust") is not None:
+            self._record("spill.exhaust", site)
+            return True
+        return False
 
     def page_fault(self, site: str = "kv_pages") -> bool:
         """Force a KV page allocation to fail (pool exhaustion on demand):

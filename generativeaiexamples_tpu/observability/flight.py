@@ -212,6 +212,13 @@ def timeline(req: Any) -> Dict[str, Any]:
         "request_id": getattr(req, "request_id", ""),
         "phases": phases,
         "preemptions": getattr(req, "preemptions", 0),
+        # resume-mode split (live migration + host spill, engine/spill.py):
+        # of the preemptions/evacuations this request survived, how many
+        # recovered by TRANSFER (spill promote, snapshot resume) — the
+        # rest recomputed via re-prefill. Recompute-vs-transfer recovery
+        # is visible per request, not just in fleet counters.
+        "spill_resumes": getattr(req, "spill_resumes", 0),
+        "snapshot_resumes": getattr(req, "snapshot_resumes", 0),
         "prefix_hit_tokens": getattr(req, "prefix_hit_tokens", 0),
         "completion_tokens": getattr(req, "completion_tokens", 0),
         "prompt_tokens": len(getattr(req, "prompt_ids", []) or []),

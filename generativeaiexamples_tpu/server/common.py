@@ -51,12 +51,57 @@ def parse_stop(value) -> list:
     return [str(s) for s in (value or []) if s][:4]
 
 
+class _DrainSwitch:
+    """Process-level graceful-drain flag for servers WITHOUT an engine
+    watchdog (chain, encoder): while draining, /health answers 503 so
+    upstream pools route new work away while in-flight requests finish —
+    the same zero-drop rotation primitive the engine's watchdog provides
+    (engine/watchdog.py), minus the evacuation machinery (these servers
+    hold no device state to migrate)."""
+
+    def __init__(self) -> None:
+        self.draining = False
+
+    def drain(self) -> None:
+        if not self.draining:
+            REGISTRY.gauge("server_draining").set(1)
+        self.draining = True
+
+    def undrain(self) -> None:
+        if self.draining:
+            REGISTRY.gauge("server_draining").set(0)
+        self.draining = False
+
+
+DRAIN = _DrainSwitch()
+
+
 async def health_handler(request: web.Request) -> web.Response:
     # slo_pressure rides the liveness probe so a pool client learns about
     # error-budget burn for free with every health check it already makes
     # (server/failover.py records it per worker)
-    return web.json_response({"message": "Service is up.",
-                              "slo_pressure": slo_mod.SLO.pressure()})
+    body = {"message": "Service is up.",
+            "slo_pressure": slo_mod.SLO.pressure()}
+    if DRAIN.draining:
+        body["message"] = "Service is draining."
+        return web.json_response(body, status=503)
+    return web.json_response(body)
+
+
+async def drain_handler(request: web.Request) -> web.Response:
+    """``POST /debug/drain[?off=1]`` for non-engine servers: flip the
+    process drain switch (health 503 ↔ 200). The engine server overrides
+    this route with its watchdog-arbitrated version, which also accepts
+    ``?evacuate=1`` for live KV migration (engine/server.py)."""
+    if request.query.get("off", "").strip() in ("1", "true", "on"):
+        DRAIN.undrain()
+    elif request.query.get("evacuate", "").strip() in ("1", "true", "on"):
+        raise web.HTTPConflict(text=json.dumps(
+            {"error": "this server holds no engine KV state to evacuate; "
+                      "?evacuate=1 applies to engine workers only"}))
+    else:
+        DRAIN.drain()
+    return web.json_response({"draining": DRAIN.draining})
 
 
 def _wants_openmetrics(request: web.Request) -> bool:
@@ -222,10 +267,14 @@ async def request_timeline_handler(request: web.Request) -> web.Response:
     return web.json_response(rec)
 
 
-def add_debug_routes(app: web.Application) -> None:
+def add_debug_routes(app: web.Application, drain: bool = True) -> None:
     """Register the observability debug surface (engine, encoder, and chain
     servers all carry it — the flight recorder and request log are process-
-    global, so whichever process hosts the scheduler answers with data)."""
+    global, so whichever process hosts the scheduler answers with data).
+    ``drain=False`` skips the default POST /debug/drain (the engine server
+    registers its own watchdog-arbitrated handler at that path)."""
+    if drain:
+        app.add_routes([web.post("/debug/drain", drain_handler)])
     app.add_routes([
         web.get("/debug/flight", flight_handler),
         web.get("/debug/requests", requests_recent_handler),

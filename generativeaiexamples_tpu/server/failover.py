@@ -63,6 +63,17 @@ from generativeaiexamples_tpu.server import resilience
 
 logger = logging.getLogger(__name__)
 
+
+class StreamEvacuated(Exception):
+    """The serving worker ended the stream with finish_reason "evacuated"
+    (graceful drain / SIGTERM / watchdog trip — engine/scheduler.py
+    _do_evacuate): its mid-decode snapshot is waiting at
+    ``/v1/kv/evacuation/<rid>``. NOT an error and NOT a truncation — the
+    router pulls the snapshot and resumes token-identically on a peer,
+    falling back to the ``continue_text`` re-prefill only when the pull
+    fails (hard death, never-snapshotable slot)."""
+
+
 # the process's routing frontend, registered at FailoverLLM construction:
 # GET /debug/fleet (server/common.py) answers from whichever router this
 # process last built — the fleet view lives where the probes live
@@ -306,6 +317,15 @@ class FailoverLLM:
             else _env_float("APP_ROUTER_AFFINITY_SLACK", 1.0))
         self.affinity_chars = int(_env_float("APP_ROUTER_AFFINITY_CHARS",
                                              512.0))
+        # live-migration resume (APP_ROUTER_SNAPSHOT_RESUME, default on):
+        # when a stream ends "evacuated" or dies mid-generation, try to
+        # pull the worker's mid-decode snapshot and resume it
+        # TOKEN-IDENTICALLY on a peer before falling back to the
+        # continue_text re-prefill. "off" restores the PR 10 behavior
+        # (re-prefill always) — the bench A/B arm.
+        self.snapshot_resume = (os.environ.get(
+            "APP_ROUTER_SNAPSHOT_RESUME", "").strip().lower() or "on") \
+            != "off"
         # hedged KV-handoff opens (server/resilience.hedged_call): when the
         # primary decode replica hasn't opened the stream within hedge_s,
         # dispatch the SAME payload to the second-least-loaded replica and
@@ -662,6 +682,11 @@ class FailoverLLM:
             if (chunk.get("error")
                     or choices[0].get("finish_reason") == "error"):
                 raise RuntimeError(f"engine error: {chunk.get('error')}")
+            if choices[0].get("finish_reason") == "evacuated":
+                # live migration: the worker is rotating out and parked
+                # this stream's mid-decode snapshot — resume it on a
+                # peer (the caller pulls /v1/kv/evacuation/<rid>)
+                raise StreamEvacuated()
             content = choices[0].get("delta", {}).get("content")
             if content:
                 emitted.append(content)
@@ -709,8 +734,12 @@ class FailoverLLM:
                                     top_p, top_k, response_format, emitted,
                                     stream=True)
             if emitted:
+                # this dispatch IS a resume, the recompute way: the
+                # emitted prefix re-prefills on the new worker
+                self._note_reprefill_resume()
                 logger.info("resuming stream on %s at %d chars", w.url,
                             len(str(payload["continue_text"])))
+            evacuated = False
             try:
                 # chaos seam (observability/chaos.py): inside the try so an
                 # injected reset/5xx takes the SAME failover path a real
@@ -729,12 +758,41 @@ class FailoverLLM:
                         raise httpx.TransportError(
                             f"HTTP {resp.status_code}")
                     resp.raise_for_status()   # 4xx: deterministic — raise
-                    yield from self._pump_sse(resp, emitted)
-                    return                    # clean completion
+                    try:
+                        yield from self._pump_sse(resp, emitted)
+                        return                # clean completion
+                    except StreamEvacuated:
+                        evacuated = True      # resume below, outside the cm
             except (httpx.TransportError, httpx.StreamError,
                     json.JSONDecodeError, ConnectionError, OSError) as exc:
                 last_err = exc
                 self._mark_down(w)
+                if emitted:
+                    # mid-stream death: prefer a snapshot resume whenever
+                    # the failing worker can still answer ONE export (a
+                    # drained-but-alive worker, a watchdog-tripped worker
+                    # whose HTTP plane survives) — the re-prefill below
+                    # stays the hard-death fallback
+                    opened = self._open_snapshot_resume(w, rid, emitted,
+                                                        span)
+                    if opened is not None:
+                        ok = yield from self._pump_snapshot_resume(
+                            opened, emitted)
+                        if ok:
+                            return
+                continue
+            if evacuated:
+                # graceful evacuation: the worker parked this stream's
+                # snapshot. NOT circuit-broken — the pull needs its HTTP
+                # plane, and its own /health 503 routes new traffic away.
+                opened = self._open_snapshot_resume(w, rid, emitted, span)
+                if opened is not None:
+                    ok = yield from self._pump_snapshot_resume(opened,
+                                                               emitted)
+                    if ok:
+                        return
+                last_err = RuntimeError(
+                    f"worker {w.url} evacuated mid-stream")
         raise RuntimeError(
             f"LLM request failed across {self.max_attempts} attempts: "
             f"{last_err}")
@@ -802,6 +860,11 @@ class FailoverLLM:
                 payload = self._payload(messages, max_tokens, temperature,
                                         top_p, top_k, response_format,
                                         emitted, stream=False)
+                if emitted:
+                    # a disaggregated resume re-prefills the emitted
+                    # prefix through the prefill phase — the recompute
+                    # recovery mode (snapshot resumes count separately)
+                    self._note_reprefill_resume()
                 t_pf = time.monotonic()
                 try:
                     if chaos_mod.CHAOS.enabled:
@@ -937,6 +1000,8 @@ class FailoverLLM:
                         # circuit-broken here
                         self._mark_down(dw)
                     continue
+                evacuated = False
+                died_mid_stream = False
                 try:
                     # handoff latency: prefill payload in hand → decode
                     # stream open (admission imported the pages)
@@ -958,15 +1023,35 @@ class FailoverLLM:
                         # genuinely wedged stream path via /health 503)
                         REGISTRY.counter("router_hedge_losses_total",
                                          labels={"worker": dw.url}).inc()
-                    yield from self._pump_sse(dresp, emitted)
-                    return                    # clean completion
+                    try:
+                        yield from self._pump_sse(dresp, emitted)
+                        return                # clean completion
+                    except StreamEvacuated:
+                        evacuated = True      # resume below, outside cm
                 except (httpx.TransportError, httpx.StreamError,
                         json.JSONDecodeError, ConnectionError,
                         OSError) as exc:
                     last_err = exc
                     self._mark_down(winner)
+                    died_mid_stream = bool(emitted)
                 finally:
                     cm.__exit__(None, None, None)
+                if evacuated or died_mid_stream:
+                    # live migration: pull the decode replica's mid-decode
+                    # snapshot and resume token-identically on a peer
+                    # (evacuated = graceful rotation, worker stays
+                    # un-broken; mid-stream death = best-effort pull, the
+                    # re-prefill route below is the hard-death fallback)
+                    opened = self._open_snapshot_resume(winner, rid,
+                                                        emitted, span)
+                    if opened is not None:
+                        ok = yield from self._pump_snapshot_resume(
+                            opened, emitted)
+                        if ok:
+                            return
+                    if evacuated:
+                        last_err = RuntimeError(
+                            f"worker {winner.url} evacuated mid-stream")
             raise RuntimeError(
                 f"LLM request failed across {self.max_attempts} attempts: "
                 f"{last_err}")
@@ -1084,6 +1169,131 @@ class FailoverLLM:
             on_error=leg_failed,
             name="router_handoff")
         return result
+
+    # ------------------------------------------- live-migration resume
+
+    def _fetch_snapshot(self, w: _Worker, rid: str):
+        """One pull of a failing/draining worker's mid-decode snapshot
+        (GET /v1/kv/evacuation/<rid>). Returns ``(body, is_binary)`` or
+        None — a dead worker, a 404 (never snapshotable / already
+        pulled), or snapshot_resume=off all mean 'use the re-prefill
+        fallback'. Deliberately ONE attempt with a short timeout: this
+        sits on the recovery path of a stream a client is waiting on."""
+        if not self.snapshot_resume:
+            return None
+        import httpx
+        try:
+            resp = httpx.get(
+                f"{w.url}/v1/kv/evacuation/{rid}",
+                headers={"Accept": kv_wire_mod.KV_FRAMES_CONTENT_TYPE,
+                         "X-Request-Id": rid},
+                timeout=http_timeout(20.0))
+            if resp.status_code != 200:
+                logger.info("no snapshot for %s on %s (HTTP %d); "
+                            "re-prefilling", rid, w.url, resp.status_code)
+                return None
+            body = resp.content
+            return body, kv_wire_mod.is_kv_frames(
+                body, resp.headers.get("content-type", ""))
+        except Exception as exc:   # tpulint: disable=except-swallow -- a dead worker answering nothing IS the expected fallback signal; the caller re-prefills
+            logger.info("snapshot pull from %s failed (%s); re-prefilling",
+                        w.url, exc)
+            return None
+
+    def _open_snapshot_resume(self, w: _Worker, rid: str,
+                              emitted: List[str], span):
+        """Pull ``w``'s snapshot for ``rid`` and open its continuation on
+        a peer replica's /v1/kv/handoff. Returns ``(cm, resp, peer)``
+        (stream already status-checked) or None — the caller then falls
+        back to re-prefill. ``X-Resume-Chars`` tells the resume worker
+        how much text this router actually delivered, so a pull that
+        races the exporting worker's last emissions re-streams the gap
+        instead of dropping it."""
+        import httpx
+
+        snap = self._fetch_snapshot(w, rid)
+        if snap is None:
+            return None
+        body, binary = snap
+        peer = self._pick(("unified", "decode", ""), exclude=(w.url,))
+        if peer is None:
+            logger.warning("snapshot for %s pulled but no peer is up; "
+                           "re-prefilling", rid)
+            return None
+        ctype = (kv_wire_mod.KV_FRAMES_CONTENT_TYPE if binary
+                 else "application/json")
+        if binary and not peer.kv_binary:
+            # legacy replica: one transcode to the JSON compat wire
+            try:
+                body = json.dumps(
+                    kv_wire_mod.transcode_to_json(body)).encode("utf-8")
+                ctype = "application/json"
+                REGISTRY.counter("router_kv_transcodes_total").inc()
+            except kv_wire_mod.KVWireError as exc:
+                logger.warning("snapshot frame failed transcode (%s); "
+                               "re-prefilling", exc)
+                return None
+        headers = self._headers(rid, span)
+        headers["X-Resume-Chars"] = str(sum(len(s) for s in emitted))
+        headers["Content-Type"] = ctype
+        cm = httpx.stream("POST", f"{peer.url}/v1/kv/handoff",
+                          content=body, headers=headers,
+                          timeout=http_timeout(120.0))
+        try:
+            resp = cm.__enter__()
+        except (httpx.TransportError, ConnectionError, OSError) as exc:
+            logger.warning("snapshot resume open on %s failed: %s",
+                           peer.url, exc)
+            self._mark_down(peer)
+            return None
+        try:
+            if resp.status_code >= 500:
+                raise httpx.TransportError(f"HTTP {resp.status_code}")
+            resp.raise_for_status()
+        except Exception as exc:   # tpulint: disable=except-swallow -- any refusal (409 geometry, 400 frame, transport) downgrades to the re-prefill fallback; the snapshot is consumed either way
+            cm.__exit__(None, None, None)
+            logger.warning("snapshot resume on %s refused (%s); "
+                           "re-prefilling", peer.url, exc)
+            return None
+        REGISTRY.counter("router_resume_total",
+                         labels={"mode": "snapshot"}).inc()
+        logger.info("resuming %s from snapshot on %s (%d chars already "
+                    "delivered)", rid, peer.url,
+                    sum(len(s) for s in emitted))
+        if span is not None:
+            span.set_attribute("router.snapshot_resume", peer.url)
+        return cm, resp, peer
+
+    def _pump_snapshot_resume(self, opened, emitted: List[str]):
+        """Drain an opened snapshot-resume stream. Generator; its RETURN
+        value (via ``yield from``) is True on clean completion — anything
+        else sends the caller back to its retry loop with ``emitted``
+        grown by whatever arrived (the re-prefill fallback resumes from
+        there, so text is never dropped or duplicated)."""
+        import httpx
+
+        cm, resp, peer = opened
+        try:
+            yield from self._pump_sse(resp, emitted)
+            return True
+        except StreamEvacuated:
+            # the resume target is itself rotating out: the snapshot is
+            # consumed, so the retry loop's re-prefill (or a fresh
+            # snapshot pull from THIS peer) takes over
+            return False
+        except (httpx.TransportError, httpx.StreamError,
+                json.JSONDecodeError, ConnectionError, OSError):
+            self._mark_down(peer)
+            return False
+        finally:
+            cm.__exit__(None, None, None)
+
+    def _note_reprefill_resume(self) -> None:
+        """Count a resume dispatch that went the re-prefill way — the
+        recompute-vs-transfer recovery split (`router_resume_total{mode}`)
+        the live-migration plane is measured by."""
+        REGISTRY.counter("router_resume_total",
+                         labels={"mode": "reprefill"}).inc()
 
     def chat_tools(self, messages: Sequence[Dict], tools: Sequence[Dict],
                    tool_choice="auto", **sampling) -> Dict:

@@ -220,7 +220,16 @@ class _FakeWorker:
         self.alive = True            # False → /health answers 503 (draining)
         self.delay = delay           # seconds before serving any POST
         self.reject_handoffs = reject_handoffs   # first N handoffs get 409
-        self.hits = {"health": 0, "prefill": 0, "handoff": 0, "chat": 0}
+        self.hits = {"health": 0, "prefill": 0, "handoff": 0, "chat": 0,
+                     "evac": 0}
+        # live-migration fakes: evacuate_after=True makes the streaming
+        # endpoints end with finish_reason "evacuated" AFTER the canned
+        # text (the graceful-drain marker the router resumes on);
+        # evac_payloads maps rid -> (body, ctype) served ONCE from
+        # GET /v1/kv/evacuation/<rid> (404 when absent — the re-prefill
+        # fallback signal)
+        self.evacuate_after = False
+        self.evac_payloads: dict = {}
         # last request headers seen per endpoint key — the usage-plane
         # tests assert the router forwards X-Tenant-Id on every dispatch
         self.headers: dict = {}
@@ -249,6 +258,25 @@ class _FakeWorker:
                 self.wfile.write(body)
 
             def do_GET(self):
+                if self.path.startswith("/v1/kv/evacuation/"):
+                    rid = self.path.rsplit("/", 1)[1]
+                    worker.hits["evac"] += 1
+                    worker.headers["evac"] = dict(self.headers)
+                    # "*" = serve any rid once (tests can't predict the
+                    # router-minted request id)
+                    entry = (worker.evac_payloads.pop(rid, None)
+                             or worker.evac_payloads.pop("*", None))
+                    if entry is None:
+                        body = json.dumps({"error": "no evacuable state"})
+                        body = body.encode()
+                        self.send_response(404)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    self._reply(*entry)
+                    return
                 if self.path != "/health":
                     self.send_response(404)
                     self.end_headers()
@@ -299,6 +327,14 @@ class _FakeWorker:
                 key = ("handoff" if self.path == "/v1/kv/handoff"
                        else "chat")
                 worker.hits[key] += 1
+                fin = "stop"
+                if worker.evacuate_after:
+                    # one evacuated stream, then the worker reports
+                    # draining (health 503) like a real rotating engine —
+                    # the router must route AWAY, not re-dispatch here
+                    fin = "evacuated"
+                    worker.evacuate_after = False
+                    worker.alive = False
                 sse = (
                     'data: {"choices":[{"delta":{"role":"assistant"},'
                     '"finish_reason":null}]}\n\n'
@@ -306,7 +342,7 @@ class _FakeWorker:
                     + json.dumps(worker.text) +
                     '},"finish_reason":null}]}\n\n'
                     'data: {"choices":[{"delta":{},'
-                    '"finish_reason":"stop"}]}\n\n'
+                    '"finish_reason":' + json.dumps(fin) + '}]}\n\n'
                     "data: [DONE]\n\n")
                 self._reply(sse.encode(), "text/event-stream")
 

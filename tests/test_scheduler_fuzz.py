@@ -173,6 +173,52 @@ class FakeCore:
         st.active[slot] = False
         return st
 
+    # -- live-migration surface (export_live_slot / spill / resume) -------
+    # Mirrors EngineCore's handoff trio with REAL paged semantics: export
+    # reads the slot's written token values back THROUGH its page list,
+    # import scatters them into different physical pages. Any length or
+    # page-math slip in the scheduler's snapshot/spill paths corrupts the
+    # resumed context sum and the stream diverges from the solo oracle.
+
+    def export_slot_kv(self, st: _FakeState, pages, length,
+                       fetch: bool = False) -> dict:
+        n = max(1, -(-int(length) // self.page_size))
+        rows = np.zeros((n, self.page_size), np.int32)
+        for i, p in enumerate(list(pages)[:n]):
+            rows[i] = st.pool[p]
+        return {"length": int(length), "n_pages": n,
+                "page_size": self.page_size, "k": rows}
+
+    def validate_handoff(self, payload: dict) -> None:
+        if payload.get("page_size") != self.page_size:
+            raise ValueError("page_size mismatch")
+        n = int(payload.get("length", 0))
+        if n < 1 or n + 1 >= self.max_seq:
+            raise ValueError("length outside serving range")
+        if "prompt_ids" in payload and len(payload["prompt_ids"]) != n:
+            raise ValueError("prompt_ids/length mismatch")
+
+    def import_slot_kv(self, st: _FakeState, slot: int, pages,
+                       payload: dict) -> _FakeState:
+        self.validate_handoff(payload)
+        st = self._clone(st)
+        n = int(payload["n_pages"])
+        for i, p in enumerate(list(pages)[:n]):
+            st.pool[p] = payload["k"][i]
+        st.lengths[slot] = int(payload["length"])
+        return st
+
+    def activate(self, st: _FakeState, slot: int, token: int,
+                 generated: int, max_gen: int, temperature: float,
+                 top_k: int, top_p: float, seed: int = 0,
+                 gram_state: int = 0) -> _FakeState:
+        st = self._clone(st)
+        st.tokens[slot] = int(token)
+        st.active[slot] = True
+        st.generated[slot] = int(generated)
+        st.max_gen[slot] = int(max_gen)
+        return st
+
     def prefill_group(self, st: _FakeState, items) -> tuple:
         st = self._clone(st)
         toks = np.zeros((len(items),), np.int32)
@@ -247,7 +293,9 @@ class _Spec:
 
 
 def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
-                 chaos_spec: Optional[str] = None) -> Optional[str]:
+                 chaos_spec: Optional[str] = None,
+                 spill: bool = False,
+                 evac_tick: Optional[int] = None) -> Optional[str]:
     """Run one scheduled episode; returns an error description or None.
 
     ``chaos_spec`` arms the fault-injection plane (observability/chaos.py,
@@ -257,11 +305,27 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
     injected worker death carries the loud "engine error" and its emitted
     text is a PREFIX of its oracle — everything else must still stream
     token-identical. Never a hang, never silent truncation.
-    """
+
+    ``spill`` arms the host spill pool (APP_KV_SPILL_MB): page-exhaust
+    preemptions demote/promote KV through host RAM — streams must stay
+    token-identical THROUGH spill round trips, and the pool's byte budget
+    must fully conserve after drain. ``evac_tick`` requests a full
+    evacuation at that tick: every live stream ends with finish_reason
+    "evacuated"; those with a parked snapshot are RESUMED via
+    submit_prefilled on the same scheduler and the combined text must
+    equal the solo oracle exactly; snapshotless ones must be loud oracle
+    prefixes — the token-identical-or-loud contract of the live-migration
+    plane."""
+    import os
     rng = np.random.RandomState(seed)
-    core = FakeCore(**core_kw)
-    tok = ByteTokenizer()
-    sched = Scheduler(core, tok)
+    if spill:
+        os.environ["APP_KV_SPILL_MB"] = "64"
+    try:
+        core = FakeCore(**core_kw)
+        tok = ByteTokenizer()
+        sched = Scheduler(core, tok)
+    finally:
+        os.environ.pop("APP_KV_SPILL_MB", None)
     if chaos_spec is not None:
         chaos_mod.CHAOS.configure(mode="on", seed=seed, spec=chaos_spec)
 
@@ -288,6 +352,11 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
         while True:
             while pending and reqs[pending[0]][1].arrival_tick <= tick:
                 sched.submit(reqs[pending.pop(0)][0])
+            if evac_tick is not None and tick == evac_tick:
+                # drain/SIGTERM/watchdog shape: everything live must end
+                # with the "evacuated" marker (the driver performs it on
+                # its next tick — wait_s=0 mirrors the signal handlers)
+                sched.request_evacuation(wait_s=0.0)
             try:
                 worked = sched._tick()
             except chaos_mod.ChaosFault:
@@ -308,6 +377,46 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
             else:
                 idle = 0
 
+        # -- resume phase: evacuated snapshots re-admit on the same sched --
+        resumes: Dict[int, Request] = {}
+        if evac_tick is not None:
+            for i, (req, sp) in enumerate(reqs):
+                if req.finish_reason != "evacuated":
+                    continue
+                payload = sched.take_evacuated(req.request_id)
+                if payload is None:
+                    continue   # never snapshotable: loud-prefix contract
+                rr = Request(
+                    prompt_ids=[int(t) for t in payload["prompt_ids"]],
+                    max_tokens=int(payload.get("max_tokens",
+                                               sp.max_tokens)),
+                    temperature=0.0,
+                    seed=int(payload.get("seed", 0)))
+                try:
+                    sched.submit_prefilled(rr, dict(payload))
+                except ValueError as exc:
+                    return f"req {i}: resume submit refused: {exc}"
+                resumes[i] = rr
+            idle = 0
+            while resumes and any(r.finished_at is None
+                                  for r in resumes.values()):
+                try:
+                    worked = sched._tick()
+                except chaos_mod.ChaosFault:
+                    sched._fail_all("engine error")
+                    sched._state = core.init_state()
+                    worked = True
+                tick += 1
+                if tick > 20000:
+                    return "livelock in evacuation-resume phase"
+                if not worked:
+                    idle += 1
+                    if idle > 50:
+                        break
+                    time.sleep(0.0005)
+                else:
+                    idle = 0
+
         # -- invariants ----------------------------------------------------
         for i, (req, sp) in enumerate(reqs):
             # termination: exactly one STOP, nothing after it
@@ -322,12 +431,54 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
                         f"(items={len(items)})")
             cap = core.max_seq - 2
             if sp.prompt_len > cap:
-                if not req.error:
+                # an evacuation racing admission may end an oversized
+                # PENDING request with the "evacuated" marker instead —
+                # the router re-dispatches it and the next worker's
+                # admission rejects it loudly; only a silent success is
+                # a bug
+                if not req.error and req.finish_reason != "evacuated":
                     return f"req {i}: oversized prompt not failed"
                 continue
             want = oracle(reqs[i][0].prompt_ids, sp.max_tokens, core.max_seq)
             got_text = "".join(s for s in items if s is not _STOP)
             want_text = tok.decode(want)
+            if req.finish_reason == "evacuated":
+                # live-migration contract: with a snapshot, the original
+                # prefix + the resumed stream reproduce the oracle EXACTLY
+                # (no dropped, no duplicated tokens across the migration);
+                # without one, the stream ended loudly on an oracle prefix
+                rr = resumes.get(i)
+                post_text = ""
+                if rr is not None:
+                    post_items = []
+                    try:
+                        while True:
+                            post_items.append(rr.out_queue.get_nowait())
+                    except queue.Empty:
+                        pass
+                    if post_items.count(_STOP) != 1 \
+                            or post_items[-1] is not _STOP:
+                        return (f"req {i}: resume STOP delivered "
+                                f"{post_items.count(_STOP)} times")
+                    post_text = "".join(s for s in post_items
+                                        if s is not _STOP)
+                combined = got_text + post_text
+                if rr is None:
+                    if not want_text.startswith(got_text):
+                        return (f"req {i}: evacuated (no snapshot) stream "
+                                f"is not an oracle prefix")
+                elif rr.error:
+                    if not (chaos_spec is not None
+                            and rr.error == "engine error"):
+                        return f"req {i}: resume failed: {rr.error!r}"
+                    if not want_text.startswith(combined):
+                        return (f"req {i}: chaos-failed resume diverged "
+                                f"from oracle prefix")
+                elif combined != want_text:
+                    return (f"req {i}: evacuated+resumed stream diverged "
+                            f"from solo oracle ({len(combined)} vs "
+                            f"{len(want_text)} chars)")
+                continue
             if req.error:
                 if chaos_spec is not None and req.error == "engine error":
                     # failed by injected worker death: a LOUD typed error,
@@ -358,6 +509,13 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
             return f"slot leak: free={sorted(sched._free)}"
         if sched._slots or sched._prefilling or sched._pending:
             return "jobs left in scheduler after drain"
+        # spill-pool conservation: every demoted payload's bytes returned
+        # (promoted, evacuated, or died with its job — incl. through
+        # worker.die driver resets); a leak here is host RAM that never
+        # comes back over a serving day
+        if sched._spill is not None and sched._spill.used_bytes != 0:
+            return (f"spill pool leaked {sched._spill.used_bytes} bytes "
+                    f"({len(sched._spill)} entries)")
         # page-second conservation (usage plane, observability/usage.py):
         # billed pages-held x wall must never exceed what the pool could
         # physically supply over the episode — a clock left open across a
@@ -423,18 +581,21 @@ def _core_kw(rng: np.random.RandomState) -> Dict:
 
 
 def _shrink(seed: int, specs: List[_Spec], core_kw: Dict, err: str,
-            chaos_spec: Optional[str] = None) -> str:
+            chaos_spec: Optional[str] = None, spill: bool = False,
+            evac_tick: Optional[int] = None) -> str:
     """Greedy one-at-a-time removal: report the minimal failing workload."""
+    kw = dict(chaos_spec=chaos_spec, spill=spill, evac_tick=evac_tick)
     changed = True
     while changed and len(specs) > 1:
         changed = False
         for i in range(len(specs)):
             cand = specs[:i] + specs[i + 1:]
-            if _run_episode(seed, cand, core_kw, chaos_spec=chaos_spec):
+            if _run_episode(seed, cand, core_kw, **kw):
                 specs, changed = cand, True
                 break
-    final = _run_episode(seed, specs, core_kw, chaos_spec=chaos_spec) or err
-    return (f"{final}\n  seed={seed} core={core_kw} chaos={chaos_spec!r}\n"
+    final = _run_episode(seed, specs, core_kw, **kw) or err
+    return (f"{final}\n  seed={seed} core={core_kw} chaos={chaos_spec!r} "
+            f"spill={spill} evac_tick={evac_tick!r}\n"
             f"  minimal workload: "
             + "\n  ".join(map(repr, specs)))
 
@@ -471,6 +632,12 @@ _CHAOS_MENUS = (
     # resets — the page-second conservation invariant must hold through
     # both (clocks close at _release, _fail, and the _fail_all reset path)
     "worker.die=0.004,page.exhaust=0.25",
+    # r07 spill menus (run with the host spill pool armed): forced pool
+    # pressure drives spill round trips; spill.exhaust forces the
+    # recompute fallback mid-storm; worker.die resets must conserve the
+    # spill byte budget too
+    "page.exhaust=0.3,spill.exhaust=0.5",
+    "worker.die=0.003,page.exhaust=0.25,spill.exhaust=0.3",
 )
 
 
@@ -480,7 +647,16 @@ def test_scheduler_fuzz_chaos_invariants():
     completes token-identical to its solo oracle or terminates with the
     loud typed "engine error" (its emitted text an exact oracle prefix) —
     never hangs, never silently truncates, and the page/slot pools stay
-    conserved through forced preemption storms and driver resets."""
+    conserved through forced preemption storms and driver resets.
+
+    r07 grows the matrix two ways: ``spill`` episodes arm the host spill
+    pool (preemption demotes/promotes KV through host RAM — streams must
+    stay token-identical through the round trips, spill.exhaust forces
+    the recompute fallback, and the byte budget conserves through
+    resets), and ``evac_tick`` episodes fire a mid-episode evacuation
+    (every live stream ends "evacuated"; snapshots resume and must
+    combine to the exact oracle — token-identical-or-loud, end to
+    end)."""
     master = np.random.RandomState(0xDEFEC8)
     t0 = time.perf_counter()
     for ep in range(CHAOS_EPISODES):
@@ -489,10 +665,15 @@ def test_scheduler_fuzz_chaos_invariants():
         core_kw = _core_kw(rng)
         specs = _gen_specs(rng, core_kw)
         chaos_spec = _CHAOS_MENUS[int(rng.randint(0, len(_CHAOS_MENUS)))]
-        err = _run_episode(seed, specs, core_kw, chaos_spec=chaos_spec)
+        spill = "spill" in chaos_spec or bool(rng.rand() < 0.3)
+        evac_tick = (int(rng.randint(2, 40))
+                     if rng.rand() < 0.35 else None)
+        err = _run_episode(seed, specs, core_kw, chaos_spec=chaos_spec,
+                           spill=spill, evac_tick=evac_tick)
         if err:
             pytest.fail(f"chaos episode {ep}: "
                         + _shrink(seed, specs, core_kw, err,
-                                  chaos_spec=chaos_spec))
+                                  chaos_spec=chaos_spec, spill=spill,
+                                  evac_tick=evac_tick))
     elapsed = time.perf_counter() - t0
     assert elapsed < 120, f"chaos fuzz too slow for CI: {elapsed:.0f}s"
