@@ -37,6 +37,14 @@ bench-disagg:
 bench-chaos:
 	$(TEST_ENV) python bench.py --chaos
 
+# QoS goodput round: one flooding tenant vs two quota-obeying tenants on a
+# tiny worker, APP_QOS=off vs fair A/B (engine/qos.py); emits one JSON line
+# with jain_fair_obeying / per-tenant ttft_p99_s / goodput_frac
+# (docs/scheduling.md "The bench scoreboard").
+.PHONY: bench-goodput
+bench-goodput:
+	$(TEST_ENV) python bench.py --goodput
+
 # Decode roofline round: the ROADMAP item-2 ledger loop — decode phases +
 # the APP_DEVTIME=on attribution pass; emits one JSON line with
 # spec_tokens_per_step / padding_waste_frac / hbm_weight_read_util /

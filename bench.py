@@ -40,6 +40,7 @@ import statistics
 import sys
 import threading
 import time
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -844,7 +845,7 @@ CHAOS_ROUTER_SPEC = "http.delay=0.10/0.05,http.drop=0.08"
 CHAOS_WORKER_SPEC = "tick.stall=0.05/0.02,http.error=0.05"
 
 
-def run_chaos_round(n_workers: int = 2, n_requests: int = 16,
+def run_chaos_round(n_workers: int = 2, n_requests: Optional[int] = None,
                     max_tokens: int = 12, deadline_ms: float = 20_000.0,
                     health_timeout: float = 240.0) -> dict:
     """Chaos resilience round (`bench.py --chaos` / `make bench-chaos`):
@@ -876,6 +877,12 @@ def run_chaos_round(n_workers: int = 2, n_requests: int = 16,
     from generativeaiexamples_tpu.observability import chaos as chaos_mod
     from generativeaiexamples_tpu.observability import slo as slo_mod
     from generativeaiexamples_tpu.server.failover import FailoverLLM
+
+    if n_requests is None:
+        # BENCH_CHAOS_N parameterizes the concurrency (was hardcoded 16)
+        # and rides the round JSON, so goodput numbers stay comparable
+        # across machines with different chaos concurrency settings
+        n_requests = int(os.environ.get("BENCH_CHAOS_N", "16") or 16)
 
     def worker_injections(port: int) -> dict:
         """This worker's per-fault injection counts off /debug/chaos."""
@@ -1089,6 +1096,255 @@ def run_chaos_round(n_workers: int = 2, n_requests: int = 16,
                 os.killpg(p.pid, signal.SIGKILL)
 
 
+GOODPUT_OBEY_TENANTS = ("obey_a", "obey_b")
+GOODPUT_FLOOD_TENANT = "flood"
+
+
+def _jain_index(values) -> Optional[float]:
+    """Jain's fairness index over per-tenant attainment: (Σx)²/(n·Σx²) —
+    1.0 = perfectly equal shares, 1/n = one tenant took everything."""
+    values = [float(v) for v in values]
+    if not values:
+        return None
+    sq = sum(v * v for v in values)
+    if sq <= 0:
+        return None
+    return round(sum(values) ** 2 / (len(values) * sq), 4)
+
+
+def run_goodput_round(deadline_ms: Optional[float] = None,
+                      max_tokens: int = 24,
+                      health_timeout: float = 240.0) -> dict:
+    """Multi-tenant antagonist round (`bench.py --goodput` / `make
+    bench-goodput`): the QoS admission plane's A/B scoreboard.
+
+    One tiny engine worker boots per arm — FIFO (``APP_QOS=off``) and
+    fair (``APP_QOS=fair`` with skewed ``APP_QOS_TENANT_WEIGHTS`` and a
+    token-rate quota on the antagonist) — and serves the SAME workload:
+    one ``flood`` tenant fires all its requests at once (best_effort
+    class, sheddable) while two obeying tenants pace theirs (interactive
+    class). Requests drive the engine DIRECTLY with the PR-15 public
+    headers (``X-Tenant-Id`` / ``X-Slo-Class`` / ``X-Deadline-Ms``), so
+    the round also exercises deadline stamping without the chain server
+    fronting. ``APP_DEVTIME=sample`` arms the measured phase rates the
+    shed-before-prefill estimator consults.
+
+    Reported per arm: per-tenant goodput_frac (completed within
+    deadline), TTFT p50/p99, sheds observed; headline: Jain's fairness
+    index across the obeying tenants (and across all three), plus the
+    fair-vs-FIFO goodput_frac delta — the acceptance gauge is Jain ≥ 0.9
+    for obeying tenants with overall goodput no worse than FIFO."""
+    import os
+    import signal
+    import statistics as stats
+    import subprocess
+    import urllib.request
+
+    import httpx
+
+    obey_n = int(os.environ.get("BENCH_GOODPUT_OBEY_N", "6") or 6)
+    # the antagonist must SATURATE the deadline window: with demand under
+    # capacity x deadline, FIFO serves everyone and any fair policy can
+    # only subtract (its whole point is choosing who wins under overload)
+    flood_n = int(os.environ.get("BENCH_GOODPUT_FLOOD_N",
+                                 str(4 * obey_n)) or 4 * obey_n)
+    if deadline_ms is None:
+        deadline_ms = float(os.environ.get("BENCH_GOODPUT_DEADLINE_MS",
+                                           "8000") or 8000.0)
+
+    def sse_one(url: str, tenant: str, slo_class: str, i: int,
+                record: list) -> None:
+        headers = {"X-Tenant-Id": tenant,
+                   "X-Slo-Class": slo_class,
+                   "X-Deadline-Ms": str(int(deadline_ms))}
+        payload = {"model": "tiny-llama-test",
+                   "messages": [{"role": "user",
+                                 "content": f"{tenant} request {i}: list "
+                                            f"the pump voltages in order"}],
+                   "max_tokens": max_tokens, "temperature": 0.0,
+                   "stream": True}
+        t0 = time.perf_counter()
+        first = None
+        ok = True
+        err = ""
+        try:
+            with httpx.stream("POST", f"{url}/v1/chat/completions",
+                              json=payload, headers=headers,
+                              timeout=float(deadline_ms) / 1000.0
+                              + 30.0) as resp:
+                resp.raise_for_status()
+                for line in resp.iter_lines():
+                    if not line.startswith("data: "):
+                        continue
+                    data = line[len("data: "):]
+                    if data.strip() == "[DONE]":
+                        break
+                    chunk = json.loads(data)
+                    if chunk.get("error"):
+                        ok = False
+                        err = str(chunk["error"])
+                        break
+                    choice = (chunk.get("choices") or [{}])[0]
+                    if (choice.get("delta", {}).get("content")
+                            and first is None):
+                        first = time.perf_counter() - t0
+        except Exception as exc:
+            ok = False
+            err = str(exc)
+        record.append((tenant, ok, first, time.perf_counter() - t0, err))
+
+    def run_arm(qos_mode: str) -> dict:
+        port = _bench_free_port()
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "PALLAS_AXON_POOL_IPS": "", "XLA_FLAGS": "",
+               "APP_QOS": qos_mode,
+               # measured phase rates feed the WFQ cost basis and the
+               # shed-before-prefill estimator in the fair arm; armed in
+               # BOTH arms so the only difference is the policy. The
+               # stride is shortened so BOTH program families (prefill +
+               # decode) sample early enough for the estimator to turn on
+               # within the round's traffic
+               "APP_DEVTIME": "sample",
+               "APP_DEVTIME_SAMPLE_N": "4",
+               "APP_QOS_TENANT_WEIGHTS": "obey_a=2,obey_b=2,flood=1",
+               # the flood's rate cap sits near the tiny worker's serving
+               # capacity: it bounds the burst (obeyers admit first) but
+               # keeps the fair arm WORK-CONSERVING — spare capacity still
+               # serves the flood, so fairness redistributes goodput
+               # instead of destroying it
+               "APP_QOS_TOKENS_PER_S": "flood=150"}
+        env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                       "/tmp/generativeaiexamples_tpu_jit_cache")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "generativeaiexamples_tpu.engine",
+             "--tiny", "--host", "127.0.0.1", "--port", str(port)],
+            env=env, start_new_session=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            _bench_wait_health(port, health_timeout)
+            url = f"http://127.0.0.1:{port}"
+            # warm the compile paths + seed the devtime rates, untimed
+            warm: list = []
+            sse_one(url, "warm", "interactive", -1, warm)
+            done: list = []
+            threads = []
+            # the antagonist fires everything at once...
+            for i in range(flood_n):
+                threads.append(threading.Thread(
+                    target=sse_one,
+                    args=(url, GOODPUT_FLOOD_TENANT, "best_effort", i,
+                          done)))
+            # ...while the obeying tenants pace within their quotas
+            for tenant in GOODPUT_OBEY_TENANTS:
+                for i in range(obey_n):
+                    threads.append(threading.Thread(
+                        target=sse_one,
+                        args=(url, tenant, "interactive", i, done)))
+            for t in threads:
+                t.start()
+                time.sleep(0.02)   # arrival jitter; floods still swamp
+            for t in threads:
+                t.join()
+            qos_snap: dict = {}
+            try:
+                with urllib.request.urlopen(f"{url}/debug/qos",
+                                            timeout=5) as r:
+                    qos_snap = json.load(r)
+            except Exception:
+                qos_snap = {"unreachable": True}
+            # the worker's own shed-before-prefill count: sheds that
+            # provably burned ZERO prefill programs (vs the burn-rate
+            # shedder's, which also land in the per-tenant `sheds`)
+            sheds_pre = 0
+            try:
+                with urllib.request.urlopen(f"{url}/metrics",
+                                            timeout=5) as r:
+                    metrics = json.load(r)
+                sheds_pre = int(sum(
+                    v for k, v in metrics.items()
+                    if isinstance(v, (int, float))
+                    and k.startswith("qos_shed_before_prefill_total")
+                    and not k.endswith("_per_s")))
+            except Exception:
+                sheds_pre = -1   # unreachable; never fake a zero
+            per_tenant: dict = {}
+            for tenant in (*GOODPUT_OBEY_TENANTS, GOODPUT_FLOOD_TENANT):
+                rows = [r for r in done if r[0] == tenant]
+                good = [r for r in rows
+                        if r[1] and r[2] is not None
+                        and r[3] <= deadline_ms / 1000.0]
+                ttfts = sorted(r[2] for r in rows if r[2] is not None)
+                sheds = sum(1 for r in rows if "shed" in (r[4] or ""))
+                per_tenant[tenant] = {
+                    "n": len(rows),
+                    "goodput_frac": (round(len(good) / len(rows), 4)
+                                     if rows else None),
+                    "ttft_p50_s": (round(stats.median(ttfts), 4)
+                                   if ttfts else None),
+                    "ttft_p99_s": (round(ttfts[min(int(0.99 * len(ttfts)),
+                                                   len(ttfts) - 1)], 4)
+                                   if ttfts else None),
+                    "sheds": sheds,
+                }
+            total_good = sum(1 for r in done
+                             if r[1] and r[2] is not None
+                             and r[3] <= deadline_ms / 1000.0)
+            obey = [per_tenant[t]["goodput_frac"] or 0.0
+                    for t in GOODPUT_OBEY_TENANTS]
+            obey_rows = [r for r in done if r[0] in GOODPUT_OBEY_TENANTS]
+            obey_good = sum(1 for r in obey_rows
+                            if r[1] and r[2] is not None
+                            and r[3] <= deadline_ms / 1000.0)
+            return {
+                "qos": qos_mode,
+                "tenants": per_tenant,
+                "goodput_frac": (round(total_good / len(done), 4)
+                                 if done else None),
+                "goodput_frac_obeying": (round(obey_good / len(obey_rows),
+                                               4) if obey_rows else None),
+                "jain_obeying": _jain_index(obey),
+                "jain_all": _jain_index(
+                    [per_tenant[t]["goodput_frac"] or 0.0
+                     for t in per_tenant]),
+                "sheds_before_prefill": sheds_pre,
+                "qos_debug": qos_snap,
+            }
+        finally:
+            if proc.poll() is None:
+                os.killpg(proc.pid, signal.SIGKILL)
+
+    fifo = run_arm("off")
+    fair = run_arm("fair")
+    return {
+        "n_obey_per_tenant": obey_n,
+        "n_flood": flood_n,
+        "deadline_ms": deadline_ms,
+        "weights": "obey_a=2,obey_b=2,flood=1",
+        "tokens_per_s": "flood=150",
+        "arms": {"fifo": fifo, "fair": fair},
+        # the headline A/B: fairness for obeying tenants under the
+        # antagonist, and what the fair policy costs (or buys) in total
+        # goodput — acceptance wants jain_fair_obeying >= 0.9 and
+        # goodput_delta >= 0
+        "jain_fair_obeying": fair["jain_obeying"],
+        "jain_fifo_obeying": fifo["jain_obeying"],
+        "goodput_frac_fair": fair["goodput_frac"],
+        "goodput_frac_fifo": fifo["goodput_frac"],
+        "goodput_delta": (round(fair["goodput_frac"]
+                                - fifo["goodput_frac"], 4)
+                          if fair["goodput_frac"] is not None
+                          and fifo["goodput_frac"] is not None else None),
+        # what fairness actually buys: the obeying tenants' goodput under
+        # the antagonist, FIFO vs fair
+        "obeying_goodput_delta": (
+            round(fair["goodput_frac_obeying"]
+                  - fifo["goodput_frac_obeying"], 4)
+            if fair["goodput_frac_obeying"] is not None
+            and fifo["goodput_frac_obeying"] is not None else None),
+        "workers_backend": "tiny-cpu",
+    }
+
+
 def main() -> None:
     import os
     on_tpu = jax.default_backend() == "tpu"
@@ -1107,6 +1363,12 @@ def main() -> None:
         # under the fixed seeded fault schedule, one parsed JSON line
         print(json.dumps({"metric": "chaos_resilience",
                           **run_chaos_round()}))
+        return
+    if "--goodput" in sys.argv:
+        # multi-tenant antagonist round (`make bench-goodput`): Jain's
+        # fairness + per-tenant TTFT p99 + goodput_frac for the
+        # APP_QOS=off vs fair A/B, one parsed JSON line
+        print(json.dumps({"metric": "qos_goodput", **run_goodput_round()}))
         return
     if "--multichip" in sys.argv:
         # standalone disaggregated round (`make bench-disagg`): role'd

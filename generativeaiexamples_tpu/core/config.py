@@ -287,6 +287,7 @@ class EngineConfig:
     long_prefill: str = configfield("long_prefill", default="auto", help_txt="Sequence-parallel whole-prompt prefill for multi-chunk prompts: auto (when the mesh has a seq axis) | off. One ring-attention pass replaces the chunk loop; decode does not interleave during it, but the pass is seq-axis-times faster.")
     mixed_phase_dispatch: str = configfield("mixed_phase_dispatch", default="auto", help_txt="Mixed-phase dispatch (ragged paged attention): pack the oldest admission's prefill chunk INTO the decode dispatch so one program serves prefill-chunk + decode rows with per-row lengths — long prompts stop stalling the decode tick and the MXU sees fatter tiles. on | off | auto (on for single-chip TPU serving, off elsewhere); the bare env APP_MIXED_PHASE_DISPATCH overrides. 'on' fails loudly at engine init if the config cannot be served (TP mesh, sliding window, unsupported page/head shape).")
     devtime: str = configfield("devtime", default="off", help_txt="Device-time attribution ledger (observability/devtime.py): off (default — dispatch counts and compile-watch only, ZERO added device fences) | sample (one timing fence every APP_DEVTIME_SAMPLE_N-th dispatch — live engine_mfu/engine_hbm_read_util gauges at bounded overhead) | on (fence every dispatch — full attribution for bench/debug; serializes the dispatch pipeline, never the serving default). The bare env APP_DEVTIME overrides this field.")
+    qos: str = configfield("qos", default="off", help_txt="QoS admission plane (engine/qos.py): off (default — the scheduler's FIFO-with-bounded-bypass admission, byte-identical to pre-QoS behavior, zero added work) | fair (per-tenant weighted fair queuing with virtual-time accounting, earliest-deadline-first within a tenant, APP_QOS_TOKENS_PER_S token-rate quotas, shed-before-prefill for unmeetable deadlines, slack-aware preemption, and cost-modeled router hedging). Tenant weights ride APP_QOS_TENANT_WEIGHTS ('acme=4,*=1'). The bare env APP_QOS overrides this field; docs/scheduling.md is the operator guide.")
     kv_spill_mb: int = configfield("kv_spill_mb", default=0, help_txt="Bounded pinned host-RAM pool (MiB) for spill-preemption of KV pages (engine/spill.py): under page exhaustion the victim slot's pages are demoted to this pool instead of freed, and promotion re-imports them on-device when pages free — preemption costs one transfer instead of a full re-prefill recompute (ROADMAP item 3's HBM→host tier, in-process). 0 (default) = off, preemption recomputes as before. The bare env APP_KV_SPILL_MB overrides this field.")
     attention: str = configfield("attention", default="auto", help_txt="Attention backend: auto (pallas on TPU, xla elsewhere) | pallas | xla.")
     mesh_shape: str = configfield("mesh_shape", default="", help_txt="Device mesh, e.g. '1x8'; empty = all devices on one tensor axis.")

@@ -52,6 +52,7 @@ from generativeaiexamples_tpu.observability.devtime import DEVTIME, pow2_bucket
 from generativeaiexamples_tpu.observability.flight import FLIGHT, REQUEST_LOG
 from generativeaiexamples_tpu.engine.engine import (
     DecodeState, EngineCore, bits_to_f32, unpack_decode_out)
+from generativeaiexamples_tpu.engine import qos as qos_mod
 from generativeaiexamples_tpu.engine.prefix_cache import chain_hashes
 from generativeaiexamples_tpu.engine.spill import KVSpillPool, spill_budget_bytes
 from generativeaiexamples_tpu.engine.tokenizer import IncrementalDetokenizer, Tokenizer
@@ -354,6 +355,17 @@ class Scheduler:
             KVSpillPool(budget) if budget > 0
             and hasattr(core, "export_slot_kv")
             and hasattr(core, "import_slot_kv") else None)
+        # QoS admission plane (engine/qos.py, APP_QOS=off|fair): None in
+        # off mode — the admission path then runs the exact pre-QoS FIFO
+        # walk with zero qos calls (the APP_CHAOS/APP_DEVTIME
+        # zero-overhead pattern, test-enforced). With fair on, _admit
+        # consults the policy for weighted-fair tenant ordering, EDF
+        # within a tenant, quota throttling, and shed-before-prefill;
+        # _pick_victim weighs tenant overuse + SLO slack.
+        self._qos: Optional[qos_mod.QosPolicy] = qos_mod.policy_from_env(
+            getattr(core, "cfg", None),
+            perf_model=getattr(core, "perf_model", None),
+            batch_hint=int(getattr(core, "batch", 1) or 1))
         # live-migration evacuation (drain/SIGTERM/watchdog-trip): callers
         # queue a request, the DRIVER thread (owner of _state) performs it
         # inside _tick, parking each live slot's mid-decode snapshot in the
@@ -562,6 +574,7 @@ class Scheduler:
             # spilled host buffers die with their job (budget conservation
             # through driver resets — fuzz-asserted)
             self._drop_spill(job)
+            self._qos_settle(job)
             usage_mod.USAGE.bill_request(job.request)
             REQUEST_LOG.record(job.request)
             job.request.out_queue.put(_STOP)
@@ -582,6 +595,15 @@ class Scheduler:
         for entry in waiters:
             entry["result"] = {"error": reason}
             entry["event"].set()
+
+    def _qos_settle(self, job: _Job) -> None:
+        """Close the job's QoS admission reservation (virtual-time true-up
+        + quota refund) at its terminal event — called at EVERY path that
+        bills the usage ledger, so the policy's outstanding set conserves
+        through finishes, failures, evacuations, and driver resets (the
+        fuzz harness asserts it drains to zero). No-op in off mode."""
+        if self._qos is not None:
+            self._qos.settle(job.request)
 
     def _bill_pages(self, job: _Job) -> None:
         """Accumulate the job's KV page-seconds (pages held x wall) into
@@ -658,6 +680,7 @@ class Scheduler:
         # AFTER the request was already recorded)
         self._bill_pages(job)
         job.page_clock = 0.0
+        self._qos_settle(job)
         usage_mod.USAGE.bill_request(req)
         REQUEST_LOG.record(req)
         req.out_queue.put(_STOP)
@@ -679,6 +702,7 @@ class Scheduler:
         self._bill_pages(job)
         job.page_clock = 0.0
         self._drop_spill(job)
+        self._qos_settle(job)
         usage_mod.USAGE.bill_request(job.request)
         REQUEST_LOG.record(job.request)
         job.request.out_queue.put(_STOP)
@@ -823,6 +847,42 @@ class Scheduler:
                             "burning); best-effort admission rejected — "
                             "retry when pressure clears (/debug/slo)")
 
+    def _qos_shed_unmeetable(self) -> None:
+        """Shed-before-prefill (engine/qos.py, APP_QOS=fair): a sheddable
+        pending request whose remaining deadline budget cannot cover its
+        ESTIMATED prefill+decode service time is shed at admission —
+        slo_outcome "shed", loud error finish — instead of burning prefill
+        programs on a generation that was already lost. Only FRESH local
+        submissions shed: resumes already streamed to a client, and
+        handoff/spill imports carry work another worker (or this pool's
+        host tier) already paid for."""
+        now = time.perf_counter()
+        with self._lock:
+            shed = []
+            for j in self._pending:
+                if (j.gen_ids or j.admit_seq != 0 or j.preload is not None
+                        or j.spill is not None):
+                    continue
+                if not slo_mod.SLO.resolve_or_default(
+                        j.request.slo_class).sheddable:
+                    continue
+                est = self._qos.should_shed(j.request, len(j.ids), now)
+                if est is not None:
+                    shed.append((j, est))
+            for job, _est in shed:
+                self._pending.remove(job)
+        for job, est in shed:
+            job.request.slo_outcome = "shed"
+            self._qos.note_shed(job.request)
+            REGISTRY.counter("slo_shed_total",
+                             labels={"class": job.request.slo_class}).inc()
+            rem = qos_mod.request_remaining_s(job.request, now)
+            self._fail(job, f"shed: deadline unmeetable before prefill "
+                            f"(estimated service {est:.3f}s > remaining "
+                            f"budget {0.0 if rem is None else rem:.3f}s); "
+                            f"nothing was dispatched — retry with a larger "
+                            f"deadline (/debug/qos)")
+
     def _admit(self) -> None:
         """Move pending jobs into the prefilling set while slots+pages last.
 
@@ -834,11 +894,26 @@ class Scheduler:
         max from head-of-line blocking) and the batch stays full. Each
         bypass is counted against the blocked head; past _BYPASS_MAX the
         queue reverts to strict FIFO until the head admits, so a stream of
-        small prompts cannot starve the big one."""
+        small prompts cannot starve the big one.
+
+        With the QoS plane armed (APP_QOS=fair, engine/qos.py) the scan
+        prefix comes from the policy instead of raw FIFO order: per-tenant
+        EDF merged by weighted-fair virtual time, quota-throttled tenants
+        held back for the pass (their jobs stay pending and admit once the
+        bucket refills — no starvation), and unmeetable-deadline sheddable
+        requests shed before any prefill program. The page-fit and
+        bounded-bypass machinery below runs unchanged on the reordered
+        prefix — the policy decides WHO is next, not whether they fit."""
         self._shed_pending()
+        if self._qos is not None:
+            self._qos_shed_unmeetable()
         while self._free:
             with self._lock:
-                cands = list(self._pending)[: self._ADMIT_SCAN]
+                pending = list(self._pending)
+            if self._qos is not None:
+                cands = self._qos.order(pending, self._ADMIT_SCAN)
+            else:
+                cands = pending[: self._ADMIT_SCAN]
             if not cands:
                 return
             chosen: Optional[_Job] = None
@@ -964,6 +1039,12 @@ class Scheduler:
                 # (youngest-first) cannot thrash an old request forever
                 self._admit_counter += 1
                 job.admit_seq = self._admit_counter
+                if self._qos is not None:
+                    # FIRST admission charges the tenant's virtual clock +
+                    # quota reservation (resumes re-admit free — a
+                    # preemption must not double-bill); settled at the
+                    # request's terminal event (_qos_settle)
+                    self._qos.charge_admission(job.request)
             self._table[slot, :] = 0
             self._table[slot, :len(pages)] = pages
             self._table_dev = None
@@ -1660,6 +1741,7 @@ class Scheduler:
         slo_mod.SLO.observe(req)
         self._bill_pages(job)
         job.page_clock = 0.0
+        self._qos_settle(job)
         usage_mod.USAGE.bill_request(req)
         REQUEST_LOG.record(req)
         req.out_queue.put(_STOP)
@@ -1867,8 +1949,17 @@ class Scheduler:
         """Youngest admission — decoding slots and mid-prefill jobs alike
         (both hold pages). The growing job is a candidate too: if IT is the
         youngest, it preempts itself rather than evicting an older request
-        (no thrash — resumes keep their original admission age)."""
+        (no thrash — resumes keep their original admission age).
+
+        With the QoS plane armed the pick is slack-aware instead of
+        age-only (engine/qos.py pick_victim): overusing tenants' jobs go
+        first (virtual-time lead — the flood pays for the pool pressure
+        it causes), then the job with the most SLO slack, with admission
+        age as the tie-break; the spill path in _preempt composes
+        unchanged, so overusing tenants SPILL first too."""
         cands = (list(self._prefilling) + list(self._slots.values()))
+        if self._qos is not None:
+            return self._qos.pick_victim(cands)
         return max(cands, key=lambda j: j.admit_seq)
 
     def _preempt(self, job: _Job) -> None:

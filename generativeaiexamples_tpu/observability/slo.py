@@ -53,6 +53,15 @@ from generativeaiexamples_tpu.core.metrics import REGISTRY
 
 CLASS_HEADER = "X-Request-Class"
 DEADLINE_HEADER = "X-Request-Deadline-Ms"
+# Client-facing aliases (PR 15): direct engine clients and bench drive
+# /v1/chat/completions and /v1/kv/prefill without the chain server
+# fronting them — the short names are the documented public contract,
+# the X-Request-* pair stays the internal propagation form (canonical
+# headers win when both arrive). Both servers parse both; outbound
+# propagation emits both so the router forwards deadline stamping to
+# engines reading either name.
+CLASS_HEADER_ALIAS = "X-Slo-Class"
+DEADLINE_HEADER_ALIAS = "X-Deadline-Ms"
 
 _PRESSURE_LEVELS = ("ok", "warn", "critical")
 
@@ -482,8 +491,11 @@ def outbound_headers(headers: Optional[Dict[str, str]] = None
     adm = _admission.get()
     if adm is not None:
         headers[CLASS_HEADER] = adm.slo_class
+        headers[CLASS_HEADER_ALIAS] = adm.slo_class
         rem = remaining_s(adm)
-        headers[DEADLINE_HEADER] = str(max(0, int(rem * 1000)))
+        deadline_ms = str(max(0, int(rem * 1000)))
+        headers[DEADLINE_HEADER] = deadline_ms
+        headers[DEADLINE_HEADER_ALIAS] = deadline_ms
     return headers
 
 
@@ -496,7 +508,12 @@ def parse_inbound(headers: Mapping[str, str],
     downgrading a caller's objective would falsify every attainment number
     downstream. ``fallback_class`` lets the chain server accept a body
     field when no header is present."""
+    # public aliases (X-Slo-Class / X-Deadline-Ms) parse wherever the
+    # canonical internal pair does — direct engine clients and bench get
+    # deadline stamping without the chain server fronting them; canonical
+    # wins when both arrive
     cls = ((headers.get(CLASS_HEADER) or "").strip()
+           or (headers.get(CLASS_HEADER_ALIAS) or "").strip()
            or (fallback_class or "").strip() or None)
     if cls is not None:
         try:
@@ -505,7 +522,8 @@ def parse_inbound(headers: Mapping[str, str],
             raise ValueError(f"unknown SLO class {cls!r}; declared: "
                              f"{sorted(SLO.classes())}")
     deadline_s = None
-    raw = (headers.get(DEADLINE_HEADER) or "").strip()
+    raw = ((headers.get(DEADLINE_HEADER) or "").strip()
+           or (headers.get(DEADLINE_HEADER_ALIAS) or "").strip())
     if raw:
         try:
             deadline_s = max(0.0, float(raw) / 1000.0)

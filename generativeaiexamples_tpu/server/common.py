@@ -250,6 +250,31 @@ async def fleet_handler(request: web.Request) -> web.Response:
     return web.json_response(body)
 
 
+async def qos_handler(request: web.Request) -> web.Response:
+    """QoS admission plane snapshot (engine/qos.py, APP_QOS): per-tenant
+    weights, virtual clocks, quota buckets/throttle state, the service-
+    time estimate basis (devtime | analytic | none), and outstanding
+    admission reservations. ``{"enabled": false}`` in off mode — the
+    surface exists everywhere so an operator probing a FIFO worker gets
+    a definitive answer, not a 404 to interpret.
+
+    The engine package import pulls jax; on processes that never loaded
+    it (a pure router/encoder), a policy CANNOT be registered — answer
+    off-mode without triggering a multi-second jax import inside the
+    event loop. Processes that serve an engine already hold the module."""
+    import os
+    import sys
+    qos_mod = sys.modules.get("generativeaiexamples_tpu.engine.qos")
+    if qos_mod is None:
+        return web.json_response({
+            "enabled": False,
+            "mode": (os.environ.get("APP_QOS", "").strip().lower()
+                     or "off"),
+            "hint": "set APP_QOS=fair (engine worker env) to enable the "
+                    "admission plane; docs/scheduling.md"})
+    return web.json_response(qos_mod.debug_payload())
+
+
 async def slo_handler(request: web.Request) -> web.Response:
     """Per-class SLO attainment, burn rates, pressure, recent breaches
     (observability/slo.py) — the operator view of 'are we keeping our
@@ -294,6 +319,9 @@ def add_debug_routes(app: web.Application, drain: bool = True) -> None:
         # "Who spent the chip")
         web.get("/debug/usage", usage_handler),
         web.get("/debug/fleet", fleet_handler),
+        # QoS admission plane: tenant fair-queuing state + quota buckets
+        # (docs/scheduling.md)
+        web.get("/debug/qos", qos_handler),
     ])
 
 

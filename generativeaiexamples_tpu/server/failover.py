@@ -260,14 +260,23 @@ class _Worker:
                        labels={"worker": self.url}).set(1.0)
 
     @property
+    def queue_depth(self) -> int:
+        """This worker's advertised load in requests: everything queued,
+        prefilling, or running from the last probe, plus this client's
+        own un-probed dispatches — the ONE load expression the
+        least-loaded score and the cost-modeled hedge trigger both read
+        (a signal added to one must reach the other)."""
+        return (self.running + self.prefilling + self.waiting
+                + self.dispatched)
+
+    @property
     def score(self) -> float:
         """Lower = less loaded. Queue depth normalized by slot capacity,
         plus the SLO-pressure penalty — the headroom/pressure signals from
         the PR-4 SLO plane, read straight off /health."""
         cap = float(self.batch or 8)
-        depth = (self.running + self.prefilling + self.waiting
-                 + self.dispatched)
-        return depth / cap + _PRESSURE_PENALTY.get(self.slo_pressure, 0.0)
+        return (self.queue_depth / cap
+                + _PRESSURE_PENALTY.get(self.slo_pressure, 0.0))
 
 
 class FailoverLLM:
@@ -333,6 +342,17 @@ class FailoverLLM:
         # tail-latency insurance, priced at one duplicate dispatch.
         self.hedge_s = (hedge_s if hedge_s is not None
                         else _env_float("APP_ROUTER_HEDGE_S", 0.0))
+        # cost-modeled hedging (engine/qos.py, PR 15): with the QoS plane
+        # armed (APP_QOS=fair) the static hedge_s becomes the BASE of a
+        # per-dispatch delay scaled by the primary replica's advertised
+        # queue depth and floored at the router's own measured typical
+        # handoff-open time (router_handoff_s p50) — a loaded-but-healthy
+        # primary gets the time its queue legitimately needs before a
+        # duplicate leg burns a second replica's cycles; hedges still
+        # bill the tenant exactly as before. off = the static delay,
+        # byte-identical to the PR 10 behavior.
+        self._qos_hedge = (os.environ.get("APP_QOS", "")
+                           .strip().lower() == "fair")
         # the shared retry policy: jittered backoff between attempts, a
         # per-pool retry BUDGET (token bucket — a retry storm cannot
         # amplify an outage beyond 1 + ratio), and the SLO-deadline
@@ -1164,11 +1184,29 @@ class FailoverLLM:
 
         result, _ix = resilience.hedged_call(
             [lambda w=w: open_one(w) for w in cands],
-            hedge_after_s=self.hedge_s,
+            hedge_after_s=self._hedge_delay_s(cands[0]),
             cancel=lambda r: r[0].__exit__(None, None, None),
             on_error=leg_failed,
             name="router_handoff")
         return result
+
+    def _hedge_delay_s(self, primary: _Worker) -> float:
+        """Per-dispatch hedge trigger for ``primary``. Static
+        ``hedge_s`` unless the QoS plane is armed; with APP_QOS=fair the
+        delay is cost-modeled (engine/qos.py hedge_delay): scaled by the
+        primary's advertised queue depth over its slot capacity — known
+        load is not an anomaly — and floored at the router's own measured
+        typical handoff-open time, so the trigger adapts to what "slow"
+        actually means on this pool instead of a hand-tuned constant."""
+        if not self._qos_hedge or self.hedge_s <= 0:
+            return self.hedge_s
+        open_h = REGISTRY.histogram("router_handoff_s")
+        typical = open_h.percentile(50.0) if open_h.count >= 8 else None
+        delay = resilience.hedge_delay(self.hedge_s, primary.queue_depth,
+                                       primary.batch or 8,
+                                       service_s=typical)
+        REGISTRY.histogram("router_hedge_delay_s").observe(delay)
+        return delay
 
     # ------------------------------------------- live-migration resume
 

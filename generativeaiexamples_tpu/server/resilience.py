@@ -178,6 +178,31 @@ class ResiliencePolicy:
         return True
 
 
+def hedge_delay(base_s: float, queue_depth: int, batch: int,
+                service_s: Optional[float] = None) -> float:
+    """Cost-modeled hedge trigger (the QoS plane's router half —
+    engine/qos.py re-exports this; it lives HERE because the routing
+    process must never import the jax-pulling engine package for 15
+    lines of arithmetic): how long to give the primary replica before a
+    duplicate leg launches.  A loaded worker opens late for a LEGITIMATE
+    reason (its queue), so the static ``APP_ROUTER_HEDGE_S`` scales with
+    the advertised queue depth normalized by slot capacity, floored at
+    the expected service time when an estimate exists — hedging fires on
+    anomaly, not on known load.  The runaway cap is 8x the base OR the
+    service floor, whichever is larger: capping BELOW the floor would
+    re-enable hedging on every legitimately-slow open, exactly the
+    duplicate-dispatch storm the floor exists to prevent."""
+    base_s = max(0.0, float(base_s))
+    if base_s <= 0.0:
+        return 0.0
+    depth_scale = 1.0 + max(0, int(queue_depth)) / float(max(1, int(batch)))
+    delay = base_s * depth_scale
+    floor = float(service_s) if service_s is not None and service_s > 0 \
+        else 0.0
+    delay = max(delay, floor)
+    return min(delay, max(base_s * 8.0, floor))
+
+
 def hedged_call(fns: Sequence[Callable[[], Any]], hedge_after_s: float,
                 cancel: Optional[Callable[[Any], None]] = None,
                 on_error: Optional[Callable[[int, Exception], None]] = None,

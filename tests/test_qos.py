@@ -1,0 +1,354 @@
+"""QoS admission plane (engine/qos.py): weighted-fair tenant queuing,
+deadline-aware admission, quota throttling, shed-before-prefill, victim
+scoring, and cost-modeled hedging.
+
+Contract under test (ISSUE 15): APP_QOS=off is BEHAVIOR-IDENTICAL to the
+pre-QoS FIFO scheduler — the admission path makes zero qos calls (the
+APP_DEVTIME/APP_CHAOS zero-overhead pattern); with fair on, tenants
+share by weight under virtual-time accounting, EDF orders within a
+tenant, metered tenants throttle-and-refill (never starve), unmeetable-
+deadline sheddable requests shed BEFORE any prefill program (devtime-
+ledger-asserted), and every admission reservation settles exactly once.
+
+Everything runs on FakeCore / stub jobs — no real engine, no compiles.
+"""
+
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.engine import qos as qos_mod
+from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler, _STOP
+from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+from generativeaiexamples_tpu.observability import slo as slo_mod
+from generativeaiexamples_tpu.observability.devtime import DEVTIME
+
+from tests.test_scheduler_fuzz import FakeCore, oracle
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+@pytest.fixture(autouse=True)
+def _qos_clean(monkeypatch):
+    """Every test leaves the process-global registration empty and the
+    env unarmed (off in the test env). The SLO tracker resets FIRST:
+    earlier suites' breaches ride its real 5-minute burn windows, and a
+    leftover `critical` pressure would make the burn-rate shedder
+    (observability/slo.py) swallow the best_effort requests these tests
+    aim at the qos shed-before-prefill path."""
+    for key in (qos_mod.MODE_ENV, qos_mod.WEIGHTS_ENV,
+                qos_mod.TOKENS_PER_S_ENV):
+        monkeypatch.delenv(key, raising=False)
+    slo_mod.SLO.reset()
+    yield
+    qos_mod.register_policy(None)
+    slo_mod.SLO.reset()
+
+
+def _req(tenant="", prompt=10, max_tokens=8, deadline_s=None, rid=None,
+         completion=0, slo_class=""):
+    return SimpleNamespace(
+        prompt_ids=[40] * prompt, max_tokens=max_tokens, tenant=tenant,
+        request_id=rid or f"r{id(object())}", completion_tokens=completion,
+        deadline_s=deadline_s, submitted_at=time.perf_counter(),
+        kv_import_s=None, slo_class=slo_class)
+
+
+def _job(req, gen_ids=(), admit_seq=0, spill=None):
+    return SimpleNamespace(request=req, gen_ids=list(gen_ids),
+                           admit_seq=admit_seq, spill=spill)
+
+
+# ------------------------------------------------------------- env parsing
+
+def test_parse_tenant_map():
+    per, default = qos_mod.parse_tenant_map("acme=4, beta =1.5,*=2")
+    assert per == {"acme": 4.0, "beta": 1.5}
+    assert default == 2.0
+    # malformed / non-positive entries drop loudly instead of raising,
+    # and sentinel-claiming tenants are escaped like the usage plane's
+    per, default = qos_mod.parse_tenant_map("bad,x=zero,evil=-1,other=3")
+    assert per == {"t_other": 3.0}
+    assert default is None
+
+
+def test_qos_mode_resolution(monkeypatch):
+    assert qos_mod.qos_mode() == "off"
+    assert qos_mod.qos_mode(SimpleNamespace(qos="fair")) == "fair"
+    monkeypatch.setenv(qos_mod.MODE_ENV, "fair")
+    assert qos_mod.qos_mode(SimpleNamespace(qos="off")) == "fair"
+    monkeypatch.setenv(qos_mod.MODE_ENV, "bogus")
+    assert qos_mod.qos_mode() == "off"   # typo never half-enables
+
+
+# -------------------------------------------------- zero-overhead (off)
+
+def test_off_mode_makes_zero_qos_calls_and_stays_fifo(monkeypatch):
+    """THE acceptance guarantee: APP_QOS unset = the scheduler holds no
+    policy and the admission path performs zero qos operations while a
+    REAL scheduler streams; admission stays strict FIFO."""
+    calls = []
+    for name in ("order", "charge_admission", "settle", "pick_victim",
+                 "should_shed"):
+        monkeypatch.setattr(
+            qos_mod.QosPolicy, name,
+            lambda self, *a, _n=name, **k: calls.append(_n))
+    core = FakeCore(batch=2, max_seq=64, page_size=8, chunk=16, steps=2,
+                    group=4)
+    sched = Scheduler(core, ByteTokenizer())
+    assert sched._qos is None
+    sched.start()
+    try:
+        reqs = [Request(prompt_ids=[40 + i] * 12, max_tokens=4,
+                        temperature=0.0, tenant=f"t{i}")
+                for i in range(5)]
+        for r in reqs:
+            sched.submit(r)
+        for r in reqs:
+            assert "".join(sched.iter_text(r))
+            assert r.error is None
+    finally:
+        sched.stop()
+    assert calls == []
+    # strict FIFO: admission order equals submission order
+    admitted = [r.admitted_at for r in reqs]
+    assert admitted == sorted(admitted)
+
+
+# ------------------------------------------------------------- WFQ / EDF
+
+def test_weighted_fair_interleave():
+    policy = qos_mod.QosPolicy(weights={"a": 3.0, "b": 1.0})
+    jobs = ([_job(_req("a", prompt=5, max_tokens=5)) for _ in range(6)]
+            + [_job(_req("b", prompt=5, max_tokens=5)) for _ in range(6)])
+    out = policy.order(jobs, 8)
+    tenants = [j.request.tenant for j in out]
+    # weight 3:1 — of the first 8 admissions, a gets ~6, b ~2
+    assert tenants.count("a") == 6 and tenants.count("b") == 2
+
+
+def test_edf_within_tenant_and_resumes_first():
+    policy = qos_mod.QosPolicy()
+    tight = _job(_req("t", deadline_s=1.0, rid="tight"))
+    loose = _job(_req("t", deadline_s=30.0, rid="loose"))
+    nodl = _job(_req("t", deadline_s=None, rid="nodl"))
+    resume = _job(_req("t", deadline_s=None, rid="resume"),
+                  gen_ids=[1, 2], admit_seq=3)
+    out = policy.order([nodl, loose, resume, tight], 10)
+    assert [j.request.request_id for j in out] == [
+        "resume", "tight", "loose", "nodl"]
+
+
+def test_quota_throttle_excludes_then_refills():
+    clock = [100.0]
+    policy = qos_mod.QosPolicy(tokens_per_s={"m": 10.0},
+                               clock=lambda: clock[0])
+    throttles0 = REGISTRY.counter("qos_quota_throttles_total",
+                                  labels={"tenant": "m"}).value
+    req = _req("m", prompt=15, max_tokens=10, rid="m1")
+    policy.charge_admission(req)      # reserve 25 > burst 20 → overdrawn
+    later = _job(_req("m", prompt=2, max_tokens=2, rid="m2"))
+    free = _job(_req("free", prompt=2, max_tokens=2, rid="f1"))
+    out = policy.order([later, free], 10)
+    assert [j.request.request_id for j in out] == ["f1"]   # m held back
+    assert REGISTRY.counter("qos_quota_throttles_total",
+                            labels={"tenant": "m"}).value == throttles0 + 1
+    clock[0] += 2.0                   # refill 20 tokens → bucket positive
+    out = policy.order([later, free], 10)
+    assert {j.request.request_id for j in out} == {"f1", "m2"}
+
+
+def test_charge_settle_conservation_and_refund():
+    clock = [0.0]
+    policy = qos_mod.QosPolicy(tokens_per_s={"m": 100.0},
+                               clock=lambda: clock[0])
+    req = _req("m", prompt=10, max_tokens=50, rid="c1")
+    policy.charge_admission(req)
+    assert policy.outstanding() == 1
+    req.completion_tokens = 5         # finished early: 45 tokens unused
+    policy.settle(req)
+    assert policy.outstanding() == 0
+    snap = policy.snapshot()["tenants"]["m"]
+    # bucket: 200 burst - 60 reserved + 45 refund = 185
+    assert snap["quota_bucket_tokens"] == pytest.approx(185.0, abs=0.01)
+    policy.settle(req)                # idempotent — reservation pops once
+    assert policy.outstanding() == 0
+
+
+def test_settle_true_up_is_weighted_and_basis_consistent():
+    """The settle correction must divide by the tenant's weight (the
+    charge did — an unweighted claw-back would refund weight-times what
+    was charged) and must subtract in the CHARGE's unit basis even when
+    the devtime rates arm between admission and finish."""
+    policy = qos_mod.QosPolicy(weights={"w": 4.0})
+    req = _req("w", prompt=20, max_tokens=40, rid="w1")
+    policy.charge_admission(req)      # token basis: est 60, clock 60/4=15
+    assert policy.snapshot()["tenants"]["w"]["virtual_time"] \
+        == pytest.approx(15.0)
+    # rates arm mid-request: the true-up must NOT switch to seconds
+    policy.configure_estimate(0.001, 0.001)
+    req.completion_tokens = 20        # actual 40 tokens vs est 60
+    policy.settle(req)
+    # clock = 15 + (40-60)/4 = 10 — weighted, token-basis delta
+    assert policy.snapshot()["tenants"]["w"]["virtual_time"] \
+        == pytest.approx(10.0)
+
+
+def test_pick_victim_prefers_overusing_tenant_then_slack():
+    policy = qos_mod.QosPolicy()
+    # hog's virtual clock races ahead of the floor
+    for i in range(5):
+        policy.charge_admission(_req("hog", prompt=50, max_tokens=50,
+                                     rid=f"h{i}"))
+    old_hog = _job(_req("hog"), admit_seq=1)
+    young_meek = _job(_req("meek"), admit_seq=9)
+    assert policy.pick_victim([old_hog, young_meek]) is old_hog
+    # equal standing → slack decides: the no-deadline stream absorbs the
+    # preemption, the deadline-tight one is spared
+    p2 = qos_mod.QosPolicy()
+    tight = _job(_req("x", deadline_s=0.5), admit_seq=8)
+    lazy = _job(_req("x", deadline_s=None), admit_seq=2)
+    assert p2.pick_victim([tight, lazy]) is lazy
+
+
+# --------------------------------------------------- shed-before-prefill
+
+def test_shed_before_prefill_fires_before_any_prefill_program():
+    """A sheddable request whose remaining deadline cannot cover the
+    estimated service time sheds at admission: slo_outcome='shed', loud
+    error, STOP delivered — and ZERO prefill work was dispatched (the
+    devtime ledger's prefill program count and the prefill_chunks counter
+    both stay flat), while a serveable request on the same scheduler
+    streams normally."""
+    import os
+    os.environ[qos_mod.MODE_ENV] = "fair"
+    try:
+        core = FakeCore(batch=2, max_seq=64, page_size=8, chunk=16,
+                        steps=2, group=4)
+        sched = Scheduler(core, ByteTokenizer())
+    finally:
+        os.environ.pop(qos_mod.MODE_ENV, None)
+    assert sched._qos is not None
+    # pin estimate rates: 10 ms/token makes a 12-token prompt cost ~0.2 s
+    sched._qos.configure_estimate(0.01, 0.01)
+    chunks0 = REGISTRY.counter("prefill_chunks").value
+    shed0 = REGISTRY.counter("qos_shed_before_prefill_total",
+                             labels={"tenant": "anon"}).value
+    pf_commits0 = sum(r["count"] for r in DEVTIME.snapshot()["programs"]
+                      if r["program"].startswith("prefill"))
+    sched.start()
+    try:
+        doomed = Request(prompt_ids=[40] * 12, max_tokens=8,
+                         temperature=0.0, slo_class="best_effort",
+                         deadline_s=0.01)
+        sched.submit(doomed)
+        text = "".join(sched.iter_text(doomed))
+        assert text == ""
+        assert doomed.slo_outcome == "shed"
+        assert doomed.error and "shed" in doomed.error
+        # the serveable request proves shedding didn't wedge the engine
+        fine = Request(prompt_ids=[44] * 12, max_tokens=6, temperature=0.0)
+        sched.submit(fine)
+        want = ByteTokenizer().decode(
+            oracle(fine.prompt_ids, 6, core.max_seq))
+        assert "".join(sched.iter_text(fine)) == want
+    finally:
+        sched.stop()
+    assert REGISTRY.counter("qos_shed_before_prefill_total",
+                            labels={"tenant": "anon"}).value == shed0 + 1
+    # the shed burned nothing: only the serveable request's chunk(s)
+    # dispatched — 12-token prompt, 16-token chunk → exactly one
+    assert REGISTRY.counter("prefill_chunks").value == chunks0 + 1
+    pf_commits = sum(r["count"] for r in DEVTIME.snapshot()["programs"]
+                     if r["program"].startswith("prefill"))
+    assert pf_commits == pf_commits0 + 1
+    assert sched._qos.outstanding() == 0
+
+
+def test_non_sheddable_class_never_sheds_before_prefill():
+    import os
+    os.environ[qos_mod.MODE_ENV] = "fair"
+    try:
+        core = FakeCore(batch=2, max_seq=64, page_size=8, chunk=16,
+                        steps=2, group=4)
+        sched = Scheduler(core, ByteTokenizer())
+    finally:
+        os.environ.pop(qos_mod.MODE_ENV, None)
+    sched._qos.configure_estimate(0.01, 0.01)
+    sched.start()
+    try:
+        # interactive is not sheddable: even with a hopeless deadline the
+        # request is served (and judged breached at finish), never shed
+        req = Request(prompt_ids=[40] * 12, max_tokens=4, temperature=0.0,
+                      slo_class="interactive", deadline_s=0.001)
+        sched.submit(req)
+        want = ByteTokenizer().decode(
+            oracle(req.prompt_ids, 4, core.max_seq))
+        assert "".join(sched.iter_text(req)) == want
+        assert req.error is None and req.slo_outcome != "shed"
+    finally:
+        sched.stop()
+
+
+# -------------------------------------------------------- header aliases
+
+def test_slo_header_aliases_parse_and_propagate():
+    cls, deadline = slo_mod.parse_inbound(
+        {"X-Slo-Class": "batch", "X-Deadline-Ms": "1500"})
+    assert cls == "batch" and deadline == pytest.approx(1.5)
+    # canonical internal headers win when both arrive
+    cls, deadline = slo_mod.parse_inbound(
+        {"X-Request-Class": "interactive", "X-Slo-Class": "batch",
+         "X-Request-Deadline-Ms": "2000", "X-Deadline-Ms": "9000"})
+    assert cls == "interactive" and deadline == pytest.approx(2.0)
+    with pytest.raises(ValueError):
+        slo_mod.parse_inbound({"X-Slo-Class": "no-such-class"})
+    with slo_mod.admission("batch", deadline_ms=5000):
+        headers = slo_mod.outbound_headers()
+    assert headers["X-Slo-Class"] == "batch"
+    assert headers["X-Request-Class"] == "batch"
+    assert headers["X-Deadline-Ms"] == headers["X-Request-Deadline-Ms"]
+
+
+# ------------------------------------------------------- hedging + debug
+
+def test_hedge_delay_scales_with_load_and_floors_at_service():
+    assert qos_mod.hedge_delay(0.0, 10, 8) == 0.0
+    assert qos_mod.hedge_delay(0.2, 0, 8) == pytest.approx(0.2)
+    assert qos_mod.hedge_delay(0.2, 8, 8) == pytest.approx(0.4)
+    assert qos_mod.hedge_delay(0.2, 0, 8,
+                               service_s=1.0) == pytest.approx(1.0)
+    # the cap keeps tail insurance alive on a deeply queued worker...
+    assert qos_mod.hedge_delay(0.2, 10_000, 8) == pytest.approx(1.6)
+    # ...but never cuts BELOW the service floor: capping under the
+    # typical open time would re-hedge every legitimately-slow open
+    assert qos_mod.hedge_delay(0.2, 0, 8,
+                               service_s=3.0) == pytest.approx(3.0)
+
+
+def test_debug_payload_off_and_on():
+    qos_mod.register_policy(None)
+    off = qos_mod.debug_payload()
+    assert off["enabled"] is False and off["mode"] == "off"
+    policy = qos_mod.QosPolicy(weights={"a": 2.0})
+    policy.charge_admission(_req("a", rid="d1"))
+    qos_mod.register_policy(policy)
+    on = qos_mod.debug_payload()
+    assert on["enabled"] is True
+    assert on["tenants"]["a"]["weight"] == 2.0
+    assert on["tenants"]["a"]["virtual_time"] > 0
+    assert on["outstanding_admissions"] == 1
+    assert on["estimate"]["basis"] in ("none", "devtime", "analytic")
+
+
+def test_estimate_override_and_cardinality_fold():
+    policy = qos_mod.QosPolicy(max_tenants=3)
+    policy.configure_estimate(0.002, 0.005)
+    assert policy.estimate_service_s(100, 10) == pytest.approx(0.25)
+    assert policy.snapshot()["estimate"]["basis"] == "override"
+    # identity space bounded: beyond the cap, new tenants fold to "other"
+    seen = {policy.canonical(f"tenant{i}") for i in range(40)}
+    assert "other" in seen
+    assert len(seen) <= policy.snapshot()["max_tenants"] + 1

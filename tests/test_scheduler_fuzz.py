@@ -285,17 +285,31 @@ class _Spec:
     """One request's workload parameters. ``family`` picks the prompt
     content stream: same-family prompts share their full common-length
     prefix (the prefix-cache sharing workload), different families diverge
-    from token 0."""
+    from token 0. ``tenant`` bills the request to a QoS/usage tenant
+    (qos episodes run 3 tenants at skewed weights)."""
     prompt_len: int
     max_tokens: int
     arrival_tick: int
     family: int = 0
+    tenant: str = ""
+
+
+# the qos fuzz menu's tenant set: skewed weights + a token-rate quota on
+# one tenant (rate high enough that a throttle clears in milliseconds —
+# the harness's idle patience is bounded)
+_QOS_ENV = {
+    "APP_QOS": "fair",
+    "APP_QOS_TENANT_WEIGHTS": "heavy=5,light=1,*=2",
+    "APP_QOS_TOKENS_PER_S": "metered=400",
+}
+_QOS_TENANTS = ("heavy", "light", "metered")
 
 
 def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
                  chaos_spec: Optional[str] = None,
                  spill: bool = False,
-                 evac_tick: Optional[int] = None) -> Optional[str]:
+                 evac_tick: Optional[int] = None,
+                 qos: bool = False) -> Optional[str]:
     """Run one scheduled episode; returns an error description or None.
 
     ``chaos_spec`` arms the fault-injection plane (observability/chaos.py,
@@ -315,17 +329,32 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
     submit_prefilled on the same scheduler and the combined text must
     equal the solo oracle exactly; snapshotless ones must be loud oracle
     prefixes — the token-identical-or-loud contract of the live-migration
-    plane."""
+    plane.
+
+    ``qos`` arms the admission plane (engine/qos.py, APP_QOS=fair) with 3
+    tenants at skewed weights and a token-rate quota on one: streams must
+    stay token-identical to the FIFO oracle per request (fair queuing
+    reorders admission, never content), every non-shed request must still
+    dispatch (no starvation — throttled tenants refill and admit; the
+    livelock/idle guards catch a starved queue), and the policy's
+    outstanding admission reservations must drain to ZERO through
+    preemptions, evacuations, and driver resets (quota conservation)."""
     import os
     rng = np.random.RandomState(seed)
     if spill:
         os.environ["APP_KV_SPILL_MB"] = "64"
+    if qos:
+        os.environ.update(_QOS_ENV)
     try:
         core = FakeCore(**core_kw)
         tok = ByteTokenizer()
         sched = Scheduler(core, tok)
     finally:
         os.environ.pop("APP_KV_SPILL_MB", None)
+        for key in _QOS_ENV:
+            os.environ.pop(key, None)
+    if qos and sched._qos is None:
+        return "qos episode built a scheduler without a policy"
     if chaos_spec is not None:
         chaos_mod.CHAOS.configure(mode="on", seed=seed, spec=chaos_spec)
 
@@ -345,7 +374,7 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
             prompt = [32 + (i * 11 + sp.family * 7) % 150
                       for i in range(sp.prompt_len)]
             reqs.append((Request(prompt_ids=prompt, max_tokens=sp.max_tokens,
-                                 temperature=0.0), sp))
+                                 temperature=0.0, tenant=sp.tenant), sp))
         pending = sorted(range(len(reqs)), key=lambda i: reqs[i][1].arrival_tick)
         tick = 0
         idle = 0
@@ -370,10 +399,24 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
             if tick > 20000:
                 return f"livelock: >{tick} ticks"
             if not worked and not pending:
+                backlog = False
+                if qos:
+                    with sched._lock:
+                        backlog = bool(sched._pending)
                 idle += 1
-                if idle > 50:   # in-flight futures may still need to land
+                if qos and backlog:
+                    # a quota-throttled tenant's jobs legitimately sit
+                    # pending until the bucket refills (milliseconds at
+                    # the menu's rate) — patience here is bounded, so a
+                    # STARVED queue still fails loudly instead of hanging
+                    if idle > 4000:
+                        return ("starvation: pending jobs never "
+                                "dispatched under qos")
+                    time.sleep(0.001)
+                elif idle > 50:  # in-flight futures may still need to land
                     break
-                time.sleep(0.0005)
+                else:
+                    time.sleep(0.0005)
             else:
                 idle = 0
 
@@ -516,6 +559,14 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
         if sched._spill is not None and sched._spill.used_bytes != 0:
             return (f"spill pool leaked {sched._spill.used_bytes} bytes "
                     f"({len(sched._spill)} entries)")
+        # qos reservation conservation (engine/qos.py): every admission's
+        # virtual-time/quota reservation settles at its request's terminal
+        # event — finish, failure, shed, evacuation, AND the _fail_all
+        # driver-reset path (worker.die menu); a leak here is a tenant
+        # billed forever for a request that already died
+        if qos and sched._qos is not None and sched._qos.outstanding():
+            return (f"qos reservations leaked: "
+                    f"{sched._qos.outstanding()} outstanding after drain")
         # page-second conservation (usage plane, observability/usage.py):
         # billed pages-held x wall must never exceed what the pool could
         # physically supply over the episode — a clock left open across a
@@ -543,7 +594,8 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
             chaos_mod.CHAOS.reset()
 
 
-def _gen_specs(rng: np.random.RandomState, core_kw: Dict) -> List[_Spec]:
+def _gen_specs(rng: np.random.RandomState, core_kw: Dict,
+               tenants: tuple = ()) -> List[_Spec]:
     n = int(rng.randint(1, 9))
     max_seq = core_kw["max_seq"]
     specs = []
@@ -558,7 +610,9 @@ def _gen_specs(rng: np.random.RandomState, core_kw: Dict) -> List[_Spec]:
         specs.append(_Spec(prompt_len=plen,
                            max_tokens=int(rng.randint(1, 24)),
                            arrival_tick=int(rng.randint(0, 12)),
-                           family=int(rng.randint(0, 3))))
+                           family=int(rng.randint(0, 3)),
+                           tenant=(tenants[int(rng.randint(0, len(tenants)))]
+                                   if tenants else "")))
     return specs
 
 
@@ -582,9 +636,10 @@ def _core_kw(rng: np.random.RandomState) -> Dict:
 
 def _shrink(seed: int, specs: List[_Spec], core_kw: Dict, err: str,
             chaos_spec: Optional[str] = None, spill: bool = False,
-            evac_tick: Optional[int] = None) -> str:
+            evac_tick: Optional[int] = None, qos: bool = False) -> str:
     """Greedy one-at-a-time removal: report the minimal failing workload."""
-    kw = dict(chaos_spec=chaos_spec, spill=spill, evac_tick=evac_tick)
+    kw = dict(chaos_spec=chaos_spec, spill=spill, evac_tick=evac_tick,
+              qos=qos)
     changed = True
     while changed and len(specs) > 1:
         changed = False
@@ -595,7 +650,7 @@ def _shrink(seed: int, specs: List[_Spec], core_kw: Dict, err: str,
                 break
     final = _run_episode(seed, specs, core_kw, **kw) or err
     return (f"{final}\n  seed={seed} core={core_kw} chaos={chaos_spec!r} "
-            f"spill={spill} evac_tick={evac_tick!r}\n"
+            f"spill={spill} evac_tick={evac_tick!r} qos={qos}\n"
             f"  minimal workload: "
             + "\n  ".join(map(repr, specs)))
 
@@ -639,6 +694,44 @@ _CHAOS_MENUS = (
     "page.exhaust=0.3,spill.exhaust=0.5",
     "worker.die=0.003,page.exhaust=0.25,spill.exhaust=0.3",
 )
+
+
+QOS_EPISODES = 100
+
+
+def test_scheduler_fuzz_qos_invariants():
+    """The ISSUE-15 qos menu: the same adversarial workloads — including
+    chaos faults, host-spill pressure, and mid-episode evacuations — with
+    the admission plane armed (APP_QOS=fair, 3 tenants at skewed weights,
+    one token-rate-metered). Invariants on top of the base episode's:
+    (i) every stream stays token-identical to the solo FIFO oracle (fair
+    queuing reorders WHO admits next, never what a request generates),
+    (ii) no starvation — every non-shed request eventually dispatches
+    (quota-throttled tenants refill and admit; the bounded idle patience
+    turns a starved queue into a loud failure), and (iii) the policy's
+    admission reservations conserve to zero through preemptions,
+    evacuations, and worker.die driver resets."""
+    master = np.random.RandomState(0x0A11FA1A)
+    t0 = time.perf_counter()
+    for ep in range(QOS_EPISODES):
+        seed = int(master.randint(0, 2**31))
+        rng = np.random.RandomState(seed)
+        core_kw = _core_kw(rng)
+        specs = _gen_specs(rng, core_kw, tenants=_QOS_TENANTS)
+        chaos_spec = (_CHAOS_MENUS[int(rng.randint(0, len(_CHAOS_MENUS)))]
+                      if rng.rand() < 0.4 else None)
+        spill = bool(rng.rand() < 0.3)
+        evac_tick = (int(rng.randint(2, 40))
+                     if rng.rand() < 0.25 else None)
+        err = _run_episode(seed, specs, core_kw, chaos_spec=chaos_spec,
+                           spill=spill, evac_tick=evac_tick, qos=True)
+        if err:
+            pytest.fail(f"qos episode {ep}: "
+                        + _shrink(seed, specs, core_kw, err,
+                                  chaos_spec=chaos_spec, spill=spill,
+                                  evac_tick=evac_tick, qos=True))
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 120, f"qos fuzz too slow for CI: {elapsed:.0f}s"
 
 
 def test_scheduler_fuzz_chaos_invariants():
