@@ -55,6 +55,14 @@ bench-goodput:
 bench-roofline:
 	$(TEST_ENV) python bench.py --roofline
 
+# Prefix-tier A/B round: the returning-conversation loop on a tight page
+# pool, APP_KV_TIER=off vs prefix (engine/kv_tier.py); emits one JSON line
+# with prefill_programs_saved / tier_hit_frac / promote-vs-reprefill TTFT
+# (docs/performance.md "Prefix-addressed KV tier").
+.PHONY: bench-prefix
+bench-prefix:
+	$(TEST_ENV) python bench.py --prefix-tier
+
 dryrun:
 	$(TEST_ENV) XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	  python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"

@@ -836,6 +836,143 @@ def run_roofline_round() -> dict:
     }
 
 
+def run_prefix_tier_round() -> dict:
+    """Prefix-tier A/B round (`bench.py --prefix-tier` / `make bench-prefix`):
+    the ISSUE-16 returning-conversation loop as ONE JSON line.
+
+    Same workload twice — APP_KV_TIER=off (the PR 14 request-keyed spill
+    pool) vs APP_KV_TIER=prefix (the prefix-addressed tier) — on a
+    deliberately TIGHT page pool so decode growth forces a spill.  Phase 1
+    runs two concurrent streams until one spills (seeding the tier with
+    its prefix run in the `prefix` arm); phase 2 resubmits the victim's
+    prompt as a sequence of "returning conversations" and records, per
+    arm, the promote-vs-reprefill split: TTFT p50, the devtime ledger's
+    prefill program/token deltas over exactly the returning requests, and
+    the tier-covered token fraction.  The headline derived fields are
+    ``prefill_programs_saved`` / ``prefill_tokens_saved`` (off minus on —
+    positive means the tier is doing its job) and ``tier_hit_frac``.
+    """
+    import os
+    import statistics as _stats
+
+    def _prefill_rows() -> tuple:
+        # (program count, token sum) for prefill-shaped dispatches; the
+        # count/token planes populate in every devtime mode, off included
+        rows = [r for r in DEVTIME.snapshot()["programs"]
+                if r["program"].startswith(("prefill", "mixed"))]
+        return (sum(r["count"] for r in rows),
+                sum(r["tokens"] for r in rows))
+
+    def _drive(sched, reqs, ticks: int = 20000) -> None:
+        for _ in range(ticks):
+            worked = sched._tick()
+            if all(r.finished_at is not None for r in reqs):
+                return
+            if not worked:
+                time.sleep(0.001)
+        raise RuntimeError("prefix-tier round: requests did not finish")
+
+    def _arm(tier_mode: str) -> dict:
+        prior = {k: os.environ.get(k)
+                 for k in ("APP_KV_SPILL_MB", "APP_KV_TIER")}
+        os.environ["APP_KV_SPILL_MB"] = "64"
+        os.environ["APP_KV_TIER"] = tier_mode
+        try:
+            model_cfg = llama.LlamaConfig.tiny(vocab_size=300)
+            # tight pool: 8 pages across batch=2 — decode growth must
+            # evict, so the spill path (and in the `prefix` arm, the
+            # tier contribution) is exercised, not merely available
+            # prefill_group=1 keeps one devtime program per CHUNK (the
+            # grouped dispatcher would fuse any prompt here into one
+            # program and hide the saving the round exists to measure)
+            ecfg = EngineConfig(max_batch_size=2, max_seq_len=128,
+                                prefill_chunk=16, page_size=16,
+                                spec_decode="off",
+                                decode_steps_per_dispatch=2,
+                                prefill_hold_chunks=0, prefill_group=1,
+                                num_pages=8, prefix_cache="off")
+            tok = ByteTokenizer()
+            params = llama.init_params(jax.random.PRNGKey(0), model_cfg)
+            core = EngineCore(model_cfg, ecfg, params, eos_id=tok.eos_id)
+            core.warmup()
+            sched = Scheduler(core, tok)
+        finally:
+            for k, v in prior.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+        pa = tok.encode("the quick brown fox jumps over the lazy")
+        pb = tok.encode("pack my box with five dozen liquor ju")
+        kwa = dict(max_tokens=60, temperature=0.7, seed=11)
+        kwb = dict(max_tokens=60, temperature=0.7, seed=22)
+
+        # phase 1 — seed under pressure: drive until a victim spills and
+        # resumes, then to completion (identical workload in both arms)
+        r1 = Request(prompt_ids=list(pa), **kwa)
+        r2 = Request(prompt_ids=list(pb), **kwb)
+        sched.submit(r1)
+        sched.submit(r2)
+        for _ in range(20000):
+            worked = sched._tick()
+            if r1.spill_resumes + r2.spill_resumes >= 1:
+                break
+            if not worked:
+                time.sleep(0.001)
+        else:
+            raise RuntimeError(
+                "prefix-tier round: no spill under page pressure — the "
+                "A/B would compare two cold paths")
+        _drive(sched, [r1, r2])
+        victim, victim_kw = (pa, kwa) if r1.spill_resumes else (pb, kwb)
+
+        # phase 2 — the returning conversations: same prompt, sequential,
+        # measured against the devtime prefill ledger
+        reps = 4
+        pre_n, pre_tok = _prefill_rows()
+        ttfts, hit_tokens = [], 0
+        for i in range(reps):
+            kw = dict(victim_kw, seed=100 + i, max_tokens=24)
+            req = Request(prompt_ids=list(victim), **kw)
+            sched.submit(req)
+            _drive(sched, [req])
+            if req.error:
+                raise RuntimeError(
+                    f"prefix-tier round failed request: {req.error}")
+            ttfts.append(req.first_token_at - req.submitted_at)
+            hit_tokens += req.tier_hit_tokens
+        post_n, post_tok = _prefill_rows()
+        return {
+            "ttft_p50_s": round(_stats.median(ttfts), 5),
+            "prefill_programs": int(post_n - pre_n),
+            "prefill_tokens": int(post_tok - pre_tok),
+            "tier_hit_tokens": int(hit_tokens),
+            "tier_hit_frac": round(hit_tokens / (reps * len(victim)), 4),
+            "n_returning": reps,
+            "prompt_tokens": len(victim),
+            "spill_resumes": int(r1.spill_resumes + r2.spill_resumes),
+        }
+
+    off = _arm("off")
+    on = _arm("prefix")
+    return {
+        "prefix_tier": {
+            "off": off,
+            "on": on,
+            "prefill_programs_saved": off["prefill_programs"]
+            - on["prefill_programs"],
+            "prefill_tokens_saved": off["prefill_tokens"]
+            - on["prefill_tokens"],
+            "tier_hit_frac": on["tier_hit_frac"],
+            "ttft_promote_over_reprefill": (
+                round(on["ttft_p50_s"] / off["ttft_p50_s"], 4)
+                if off["ttft_p50_s"] else None),
+        },
+        "workers_backend": "tiny-cpu",
+    }
+
+
 CHAOS_SEED = 1337
 # the FIXED injected-fault schedule of the recorded chaos round: router-
 # side transport flakiness (delays + resets) and engine-side stalls/5xx.
@@ -1369,6 +1506,13 @@ def main() -> None:
         # fairness + per-tenant TTFT p99 + goodput_frac for the
         # APP_QOS=off vs fair A/B, one parsed JSON line
         print(json.dumps({"metric": "qos_goodput", **run_goodput_round()}))
+        return
+    if "--prefix-tier" in sys.argv:
+        # prefix-tier A/B round (`make bench-prefix`): returning-prefix
+        # promote vs re-prefill — TTFT p50, prefill programs/tokens
+        # saved, tier hit fraction, one parsed JSON line
+        print(json.dumps({"metric": "prefix_tier",
+                          **run_prefix_tier_round()}))
         return
     if "--multichip" in sys.argv:
         # standalone disaggregated round (`make bench-disagg`): role'd

@@ -57,8 +57,14 @@ _LOCKISH_SEGMENTS = frozenset({"lock", "rlock", "wlock", "mutex", "cv",
 # blocking while holding a lock: serializes every other thread on it
 _BLOCKING_UNDER_LOCK_CALLS = frozenset(
     {"time.sleep"} | _HTTP_CALLS | _URLOPEN_CALLS
-    | {"jax.device_get", "device_get"})
+    | {"jax.device_get", "device_get"}
+    # disk syscalls: the KV tier's write-behind demotion (engine/
+    # kv_tier.py) stages multi-MB files near the driver thread — a
+    # flush/rename under the tier lock stalls every admission probe
+    | {"os.replace", "os.fsync", "os.remove", "os.unlink"})
 _BLOCKING_UNDER_LOCK_ATTRS = frozenset({"result", "block_until_ready"})
+# pathlib whole-file I/O: one call hides an open+read/write+close
+_DISK_UNDER_LOCK_ATTRS = frozenset({"write_bytes", "read_bytes"})
 # Condition.wait RELEASES the lock; notify wakes without blocking
 _LOCK_SAFE_ATTRS = frozenset({"wait", "wait_for", "notify", "notify_all",
                               "acquire", "release"})
@@ -202,9 +208,9 @@ def _lockish(expr: ast.AST) -> Optional[str]:
 
 
 @rule("lock-discipline", "error",
-      "Blocking call (sleep, HTTP, future .result(), TPU fetch) while "
-      "holding a threading.Lock/Condition — serializes every thread "
-      "contending on that lock behind the slow operation")
+      "Blocking call (sleep, HTTP, future .result(), TPU fetch, disk "
+      "I/O) while holding a threading.Lock/Condition — serializes every "
+      "thread contending on that lock behind the slow operation")
 def check_lock_discipline(ctx: ModuleContext) -> Iterable[Finding]:
     """``Condition.wait`` is exempt (it releases the lock); closures
     defined under the lock are skipped (they run later, elsewhere)."""
@@ -238,6 +244,13 @@ def check_lock_discipline(ctx: ModuleContext) -> Iterable[Finding]:
                     f"`.{attr}()` while holding `{held}` — a blocking "
                     "wait inside the critical section; collect the future "
                     "/ device value after releasing the lock")
+            elif attr in _DISK_UNDER_LOCK_ATTRS:
+                yield Finding(
+                    ctx.path, inner.lineno, "lock-discipline", "error",
+                    f"`.{attr}()` while holding `{held}` — whole-file "
+                    "disk I/O inside the critical section; stage the "
+                    "bytes under the lock, touch the filesystem after "
+                    "releasing it (see engine/kv_tier.py write-behind)")
 
 
 # --------------------------------------------------------------------------

@@ -289,6 +289,8 @@ class EngineConfig:
     devtime: str = configfield("devtime", default="off", help_txt="Device-time attribution ledger (observability/devtime.py): off (default — dispatch counts and compile-watch only, ZERO added device fences) | sample (one timing fence every APP_DEVTIME_SAMPLE_N-th dispatch — live engine_mfu/engine_hbm_read_util gauges at bounded overhead) | on (fence every dispatch — full attribution for bench/debug; serializes the dispatch pipeline, never the serving default). The bare env APP_DEVTIME overrides this field.")
     qos: str = configfield("qos", default="off", help_txt="QoS admission plane (engine/qos.py): off (default — the scheduler's FIFO-with-bounded-bypass admission, byte-identical to pre-QoS behavior, zero added work) | fair (per-tenant weighted fair queuing with virtual-time accounting, earliest-deadline-first within a tenant, APP_QOS_TOKENS_PER_S token-rate quotas, shed-before-prefill for unmeetable deadlines, slack-aware preemption, and cost-modeled router hedging). Tenant weights ride APP_QOS_TENANT_WEIGHTS ('acme=4,*=1'). The bare env APP_QOS overrides this field; docs/scheduling.md is the operator guide.")
     kv_spill_mb: int = configfield("kv_spill_mb", default=0, help_txt="Bounded pinned host-RAM pool (MiB) for spill-preemption of KV pages (engine/spill.py): under page exhaustion the victim slot's pages are demoted to this pool instead of freed, and promotion re-imports them on-device when pages free — preemption costs one transfer instead of a full re-prefill recompute (ROADMAP item 3's HBM→host tier, in-process). 0 (default) = off, preemption recomputes as before. The bare env APP_KV_SPILL_MB overrides this field.")
+    kv_tier: str = configfield("kv_tier", default="off", help_txt="Prefix-addressed host KV tier over the spill pool (engine/kv_tier.py): off (default — the request-keyed spill pool, byte-identical to pre-tier behavior) | prefix (spilled page runs are re-keyed by their token-level page-chain hashes and RETAINED after release as a refcounted, value-priced cache; admission probes the tier for the longest cached prefix of every prompt and promotes it with a partial page import — zero prefill programs over the covered span, prefill only the tail; returning conversations and fleet-shared system prompts stop re-prefilling). Requires a spill budget (kv_spill_mb / APP_KV_SPILL_MB > 0). The bare env APP_KV_TIER overrides this field.")
+    kv_tier_disk_mb: int = configfield("kv_tier_disk_mb", default=0, help_txt="Optional disk tier (MiB) below the host-RAM KV tier: retained prefix entries are written behind (async, never on the driver thread) as crc32-framed files (core/kv_wire.py format — corruption is a loud decode failure, never served KV), so a RAM eviction demotes instead of drops and a later promote reloads from disk. 0 (default) = off. APP_KV_TIER_DISK_DIR picks the directory; the bare env APP_KV_TIER_DISK_MB overrides this field.")
     attention: str = configfield("attention", default="auto", help_txt="Attention backend: auto (pallas on TPU, xla elsewhere) | pallas | xla.")
     mesh_shape: str = configfield("mesh_shape", default="", help_txt="Device mesh, e.g. '1x8'; empty = all devices on one tensor axis.")
     checkpoint_dir: str = configfield("checkpoint_dir", default="", help_txt="Orbax checkpoint to serve; empty = random init (test mode).")

@@ -91,6 +91,17 @@ class PerfModel:
         per fused decode step; grouped prefill pays one per dispatch)."""
         return float(weight_passes) * self.param_bytes
 
+    def prefill_seconds(self, tokens: float) -> Optional[float]:
+        """Lower-bound device seconds to prefill ``tokens`` positions at
+        the chip's matmul peak — the recompute cost a cached KV prefix
+        saves, and therefore the value basis for the prefix tier's
+        eviction pricing (engine/kv_tier.py). None when the chip's peaks
+        are unknown — the tier falls back to a token-count proxy, never
+        to pricing every entry at zero."""
+        if not self.peak_flops:
+            return None
+        return self.flops(tokens) / self.peak_flops
+
     def mfu(self, tokens: float, seconds: float) -> Optional[float]:
         """Achieved model-FLOP utilization of ``tokens`` positions computed
         in ``seconds`` of device time."""

@@ -466,6 +466,10 @@ class EngineCore:
         # must NOT donate — the state keeps serving after the copy-out
         self._export_fn = jax.jit(self._export_impl)
         self._import_fn = jax.jit(self._import_impl, donate_argnums=dn)
+        # prefix-tier promotion (engine/kv_tier.py): scatter only, no
+        # slot state — the tail prefill owns lengths/activation
+        self._import_pages_fn = jax.jit(self._import_pages_impl,
+                                        donate_argnums=dn)
         # transported pool dtype, validated on both ends of a handoff
         self._kv_dtype = ("int8" if engine_cfg.kv_quant == "int8"
                           else str(jax.dtypes.canonicalize_dtype(
@@ -1546,6 +1550,94 @@ class EngineCore:
             state, jnp.asarray(ids), jnp.int32(slot),
             jnp.int32(int(payload["length"])), pad(payload["k"]),
             pad(payload["v"]),
+            pad(payload["k_s"]) if quant else None,
+            pad(payload["v_s"]) if quant else None)
+
+    def validate_pages_payload(self, payload: dict, n_pages: int) -> None:
+        """Loudly refuse a PARTIAL page import this pool cannot host —
+        the prefix-tier variant of :meth:`validate_handoff`. Geometry
+        only: the tier promotes the first ``n_pages`` full pages of a
+        cached run, so length/prompt consistency is the SCHEDULER's
+        admission plan (it prefills the tail), but a page-size / layer /
+        dtype mismatch would still scatter garbage KV."""
+        mine = {"page_size": self.page_size,
+                "n_layers": self.model_cfg.n_layers,
+                "kv_dim": self.model_cfg.n_kv_heads * self.model_cfg.head_dim,
+                "kv_dtype": self._kv_dtype}
+        for key, want in mine.items():
+            got = payload.get(key)
+            if got != want:
+                raise ValueError(
+                    f"tier import {key} mismatch: payload carries {got!r}, "
+                    f"this engine serves {want!r}")
+        total = int(payload.get("n_pages", 0))
+        if n_pages < 1 or n_pages > total:
+            raise ValueError(f"tier import of {n_pages} pages from a "
+                             f"{total}-page payload")
+        if n_pages > self.max_pages_per_slot:
+            raise ValueError(f"tier import of {n_pages} pages exceeds this "
+                             f"engine's {self.max_pages_per_slot} pages/slot")
+        kv_dim = mine["kv_dim"]
+        want_kv = (mine["n_layers"], total, self.page_size, kv_dim)
+        want_sc = (mine["n_layers"], total, self.model_cfg.n_kv_heads,
+                   self.page_size)
+        for key, want in (("k", want_kv), ("v", want_kv),
+                          ("k_s", want_sc), ("v_s", want_sc)):
+            arr = payload.get(key)
+            if arr is None:
+                if key in ("k", "v") or self.cfg.kv_quant == "int8":
+                    raise ValueError(f"tier payload is missing {key!r}")
+                continue
+            shape = tuple(getattr(arr, "shape", ()))
+            if shape != want:
+                raise ValueError(
+                    f"tier {key} buffer shape {shape} does not match the "
+                    f"metadata's {want}")
+
+    def _import_pages_impl(self, state: DecodeState, page_ids,
+                           k, v, k_s, v_s) -> DecodeState:
+        cache = kv_cache.import_pages_partial(
+            state.cache, page_ids, self.num_pages, k, v, k_s=k_s, v_s=v_s)
+        return dataclasses.replace(state, cache=cache)
+
+    def import_pages_kv(self, state: DecodeState, pages, payload: dict,
+                        n_pages: Optional[int] = None
+                        ) -> DecodeState:   # tpulint: hot-path
+        """Scatter the first ``n_pages`` pages of an exported payload into
+        freshly allocated pages of THIS pool — the prefix-tier promotion
+        (engine/kv_tier.py). No slot state is touched: the caller starts
+        its chunked prefill at the covered boundary, so the promoted span
+        costs zero prefill programs and the tail runs exactly as a fresh
+        admission."""
+        n_imp = int(payload["n_pages"] if n_pages is None else n_pages)
+        self.validate_pages_payload(payload, n_imp)
+        b = self._export_bucket(n_imp)
+        ids = np.zeros((b,), np.int32)
+        ids[:n_imp] = list(pages)[:n_imp]
+        L = self.model_cfg.n_layers
+
+        def pad(a):
+            if a is None:
+                return None
+            if isinstance(a, jax.Array):
+                a = a[:, :n_imp]
+                if a.shape[1] < b:
+                    a = jnp.pad(a, ((0, 0), (0, b - a.shape[1]))
+                                + ((0, 0),) * (a.ndim - 2))
+                return a.reshape((L * b,) + a.shape[2:])
+            # host path: slicing may alias a READ-ONLY wire/disk view —
+            # both branches below only read
+            a = np.asarray(a)[:, :n_imp]
+            if a.shape[1] < b:
+                a = np.concatenate(
+                    [a, np.zeros((L, b - a.shape[1]) + a.shape[2:],
+                                 a.dtype)], axis=1)
+            return jnp.asarray(np.ascontiguousarray(
+                a.reshape((L * b,) + a.shape[2:])))
+
+        quant = self.cfg.kv_quant == "int8"
+        return self._import_pages_fn(
+            state, jnp.asarray(ids), pad(payload["k"]), pad(payload["v"]),
             pad(payload["k_s"]) if quant else None,
             pad(payload["v_s"]) if quant else None)
 

@@ -266,6 +266,35 @@ def import_pages(cache: PagedKVCache, page_ids: jnp.ndarray,   # tpulint: hot-pa
     return PagedKVCache(k=new_k, v=new_v, lengths=lengths)
 
 
+def import_pages_partial(cache: PagedKVCache,   # tpulint: hot-path
+                         page_ids: jnp.ndarray, num_pages: int,
+                         k: jnp.ndarray, v: jnp.ndarray,
+                         k_s: Optional[jnp.ndarray] = None,
+                         v_s: Optional[jnp.ndarray] = None) -> PagedKVCache:
+    """Scatter an exported page buffer WITHOUT touching any slot state —
+    the prefix-tier promotion path (engine/kv_tier.py).
+
+    Unlike :func:`import_pages`, the imported run covers only the
+    PREFIX of a prompt still being admitted: the caller's chunked tail
+    prefill owns ``lengths``/sampling state exactly as a fresh admission
+    does, and starts at the covered boundary because the scheduler sets
+    ``job.prefilled`` to the promoted span. Writing ``lengths`` here
+    would corrupt whichever slot the caller hasn't activated yet.
+    """
+    L = cache.k.shape[0] // num_pages
+    rows = (jnp.arange(L, dtype=jnp.int32)[:, None] * num_pages
+            + page_ids[None, :].astype(jnp.int32)).reshape(-1)
+    new_k = cache.k.at[rows].set(k.astype(cache.k.dtype))
+    new_v = cache.v.at[rows].set(v.astype(cache.v.dtype))
+    if cache.quantized:
+        if k_s is None or v_s is None:
+            raise ValueError("int8 pool import needs k_s/v_s scales")
+        return PagedKVCache(k=new_k, v=new_v, lengths=cache.lengths,
+                            k_s=cache.k_s.at[rows].set(k_s),
+                            v_s=cache.v_s.at[rows].set(v_s))
+    return PagedKVCache(k=new_k, v=new_v, lengths=cache.lengths)
+
+
 # The wire codecs live in core/kv_wire.py (numpy-only, so the routing
 # frontend can transcode without importing the engine stack): the binary
 # zero-copy frame (encode/decode_kv_frames) is the serving wire, the JSON

@@ -1,0 +1,652 @@
+"""Prefix-addressed host KV tier over the spill pool (ROADMAP item 2).
+
+PR 14's ``KVSpillPool`` keys host-RAM KV by REQUEST id: a spilled
+payload exists only for the request that exported it, and dies with it.
+That makes returning conversations and fleet-shared system prompts pay
+full prefill even when a byte-identical KV run sits in host RAM —
+``prefix_hit_frac`` stalls at whatever the device cache alone covers.
+
+``PrefixKVTier`` re-keys the same pool by the token-level page-chain
+blake2b hashes the device prefix cache already computes
+(engine/prefix_cache.chain_hashes — engine and tier agree on page
+identity by construction):
+
+  * a spilled request CONTRIBUTES its full-page prefix run under those
+    hashes; the rid registry pins the entry while the spill is live;
+  * when the rid releases (promotion, finish, death, evacuation) the
+    entry is RETAINED as refcounted cache — that retention is the
+    returning-conversation hit;
+  * admission PROBES the tier for the longest cached prefix of every
+    incoming prompt (deepest hash first) and promotes the covered run
+    with a partial page import — zero prefill programs over the span,
+    prefill only the tail;
+  * eviction is value-priced, not refuse-at-budget: value ≈ recompute
+    cost (core/perfmodel's prefill estimate, token count when the chip's
+    peaks are unknown) × recency × hit history, biased so entries whose
+    contributors had little SLO slack are kept longest, divided by the
+    contributing tenant's QoS overuse (the PR 15 victim-picker doctrine:
+    whoever floods the pool pays for the pressure). An entry with
+    ``refs > 0`` (or a live rid pin) is NEVER evicted;
+  * an optional disk tier (``APP_KV_TIER_DISK_MB``) demotes RAM-evicted
+    entries to crc32-framed files (core/kv_wire.py — corruption is a
+    loud decode error, never served KV) via an async write-behind
+    thread; file I/O never runs under the tier lock and never on the
+    driver thread.
+
+``APP_KV_TIER=off`` (the default) keeps the plain ``KVSpillPool`` —
+byte-identical PR 14 behavior, zero tier code on any hot path (the
+APP_CHAOS/APP_DEVTIME/APP_QOS zero-overhead pattern, test-enforced).
+
+The fleet loop: ``Scheduler.load_stats`` advertises the tier's top-K
+hottest h₀ hashes + occupancy on ``/health``; the failover router
+matches them against per-conversation hashes learned from the
+``X-KV-Prefix`` response header and routes a prefix miss to the replica
+that can PROMOTE instead of recompute
+(``router_prefix_route_total{outcome="promote"}``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from generativeaiexamples_tpu.core import kv_wire
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+
+logger = logging.getLogger("generativeaiexamples_tpu.kv_tier")
+
+# slack values are clamped here (mirrors engine/qos.py's cap): an
+# undated request is "maximally slack", never infinitely valuable
+_SLACK_CAP_S = 600.0
+
+
+def payload_nbytes(payload: Dict[str, Any]) -> int:
+    """Host bytes a spilled handoff payload occupies. Charges EVERY
+    ndarray-valued segment — a payload that grows a new buffer (adapter
+    state, draft caches) must never ride the budget for free — plus the
+    packed token lists (``prompt_ids`` at 4 bytes/token, exactly the
+    kv_wire frame footprint); the remaining scalar passthrough is noise
+    next to the KV pages."""
+    total = 0
+    for key, value in payload.items():
+        n = getattr(value, "nbytes", None)
+        if n is not None:
+            total += int(n)
+        elif key == "prompt_ids" and value is not None:
+            total += 4 * len(value)
+    return total
+
+
+def spill_budget_bytes(cfg: Any = None) -> int:
+    """Resolve the spill budget: the bare env ``APP_KV_SPILL_MB`` wins
+    (the knob the issue/docs name), else ``EngineConfig.kv_spill_mb``,
+    else 0 (spill off — preemption recomputes, the pre-r07 behavior)."""
+    raw = os.environ.get("APP_KV_SPILL_MB", "").strip()
+    if raw:
+        try:
+            return max(0, int(float(raw))) * (1 << 20)
+        except ValueError:
+            pass
+    mb = int(getattr(cfg, "kv_spill_mb", 0) or 0)
+    return max(0, mb) * (1 << 20)
+
+
+def tier_mode(cfg: Any = None) -> str:
+    """``off`` (default — plain request-keyed spill pool) or ``prefix``
+    (prefix-addressed tier). The bare env ``APP_KV_TIER`` wins over
+    ``EngineConfig.kv_tier``; unknown values are loudly treated as off
+    rather than silently arming a cache the operator did not name."""
+    raw = os.environ.get("APP_KV_TIER", "").strip().lower()
+    if not raw:
+        raw = str(getattr(cfg, "kv_tier", "off") or "off").strip().lower()
+    if raw in ("off", "prefix"):
+        return raw
+    logger.warning("APP_KV_TIER=%r is not off|prefix; tier stays off", raw)
+    return "off"
+
+
+def tier_disk_bytes(cfg: Any = None) -> int:
+    """Disk-tier byte budget: bare env ``APP_KV_TIER_DISK_MB`` wins,
+    else ``EngineConfig.kv_tier_disk_mb``, else 0 (no disk tier)."""
+    raw = os.environ.get("APP_KV_TIER_DISK_MB", "").strip()
+    if raw:
+        try:
+            return max(0, int(float(raw))) * (1 << 20)
+        except ValueError:
+            pass
+    mb = int(getattr(cfg, "kv_tier_disk_mb", 0) or 0)
+    return max(0, mb) * (1 << 20)
+
+
+def tier_hot_k() -> int:
+    """How many hottest prefix hashes ride each /health advert."""
+    try:
+        return max(0, int(os.environ.get("APP_KV_TIER_HOT_K", "") or 8))
+    except ValueError:
+        return 8
+
+
+class KVSpillPool:
+    """Byte-budgeted registry of spilled KV payloads (one per request).
+
+    The PR 14 accounting pool, unchanged: ``APP_KV_TIER=off`` serves
+    exactly this class. The payload arrays themselves ride the ``_Job``
+    (the scheduler owns their lifecycle); the pool guarantees the
+    aggregate host footprint stays under the operator's bound — when it
+    would not, the preemption falls back to the recompute path, loudly
+    counted (``kv_spill_total{outcome="over_budget"}``)."""
+
+    def __init__(self, budget_bytes: int) -> None:
+        self.budget_bytes = int(budget_bytes)
+        self._lock = threading.Lock()
+        self._bytes: Dict[str, int] = {}
+        self._used = 0
+
+    @property
+    def used_bytes(self) -> int:
+        with self._lock:
+            return self._used
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._bytes)
+
+    def _gauge(self) -> None:
+        REGISTRY.gauge("kv_spill_bytes").set(self._used)
+
+    def admit(self, rid: str, payload: Dict[str, Any]) -> bool:
+        """Charge ``payload``'s bytes to the pool. False = over budget
+        (the caller must take the recompute path instead)."""
+        n = payload_nbytes(payload)
+        with self._lock:
+            if rid in self._bytes:
+                # a re-spill of the same request replaces its charge
+                self._used -= self._bytes.pop(rid)
+            if self._used + n > self.budget_bytes:
+                self._gauge()
+                REGISTRY.counter("kv_spill_total",
+                                 labels={"outcome": "over_budget"}).inc()
+                return False
+            self._bytes[rid] = n
+            self._used += n
+            self._gauge()
+        REGISTRY.counter("kv_spill_total",
+                         labels={"outcome": "spilled"}).inc()
+        return True
+
+    def release(self, rid: str, outcome: str = "promoted") -> Optional[int]:
+        """Return a request's bytes to the budget (promotion back
+        on-device, or the job dying while spilled). None = not held."""
+        with self._lock:
+            n = self._bytes.pop(rid, None)
+            if n is None:
+                return None
+            self._used -= n
+            self._gauge()
+        REGISTRY.counter("kv_spill_total", labels={"outcome": outcome}).inc()
+        return n
+
+
+@dataclass
+class _TierEntry:
+    """One cached prefix run: the payload whose first ``depth`` pages
+    are addressable by the chain hashes ``hashes[0..depth-1]``."""
+
+    key: bytes                         # deepest chain hash == identity
+    hashes: Tuple[bytes, ...]          # h_0 .. h_{depth-1}
+    depth: int                         # full pages covered
+    tokens: int                        # depth * page_size (pricing basis)
+    payload: Optional[Dict[str, Any]]  # RAM copy; None = disk-resident only
+    nbytes: int = 0                    # RAM charge while retained
+    tenant: str = ""
+    slack_s: float = _SLACK_CAP_S      # contributor's SLO slack
+    linked_rid: str = ""               # live spill pinning this entry
+    refs: int = 0                      # checkout pins (promote in flight)
+    hits: int = 0
+    last_use: float = field(default_factory=time.monotonic)
+    disk_path: str = ""
+    disk_bytes: int = 0
+
+
+class PrefixKVTier(KVSpillPool):
+    """Prefix-addressed, refcounted, value-priced KV store (module doc).
+
+    Accounting: the rid registry (inherited) charges live spill payloads;
+    ``cached_bytes`` charges retained entries. ``used_bytes`` — the
+    budget the operator set — covers BOTH: retaining an entry moves its
+    charge from the rid row to the entry, it never doubles it."""
+
+    def __init__(self, budget_bytes: int,
+                 disk_budget_bytes: int = 0,
+                 perf_model: Any = None,
+                 disk_dir: Optional[str] = None,
+                 half_life_s: float = 300.0) -> None:
+        super().__init__(budget_bytes)
+        self._entries: Dict[bytes, _TierEntry] = {}
+        self._by_hash: Dict[bytes, Tuple[bytes, int]] = {}
+        self._rid_link: Dict[str, bytes] = {}
+        self._cached = 0
+        self._perf = perf_model
+        self._half_life_s = float(half_life_s)
+        # QoS composition hook: tenant -> overuse seconds (virtual-time
+        # lead). Entries from overusing tenants evict first.
+        self._victim_bias: Optional[Callable[[str], float]] = None
+        # disk tier (write-behind): ops drain on ONE background thread so
+        # file I/O never blocks the driver and never runs under _lock
+        self.disk_budget_bytes = int(disk_budget_bytes)
+        self._disk_used = 0
+        self._disk_dir = disk_dir
+        self._disk_q: "queue.Queue[Optional[tuple]]" = queue.Queue()
+        self._disk_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- accounting
+
+    @property
+    def used_bytes(self) -> int:
+        with self._lock:
+            return self._used + self._cached
+
+    @property
+    def cached_bytes(self) -> int:
+        """RAM bytes held by RETAINED entries (refcount cache), excluding
+        live rid-pinned spill payloads."""
+        with self._lock:
+            return self._cached
+
+    @property
+    def disk_used_bytes(self) -> int:
+        with self._lock:
+            return self._disk_used
+
+    def entries(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def live_refs(self) -> int:
+        """Open pins: checkout refs + live rid links — the fuzz harness
+        asserts this drains to zero (refcount conservation through
+        preemptions, chaos, and driver resets)."""
+        with self._lock:
+            return (sum(e.refs for e in self._entries.values())
+                    + len(self._rid_link))
+
+    def set_victim_bias(self, fn: Optional[Callable[[str], float]]) -> None:
+        self._victim_bias = fn
+
+    def _gauge(self) -> None:
+        REGISTRY.gauge("kv_spill_bytes").set(self._used)
+        REGISTRY.gauge("kv_tier_bytes").set(self._cached)
+        REGISTRY.gauge("kv_tier_entries").set(len(self._entries))
+        if self.disk_budget_bytes:
+            REGISTRY.gauge("kv_tier_disk_bytes").set(self._disk_used)
+
+    # --------------------------------------------------------------- pricing
+
+    def _recompute_cost(self, tokens: int) -> float:
+        """What re-prefilling ``tokens`` would cost: core/perfmodel's
+        prefill-seconds estimate when the chip's peaks are known, the
+        token count itself otherwise (an unknown denominator must never
+        make every entry worthless — relative ordering survives)."""
+        if self._perf is not None:
+            est = None
+            fn = getattr(self._perf, "prefill_seconds", None)
+            if fn is not None:
+                est = fn(tokens)
+            if est is not None:
+                return float(est)
+        return float(tokens)
+
+    def _score_locked(self, e: _TierEntry, now: float) -> float:
+        """Eviction value (lower evicts first): recompute cost × recency
+        decay × hit history, kept longer when the contributor had little
+        SLO slack, discounted by the contributing tenant's QoS overuse."""
+        value = self._recompute_cost(e.tokens)
+        age = max(0.0, now - e.last_use)
+        value *= max(0.5 ** (age / self._half_life_s), 1e-3)
+        value *= 1.0 + min(e.hits, 8)
+        slack = min(max(e.slack_s, 0.0), _SLACK_CAP_S)
+        value *= 1.0 + (_SLACK_CAP_S - slack) / _SLACK_CAP_S
+        bias = self._victim_bias
+        if bias is not None:
+            try:
+                value /= 1.0 + max(0.0, float(bias(e.tenant) or 0.0))
+            except Exception:   # tpulint: disable=except-swallow -- a pricing hook must never break eviction; the unbiased score is always safe
+                pass
+        return value
+
+    # -------------------------------------------------------------- eviction
+
+    def _drop_entry_locked(self, e: _TierEntry, outcome: str) -> None:
+        """Remove an entry's RAM presence; full removal when no disk copy
+        survives. NEVER called on a pinned entry — the callers filter."""
+        if e.payload is not None:
+            self._cached -= e.nbytes
+            e.payload = None
+            e.nbytes = 0
+        if e.disk_path and outcome != "evicted_disk":
+            REGISTRY.counter("kv_tier_total",
+                             labels={"outcome": "demoted"}).inc()
+            return   # demoted: the disk copy keeps the entry addressable
+        self._entries.pop(e.key, None)
+        for h in e.hashes:
+            ref = self._by_hash.get(h)
+            if ref is not None and ref[0] == e.key:
+                del self._by_hash[h]
+        if e.disk_path:
+            self._disk_used -= e.disk_bytes
+            self._disk_q.put(("del", e.disk_path))
+        REGISTRY.counter("kv_tier_total", labels={"outcome": outcome}).inc()
+
+    def _evict_for_locked(self, need: int) -> None:
+        """Value-priced eviction until ``need`` more bytes fit. Only
+        unpinned RAM-resident entries are candidates; an entry with a
+        checkout ref or a live rid link is untouchable by construction."""
+        now = time.monotonic()
+        while self._used + self._cached + need > self.budget_bytes:
+            cands = [e for e in self._entries.values()
+                     if e.refs == 0 and not e.linked_rid
+                     and e.payload is not None]
+            if not cands:
+                return
+            victim = min(cands, key=lambda e: self._score_locked(e, now))
+            self._drop_entry_locked(victim, "evicted")
+
+    # ------------------------------------------------------------ rid plane
+
+    def admit(self, rid: str, payload: Dict[str, Any]) -> bool:
+        """Charge a spilled payload, evicting retained cache first when
+        the budget demands it — live requests outrank history. False =
+        over budget even with every unpinned entry gone (recompute
+        fallback, same contract as the base pool)."""
+        n = payload_nbytes(payload)
+        stale_key: Optional[bytes] = None
+        with self._lock:
+            if rid in self._bytes:
+                self._used -= self._bytes.pop(rid)
+                # a re-spill replaces the payload the old entry shares —
+                # drop the stale entry rather than serve old arrays
+                stale_key = self._rid_link.pop(rid, None)
+            if stale_key is not None:
+                e = self._entries.get(stale_key)
+                if e is not None and e.linked_rid == rid:
+                    e.linked_rid = ""
+                    self._drop_entry_locked(e, "replaced")
+            self._evict_for_locked(n)
+            if self._used + self._cached + n > self.budget_bytes:
+                self._gauge()
+                REGISTRY.counter("kv_spill_total",
+                                 labels={"outcome": "over_budget"}).inc()
+                return False
+            self._bytes[rid] = n
+            self._used += n
+            self._gauge()
+        REGISTRY.counter("kv_spill_total",
+                         labels={"outcome": "spilled"}).inc()
+        return True
+
+    def release(self, rid: str, outcome: str = "promoted") -> Optional[int]:
+        """Release a rid's charge. Unlike the base pool, a linked tier
+        entry is RETAINED: its bytes move from the rid row to the cached
+        plane (no net change against the budget) and the entry becomes an
+        evictable, value-priced prefix — the returning-conversation hit."""
+        retained: Optional[_TierEntry] = None
+        with self._lock:
+            n = self._bytes.pop(rid, None)
+            if n is None:
+                return None
+            self._used -= n
+            key = self._rid_link.pop(rid, None)
+            if key is not None:
+                e = self._entries.get(key)
+                if e is not None and e.linked_rid == rid:
+                    e.linked_rid = ""
+                    if e.payload is not None:
+                        e.nbytes = payload_nbytes(e.payload)
+                        self._cached += e.nbytes
+                        e.last_use = time.monotonic()
+                        retained = e
+            self._gauge()
+        REGISTRY.counter("kv_spill_total", labels={"outcome": outcome}).inc()
+        if retained is not None:
+            REGISTRY.counter("kv_tier_total",
+                             labels={"outcome": "retained"}).inc()
+            if self.disk_budget_bytes > 0 and not retained.disk_path:
+                # write-behind: the disk copy is made AHEAD of eviction so
+                # a later RAM demotion is instant and lossless
+                self._ensure_disk_thread()
+                self._disk_q.put(("write", retained.key, retained.payload))
+        return n
+
+    # ----------------------------------------------------------- tier plane
+
+    def contribute(self, rid: str, hashes: Sequence[bytes],
+                   payload: Dict[str, Any], tokens: int,
+                   tenant: str = "",
+                   slack_s: Optional[float] = None) -> bool:
+        """Register a spilled payload's full-page prefix run under its
+        chain hashes. The entry shares the rid's payload arrays (zero
+        copy) and is pinned by the rid until :meth:`release`."""
+        if not hashes:
+            return False
+        key = bytes(hashes[-1])
+        with self._lock:
+            if rid not in self._bytes:
+                return False   # admit failed or raced a release
+            prev = self._entries.get(key)
+            if prev is not None:
+                if prev.linked_rid and prev.linked_rid != rid:
+                    return False   # pinned by another live spill
+                if prev.refs > 0:
+                    return False   # promote in flight reads its arrays
+                self._drop_entry_locked(prev, "replaced")
+            e = _TierEntry(
+                key=key,
+                hashes=tuple(bytes(h) for h in hashes),
+                depth=len(hashes),
+                tokens=int(tokens),
+                payload=payload,
+                tenant=str(tenant or ""),
+                slack_s=(_SLACK_CAP_S if slack_s is None
+                         else min(max(float(slack_s), 0.0), _SLACK_CAP_S)),
+                linked_rid=rid,
+            )
+            self._entries[key] = e
+            for i, h in enumerate(e.hashes):
+                self._by_hash[h] = (key, i + 1)
+            self._rid_link[rid] = key
+            self._gauge()
+        REGISTRY.counter("kv_tier_total",
+                         labels={"outcome": "contributed"}).inc()
+        return True
+
+    def probe(self, hashes: Sequence[bytes]
+              ) -> Optional[Tuple[bytes, int]]:
+        """Longest cached prefix of a prompt's chain hashes, deepest
+        first: ``(entry_key, covered_pages)`` or None. Read-only — the
+        caller promotes via :meth:`checkout`/:meth:`checkin`."""
+        if not hashes:
+            return None
+        with self._lock:
+            for i in range(len(hashes) - 1, -1, -1):
+                ref = self._by_hash.get(bytes(hashes[i]))
+                if ref is None:
+                    continue
+                key, depth = ref
+                e = self._entries.get(key)
+                if e is None or (e.payload is None and not e.disk_path):
+                    continue
+                REGISTRY.counter("kv_tier_probe_total",
+                                 labels={"outcome": "hit"}).inc()
+                return key, depth
+        REGISTRY.counter("kv_tier_probe_total",
+                         labels={"outcome": "miss"}).inc()
+        return None
+
+    def checkout(self, key: bytes) -> Optional[Dict[str, Any]]:
+        """Pin an entry for a promote and return its payload (RAM, or a
+        one-shot disk load — the crc32-framed file either decodes exactly
+        or fails loudly and the entry dies). None = evicted since the
+        probe, or the disk copy is corrupt; the caller re-prefills. Pair
+        every non-None return with :meth:`checkin`."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return None
+            e.refs += 1
+            e.hits += 1
+            e.last_use = time.monotonic()
+            payload = e.payload
+            path = e.disk_path
+        if payload is not None:
+            return payload
+        # disk load: blocking file I/O OUTSIDE the lock. The driver pays
+        # one read per promote — comparable to the fetch=True export the
+        # spill already does, and strictly cheaper than the re-prefill
+        # this load avoids.
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+            payload = kv_wire.decode_kv_frames(data)
+        except Exception as exc:
+            # corruption is LOUD and terminal for the entry: a bad frame
+            # must become a re-prefill, never served garbage KV
+            logger.warning("kv tier disk entry %s unreadable (%s); "
+                           "dropping", path, exc)
+            REGISTRY.counter("kv_tier_total",
+                             labels={"outcome": "disk_corrupt"}).inc()
+            with self._lock:
+                e = self._entries.get(key)
+                if e is not None:
+                    e.refs = max(0, e.refs - 1)
+                    if e.refs == 0 and not e.linked_rid:
+                        self._drop_entry_locked(e, "evicted_disk")
+                self._gauge()
+            return None
+        REGISTRY.counter("kv_tier_total",
+                         labels={"outcome": "disk_load"}).inc()
+        return payload
+
+    def checkin(self, key: bytes) -> None:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                e.refs = max(0, e.refs - 1)
+
+    # ---------------------------------------------------------- fleet advert
+
+    def hot_stats(self, k: Optional[int] = None) -> Dict[str, Any]:
+        """The /health piggyback: tier occupancy + the top-K hottest
+        entries' h₀ hex digests (the shareable OPENING page — what a
+        router-side conversation key can actually match)."""
+        k = tier_hot_k() if k is None else int(k)
+        with self._lock:
+            now = time.monotonic()
+            live = [e for e in self._entries.values()
+                    if e.payload is not None or e.disk_path]
+            live.sort(key=lambda e: self._score_locked(e, now), reverse=True)
+            hot: List[str] = []
+            for e in live:
+                h0 = e.hashes[0].hex()
+                if h0 not in hot:
+                    hot.append(h0)
+                if len(hot) >= k:
+                    break
+            return {
+                "kv_tier_bytes": self._cached,
+                "kv_tier_entries": len(self._entries),
+                "kv_tier_disk_bytes": self._disk_used,
+                "kv_tier_hot": hot,
+            }
+
+    # ------------------------------------------------------------- disk tier
+
+    def _ensure_disk_thread(self) -> None:
+        if self._disk_thread is not None and self._disk_thread.is_alive():
+            return
+        self._disk_thread = threading.Thread(target=self._disk_loop,
+                                             name="kv-tier-disk",
+                                             daemon=True)
+        self._disk_thread.start()
+
+    def _disk_dir_path(self) -> str:
+        if self._disk_dir is None:
+            self._disk_dir = os.environ.get("APP_KV_TIER_DISK_DIR", "") or \
+                os.path.join(tempfile.gettempdir(),
+                             f"gaix_kv_tier_{os.getpid()}")
+        os.makedirs(self._disk_dir, exist_ok=True)
+        return self._disk_dir
+
+    def _disk_loop(self) -> None:
+        """Write-behind drain: encode + write crc32-framed files, then
+        publish the path under the lock. All file I/O lives here — never
+        under ``_lock``, never on the driver thread."""
+        while True:
+            op = self._disk_q.get()
+            if op is None:
+                return
+            try:
+                if op[0] == "del":
+                    try:
+                        os.remove(op[1])
+                    except OSError:
+                        pass
+                    continue
+                _, key, payload = op
+                data = kv_wire.encode_kv_frames(payload)
+                path = os.path.join(self._disk_dir_path(),
+                                    f"{key.hex()}.kvw")
+                tmp = f"{path}.{os.getpid()}.tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path)
+                dead: List[str] = []
+                with self._lock:
+                    e = self._entries.get(key)
+                    if e is None:
+                        dead.append(path)
+                    else:
+                        e.disk_path = path
+                        e.disk_bytes = len(data)
+                        self._disk_used += len(data)
+                        dead = self._enforce_disk_budget_locked()
+                    self._gauge()
+                for p in dead:
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
+                REGISTRY.counter("kv_tier_total",
+                                 labels={"outcome": "disk_write"}).inc()
+            except Exception:
+                logger.exception("kv tier disk write-behind failed")
+
+    def _enforce_disk_budget_locked(self) -> List[str]:
+        """Delete lowest-value disk copies past the disk budget; returns
+        the file paths for the CALLER to remove outside the lock."""
+        dead: List[str] = []
+        now = time.monotonic()
+        while self._disk_used > self.disk_budget_bytes:
+            cands = [e for e in self._entries.values()
+                     if e.disk_path and e.refs == 0 and not e.linked_rid]
+            if not cands:
+                break
+            victim = min(cands, key=lambda e: self._score_locked(e, now))
+            dead.append(victim.disk_path)
+            self._disk_used -= victim.disk_bytes
+            victim.disk_path = ""
+            victim.disk_bytes = 0
+            if victim.payload is None:
+                self._drop_entry_locked(victim, "evicted_disk")
+        return dead
+
+    def drain_disk(self, timeout_s: float = 5.0) -> None:
+        """Block until queued write-behind ops have drained (tests)."""
+        deadline = time.monotonic() + timeout_s
+        while not self._disk_q.empty() and time.monotonic() < deadline:
+            time.sleep(0.01)

@@ -551,6 +551,19 @@ class QosPolicy:
 
         return max(jobs, key=score)
 
+    def tenant_overuse_s(self, tenant: str) -> float:
+        """How far AHEAD of the global virtual clock a tenant is running
+        (seconds of weighted service beyond its fair share; 0.0 for
+        tenants at or behind the clock). The same overuse signal
+        :meth:`pick_victim` ranks on, exported so the prefix KV tier's
+        eviction pricing (engine/kv_tier.py) can compose with it: cached
+        prefixes contributed by a flooding tenant evict first, exactly
+        as that tenant's live jobs spill first."""
+        t = self.canonical(tenant)
+        with self._lock:
+            return max(0.0, self._vtime.get(t, self._global_v)
+                       - self._global_v)
+
     # ----------------------------------------------------------- reporting
 
     def snapshot(self) -> Dict[str, Any]:

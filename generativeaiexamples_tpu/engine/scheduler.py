@@ -54,7 +54,8 @@ from generativeaiexamples_tpu.engine.engine import (
     DecodeState, EngineCore, bits_to_f32, unpack_decode_out)
 from generativeaiexamples_tpu.engine import qos as qos_mod
 from generativeaiexamples_tpu.engine.prefix_cache import chain_hashes
-from generativeaiexamples_tpu.engine.spill import KVSpillPool, spill_budget_bytes
+from generativeaiexamples_tpu.engine.kv_tier import (
+    KVSpillPool, PrefixKVTier, spill_budget_bytes, tier_disk_bytes, tier_mode)
 from generativeaiexamples_tpu.engine.tokenizer import IncrementalDetokenizer, Tokenizer
 
 logger = logging.getLogger(__name__)
@@ -193,6 +194,11 @@ class Request:
     spill_resumes: int = 0
     snapshot_resumes: int = 0
     prefix_hit_tokens: int = 0
+    # prompt tokens whose KV was promoted from the prefix-addressed host
+    # tier (engine/kv_tier.py) at admission — a subset of
+    # prefix_hit_tokens (the tier hit also counts as a prefix hit; the
+    # split tells the flight recorder WHERE the hit was served from)
+    tier_hit_tokens: int = 0
     completion_tokens: int = 0
     error: Optional[str] = None
     # why generation ended — "eos" (model emitted EOS), "stop" (a stop
@@ -246,6 +252,11 @@ class _Job:
     # (_admit_spilled) instead of re-prefilling — the job keeps its live
     # detok/stop/grammar state, only the KV pages moved
     spill: Optional[dict] = None
+    # prefix-tier promotion plan from _plan_admission: (entry_key,
+    # covered_tokens) — _admit imports the covered pages from the host
+    # tier (engine/kv_tier.py) and the chunked prefill starts at the
+    # boundary. Recomputed every admission pass; never survives a plan.
+    tier_plan: Optional[tuple] = None
     # trailing acceptance EMA (drafts accepted per widened step) — the
     # adaptive spec-width controller's per-slot signal; seeded from the
     # scheduler-global EMA at admission so fresh slots start where the
@@ -351,10 +362,25 @@ class Scheduler:
         # armed, page-exhaust preemption demotes the victim's pages to
         # host RAM instead of freeing-and-recomputing them; 0 = off.
         budget = spill_budget_bytes(getattr(core, "cfg", None))
-        self._spill: Optional[KVSpillPool] = (
-            KVSpillPool(budget) if budget > 0
-            and hasattr(core, "export_slot_kv")
-            and hasattr(core, "import_slot_kv") else None)
+        self._spill: Optional[KVSpillPool] = None
+        self._tier: Optional[PrefixKVTier] = None
+        if (budget > 0 and hasattr(core, "export_slot_kv")
+                and hasattr(core, "import_slot_kv")):
+            if (tier_mode(getattr(core, "cfg", None)) == "prefix"
+                    and hasattr(core, "import_pages_kv")):
+                # prefix-addressed tier (engine/kv_tier.py): the spill
+                # pool grows retention, hash addressing, and value-priced
+                # eviction; _plan_admission probes it for every prompt
+                self._tier = PrefixKVTier(
+                    budget,
+                    disk_budget_bytes=tier_disk_bytes(
+                        getattr(core, "cfg", None)),
+                    perf_model=getattr(core, "perf_model", None))
+                self._spill = self._tier
+            else:
+                # off (default): the request-keyed pool, byte-identical
+                # to pre-tier spill behavior — zero tier code on any path
+                self._spill = KVSpillPool(budget)
         # QoS admission plane (engine/qos.py, APP_QOS=off|fair): None in
         # off mode — the admission path then runs the exact pre-QoS FIFO
         # walk with zero qos calls (the APP_CHAOS/APP_DEVTIME
@@ -366,6 +392,11 @@ class Scheduler:
             getattr(core, "cfg", None),
             perf_model=getattr(core, "perf_model", None),
             batch_hint=int(getattr(core, "batch", 1) or 1))
+        if self._tier is not None and self._qos is not None:
+            # compose tier eviction with the QoS victim doctrine: cached
+            # prefixes contributed by an overusing tenant evict first,
+            # exactly as that tenant's live jobs spill first (PR 15)
+            self._tier.set_victim_bias(self._qos.tenant_overuse_s)
         # live-migration evacuation (drain/SIGTERM/watchdog-trip): callers
         # queue a request, the DRIVER thread (owner of _state) performs it
         # inside _tick, parking each live slot's mid-decode snapshot in the
@@ -513,7 +544,7 @@ class Scheduler:
         prompted = REGISTRY.counter("prefix_prompt_tokens").value
         hit_frac = round(hits / prompted, 4) if prompted else 0.0
         REGISTRY.gauge("prefix_hit_frac").set(hit_frac)
-        return {
+        out = {
             "engine_role": self._role,
             "running": len(self._slots),
             "prefilling": len(self._prefilling),
@@ -522,7 +553,34 @@ class Scheduler:
             "kv_pages_free": int(getattr(self._alloc, "available", 0)),
             "inflight_dispatches": len(self._inflight),
             "prefix_hit_frac": hit_frac,
+            # host spill/tier occupancy: the router must see a replica's
+            # host-RAM headroom BEFORE routing preemption-heavy load at it
+            "kv_spill_used_bytes": (self._spill.used_bytes
+                                    if self._spill is not None else 0),
+            "kv_spill_budget_bytes": (self._spill.budget_bytes
+                                      if self._spill is not None else 0),
         }
+        if self._tier is not None:
+            # fleet hotset advert: tier occupancy + the top-K hottest h0
+            # hashes — what the router's promote routing matches against
+            out.update(self._tier.hot_stats())
+        return out
+
+    def prefix_key_hex(self, prompt_ids: Sequence[int],
+                       adapter: str = "") -> str:
+        """h0 — the chain hash of a prompt's FIRST full page under this
+        scheduler's cache seed: the identity the fleet hotset protocol
+        advertises (load_stats) and the router learns from the
+        ``X-KV-Prefix`` response header. "" when the tier is off or the
+        prompt doesn't cover one page (nothing shareable to advertise)."""
+        if self._tier is None:
+            return ""
+        ps = int(self.core.page_size)
+        if len(prompt_ids) < ps:
+            return ""
+        hs = chain_hashes([int(t) for t in prompt_ids[:ps]], ps,
+                          seed=f"{self._cache_seed}|{adapter}")
+        return hs[0].hex() if hs else ""
 
     def iter_text(self, request: Request) -> Iterator[str]:
         """Blocking iterator over the request's text deltas."""
@@ -759,8 +817,9 @@ class Scheduler:
         prefill pass skip reuse unless the cache covers most of the prompt
         — one ring pass beats re-chunking a nearly-uncovered prompt."""
         n = len(job.ids)
+        job.tier_plan = None
         if job.preload is not None or job.spill is not None \
-                or not self._caching:
+                or (not self._caching and self._tier is None):
             # handoff/spill imports SCATTER into their pages — they must
             # never be served shared (refcounted) prefix-cache pages, which
             # other requests may be reading; always allocate fresh
@@ -773,7 +832,7 @@ class Scheduler:
                 job.ids, self.core.page_size,
                 seed=f"{self._cache_seed}|{job.request.adapter}")
             job.hashed_len = n
-        hits = self._alloc.match(job.page_hashes)
+        hits = self._alloc.match(job.page_hashes) if self._caching else []
         shared = self._cap_shared(n, len(hits) * self.core.page_size)
         if (shared and job.request.grammar is None
                 and not job.request.adapter
@@ -781,6 +840,27 @@ class Scheduler:
                 and self.core.supports_long_prefill
                 and n - shared > 4 * self.core.chunk):
             shared = 0
+        if self._tier is not None:
+            # prefix-tier probe: when the host tier covers MORE of the
+            # prompt than the device cache, plan a promotion — fresh
+            # pages for the whole prompt (imports scatter, same rule as
+            # handoff/spill above), the covered span imported from host,
+            # the chunk walk starting at the boundary. Same long-prefill
+            # guard as the device path: one ring pass beats importing a
+            # sliver of a long prompt.
+            hit = self._tier.probe(job.page_hashes)
+            if hit is not None:
+                key, depth = hit
+                covered = self._cap_shared(n, depth * self.core.page_size)
+                if (covered and job.request.grammar is None
+                        and not job.request.adapter
+                        and self.core.cfg.long_prefill != "off"
+                        and self.core.supports_long_prefill
+                        and n - covered > 4 * self.core.chunk):
+                    covered = 0
+                if covered > shared:
+                    job.tier_plan = (key, covered)
+                    return self.core.pages_for(n), 0, []
         hits = hits[: shared // self.core.page_size]
         return self.core.pages_for(n) - len(hits), shared, hits
 
@@ -1023,7 +1103,7 @@ class Scheduler:
             job.shared = shared
             if job.request.admitted_at is None:
                 job.request.admitted_at = time.perf_counter()
-            if self._caching:
+            if self._caching or self._tier is not None:
                 if shared:
                     job.request.prefix_hit_tokens += shared
                     REGISTRY.counter("prefix_hit_tokens").inc(shared)
@@ -1052,6 +1132,8 @@ class Scheduler:
                 self._admit_prefilled(job)
             elif job.spill is not None:
                 self._admit_spilled(job)
+            elif job.tier_plan is not None:
+                self._admit_tier(job)
             else:
                 self._prefilling.append(job)
 
@@ -1141,6 +1223,61 @@ class Scheduler:
         if not alive:
             req.finish_reason = "length"
             self._finish(job)
+
+    def _admit_tier(self, job: _Job) -> None:   # tpulint: hot-path
+        """Prefix-tier promotion at admission (engine/kv_tier.py): import
+        the cached prefix run into the job's freshly allocated pages (a
+        partial page scatter — no slot state) and start the chunk walk at
+        the covered boundary. Zero prefill programs over the covered
+        span; the tail prefills exactly as a fresh admission, so the
+        stream is token-identical to an uncached run by construction.
+        Every failure mode (entry evicted since the plan, corrupt disk
+        copy, geometry mismatch) falls back to a plain full prefill on
+        the same pages — the tier can only ever SAVE work."""
+        key, covered = job.tier_plan
+        job.tier_plan = None
+        req = job.request
+        tier = self._tier
+        payload = tier.checkout(key) if tier is not None else None
+        if payload is None:
+            self._prefilling.append(job)
+            return
+        now = time.perf_counter()
+        n_imp = covered // self.core.page_size
+        try:
+            self._state = self.core.import_pages_kv(
+                self._state, job.pages, payload, n_pages=n_imp)
+        except Exception as exc:
+            logger.warning("kv tier promote failed for %s (%s); "
+                           "re-prefilling", req.request_id, exc)
+            REGISTRY.counter("kv_tier_total",
+                             labels={"outcome": "import_failed"}).inc()
+            tier.checkin(key)
+            self._prefilling.append(job)
+            return
+        tier.checkin(key)
+        job.prefilled = covered
+        job.total_len = covered
+        job.shared = covered
+        # the import dispatch is async; retain=False as in _admit_prefilled
+        # (the NEXT dispatch donates the state away)
+        pb = min(pow2_bucket(max(1, n_imp)),
+                 int(getattr(self.core, "max_pages_per_slot", 1 << 30)))
+        DEVTIME.commit("kv_import", f"p{pb}", self._state.tokens, t0=now,
+                       tokens=covered, mfu=False, retain=False)
+        req.kv_import_s = round(time.perf_counter() - now, 6)
+        req.tier_hit_tokens += covered
+        req.prefix_hit_tokens += covered
+        REGISTRY.counter("prefix_hit_tokens").inc(covered)
+        REGISTRY.counter("kv_tier_hit_tokens").inc(covered)
+        REGISTRY.counter("kv_tier_total",
+                         labels={"outcome": "promoted"}).inc()
+        if self._spec_w > 1 and hasattr(self.core, "seed_history"):
+            # promoted pages skip prefill dispatches, so the drafting
+            # history row must be seeded explicitly (as for cache hits)
+            self._state = self.core.seed_history(self._state, job.slot,
+                                                 job.ids)
+        self._prefilling.append(job)
 
     def _resume_stream_state(self, job: _Job, payload: dict, first: int,
                              alive: bool) -> None:
@@ -1776,6 +1913,8 @@ class Scheduler:
             return False
         if not self._spill.admit(req.request_id, payload):
             return False   # over APP_KV_SPILL_MB: recompute fallback
+        if self._tier is not None:
+            self._tier_contribute(job, payload)
         job.spill = payload
         del self._slots[job.slot]
         self._state = self.core.release(self._state, job.slot)
@@ -1796,6 +1935,28 @@ class Scheduler:
                     "host)", req.request_id, len(job.gen_ids),
                     self._spill.used_bytes)
         return True
+
+    def _tier_contribute(self, job: _Job, payload: dict) -> None:
+        """Register a freshly spilled payload's full-page prefix run in
+        the prefix tier (engine/kv_tier.py) under its chain hashes: the
+        spill registry pins the entry while the spill is live; after the
+        rid releases it stays behind as value-priced cache, so FUTURE
+        requests sharing the prefix promote instead of re-prefilling.
+        Hashes run over the WRITTEN context (prompt + fed-back generated
+        tokens) — a returning conversation's next turn extends exactly
+        that sequence."""
+        req = job.request
+        ids = payload.get("prompt_ids") or []
+        ps = self.core.page_size
+        hashes = chain_hashes([int(t) for t in ids], ps,
+                              seed=f"{self._cache_seed}|{req.adapter}")
+        depth = min(len(hashes), int(payload.get("n_pages", 0)))
+        if depth <= 0:
+            return
+        self._tier.contribute(
+            req.request_id, hashes[:depth], payload, tokens=depth * ps,
+            tenant=str(getattr(req, "tenant", "") or ""),
+            slack_s=qos_mod.request_remaining_s(req))
 
     def _admit_spilled(self, job: _Job) -> None:   # tpulint: hot-path
         """Promotion: re-import a spilled job's pages into its freshly

@@ -672,10 +672,17 @@ class ModelServer:
                     for key, value in flight_mod.timeline_attributes(
                             req).items():
                         span.set_attribute(key, value)
+                headers = {"X-Request-Id": req.request_id}
+                pk = getattr(self.scheduler, "prefix_key_hex", None)
+                h0 = pk(prompt_ids) if pk else ""
+                if h0:
+                    # disagg routes learn the prefix identity too — the
+                    # router's promote routing is wire-agnostic
+                    headers["X-KV-Prefix"] = h0
                 return web.Response(
                     body=payload_body,
                     content_type=ctype,
-                    headers={"X-Request-Id": req.request_id})
+                    headers=headers)
 
     async def kv_handoff(self, request: web.Request) -> web.StreamResponse:
         """Import a /v1/kv/prefill payload into this worker's pool and
@@ -870,6 +877,12 @@ class ModelServer:
         # the scheduler id is the /debug/requests/<id> lookup key; expose it
         # on every response as X-Request-Id (span envelope reads it too)
         request["engine_request"] = req
+        # fleet prefix-tier identity (engine/kv_tier.py): the opening-page
+        # chain hash rides the response as X-KV-Prefix so the router can
+        # learn which token-hash prefix this conversation maps to and
+        # route its next turn to a replica advertising it ("" = tier off)
+        pk = getattr(self.scheduler, "prefix_key_hex", None)
+        request["kv_prefix_h0"] = pk(prompt_ids, adapter) if pk else ""
         rid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
         stream = bool(body.get("stream", False))
         for r in reqs:
@@ -946,8 +959,10 @@ class ModelServer:
             errs = [r.error for r in reqs if r.error]
             if errs:
                 payload["error"] = "; ".join(errs)
-            return web.json_response(
-                payload, headers={"X-Request-Id": req.request_id})
+            headers = {"X-Request-Id": req.request_id}
+            if request.get("kv_prefix_h0"):
+                headers["X-KV-Prefix"] = request["kv_prefix_h0"]
+            return web.json_response(payload, headers=headers)
 
         resp = await self._sse_response(request)
         if chat:
@@ -1104,6 +1119,10 @@ class ModelServer:
         req = request.get("engine_request")
         if req is not None:
             headers["X-Request-Id"] = req.request_id
+        if request.get("kv_prefix_h0"):
+            # the router learns conversation -> prefix-hash from this
+            # (server/failover.py promote routing, engine/kv_tier.py)
+            headers["X-KV-Prefix"] = request["kv_prefix_h0"]
         resp = web.StreamResponse(headers=headers)
         await resp.prepare(request)
         return resp

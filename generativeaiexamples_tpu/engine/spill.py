@@ -1,108 +1,30 @@
-"""Bounded host-RAM spill pool for preempted KV pages (ROADMAP item 3).
+"""Compatibility shim — the spill pool moved to ``engine/kv_tier.py``.
 
-Page-exhaust preemption used to be recompute-style: free the victim's
-pages, re-queue prompt + generated tokens, and re-prefill the whole
-context when pages free again. With a spill pool armed
-(``APP_KV_SPILL_MB`` / ``EngineConfig.kv_spill_mb``), the scheduler
-instead demotes the victim slot's live pages to host RAM (one
-device→host transfer via ``export_slot_kv(fetch=True)``) and promotes
-them back with ``import_slot_kv`` at re-admission — zero prefill
-programs, token-identical by construction (the snapshot carries the
-sampling seed + position, and the per-position ``fold_in`` keys make
-resumed decode bit-equal to uninterrupted decode).
-
-This pool is the accounting half: a byte-budgeted registry of spilled
-payloads keyed by request id. The payload arrays themselves ride the
-``_Job`` (the scheduler owns their lifecycle); the pool guarantees the
-aggregate host footprint stays under the operator's bound — when it
-would not, the preemption falls back to the recompute path, loudly
-counted (``kv_spill_total{outcome="over_budget"}``). The live footprint
-is the ``kv_spill_bytes`` gauge.
+PR 14's bounded host-RAM spill pool (request-keyed) grew into the
+prefix-addressed KV tier (ROADMAP item 2): ``kv_tier.KVSpillPool`` is
+the identical request-keyed pool (``APP_KV_TIER=off``, the default),
+``kv_tier.PrefixKVTier`` is the prefix-hash-keyed, refcounted,
+value-priced store layered on top of it. This module keeps the old
+import path alive for external callers; new code imports from
+``generativeaiexamples_tpu.engine.kv_tier`` directly.
 """
 
 from __future__ import annotations
 
-import os
-import threading
-from typing import Any, Dict, Optional
+from generativeaiexamples_tpu.engine.kv_tier import (  # noqa: F401
+    KVSpillPool,
+    PrefixKVTier,
+    payload_nbytes,
+    spill_budget_bytes,
+    tier_disk_bytes,
+    tier_mode,
+)
 
-from generativeaiexamples_tpu.core.metrics import REGISTRY
-
-
-def payload_nbytes(payload: Dict[str, Any]) -> int:
-    """Host bytes a spilled handoff payload occupies (array segments;
-    scalar passthrough is noise next to the KV pages)."""
-    total = 0
-    for key in ("k", "v", "k_s", "v_s"):
-        arr = payload.get(key)
-        if arr is not None:
-            total += int(getattr(arr, "nbytes", 0))
-    return total
-
-
-def spill_budget_bytes(cfg: Any = None) -> int:
-    """Resolve the spill budget: the bare env ``APP_KV_SPILL_MB`` wins
-    (the knob the issue/docs name), else ``EngineConfig.kv_spill_mb``,
-    else 0 (spill off — preemption recomputes, the pre-r07 behavior)."""
-    raw = os.environ.get("APP_KV_SPILL_MB", "").strip()
-    if raw:
-        try:
-            return max(0, int(float(raw))) * (1 << 20)
-        except ValueError:
-            pass
-    mb = int(getattr(cfg, "kv_spill_mb", 0) or 0)
-    return max(0, mb) * (1 << 20)
-
-
-class KVSpillPool:
-    """Byte-budgeted registry of spilled KV payloads (one per request)."""
-
-    def __init__(self, budget_bytes: int) -> None:
-        self.budget_bytes = int(budget_bytes)
-        self._lock = threading.Lock()
-        self._bytes: Dict[str, int] = {}
-        self._used = 0
-
-    @property
-    def used_bytes(self) -> int:
-        with self._lock:
-            return self._used
-
-    def __len__(self) -> int:
-        with self._lock:
-            return len(self._bytes)
-
-    def _gauge(self) -> None:
-        REGISTRY.gauge("kv_spill_bytes").set(self._used)
-
-    def admit(self, rid: str, payload: Dict[str, Any]) -> bool:
-        """Charge ``payload``'s bytes to the pool. False = over budget
-        (the caller must take the recompute path instead)."""
-        n = payload_nbytes(payload)
-        with self._lock:
-            if rid in self._bytes:
-                # a re-spill of the same request replaces its charge
-                self._used -= self._bytes.pop(rid)
-            if self._used + n > self.budget_bytes:
-                self._gauge()
-                REGISTRY.counter("kv_spill_total",
-                                 labels={"outcome": "over_budget"}).inc()
-                return False
-            self._bytes[rid] = n
-            self._used += n
-            self._gauge()
-        REGISTRY.counter("kv_spill_total",
-                         labels={"outcome": "spilled"}).inc()
-        return True
-
-    def release(self, rid: str, outcome: str = "promoted") -> Optional[int]:
-        """Return a request's bytes to the budget (promotion back
-        on-device, or the job dying while spilled). None = not held."""
-        with self._lock:
-            n = self._bytes.pop(rid, None)
-            if n is None:
-                return None
-            self._used -= n
-            self._gauge()
-        REGISTRY.counter("kv_spill_total", labels={"outcome": outcome}).inc()
-        return n
+__all__ = [
+    "KVSpillPool",
+    "PrefixKVTier",
+    "payload_nbytes",
+    "spill_budget_bytes",
+    "tier_disk_bytes",
+    "tier_mode",
+]

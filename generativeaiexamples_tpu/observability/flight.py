@@ -220,6 +220,9 @@ def timeline(req: Any) -> Dict[str, Any]:
         "spill_resumes": getattr(req, "spill_resumes", 0),
         "snapshot_resumes": getattr(req, "snapshot_resumes", 0),
         "prefix_hit_tokens": getattr(req, "prefix_hit_tokens", 0),
+        # prefix-tier split (engine/kv_tier.py): how many of the prefix
+        # hits were promoted from the HOST tier (vs device prefix cache)
+        "tier_hit_tokens": getattr(req, "tier_hit_tokens", 0),
         "completion_tokens": getattr(req, "completion_tokens", 0),
         "prompt_tokens": len(getattr(req, "prompt_ids", []) or []),
         "finish": getattr(req, "finish_reason", None),
@@ -257,6 +260,7 @@ def timeline_attributes(req: Any) -> Dict[str, Any]:
         "request.id": rec["request_id"],
         "request.preemptions": rec["preemptions"],
         "request.prefix_hit_tokens": rec["prefix_hit_tokens"],
+        "request.tier_hit_tokens": rec["tier_hit_tokens"],
         "request.completion_tokens": rec["completion_tokens"],
         "request.finish": rec["finish"] or (rec["error"] and "error") or "",
     }

@@ -49,10 +49,12 @@ import threading
 import time
 import urllib.request
 import uuid
+from collections import OrderedDict
 from typing import Dict, Iterator, List, Optional, Sequence
 
 from generativeaiexamples_tpu.core import kv_wire as kv_wire_mod
 from generativeaiexamples_tpu.core.config import env_float as _env_float
+from generativeaiexamples_tpu.core.config import env_int as _env_int
 from generativeaiexamples_tpu.core.config import http_timeout
 from generativeaiexamples_tpu.core.metrics import REGISTRY
 from generativeaiexamples_tpu.observability import chaos as chaos_mod
@@ -94,7 +96,9 @@ def current_router() -> Optional["FailoverLLM"]:
 # the cache / burns the chip" without scraping N workers
 _FLEET_GAUGE_FIELDS = ("occupancy", "prefix_hit_frac", "mfu",
                        "hbm_read_util", "padding_waste_frac", "recompiles",
-                       "waiting", "kv_pages_free")
+                       "waiting", "kv_pages_free", "kv_spill_used_bytes",
+                       "kv_spill_budget_bytes", "kv_tier_bytes",
+                       "kv_tier_entries")
 
 _PRESSURE_GAUGE = {"ok": 0, "warn": 1, "critical": 2}
 # least-loaded scoring: an alive-but-burning worker yields to a healthy one
@@ -137,6 +141,16 @@ class _Worker:
         # probes this pool already makes — /debug/fleet aggregates these
         self.kv_pages_free = 0
         self.prefix_hit_frac = 0.0
+        # host spill/prefix-tier occupancy (engine/kv_tier.py): budget
+        # headroom rides every probe so capacity is visible BEFORE the
+        # router sends preemption-heavy load; kv_tier_hot is the worker's
+        # advertised hottest prefix hashes (h0 hex) — what promote
+        # routing matches a learned conversation hash against
+        self.kv_spill_used_bytes = 0
+        self.kv_spill_budget_bytes = 0
+        self.kv_tier_bytes = 0
+        self.kv_tier_entries = 0
+        self.kv_tier_hot: frozenset = frozenset()
         # KV-wire capability advert (engine/server.py health): True once
         # the worker declares it accepts the binary frame on
         # /v1/kv/handoff. Workers predating the binary wire carry no
@@ -168,6 +182,19 @@ class _Worker:
                             body.get("kv_pages_free", 0) or 0)
                         self.prefix_hit_frac = float(
                             body.get("prefix_hit_frac", 0.0) or 0.0)
+                        self.kv_spill_used_bytes = int(
+                            body.get("kv_spill_used_bytes", 0) or 0)
+                        self.kv_spill_budget_bytes = int(
+                            body.get("kv_spill_budget_bytes", 0) or 0)
+                        self.kv_tier_bytes = int(
+                            body.get("kv_tier_bytes", 0) or 0)
+                        self.kv_tier_entries = int(
+                            body.get("kv_tier_entries", 0) or 0)
+                        hot = body.get("kv_tier_hot")
+                        self.kv_tier_hot = (
+                            frozenset(str(h) for h in hot)
+                            if isinstance(hot, (list, tuple))
+                            else frozenset())
                         wire = body.get("kv_wire")
                         self.kv_binary = (isinstance(wire, (list, tuple))
                                           and "binary" in wire)
@@ -229,6 +256,11 @@ class _Worker:
             "batch": self.batch,
             "kv_pages_free": self.kv_pages_free,
             "prefix_hit_frac": self.prefix_hit_frac,
+            "kv_spill_used_bytes": self.kv_spill_used_bytes,
+            "kv_spill_budget_bytes": self.kv_spill_budget_bytes,
+            "kv_tier_bytes": self.kv_tier_bytes,
+            "kv_tier_entries": self.kv_tier_entries,
+            "kv_tier_hot": sorted(self.kv_tier_hot),
             "slo_pressure": self.slo_pressure,
             "dispatched": self.total_dispatched,
             "watchdog": self.watchdog,
@@ -372,6 +404,14 @@ class FailoverLLM:
         # concurrent chat threads; health probes stay outside it (HTTP
         # under a lock is a tpulint-enforced hazard)
         self._lock = threading.Lock()
+        # conversation -> prefix-hash map for promote routing (engine/
+        # kv_tier.py fleet loop): the affinity key of a dispatched chat
+        # maps to the h0 hash the serving worker stamped on X-KV-Prefix;
+        # the next turn of that conversation can then be matched against
+        # workers' advertised kv_tier_hot sets. Bounded LRU — the router
+        # must never grow state per conversation without bound.
+        self._prefix_hot: "OrderedDict[str, str]" = OrderedDict()
+        self._prefix_hot_cap = _env_int("APP_ROUTER_PREFIX_MAP_CAP", 4096)
         # the fleet view (GET /debug/fleet) answers from this router
         register_router(self)
 
@@ -497,6 +537,20 @@ class FailoverLLM:
                    key=lambda w: hashlib.blake2b(
                        f"{key}|{w.url}".encode(), digest_size=8).digest())
 
+    def _learn_prefix(self, affinity_key: str, h0: str) -> None:
+        """Record which token-hash prefix (h0, from the worker's
+        X-KV-Prefix response header) a conversation's affinity key maps
+        to — promote routing consults this on the conversation's NEXT
+        turn. Bounded LRU; empty header (tier off worker-side) learns
+        nothing."""
+        if not affinity_key or not h0:
+            return
+        with self._lock:
+            self._prefix_hot[affinity_key] = h0
+            self._prefix_hot.move_to_end(affinity_key)
+            while len(self._prefix_hot) > self._prefix_hot_cap:
+                self._prefix_hot.popitem(last=False)
+
     def _pick(self, roles: Sequence[str],
               exclude: Sequence[str] = (),
               charge: bool = True,
@@ -564,22 +618,45 @@ class FailoverLLM:
         if not up:
             return None
         affinity_outcome = ""
+        route_outcome = ""
         with self._lock:
             best = min(up, key=lambda w: w.score)
             if affinity_key and len(up) > 1:
                 pref = self._rendezvous(affinity_key, up)
                 slack = self.affinity_slack * (1.0 + pref.prefix_hit_frac)
-                if pref.score <= best.score + slack:
+                # prefix-tier promote routing (engine/kv_tier.py fleet
+                # loop): when this conversation's learned token-hash
+                # prefix is advertised hot by a replica OTHER than the
+                # rendezvous pick, dispatching there PROMOTES host-cached
+                # KV instead of re-prefilling — worth the same slack the
+                # text-opening affinity earns. The token hash is exact
+                # where the rendezvous key is heuristic, so it wins ties.
+                h0 = self._prefix_hot.get(affinity_key, "")
+                promote = None
+                if h0 and h0 not in pref.kv_tier_hot:
+                    adv = [w for w in up if h0 in w.kv_tier_hot]
+                    if adv:
+                        promote = min(adv, key=lambda w: w.score)
+                if (promote is not None
+                        and promote.score <= best.score + slack):
+                    best = promote
+                    route_outcome = "promote"
+                elif pref.score <= best.score + slack:
                     best = pref
                     affinity_outcome = "pinned"
+                    route_outcome = "affinity"
                 else:
                     affinity_outcome = "overridden"
+                    route_outcome = "load"
             if charge:
                 best.dispatched += 1
                 best.total_dispatched += 1
         if affinity_outcome:
             REGISTRY.counter("router_affinity_total",
                              labels={"outcome": affinity_outcome}).inc()
+        if route_outcome:
+            REGISTRY.counter("router_prefix_route_total",
+                             labels={"outcome": route_outcome}).inc()
         if charge:
             REGISTRY.counter("router_dispatches",
                              labels={"worker": best.url,
@@ -778,6 +855,10 @@ class FailoverLLM:
                         raise httpx.TransportError(
                             f"HTTP {resp.status_code}")
                     resp.raise_for_status()   # 4xx: deterministic — raise
+                    # promote routing learns conversation -> prefix hash
+                    # from the worker's stamp (engine/kv_tier.py)
+                    self._learn_prefix(affinity_key,
+                                       resp.headers.get("x-kv-prefix", ""))
                     try:
                         yield from self._pump_sse(resp, emitted)
                         return                # clean completion
@@ -904,6 +985,8 @@ class FailoverLLM:
                         raise httpx.TransportError(
                             f"HTTP {resp.status_code}")
                     resp.raise_for_status()   # 4xx: deterministic — raise
+                    self._learn_prefix(affinity_key,
+                                       resp.headers.get("x-kv-prefix", ""))
                     handoff_body = resp.content
                     handoff_binary = kv_wire_mod.is_kv_frames(
                         handoff_body,

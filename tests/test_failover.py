@@ -242,6 +242,9 @@ class _FakeWorker:
         # extra canned fields merged into the /health body (fleet rollups,
         # kv_wire capability adverts)
         self.health_extra: dict = {}
+        # extra response headers stamped on every 200 reply (the prefix-
+        # tier tests set X-KV-Prefix, the header real engines stamp)
+        self.resp_headers: dict = {}
         worker = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -254,6 +257,8 @@ class _FakeWorker:
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in worker.resp_headers.items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -405,6 +410,55 @@ def test_router_unified_pool_prefers_unloaded_worker():
         for _ in range(3):
             assert "".join(pool.chat(MESSAGES, max_tokens=8))
         assert calm.hits["chat"] == 3 and busy.hits["chat"] == 0
+
+
+def test_router_promote_routes_prefix_miss_to_advertising_replica():
+    """The kv_tier fleet loop (ISSUE-16): turn 1 of a conversation lands
+    on its rendezvous-affinity replica, which stamps the prompt's
+    token-hash prefix on X-KV-Prefix; the router learns the mapping.
+    Before turn 2, a DIFFERENT replica advertises that hash hot in its
+    /health kv_tier_hot set (it holds the spilled prefix run) — the
+    router must route turn 2 THERE, promoting host-cached KV instead of
+    re-prefilling, counted as
+    ``router_prefix_route_total{outcome="promote"}``."""
+    from generativeaiexamples_tpu.core.metrics import REGISTRY
+
+    h0 = "ab" * 16
+    w1 = _FakeWorker("unified", text="t1")
+    w2 = _FakeWorker("unified", text="t2")
+    w1.resp_headers["X-KV-Prefix"] = h0
+    w2.resp_headers["X-KV-Prefix"] = h0
+    with _fake_pool(w1, w2):
+        # refresh_s=0: every pick re-probes /health, so the advert set
+        # below is visible on the very next dispatch
+        pool = FailoverLLM([w1.url, w2.url], "tiny", refresh_s=0.0)
+        key = pool._affinity_key(MESSAGES)
+        assert key
+        pref = pool._rendezvous(key, pool._workers)
+        other = next(w for w in pool._workers if w is not pref)
+        by_url = {w1.url: w1, w2.url: w2}
+        promote0 = REGISTRY.counter("router_prefix_route_total",
+                                    labels={"outcome": "promote"}).value
+        # turn 1: affinity pins the rendezvous replica; the router learns
+        # the conversation -> h0 mapping from its response header
+        assert "".join(pool.chat(MESSAGES, max_tokens=8))
+        assert by_url[pref.url].hits["chat"] == 1
+        assert by_url[other.url].hits["chat"] == 0
+        with pool._lock:
+            assert pool._prefix_hot.get(key) == h0
+        # the OTHER replica now advertises the hash hot (it holds the
+        # prefix run in its host tier); the rendezvous pick does not
+        by_url[other.url].health_extra["kv_tier_hot"] = [h0]
+        # turn 2: promote routing beats rendezvous affinity
+        assert "".join(pool.chat(MESSAGES, max_tokens=8))
+        assert by_url[other.url].hits["chat"] == 1
+        assert by_url[pref.url].hits["chat"] == 1
+        assert REGISTRY.counter("router_prefix_route_total",
+                                labels={"outcome": "promote"}).value \
+            == promote0 + 1
+        fleet = pool.fleet()
+        assert any(w["kv_tier_hot"] == [h0]
+                   for w in fleet["workers"].values())
 
 
 def test_router_drain_and_readmission():

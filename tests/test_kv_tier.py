@@ -1,0 +1,384 @@
+"""Prefix-addressed global KV tier (ISSUE 16 tentpole).
+
+The acceptance contracts:
+
+  * **Returning-conversation promote.** With ``APP_KV_TIER=prefix``, a
+    request whose prompt shares a cached prefix with an earlier spilled
+    request admits with ZERO prefill programs over the covered span —
+    the devtime ledger must show exactly ``len(prompt) - covered``
+    prefill tokens plus one ``kv_import`` dispatch — and streams
+    token-identical to an uncached big-pool oracle, on both pool dtypes
+    (xla/float and pallas/int8).
+  * **Off is off.** ``APP_KV_TIER`` unset (the default) builds the plain
+    request-keyed ``KVSpillPool`` — ``Scheduler._tier is None``, zero
+    tier code on any hot path, byte-identical PR 14 spill behavior (the
+    spill tests in test_live_migration.py run in exactly this mode).
+  * **Pins are inviolable.** An entry with a checkout ref or a live rid
+    link is NEVER evicted, even when the byte budget demands it —
+    admission over-budgets instead.
+  * **Disk tier is loud.** RAM-evicted entries demote to crc32-framed
+    files and promote back bit-exactly; a corrupt file is a counted
+    decode failure and a dropped entry, never served KV.
+  * **Accounting covers everything.** ``payload_nbytes`` charges every
+    ndarray segment plus the packed token list — a payload that grows a
+    new buffer never rides the budget for free.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.engine.kv_tier import (
+    KVSpillPool, PrefixKVTier, payload_nbytes)
+from generativeaiexamples_tpu.engine.prefix_cache import chain_hashes
+from generativeaiexamples_tpu.engine.scheduler import Request
+from tests.test_disagg import _drive, _text
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+
+    from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+    from generativeaiexamples_tpu.models import llama
+    cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    params = llama.init_params(jax.random.PRNGKey(5), cfg)
+    return cfg, params, ByteTokenizer()
+
+
+def _mk_tier(cfg, params, tok, num_pages, monkeypatch, spill_mb=64,
+             tier="prefix", attn="xla", kv_quant="none"):
+    from generativeaiexamples_tpu.core.config import EngineConfig
+    from generativeaiexamples_tpu.engine.engine import EngineCore
+    from generativeaiexamples_tpu.engine.scheduler import Scheduler
+    if spill_mb is None:
+        monkeypatch.delenv("APP_KV_SPILL_MB", raising=False)
+    else:
+        monkeypatch.setenv("APP_KV_SPILL_MB", str(spill_mb))
+    if tier is None:
+        monkeypatch.delenv("APP_KV_TIER", raising=False)
+    else:
+        monkeypatch.setenv("APP_KV_TIER", tier)
+    ecfg = EngineConfig(max_batch_size=2, max_seq_len=128, prefill_chunk=16,
+                        page_size=16, attention=attn, kv_quant=kv_quant,
+                        spec_decode="off", decode_steps_per_dispatch=2,
+                        prefill_hold_chunks=0, num_pages=num_pages,
+                        prefix_cache="off")
+    return Scheduler(EngineCore(cfg, ecfg, params, eos_id=tok.eos_id), tok)
+
+
+def _devtime_rows(prefixes) -> tuple:
+    """(program count, token sum) for devtime programs starting with any
+    of ``prefixes`` (counts populate in every mode, APP_DEVTIME=off
+    incl.)."""
+    from generativeaiexamples_tpu.observability.devtime import DEVTIME
+    rows = [r for r in DEVTIME.snapshot()["programs"]
+            if r["program"].startswith(tuple(prefixes))]
+    return (sum(r["count"] for r in rows),
+            sum(r["tokens"] for r in rows))
+
+
+# --------------------------------------------- returning-conversation promote
+
+@pytest.mark.parametrize("attn,kv_quant", [("xla", "none"),
+                                           ("pallas", "int8")])
+def test_returning_prefix_promotes_zero_prefill_token_identical(
+        tiny, monkeypatch, attn, kv_quant):
+    """The acceptance bar, end to end on the REAL engine: page pressure
+    spills a stream into the tier, the stream finishes, and a SECOND
+    request with the same prompt promotes the cached prefix — the
+    devtime ledger shows zero prefill programs over the covered span
+    (exactly the tail's tokens prefill) plus a kv_import dispatch, and
+    the promoted stream is token-identical to a big-pool oracle that
+    never saw a cache."""
+    cfg, params, tok = tiny
+    pa = tok.encode("the quick brown fox jumps over the lazy")
+    pb = tok.encode("pack my box with five dozen liquor ju")
+    kwa = dict(max_tokens=60, temperature=0.7, seed=11)
+    kwb = dict(max_tokens=60, temperature=0.7, seed=22)
+
+    # uncached oracles: big pool, tier off
+    big = _mk_tier(cfg, params, tok, 0, monkeypatch, spill_mb=None,
+                   tier=None, attn=attn, kv_quant=kv_quant)
+    o1 = Request(prompt_ids=list(pa), **kwa)
+    o2 = Request(prompt_ids=list(pb), **kwb)
+    big.submit(o1)
+    big.submit(o2)
+    _drive(big, [o1, o2], ticks=4000)
+    oracles = {tuple(pa): _text(o1), tuple(pb): _text(o2)}
+
+    # phase 1 — feed the tier: tight pool, decode growth exhausts it,
+    # the victim spills (contributing its prefix run) and promotes back
+    sched = _mk_tier(cfg, params, tok, 8, monkeypatch,
+                     attn=attn, kv_quant=kv_quant)
+    assert isinstance(sched._spill, PrefixKVTier)
+    assert sched._tier is sched._spill
+    r1 = Request(prompt_ids=list(pa), **kwa)
+    r2 = Request(prompt_ids=list(pb), **kwb)
+    sched.submit(r1)
+    sched.submit(r2)
+    for _ in range(6000):
+        worked = sched._tick()
+        if r1.spill_resumes + r2.spill_resumes >= 1:
+            break
+        if not worked:
+            time.sleep(0.001)
+    else:
+        raise AssertionError("no spill resume under page pressure")
+    _drive(sched, [r1, r2], ticks=6000)
+    assert r1.error is None and r2.error is None
+    assert _text(r1) == oracles[tuple(pa)]
+    assert _text(r2) == oracles[tuple(pb)]
+    # the spill victims released; their prefix runs stayed behind
+    assert len(sched._spill) == 0
+    assert sched._tier.entries() >= 1
+    assert sched._tier.used_bytes == sched._tier.cached_bytes > 0
+
+    # phase 2 — the returning conversation: resubmit the VICTIM's prompt
+    victim_prompt, victim_kw = (pa, kwa) if r1.spill_resumes else (pb, kwb)
+    pre_n, pre_tok = _devtime_rows(("prefill", "mixed"))
+    imp_n, _ = _devtime_rows(("kv_import",))
+    promoted0 = REGISTRY.counter("kv_tier_total",
+                                 labels={"outcome": "promoted"}).value
+    r3 = Request(prompt_ids=list(victim_prompt), **victim_kw)
+    sched.submit(r3)
+    _drive(sched, [r3], ticks=6000)
+    assert r3.error is None, r3.error
+
+    covered = r3.tier_hit_tokens
+    assert covered > 0 and covered % 16 == 0
+    assert r3.prefix_hit_tokens >= covered
+    # zero prefill programs over the covered span: the ledger's prefill
+    # token delta is EXACTLY the uncovered tail, and the covered span
+    # arrived as a kv_import dispatch instead
+    post_n, post_tok = _devtime_rows(("prefill", "mixed"))
+    assert post_tok - pre_tok == len(victim_prompt) - covered
+    assert post_n - pre_n < -(-len(victim_prompt) // 16)
+    assert _devtime_rows(("kv_import",))[0] > imp_n
+    assert REGISTRY.counter("kv_tier_total",
+                            labels={"outcome": "promoted"}).value \
+        == promoted0 + 1
+    assert r3.kv_import_s > 0
+    # token-identical to the oracle that never touched a cache
+    assert _text(r3) == oracles[tuple(victim_prompt)]
+
+
+# ------------------------------------------------------------ off means off
+
+def test_tier_off_by_default_keeps_plain_spill_pool(tiny, monkeypatch):
+    """APP_KV_TIER unset → the scheduler builds the PR 14 pool exactly:
+    ``type(sched._spill) is KVSpillPool`` (not the subclass), no tier
+    object, no prefix key on the response-header surface — and
+    load_stats still reports spill occupancy (the fleet satellite)."""
+    cfg, params, tok = tiny
+    sched = _mk_tier(cfg, params, tok, 8, monkeypatch, tier=None)
+    assert sched._tier is None
+    assert type(sched._spill) is KVSpillPool
+    assert sched.prefix_key_hex(tok.encode("x" * 40)) == ""
+    stats = sched.load_stats()
+    assert stats["kv_spill_used_bytes"] == 0
+    assert stats["kv_spill_budget_bytes"] == 64 * (1 << 20)
+    assert "kv_tier_hot" not in stats
+
+
+def test_tier_on_advertises_hotset_and_prefix_key(tiny, monkeypatch):
+    """APP_KV_TIER=prefix → the tier subclass serves the spill surface,
+    /health (load_stats) carries the fleet advert fields, and
+    prefix_key_hex computes the conversation key the router learns from
+    the X-KV-Prefix response header."""
+    cfg, params, tok = tiny
+    sched = _mk_tier(cfg, params, tok, 8, monkeypatch)
+    assert isinstance(sched._tier, PrefixKVTier)
+    stats = sched.load_stats()
+    assert stats["kv_tier_entries"] == 0
+    assert stats["kv_tier_bytes"] == 0
+    assert stats["kv_tier_hot"] == []
+    prompt = tok.encode("the quick brown fox jumps over the lazy")
+    want = chain_hashes([int(t) for t in prompt[:16]], 16,
+                        seed="0|")[0].hex()
+    assert sched.prefix_key_hex(prompt) == want
+    # sub-page prompts have no full-page hash to advertise
+    assert sched.prefix_key_hex(prompt[:5]) == ""
+
+
+# ------------------------------------------------------- pins are inviolable
+
+def _payload(fill: float, pages: int = 2, ps: int = 16) -> dict:
+    return {"length": pages * ps, "n_pages": pages, "page_size": ps,
+            "k": np.full((pages, ps, 4), fill, np.float32),
+            "v": np.full((pages, ps, 4), -fill, np.float32),
+            "prompt_ids": list(range(pages * ps))}
+
+
+def _hashes(tag: bytes, depth: int) -> list:
+    return [bytes([i]) * 15 + tag for i in range(depth)]
+
+
+def test_refcounted_entry_never_evicted():
+    """The hard invariant: eviction never drops an entry with a live pin
+    — neither a checkout ref (promote in flight) nor a rid link (live
+    spill). Admission over-budgets instead; the pin released, the same
+    admission succeeds by evicting."""
+    p1, p2 = _payload(1.0), _payload(2.0)
+    n1 = payload_nbytes(p1)
+    tier = PrefixKVTier(int(n1 * 1.5))
+    assert tier.admit("r1", p1)
+    assert tier.contribute("r1", _hashes(b"a", 2), p1, tokens=32)
+    # rid-linked: the budget cannot evict it to fit a second spill
+    assert not tier.admit("r2", p2)
+    assert tier.entries() == 1
+    # release retains: bytes move to the cached plane, entry unpinned
+    tier.release("r1", outcome="promoted")
+    assert len(tier) == 0 and tier.cached_bytes == n1
+    hit = tier.probe(_hashes(b"a", 2))
+    assert hit is not None and hit[1] == 2
+    # checkout pins it again: still not evictable
+    key = hit[0]
+    out = tier.checkout(key)
+    assert out is p1 and tier.live_refs() == 1
+    assert not tier.admit("r2", p2)
+    assert tier.entries() == 1
+    # pin released: the SAME admission now evicts it and fits
+    tier.checkin(key)
+    assert tier.live_refs() == 0
+    evicted0 = REGISTRY.counter("kv_tier_total",
+                                labels={"outcome": "evicted"}).value
+    assert tier.admit("r2", p2)
+    assert tier.entries() == 0 and tier.cached_bytes == 0
+    assert tier.probe(_hashes(b"a", 2)) is None
+    assert REGISTRY.counter("kv_tier_total",
+                            labels={"outcome": "evicted"}).value \
+        == evicted0 + 1
+    tier.release("r2", outcome="dropped")
+    assert tier.used_bytes == 0
+
+
+def test_probe_prefers_deepest_cached_prefix():
+    """Two entries sharing an opening page: a probe resolves to the
+    DEEPEST covered prefix of the asked chain, not the first match."""
+    tier = PrefixKVTier(1 << 20)
+    shallow, deep = _payload(1.0, pages=1), _payload(2.0, pages=3)
+    hs = _hashes(b"z", 3)
+    assert tier.admit("r1", shallow)
+    assert tier.contribute("r1", hs[:1], shallow, tokens=16)
+    tier.release("r1")
+    assert tier.admit("r2", deep)
+    assert tier.contribute("r2", hs, deep, tokens=48)
+    tier.release("r2")
+    key, depth = tier.probe(hs)
+    assert depth == 3
+    assert tier.checkout(key) is deep
+    tier.checkin(key)
+    # a prompt covering only the opening page still hits, shallower
+    assert tier.probe(hs[:1]) is not None
+    assert tier.probe(_hashes(b"q", 2)) is None
+
+
+# ------------------------------------------------------------- disk tier
+
+def _wait(cond, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_disk_tier_demotes_and_promotes_bit_exact(tmp_path):
+    """RAM eviction of a disk-backed entry is a DEMOTION: the entry
+    stays addressable, checkout loads the crc32-framed file and the
+    arrays come back bit-exact."""
+    p1, p2 = _payload(3.5), _payload(4.5)
+    n1 = payload_nbytes(p1)
+    tier = PrefixKVTier(int(n1 * 1.5), disk_budget_bytes=1 << 20,
+                        disk_dir=str(tmp_path))
+    assert tier.admit("r1", p1)
+    assert tier.contribute("r1", _hashes(b"d", 2), p1, tokens=32)
+    tier.release("r1")
+    # write-behind runs at retention: wait for the published disk copy
+    assert _wait(lambda: tier.disk_used_bytes > 0), \
+        "write-behind never published the disk copy"
+    demoted0 = REGISTRY.counter("kv_tier_total",
+                                labels={"outcome": "demoted"}).value
+    # force the RAM eviction: the entry demotes instead of dying
+    assert tier.admit("r2", p2)
+    assert REGISTRY.counter("kv_tier_total",
+                            labels={"outcome": "demoted"}).value \
+        == demoted0 + 1
+    assert tier.cached_bytes == 0 and tier.entries() == 1
+    hit = tier.probe(_hashes(b"d", 2))
+    assert hit is not None and hit[1] == 2
+    loaded = tier.checkout(hit[0])
+    assert loaded is not None and loaded is not p1
+    assert np.array_equal(loaded["k"], p1["k"])
+    assert np.array_equal(loaded["v"], p1["v"])
+    assert [int(t) for t in loaded["prompt_ids"]] == p1["prompt_ids"]
+    tier.checkin(hit[0])
+
+
+def test_disk_tier_corruption_is_loud_not_served(tmp_path):
+    """A flipped byte in the disk file is a counted decode failure and a
+    dropped entry — the caller re-prefills; garbage KV is never
+    returned."""
+    p1, p2 = _payload(5.5), _payload(6.5)
+    n1 = payload_nbytes(p1)
+    tier = PrefixKVTier(int(n1 * 1.5), disk_budget_bytes=1 << 20,
+                        disk_dir=str(tmp_path))
+    assert tier.admit("r1", p1)
+    assert tier.contribute("r1", _hashes(b"c", 2), p1, tokens=32)
+    tier.release("r1")
+    assert _wait(lambda: tier.disk_used_bytes > 0)
+    assert tier.admit("r2", p2)          # demote the entry to disk-only
+    files = list(tmp_path.glob("*.kvw"))
+    assert len(files) == 1
+    files[0].write_bytes(b"garbage" + files[0].read_bytes()[7:])
+    corrupt0 = REGISTRY.counter("kv_tier_total",
+                                labels={"outcome": "disk_corrupt"}).value
+    hit = tier.probe(_hashes(b"c", 2))
+    assert hit is not None
+    assert tier.checkout(hit[0]) is None
+    assert REGISTRY.counter("kv_tier_total",
+                            labels={"outcome": "disk_corrupt"}).value \
+        == corrupt0 + 1
+    # the entry died with its corrupt copy: later probes miss cleanly
+    assert tier.probe(_hashes(b"c", 2)) is None
+    assert tier.live_refs() == 0
+
+
+# ------------------------------------------------------------- accounting
+
+def test_payload_nbytes_charges_every_segment():
+    """Every ndarray segment counts — k, v, scales, AND any new buffer a
+    future payload grows — plus prompt_ids at 4 bytes/token; scalar
+    passthrough fields ride free."""
+    k = np.zeros((2, 16, 4), np.float32)
+    base = {"length": 32, "n_pages": 2, "page_size": 16,
+            "k": k, "v": k.copy(), "temperature": 0.7, "seed": 11}
+    n0 = payload_nbytes(base)
+    assert n0 == 2 * k.nbytes
+    base["k_s"] = np.zeros((2, 16), np.float32)
+    assert payload_nbytes(base) == n0 + base["k_s"].nbytes
+    base["draft_cache"] = np.zeros((8,), np.int8)   # a NEW segment
+    assert payload_nbytes(base) == n0 + base["k_s"].nbytes + 8
+    base["prompt_ids"] = list(range(32))
+    assert payload_nbytes(base) \
+        == n0 + base["k_s"].nbytes + 8 + 4 * 32
+
+
+def test_timeline_carries_tier_hit_tokens():
+    """/debug/requests timelines stamp tier_hit_tokens next to
+    prefix_hit_tokens — host-tier promotes are visible per request (the
+    observability satellite)."""
+    from generativeaiexamples_tpu.observability.flight import timeline
+
+    req = Request(prompt_ids=[1, 2, 3])
+    req.prefix_hit_tokens = 48
+    req.tier_hit_tokens = 32
+    rec = timeline(req)
+    assert rec["prefix_hit_tokens"] == 48
+    assert rec["tier_hit_tokens"] == 32

@@ -208,6 +208,24 @@ class FakeCore:
         st.lengths[slot] = int(payload["length"])
         return st
 
+    def import_pages_kv(self, st: _FakeState, pages, payload: dict,
+                        n_pages: Optional[int] = None) -> _FakeState:
+        """Partial page import — the prefix-tier promote surface
+        (engine/kv_tier.py): scatter the payload's first ``n_pages`` page
+        rows into freshly allocated physical pages, touching NO slot
+        state. The promoted job's chunk walk starts at the covered
+        boundary; any coverage/page-math slip here corrupts the read-back
+        context sum and the stream diverges from the solo oracle."""
+        if payload.get("page_size") != self.page_size:
+            raise ValueError("page_size mismatch")
+        n = int(n_pages if n_pages is not None else payload["n_pages"])
+        if n < 1 or n > int(payload["n_pages"]):
+            raise ValueError("n_pages outside payload coverage")
+        st = self._clone(st)
+        for i, p in enumerate(list(pages)[:n]):
+            st.pool[p] = payload["k"][i]
+        return st
+
     def activate(self, st: _FakeState, slot: int, token: int,
                  generated: int, max_gen: int, temperature: float,
                  top_k: int, top_p: float, seed: int = 0,
@@ -309,7 +327,8 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
                  chaos_spec: Optional[str] = None,
                  spill: bool = False,
                  evac_tick: Optional[int] = None,
-                 qos: bool = False) -> Optional[str]:
+                 qos: bool = False,
+                 tier: bool = False) -> Optional[str]:
     """Run one scheduled episode; returns an error description or None.
 
     ``chaos_spec`` arms the fault-injection plane (observability/chaos.py,
@@ -338,11 +357,22 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
     dispatch (no starvation — throttled tenants refill and admit; the
     livelock/idle guards catch a starved queue), and the policy's
     outstanding admission reservations must drain to ZERO through
-    preemptions, evacuations, and driver resets (quota conservation)."""
+    preemptions, evacuations, and driver resets (quota conservation).
+
+    ``tier`` arms the prefix-addressed KV tier (APP_KV_TIER=prefix, on
+    top of the spill pool): spilled prefix runs are RETAINED after their
+    request releases and later same-family prompts promote the covered
+    span with zero prefill programs. Promoted streams must stay
+    token-identical to the solo oracle (a wrong promote serves another
+    request's KV — the paged read-back catches it), and after drain the
+    tier's refcounts and rid pins conserve to zero while retained bytes
+    stay exactly on the cached plane, within budget."""
     import os
     rng = np.random.RandomState(seed)
-    if spill:
+    if spill or tier:
         os.environ["APP_KV_SPILL_MB"] = "64"
+    if tier:
+        os.environ["APP_KV_TIER"] = "prefix"
     if qos:
         os.environ.update(_QOS_ENV)
     try:
@@ -351,10 +381,13 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
         sched = Scheduler(core, tok)
     finally:
         os.environ.pop("APP_KV_SPILL_MB", None)
+        os.environ.pop("APP_KV_TIER", None)
         for key in _QOS_ENV:
             os.environ.pop(key, None)
     if qos and sched._qos is None:
         return "qos episode built a scheduler without a policy"
+    if tier and sched._tier is None:
+        return "tier episode built a scheduler without a prefix tier"
     if chaos_spec is not None:
         chaos_mod.CHAOS.configure(mode="on", seed=seed, spec=chaos_spec)
 
@@ -555,8 +588,25 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
         # spill-pool conservation: every demoted payload's bytes returned
         # (promoted, evacuated, or died with its job — incl. through
         # worker.die driver resets); a leak here is host RAM that never
-        # comes back over a serving day
-        if sched._spill is not None and sched._spill.used_bytes != 0:
+        # comes back over a serving day. With the prefix tier armed,
+        # RETAINED entries legitimately keep bytes after drain (they ARE
+        # the cache) — but the rid registry and every checkout pin must
+        # conserve to zero, and the retained bytes must sit exactly on
+        # the cached plane within the operator's budget.
+        if sched._tier is not None:
+            if len(sched._spill) != 0:
+                return (f"tier rid registry leaked {len(sched._spill)} "
+                        f"rows after drain")
+            if sched._spill.live_refs() != 0:
+                return (f"tier pins leaked: {sched._spill.live_refs()} "
+                        f"refs/links after drain")
+            used = sched._spill.used_bytes
+            cached = sched._spill.cached_bytes
+            if used != cached or used > sched._spill.budget_bytes:
+                return (f"tier byte accounting broken after drain: "
+                        f"used={used} cached={cached} "
+                        f"budget={sched._spill.budget_bytes}")
+        elif sched._spill is not None and sched._spill.used_bytes != 0:
             return (f"spill pool leaked {sched._spill.used_bytes} bytes "
                     f"({len(sched._spill)} entries)")
         # qos reservation conservation (engine/qos.py): every admission's
@@ -636,10 +686,11 @@ def _core_kw(rng: np.random.RandomState) -> Dict:
 
 def _shrink(seed: int, specs: List[_Spec], core_kw: Dict, err: str,
             chaos_spec: Optional[str] = None, spill: bool = False,
-            evac_tick: Optional[int] = None, qos: bool = False) -> str:
+            evac_tick: Optional[int] = None, qos: bool = False,
+            tier: bool = False) -> str:
     """Greedy one-at-a-time removal: report the minimal failing workload."""
     kw = dict(chaos_spec=chaos_spec, spill=spill, evac_tick=evac_tick,
-              qos=qos)
+              qos=qos, tier=tier)
     changed = True
     while changed and len(specs) > 1:
         changed = False
@@ -650,7 +701,7 @@ def _shrink(seed: int, specs: List[_Spec], core_kw: Dict, err: str,
                 break
     final = _run_episode(seed, specs, core_kw, **kw) or err
     return (f"{final}\n  seed={seed} core={core_kw} chaos={chaos_spec!r} "
-            f"spill={spill} evac_tick={evac_tick!r} qos={qos}\n"
+            f"spill={spill} evac_tick={evac_tick!r} qos={qos} tier={tier}\n"
             f"  minimal workload: "
             + "\n  ".join(map(repr, specs)))
 
@@ -732,6 +783,72 @@ def test_scheduler_fuzz_qos_invariants():
                                   evac_tick=evac_tick, qos=True))
     elapsed = time.perf_counter() - t0
     assert elapsed < 120, f"qos fuzz too slow for CI: {elapsed:.0f}s"
+
+
+TIER_EPISODES = 100
+
+# tier menus (ISSUE-16): preemption storms feed the tier via spill,
+# spill.exhaust forces the recompute fallback around contributions, and
+# worker.die driver resets must release every rid pin while RETAINED
+# entries survive to serve later same-family prompts
+_TIER_MENUS = (
+    None,
+    "page.exhaust=0.3",
+    "page.exhaust=0.3,spill.exhaust=0.5",
+    "worker.die=0.003,page.exhaust=0.25,spill.exhaust=0.3",
+)
+
+
+def test_scheduler_fuzz_tier_invariants():
+    """The ISSUE-16 tier menu: the same adversarial workloads with the
+    prefix-addressed KV tier armed (APP_KV_TIER=prefix over the spill
+    pool). Same-family specs share prompt prefixes, so spill-contributed
+    runs get probed and PROMOTED by later arrivals — through preemption
+    storms, forced spill exhaustion, mid-episode evacuations, and
+    worker.die driver resets. Invariants on top of the base episode's:
+    (i) promoted streams stay token-identical to the solo oracle (a
+    promote that serves the wrong KV corrupts the paged read-back), and
+    (ii) the tier's refcounts and rid pins conserve to zero after drain
+    while retained cache bytes stay exactly on the cached plane, within
+    the operator's byte budget."""
+    master = np.random.RandomState(0x7E1E7)
+    t0 = time.perf_counter()
+    for ep in range(TIER_EPISODES):
+        seed = int(master.randint(0, 2**31))
+        rng = np.random.RandomState(seed)
+        core_kw = _core_kw(rng)
+        # tier-focused pool shape: tight pools make preemption feed the
+        # tier, and the device prefix cache mostly off means the HOST
+        # tier is the cache that can win (covered > shared) — the promote
+        # path, not just the probe path, gets real traffic
+        core_kw["num_pages"] = int(rng.choice([9, 13]))
+        core_kw["prefix_cache"] = bool(rng.rand() < 0.25)
+        specs = _gen_specs(rng, core_kw)
+        # a "returning conversation" pair: one long prompt up front (its
+        # decode phase is what preemption storms spill into the tier) and
+        # the SAME prompt again after the early cohort drains — the
+        # workload shape whose tier probes actually HIT and promote
+        fam = int(rng.randint(0, 3))
+        long_len = int(rng.randint(core_kw["page_size"] * 2,
+                                   core_kw["max_seq"] - 2))
+        specs = specs + [
+            _Spec(prompt_len=long_len, max_tokens=int(rng.randint(4, 24)),
+                  arrival_tick=0, family=fam),
+            _Spec(prompt_len=long_len, max_tokens=int(rng.randint(1, 24)),
+                  arrival_tick=int(rng.randint(20, 60)), family=fam),
+        ]
+        chaos_spec = _TIER_MENUS[int(rng.randint(0, len(_TIER_MENUS)))]
+        evac_tick = (int(rng.randint(2, 40))
+                     if rng.rand() < 0.25 else None)
+        err = _run_episode(seed, specs, core_kw, chaos_spec=chaos_spec,
+                           evac_tick=evac_tick, tier=True)
+        if err:
+            pytest.fail(f"tier episode {ep}: "
+                        + _shrink(seed, specs, core_kw, err,
+                                  chaos_spec=chaos_spec,
+                                  evac_tick=evac_tick, tier=True))
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 120, f"tier fuzz too slow for CI: {elapsed:.0f}s"
 
 
 def test_scheduler_fuzz_chaos_invariants():

@@ -230,6 +230,32 @@ def test_lock_discipline_ignores_clock_and_blocker_names():
     assert "cache_lock" in fnd[0].message
 
 
+def test_lock_discipline_flags_disk_io_under_lock():
+    """The KV tier's disk write-behind (engine/kv_tier.py) must stage
+    bytes under the lock and touch the filesystem OUTSIDE it — a rename/
+    fsync/pathlib whole-file read inside the critical section stalls
+    every admission probe behind the disk."""
+    src = """
+    import os
+
+    def demote(self, path, blob):
+        with self._tier_lock:
+            path.write_bytes(blob)              # whole-file write: bad
+            os.replace(path, self.final)        # rename syscall: bad
+            os.fsync(self.fd)                   # flush syscall: bad
+
+    def promote(self, path):
+        with self._tier_lock:
+            name = str(path)                    # staging only: fine
+        blob = path.read_bytes()                # outside the lock: fine
+        return name, blob
+    """
+    fnd = findings_for(src, only="lock-discipline")
+    assert [f.line for f in fnd] == [6, 7, 8]
+    assert "write_bytes" in fnd[0].message
+    assert "os.replace" in fnd[1].message
+
+
 def test_lock_discipline_allows_cv_wait_and_closures():
     src = """
     import time
