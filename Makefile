@@ -83,3 +83,18 @@ up:
 # core with real page-table semantics (tests/test_scheduler_fuzz.py).
 fuzz:
 	$(TEST_ENV) python -m pytest tests/test_scheduler_fuzz.py -q
+
+# What-if replay simulator (ops/simulate.py, docs/simulation.md): drives
+# the REAL scheduler/QoS/KV-tier/router policies on a virtual clock —
+# here a 100-replica synthetic antagonist fleet, seconds on CPU.
+.PHONY: simulate
+simulate:
+	$(TEST_ENV) python -m generativeaiexamples_tpu.ops.simulate \
+	  --synthetic --requests 400 --replicas 100 --qos fair
+
+# Tier-1 smoke for the time-travel loop: record a 50-request FakeCore
+# trace, replay it, assert identical token counts + finish order (zero
+# drift) — tests/test_simulate.py.
+.PHONY: simulate-smoke
+simulate-smoke:
+	$(TEST_ENV) python -m pytest tests/test_simulate.py -q

@@ -50,6 +50,7 @@ from generativeaiexamples_tpu.core.config import EngineConfig
 from generativeaiexamples_tpu.core.metrics import REGISTRY
 from generativeaiexamples_tpu.observability.devtime import DEVTIME
 from generativeaiexamples_tpu.observability.flight import FLIGHT
+from generativeaiexamples_tpu.observability.trace import TRACE
 from generativeaiexamples_tpu.observability.usage import USAGE
 from generativeaiexamples_tpu.engine.engine import EngineCore
 from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
@@ -1482,42 +1483,90 @@ def run_goodput_round(deadline_ms: Optional[float] = None,
     }
 
 
+def _arm_trace(round_name: str) -> Optional[str]:
+    """Fleet event trace for a bench round, ON by default (`--trace-out
+    PATH` overrides the sink, `--no-trace` disarms): every round leaves a
+    replayable JSONL next to its JSON line, so any recorded workload can
+    be what-if'd later through ops/simulate.py (docs/simulation.md)."""
+    import os
+    import tempfile
+    if "--no-trace" in sys.argv:
+        return None
+    path = None
+    if "--trace-out" in sys.argv:
+        ix = sys.argv.index("--trace-out")
+        if ix + 1 >= len(sys.argv):
+            raise SystemExit("--trace-out requires a PATH argument")
+        path = sys.argv[ix + 1]
+    if path is None:
+        path = os.path.join(tempfile.gettempdir(),
+                            f"bench_trace_{round_name}.jsonl")
+    try:
+        os.remove(path)   # the file holds exactly this round
+    except OSError:
+        pass
+    TRACE.configure(mode="on", path=path)
+    TRACE.reset()
+    # rounds that boot engine WORKERS as subprocesses (goodput, chaos,
+    # disagg) inherit the sink through env — each worker's trace plane
+    # appends to the same JSONL (line-batched appends; the replayer
+    # orders by mono+seq, not file position)
+    os.environ["APP_TRACE"] = "on"
+    os.environ["APP_TRACE_PATH"] = path
+    return path
+
+
+def _seal_trace(extra: dict, path: Optional[str]) -> dict:
+    if path is not None:
+        TRACE.flush()
+        extra["trace_out"] = path
+    return extra
+
+
 def main() -> None:
     import os
     on_tpu = jax.default_backend() == "tpu"
     if "--kernel-bench" in sys.argv:
+        # pure device microbench — no scheduler, nothing to trace
         print(json.dumps({"metric": "ragged_kernel_bench",
                           **_kernel_microbench(on_tpu)}))
         return
     if "--roofline" in sys.argv:
         # decode roofline round (`make bench-roofline`): the ROADMAP item-2
         # ledger loop — decode phases + attribution pass, one JSON line
-        print(json.dumps({"metric": "decode_roofline",
-                          **run_roofline_round()}))
+        tp = _arm_trace("roofline")
+        print(json.dumps(_seal_trace({"metric": "decode_roofline",
+                                      **run_roofline_round()}, tp)))
         return
     if "--chaos" in sys.argv:
         # chaos resilience round (`make bench-chaos`): goodput + p99 TTFT
         # under the fixed seeded fault schedule, one parsed JSON line
-        print(json.dumps({"metric": "chaos_resilience",
-                          **run_chaos_round()}))
+        tp = _arm_trace("chaos")
+        print(json.dumps(_seal_trace({"metric": "chaos_resilience",
+                                      **run_chaos_round()}, tp)))
         return
     if "--goodput" in sys.argv:
         # multi-tenant antagonist round (`make bench-goodput`): Jain's
         # fairness + per-tenant TTFT p99 + goodput_frac for the
         # APP_QOS=off vs fair A/B, one parsed JSON line
-        print(json.dumps({"metric": "qos_goodput", **run_goodput_round()}))
+        tp = _arm_trace("goodput")
+        print(json.dumps(_seal_trace({"metric": "qos_goodput",
+                                      **run_goodput_round()}, tp)))
         return
     if "--prefix-tier" in sys.argv:
         # prefix-tier A/B round (`make bench-prefix`): returning-prefix
         # promote vs re-prefill — TTFT p50, prefill programs/tokens
         # saved, tier hit fraction, one parsed JSON line
-        print(json.dumps({"metric": "prefix_tier",
-                          **run_prefix_tier_round()}))
+        tp = _arm_trace("prefix_tier")
+        print(json.dumps(_seal_trace({"metric": "prefix_tier",
+                                      **run_prefix_tier_round()}, tp)))
         return
     if "--multichip" in sys.argv:
         # standalone disaggregated round (`make bench-disagg`): role'd
         # worker processes + the routing frontend, one parsed JSON line
-        print(json.dumps({"metric": "disagg_serving", **run_disagg_round()}))
+        tp = _arm_trace("disagg")
+        print(json.dumps(_seal_trace({"metric": "disagg_serving",
+                                      **run_disagg_round()}, tp)))
         return
     quant = os.environ.get("BENCH_QUANT", "int8" if on_tpu else "none")
     # tuning knobs (default = the shipped serving point); BENCH_FAST=1
@@ -1596,6 +1645,7 @@ def main() -> None:
     emb_docs_s, rerank_pairs_s = (0.0, 0.0) if fast else _measure_encoders(
         on_tpu)
 
+    trace_path = _arm_trace("serving")
     tok = ByteTokenizer()
     params = llama.init_params(jax.random.PRNGKey(0), model_cfg)
     n_params = sum(x.size for x in jax.tree.leaves(params))
@@ -1898,7 +1948,7 @@ def main() -> None:
         # payload weight, and decode-replica dispatch imbalance
         **disagg,
         "device": str(jax.devices()[0]),
-    }))
+    } | _seal_trace({}, trace_path)))
 
 
 if __name__ == "__main__":

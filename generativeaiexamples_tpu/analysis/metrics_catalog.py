@@ -1,0 +1,130 @@
+"""Metric-catalog extraction — the code side of the docs sync contract.
+
+docs/observability.md carries a marker-delimited catalog of every metric
+family the package registers (the section between
+``<!-- metric-catalog:begin -->`` and ``<!-- metric-catalog:end -->``).
+This module AST-walks the shipped package and collects what the code
+*actually* registers, so ``tests/test_metric_catalog.py`` can hold the
+two sides equal in both directions: an undocumented registration fails,
+and a documented-but-dead name (the classic doc-rot failure — a
+dashboard built on a metric that no longer exists) fails just as loudly.
+
+Collection is pure AST (same bargain as tpulint — no imports of the
+analyzed code): a call whose callee chain ends in
+``REGISTRY.counter/gauge/histogram`` with a literal first argument is a
+static registration; an f-string first argument becomes a *dynamic
+pattern* with ``*`` standing for the interpolated parts
+(``stage_*_s``). Dynamic patterns are documented as patterns — the
+catalog cannot enumerate per-encoder or per-stage instantiations.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, Set, Tuple
+
+_METRIC_CTORS = frozenset({"counter", "gauge", "histogram"})
+
+# marker pair the docs section lives between
+CATALOG_BEGIN = "<!-- metric-catalog:begin -->"
+CATALOG_END = "<!-- metric-catalog:end -->"
+
+# `name` in a table row's first backticked cell
+_ROW_NAME = re.compile(r"^\|\s*`([^`]+)`")
+
+
+def _iter_py(pkg_dir: str) -> Iterator[str]:
+    for root, dirs, files in os.walk(pkg_dir):
+        dirs[:] = [d for d in dirs if not d.startswith((".", "__pycache__"))]
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+
+
+def _registry_ctor(node: ast.Call) -> str:
+    """'counter'/'gauge'/'histogram' when the callee is a
+    ``REGISTRY.<ctor>`` chain (any base spelling whose last-but-one
+    segment is REGISTRY — ``metrics.REGISTRY.counter`` counts), else ''."""
+    fn = node.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr in _METRIC_CTORS):
+        return ""
+    base = fn.value
+    if isinstance(base, ast.Name) and base.id == "REGISTRY":
+        return fn.attr
+    if isinstance(base, ast.Attribute) and base.attr == "REGISTRY":
+        return fn.attr
+    return ""
+
+
+def _dynamic_pattern(js: ast.JoinedStr) -> str:
+    parts: List[str] = []
+    for v in js.values:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            parts.append(v.value)
+        else:
+            parts.append("*")
+    return "".join(parts) or "*"
+
+
+def collect_registered(pkg_dir: str
+                       ) -> Tuple[Dict[str, Set[str]], Set[str]]:
+    """Scan the package: returns ``(static, dynamic)`` where ``static``
+    maps ``name -> {ctor kinds seen}`` and ``dynamic`` is the set of
+    f-string patterns (``*`` per interpolation)."""
+    static: Dict[str, Set[str]] = {}
+    dynamic: Set[str] = set()
+    for path in _iter_py(pkg_dir):
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            # tpulint's parse-error rule owns unparseable files; the
+            # catalog just skips them
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            ctor = _registry_ctor(node)
+            if not ctor or not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                static.setdefault(arg.value, set()).add(ctor)
+            elif isinstance(arg, ast.JoinedStr):
+                dynamic.add(_dynamic_pattern(arg))
+            # a plain variable first arg (rare: wrapper helpers) is
+            # invisible here by design — wrappers register literals at
+            # their own call sites
+    return static, dynamic
+
+
+def parse_catalog(md_text: str) -> Tuple[Set[str], Set[str]]:
+    """Names from the marker-delimited docs section: returns
+    ``(documented_static, documented_patterns)`` — a name containing
+    ``*`` is a dynamic pattern row."""
+    try:
+        start = md_text.index(CATALOG_BEGIN)
+        end = md_text.index(CATALOG_END)
+    except ValueError:
+        raise ValueError(
+            "docs catalog markers not found (metric-catalog:begin/end)")
+    block = md_text[start:end]
+    names: Set[str] = set()
+    patterns: Set[str] = set()
+    for line in block.splitlines():
+        m = _ROW_NAME.match(line.strip())
+        if not m:
+            continue
+        name = m.group(1)
+        (patterns if "*" in name else names).add(name)
+    return names, patterns
+
+
+def pattern_matches(pattern: str, name: str) -> bool:
+    """``stage_*_s`` vs ``stage_retrieve_s`` — ``*`` spans any non-empty
+    run (the interpolated part is never empty in practice)."""
+    rx = "^" + ".+".join(re.escape(p) for p in pattern.split("*")) + "$"
+    return re.match(rx, name) is not None

@@ -285,6 +285,48 @@ def check_clock_discipline(ctx: ModuleContext) -> Iterable[Finding]:
 
 
 # --------------------------------------------------------------------------
+# clock-injection
+# --------------------------------------------------------------------------
+
+# policy modules the replay simulator (ops/simulate.py) drives on a
+# virtual clock — a direct stdlib clock read here silently desynchronizes
+# record and replay instead of failing loudly
+_CLOCK_POLICY_SUFFIXES = (
+    "engine/scheduler.py", "engine/qos.py", "engine/kv_tier.py",
+)
+_STDLIB_CLOCK_CALLS = frozenset({
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.monotonic_ns", "time.perf_counter_ns", "time.time_ns",
+})
+
+
+@rule("clock-injection", "error",
+      "Direct stdlib clock read (time.time/monotonic/perf_counter) in "
+      "scheduler/QoS/KV-tier policy code — these modules run under the "
+      "replay simulator's virtual clock and must read core/clock.py "
+      "(mono()/perf()/wall()) or an injected clock instead")
+def check_clock_injection(ctx: ModuleContext) -> Iterable[Finding]:
+    """The time-travel debugger's contract (docs/simulation.md): every
+    time-dependent decision in the three policy modules flows through the
+    injectable process clock, so a recorded trace replays bit-identically
+    on virtual time.  ``time.sleep`` stays legal (it is a *wait*, not a
+    clock read — the simulator never calls the paths that block).
+    Genuine telemetry-only sites use the inline
+    ``# tpulint: disable=clock-injection`` allowlist with a reason."""
+    norm = ctx.path.replace("\\", "/")
+    if not norm.endswith(_CLOCK_POLICY_SUFFIXES):
+        return
+    for node in ctx.walk():
+        name = call_name(node)
+        if name in _STDLIB_CLOCK_CALLS:
+            yield Finding(
+                ctx.path, node.lineno, "clock-injection", "error",
+                f"`{name}()` in simulator-driven policy code — read the "
+                "injected process clock (core/clock.py mono()/perf()/"
+                "wall()) so replay on a virtual clock stays faithful")
+
+
+# --------------------------------------------------------------------------
 # net-timeout
 # --------------------------------------------------------------------------
 

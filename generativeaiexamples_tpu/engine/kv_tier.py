@@ -56,6 +56,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from generativeaiexamples_tpu.core import clock
 from generativeaiexamples_tpu.core import kv_wire
 from generativeaiexamples_tpu.core.metrics import REGISTRY
 
@@ -192,6 +193,16 @@ class KVSpillPool:
         REGISTRY.counter("kv_spill_total", labels={"outcome": outcome}).inc()
         return n
 
+    def occupancy(self) -> Dict[str, Any]:
+        """Point-in-time occupancy snapshot — what the flight dump and
+        the trace plane's what-if baselines record (the gauges carry the
+        same numbers but a crash-dump artifact must be self-contained)."""
+        with self._lock:
+            return {"kind": "spill",
+                    "budget_bytes": self.budget_bytes,
+                    "used_bytes": self._used,
+                    "held": len(self._bytes)}
+
 
 @dataclass
 class _TierEntry:
@@ -209,7 +220,7 @@ class _TierEntry:
     linked_rid: str = ""               # live spill pinning this entry
     refs: int = 0                      # checkout pins (promote in flight)
     hits: int = 0
-    last_use: float = field(default_factory=time.monotonic)
+    last_use: float = field(default_factory=clock.mono)
     disk_path: str = ""
     disk_bytes: int = 0
 
@@ -347,7 +358,7 @@ class PrefixKVTier(KVSpillPool):
         """Value-priced eviction until ``need`` more bytes fit. Only
         unpinned RAM-resident entries are candidates; an entry with a
         checkout ref or a live rid link is untouchable by construction."""
-        now = time.monotonic()
+        now = clock.mono()
         while self._used + self._cached + need > self.budget_bytes:
             cands = [e for e in self._entries.values()
                      if e.refs == 0 and not e.linked_rid
@@ -409,7 +420,7 @@ class PrefixKVTier(KVSpillPool):
                     if e.payload is not None:
                         e.nbytes = payload_nbytes(e.payload)
                         self._cached += e.nbytes
-                        e.last_use = time.monotonic()
+                        e.last_use = clock.mono()
                         retained = e
             self._gauge()
         REGISTRY.counter("kv_spill_total", labels={"outcome": outcome}).inc()
@@ -500,7 +511,7 @@ class PrefixKVTier(KVSpillPool):
                 return None
             e.refs += 1
             e.hits += 1
-            e.last_use = time.monotonic()
+            e.last_use = clock.mono()
             payload = e.payload
             path = e.disk_path
         if payload is not None:
@@ -546,7 +557,7 @@ class PrefixKVTier(KVSpillPool):
         router-side conversation key can actually match)."""
         k = tier_hot_k() if k is None else int(k)
         with self._lock:
-            now = time.monotonic()
+            now = clock.mono()
             live = [e for e in self._entries.values()
                     if e.payload is not None or e.disk_path]
             live.sort(key=lambda e: self._score_locked(e, now), reverse=True)
@@ -563,6 +574,19 @@ class PrefixKVTier(KVSpillPool):
                 "kv_tier_disk_bytes": self._disk_used,
                 "kv_tier_hot": hot,
             }
+
+    def occupancy(self) -> Dict[str, Any]:
+        with self._lock:
+            live_refs = sum(e.refs for e in self._entries.values())
+            return {"kind": "prefix",
+                    "budget_bytes": self.budget_bytes,
+                    "used_bytes": self._used,
+                    "cached_bytes": self._cached,
+                    "held": len(self._bytes),
+                    "entries": len(self._entries),
+                    "live_refs": live_refs,
+                    "disk_budget_bytes": self.disk_budget_bytes,
+                    "disk_used_bytes": self._disk_used}
 
     # ------------------------------------------------------------- disk tier
 
@@ -630,7 +654,7 @@ class PrefixKVTier(KVSpillPool):
         """Delete lowest-value disk copies past the disk budget; returns
         the file paths for the CALLER to remove outside the lock."""
         dead: List[str] = []
-        now = time.monotonic()
+        now = clock.mono()
         while self._disk_used > self.disk_budget_bytes:
             cands = [e for e in self._entries.values()
                      if e.disk_path and e.refs == 0 and not e.linked_rid]
@@ -647,6 +671,36 @@ class PrefixKVTier(KVSpillPool):
 
     def drain_disk(self, timeout_s: float = 5.0) -> None:
         """Block until queued write-behind ops have drained (tests)."""
-        deadline = time.monotonic() + timeout_s
-        while not self._disk_q.empty() and time.monotonic() < deadline:
+        deadline = clock.mono() + timeout_s
+        while not self._disk_q.empty() and clock.mono() < deadline:
             time.sleep(0.01)
+
+
+# ---------------------------------------------------------------------------
+# process-level registry (flight dump / debug surfaces)
+# ---------------------------------------------------------------------------
+
+_POOL: Optional[KVSpillPool] = None
+
+
+def register_pool(pool: Optional[KVSpillPool]) -> None:
+    """Record the serving scheduler's spill pool / prefix tier so
+    process-global dump surfaces (observability/flight.py ``dump()``) can
+    embed its occupancy without holding a scheduler reference. Mirrors
+    qos.register_policy: last-constructed wins (one serving scheduler per
+    process; test schedulers overwrite freely)."""
+    global _POOL
+    _POOL = pool
+
+
+def current_pool() -> Optional[KVSpillPool]:
+    return _POOL
+
+
+def occupancy_payload() -> Dict[str, Any]:
+    pool = _POOL
+    if pool is None:
+        return {"enabled": False, "mode": tier_mode(),
+                "hint": "set APP_KV_SPILL_MB / APP_KV_TIER=prefix on the "
+                        "engine worker to arm the host tier"}
+    return pool.occupancy()

@@ -62,9 +62,9 @@ import heapq
 import logging
 import os
 import threading
-import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from generativeaiexamples_tpu.core import clock
 from generativeaiexamples_tpu.core.config import env_int
 from generativeaiexamples_tpu.core.metrics import REGISTRY
 from generativeaiexamples_tpu.observability import usage as usage_mod
@@ -139,7 +139,7 @@ def request_remaining_s(req: Any, now: Optional[float] = None
     submitted = getattr(req, "submitted_at", None)
     if submitted is None:
         return float(deadline)
-    now = time.perf_counter() if now is None else now
+    now = clock.perf() if now is None else now
     return float(deadline) - (now - submitted)
 
 
@@ -150,6 +150,12 @@ def request_remaining_s(req: Any, now: Optional[float] = None
 from generativeaiexamples_tpu.server.resilience import hedge_delay  # noqa: E402,F401
 
 
+def _mono_clock() -> float:
+    """Default QosPolicy clock: the injected process clock (virtual under
+    ops/simulate.py, time.monotonic live)."""
+    return clock.mono()
+
+
 class QosPolicy:
     """Per-process admission policy: WFQ virtual time + EDF + quotas.
 
@@ -157,7 +163,9 @@ class QosPolicy:
     charges, victim picks) and read by HTTP debug threads; one RLock
     guards the tenant tables.  ``clock`` must be monotonic (tests inject
     a fake — the quota buckets and nothing else read it; request-deadline
-    math stays on the perf_counter clock the Request stamps use)."""
+    math stays on the perf clock the Request stamps use). The default is
+    the process's injected mono clock (core/clock.py), so a simulated
+    policy runs on virtual time with no constructor plumbing."""
 
     def __init__(self,
                  weights: Optional[Dict[str, float]] = None,
@@ -166,9 +174,9 @@ class QosPolicy:
                  perf_model: Optional[Any] = None,
                  batch_hint: int = 1,
                  max_tenants: Optional[int] = None,
-                 clock=time.monotonic) -> None:
+                 clock=None) -> None:
         self._lock = threading.RLock()
-        self._clock = clock
+        self._clock = clock if clock is not None else _mono_clock
         self._weights = dict(weights or {})
         self._default_weight = max(1e-6, float(default_weight))
         self._quota_rate = dict(tokens_per_s or {})
@@ -370,7 +378,7 @@ class QosPolicy:
             self._depth_tenants = set()
             return []
         now_q = self._clock()
-        now_req = time.perf_counter()
+        now_req = clock.perf()
         limit = max(0, int(limit))
         buckets: Dict[str, List[Any]] = {}
         for job in jobs:
@@ -530,7 +538,7 @@ class QosPolicy:
         tie-break, so equal-standing tenants behave exactly as before).
         The caller's spill path applies to whoever is picked — overusing
         tenants spill first by construction."""
-        now = time.perf_counter()
+        now = clock.perf()
         with self._lock:
             vt = dict(self._vtime)
             floor = self._global_v

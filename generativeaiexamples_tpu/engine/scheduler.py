@@ -33,7 +33,6 @@ import math
 import os
 import queue
 import threading
-import time
 import uuid
 from collections import OrderedDict, deque
 from concurrent.futures import ThreadPoolExecutor
@@ -44,16 +43,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from generativeaiexamples_tpu.core import clock
 from generativeaiexamples_tpu.core.metrics import REGISTRY
 from generativeaiexamples_tpu.observability import chaos as chaos_mod
 from generativeaiexamples_tpu.observability import slo as slo_mod
 from generativeaiexamples_tpu.observability import usage as usage_mod
 from generativeaiexamples_tpu.observability.devtime import DEVTIME, pow2_bucket
 from generativeaiexamples_tpu.observability.flight import FLIGHT, REQUEST_LOG
+from generativeaiexamples_tpu.observability.trace import TRACE
 from generativeaiexamples_tpu.engine.engine import (
     DecodeState, EngineCore, bits_to_f32, unpack_decode_out)
 from generativeaiexamples_tpu.engine import qos as qos_mod
 from generativeaiexamples_tpu.engine.prefix_cache import chain_hashes
+from generativeaiexamples_tpu.engine import kv_tier as kv_tier_mod
 from generativeaiexamples_tpu.engine.kv_tier import (
     KVSpillPool, PrefixKVTier, spill_budget_bytes, tier_disk_bytes, tier_mode)
 from generativeaiexamples_tpu.engine.tokenizer import IncrementalDetokenizer, Tokenizer
@@ -68,9 +70,9 @@ def _fetch(arr, metric: str = "fetch_rtt_s") -> np.ndarray:
     the transfer, so it overlaps the driver thread's dispatching).
     ``metric`` keeps the packed-decode transfers (what pipeline-depth
     tuning reads) and the tiny first-token scalars in separate histograms."""
-    t0 = time.perf_counter()
+    t0 = clock.perf()
     out = np.asarray(jax.device_get(arr))
-    REGISTRY.histogram(metric).observe(time.perf_counter() - t0)
+    REGISTRY.histogram(metric).observe(clock.perf() - t0)
     return out
 
 
@@ -179,7 +181,7 @@ class Request:
     # occurrence — a preemption resume re-admits and re-prefills, but the
     # client-visible phases happened once; the resume shows up in
     # `preemptions` instead.
-    submitted_at: float = field(default_factory=time.perf_counter)
+    submitted_at: float = field(default_factory=clock.perf)
     admitted_at: Optional[float] = None
     prefill_start_at: Optional[float] = None
     first_token_at: Optional[float] = None
@@ -381,6 +383,10 @@ class Scheduler:
                 # off (default): the request-keyed pool, byte-identical
                 # to pre-tier spill behavior — zero tier code on any path
                 self._spill = KVSpillPool(budget)
+        # flight-dump occupancy surface (observability/flight.py): the
+        # crash-dump artifact embeds the pool snapshot without holding a
+        # scheduler reference
+        kv_tier_mod.register_pool(self._spill)
         # QoS admission plane (engine/qos.py, APP_QOS=off|fair): None in
         # off mode — the admission path then runs the exact pre-QoS FIFO
         # walk with zero qos calls (the APP_CHAOS/APP_DEVTIME
@@ -415,7 +421,7 @@ class Scheduler:
         # tick heartbeat for the engine watchdog (engine/watchdog.py): the
         # driver stamps this every loop iteration; a sustained gap while
         # _running means the driver is wedged inside one tick
-        self.last_tick_mono = time.monotonic()
+        self.last_tick_mono = clock.mono()
 
     # ------------------------------------------------------------------ API
 
@@ -480,6 +486,16 @@ class Scheduler:
             self._pending.append(job)
         self._wake.set()
         REGISTRY.counter("requests_submitted").inc()
+        if TRACE.enabled:
+            self._trace("submit", request,
+                        prompt_tokens=len(request.prompt_ids),
+                        max_tokens=request.max_tokens,
+                        slo=request.slo_class,
+                        deadline_s=request.deadline_s,
+                        prefix=self.prefix_key_hex(request.prompt_ids,
+                                                   request.adapter or ""),
+                        est_cost_s=self._est_cost_s(len(request.prompt_ids),
+                                                    request.max_tokens))
         return request
 
     def submit_prefilled(self, request: Request, payload: dict) -> Request:
@@ -527,6 +543,14 @@ class Scheduler:
         self._wake.set()
         REGISTRY.counter("requests_submitted").inc()
         REGISTRY.counter("kv_handoff_submitted").inc()
+        if TRACE.enabled:
+            self._trace("submit", request,
+                        prompt_tokens=len(request.prompt_ids),
+                        max_tokens=request.max_tokens,
+                        slo=request.slo_class,
+                        deadline_s=request.deadline_s,
+                        handoff=True, resume=bool(payload.get("resume")),
+                        est_cost_s=0.0)
         return request
 
     def load_stats(self) -> Dict[str, object]:
@@ -582,6 +606,32 @@ class Scheduler:
                           seed=f"{self._cache_seed}|{adapter}")
         return hs[0].hex() if hs else ""
 
+    # ------------------------------------------------------- event trace
+
+    def _est_cost_s(self, prompt_tokens: int, max_tokens: int) -> float:
+        """Perfmodel-estimated service seconds for a request: prefill over
+        the prompt plus one weight-read-bound decode pass per budgeted
+        token — the same first-principles model the QoS plane budgets
+        with, stamped on every trace record so replay (ops/simulate.py)
+        and live cost accounting read identical estimates. 0.0 when the
+        core carries no perf model."""
+        pm = getattr(self.core, "perf_model", None)
+        if pm is None:
+            return 0.0
+        try:
+            per_tok = pm.weight_read_bytes(1) / pm.peak_bw
+            return round(pm.prefill_seconds(max(1, prompt_tokens))
+                         + max(1, max_tokens) * per_tok, 6)
+        except Exception:   # tpulint: disable=except-swallow -- a cost estimate is advisory trace metadata; a perfmodel stub without these fields degrades to 0.0, never blocks admission
+            return 0.0
+
+    def _trace(self, kind: str, req: Request, **fields) -> None:
+        """One canonical fleet-trace record (observability/trace.py) for a
+        request-scoped scheduler event. Callers guard with TRACE.enabled
+        so the off mode costs one attribute read."""
+        TRACE.emit(kind, rid=req.request_id,
+                   tenant=str(getattr(req, "tenant", "") or ""), **fields)
+
     def iter_text(self, request: Request) -> Iterator[str]:
         """Blocking iterator over the request's text deltas."""
         while True:
@@ -611,7 +661,7 @@ class Scheduler:
         jobs += list(self._prefilling) + list(self._slots.values())
         self._prefilling.clear()
         self._slots.clear()
-        now = time.perf_counter()
+        now = clock.perf()
         for job in jobs:
             job.request.error = reason
             if job.request.finished_at is None:
@@ -670,7 +720,7 @@ class Scheduler:
         usage plane's page-second vector integrates exactly the pages
         this job actually occupied. A stopped clock (0.0) only restamps:
         admission uses that to start billing."""
-        now = time.perf_counter()
+        now = clock.perf()
         if job.page_clock and job.pages:
             job.request.kv_page_seconds += (len(job.pages)
                                             * (now - job.page_clock))
@@ -720,7 +770,7 @@ class Scheduler:
         # the drain ends) must find the completed timeline — _STOP is the
         # happens-before edge consumers synchronize on
         req = job.request
-        req.finished_at = time.perf_counter()
+        req.finished_at = clock.perf()
         REGISTRY.counter("requests_completed").inc()
         # labeled family: finish-cause breakdown without a counter per name
         REGISTRY.counter("requests_finished",
@@ -728,6 +778,18 @@ class Scheduler:
                          ).inc()
         REGISTRY.histogram("request_latency_s").observe(
             req.finished_at - req.submitted_at)
+        if TRACE.enabled:
+            self._trace("finish", req,
+                        finish=req.finish_reason or "unknown",
+                        completion_tokens=len(job.gen_ids),
+                        prompt_tokens=len(req.prompt_ids),
+                        e2e_s=round(req.finished_at - req.submitted_at, 6),
+                        ttft_s=(round(req.first_token_at
+                                      - req.submitted_at, 6)
+                                if req.first_token_at else None),
+                        preemptions=req.preemptions,
+                        prefix_hit_tokens=req.prefix_hit_tokens,
+                        tier_hit_tokens=req.tier_hit_tokens)
         # judge SLO attainment BEFORE the log write and the stream release:
         # the /debug/requests timeline and the breach record a client can
         # fetch right after [DONE] already carry the verdict
@@ -751,9 +813,14 @@ class Scheduler:
 
     def _fail(self, job: _Job, reason: str) -> None:
         job.request.error = reason
-        job.request.finished_at = time.perf_counter()
+        job.request.finished_at = clock.perf()
         REGISTRY.counter("requests_failed").inc()
         REGISTRY.counter("requests_finished", labels={"finish": "error"}).inc()
+        if TRACE.enabled:
+            self._trace("finish", job.request, finish="error",
+                        error=reason[:200],
+                        completion_tokens=len(job.gen_ids),
+                        prompt_tokens=len(job.request.prompt_ids))
         slo_mod.SLO.observe(job.request)
         # close out page-seconds before billing: failure paths that still
         # hold pages (kv-export failure) release AFTER this call
@@ -921,6 +988,9 @@ class Scheduler:
                 self._pending.remove(job)
         for job in shed:
             job.request.slo_outcome = "shed"
+            if TRACE.enabled:
+                self._trace("qos", job.request, decision="shed",
+                            reason="slo_pressure")
             REGISTRY.counter("slo_shed_total",
                              labels={"class": job.request.slo_class}).inc()
             self._fail(job, "shed: SLO pressure is critical (error budget "
@@ -936,7 +1006,7 @@ class Scheduler:
         submissions shed: resumes already streamed to a client, and
         handoff/spill imports carry work another worker (or this pool's
         host tier) already paid for."""
-        now = time.perf_counter()
+        now = clock.perf()
         with self._lock:
             shed = []
             for j in self._pending:
@@ -953,6 +1023,10 @@ class Scheduler:
                 self._pending.remove(job)
         for job, est in shed:
             job.request.slo_outcome = "shed"
+            if TRACE.enabled:
+                self._trace("qos", job.request, decision="shed",
+                            reason="deadline_unmeetable",
+                            est_s=round(est, 6))
             self._qos.note_shed(job.request)
             REGISTRY.counter("slo_shed_total",
                              labels={"class": job.request.slo_class}).inc()
@@ -1102,7 +1176,7 @@ class Scheduler:
             job.total_len = shared
             job.shared = shared
             if job.request.admitted_at is None:
-                job.request.admitted_at = time.perf_counter()
+                job.request.admitted_at = clock.perf()
             if self._caching or self._tier is not None:
                 if shared:
                     job.request.prefix_hit_tokens += shared
@@ -1128,6 +1202,16 @@ class Scheduler:
             self._table[slot, :] = 0
             self._table[slot, :len(pages)] = pages
             self._table_dev = None
+            if TRACE.enabled:
+                self._trace("admit", job.request, slot=slot,
+                            pages=len(pages), shared_tokens=shared,
+                            resume=bool(job.gen_ids),
+                            waited_s=round(clock.perf()
+                                           - job.request.submitted_at, 6),
+                            path=("handoff" if job.preload is not None
+                                  else "spill" if job.spill is not None
+                                  else "tier" if job.tier_plan is not None
+                                  else "prefill"))
             if job.preload is not None:
                 self._admit_prefilled(job)
             elif job.spill is not None:
@@ -1148,7 +1232,7 @@ class Scheduler:
         req = job.request
         payload = job.preload
         job.preload = None
-        now = time.perf_counter()
+        now = clock.perf()
         if req.prefill_start_at is None:
             req.prefill_start_at = now
         self._state = self.core.import_slot_kv(
@@ -1164,7 +1248,7 @@ class Scheduler:
                  int(getattr(self.core, "max_pages_per_slot", 1 << 30)))
         DEVTIME.commit("kv_import", f"p{pb}", self._state.tokens, t0=now,
                        tokens=n, mfu=False, retain=False)
-        req.kv_import_s = round(time.perf_counter() - now, 6)
+        req.kv_import_s = round(clock.perf() - now, 6)
         REGISTRY.counter("kv_handoff_imports").inc()
         first = int(payload.get("first_token", self.core.eos_id))
         gen = max(1, int(payload.get("generated", 1)))
@@ -1242,7 +1326,7 @@ class Scheduler:
         if payload is None:
             self._prefilling.append(job)
             return
-        now = time.perf_counter()
+        now = clock.perf()
         n_imp = covered // self.core.page_size
         try:
             self._state = self.core.import_pages_kv(
@@ -1265,13 +1349,17 @@ class Scheduler:
                  int(getattr(self.core, "max_pages_per_slot", 1 << 30)))
         DEVTIME.commit("kv_import", f"p{pb}", self._state.tokens, t0=now,
                        tokens=covered, mfu=False, retain=False)
-        req.kv_import_s = round(time.perf_counter() - now, 6)
+        req.kv_import_s = round(clock.perf() - now, 6)
         req.tier_hit_tokens += covered
         req.prefix_hit_tokens += covered
         REGISTRY.counter("prefix_hit_tokens").inc(covered)
         REGISTRY.counter("kv_tier_hit_tokens").inc(covered)
         REGISTRY.counter("kv_tier_total",
                          labels={"outcome": "promoted"}).inc()
+        if TRACE.enabled:
+            self._trace("promote", req, source="tier",
+                        covered_tokens=covered,
+                        import_s=req.kv_import_s)
         if self._spec_w > 1 and hasattr(self.core, "seed_history"):
             # promoted pages skip prefill dispatches, so the drafting
             # history row must be seeded explicitly (as for cache hits)
@@ -1329,12 +1417,12 @@ class Scheduler:
         (engine.prefill_long_last): decode does not interleave during it,
         but the pass runs seq-axis-times faster than the chunk loop — the
         §5.7 long-context serving trade."""
-        t0 = time.perf_counter()
+        t0 = clock.perf()
         try:
             return self._prefill_step_inner()
         finally:
             REGISTRY.histogram("prefill_issue_s").observe(
-                time.perf_counter() - t0)
+                clock.perf() - t0)
 
     def _prefill_step_inner(self) -> int:
         from generativeaiexamples_tpu.engine.engine import PrefillItem
@@ -1347,7 +1435,7 @@ class Scheduler:
         # (engine.py _activate_sampled), so taking it would silently drop
         # token-level enforcement the serving layer promised the client.
         if self._long_pass_claims(job):
-            job.prefill_started = time.perf_counter()
+            job.prefill_started = clock.perf()
             if req.prefill_start_at is None:
                 req.prefill_start_at = job.prefill_started
             self._prefilling.popleft()
@@ -1372,6 +1460,9 @@ class Scheduler:
                            tokens=len(job.ids), padded_tokens=nb,
                            weight_passes=1.0, retain=False)
             del tok   # value rides state.tokens (_mark_first_pending)
+            if TRACE.enabled:
+                self._trace("dispatch", req, phase="prefill_long",
+                            tokens=len(job.ids))
             self._enter_decode(job)
             return 1
 
@@ -1389,7 +1480,7 @@ class Scheduler:
             req = job.request
             start = job.prefilled
             if start == job.shared:
-                job.prefill_started = time.perf_counter()
+                job.prefill_started = clock.perf()
                 if req.prefill_start_at is None:
                     req.prefill_start_at = job.prefill_started
             while len(items) < budget and start < len(job.ids):
@@ -1425,6 +1516,10 @@ class Scheduler:
                        tokens=sum(len(it.chunk_ids) for it in items),
                        padded_tokens=g_bucket * self.core.chunk,
                        weight_passes=1.0)
+        if TRACE.enabled:
+            TRACE.emit("dispatch", phase="prefill", chunks=len(items),
+                       tokens=sum(len(it.chunk_ids) for it in items),
+                       jobs=len({it.slot for it in items}))
         for job in finals:
             self._prefilling.remove(job)
             # prompt pages are now fully write-dispatched: publish them
@@ -1552,7 +1647,7 @@ class Scheduler:
         so the driver's in-order stream makes it safe against reuse; the
         fetch is this role's per-request host sync point."""
         req = job.request
-        t0 = time.perf_counter()
+        t0 = clock.perf()
         try:
             payload = self.core.export_slot_kv(self._state, job.pages,
                                                len(job.ids))
@@ -1609,7 +1704,7 @@ class Scheduler:
         that). Bucket mirrors the engine's export compile unit
         (_export_bucket: pow2 CLAMPED at the slot's page capacity — an
         unclamped key would name a program that never compiles)."""
-        export_s = time.perf_counter() - t0
+        export_s = clock.perf() - t0
         REGISTRY.histogram("kv_export_s").observe(export_s)
         pb = min(pow2_bucket(int(payload.get("n_pages", 1))),
                  int(getattr(self.core, "max_pages_per_slot", 1 << 30)))
@@ -1679,7 +1774,7 @@ class Scheduler:
         caller must have verified :meth:`_snapshot_eligible`."""
         req = job.request
         written = job.total_len - 1
-        t0 = time.perf_counter()
+        t0 = clock.perf()
         payload = self.core.export_slot_kv(self._state, job.pages, written,
                                            fetch=fetch)
         self._commit_export(payload, job, t0, tokens=written)
@@ -1725,7 +1820,7 @@ class Scheduler:
     def _prune_outbox(self) -> None:
         """Expire outbox entries past APP_EVAC_TTL_S (caller holds
         _evac_lock). Insertion order == age order (OrderedDict)."""
-        now = time.monotonic()
+        now = clock.mono()
         while self._evac_outbox:
             rid, (_payload, parked) = next(iter(self._evac_outbox.items()))
             if now - parked <= self._evac_ttl_s:
@@ -1867,14 +1962,17 @@ class Scheduler:
             with self._evac_lock:
                 self._prune_outbox()
                 self._evac_outbox[req.request_id] = (payload,
-                                                     time.monotonic())
+                                                     clock.mono())
                 self._evac_outbox.move_to_end(req.request_id)
                 while len(self._evac_outbox) > self._evac_outbox_cap:
                     self._evac_outbox.popitem(last=False)
         req.slo_outcome = req.slo_outcome or "evacuated"
-        req.finished_at = time.perf_counter()
+        req.finished_at = clock.perf()
         REGISTRY.counter("requests_finished",
                          labels={"finish": "evacuated"}).inc()
+        if TRACE.enabled:
+            self._trace("migrate", req, snapshot=payload is not None,
+                        generated=len(job.gen_ids))
         slo_mod.SLO.observe(req)
         self._bill_pages(job)
         job.page_clock = 0.0
@@ -1934,6 +2032,9 @@ class Scheduler:
         logger.info("spilled request %s at %d generated tokens (%d bytes "
                     "host)", req.request_id, len(job.gen_ids),
                     self._spill.used_bytes)
+        if TRACE.enabled:
+            self._trace("spill", req, generated=len(job.gen_ids),
+                        pool_used_bytes=self._spill.used_bytes)
         return True
 
     def _tier_contribute(self, job: _Job, payload: dict) -> None:
@@ -1971,7 +2072,7 @@ class Scheduler:
         job.spill = None
         if self._spill is not None:
             self._spill.release(req.request_id, outcome="promoted")
-        now = time.perf_counter()
+        now = clock.perf()
         try:
             self._state = self.core.import_slot_kv(
                 self._state, job.slot, job.pages, payload)
@@ -1991,6 +2092,10 @@ class Scheduler:
                        retain=False)
         REGISTRY.counter("spill_resumes").inc()
         req.spill_resumes += 1
+        if TRACE.enabled:
+            self._trace("promote", req, source="spill",
+                        generated=len(job.gen_ids),
+                        length=int(payload.get("length", 0)))
         if self._spec_w > 1 and hasattr(self.core, "seed_history"):
             self._state = self.core.seed_history(self._state, job.slot,
                                                  job.ids)
@@ -2156,6 +2261,9 @@ class Scheduler:
         REGISTRY.counter("preemptions").inc()
         logger.info("preempted request %s at %d generated tokens",
                     job.request.request_id, len(job.gen_ids))
+        if TRACE.enabled:
+            self._trace("preempt", job.request, mode="recompute",
+                        generated=len(job.gen_ids))
 
     @property
     def _steps(self) -> int:
@@ -2252,7 +2360,7 @@ class Scheduler:
             chunk_ids = job.ids[start:start + self.core.chunk]
             last = start + len(chunk_ids) >= len(job.ids)
             if start == job.shared:
-                job.prefill_started = time.perf_counter()
+                job.prefill_started = clock.perf()
                 if req.prefill_start_at is None:
                     req.prefill_start_at = job.prefill_started
             # grammared finals sample their fused first token under the
@@ -2376,7 +2484,7 @@ class Scheduler:
                  if j.first_pending and not j.first_inflight]
         for _, j in fresh:
             j.first_inflight = True   # only the first dispatch resolves it
-        t0 = time.perf_counter()
+        t0 = clock.perf()
         use_grammar = any(j.gram_on for j in self._slots.values())
         want_top = any(j.request.logprobs and j.request.top_logprobs > 0
                        for j in self._slots.values())
@@ -2450,9 +2558,14 @@ class Scheduler:
                         + g_bucket * self.core.chunk)
         self._ragged_row_util = active_q / padded_q
         REGISTRY.gauge("ragged_row_util").set(round(self._ragged_row_util, 4))
-        REGISTRY.histogram("decode_issue_s").observe(time.perf_counter() - t0)
+        REGISTRY.histogram("decode_issue_s").observe(clock.perf() - t0)
         REGISTRY.histogram("decode_batch_fill").observe(
             len(self._slots) / self.core.batch)
+        if TRACE.enabled:
+            TRACE.emit("dispatch", phase="decode", steps=steps,
+                       width=width, slots=len(self._slots),
+                       mixed=packed_chunks is not None,
+                       fill=round(len(self._slots) / self.core.batch, 4))
         # devtime ledger (observability/devtime.py): classify this dispatch
         # into its XLA compile-unit key. Grammar and top-logprob variants
         # ARE separate compiles (static args), so they split the program
@@ -2500,7 +2613,7 @@ class Scheduler:
         # dispatch bound (engine/watchdog.py reads the head entry's age)
         self._inflight.append((steps * w_disp, packed, fresh,
                                dict(self._slots),
-                               (time.monotonic(), steps)))
+                               (clock.mono(), steps)))
         self._pending_steps += steps * w_disp
         REGISTRY.counter("decode_steps").inc(steps)
         if packed_chunks is not None:
@@ -2530,12 +2643,12 @@ class Scheduler:
         # coarser tick-stall heartbeat)
         positions, packed, fresh, active_map, issued = self._inflight[0]
         # one transfer per dispatch, already in flight on the fetcher thread
-        t0 = time.perf_counter()
+        t0 = clock.perf()
         out = unpack_decode_out(packed.result())
         self._inflight.popleft()
         self._pending_steps -= positions
-        REGISTRY.histogram("sync_wait_s").observe(time.perf_counter() - t0)
-        now = time.perf_counter()
+        REGISTRY.histogram("sync_wait_s").observe(clock.perf() - t0)
+        now = clock.perf()
         REGISTRY.counter("tokens_generated").inc(int(out["emitted"].sum()))
         # acceptance telemetry + the adaptive-width controller's EMA feed;
         # the dispatch's OWN width (positions / steps — ladder rungs vary
@@ -2651,7 +2764,7 @@ class Scheduler:
             landed_ids = {id(ff) for ff in landed}
             self._first_fetches = [ff for ff in self._first_fetches
                                    if id(ff) not in landed_ids]
-            now = time.perf_counter()
+            now = clock.perf()
             for fut, pairs in landed:
                 snap_host = fut.result()      # (2, B): tokens, logprob bits
                 for slot, job, epoch in pairs:
@@ -2751,7 +2864,7 @@ class Scheduler:
         logger.info("engine driver thread started (slots=%d pages=%d)",
                     self.core.batch, self.core.num_pages)
         while self._running:
-            self.last_tick_mono = time.monotonic()
+            self.last_tick_mono = clock.mono()
             try:
                 if not self._tick():
                     # idle: wait for work without burning the core

@@ -1201,6 +1201,14 @@ def install_sigterm_drain(scheduler: Scheduler,
         def _exit_after_grace():
             time.sleep(grace_s)
             log.warning("drain grace elapsed; exiting")
+            # os._exit skips atexit: push the event-trace tail to its
+            # file sink first, or a rotation loses the last <128 records
+            try:
+                from generativeaiexamples_tpu.observability.trace import (
+                    TRACE)
+                TRACE.flush()
+            except Exception:   # tpulint: disable=except-swallow -- a failed best-effort flush must not block the drain exit; the write-error counter inside _write already accounts sink failures
+                pass
             exit_fn()
 
         _threading.Thread(target=_exit_after_grace, daemon=True).start()

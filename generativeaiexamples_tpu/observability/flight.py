@@ -19,9 +19,10 @@ strictly memory-capped, both free when nobody reads them:
     (queued → admitted → prefill_start → first_token → finished, plus
     preemption count, prefix-hit tokens, finish cause), looked up by
     request id via ``GET /debug/requests/<id>`` and stamped onto the engine
-    server's spans. Phase stamps all come from ``time.perf_counter()`` (one
-    monotonic clock), so phase ordering is exact; ``finished_unix`` anchors
-    the timeline to the wall clock for cross-log correlation.
+    server's spans. Phase stamps all come from the injected perf clock
+    (core/clock.py — ``time.perf_counter`` live, virtual under the
+    simulator), so phase ordering is exact; ``finished_unix`` anchors the
+    timeline to the wall clock for cross-log correlation.
 
 Env knobs: ``APP_FLIGHT_CAPACITY`` (samples, default 4096),
 ``APP_FLIGHT_INTERVAL_MS`` (default 250 — ~17 min of history at the
@@ -35,10 +36,10 @@ import json
 import logging
 import os
 import threading
-import time
 from collections import OrderedDict, deque
 from typing import Any, Callable, Deque, Dict, List, Mapping, Optional
 
+from generativeaiexamples_tpu.core import clock
 from generativeaiexamples_tpu.core.metrics import REGISTRY
 
 logger = logging.getLogger(__name__)
@@ -56,6 +57,37 @@ def _env_float(name: str, default: float) -> float:
         return float(os.environ.get(name, "") or default)
     except ValueError:
         return default
+
+
+def _policy_state() -> Dict[str, Any]:
+    """Point-in-time QoS virtual-time state + KV-tier occupancy for the
+    crash-dump artifact. Both planes live in the engine package, whose
+    import pulls jax — a process that never loaded it (router, encoder)
+    CANNOT have registered either object, so consult sys.modules instead
+    of importing (the /debug/qos handler's idiom, server/common.py)."""
+    import sys
+    out: Dict[str, Any] = {}
+    qos_mod = sys.modules.get("generativeaiexamples_tpu.engine.qos")
+    if qos_mod is not None:
+        try:
+            out["qos"] = qos_mod.debug_payload()
+        except Exception:
+            logger.exception("flight dump: qos snapshot failed")
+    tier_mod = sys.modules.get("generativeaiexamples_tpu.engine.kv_tier")
+    if tier_mod is not None:
+        try:
+            out["kv_tier"] = tier_mod.occupancy_payload()
+        except Exception:
+            logger.exception("flight dump: kv-tier snapshot failed")
+    # the event-trace tail rides the dump too: a post-incident artifact
+    # should carry the last decisions, not just the last gauges
+    try:
+        from generativeaiexamples_tpu.observability.trace import TRACE
+        out["trace"] = {**TRACE.describe(),
+                        "tail": TRACE.window(600.0, limit=512)}
+    except Exception:
+        logger.exception("flight dump: trace tail failed")
+    return out
 
 
 class FlightRecorder:
@@ -81,7 +113,7 @@ class FlightRecorder:
         """Record a sample iff the interval has elapsed. ``fields_fn`` is
         only invoked when a sample is due — the fast path is one clock
         read, cheap enough for every scheduler tick."""
-        now = time.monotonic()
+        now = clock.mono()
         if now - self._last_t < self.interval_s:
             return False
         with self._lock:
@@ -101,8 +133,8 @@ class FlightRecorder:
         (monotonic — what every delta and window cutoff computes from, so
         an NTP step can never produce a negative tok/s or swallow a
         window)."""
-        now = time.monotonic()
-        sample: Dict[str, Any] = {"ts": time.time(), "mono": now}
+        now = clock.mono()
+        sample: Dict[str, Any] = {"ts": clock.wall(), "mono": now}
         sample.update(fields)
         with self._lock:
             prev = self._prev
@@ -127,7 +159,7 @@ class FlightRecorder:
         gate and never touch the periodic ring or its tok/s delta chain:
         sample consumers iterate a fixed field shape that an interleaved
         event would break."""
-        sample: Dict[str, Any] = {"ts": time.time(), "mono": time.monotonic(),
+        sample: Dict[str, Any] = {"ts": clock.wall(), "mono": clock.mono(),
                                   "event": name}
         sample.update(fields)
         with self._lock:
@@ -140,7 +172,7 @@ class FlightRecorder:
         with self._lock:
             events = list(self._events)
         if seconds is not None:
-            cutoff = time.monotonic() - seconds
+            cutoff = clock.mono() - seconds
             events = [e for e in events if e["mono"] >= cutoff]
         return events
 
@@ -152,7 +184,7 @@ class FlightRecorder:
         with self._lock:
             samples = list(self._ring)
         if seconds is not None:
-            cutoff = time.monotonic() - seconds
+            cutoff = clock.mono() - seconds
             samples = [s for s in samples if s["mono"] >= cutoff]
         if limit is not None and len(samples) > limit:
             samples = samples[len(samples) - limit:]
@@ -178,9 +210,14 @@ class FlightRecorder:
                 "events_held": n_events}
 
     def dump(self, path: str) -> str:
-        """Write the full ring as JSON (the SIGUSR1 / post-incident dump)."""
-        payload = {"dumped_at_unix": time.time(), **self.describe(),
-                   "samples": self.window(), "events": self.events()}
+        """Write the full ring as JSON (the SIGUSR1 / post-incident dump),
+        plus the QoS virtual-time state, KV-tier occupancy, and the event
+        trace's recent tail when those planes are loaded — the crash
+        artifact answers "what was the policy state" without a second
+        probe of a possibly-dead server."""
+        payload = {"dumped_at_unix": clock.wall(), **self.describe(),
+                   "samples": self.window(), "events": self.events(),
+                   **_policy_state()}
         with open(path, "w", encoding="utf-8") as fh:
             json.dump(payload, fh)
         return path
@@ -198,7 +235,7 @@ _PHASES = ("queued", "admitted", "prefill_start", "first_token", "finished")
 
 def timeline(req: Any) -> Dict[str, Any]:
     """Serializable timeline of a scheduler Request. Phase values share the
-    ``time.perf_counter`` clock (monotonic ordering is exact); unreached
+    injected perf clock (monotonic ordering is exact); unreached
     phases (e.g. a request failed before admission) are omitted."""
     stamps = {
         "queued": getattr(req, "submitted_at", None),
@@ -235,7 +272,7 @@ def timeline(req: Any) -> Dict[str, Any]:
         # slo_requests_total agree per request
         "slo_class": getattr(req, "slo_class", None),
         "slo": getattr(req, "slo", None),
-        "finished_unix": time.time(),
+        "finished_unix": clock.wall(),
     }
     durations: Dict[str, float] = {}
     q = stamps["queued"]

@@ -1,0 +1,695 @@
+"""Discrete-event trace replay + what-if simulator (docs/simulation.md).
+
+Time-travel observability: load a canonical fleet event trace
+(observability/trace.py), reconstruct its arrival process, and re-drive
+the REAL policy objects — ``engine/scheduler.Scheduler``, the QoS
+admission plane (engine/qos.py), the KV spill pool / prefix tier
+(engine/kv_tier.py), and the router's placement primitives
+(server/failover.py) — on a :class:`core.clock.VirtualClock` across N
+simulated replicas. No mocks of policy code: what admitted, preempted,
+spilled, promoted, or shed in the simulation is decided by exactly the
+code that would decide it live. Only the DEVICE is faked
+(engine/fakecore.py), and it charges perfmodel-estimated seconds per
+dispatch, which is what advances the virtual clock.
+
+Determinism: every replica's dispatch executor is replaced with an
+inline (same-thread) one, so futures resolve synchronously and a run is
+a pure function of (workload, knobs). Prompts are synthesized from
+``(request_id, prompt_tokens)``, so a trace RECORDED by this simulator
+replays token-identically — ``make simulate-smoke`` asserts zero drift.
+Traces recorded from live traffic replay the same arrival process and
+cost model but synthetic token content; the fidelity report
+(:func:`fidelity_report`) quantifies the per-metric drift instead of
+assuming it away (caveats in docs/simulation.md).
+
+What-if knobs (the CLI): replica count, tenant weights/quotas
+(``APP_QOS_*``), spill/tier bytes (``APP_KV_SPILL_MB`` /
+``APP_KV_TIER``), and ``tuned_prefill_share`` (``APP_PREFILL_SHARE``,
+parallel/topology.py). A 100-replica synthetic run completes in seconds
+on CPU (``make simulate``) because virtual seconds cost nothing — only
+dispatch bookkeeping does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from generativeaiexamples_tpu.core import clock
+from generativeaiexamples_tpu.observability.trace import TRACE, read_jsonl
+
+_QUANTUM_S = 2e-4          # virtual step when no dispatch consumed time
+_DEADLINE_MS_DEFAULT = 8000.0
+
+
+def _jain_index(values: Sequence[float]) -> Optional[float]:
+    """Jain's fairness index: (Σx)²/(n·Σx²) — 1.0 = equal shares (the
+    same expression bench.py's goodput round reports)."""
+    values = [float(v) for v in values]
+    if not values:
+        return None
+    sq = sum(v * v for v in values)
+    if sq <= 0:
+        return None
+    return round(sum(values) ** 2 / (len(values) * sq), 4)
+
+
+def _pct(sorted_vals: List[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    ix = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return round(sorted_vals[ix], 6)
+
+
+class _InlineExecutor:
+    """Same-thread stand-in for the scheduler's fetcher pool: futures
+    resolve before submit() returns, so replay never races a thread
+    scheduler — the determinism the round-trip fidelity test asserts."""
+
+    def submit(self, fn, *args, **kw) -> Future:
+        fut: Future = Future()
+        try:
+            fut.set_result(fn(*args, **kw))
+        except BaseException as exc:   # tpulint: disable=except-swallow -- mirrors Executor.submit semantics: the error is DELIVERED via the future; the scheduler's fetch path re-raises it
+            fut.set_exception(exc)
+        return fut
+
+    def shutdown(self, wait: bool = True, **kw) -> None:
+        return None
+
+
+# ---------------------------------------------------------------- workload
+
+
+@dataclass
+class Arrival:
+    """One reconstructed (or synthesized) request arrival."""
+
+    t: float                  # virtual arrival instant (mono seconds)
+    rid: str
+    tenant: str
+    prompt_tokens: int
+    max_tokens: int
+    slo_class: str = ""
+    deadline_s: Optional[float] = None
+    affinity: str = ""        # router stickiness key (conversation id)
+    prompt: List[int] = field(default_factory=list)
+
+
+def _family_of(rid: str, families: int = 64) -> int:
+    h = hashlib.blake2b(rid.encode("utf-8"), digest_size=4).digest()
+    return int.from_bytes(h, "little") % max(1, families)
+
+
+def synth_prompt(rid: str, n: int) -> List[int]:
+    """Deterministic prompt content from (request_id, length): record and
+    replay runs regenerate the SAME bytes, so FakeCore's content-hash
+    sampler produces token-identical streams — the round-trip fidelity
+    contract. Same-family openings repeat across rids (prefix-cache and
+    tier promotion stay exercised)."""
+    fam = _family_of(rid)
+    return [32 + (i * 11 + fam * 7) % 150 for i in range(max(1, n))]
+
+
+def synthetic_arrivals(requests: int = 50, seed: int = 0,
+                       deadline_ms: float = _DEADLINE_MS_DEFAULT,
+                       antagonist: bool = True,
+                       max_tokens: int = 16,
+                       prompt_tokens: int = 24,
+                       pace_s: float = 0.05) -> List[Arrival]:
+    """The GOODPUT-round workload shape (bench.py run_goodput_round):
+    one ``flood`` tenant fires everything at t=0 (best_effort,
+    sheddable) while ``obey_a``/``obey_b`` pace their interactive-class
+    requests — the antagonist scenario the QoS what-if sweep runs over.
+    ``antagonist=False`` degrades to a single paced tenant."""
+    out: List[Arrival] = []
+    if not antagonist:
+        for i in range(requests):
+            rid = f"sim-{seed:03d}-{i:05d}"
+            out.append(Arrival(
+                t=i * pace_s, rid=rid, tenant="solo",
+                prompt_tokens=prompt_tokens, max_tokens=max_tokens,
+                slo_class="interactive",
+                deadline_s=deadline_ms / 1000.0,
+                affinity=f"conv-{_family_of(rid, 8)}",
+                prompt=synth_prompt(rid, prompt_tokens)))
+        return out
+    obey_n = max(1, requests // 3)
+    flood_n = requests - 2 * obey_n
+    for tenant_ix, tenant in enumerate(("obey_a", "obey_b")):
+        for i in range(obey_n):
+            rid = f"sim-{seed:03d}-{tenant}-{i:05d}"
+            out.append(Arrival(
+                t=i * pace_s + tenant_ix * pace_s / 2, rid=rid,
+                tenant=tenant, prompt_tokens=prompt_tokens,
+                max_tokens=max_tokens, slo_class="interactive",
+                deadline_s=deadline_ms / 1000.0,
+                affinity=f"{tenant}-conv-{_family_of(rid, 4)}",
+                prompt=synth_prompt(rid, prompt_tokens)))
+    for i in range(max(0, flood_n)):
+        rid = f"sim-{seed:03d}-flood-{i:05d}"
+        out.append(Arrival(
+            t=0.0, rid=rid, tenant="flood",
+            prompt_tokens=prompt_tokens, max_tokens=max_tokens,
+            slo_class="best_effort", deadline_s=deadline_ms / 1000.0,
+            affinity=f"flood-conv-{_family_of(rid, 4)}",
+            prompt=synth_prompt(rid, prompt_tokens)))
+    return out
+
+
+def arrivals_from_trace(records: List[dict]) -> List[Arrival]:
+    """Reconstruct the arrival process from a trace's ``submit`` records:
+    virtual arrival offsets are the recorded mono stamps rebased to the
+    first submission. Prompt CONTENT is synthesized from (rid,
+    prompt_tokens) — exact for simulator-recorded traces, a documented
+    approximation for live ones."""
+    subs = [r for r in records if r.get("kind") == "submit"
+            and not r.get("handoff")]
+    if not subs:
+        raise ValueError("trace holds no submit records — nothing to "
+                         "replay (was APP_TRACE=on during recording?)")
+    t0 = min(float(r.get("mono", 0.0)) for r in subs)
+    # simulator-recorded traces carry an "arrival" supplement with the
+    # router affinity key (client-side state no scheduler record has);
+    # live traces fall back to the learned prefix hash, then the rid
+    affinity = {str(r.get("rid")): str(r.get("affinity", "") or "")
+                for r in records if r.get("kind") == "arrival"}
+    out: List[Arrival] = []
+    for r in sorted(subs, key=lambda r: (float(r.get("mono", 0.0)),
+                                         int(r.get("seq", 0)))):
+        rid = str(r.get("rid", "")) or f"trace-{r.get('seq', 0)}"
+        n = int(r.get("prompt_tokens", 1) or 1)
+        out.append(Arrival(
+            t=float(r.get("mono", 0.0)) - t0, rid=rid,
+            tenant=str(r.get("tenant", "") or ""),
+            prompt_tokens=n,
+            max_tokens=int(r.get("max_tokens", 16) or 16),
+            slo_class=str(r.get("slo", "") or ""),
+            deadline_s=r.get("deadline_s"),
+            affinity=(affinity.get(rid)
+                      or str(r.get("prefix", "") or rid)),
+            prompt=synth_prompt(rid, n)))
+    return out
+
+
+# ---------------------------------------------------------------- replicas
+
+
+@dataclass
+class SimConfig:
+    """What-if knobs — each maps to the env contract the live stack
+    already honors, applied only for the replica-construction scope."""
+
+    replicas: int = 1
+    qos: str = "off"                       # APP_QOS
+    tenant_weights: str = ""               # APP_QOS_TENANT_WEIGHTS
+    tenant_quota: str = ""                 # APP_QOS_TOKENS_PER_S
+    tier_mb: int = 0                       # APP_KV_SPILL_MB (+ tier mode)
+    tier_mode: str = ""                    # "" | "prefix"
+    prefill_share: Optional[float] = None  # APP_PREFILL_SHARE
+    batch: int = 4
+    max_seq: int = 96
+    page_size: int = 8
+    num_pages: int = 0
+    chunk: int = 16
+    steps: int = 2
+    group: int = 4
+    prefix_cache: bool = True
+
+    def env(self) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        if self.qos and self.qos != "off":
+            out["APP_QOS"] = self.qos
+            if self.tenant_weights:
+                out["APP_QOS_TENANT_WEIGHTS"] = self.tenant_weights
+            if self.tenant_quota:
+                out["APP_QOS_TOKENS_PER_S"] = self.tenant_quota
+        if self.tier_mb > 0:
+            out["APP_KV_SPILL_MB"] = str(self.tier_mb)
+            if self.tier_mode:
+                out["APP_KV_TIER"] = self.tier_mode
+        if self.prefill_share is not None:
+            out["APP_PREFILL_SHARE"] = str(self.prefill_share)
+        return out
+
+
+class SimReplica:
+    """One simulated engine worker: FakeCore (perfmodel-costed virtual
+    device) + the REAL Scheduler, its dispatch executor made inline."""
+
+    def __init__(self, ix: int, cfg: SimConfig) -> None:
+        from generativeaiexamples_tpu.engine.fakecore import FakeCore
+        from generativeaiexamples_tpu.engine.scheduler import Scheduler
+        from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+        self.ix = ix
+        self.url = f"sim://replica/{ix}"
+        self.core = FakeCore(
+            batch=cfg.batch, max_seq=cfg.max_seq, page_size=cfg.page_size,
+            num_pages=cfg.num_pages, chunk=cfg.chunk, steps=cfg.steps,
+            group=cfg.group, prefix_cache=cfg.prefix_cache)
+        self.sched = Scheduler(self.core, ByteTokenizer())
+        # never .start(): the simulator's loop IS the driver thread
+        self.sched._fetcher.shutdown(wait=False)
+        self.sched._fetcher = _InlineExecutor()
+
+    def close(self) -> None:
+        self.sched._fetcher.shutdown(wait=False)
+
+
+def build_replicas(cfg: SimConfig) -> List[SimReplica]:
+    """Construct N replicas under the config's env scope (the same
+    env-var contract the live worker boot reads), restoring the caller's
+    environment afterwards."""
+    env = cfg.env()
+    saved = {k: os.environ.get(k) for k in
+             ("APP_QOS", "APP_QOS_TENANT_WEIGHTS", "APP_QOS_TOKENS_PER_S",
+              "APP_KV_SPILL_MB", "APP_KV_TIER", "APP_PREFILL_SHARE")}
+    os.environ.update(env)
+    for k in saved:
+        if k not in env:
+            os.environ.pop(k, None)
+    try:
+        return [SimReplica(i, cfg) for i in range(max(1, cfg.replicas))]
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# ------------------------------------------------------------------ router
+
+
+class SimRouter:
+    """Placement over simulated replicas driving the REAL router policy
+    primitives (server/failover.py): real ``_Worker`` scoring cards fed
+    from each replica's real ``load_stats()``, the real rendezvous hash
+    for prefix affinity, and the same slack/promote comparison ``_pick``
+    runs — without the HTTP probe machinery around them."""
+
+    def __init__(self, replicas: List[SimReplica],
+                 affinity_slack: Optional[float] = None) -> None:
+        from generativeaiexamples_tpu.server import failover
+        self._failover = failover
+        self._replicas = replicas
+        self._workers = [failover._Worker(r.url) for r in replicas]
+        self.affinity_slack = (
+            affinity_slack if affinity_slack is not None
+            else float(os.environ.get("APP_ROUTER_AFFINITY_SLACK", "")
+                       or 1.0))
+        self.outcomes: Dict[str, int] = {}
+
+    def _refresh(self) -> None:
+        for w, r in zip(self._workers, self._replicas):
+            stats = r.sched.load_stats()
+            w.running = int(stats.get("running", 0))
+            w.prefilling = int(stats.get("prefilling", 0))
+            w.waiting = int(stats.get("waiting", 0))
+            w.batch = int(stats.get("batch", 0) or r.core.batch)
+            w.prefix_hit_frac = float(stats.get("prefix_hit_frac", 0.0))
+            hot = stats.get("kv_tier_hot")
+            w.kv_tier_hot = (frozenset(str(h) for h in hot)
+                             if hot else frozenset())
+
+    def place(self, arrival: Arrival) -> int:
+        """Replica index for this arrival — least-loaded with rendezvous
+        affinity and tier-promote override, exactly the live ordering."""
+        self._refresh()
+        workers = self._workers
+        best = min(workers, key=lambda w: w.score)
+        outcome = "load"
+        if arrival.affinity and len(workers) > 1:
+            pref = self._failover.FailoverLLM._rendezvous(
+                arrival.affinity, workers)
+            slack = self.affinity_slack * (1.0 + pref.prefix_hit_frac)
+            h0 = ""
+            if arrival.prompt:
+                h0 = self._replicas[0].sched.prefix_key_hex(arrival.prompt)
+            promote = None
+            if h0 and h0 not in pref.kv_tier_hot:
+                adv = [w for w in workers if h0 in w.kv_tier_hot]
+                if adv:
+                    promote = min(adv, key=lambda w: w.score)
+            if promote is not None and promote.score <= best.score + slack:
+                best = promote
+                outcome = "promote"
+            elif pref.score <= best.score + slack:
+                best = pref
+                outcome = "affinity"
+        best.dispatched += 1
+        best.total_dispatched += 1
+        self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
+        return workers.index(best)
+
+
+# ------------------------------------------------------------------- drive
+
+
+def simulate(arrivals: List[Arrival], cfg: SimConfig,
+             record_trace: Optional[str] = None) -> Dict[str, Any]:
+    """Run the workload to completion on a virtual clock; returns the
+    flight/goodput-family metric summary plus per-request records.
+    ``record_trace`` arms APP_TRACE-equivalent recording during the run
+    and dumps the ring as JSONL to that path (the simulate-smoke
+    round-trip records through here)."""
+    from generativeaiexamples_tpu.engine.scheduler import Request
+
+    wall0 = time.perf_counter()
+    arrivals = sorted(arrivals, key=lambda a: (a.t, a.rid))
+    vc = clock.VirtualClock()
+    # the run ALWAYS records through the event-trace ring (no file sink
+    # unless asked): finish order and the fidelity comparison read the
+    # trace's own total order (seq), not a per-tick approximation. The
+    # caller's live trace state is restored afterwards.
+    prev_trace = (TRACE.enabled, TRACE.path)
+    TRACE.configure(mode="on", path="")
+    TRACE.reset()
+    with clock.use(vc):
+        replicas = build_replicas(cfg)
+        router = SimRouter(replicas)
+        reqs: List[tuple] = []
+        finished: set = set()
+        next_ix = 0
+        ticks = 0
+        tick_cap = max(20000, 400 * len(arrivals))
+        try:
+            while True:
+                now = clock.mono()
+                while (next_ix < len(arrivals)
+                       and arrivals[next_ix].t <= now + 1e-12):
+                    a = arrivals[next_ix]
+                    next_ix += 1
+                    req = Request(prompt_ids=list(a.prompt),
+                                  max_tokens=a.max_tokens,
+                                  temperature=0.0, tenant=a.tenant,
+                                  request_id=a.rid, seed=1,
+                                  slo_class=a.slo_class,
+                                  deadline_s=a.deadline_s)
+                    r_ix = router.place(a)
+                    if TRACE.enabled:
+                        # simulator supplement: the router affinity key is
+                        # client-side state no scheduler record carries —
+                        # replaying THIS trace must place with the same key
+                        TRACE.emit("arrival", rid=a.rid,
+                                   affinity=a.affinity, replica=r_ix)
+                    replicas[r_ix].sched.submit(req)
+                    reqs.append((req, a, r_ix))
+                worked = False
+                dt = 0.0
+                for rep in replicas:
+                    if rep.sched._tick():
+                        worked = True
+                    dt = max(dt, rep.core.take_consumed())
+                for req, _a, _r in reqs:
+                    if (req.finished_at is not None
+                            and req.request_id not in finished):
+                        finished.add(req.request_id)
+                ticks += 1
+                if ticks > tick_cap:
+                    raise RuntimeError(
+                        f"simulator livelock: {len(finished)}/"
+                        f"{len(arrivals)} finished after {ticks} ticks")
+                if (len(finished) >= len(arrivals)
+                        and next_ix >= len(arrivals)):
+                    break
+                if dt > 0:
+                    vc.advance(dt)
+                elif not worked and next_ix < len(arrivals):
+                    vc.advance_to(max(clock.mono() + _QUANTUM_S,
+                                      arrivals[next_ix].t))
+                else:
+                    # host-only tick (admission, fetch bookkeeping, or a
+                    # quota-throttled idle pass): a small quantum keeps
+                    # refill/deadline clocks moving
+                    vc.advance(_QUANTUM_S if not worked else 1e-5)
+            span_s = clock.mono()
+        finally:
+            for rep in replicas:
+                rep.close()
+    # the trace's seq field is the run's total order — finish order reads
+    # it directly (two finishes inside one tick keep their true order)
+    finish_order = [str(r.get("rid")) for r in sorted(
+        (r for r in TRACE.records() if r.get("kind") == "finish"),
+        key=lambda r: int(r.get("seq", 0)))]
+    if record_trace is not None:
+        TRACE.dump_jsonl(record_trace)
+    TRACE.reset()
+    TRACE.configure(mode="on" if prev_trace[0] else "off",
+                    path=prev_trace[1] or "")
+    result = _summarize(reqs, finish_order, span_s, cfg, router)
+    result["ticks"] = ticks
+    result["wall_seconds"] = round(time.perf_counter() - wall0, 3)
+    return result
+
+
+def _summarize(reqs: List[tuple], finish_order: List[str], span_s: float,
+               cfg: SimConfig, router: SimRouter) -> Dict[str, Any]:
+    per_req: List[dict] = []
+    tenants: Dict[str, dict] = {}
+    finishes: Dict[str, int] = {}
+    for req, a, r_ix in reqs:
+        fin = (req.finish_reason or ("error" if req.error else "none"))
+        finishes[fin] = finishes.get(fin, 0) + 1
+        ttft = (round(req.first_token_at - req.submitted_at, 6)
+                if req.first_token_at is not None else None)
+        e2e = (round(req.finished_at - req.submitted_at, 6)
+               if req.finished_at is not None else None)
+        in_deadline = (req.error is None and e2e is not None
+                       and (req.deadline_s is None or e2e <= req.deadline_s))
+        per_req.append({
+            "rid": req.request_id, "tenant": req.tenant, "replica": r_ix,
+            "prompt_tokens": len(req.prompt_ids),
+            "completion_tokens": req.completion_tokens,
+            "finish": fin, "ttft_s": ttft, "e2e_s": e2e,
+            "goodput": bool(in_deadline),
+            "preemptions": req.preemptions,
+            "spill_resumes": req.spill_resumes,
+            "prefix_hit_tokens": req.prefix_hit_tokens,
+            "tier_hit_tokens": req.tier_hit_tokens,
+        })
+        t = tenants.setdefault(req.tenant or "anon", {
+            "requests": 0, "completion_tokens": 0, "goodput": 0,
+            "ttfts": [], "sheds": 0})
+        t["requests"] += 1
+        t["completion_tokens"] += req.completion_tokens
+        t["goodput"] += int(in_deadline)
+        if req.slo_outcome == "shed":
+            t["sheds"] += 1
+        if ttft is not None:
+            t["ttfts"].append(ttft)
+    per_tenant: Dict[str, dict] = {}
+    for name, t in sorted(tenants.items()):
+        ttfts = sorted(t.pop("ttfts"))
+        per_tenant[name] = {
+            **t,
+            "goodput_frac": round(t["goodput"] / t["requests"], 4),
+            "tok_s": (round(t["completion_tokens"] / span_s, 2)
+                      if span_s > 0 else 0.0),
+            "ttft_p50_s": _pct(ttfts, 0.50),
+            "ttft_p95_s": _pct(ttfts, 0.95),
+        }
+    obeying = [v for k, v in per_tenant.items() if k != "flood"]
+    total_completion = sum(r["completion_tokens"] for r in per_req)
+    return {
+        "replicas": cfg.replicas,
+        "qos": cfg.qos,
+        "tenant_weights": cfg.tenant_weights,
+        "virtual_seconds": round(span_s, 6),
+        "requests": {"total": len(per_req), "finishes": finishes},
+        "completion_tokens": total_completion,
+        "goodput_tok_s": (round(total_completion / span_s, 2)
+                          if span_s > 0 else 0.0),
+        "per_tenant": per_tenant,
+        "jain_fair_obeying": _jain_index(
+            [t["goodput_frac"] for t in obeying]) if obeying else None,
+        "jain_fair_all": _jain_index(
+            [t["goodput_frac"] for t in per_tenant.values()]),
+        "route_outcomes": dict(sorted(router.outcomes.items())),
+        "finish_order": finish_order,
+        "requests_detail": per_req,
+    }
+
+
+# ---------------------------------------------------------------- fidelity
+
+
+def fidelity_report(trace_records: List[dict],
+                    result: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-metric drift between what a trace RECORDED and what the replay
+    produced. Zero across the board for simulator-recorded traces at
+    equal knobs (the smoke test's assertion); a quantified gap — not a
+    silent one — for live traces (docs/simulation.md caveats)."""
+    rec_fin = {str(r.get("rid")): r for r in trace_records
+               if r.get("kind") == "finish"}
+    sim_fin = {r["rid"]: r for r in result.get("requests_detail", [])}
+    both = sorted(set(rec_fin) & set(sim_fin))
+    tok_mismatch = [rid for rid in both
+                    if int(rec_fin[rid].get("completion_tokens", -1))
+                    != int(sim_fin[rid]["completion_tokens"])]
+
+    def _mean(vals: List[float]) -> Optional[float]:
+        return round(sum(vals) / len(vals), 6) if vals else None
+
+    rec_order = [str(r.get("rid")) for r in sorted(
+        (r for r in trace_records if r.get("kind") == "finish"),
+        key=lambda r: int(r.get("seq", 0)))]
+    sim_order = [rid for rid in result.get("finish_order", [])
+                 if rid in rec_fin]
+    rec_tok = sum(int(r.get("completion_tokens", 0) or 0)
+                  for r in rec_fin.values())
+    sim_tok = sum(int(r["completion_tokens"]) for r in sim_fin.values())
+    rec_ttft = _mean([float(r["ttft_s"]) for r in rec_fin.values()
+                      if r.get("ttft_s") is not None])
+    sim_ttft = _mean([float(r["ttft_s"]) for r in sim_fin.values()
+                      if r.get("ttft_s") is not None])
+    return {
+        "requests_traced": len(rec_fin),
+        "requests_replayed": len(sim_fin),
+        "matched": len(both),
+        "completion_tokens": {"traced": rec_tok, "replayed": sim_tok,
+                              "drift": sim_tok - rec_tok},
+        "token_mismatch_rids": tok_mismatch[:32],
+        "token_mismatches": len(tok_mismatch),
+        "finish_order_identical": rec_order == sim_order,
+        "ttft_mean_s": {"traced": rec_ttft, "replayed": sim_ttft,
+                        "drift": (round(sim_ttft - rec_ttft, 6)
+                                  if None not in (rec_ttft, sim_ttft)
+                                  else None)},
+    }
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def sweep_tenant_weight(arrivals: List[Arrival], cfg: SimConfig,
+                        multipliers: Sequence[float]) -> List[dict]:
+    """What-if sweep: scale the OBEYING tenants' weight 1x→Nx against a
+    fixed-weight antagonist and rerun — the acceptance check is the
+    obeying tenants' goodput share moving monotonically with their
+    weight."""
+    out: List[dict] = []
+    for m in multipliers:
+        w = max(1, int(round(2 * m)))
+        swept = SimConfig(**{**cfg.__dict__,
+                             "qos": "fair",
+                             "tenant_weights":
+                                 f"obey_a={w},obey_b={w},flood=1"})
+        res = simulate(list(arrivals), swept)
+        obey = [t for name, t in res["per_tenant"].items()
+                if name != "flood"]
+        flood = res["per_tenant"].get("flood", {})
+        obey_tok = sum(t["completion_tokens"] for t in obey)
+        total = obey_tok + flood.get("completion_tokens", 0)
+        out.append({
+            "multiplier": m,
+            "tenant_weights": swept.tenant_weights,
+            "obeying_goodput_frac": (
+                round(sum(t["goodput_frac"] for t in obey) / len(obey), 4)
+                if obey else None),
+            "obeying_token_share": (round(obey_tok / total, 4)
+                                    if total else None),
+            "obeying_ttft_p50_s": _pct(sorted(
+                t["ttft_p50_s"] for t in obey
+                if t["ttft_p50_s"] is not None), 0.5),
+            "jain_fair_obeying": res["jain_fair_obeying"],
+        })
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m generativeaiexamples_tpu.ops.simulate",
+        description="Replay a fleet event trace (or a synthetic workload) "
+                    "through the real scheduler/QoS/KV-tier/router policies "
+                    "on a virtual clock (docs/simulation.md)")
+    p.add_argument("--trace", default="", help="trace JSONL to replay "
+                   "(APP_TRACE_PATH sink, /debug/trace dump, or a "
+                   "simulator recording)")
+    p.add_argument("--synthetic", action="store_true",
+                   help="generate the goodput-round antagonist workload "
+                        "instead of loading a trace")
+    p.add_argument("--requests", type=int, default=60)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--replicas", type=int, default=1)
+    p.add_argument("--qos", default="off", choices=("off", "fair"))
+    p.add_argument("--tenant-weights", default="",
+                   help="APP_QOS_TENANT_WEIGHTS for the run, e.g. "
+                        "'obey_a=2,obey_b=2,flood=1'")
+    p.add_argument("--tenant-quota", default="",
+                   help="APP_QOS_TOKENS_PER_S, e.g. 'flood=150'")
+    p.add_argument("--tier-mb", type=int, default=0,
+                   help="host KV budget MB (APP_KV_SPILL_MB); with "
+                        "--tier-mode prefix arms the prefix tier")
+    p.add_argument("--tier-mode", default="", choices=("", "prefix"))
+    p.add_argument("--prefill-share", type=float, default=None,
+                   help="APP_PREFILL_SHARE what-if (parallel/topology.py "
+                        "tuned_prefill_share)")
+    p.add_argument("--deadline-ms", type=float,
+                   default=_DEADLINE_MS_DEFAULT)
+    p.add_argument("--pace-s", type=float, default=0.05,
+                   help="synthetic obeying-tenant inter-arrival seconds; "
+                        "tighten with --deadline-ms to saturate the "
+                        "deadline window (sweeps are flat otherwise)")
+    p.add_argument("--record-out", default="",
+                   help="dump the run's own event trace JSONL here")
+    p.add_argument("--sweep-weights", default="",
+                   help="comma list of obeying-tenant weight multipliers "
+                        "to sweep, e.g. '1,2,4'")
+    p.add_argument("--out", default="", help="write the JSON report here "
+                   "(default stdout)")
+    args = p.parse_args(argv)
+
+    cfg = SimConfig(replicas=args.replicas, qos=args.qos,
+                    tenant_weights=args.tenant_weights,
+                    tenant_quota=args.tenant_quota,
+                    tier_mb=args.tier_mb, tier_mode=args.tier_mode,
+                    prefill_share=args.prefill_share)
+    trace_records: Optional[List[dict]] = None
+    if args.trace:
+        trace_records = read_jsonl(args.trace)
+        arrivals = arrivals_from_trace(trace_records)
+    elif args.synthetic:
+        arrivals = synthetic_arrivals(requests=args.requests,
+                                      seed=args.seed,
+                                      deadline_ms=args.deadline_ms,
+                                      pace_s=args.pace_s)
+    else:
+        p.error("one of --trace PATH or --synthetic is required")
+        return 2
+
+    report: Dict[str, Any]
+    if args.sweep_weights:
+        mults = [float(x) for x in args.sweep_weights.split(",") if x]
+        report = {"sweep": sweep_tenant_weight(arrivals, cfg, mults),
+                  "replicas": cfg.replicas,
+                  "requests": len(arrivals)}
+    else:
+        report = simulate(arrivals, cfg,
+                          record_trace=args.record_out or None)
+        if trace_records is not None:
+            report["fidelity"] = fidelity_report(trace_records, report)
+        # the detail list is for programmatic consumers; the CLI report
+        # stays skimmable
+        report.pop("requests_detail", None)
+        if len(report.get("finish_order", ())) > 24:
+            report["finish_order"] = report["finish_order"][:24] + ["..."]
+    body = json.dumps(report, indent=2, default=str)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(body + "\n")
+        print(f"wrote {args.out}")
+    else:
+        print(body)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

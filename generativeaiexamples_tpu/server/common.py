@@ -40,6 +40,9 @@ FLIGHT_LIMIT_DEFAULT = 1024
 FLIGHT_LIMIT_MAX = 8192
 REQUESTS_LIMIT_DEFAULT = 50
 REQUESTS_LIMIT_MAX = 500
+TRACE_WINDOW_DEFAULT_S = 600.0
+TRACE_LIMIT_DEFAULT = 2048
+TRACE_LIMIT_MAX = 8192
 
 
 def parse_stop(value) -> list:
@@ -275,6 +278,34 @@ async def qos_handler(request: web.Request) -> web.Response:
     return web.json_response(qos_mod.debug_payload())
 
 
+async def trace_handler(request: web.Request) -> web.Response:
+    """Canonical fleet event trace (observability/trace.py, APP_TRACE=on):
+    the newest window of admission/dispatch/preempt/spill/promote/route/
+    finish records this process emitted, plus the stream's own health
+    (recorded/dropped/rotation path). ``?window=<seconds>`` bounds the
+    lookback (default 600 s), ``?limit=<n>`` the record count (newest
+    kept, hard cap 8192), ``?kind=a,b`` filters by record kind. Off mode
+    answers ``{"enabled": false}`` with the env hint — a definitive
+    answer on every process, never a 404 to interpret."""
+    from generativeaiexamples_tpu.observability.trace import TRACE
+    seconds = _query_number(request, "window", TRACE_WINDOW_DEFAULT_S, float)
+    limit = _query_number(request, "limit", TRACE_LIMIT_DEFAULT, int,
+                          maximum=TRACE_LIMIT_MAX)
+    kinds_raw = request.query.get("kind", "").strip()
+    kinds = ([k.strip() for k in kinds_raw.split(",") if k.strip()]
+             or None)
+    if not TRACE.enabled:
+        return web.json_response({
+            **TRACE.describe(),
+            "hint": "set APP_TRACE=on (worker env) to record the fleet "
+                    "event trace; docs/simulation.md"})
+    return web.json_response({**TRACE.describe(),
+                              "window_s": seconds,
+                              "limit": limit,
+                              "records": TRACE.window(seconds, limit=limit,
+                                                      kinds=kinds)})
+
+
 async def slo_handler(request: web.Request) -> web.Response:
     """Per-class SLO attainment, burn rates, pressure, recent breaches
     (observability/slo.py) — the operator view of 'are we keeping our
@@ -322,6 +353,9 @@ def add_debug_routes(app: web.Application, drain: bool = True) -> None:
         # QoS admission plane: tenant fair-queuing state + quota buckets
         # (docs/scheduling.md)
         web.get("/debug/qos", qos_handler),
+        # canonical fleet event trace: the replayable admission/dispatch/
+        # route record stream (docs/simulation.md)
+        web.get("/debug/trace", trace_handler),
     ])
 
 

@@ -61,6 +61,7 @@ from generativeaiexamples_tpu.observability import chaos as chaos_mod
 from generativeaiexamples_tpu.observability import otel
 from generativeaiexamples_tpu.observability import slo as slo_mod
 from generativeaiexamples_tpu.observability import usage as usage_mod
+from generativeaiexamples_tpu.observability.trace import TRACE
 from generativeaiexamples_tpu.server import resilience
 
 logger = logging.getLogger(__name__)
@@ -661,6 +662,15 @@ class FailoverLLM:
             REGISTRY.counter("router_dispatches",
                              labels={"worker": best.url,
                                      "role": best.role or "unified"}).inc()
+        if TRACE.enabled:
+            # placement decisions ride the same canonical stream the
+            # scheduler writes: a replayed trace reconstructs WHERE each
+            # request went and WHY (ops/simulate.py what-if routing)
+            TRACE.emit("route", worker=best.url,
+                       role=best.role or "unified",
+                       outcome=route_outcome or "load",
+                       affinity=affinity_outcome, charged=bool(charge),
+                       score=round(best.score, 4), pool=len(up))
         return best
 
     def _charge(self, w: _Worker) -> None:
@@ -672,6 +682,9 @@ class FailoverLLM:
         REGISTRY.counter("router_dispatches",
                          labels={"worker": w.url,
                                  "role": w.role or "unified"}).inc()
+        if TRACE.enabled:
+            TRACE.emit("hedge", worker=w.url,
+                       role=w.role or "unified")
 
     def _has_disagg(self) -> bool:
         """Serve disaggregated iff the pool holds at least one prefill-role

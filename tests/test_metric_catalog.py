@@ -1,0 +1,71 @@
+"""Metric-catalog sync — docs/observability.md vs what the code registers.
+
+Both directions are enforced: a metric the code registers but the catalog
+omits fails (operators cannot discover it), and a name the catalog lists
+but no code registers fails (a dashboard built on a documented-but-dead
+metric is silent doc rot). The code side comes from a pure-AST scan
+(`analysis/metrics_catalog.py`) so the test never imports jax-heavy
+modules.
+"""
+
+import os
+
+import generativeaiexamples_tpu
+from generativeaiexamples_tpu.analysis.metrics_catalog import (
+    CATALOG_BEGIN, CATALOG_END, collect_registered, parse_catalog,
+    pattern_matches)
+
+PKG_DIR = os.path.dirname(generativeaiexamples_tpu.__file__)
+DOC_PATH = os.path.join(PKG_DIR, os.pardir, "docs", "observability.md")
+
+
+def _sides():
+    static, dynamic = collect_registered(PKG_DIR)
+    with open(DOC_PATH, "r", encoding="utf-8") as f:
+        doc_names, doc_patterns = parse_catalog(f.read())
+    return static, dynamic, doc_names, doc_patterns
+
+
+def test_markers_present():
+    with open(DOC_PATH, "r", encoding="utf-8") as f:
+        text = f.read()
+    assert CATALOG_BEGIN in text and CATALOG_END in text
+    assert text.index(CATALOG_BEGIN) < text.index(CATALOG_END)
+
+
+def test_collector_sees_the_tree():
+    static, dynamic, _, _ = _sides()
+    # sanity floor: the scan really covered the package, not a stub dir
+    assert len(static) > 80, sorted(static)
+    assert "ttft_s" in static and "qos_virtual_time" in static
+    assert any("stage_" in p for p in dynamic)
+
+
+def test_every_registered_metric_is_documented():
+    static, dynamic, doc_names, doc_patterns = _sides()
+    undocumented = sorted(set(static) - doc_names)
+    assert undocumented == [], (
+        "registered but missing from the docs/observability.md catalog "
+        f"(add rows between the metric-catalog markers): {undocumented}")
+    unlisted = sorted(dynamic - doc_patterns)
+    assert unlisted == [], (
+        f"dynamic registration patterns missing from the catalog: {unlisted}")
+
+
+def test_no_documented_but_dead_metrics():
+    static, dynamic, doc_names, doc_patterns = _sides()
+    dead = sorted(doc_names - set(static))
+    assert dead == [], (
+        "documented in docs/observability.md but registered nowhere in "
+        f"code — delete the rows or restore the metrics: {dead}")
+    dead_patterns = sorted(doc_patterns - dynamic)
+    assert dead_patterns == [], (
+        f"documented dynamic patterns with no registering f-string: "
+        f"{dead_patterns}")
+
+
+def test_pattern_matcher_semantics():
+    assert pattern_matches("stage_*_s", "stage_retrieve_s")
+    assert pattern_matches("flight_*", "flight_tok_s")
+    assert not pattern_matches("stage_*_s", "stage_s")      # * is non-empty
+    assert not pattern_matches("slo_*_s", "slo_shed_total")

@@ -39,264 +39,21 @@ from __future__ import annotations
 import queue
 import time
 from dataclasses import dataclass
-from types import SimpleNamespace
 from typing import Dict, List, Optional
 
 import numpy as np
 import pytest
 
 from generativeaiexamples_tpu.engine import scheduler as sched_mod
-from generativeaiexamples_tpu.engine.kv_cache import PageAllocator
+# the fake paged core lives in the package now (engine/fakecore.py) so the
+# trace-replay simulator (ops/simulate.py) can drive it too; re-exported
+# here because the observability/QoS/chaos test files import it from this
+# module (the fuzz harness remains its behavioral anchor)
+from generativeaiexamples_tpu.engine.fakecore import (  # noqa: F401
+    EOS, VOCAB, FakeCore, _FakeState, _next_token, oracle)
 from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler, _STOP
 from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
 from generativeaiexamples_tpu.observability import chaos as chaos_mod
-
-EOS = 3
-VOCAB = 260
-
-
-def _next_token(context: List[int]) -> int:
-    """Deterministic 'model': next token from the FULL context. EOS appears
-    on a deterministic schedule so budget-exhaustion and eos paths both get
-    exercised."""
-    s = (sum(context) * 31 + len(context) * 7) & 0xFFFF
-    if s % 13 == 0:
-        return EOS
-    return 32 + s % (VOCAB - 64)
-
-
-def oracle(prompt: List[int], max_tokens: int, max_seq: int) -> List[int]:
-    """Solo-run reference: what a correct engine must stream for a prompt.
-    Generation ends at eos, the token budget, or cache capacity (the engine
-    retires a slot when its context reaches max_seq - 1; the capacity-step
-    token itself is still emitted, the eos token never is)."""
-    ctx = list(prompt)
-    out: List[int] = []
-    cap = max(0, max_seq - len(prompt))          # 1 fused + (max_seq-1-n) decode
-    while len(out) < min(max_tokens, cap):
-        t = _next_token(ctx)
-        if t == EOS:
-            break
-        out.append(t)
-        ctx.append(t)
-    return out
-
-
-@dataclass
-class _FakeState:
-    pool: np.ndarray              # (num_pages, page_size) written token values
-    lengths: np.ndarray           # (B,)
-    tokens: np.ndarray            # (B,) last sampled token
-    active: np.ndarray            # (B,) bool
-    generated: np.ndarray         # (B,)
-    max_gen: np.ndarray           # (B,)
-    last_logprob: np.ndarray = None  # (B,) f32 (scheduler snapshot shape)
-
-
-class FakeCore:
-    """Pure-numpy stand-in for EngineCore with REAL paged-read semantics."""
-
-    def __init__(self, batch=4, max_seq=64, page_size=8, num_pages=0,
-                 chunk=16, steps=4, steps_max=0, group=4, prefix_cache=False,
-                 width_ladder=False):
-        self.batch, self.max_seq = batch, max_seq
-        self.page_size, self.chunk = page_size, chunk
-        self.max_pages_per_slot = -(-max_seq // page_size)
-        self.num_pages = num_pages or batch * self.max_pages_per_slot + 1
-        self.eos_id = EOS
-        self.donates_state = False
-        self.supports_long_prefill = False
-        self.prefix_cache = prefix_cache
-        if width_ladder and batch > 2:
-            # decode batch-width ladder (engine.decode_widths): the
-            # scheduler dispatches at the narrowest rung covering the
-            # highest live slot, and rung transitions happen mid-stream as
-            # slots fill and drain — the fuzz menu exercises exactly that
-            self.decode_widths = (2, batch)
-        self.cfg = SimpleNamespace(
-            decode_steps_per_dispatch=steps, decode_steps_max=steps_max,
-            prefill_group=group, long_prefill="off", prefill_hold_chunks=8,
-            pipeline_depth=2)
-        self.group_buckets = (1, 2, 4)
-        # final-chunk bucket ladder (the prefix-cache coverage cap reads it)
-        buckets, b = [], page_size
-        while b < chunk:
-            buckets.append(b)
-            b *= 2
-        buckets.append(chunk)
-        self.buckets = tuple(buckets)
-
-    def init_state(self) -> _FakeState:
-        B = self.batch
-        return _FakeState(
-            pool=np.zeros((self.num_pages, self.page_size), np.int32),
-            lengths=np.zeros((B,), np.int32), tokens=np.zeros((B,), np.int32),
-            active=np.zeros((B,), bool), generated=np.zeros((B,), np.int32),
-            max_gen=np.zeros((B,), np.int32),
-            last_logprob=np.zeros((B,), np.float32))
-
-    def new_allocator(self):
-        """Caching episodes run the REAL CachingAllocator against the fake
-        paged pool: a page shared wrongly (content not actually the matched
-        prefix) or evicted while referenced corrupts a stream's context sum
-        and diverges from the solo oracle."""
-        if self.prefix_cache:
-            from generativeaiexamples_tpu.engine.prefix_cache import (
-                CachingAllocator)
-            return CachingAllocator(self.num_pages, self.page_size)
-        return PageAllocator(self.num_pages)
-
-    def pages_for(self, n: int) -> int:
-        return n // self.page_size + 1
-
-    def put_table(self, table: np.ndarray) -> np.ndarray:
-        return np.array(table, np.int32)      # snapshot, like a device copy
-
-    def _read_context(self, st: _FakeState, row: np.ndarray, n: int) -> List[int]:
-        ps = self.page_size
-        out = []
-        for i in range(n):
-            out.append(int(st.pool[row[i // ps], i % ps]))
-        return out
-
-    @staticmethod
-    def _clone(st: _FakeState) -> _FakeState:
-        """Functional update, like real jax dispatches: handles the
-        scheduler kept into an OLD state (the batched first-token fetch of
-        state.tokens) must stay stable snapshots."""
-        return _FakeState(*(a.copy() for a in (
-            st.pool, st.lengths, st.tokens, st.active, st.generated,
-            st.max_gen, st.last_logprob)))
-
-    def release(self, st: _FakeState, slot: int) -> _FakeState:
-        st = self._clone(st)
-        st.active[slot] = False
-        return st
-
-    # -- live-migration surface (export_live_slot / spill / resume) -------
-    # Mirrors EngineCore's handoff trio with REAL paged semantics: export
-    # reads the slot's written token values back THROUGH its page list,
-    # import scatters them into different physical pages. Any length or
-    # page-math slip in the scheduler's snapshot/spill paths corrupts the
-    # resumed context sum and the stream diverges from the solo oracle.
-
-    def export_slot_kv(self, st: _FakeState, pages, length,
-                       fetch: bool = False) -> dict:
-        n = max(1, -(-int(length) // self.page_size))
-        rows = np.zeros((n, self.page_size), np.int32)
-        for i, p in enumerate(list(pages)[:n]):
-            rows[i] = st.pool[p]
-        return {"length": int(length), "n_pages": n,
-                "page_size": self.page_size, "k": rows}
-
-    def validate_handoff(self, payload: dict) -> None:
-        if payload.get("page_size") != self.page_size:
-            raise ValueError("page_size mismatch")
-        n = int(payload.get("length", 0))
-        if n < 1 or n + 1 >= self.max_seq:
-            raise ValueError("length outside serving range")
-        if "prompt_ids" in payload and len(payload["prompt_ids"]) != n:
-            raise ValueError("prompt_ids/length mismatch")
-
-    def import_slot_kv(self, st: _FakeState, slot: int, pages,
-                       payload: dict) -> _FakeState:
-        self.validate_handoff(payload)
-        st = self._clone(st)
-        n = int(payload["n_pages"])
-        for i, p in enumerate(list(pages)[:n]):
-            st.pool[p] = payload["k"][i]
-        st.lengths[slot] = int(payload["length"])
-        return st
-
-    def import_pages_kv(self, st: _FakeState, pages, payload: dict,
-                        n_pages: Optional[int] = None) -> _FakeState:
-        """Partial page import — the prefix-tier promote surface
-        (engine/kv_tier.py): scatter the payload's first ``n_pages`` page
-        rows into freshly allocated physical pages, touching NO slot
-        state. The promoted job's chunk walk starts at the covered
-        boundary; any coverage/page-math slip here corrupts the read-back
-        context sum and the stream diverges from the solo oracle."""
-        if payload.get("page_size") != self.page_size:
-            raise ValueError("page_size mismatch")
-        n = int(n_pages if n_pages is not None else payload["n_pages"])
-        if n < 1 or n > int(payload["n_pages"]):
-            raise ValueError("n_pages outside payload coverage")
-        st = self._clone(st)
-        for i, p in enumerate(list(pages)[:n]):
-            st.pool[p] = payload["k"][i]
-        return st
-
-    def activate(self, st: _FakeState, slot: int, token: int,
-                 generated: int, max_gen: int, temperature: float,
-                 top_k: int, top_p: float, seed: int = 0,
-                 gram_state: int = 0) -> _FakeState:
-        st = self._clone(st)
-        st.tokens[slot] = int(token)
-        st.active[slot] = True
-        st.generated[slot] = int(generated)
-        st.max_gen[slot] = int(max_gen)
-        return st
-
-    def prefill_group(self, st: _FakeState, items) -> tuple:
-        st = self._clone(st)
-        toks = np.zeros((len(items),), np.int32)
-        for i, it in enumerate(items):
-            ps = self.page_size
-            row = np.asarray(it.page_row)
-            for j, t in enumerate(it.chunk_ids):
-                pos = it.start_pos + j
-                st.pool[row[pos // ps], pos % ps] = t
-            n = it.start_pos + len(it.chunk_ids)
-            st.lengths[it.slot] = n
-            if it.is_last:
-                ctx = self._read_context(st, row, n)
-                tok = _next_token(ctx)
-                toks[i] = tok
-                alive = (tok != EOS) and (it.generated < it.max_gen)
-                st.tokens[it.slot] = tok
-                st.active[it.slot] = alive
-                st.generated[it.slot] = it.generated
-                st.max_gen[it.slot] = it.max_gen
-        return st, toks
-
-    def decode(self, st: _FakeState, table: np.ndarray, steps: int = 1,
-               use_grammar: bool = False, want_top: bool = False,
-               width: int = 0) -> tuple:
-        st = self._clone(st)
-        B, ps = (width or self.batch), self.page_size
-        # a narrow batch-width rung must cover every live slot — the
-        # scheduler's lowest-id-first allocation guarantees it; a slot at
-        # or past the rung would silently stall here, which the episode
-        # invariants catch as a livelock/diverged stream
-        # 7 rows: the scheduler's unpack expects the logprob rows too
-        # (they carry 0.0 bits here — the fake model has no distribution)
-        out = np.zeros((7, steps, B), np.int32)
-        for k in range(steps):
-            for b in range(B):
-                out[4, k, b] = st.tokens[b]              # input_tokens
-                if not st.active[b]:
-                    continue
-                out[1, k, b] = 1                          # emitted
-                n = int(st.lengths[b])
-                # write the input token at position n (through the table,
-                # like the real engine), then read the WHOLE context back
-                st.pool[table[b, n // ps], n % ps] = st.tokens[b]
-                st.lengths[b] = n + 1
-                ctx = self._read_context(st, table[b], n + 1)
-                tok = _next_token(ctx)
-                out[0, k, b] = tok                        # sampled
-                st.generated[b] += 1
-                hit_eos = tok == EOS
-                done = (hit_eos or st.generated[b] >= st.max_gen[b]
-                        or st.lengths[b] >= self.max_seq - 1)
-                out[2, k, b] = int(done)
-                out[3, k, b] = int(hit_eos)
-                if done:
-                    st.active[b] = False
-                else:
-                    st.tokens[b] = tok
-        return st, {"packed": out, "emitted": out[1]}
-
 
 @dataclass(frozen=True)
 class _Spec:

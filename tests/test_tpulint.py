@@ -304,6 +304,66 @@ def test_clock_discipline_clean_with_monotonic():
 
 
 # ---------------------------------------------------------------------------
+# clock-injection
+# ---------------------------------------------------------------------------
+
+_CLOCK_SRC = textwrap.dedent("""
+    import time
+
+    def _tick(self):
+        now = time.monotonic()                 # bad in policy code
+        self._last = time.perf_counter()       # bad in policy code
+        self._started = time.time()            # bad in policy code
+        time.sleep(0.01)                       # a wait, not a clock read
+""")
+
+
+def test_clock_injection_fires_only_in_policy_modules():
+    for suffix in ("generativeaiexamples_tpu/engine/scheduler.py",
+                   "generativeaiexamples_tpu/engine/qos.py",
+                   "generativeaiexamples_tpu/engine/kv_tier.py"):
+        out = analyze_source(suffix, _CLOCK_SRC,
+                             [RULES["clock-injection"]])
+        assert [f.line for f in out] == [5, 6, 7], suffix
+        assert all(f.severity == "error" for f in out)
+
+
+def test_clock_injection_silent_outside_policy_modules():
+    for path in ("snippet.py",
+                 "generativeaiexamples_tpu/observability/flight.py",
+                 "generativeaiexamples_tpu/server/failover.py"):
+        assert analyze_source(path, _CLOCK_SRC,
+                              [RULES["clock-injection"]]) == []
+
+
+def test_clock_injection_clean_on_injected_clock():
+    src = """
+    from generativeaiexamples_tpu.core import clock
+
+    def _tick(self):
+        now = clock.mono()
+        self._stamp = clock.perf()
+        return {"ts": clock.wall()}
+    """
+    out = analyze_source("generativeaiexamples_tpu/engine/qos.py",
+                         textwrap.dedent(src),
+                         [RULES["clock-injection"]])
+    assert out == []
+
+
+def test_clock_injection_policy_modules_are_clean_in_tree():
+    # the contract the simulator depends on: the real policy modules
+    # carry zero direct stdlib clock reads
+    for rel in ("engine/scheduler.py", "engine/qos.py",
+                "engine/kv_tier.py"):
+        path = os.path.join(PKG_DIR, rel)
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+        assert analyze_source(path, src,
+                              [RULES["clock-injection"]]) == [], rel
+
+
+# ---------------------------------------------------------------------------
 # net-timeout
 # ---------------------------------------------------------------------------
 
@@ -849,6 +909,11 @@ def test_every_registered_rule_has_a_firing_fixture():
     ]
     for src in snippets:
         fired |= {f.rule for f in analyze_source("s.py", src)}
+    # clock-injection is path-scoped: it only exists inside the three
+    # simulator-driven policy modules
+    fired |= {f.rule for f in analyze_source(
+        "generativeaiexamples_tpu/engine/qos.py",
+        "import time\nx = time.monotonic()\n")}
     assert fired == set(RULES)
 
 

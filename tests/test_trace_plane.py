@@ -1,0 +1,193 @@
+"""Fleet event trace plane: gating, ring/window semantics, the file sink
+(rotation + torn-tail tolerance), /debug/trace over a real socket, and the
+flight dump's trace tail (observability/trace.py, docs/simulation.md).
+
+Same cost bargain as test_observability_plane.py: the live-engine test
+rides the deterministic FakeCore (pure numpy, no compile) so the module
+exercises the REAL scheduler driver thread and real aiohttp sockets while
+staying seconds-cheap.
+"""
+
+import json
+import os
+import time
+
+import pytest
+import requests
+
+from test_scheduler_fuzz import FakeCore
+
+from generativeaiexamples_tpu.engine.scheduler import Scheduler
+from generativeaiexamples_tpu.engine.server import ModelServer
+from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+from generativeaiexamples_tpu.observability.trace import (
+    EventTrace, TRACE, read_jsonl)
+
+
+@pytest.fixture
+def clean_trace():
+    """Arm the process-global TRACE for a test and restore it after —
+    other modules rely on the default-off state."""
+    prev = (TRACE.enabled, TRACE.path, TRACE.capacity)
+    TRACE.configure(mode="on", path="")
+    TRACE.reset()
+    yield TRACE
+    TRACE.configure(mode="on" if prev[0] else "off",
+                    path=prev[1] or "", capacity=prev[2])
+    TRACE.reset()
+
+
+# ------------------------------------------------------------- gating
+
+def test_default_off_records_nothing():
+    t = EventTrace()          # fresh instance, env APP_TRACE unset
+    assert t.enabled is False
+    t.emit("submit", rid="r1")
+    assert t.records() == []
+    assert t.describe()["recorded_total"] == 0
+    assert t.describe()["mode"] == "off"
+
+
+def test_emit_window_and_kind_filter(clean_trace):
+    t = clean_trace
+    for i in range(6):
+        t.emit("submit" if i % 2 == 0 else "finish", rid=f"r{i}")
+    recs = t.records()
+    assert len(recs) == 6
+    assert [r["seq"] for r in recs] == list(range(6))
+    assert all(r["v"] == 1 and "mono" in r for r in recs)
+    only_fin = t.window(3600.0, kinds=("finish",))
+    assert {r["kind"] for r in only_fin} == {"finish"}
+    assert len(only_fin) == 3
+    assert t.window(3600.0, limit=2) == recs[-2:]
+    # a window in the past excludes everything
+    assert t.window(0.0) == [] or all(
+        r["mono"] >= recs[-1]["mono"] for r in t.window(0.0))
+
+
+def test_ring_bounded_and_capacity_floor(clean_trace):
+    t = clean_trace
+    t.configure(capacity=256)          # floor: configure clamps up to 256
+    for i in range(300):
+        t.emit("qos", i=i)
+    d = t.describe()
+    assert d["buffered"] == 256
+    assert d["recorded_total"] == 300
+    assert d["dropped"] == 44
+    assert t.records()[0]["i"] == 44   # oldest evicted first
+
+
+# ------------------------------------------------------------- file sink
+
+def test_sink_flush_dump_and_reload(tmp_path, clean_trace):
+    t = clean_trace
+    sink = str(tmp_path / "trace.jsonl")
+    t.configure(path=sink)
+    for i in range(10):
+        t.emit("dispatch", step=i)
+    t.flush()
+    on_disk = read_jsonl(sink)
+    assert [r["step"] for r in on_disk] == list(range(10))
+    # ring dump produces the same line shape
+    dump = str(tmp_path / "dump.jsonl")
+    n = t.dump_jsonl(dump)
+    assert n == 10
+    assert read_jsonl(dump) == t.records()
+
+
+def test_sink_rotation(tmp_path, clean_trace):
+    t = clean_trace
+    sink = str(tmp_path / "trace.jsonl")
+    t.configure(path=sink)
+    t.rotate_bytes = 2048              # tiny budget to force rotation
+    for i in range(400):
+        t.emit("dispatch", step=i, pad="x" * 40)
+    t.flush()
+    assert os.path.exists(sink + ".1")          # rotated predecessor
+    assert os.path.getsize(sink) <= 2048 + 120 * 128   # bounded post-rotate
+    # both generations still parse
+    read_jsonl(sink + ".1")
+    read_jsonl(sink)
+
+
+def test_read_jsonl_tolerates_torn_tail_only(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    with open(p, "w", encoding="utf-8") as f:
+        f.write(json.dumps({"v": 1, "kind": "submit", "seq": 0}) + "\n")
+        f.write('{"v": 1, "kind": "fin')        # killed mid-write
+    recs = read_jsonl(p)
+    assert len(recs) == 1
+    # torn line NOT at the tail = not a trace file → loud
+    with open(p, "w", encoding="utf-8") as f:
+        f.write("not json at all\n")
+        f.write(json.dumps({"v": 1}) + "\n")
+    with pytest.raises(ValueError, match="undecodable"):
+        read_jsonl(p)
+
+
+# ------------------------------------------------- live engine over HTTP
+
+from test_chain_server import _ServerThread, _free_port  # noqa: E402
+
+
+@pytest.fixture
+def served_engine(clean_trace):
+    core = FakeCore(batch=4, max_seq=64, page_size=8, chunk=16, steps=2,
+                    group=4)
+    sched = Scheduler(core, ByteTokenizer())
+    sched.start()
+    port = _free_port()
+    server = _ServerThread(ModelServer(sched, "fake-tpu").app, port)
+    server.start()
+    try:
+        yield f"http://127.0.0.1:{port}"
+    finally:
+        server.stop()
+        sched.stop()
+
+
+def test_debug_trace_endpoint_live(served_engine):
+    r = requests.post(f"{served_engine}/v1/completions",
+                      json={"prompt": "trace me", "max_tokens": 6},
+                      timeout=30)
+    assert r.status_code == 200
+    body = requests.get(f"{served_engine}/debug/trace?window=600",
+                        timeout=5).json()
+    assert body["enabled"] is True
+    kinds = {rec["kind"] for rec in body["records"]}
+    assert {"submit", "admit", "dispatch", "finish"} <= kinds
+    fin = [rec for rec in body["records"] if rec["kind"] == "finish"]
+    assert fin and fin[-1]["completion_tokens"] > 0
+    # kind filter + limit are honored
+    only = requests.get(
+        f"{served_engine}/debug/trace?window=600&kind=submit&limit=1",
+        timeout=5).json()
+    assert len(only["records"]) == 1
+    assert only["records"][0]["kind"] == "submit"
+    # bad window is a 400, not a 500
+    assert requests.get(f"{served_engine}/debug/trace?window=x",
+                        timeout=5).status_code == 400
+
+
+def test_debug_trace_endpoint_off_mode(served_engine):
+    TRACE.configure(mode="off")
+    try:
+        body = requests.get(f"{served_engine}/debug/trace", timeout=5).json()
+        assert body["enabled"] is False
+        assert "hint" in body and "APP_TRACE" in body["hint"]
+        assert "records" not in body          # no empty-list masquerade
+    finally:
+        TRACE.configure(mode="on")
+
+
+def test_flight_dump_embeds_trace_tail(tmp_path, served_engine):
+    from generativeaiexamples_tpu.observability.flight import FLIGHT
+    requests.post(f"{served_engine}/v1/completions",
+                  json={"prompt": "dump me", "max_tokens": 4}, timeout=30)
+    out = FLIGHT.dump(str(tmp_path / "flight.json"))
+    with open(out, "r", encoding="utf-8") as f:
+        payload = json.load(f)
+    tr = payload["trace"]
+    assert tr["enabled"] is True
+    assert tr["schema_version"] == 1
+    assert any(rec["kind"] == "finish" for rec in tr["tail"])
