@@ -8,9 +8,19 @@ test:
 # tpulint: in-tree static analysis for TPU-serving hazards
 # (docs/static_analysis.md). Non-zero exit on any unsuppressed,
 # non-baselined finding; also enforced inside tier-1 by tests/test_tpulint.py.
+# Emits the machine-readable artifact (tpulint.json) for CI diffing and
+# enforces the 30 s full-tree wall budget — a lint nobody waits for is a
+# lint nobody runs.
 .PHONY: lint
 lint:
-	$(TEST_ENV) python -m generativeaiexamples_tpu.analysis generativeaiexamples_tpu/
+	$(TEST_ENV) python -m generativeaiexamples_tpu.analysis generativeaiexamples_tpu/ \
+		--json-out tpulint.json --budget-s 30
+
+# The interprocedural lock-order graph (one witnessed edge per line) —
+# the source of the rendered graph in docs/static_analysis.md.
+.PHONY: lock-graph
+lock-graph:
+	$(TEST_ENV) python -m generativeaiexamples_tpu.analysis generativeaiexamples_tpu/ --lock-graph
 
 # Build the native (C++) components: byte-level BPE tokenizer core.
 # Delegates to the one build recipe in native_tokenizer.py (also used by

@@ -1,0 +1,628 @@
+"""Whole-program interprocedural analysis: the module-level call graph
+and the transitive facts tpulint's deep rules fire on.
+
+PR 3's per-module rules see a blocking call only when it sits *directly*
+inside a ``with lock:`` block or a jitted function — a helper that
+fetches or sleeps under its caller's lock escapes entirely, and nothing
+checks lock *ordering* across modules.  This module closes both gaps
+with the same bargain as the rest of tpulint (pure AST, no imports of
+the analyzed code, deterministic, fast):
+
+* :class:`Program` indexes every function in a set of parsed modules,
+  resolves the calls a pure-AST pass *can* resolve — bare names to
+  module functions, ``self.m()`` / ``cls.m()`` within the enclosing
+  class, ``mod.f()`` / ``from mod import f`` across modules in the
+  scanned set, nested defs within their enclosing function — and
+  records, per call site, the stack of lockish ``with`` contexts the
+  site executes under.
+* Three transitive facts are then fixpointed over the graph:
+  **may-block** (sleep, HTTP, future ``.result()``, ``device_get``,
+  disk syscalls — the lock-discipline vocabulary), **may-sync** (the
+  trace-hazard vocabulary: ``.item()``, ``np.asarray`` …), and
+  **locks-acquired** (every lock a function may take, directly or via
+  any callee).
+* The deep rules report on those facts: ``deep-lock`` (a call chain
+  that blocks while a lock is held), ``deep-hot-path`` (a jit/hot-path
+  root whose call chain syncs or blocks), and ``lock-order`` (a cycle
+  in the static lock-acquisition graph — the textbook AB/BA deadlock,
+  caught before a thread ever runs).
+
+Resolution is deliberately conservative: an attribute call on an object
+of unknown type (``self._qos.order()``) is skipped, not guessed — a
+tpulint true positive must stay near-certain.  The runtime counterpart
+(observability/lockwatch.py) covers exactly the edges static resolution
+cannot see: callbacks wired at construction time cross here as plain
+attributes, but at runtime they acquire real locks in a real order.
+
+Lock identity: ``self._lock`` inside class ``C`` of ``engine/qos.py``
+becomes node ``engine.qos.C._lock`` — per-class, so the spill pool's
+lock and the tier's lock stay distinct nodes; a module-global lock
+becomes ``engine.qos._lock``; a function-local lock is scoped under the
+function.  The rendered graph (``--lock-graph``) is checked into
+docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from generativeaiexamples_tpu.analysis.astutil import (
+    ModuleContext, call_name, dotted_name)
+from generativeaiexamples_tpu.analysis.findings import Finding
+from generativeaiexamples_tpu.analysis.registry import rule
+from generativeaiexamples_tpu.analysis.rules import (
+    _BLOCKING_UNDER_LOCK_ATTRS, _BLOCKING_UNDER_LOCK_CALLS,
+    _DISK_UNDER_LOCK_ATTRS, _LOCK_SAFE_ATTRS, _SYNC_ATTRS, _SYNC_CALLS,
+    _jit_decorated, _lockish)
+
+_MAX_CHAIN = 6      # rendered hops before "…" (messages must stay greppable)
+
+
+# --------------------------------------------------------------------------
+# per-function index
+# --------------------------------------------------------------------------
+
+@dataclass
+class CallSite:
+    lineno: int
+    target: str                      # qname of the resolved callee
+    under: Tuple[str, ...]           # lock ids held here, outermost first
+
+
+@dataclass
+class LockAcquire:
+    lineno: int
+    lock: str                        # lock id acquired
+    under: Tuple[str, ...]           # lock ids already held
+
+
+@dataclass
+class FunctionInfo:
+    qname: str                       # "<relpath>::<Class.meth|func>"
+    path: str                        # repo-relative module path
+    name: str                        # display name (Class.meth / func)
+    hot: bool = False                # jit-decorated or `# tpulint: hot-path`
+    calls: List[CallSite] = field(default_factory=list)
+    acquires: List[LockAcquire] = field(default_factory=list)
+    # direct facts: (lineno, op description)
+    blocks: List[Tuple[int, str]] = field(default_factory=list)
+    syncs: List[Tuple[int, str]] = field(default_factory=list)
+
+
+def _module_stem(path: str) -> str:
+    """'generativeaiexamples_tpu/engine/qos.py' -> 'engine.qos' (the
+    package prefix is noise in every rendered name)."""
+    stem = path.replace("\\", "/")
+    if stem.endswith(".py"):
+        stem = stem[:-3]
+    parts = [p for p in stem.split("/") if p]
+    if len(parts) > 1 and parts[0] == "generativeaiexamples_tpu":
+        parts = parts[1:]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1] or parts
+    return ".".join(parts)
+
+
+def _module_dotted(path: str) -> str:
+    """Full dotted module name for import resolution."""
+    stem = path.replace("\\", "/")
+    if stem.endswith(".py"):
+        stem = stem[:-3]
+    parts = [p for p in stem.split("/") if p]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class _ModuleIndex:
+    """One module's functions, imports, and class layout."""
+
+    def __init__(self, ctx: ModuleContext) -> None:
+        self.ctx = ctx
+        self.path = ctx.path
+        self.stem = _module_stem(ctx.path)
+        self.functions: Dict[str, FunctionInfo] = {}   # local name -> info
+        # import bindings: local name -> ("module", dotted) or
+        # ("symbol", dotted_module, symbol)
+        self.imports: Dict[str, Tuple[str, ...]] = {}
+        # names bound at module level: a bare lock name in this set is one
+        # shared module-global node, not a per-function local
+        self.globals: Set[str] = set()
+        for node in self.ctx.tree.body:
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    self.globals.add(t.id)
+                elif isinstance(t, ast.Tuple):
+                    self.globals.update(e.id for e in t.elts
+                                        if isinstance(e, ast.Name))
+        self._collect_imports()
+        self._collect_functions()
+
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    # `import a.b.c` binds `a`; only the asname form gives
+                    # a direct module handle worth resolving through
+                    target = alias.name if alias.asname else \
+                        alias.name.split(".")[0]
+                    self.imports[local] = ("module", target)
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    self.imports[local] = ("from", node.module, alias.name)
+
+    def _collect_functions(self) -> None:
+        # two phases: register every def first (calls resolve forward —
+        # `tick` may call a helper defined below it), then index bodies
+        defs: List[Tuple[ast.AST, str]] = []
+
+        def visit(body: Sequence[ast.stmt], prefix: str) -> None:
+            for node in body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    local = prefix + node.name
+                    defs.append((node, local))
+                    # nested defs resolve only from the enclosing function
+                    visit(node.body, local + ".<locals>.")
+                elif isinstance(node, ast.ClassDef):
+                    visit(node.body, prefix + node.name + ".")
+        visit(self.ctx.tree.body, "")
+        for node, local in defs:
+            self.functions[local] = FunctionInfo(
+                qname=f"{self.path}::{local}", path=self.path,
+                name=local.replace(".<locals>.", "."))
+        for node, local in defs:
+            self._index_function(node, local)
+
+    # -- lock identity ----------------------------------------------------
+
+    def _lock_id(self, name: str, local: str) -> str:
+        """Resolve a lockish dotted name to a stable node id."""
+        if name.startswith("self.") or name.startswith("cls."):
+            cls = local.rsplit(".", 1)[0] if "." in local else ""
+            tail = name.split(".", 1)[1]
+            if cls and "<locals>" not in cls:
+                return f"{self.stem}.{cls}.{tail}"
+            return f"{self.stem}.{tail}"
+        if "." in name:
+            return f"{self.stem}.{name}"
+        # bare name: module global or function local
+        if name in self.globals or name in self.functions \
+                or name in self.imports:
+            return f"{self.stem}.{name}"
+        return f"{self.stem}.{local}.{name}" if local else \
+            f"{self.stem}.{name}"
+
+    # -- one function's body ----------------------------------------------
+
+    def _index_function(self, fn: ast.AST, local: str) -> FunctionInfo:
+        info = self.functions[local]
+        info.hot = (_jit_decorated(fn)
+                    or self.ctx.has_marker(fn, "hot-path"))
+        cls_prefix = local.rsplit(".", 1)[0] + "." if "." in local else ""
+
+        def classify_call(node: ast.Call, under: Tuple[str, ...]) -> None:
+            name = call_name(node)
+            attr = (node.func.attr
+                    if isinstance(node.func, ast.Attribute) else None)
+            if attr in _LOCK_SAFE_ATTRS:
+                return
+            if name in _BLOCKING_UNDER_LOCK_CALLS:
+                info.blocks.append((node.lineno, f"`{name}`"))
+            elif attr in _BLOCKING_UNDER_LOCK_ATTRS:
+                info.blocks.append((node.lineno, f"`.{attr}()`"))
+            elif attr in _DISK_UNDER_LOCK_ATTRS:
+                info.blocks.append((node.lineno, f"`.{attr}()`"))
+            if attr in _SYNC_ATTRS:
+                info.syncs.append((node.lineno, f"`.{attr}()`"))
+            elif name in _SYNC_CALLS:
+                info.syncs.append((node.lineno, f"`{name}`"))
+            target = self._resolve_local(name, local, cls_prefix)
+            if target is not None:
+                info.calls.append(CallSite(node.lineno, target, under))
+
+        def scan(node: ast.AST, under: Tuple[str, ...]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return          # a closure under a lock does not RUN under it
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                held = list(under)
+                for item in node.items:
+                    lname = _lockish(item.context_expr)
+                    if lname:
+                        lock = self._lock_id(lname, local)
+                        info.acquires.append(
+                            LockAcquire(item.context_expr.lineno, lock,
+                                        tuple(held)))
+                        held.append(lock)
+                    else:
+                        scan(item.context_expr, tuple(under))
+                for stmt in node.body:
+                    scan(stmt, tuple(held))
+                return
+            if isinstance(node, ast.Call):
+                classify_call(node, under)
+            for child in ast.iter_child_nodes(node):
+                scan(child, under)
+
+        for stmt in fn.body:
+            scan(stmt, ())
+        return info
+
+    # -- call resolution ---------------------------------------------------
+
+    def _resolve_local(self, name: Optional[str], local: str,
+                       cls_prefix: str) -> Optional[str]:
+        """Resolve a dotted callee name to a local qname or a deferred
+        cross-module key ``('xmod', dotted_module, symbol)`` encoded as a
+        string the Program finishes resolving (it knows every module)."""
+        if not name:
+            return None
+        if name.startswith("self.") or name.startswith("cls."):
+            meth = name.split(".", 1)[1]
+            if "." in meth:
+                return None             # self._qos.order(): unknown type
+            cand = cls_prefix + meth
+            if cand in self.functions:
+                return f"{self.path}::{cand}"
+            return None
+        if "." not in name:
+            # nested def in the enclosing function wins, then module scope,
+            # then a `from mod import f` binding
+            nested = f"{local}.<locals>.{name}"
+            if nested in self.functions:
+                return f"{self.path}::{nested}"
+            if name in self.functions:
+                return f"{self.path}::{name}"
+            bind = self.imports.get(name)
+            if bind and bind[0] == "from":
+                return f"@{bind[1]}::{bind[2]}"
+            return None
+        head, rest = name.split(".", 1)
+        bind = self.imports.get(head)
+        if bind is None:
+            return None
+        if bind[0] == "module" and "." not in rest:
+            return f"@{bind[1]}::{rest}"
+        if bind[0] == "from" and "." not in rest:
+            # `from pkg import mod` then `mod.f()`
+            return f"@{bind[1]}.{bind[2]}::{rest}"
+        return None
+
+
+# --------------------------------------------------------------------------
+# the program
+# --------------------------------------------------------------------------
+
+class Program:
+    """A set of parsed modules plus the resolved call graph and the
+    transitive facts the deep rules consume."""
+
+    def __init__(self, contexts: Iterable[ModuleContext]) -> None:
+        self.modules: List[_ModuleIndex] = [
+            _ModuleIndex(ctx) for ctx in contexts]
+        self.functions: Dict[str, FunctionInfo] = {}
+        by_dotted: Dict[str, _ModuleIndex] = {}
+        for mod in self.modules:
+            by_dotted[_module_dotted(mod.path)] = mod
+            for local, info in mod.functions.items():
+                self.functions[info.qname] = info
+        # finish cross-module resolution: '@dotted.module::symbol' keys
+        # become real qnames when the module is in the scanned set (tails
+        # match too, so running over a subtree still resolves package
+        # imports), else the call is dropped
+        tails: Dict[str, _ModuleIndex] = {}
+        for dotted, mod in by_dotted.items():
+            tails.setdefault(dotted.split(".")[-1], mod)
+        for info in self.functions.values():
+            resolved: List[CallSite] = []
+            for site in info.calls:
+                if not site.target.startswith("@"):
+                    resolved.append(site)
+                    continue
+                dotted, symbol = site.target[1:].split("::", 1)
+                mod = by_dotted.get(dotted) or tails.get(
+                    dotted.split(".")[-1])
+                if mod is not None and symbol in mod.functions:
+                    resolved.append(CallSite(
+                        site.lineno, mod.functions[symbol].qname,
+                        site.under))
+            info.calls = resolved
+        self._fixpoint()
+
+    # -- transitive facts --------------------------------------------------
+
+    def _fixpoint(self) -> None:
+        # witness per function: ("direct", lineno, op) or ("via", callee,
+        # call lineno) — enough to reconstruct one chain per finding
+        self.block_why: Dict[str, Tuple] = {}
+        self.sync_why: Dict[str, Tuple] = {}
+        self.locks_acquired: Dict[str, Dict[str, int]] = {}
+        for q, info in self.functions.items():
+            if info.blocks:
+                lineno, op = min(info.blocks)
+                self.block_why[q] = ("direct", lineno, op)
+            if info.syncs:
+                lineno, op = min(info.syncs)
+                self.sync_why[q] = ("direct", lineno, op)
+            self.locks_acquired[q] = {a.lock: a.lineno
+                                      for a in sorted(info.acquires,
+                                                      key=lambda a: a.lineno,
+                                                      reverse=True)}
+        changed = True
+        while changed:
+            changed = False
+            for q, info in self.functions.items():
+                for site in info.calls:
+                    if site.target == q:
+                        continue
+                    if site.target in self.block_why \
+                            and q not in self.block_why:
+                        self.block_why[q] = ("via", site.target, site.lineno)
+                        changed = True
+                    if site.target in self.sync_why \
+                            and q not in self.sync_why:
+                        self.sync_why[q] = ("via", site.target, site.lineno)
+                        changed = True
+                    callee_locks = self.locks_acquired.get(site.target, {})
+                    mine = self.locks_acquired[q]
+                    for lock in callee_locks:
+                        if lock not in mine:
+                            mine[lock] = site.lineno
+                            changed = True
+
+    def chain_through_hot(self, start: str, why: Dict[str, Tuple]) -> bool:
+        """True when the witness chain from ``start`` passes through a
+        hot-marked/jitted function — that function is its own audited
+        check root (trace-hazard and deep-hot-path analyze it directly),
+        so callers upstream of it do not re-report its deliberate ops."""
+        cur: Optional[str] = start
+        seen: Set[str] = set()
+        while cur is not None and cur not in seen:
+            seen.add(cur)
+            if self.functions[cur].hot:
+                return True
+            wit = why.get(cur)
+            if wit is None or wit[0] == "direct":
+                return False
+            cur = wit[1]
+        return False
+
+    # -- chain rendering ---------------------------------------------------
+
+    def chain(self, start: str, why: Dict[str, Tuple]) -> str:
+        """'helper -> fetch -> `requests.get`' — the witness path from a
+        function to the operation that gives it the fact."""
+        parts: List[str] = []
+        cur: Optional[str] = start
+        seen: Set[str] = set()
+        while cur is not None and cur not in seen and len(parts) < _MAX_CHAIN:
+            seen.add(cur)
+            info = self.functions[cur]
+            parts.append(info.name)
+            wit = why.get(cur)
+            if wit is None:
+                break
+            if wit[0] == "direct":
+                parts.append(wit[2])
+                return " -> ".join(parts)
+            cur = wit[1]
+        parts.append("…")
+        return " -> ".join(parts)
+
+    # -- the lock graph ----------------------------------------------------
+
+    def lock_edges(self) -> Dict[Tuple[str, str], Tuple[str, int, str]]:
+        """(held, acquired) -> (file, line, how) — first witness per edge,
+        from both shapes: a nested ``with`` in one function, and a call
+        made under a lock to a function that (transitively) acquires
+        another."""
+        edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+        def add(a: str, b: str, path: str, line: int, how: str) -> None:
+            if a == b:
+                return                   # RLock re-entry is not an ordering
+            key = (a, b)
+            wit = (path, line, how)
+            if key not in edges or wit < edges[key]:
+                edges[key] = wit
+        for info in self.functions.values():
+            for acq in info.acquires:
+                for held in acq.under:
+                    add(held, acq.lock, info.path, acq.lineno,
+                        f"nested `with` in `{info.name}`")
+            for site in info.calls:
+                if not site.under:
+                    continue
+                for lock, _ in sorted(
+                        self.locks_acquired.get(site.target, {}).items()):
+                    for held in site.under:
+                        add(held, lock, info.path, site.lineno,
+                            f"`{info.name}` calls "
+                            f"`{self.functions[site.target].name}`")
+        return edges
+
+    def lock_cycles(self) -> List[List[Tuple[str, str]]]:
+        """Elementary cycles in the lock graph, as edge lists, one per
+        strongly-connected component (deterministic: the cycle walk
+        starts from the smallest node and follows smallest successors)."""
+        edges = self.lock_edges()
+        succ: Dict[str, List[str]] = {}
+        for (a, b) in edges:
+            succ.setdefault(a, []).append(b)
+        for outs in succ.values():
+            outs.sort()
+        # Tarjan SCC, iterative
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on: Set[str] = set()
+        stack: List[str] = []
+        sccs: List[List[str]] = []
+        counter = [0]
+
+        def strongconnect(root: str) -> None:
+            work = [(root, iter(succ.get(root, [])))]
+            index[root] = low[root] = counter[0]
+            counter[0] += 1
+            stack.append(root)
+            on.add(root)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for nxt in it:
+                    if nxt not in index:
+                        index[nxt] = low[nxt] = counter[0]
+                        counter[0] += 1
+                        stack.append(nxt)
+                        on.add(nxt)
+                        work.append((nxt, iter(succ.get(nxt, []))))
+                        advanced = True
+                        break
+                    if nxt in on:
+                        low[node] = min(low[node], index[nxt])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    if len(comp) > 1:
+                        sccs.append(sorted(comp))
+
+        for node in sorted(set(a for a, _ in edges)
+                           | set(b for _, b in edges)):
+            if node not in index:
+                strongconnect(node)
+
+        cycles: List[List[Tuple[str, str]]] = []
+        for comp in sccs:
+            comp_set = set(comp)
+            # walk one representative cycle: smallest node, smallest
+            # in-component successor each hop, until we return
+            start = comp[0]
+            path = [start]
+            seen = {start}
+            cur = start
+            while True:
+                nxt = next((n for n in succ.get(cur, [])
+                            if n in comp_set and (n == start or n not in seen)),
+                           None)
+                if nxt is None or nxt == start:
+                    break
+                path.append(nxt)
+                seen.add(nxt)
+                cur = nxt
+            cycle = [(path[i], path[(i + 1) % len(path)])
+                     for i in range(len(path))]
+            cycles.append(cycle)
+        return sorted(cycles)
+
+    def render_lock_graph(self) -> str:
+        """The checked-in graph (docs/static_analysis.md): one sorted
+        line per edge with its first witness."""
+        edges = self.lock_edges()
+        lines = []
+        for (a, b), (path, lineno, how) in sorted(edges.items()):
+            lines.append(f"{a} -> {b}    [{path}:{lineno} {how}]")
+        return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# the deep rules
+# --------------------------------------------------------------------------
+# Program-scoped: the engine runs each ONCE over the whole scanned tree
+# (analyze_source wraps a single module into a one-module Program, so the
+# per-rule fixtures in tests/test_tpulint.py exercise the same code).
+
+@rule("deep-lock", "error",
+      "Blocking call (sleep, HTTP, future .result(), TPU fetch, disk "
+      "I/O) reached through a call chain while a lock is held — the "
+      "interprocedural reach of lock-discipline",
+      scope="program")
+def check_deep_lock(program: Program) -> Iterable[Finding]:
+    """Fires on the CALL SITE under the lock whose resolved callee
+    may-block (direct blocking under a lock stays lock-discipline's);
+    the message carries the witness chain down to the operation."""
+    for info in program.functions.values():
+        for site in info.calls:
+            if not site.under or site.target not in program.block_why:
+                continue
+            lock = site.under[-1]
+            chain = program.chain(site.target, program.block_why)
+            yield Finding(
+                info.path, site.lineno, "deep-lock", "error",
+                f"`{info.name}` holds `{lock}` while the call chain "
+                f"`{chain}` blocks — every thread contending on the lock "
+                "stalls behind it; move the call outside the critical "
+                "section or make the callee non-blocking")
+
+
+@rule("deep-hot-path", "error",
+      "Host sync or blocking call reached through a call chain from a "
+      "jit-compiled or `# tpulint: hot-path` function — the "
+      "interprocedural reach of trace-hazard",
+      scope="program")
+def check_deep_hot_path(program: Program) -> Iterable[Finding]:
+    """Reports at the hot root's call site; a callee that is itself
+    hot-marked is its own check root (trace-hazard and this rule both
+    analyze it directly) and is skipped here to keep one finding per
+    hazard."""
+    for info in program.functions.values():
+        if not info.hot:
+            continue
+        for site in info.calls:
+            callee = program.functions[site.target]
+            if callee.hot:
+                continue
+            why = None
+            kind = ""
+            if site.target in program.sync_why:
+                why, kind = program.sync_why, "forces a host sync"
+            elif site.target in program.block_why:
+                why, kind = program.block_why, "blocks"
+            if why is None or program.chain_through_hot(site.target, why):
+                continue
+            chain = program.chain(site.target, why)
+            yield Finding(
+                info.path, site.lineno, "deep-hot-path", "error",
+                f"hot-path `{info.name}` reaches `{chain}` which {kind} — "
+                "per-tick host work serializes the dispatch pipeline; "
+                "batch it outside the hot region")
+
+
+@rule("lock-order", "error",
+      "Cycle in the static lock-acquisition graph (lock B taken while "
+      "holding A on one path, A while holding B on another) — two "
+      "threads interleaving those paths deadlock",
+      scope="program")
+def check_lock_order(program: Program) -> Iterable[Finding]:
+    edges = program.lock_edges()
+    for cycle in program.lock_cycles():
+        names = [a for a, _ in cycle] + [cycle[0][0]]
+        witnesses = "; ".join(
+            f"{a}->{b} at {edges[(a, b)][0]}:{edges[(a, b)][1]}"
+            f" ({edges[(a, b)][2]})"
+            for a, b in cycle if (a, b) in edges)
+        path, lineno, _ = edges[cycle[0]]
+        yield Finding(
+            path, lineno, "lock-order", "error",
+            f"lock-order cycle `{' -> '.join(names)}` — acquisition "
+            f"orders conflict ({witnesses}); pick one global order or "
+            "drop a lock from one path")

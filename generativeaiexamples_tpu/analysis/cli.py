@@ -15,11 +15,12 @@ import argparse
 import json
 import os
 import sys
+import time
 from typing import List, Optional
 
 from generativeaiexamples_tpu.analysis import baseline as baseline_mod
 from generativeaiexamples_tpu.analysis import rules as _rules  # noqa: F401
-from generativeaiexamples_tpu.analysis.engine import run_paths
+from generativeaiexamples_tpu.analysis.engine import build_program, run_paths
 from generativeaiexamples_tpu.analysis.registry import RULES
 
 # the installed package directory itself — cwd-independent, like every
@@ -52,6 +53,15 @@ def _build_parser() -> argparse.ArgumentParser:
                    "exit 0 (the grandfathering workflow)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
+    p.add_argument("--json-out", metavar="PATH",
+                   help="ALSO write the machine-readable report to PATH "
+                   "(the CI artifact), independent of --json")
+    p.add_argument("--budget-s", type=float, metavar="SECONDS",
+                   help="fail (exit 1) if the run takes longer than this "
+                   "— the lint wall-time budget, enforced in CI")
+    p.add_argument("--lock-graph", action="store_true",
+                   help="print the interprocedural lock-order graph "
+                   "(one witnessed edge per line) and exit")
     return p
 
 
@@ -64,6 +74,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{name} [{r.severity}]\n    {r.description}")
         return 0
 
+    if args.lock_graph:
+        try:
+            program = build_program(args.paths)
+        except (ValueError, OSError) as exc:
+            print(f"tpulint: {exc}", file=sys.stderr)
+            return 2
+        graph = program.render_lock_graph()
+        print(graph if graph else "(no lock-order edges)")
+        return 0
+
     if args.write_baseline and (args.only or args.skip):
         # a filtered run sees a subset of findings; writing it would drop
         # every other rule's grandfathered entries from the baseline
@@ -72,6 +92,7 @@ def main(argv: Optional[List[str]] = None) -> int:
               "baseline entries)", file=sys.stderr)
         return 2
 
+    t0 = time.monotonic()
     try:
         report = run_paths(
             args.paths, only=args.only, skip=args.skip,
@@ -80,6 +101,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     except (ValueError, OSError) as exc:
         print(f"tpulint: {exc}", file=sys.stderr)
         return 2
+    elapsed_s = time.monotonic() - t0
 
     if report.files_scanned == 0:
         print("tpulint: no .py files under the given paths — refusing to "
@@ -123,21 +145,40 @@ def main(argv: Optional[List[str]] = None) -> int:
                  "outside the scanned paths)" if keep else ""))
         return 0
 
-    if args.as_json:
-        print(json.dumps({"version": 1,
-                          "findings": [f.to_json() for f in report.findings],
-                          "summary": report.summary()},
-                         indent=2, sort_keys=True))
-        return 0 if report.clean else 1
+    doc = {"version": 1,
+           "findings": [f.to_json() for f in report.findings],
+           "summary": {**report.summary(),
+                       "elapsed_s": round(elapsed_s, 3)}}
+    rendered = json.dumps(doc, indent=2, sort_keys=True)
 
-    for f in report.findings:
-        print(f.render())
-    for msg in report.unknown_suppressions:
-        print(f"{msg}", file=sys.stderr)
-    s = report.summary()
-    status = "clean" if report.clean else f"{s['findings']} finding(s)"
-    print(f"tpulint: {status} — {s['files_scanned']} file(s) scanned, "
-          f"{s['suppressed']} suppressed, {s['baselined']} baselined")
+    over_budget = (args.budget_s is not None and elapsed_s > args.budget_s)
+
+    if args.json_out:
+        try:
+            with open(args.json_out, "w", encoding="utf-8") as f:
+                f.write(rendered + "\n")
+        except OSError as exc:
+            print(f"tpulint: cannot write {args.json_out}: {exc}",
+                  file=sys.stderr)
+            return 2
+
+    if args.as_json:
+        print(rendered)
+    else:
+        for f in report.findings:
+            print(f.render())
+        for msg in report.unknown_suppressions:
+            print(f"{msg}", file=sys.stderr)
+        s = report.summary()
+        status = "clean" if report.clean else f"{s['findings']} finding(s)"
+        print(f"tpulint: {status} — {s['files_scanned']} file(s) scanned, "
+              f"{s['suppressed']} suppressed, {s['baselined']} baselined "
+              f"[{elapsed_s:.2f}s]")
+    if over_budget:
+        print(f"tpulint: wall-time budget exceeded — {elapsed_s:.2f}s > "
+              f"{args.budget_s:.0f}s (a lint nobody waits for is a lint "
+              f"nobody runs)", file=sys.stderr)
+        return 1
     return 0 if report.clean else 1
 
 

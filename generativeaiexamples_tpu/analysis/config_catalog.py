@@ -1,0 +1,182 @@
+"""Config-knob extraction — the ``APP_*`` side of the docs sync contract.
+
+docs/configuration.md carries a marker-delimited catalog of every
+environment knob the package reads (between ``<!-- config-catalog:begin
+-->`` and ``<!-- config-catalog:end -->``), and
+``tests/test_config_catalog.py`` holds it equal to the code in BOTH
+directions: a knob the code reads but the table omits fails (the
+undocumented-flag failure — an operator cannot set what they cannot
+find), and a table row no code reads fails just as loudly (doc rot — an
+operator tuning a dead knob and watching nothing change).
+
+Knobs reach the process three ways, and the catalog sees each:
+
+  * **Literal reads** — ``os.environ.get("APP_X")``, ``os.getenv``,
+    ``os.environ["APP_X"]`` (Load context only; writes are not reads),
+    and the typed helpers ``env_float``/``env_int`` (core/config.py)
+    plus the router's module-local ``_env_int``/``_env_float``. Pure
+    AST, same bargain as tpulint/metrics_catalog: no imports of the
+    analyzed code. A name passed as a module-level string constant
+    (``MODE_ENV = "APP_QOS"``) resolves through the module's constant
+    table; an f-string resolves constant interpolations
+    (``f"{ENV_PREFIX}_CONFIG_FILE"`` → ``APP_CONFIG_FILE``) and turns
+    anything else into a ``*`` — a *dynamic pattern* row.
+  * **Schema overlay** — every field of the AppConfig dataclass tree is
+    an ``APP_<PATH>_<FIELD>`` override (core/config.py ``_from_dict``).
+    Those names are computed, not written, so :func:`collect_schema_env`
+    enumerates them by reflecting the schema itself (an import of
+    core/config only — the one catalog source where reflection IS the
+    ground truth, since the dataclass is the single place the names are
+    defined).
+  * **Pass-through names** — variables read and handed to a subprocess
+    or library verbatim (``JAX_PLATFORMS``, ``PALLAS_AXON_POOL_IPS``)
+    are not ``APP_`` knobs and stay out of the catalog by the prefix
+    filter.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, Set, Tuple
+
+# marker pair the docs section lives between
+CATALOG_BEGIN = "<!-- config-catalog:begin -->"
+CATALOG_END = "<!-- config-catalog:end -->"
+
+# `name` in a table row's first backticked cell
+_ROW_NAME = re.compile(r"^\|\s*`([^`]+)`")
+
+# callables whose first argument is an env-var name (the typed readers
+# in core/config.py, the router's module-local variants, and the debug
+# plane's bool `_flag`)
+_ENV_HELPERS = frozenset({"env_float", "env_int", "_env_float",
+                          "_env_int", "_flag"})
+
+_PREFIX = "APP_"
+
+
+def _iter_py(pkg_dir: str) -> Iterator[str]:
+    for root, dirs, files in os.walk(pkg_dir):
+        dirs[:] = [d for d in dirs if not d.startswith((".", "__pycache__"))]
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+
+
+def _module_constants(tree: ast.Module) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` assignments — the indirection
+    the qos/config modules use for their env names."""
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _env_callee(node: ast.Call) -> bool:
+    """True when the call reads the environment by name: ``os.environ.get``
+    / ``os.environ.setdefault`` / ``os.getenv`` / an ``env_*`` helper."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in ("get", "setdefault") \
+                and isinstance(fn.value, ast.Attribute) \
+                and fn.value.attr == "environ":
+            return True
+        if fn.attr == "getenv" and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "os":
+            return True
+        if fn.attr in _ENV_HELPERS:
+            return True
+    elif isinstance(fn, ast.Name) and fn.id in _ENV_HELPERS:
+        return True
+    return False
+
+
+def _resolve_name(arg: ast.expr, consts: Dict[str, str]) -> str:
+    """The env-var name an expression denotes: '' when invisible,
+    a ``*``-bearing pattern when partially resolvable."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.Name):
+        return consts.get(arg.id, "")
+    if isinstance(arg, ast.JoinedStr):
+        parts: List[str] = []
+        for v in arg.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            elif isinstance(v, ast.FormattedValue) \
+                    and isinstance(v.value, ast.Name) \
+                    and v.value.id in consts:
+                parts.append(consts[v.value.id])
+            else:
+                parts.append("*")
+        return "".join(parts)
+    return ""
+
+
+def collect_env_reads(pkg_dir: str) -> Tuple[Set[str], Set[str]]:
+    """Scan the package: returns ``(static, patterns)`` — APP_-prefixed
+    names read by literal/constant, and ``*``-bearing dynamic patterns."""
+    static: Set[str] = set()
+    patterns: Set[str] = set()
+    for path in _iter_py(pkg_dir):
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            # tpulint's parse-error rule owns unparseable files
+            continue
+        consts = _module_constants(tree)
+        for node in ast.walk(tree):
+            name = ""
+            if isinstance(node, ast.Call) and node.args \
+                    and _env_callee(node):
+                name = _resolve_name(node.args[0], consts)
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and isinstance(node.value, ast.Attribute) \
+                    and node.value.attr == "environ":
+                name = _resolve_name(node.slice, consts)
+            if not name or not name.startswith(_PREFIX):
+                continue
+            (patterns if "*" in name else static).add(name)
+    return static, patterns
+
+
+def collect_schema_env() -> Set[str]:
+    """Every ``APP_*`` override the AppConfig schema overlay accepts —
+    enumerated by reflecting the dataclass tree (the names are computed
+    per field in ``_from_dict``; the schema is their only definition)."""
+    from generativeaiexamples_tpu.core import config as config_mod
+    names = {env_name for env_name, _ftype, _default, _help
+             in config_mod._iter_env_vars(config_mod.AppConfig,
+                                          config_mod.ENV_PREFIX)}
+    return {n for n in names if n.startswith(_PREFIX)}
+
+
+def parse_catalog(md_text: str) -> Tuple[Set[str], Set[str]]:
+    """Names from the marker-delimited docs section: returns
+    ``(documented_static, documented_patterns)`` — a name containing
+    ``*`` is a dynamic pattern row."""
+    try:
+        start = md_text.index(CATALOG_BEGIN)
+        end = md_text.index(CATALOG_END)
+    except ValueError:
+        raise ValueError(
+            "docs catalog markers not found (config-catalog:begin/end)")
+    block = md_text[start:end]
+    names: Set[str] = set()
+    patterns: Set[str] = set()
+    for line in block.splitlines():
+        m = _ROW_NAME.match(line.strip())
+        if not m:
+            continue
+        name = m.group(1)
+        (patterns if "*" in name else names).add(name)
+    return names, patterns

@@ -13,7 +13,9 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
 from generativeaiexamples_tpu.analysis import baseline as baseline_mod
+from generativeaiexamples_tpu.analysis import rules as _rules  # noqa: F401 — registers the module rules
 from generativeaiexamples_tpu.analysis.astutil import ModuleContext
+from generativeaiexamples_tpu.analysis.callgraph import Program  # noqa: F401 — registers the program rules
 from generativeaiexamples_tpu.analysis.findings import BaselineKey, Finding
 from generativeaiexamples_tpu.analysis.registry import RULES, Rule
 from generativeaiexamples_tpu.analysis.suppressions import Suppressions
@@ -117,7 +119,9 @@ def analyze_source(path: str, source: str,
                    ) -> List[Finding]:
     """All raw findings for one module (suppressions NOT applied — the
     caller owns policy). A syntax error is itself a finding: tier-1 must
-    not report 'clean' on a tree it could not parse."""
+    not report 'clean' on a tree it could not parse.  Program-scoped
+    rules run over a one-module program here, so single-file fixtures
+    exercise the same interprocedural code the full run does."""
     rel = _rel(path)
     try:
         ctx = ModuleContext(rel, source)
@@ -125,8 +129,14 @@ def analyze_source(path: str, source: str,
         return [Finding(rel, exc.lineno or 1, "parse-error", "error",
                         f"file does not parse: {exc.msg}")]
     findings: List[Finding] = []
+    program: Optional[Program] = None
     for r in rules if rules is not None else list(RULES.values()):
-        findings.extend(r.check(ctx))
+        if r.scope == "program":
+            if program is None:
+                program = Program([ctx])
+            findings.extend(r.check(program))
+        else:
+            findings.extend(r.check(ctx))
     return sorted(findings)
 
 
@@ -147,25 +157,71 @@ def run_paths(paths: Sequence[str],
     rules are reported, not ignored — a typo in ``disable=`` must not
     silently re-enable nothing."""
     rules = _select(only, skip)
+    module_rules = [r for r in rules if r.scope == "module"]
+    program_rules = [r for r in rules if r.scope == "program"]
     grandfathered: Dict[BaselineKey, int] = (
         baseline_mod.load(baseline_path) if baseline_path else {})
     report = Report()
     all_remaining: List[Finding] = []
+    contexts: List[ModuleContext] = []
+    supps: Dict[str, Suppressions] = {}
     for path in discover(paths):
         with open(path, "r", encoding="utf-8") as fh:
             source = fh.read()
-        report.files.append(_rel(path))
-        findings = analyze_source(path, source, rules)
+        rel = _rel(path)
+        report.files.append(rel)
         supp = Suppressions(source)
+        supps[rel] = supp
+        try:
+            ctx: Optional[ModuleContext] = ModuleContext(rel, source)
+        except SyntaxError as exc:
+            ctx = None
+            findings = [Finding(rel, exc.lineno or 1, "parse-error", "error",
+                                f"file does not parse: {exc.msg}")]
+        else:
+            contexts.append(ctx)
+            findings = []
+            for r in module_rules:
+                findings.extend(r.check(ctx))
+            findings.sort()
         kept, n_supp = supp.split(findings)
         report.suppressed += n_supp
         all_remaining.extend(kept)
         for name in sorted(supp.mentioned):
             if name not in RULES:
                 report.unknown_suppressions.append(
-                    f"{_rel(path)}: suppression references unknown rule "
+                    f"{rel}: suppression references unknown rule "
                     f"{name!r}")
+    # whole-program phase: one Program over every parsed module, each
+    # interprocedural rule run ONCE; findings anchor to real call sites,
+    # so the per-file inline suppressions apply to them unchanged
+    if program_rules and contexts:
+        program = Program(contexts)
+        pfindings: List[Finding] = []
+        for r in program_rules:
+            pfindings.extend(r.check(program))
+        for f in sorted(pfindings):
+            supp_f = supps.get(f.file)
+            if supp_f is not None and supp_f.is_suppressed(f.rule, f.line):
+                report.suppressed += 1
+            else:
+                all_remaining.append(f)
     remaining, absorbed = baseline_mod.apply(all_remaining, grandfathered)
     report.baselined = absorbed
     report.findings = sorted(remaining)
     return report
+
+
+def build_program(paths: Sequence[str]) -> Program:
+    """Parse ``paths`` into a whole-program :class:`Program` (the CLI's
+    ``--lock-graph`` rendering path; unparseable files are skipped — the
+    lint run itself owns reporting them)."""
+    contexts: List[ModuleContext] = []
+    for path in discover(paths):
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        try:
+            contexts.append(ModuleContext(_rel(path), source))
+        except SyntaxError:
+            continue
+    return Program(contexts)

@@ -19,6 +19,11 @@ if TYPE_CHECKING:   # pragma: no cover
 
 CheckFn = Callable[["ModuleContext"], Iterable[Finding]]
 
+# "module": check(ModuleContext), run per file.  "program": check(Program)
+# (analysis/callgraph.py), run ONCE over the whole scanned tree by the
+# engine — interprocedural rules see every module at once.
+SCOPES = ("module", "program")
+
 
 @dataclass(frozen=True)
 class Rule:
@@ -26,21 +31,25 @@ class Rule:
     severity: str
     description: str
     check: CheckFn
+    scope: str = "module"
 
 
 RULES: Dict[str, Rule] = {}
 
 
-def rule(name: str, severity: str, description: str) -> Callable[[CheckFn], CheckFn]:
+def rule(name: str, severity: str, description: str,
+         scope: str = "module") -> Callable[[CheckFn], CheckFn]:
     """Register ``fn`` as the checker for ``name``. Import-time validation
     keeps rule metadata honest (the doc catalog renders from it)."""
     if severity not in SEVERITIES:
         raise ValueError(f"rule {name!r}: severity must be one of {SEVERITIES}")
+    if scope not in SCOPES:
+        raise ValueError(f"rule {name!r}: scope must be one of {SCOPES}")
 
     def deco(fn: CheckFn) -> CheckFn:
         if name in RULES:
             raise ValueError(f"duplicate rule name {name!r}")
-        RULES[name] = Rule(name, severity, description, fn)
+        RULES[name] = Rule(name, severity, description, fn, scope)
         return fn
 
     return deco

@@ -591,6 +591,85 @@ def check_metric_cardinality(ctx: ModuleContext) -> Iterable[Finding]:
 
 
 # --------------------------------------------------------------------------
+# daemon-shutdown
+# --------------------------------------------------------------------------
+
+_THREAD_CTORS = frozenset({"threading.Thread", "Thread"})
+
+
+def _joined_names(ctx: ModuleContext) -> frozenset:
+    """Last components of every ``X.join(...)`` receiver in the module —
+    `self._disk_thread.join(2)` contributes ``_disk_thread``.  One level
+    of local aliasing is followed: the idiomatic bounded-join shutdown
+    hook detaches under the lock first (``t = self._writer`` or
+    ``t, self._writer = self._writer, None``), then joins ``t`` — that
+    must credit ``_writer``, not the throwaway local."""
+    aliases: dict = {}
+    for node in ctx.walk():
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt, val = node.targets[0], node.value
+            if isinstance(tgt, ast.Name) and isinstance(val, ast.Attribute):
+                aliases.setdefault(tgt.id, set()).add(val.attr)
+            elif isinstance(tgt, ast.Tuple) and isinstance(val, ast.Tuple) \
+                    and len(tgt.elts) == len(val.elts):
+                for t_el, v_el in zip(tgt.elts, val.elts):
+                    if isinstance(t_el, ast.Name) \
+                            and isinstance(v_el, ast.Attribute):
+                        aliases.setdefault(t_el.id, set()).add(v_el.attr)
+    out = set()
+    for node in ctx.walk():
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "join":
+            base = dotted_name(node.func.value)
+            if base:
+                name = base.rsplit(".", 1)[-1]
+                out.add(name)
+                out.update(aliases.get(name, ()))
+    return frozenset(out)
+
+
+@rule("daemon-shutdown", "error",
+      "threading.Thread(daemon=True) with no join() anywhere in the "
+      "module — the interpreter kills daemons mid-write at exit, so an "
+      "unjoined writer loses its last buffer; add a bounded-join "
+      "shutdown hook (sentinel + join(timeout)) on drain/atexit")
+def check_daemon_shutdown(ctx: ModuleContext) -> Iterable[Finding]:
+    """Fires on the creation site.  Clean when the module joins the
+    stored thread somewhere (the sentinel that unblocks the loop is the
+    author's business — the join is what makes shutdown *bounded* and
+    observable).  Deliberate fire-and-forget threads (request-scoped
+    pumps, the exit-grace timer) carry an inline suppression with the
+    reason their lifecycle needs no join."""
+    joined = _joined_names(ctx)
+    for node in ctx.walk():
+        if not isinstance(node, ast.Call) \
+                or call_name(node) not in _THREAD_CTORS:
+            continue
+        daemon = next((kw.value for kw in node.keywords
+                       if kw.arg == "daemon"), None)
+        if not (isinstance(daemon, ast.Constant) and daemon.value is True):
+            continue
+        stored = None
+        parent = ctx.parent(node)
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+            stored = dotted_name(parent.targets[0])
+        elif isinstance(parent, (ast.AnnAssign, ast.NamedExpr)):
+            stored = dotted_name(parent.target)
+        if stored is not None and stored.rsplit(".", 1)[-1] in joined:
+            continue
+        what = (f"`{stored}`" if stored
+                else "an unbound `threading.Thread(daemon=True)`")
+        yield Finding(
+            ctx.path, node.lineno, "daemon-shutdown", "error",
+            f"daemon thread {what} is never join()ed — at interpreter "
+            "exit daemons die mid-operation (a write-behind loses its "
+            "last buffer); add a sentinel-stop + bounded join on "
+            "drain/atexit, or annotate the deliberate fire-and-forget "
+            "with a reason")
+
+
+# --------------------------------------------------------------------------
 # except-swallow
 # --------------------------------------------------------------------------
 

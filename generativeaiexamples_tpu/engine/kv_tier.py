@@ -47,6 +47,7 @@ that can PROMOTE instead of recompute
 
 from __future__ import annotations
 
+import atexit
 import logging
 import os
 import queue
@@ -59,6 +60,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from generativeaiexamples_tpu.core import clock
 from generativeaiexamples_tpu.core import kv_wire
 from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.observability.lockwatch import tracked_lock
 
 logger = logging.getLogger("generativeaiexamples_tpu.kv_tier")
 
@@ -145,7 +147,7 @@ class KVSpillPool:
 
     def __init__(self, budget_bytes: int) -> None:
         self.budget_bytes = int(budget_bytes)
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("kv_tier._lock")
         self._bytes: Dict[str, int] = {}
         self._used = 0
 
@@ -255,6 +257,7 @@ class PrefixKVTier(KVSpillPool):
         self._disk_dir = disk_dir
         self._disk_q: "queue.Queue[Optional[tuple]]" = queue.Queue()
         self._disk_thread: Optional[threading.Thread] = None
+        self._close_registered = False
 
     # ------------------------------------------------------------- accounting
 
@@ -597,6 +600,22 @@ class PrefixKVTier(KVSpillPool):
                                              name="kv-tier-disk",
                                              daemon=True)
         self._disk_thread.start()
+        if not self._close_registered:
+            # bounded-join shutdown: a daemon dies mid-os.replace at
+            # interpreter exit, leaving a torn .tmp next to the store
+            atexit.register(self.close)
+            self._close_registered = True
+
+    def close(self, timeout_s: float = 2.0) -> None:
+        """Bounded shutdown of the write-behind thread: sentinel-stop,
+        then join with a deadline (atexit and the scheduler's drain path
+        both land here — shutdown must never hang on a slow disk)."""
+        t = self._disk_thread
+        if t is None or not t.is_alive():
+            return
+        self._disk_q.put(None)
+        t.join(timeout_s)
+        self._disk_thread = None
 
     def _disk_dir_path(self) -> str:
         if self._disk_dir is None:

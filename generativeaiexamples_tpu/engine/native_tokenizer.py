@@ -78,6 +78,9 @@ def load_native_lib() -> Optional[ctypes.CDLL]:
         stale = (not os.path.exists(_LIB_PATH) or
                  (os.path.exists(_SRC_PATH) and
                   os.path.getmtime(_SRC_PATH) > os.path.getmtime(_LIB_PATH)))
+        # tpulint: disable=deep-lock -- one-time init: concurrent first
+        # users must WAIT for the single build (then dlopen the result),
+        # not race a second g++ against a half-linked .so
         if stale and not _build_lib():
             _lib_failed = True
             return None

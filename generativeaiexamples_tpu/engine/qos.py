@@ -68,6 +68,7 @@ from generativeaiexamples_tpu.core import clock
 from generativeaiexamples_tpu.core.config import env_int
 from generativeaiexamples_tpu.core.metrics import REGISTRY
 from generativeaiexamples_tpu.observability import usage as usage_mod
+from generativeaiexamples_tpu.observability.lockwatch import tracked_rlock
 
 logger = logging.getLogger(__name__)
 
@@ -175,7 +176,7 @@ class QosPolicy:
                  batch_hint: int = 1,
                  max_tenants: Optional[int] = None,
                  clock=None) -> None:
-        self._lock = threading.RLock()
+        self._lock = tracked_rlock("qos._lock")
         self._clock = clock if clock is not None else _mono_clock
         self._weights = dict(weights or {})
         self._default_weight = max(1e-6, float(default_weight))
@@ -193,6 +194,13 @@ class QosPolicy:
         # WFQ state: per-tenant virtual clocks + the global floor
         self._vtime: Dict[str, float] = {}
         self._global_v = 0.0
+        # lock-free overuse snapshot (tenant_overuse_hint): rebuilt under
+        # the lock at every charge/settle/order, read WITHOUT it — the KV
+        # tier prices evictions under its own lock, and taking the QoS
+        # lock there orders kv_tier._lock -> qos._lock (half a deadlock
+        # cycle lockwatch exists to catch)
+        self._overuse_snap: Tuple[frozenset, Dict[str, float]] = (
+            frozenset(self._known), {})
         # token-bucket quotas: level per metered tenant (starts full at
         # the burst cap = 2 s of rate), last-refill stamp
         self._bucket: Dict[str, float] = {
@@ -423,6 +431,7 @@ class QosPolicy:
                 idx[t] += 1
                 out.append(job)
                 vt[t] += self._cost(job.request) / self._weight(t)
+            self._publish_overuse_locked()
         # gauges outside the lock (REGISTRY locks internally); tenants
         # whose backlog drained reset to 0 so the surface never lies
         # (depths captured pre-truncation — the gauge reports the real
@@ -465,6 +474,7 @@ class QosPolicy:
             self._vtime[tenant] = v
             rid = str(getattr(req, "request_id", "") or id(req))
             self._outstanding[rid] = (tenant, est, reserve, rates)
+            self._publish_overuse_locked()
         REGISTRY.gauge("qos_virtual_time", labels={"tenant": tenant}
                        ).set(round(v, 6))
         REGISTRY.counter("qos_admissions_total",
@@ -501,6 +511,7 @@ class QosPolicy:
                     self._bucket.get(tenant, 0.0)
                     + max(0, reserved - used))
             v = self._vtime[tenant]
+            self._publish_overuse_locked()
         REGISTRY.gauge("qos_virtual_time", labels={"tenant": tenant}
                        ).set(round(v, 6))
 
@@ -571,6 +582,27 @@ class QosPolicy:
         with self._lock:
             return max(0.0, self._vtime.get(t, self._global_v)
                        - self._global_v)
+
+    def _publish_overuse_locked(self) -> None:
+        """Rebuild the lock-free overuse snapshot (one atomic whole-tuple
+        rebind — readers never observe a mid-update dict).  Caller holds
+        ``_lock``."""
+        g = self._global_v
+        snap = {t: v - g for t, v in self._vtime.items() if v > g}
+        self._overuse_snap = (frozenset(self._known), snap)
+
+    def tenant_overuse_hint(self, tenant: str) -> float:
+        """:meth:`tenant_overuse_s` from the published snapshot, WITHOUT
+        taking the QoS lock — the read the prefix KV tier's eviction
+        pricing uses *under its own lock* (engine/kv_tier.py).  At most
+        one charge/settle/order stale, which is fine for an eviction
+        bias; never taking the lock is what keeps the static lock graph
+        (and lockwatch's witness graph) free of a kv_tier->qos edge."""
+        known, snap = self._overuse_snap
+        t = usage_mod.sanitize_tenant(tenant) or usage_mod.DEFAULT_TENANT
+        if t not in known:
+            t = usage_mod.OVERFLOW_TENANT
+        return snap.get(t, 0.0)
 
     # ----------------------------------------------------------- reporting
 

@@ -50,6 +50,7 @@ from generativeaiexamples_tpu.observability import slo as slo_mod
 from generativeaiexamples_tpu.observability import usage as usage_mod
 from generativeaiexamples_tpu.observability.devtime import DEVTIME, pow2_bucket
 from generativeaiexamples_tpu.observability.flight import FLIGHT, REQUEST_LOG
+from generativeaiexamples_tpu.observability.lockwatch import tracked_lock
 from generativeaiexamples_tpu.observability.trace import TRACE
 from generativeaiexamples_tpu.engine.engine import (
     DecodeState, EngineCore, bits_to_f32, unpack_decode_out)
@@ -281,7 +282,7 @@ class Scheduler:
         # export their KV instead (_export_handoff); "decode"/"unified"
         # behave identically here (the role is a routing contract)
         self._role = str(getattr(core, "role", "unified") or "unified")
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("scheduler._lock")
         self._pending: Deque[_Job] = deque()     # awaiting slot+pages
         self._prefilling: Deque[_Job] = deque()  # admitted, chunking in
         self._slots: Dict[int, _Job] = {}        # decoding
@@ -401,8 +402,12 @@ class Scheduler:
         if self._tier is not None and self._qos is not None:
             # compose tier eviction with the QoS victim doctrine: cached
             # prefixes contributed by an overusing tenant evict first,
-            # exactly as that tenant's live jobs spill first (PR 15)
-            self._tier.set_victim_bias(self._qos.tenant_overuse_s)
+            # exactly as that tenant's live jobs spill first (PR 15).
+            # The HINT variant reads a published snapshot without the QoS
+            # lock: the tier calls this under its own lock, and
+            # kv_tier._lock -> qos._lock was the cross-module ordering
+            # edge the lock-order analyses flagged
+            self._tier.set_victim_bias(self._qos.tenant_overuse_hint)
         # live-migration evacuation (drain/SIGTERM/watchdog-trip): callers
         # queue a request, the DRIVER thread (owner of _state) performs it
         # inside _tick, parking each live slot's mid-decode snapshot in the
@@ -412,7 +417,7 @@ class Scheduler:
         # disabled router-side, no router at all, a watchdog-recovered
         # worker that keeps serving — must not hold device memory forever
         # on exactly the worker that just tripped under pressure.
-        self._evac_lock = threading.Lock()
+        self._evac_lock = tracked_lock("scheduler._evac_lock")
         self._evac_reqs: List[dict] = []
         self._evac_outbox: "OrderedDict[str, tuple]" = OrderedDict()
         self._evac_outbox_cap = 64
@@ -460,6 +465,10 @@ class Scheduler:
         # a shut-down executor
         self._fetcher.shutdown(wait=False)
         self._fail_all("scheduler stopped")
+        if self._tier is not None:
+            # bounded-join shutdown of the tier's write-behind thread —
+            # queued disk ops (including _fail_all's deletes) drain first
+            self._tier.close()
 
     def submit(self, request: Request) -> Request:
         """Enqueue; stream deltas via `iter_text(request)`."""
@@ -1085,7 +1094,14 @@ class Scheduler:
                         break
                 n = len(job.ids)
                 need = self.core.pages_for(n)
-                if (n + 1 >= self.core.max_seq
+                # capacity: a fresh prompt prefills n positions and its
+                # first decode writes at n (peak n + 1); a decoding resume
+                # re-feeds its last generated token as the first decode
+                # input (peak n), so a request preempted at exactly
+                # max_seq - 1 tokens still fits for its capacity-step
+                # token — the solo run emits it, so the resume must too
+                peak = n if job.gen_ids else n + 1
+                if (peak >= self.core.max_seq
                         or need > self.core.num_pages - 1):
                     oversized = job
                     break

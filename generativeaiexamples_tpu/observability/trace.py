@@ -36,11 +36,14 @@ from __future__ import annotations
 import atexit
 import json
 import os
+import queue as queue_mod
 import threading
+import time
 from collections import deque
 from typing import Dict, Iterable, List, Optional
 
 from generativeaiexamples_tpu.core import clock
+from generativeaiexamples_tpu.observability.lockwatch import tracked_lock
 
 SCHEMA_VERSION = 1
 
@@ -68,13 +71,20 @@ class EventTrace:
             * 1024 * 1024)
         self._ring: "deque[dict]" = deque(maxlen=self.capacity)
         self._pending: List[str] = []
-        self._flushing = False
         self._seq = 0
         self._total = 0
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("trace._lock")
+        # write-behind: full batches drain on ONE dedicated writer thread
+        # (started lazily at first batch), so file I/O never runs on an
+        # emitting thread — the driver tick and HTTP handlers pay one
+        # list-append, never an fsync
+        self._wq: "queue_mod.Queue[Optional[List[str]]]" = queue_mod.Queue()
+        self._inflight = 0            # batches enqueued, not yet on disk
+        self._writer: Optional[threading.Thread] = None
         # a bench worker subprocess may exit with < _FLUSH_EVERY lines
-        # buffered; flush() is a no-op without a file sink
-        atexit.register(self.flush)
+        # buffered; close() flushes them and bounded-joins the writer so
+        # the daemon never dies mid-write at interpreter exit
+        atexit.register(self.close)
 
     # -- configuration (bench / simulator / tests) -----------------------
 
@@ -112,7 +122,7 @@ class EventTrace:
             return
         rec = {"v": SCHEMA_VERSION, "mono": clock.mono(), "kind": kind}
         rec.update(fields)
-        flush_lines: Optional[List[str]] = None
+        batch: Optional[List[str]] = None
         with self._lock:
             rec["seq"] = self._seq
             self._seq += 1
@@ -121,35 +131,69 @@ class EventTrace:
             if self.path is not None:
                 self._pending.append(json.dumps(rec, separators=(",", ":"),
                                                 default=str))
-                if len(self._pending) >= _FLUSH_EVERY and not self._flushing:
-                    self._flushing = True
-                    flush_lines, self._pending = self._pending, []
-        if flush_lines is not None:
+                if len(self._pending) >= _FLUSH_EVERY:
+                    batch, self._pending = self._pending, []
+                    self._inflight += 1
+        if batch is not None:
+            self._wq.put(batch)
+            self._ensure_writer()
+
+    def flush(self, timeout_s: float = 2.0) -> None:
+        """Push buffered lines to the file sink and bounded-wait for the
+        writer to land every in-flight batch: dump paths and tests read
+        the file synchronously after this returns."""
+        batch: Optional[List[str]] = None
+        with self._lock:
+            if self._pending:
+                batch, self._pending = self._pending, []
+                self._inflight += 1
+            waiting = self._inflight > 0
+        if batch is not None:
+            self._wq.put(batch)
+        if not waiting:
+            return
+        self._ensure_writer()
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._inflight == 0:
+                    return
+            time.sleep(0.002)
+
+    def close(self, timeout_s: float = 2.0) -> None:
+        """Bounded shutdown (atexit): flush buffered lines, then
+        sentinel-stop the writer thread and join with a deadline so a
+        slow disk can never hang interpreter exit."""
+        self.flush(timeout_s)
+        with self._lock:
+            t, self._writer = self._writer, None
+        if t is not None and t.is_alive():
+            self._wq.put(None)
+            t.join(timeout_s)
+
+    def _ensure_writer(self) -> None:
+        with self._lock:
+            if self._writer is not None and self._writer.is_alive():
+                return
+            self._writer = threading.Thread(target=self._writer_loop,
+                                            name="trace-writer", daemon=True)
+            self._writer.start()
+
+    def _writer_loop(self) -> None:
+        while True:
+            batch = self._wq.get()
+            if batch is None:
+                return
             try:
-                self._write(flush_lines)
+                self._write(batch)
             finally:
                 with self._lock:
-                    self._flushing = False
-
-    def flush(self) -> None:
-        """Push buffered lines to the file sink (dump paths and shutdown
-        call this so the on-disk trace never trails the ring by a
-        buffer)."""
-        with self._lock:
-            if self.path is None or self._flushing:
-                return
-            self._flushing = True
-            lines, self._pending = self._pending, []
-        try:
-            if lines:
-                self._write(lines)
-        finally:
-            with self._lock:
-                self._flushing = False
+                    self._inflight -= 1
 
     def _write(self, lines: List[str]) -> None:
-        # file I/O happens with NO lock held (lock-discipline): emitters
-        # keep appending to the ring/buffer while this thread writes
+        # file I/O happens on the writer thread with NO lock held
+        # (lock-discipline): emitters keep appending to the ring/buffer
+        # while this thread writes
         path = self.path
         if not path:
             return

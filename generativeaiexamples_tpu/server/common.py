@@ -306,6 +306,24 @@ async def trace_handler(request: web.Request) -> web.Response:
                                                       kinds=kinds)})
 
 
+async def locks_handler(request: web.Request) -> web.Response:
+    """Runtime lock-order sanitizer (observability/lockwatch.py,
+    APP_LOCKWATCH=on): the witness order graph over every tracked lock,
+    plus every inversion (cycle-closing acquisition, BOTH stacks) and
+    long hold (> APP_LOCKWATCH_HOLD_MS) observed since arming. Off mode
+    answers ``{"enabled": false}`` with the env hint — the armed state
+    is a construction-time property of each lock, so flipping the env on
+    a live process tracks only locks built after the flip."""
+    from generativeaiexamples_tpu.observability import lockwatch
+    if not lockwatch._env_on():
+        return web.json_response({
+            "enabled": False,
+            "hint": "set APP_LOCKWATCH=on (worker env, before process "
+                    "start) to arm the lock-order sanitizer; "
+                    "docs/static_analysis.md"})
+    return web.json_response(lockwatch.WATCH.payload())
+
+
 async def slo_handler(request: web.Request) -> web.Response:
     """Per-class SLO attainment, burn rates, pressure, recent breaches
     (observability/slo.py) — the operator view of 'are we keeping our
@@ -356,6 +374,9 @@ def add_debug_routes(app: web.Application, drain: bool = True) -> None:
         # canonical fleet event trace: the replayable admission/dispatch/
         # route record stream (docs/simulation.md)
         web.get("/debug/trace", trace_handler),
+        # runtime lock-order sanitizer: witness graph + inversions
+        # (docs/static_analysis.md)
+        web.get("/debug/locks", locks_handler),
     ])
 
 
@@ -382,6 +403,9 @@ class StreamDrain:
         self._iterator = iterator
         self._loop = asyncio.get_running_loop()
         self._queue: "asyncio.Queue" = asyncio.Queue()
+        # tpulint: disable=daemon-shutdown -- request-scoped: the pump
+        # exits when the delta iterator ends (or the loop closes); there
+        # is no process-shutdown hook to join hundreds of live streams
         self._thread = threading.Thread(target=self._pump, daemon=True)
         self._thread.start()
 

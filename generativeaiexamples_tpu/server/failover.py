@@ -61,6 +61,7 @@ from generativeaiexamples_tpu.observability import chaos as chaos_mod
 from generativeaiexamples_tpu.observability import otel
 from generativeaiexamples_tpu.observability import slo as slo_mod
 from generativeaiexamples_tpu.observability import usage as usage_mod
+from generativeaiexamples_tpu.observability.lockwatch import tracked_lock
 from generativeaiexamples_tpu.observability.trace import TRACE
 from generativeaiexamples_tpu.server import resilience
 
@@ -118,7 +119,7 @@ class _Worker:
         # the probe passes, so recovery is a single request, not a
         # stampede of everything that queued up during the outage
         self.half_open = False
-        self.probe_lock = threading.Lock()
+        self.probe_lock = tracked_lock("failover.probe_lock")
         # discovered from /health (engine/server.py health handler): the
         # worker's serving role and live load. "" role = not yet probed;
         # a health body with no engine_role field is a unified worker.
@@ -400,11 +401,11 @@ class FailoverLLM:
                     ratio=_env_float("APP_ROUTER_RETRY_RATIO", 0.5),
                     burst=_env_float("APP_ROUTER_RETRY_BURST", 10.0)))
         self._discovered = False
-        self._discover_lock = threading.Lock()
+        self._discover_lock = tracked_lock("failover._discover_lock")
         # guards SELECTION state (score reads + dispatched increments) for
         # concurrent chat threads; health probes stay outside it (HTTP
         # under a lock is a tpulint-enforced hazard)
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("failover._lock")
         # conversation -> prefix-hash map for promote routing (engine/
         # kv_tier.py fleet loop): the affinity key of a dispatched chat
         # maps to the h0 hash the serving worker stamped on X-KV-Prefix;
@@ -1223,7 +1224,7 @@ class FailoverLLM:
                 "advertised frame support — transcode refused")
 
         transcoded: Dict[str, bytes] = {}
-        transcode_lock = threading.Lock()
+        transcode_lock = tracked_lock("failover.transcode_lock")
 
         def body_for(w: _Worker):
             if not handoff_binary or w.kv_binary:

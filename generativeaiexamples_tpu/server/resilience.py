@@ -233,6 +233,10 @@ def hedged_call(fns: Sequence[Callable[[], Any]], hedge_after_s: float,
             results.put(("err", ix, exc))
 
     launched = 1
+    # tpulint: disable=daemon-shutdown -- hedge legs are call-scoped: the
+    # result queue delivers every leg's outcome to THIS frame before it
+    # returns (or the drainer below reaps stragglers); no join point
+    # exists at process shutdown
     threading.Thread(target=run, args=(0,), daemon=True,
                      name=f"{name}-0").start()
     finished = 0
@@ -246,6 +250,7 @@ def hedged_call(fns: Sequence[Callable[[], Any]], hedge_after_s: float,
         except queue_mod.Empty:
             # hedge window expired with no result: launch the next leg
             REGISTRY.counter("hedges_total", labels={"pool": name}).inc()
+            # tpulint: disable=daemon-shutdown -- call-scoped hedge leg (see above)
             threading.Thread(target=run, args=(launched,), daemon=True,
                              name=f"{name}-{launched}").start()
             launched += 1
@@ -273,6 +278,8 @@ def hedged_call(fns: Sequence[Callable[[], Any]], hedge_after_s: float,
                             logger.debug("hedge drain callback "
                                          "failed: %s", exc)
 
+                # tpulint: disable=daemon-shutdown -- reaps in-flight legs
+                # so the winner streams now; exits after `remaining` gets
                 threading.Thread(target=drain, args=(remaining,),
                                  daemon=True,
                                  name=f"{name}-drain").start()
@@ -287,6 +294,7 @@ def hedged_call(fns: Sequence[Callable[[], Any]], hedge_after_s: float,
             if launched < len(fns) and winner is None:
                 # a leg failing FAST is better information than the hedge
                 # timer: move to the next leg immediately
+                # tpulint: disable=daemon-shutdown -- call-scoped hedge leg (see above)
                 threading.Thread(target=run, args=(launched,), daemon=True,
                                  name=f"{name}-{launched}").start()
                 launched += 1

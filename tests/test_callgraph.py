@@ -1,0 +1,357 @@
+"""Interprocedural analysis (analysis/callgraph.py): the Program call
+graph, the transitive facts, and the deep rules built on them.
+
+tests/test_tpulint.py pins each rule's one-module firing fixture; this
+file pins the MACHINERY — multi-hop witness chains, cross-module call
+resolution, both lock-edge shapes (nested ``with`` and call-under-lock),
+cycle detection and its absence under a consistent global order, the
+suppression round-trip, and the alias-following of the daemon-shutdown
+join check.
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+from generativeaiexamples_tpu.analysis.astutil import ModuleContext
+from generativeaiexamples_tpu.analysis.callgraph import Program
+from generativeaiexamples_tpu.analysis.engine import analyze_source, run_paths
+
+
+def _program(**modules):
+    """Program from ``name=source`` pairs; name ``a`` becomes ``pkg/a.py``."""
+    return Program([
+        ModuleContext(f"pkg/{name}.py", textwrap.dedent(src))
+        for name, src in modules.items()])
+
+
+def _findings(src, rule=None):
+    out = analyze_source("snippet.py", textwrap.dedent(src))
+    return [f for f in out if rule is None or f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# transitive facts + witness chains
+# ---------------------------------------------------------------------------
+
+def test_two_hop_transitive_block_fires_deep_lock():
+    """The lock-discipline gap this module exists to close: the sleep is
+    TWO calls away from the lock, invisible to any per-module rule."""
+    fnd = _findings(
+        """
+        import time
+
+        def helper_two():
+            time.sleep(1)
+
+        def helper_one():
+            helper_two()
+
+        def tick(self):
+            with self._lock:
+                helper_one()
+        """, rule="deep-lock")
+    assert len(fnd) == 1
+    # the witness chain renders every hop down to the operation
+    assert "helper_one -> helper_two -> `time.sleep`" in fnd[0].message
+    assert "_lock" in fnd[0].message
+
+
+def test_direct_block_under_lock_stays_lock_disciplines():
+    """A sleep textually under the ``with`` is the per-module rule's
+    finding; deep-lock only owns the interprocedural reach — one
+    finding per hazard, never two."""
+    fnd = _findings(
+        """
+        import time
+
+        def tick(self):
+            with self._lock:
+                time.sleep(1)
+        """)
+    assert [f.rule for f in fnd] == ["lock-discipline"]
+
+
+def test_forward_reference_resolves():
+    """Callees defined BELOW their caller still resolve (two-phase
+    collection): the driver-loop-at-top layout of every engine module."""
+    fnd = _findings(
+        """
+        import time
+
+        def tick(self):
+            with self._lock:
+                helper()
+
+        def helper():
+            time.sleep(1)
+        """, rule="deep-lock")
+    assert len(fnd) == 1
+
+
+def test_cross_module_resolution():
+    """``from pkg import b`` + ``b.helper()`` resolves when b.py is in
+    the scanned set — the Program finishes the deferred key."""
+    program = _program(
+        a="""
+        from pkg import b
+
+        def tick(self):
+            with self._lock:
+                b.helper()
+        """,
+        b="""
+        import time
+
+        def helper():
+            time.sleep(1)
+        """)
+    caller = program.functions["pkg/a.py::tick"]
+    assert [site.target for site in caller.calls] == ["pkg/b.py::helper"]
+    assert "pkg/b.py::helper" in program.block_why
+    # the transitive fact flows back across the module boundary
+    assert "pkg/a.py::tick" in program.block_why
+
+
+def test_unresolvable_attribute_call_is_skipped_not_guessed():
+    """``self._qos.order()`` — an attribute on an object of unknown type
+    — must NOT resolve (a tpulint true positive stays near-certain);
+    this is exactly the edge the runtime lockwatch covers instead."""
+    program = _program(
+        a="""
+        def tick(self):
+            with self._lock:
+                self._qos.order()
+        """)
+    assert program.functions["pkg/a.py::tick"].calls == []
+
+
+def test_hot_callee_is_its_own_check_root():
+    """A jitted helper reached from a hot root is analyzed directly by
+    trace-hazard/deep-hot-path — the caller does not re-report it."""
+    fnd = _findings(
+        """
+        import jax
+
+        @jax.jit
+        def inner(x):
+            return x.item()
+
+        @jax.jit
+        def outer(x):
+            return inner(x)
+        """, rule="deep-hot-path")
+    assert fnd == []   # trace-hazard owns inner's direct .item()
+
+
+# ---------------------------------------------------------------------------
+# the lock graph: both edge shapes, cycles, rendering
+# ---------------------------------------------------------------------------
+
+def test_lock_edges_from_nested_with_and_call_under_lock():
+    program = _program(
+        m="""
+        import threading
+        _alpha_lock = threading.Lock()
+        _beta_lock = threading.Lock()
+        _gamma_lock = threading.Lock()
+
+        def nested():
+            with _alpha_lock:
+                with _beta_lock:
+                    pass
+
+        def takes_gamma():
+            with _gamma_lock:
+                pass
+
+        def call_under():
+            with _alpha_lock:
+                takes_gamma()
+        """)
+    edges = program.lock_edges()
+    assert set(edges) == {("pkg.m._alpha_lock", "pkg.m._beta_lock"),
+                          ("pkg.m._alpha_lock", "pkg.m._gamma_lock")}
+    _, _, how_nested = edges[("pkg.m._alpha_lock", "pkg.m._beta_lock")]
+    assert "nested `with`" in how_nested
+    _, _, how_call = edges[("pkg.m._alpha_lock", "pkg.m._gamma_lock")]
+    assert "`call_under` calls `takes_gamma`" in how_call
+    rendered = program.render_lock_graph()
+    assert "pkg.m._alpha_lock -> pkg.m._beta_lock" in rendered
+    assert "pkg/m.py" in rendered
+
+
+def test_per_class_lock_identity():
+    """``self._lock`` in two classes of one module are DISTINCT nodes —
+    the spill pool's lock and the tier's lock never alias."""
+    program = _program(
+        m="""
+        class Pool:
+            def a(self):
+                with self._lock:
+                    pass
+
+        class Tier:
+            def b(self):
+                with self._lock:
+                    pass
+        """)
+    acquires = {a.lock for info in program.functions.values()
+                for a in info.acquires}
+    assert acquires == {"pkg.m.Pool._lock", "pkg.m.Tier._lock"}
+
+
+def test_lock_order_cycle_detected_and_witnessed():
+    fnd = _findings(
+        """
+        import threading
+        _alpha_lock = threading.Lock()
+        _beta_lock = threading.Lock()
+
+        def ab():
+            with _alpha_lock:
+                with _beta_lock:
+                    pass
+
+        def ba():
+            with _beta_lock:
+                with _alpha_lock:
+                    pass
+        """, rule="lock-order")
+    assert len(fnd) == 1
+    msg = fnd[0].message
+    # both conflicting witnesses, with file:line each
+    assert "snippet._alpha_lock->snippet._beta_lock" in msg
+    assert "snippet._beta_lock->snippet._alpha_lock" in msg
+    assert msg.count("snippet.py:") == 2
+
+
+def test_consistent_global_order_is_clean():
+    """A->B on ten paths is FINE — only a conflicting order fires."""
+    fnd = _findings(
+        """
+        import threading
+        _alpha_lock = threading.Lock()
+        _beta_lock = threading.Lock()
+
+        def one():
+            with _alpha_lock:
+                with _beta_lock:
+                    pass
+
+        def two():
+            with _alpha_lock:
+                with _beta_lock:
+                    pass
+        """, rule="lock-order")
+    assert fnd == []
+
+
+def test_transitive_lock_cycle_through_a_call():
+    """The order conflict hides behind a call: ``ab`` nests A->B while
+    ``b_then_call`` holds B and CALLS a function that takes A."""
+    fnd = _findings(
+        """
+        import threading
+        _alpha_lock = threading.Lock()
+        _beta_lock = threading.Lock()
+
+        def takes_alpha():
+            with _alpha_lock:
+                pass
+
+        def ab():
+            with _alpha_lock:
+                with _beta_lock:
+                    pass
+
+        def b_then_call():
+            with _beta_lock:
+                takes_alpha()
+        """, rule="lock-order")
+    assert len(fnd) == 1
+
+
+# ---------------------------------------------------------------------------
+# suppression round-trip
+# ---------------------------------------------------------------------------
+
+def test_deep_rule_suppression_round_trip(tmp_path):
+    """Program-phase findings anchor to real call sites, so the per-file
+    inline suppressions apply to them through ``run_paths`` unchanged —
+    the engine wiring, not just the Suppressions helper."""
+    src = """
+    import time
+
+    def helper():
+        time.sleep(1)
+
+    def tick(self):
+        with self._lock:
+            helper(){sup}
+    """
+    mod = tmp_path / "m.py"
+    mod.write_text(textwrap.dedent(src.format(sup="")))
+    report = run_paths([str(tmp_path)], baseline_path=None)
+    assert [f.rule for f in report.findings] == ["deep-lock"]
+
+    mod.write_text(textwrap.dedent(src.format(
+        sup="  # tpulint: disable=deep-lock -- drain sleep is bounded")))
+    report = run_paths([str(tmp_path)], baseline_path=None)
+    assert report.findings == []
+    assert report.suppressed == 1
+
+
+# ---------------------------------------------------------------------------
+# daemon-shutdown alias following
+# ---------------------------------------------------------------------------
+
+def test_daemon_shutdown_credits_detach_then_join():
+    """The house shutdown idiom — detach the thread attribute under a
+    lock, then join the LOCAL alias outside it — must count as joined
+    (both the single-assign and tuple-swap shapes)."""
+    clean = _findings(
+        """
+        import threading
+
+        class Sink:
+            def start(self):
+                self._writer = threading.Thread(
+                    target=self._loop, daemon=True)
+                self._writer.start()
+
+            def close(self):
+                t, self._writer = self._writer, None
+                if t is not None:
+                    t.join(2.0)
+        """, rule="daemon-shutdown")
+    assert clean == []
+
+    clean2 = _findings(
+        """
+        import threading
+
+        class Pool:
+            def start(self):
+                self._disk_thread = threading.Thread(
+                    target=self._loop, daemon=True)
+                self._disk_thread.start()
+
+            def close(self):
+                t = self._disk_thread
+                t.join(2.0)
+        """, rule="daemon-shutdown")
+    assert clean2 == []
+
+    # and WITHOUT any join, the same start fires
+    fnd = _findings(
+        """
+        import threading
+
+        class Sink:
+            def start(self):
+                self._writer = threading.Thread(
+                    target=self._loop, daemon=True)
+                self._writer.start()
+        """, rule="daemon-shutdown")
+    assert len(fnd) == 1

@@ -1,0 +1,105 @@
+"""Config-catalog sync — docs/configuration.md vs what the code reads.
+
+Both directions are enforced (the metric-catalog contract applied to
+config knobs): an ``APP_*`` variable the code reads but the catalog
+omits fails (an operator cannot set what they cannot find), and a row
+no code reads fails just as loudly (an operator tuning a dead knob and
+watching nothing change). The code side is a pure-AST scan for direct
+reads (`analysis/config_catalog.py`) plus reflection over the AppConfig
+schema for the computed ``APP_<PATH>_<FIELD>`` overlay names.
+"""
+
+import ast
+import os
+
+import generativeaiexamples_tpu
+from generativeaiexamples_tpu.analysis.config_catalog import (
+    CATALOG_BEGIN, CATALOG_END, _module_constants, _resolve_name,
+    collect_env_reads, collect_schema_env, parse_catalog)
+
+PKG_DIR = os.path.dirname(generativeaiexamples_tpu.__file__)
+DOC_PATH = os.path.join(PKG_DIR, os.pardir, "docs", "configuration.md")
+
+
+def _sides():
+    static, patterns = collect_env_reads(PKG_DIR)
+    known = static | collect_schema_env()
+    with open(DOC_PATH, "r", encoding="utf-8") as f:
+        doc_names, doc_patterns = parse_catalog(f.read())
+    return known, patterns, doc_names, doc_patterns
+
+
+def test_markers_present():
+    with open(DOC_PATH, "r", encoding="utf-8") as f:
+        text = f.read()
+    assert CATALOG_BEGIN in text and CATALOG_END in text
+    assert text.index(CATALOG_BEGIN) < text.index(CATALOG_END)
+
+
+def test_collector_sees_the_tree():
+    known, _, _, _ = _sides()
+    # sanity floor: the scan really covered the package, not a stub dir
+    assert len(known) > 100, sorted(known)
+    # one of each read shape: plain literal, module-constant indirection
+    # (qos MODE_ENV), typed helper (env_float), bool helper (_flag),
+    # resolved f-string (ENV_PREFIX), and a schema-only overlay name
+    for probe in ("APP_TRACE", "APP_QOS", "APP_WATCHDOG_DISPATCH_S",
+                  "APP_DEBUG_NANS", "APP_CONFIG_FILE", "APP_LOCKWATCH",
+                  "APP_ENGINE_MAX_BATCH_SIZE"):
+        assert probe in known, probe
+
+
+def test_every_read_knob_is_documented():
+    known, patterns, doc_names, doc_patterns = _sides()
+    undocumented = sorted(known - doc_names)
+    assert undocumented == [], (
+        "read by code but missing from the docs/configuration.md catalog "
+        f"(add rows between the config-catalog markers): {undocumented}")
+    unlisted = sorted(patterns - doc_patterns)
+    assert unlisted == [], (
+        f"dynamic read patterns missing from the catalog: {unlisted}")
+
+
+def test_no_documented_but_dead_knobs():
+    known, patterns, doc_names, doc_patterns = _sides()
+    dead = sorted(doc_names - known)
+    assert dead == [], (
+        "documented in docs/configuration.md but read nowhere in code — "
+        f"delete the rows or restore the reads: {dead}")
+    dead_patterns = sorted(doc_patterns - patterns)
+    assert dead_patterns == [], (
+        f"documented dynamic patterns with no reading call site: "
+        f"{dead_patterns}")
+
+
+def test_resolver_semantics():
+    """The extractor's three resolution paths, pinned on a fixture."""
+    tree = ast.parse(
+        'PREFIX = "APP"\n'
+        'MODE_ENV = "APP_MODE"\n'
+        'import os\n'
+        'a = os.environ.get("APP_LIT")\n'
+        'b = os.environ.get(MODE_ENV)\n'
+        'c = os.environ.get(f"{PREFIX}_SUFFIX")\n'
+        'd = os.environ.get(f"{unknown}_TAIL")\n')
+    consts = _module_constants(tree)
+    calls = [n for n in ast.walk(tree) if isinstance(n, ast.Call)]
+    got = {_resolve_name(c.args[0], consts) for c in calls if c.args}
+    assert "APP_LIT" in got
+    assert "APP_MODE" in got            # constant indirection
+    assert "APP_SUFFIX" in got          # resolved f-string
+    assert "*_TAIL" in got              # unresolvable part becomes *
+
+
+def test_writes_are_not_reads():
+    """``os.environ["X"] = ...`` (Store context) must not put X in the
+    catalog — otel's service-name stamp is a write, not a knob."""
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        with open(os.path.join(d, "m.py"), "w") as f:
+            f.write('import os\n'
+                    'os.environ["APP_WRITTEN"] = "x"\n'
+                    'y = os.environ["APP_READ"]\n')
+        static, _ = collect_env_reads(d)
+    assert "APP_READ" in static
+    assert "APP_WRITTEN" not in static

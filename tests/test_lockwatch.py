@@ -1,0 +1,222 @@
+"""Runtime lock-order sanitizer (observability/lockwatch.py).
+
+Two contracts pinned here. First, the detector: an A→B acquisition
+followed by B→A (any thread) is an inversion, reported with BOTH witness
+stacks — the full deadlock diagnosis without ever deadlocking. Second,
+the zero-overhead bargain: with ``APP_LOCKWATCH`` off the factories
+return RAW ``threading`` primitives, and a real Scheduler tick makes
+ZERO calls into the watcher — enforced by counting, not by timing.
+
+The 1000-episode deadlock hunt over the real serving plane lives in
+tests/test_scheduler_fuzz.py (every episode arms the watch and asserts
+the witness graph stayed acyclic); this file pins the mechanism itself.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+
+import pytest
+
+from generativeaiexamples_tpu.engine.fakecore import FakeCore
+from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
+from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+from generativeaiexamples_tpu.observability import lockwatch
+from generativeaiexamples_tpu.observability.lockwatch import (
+    TrackedLock, WATCH, tracked_lock, tracked_rlock)
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    """APP_LOCKWATCH=on with a clean witness graph, reset on exit so no
+    edge recorded here leaks into the fuzz suites' assertions."""
+    monkeypatch.setenv("APP_LOCKWATCH", "on")
+    WATCH.reset()
+    yield
+    WATCH.reset()
+
+
+# ---------------------------------------------------------------------------
+# the factories: raw when off, tracked when armed
+# ---------------------------------------------------------------------------
+
+def test_off_mode_returns_raw_primitives(monkeypatch):
+    monkeypatch.delenv("APP_LOCKWATCH", raising=False)
+    # the REAL primitives, not a pass-through wrapper — type-identical
+    assert type(tracked_lock("x")) is type(threading.Lock())
+    assert type(tracked_rlock("x")) is type(threading.RLock())
+
+
+def test_armed_mode_returns_tracked(armed):
+    lk = tracked_lock("a.lock")
+    assert isinstance(lk, TrackedLock)
+    assert "a.lock" in repr(lk)
+    # the env is re-read per CONSTRUCTION — a lock built while armed
+    # stays tracked, context-manager protocol intact
+    with lk:
+        assert lk.locked()
+    assert not lk.locked()
+
+
+# ---------------------------------------------------------------------------
+# inversion detection
+# ---------------------------------------------------------------------------
+
+def test_inversion_reported_with_both_stacks(armed):
+    a = tracked_lock("A")
+    b = tracked_lock("B")
+
+    with a:          # A → B on this thread
+        with b:
+            pass
+    assert WATCH.inversions == []       # one order alone is fine
+
+    def reversed_order():               # B → A on another thread
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=reversed_order, name="inverter")
+    t.start()
+    t.join(5.0)
+
+    invs = WATCH.inversions
+    assert len(invs) == 1
+    inv = invs[0]
+    # the cycle names both locks and closes on itself
+    assert inv["cycle"][0] == inv["cycle"][-1]
+    assert set(inv["cycle"]) == {"A", "B"}
+    # BOTH witnesses carry stacks: the cycle-closing acquisition ...
+    assert inv["this"]["held"] == "B" and inv["this"]["acquired"] == "A"
+    assert inv["this"]["thread"] == "inverter"
+    assert inv["this"]["acquire_stack"] and inv["this"]["held_stack"]
+    assert all(":" in frame for frame in inv["this"]["acquire_stack"])
+    # ... and the conflicting edge it raced (the earlier A → B)
+    assert inv["conflict"]["held"] == "A"
+    assert inv["conflict"]["acquired"] == "B"
+    assert inv["conflict"]["acquire_stack"]
+    assert inv["conflict"]["thread"] != "inverter"
+
+
+def test_transitive_cycle_detected(armed):
+    """A → B, B → C, then C → A: no pair inverts, the TRIPLE does."""
+    a, b, c = (tracked_lock(n) for n in "ABC")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    assert WATCH.inversions == []
+    with c:
+        with a:
+            pass
+    invs = WATCH.inversions
+    assert len(invs) == 1
+    assert set(invs[0]["cycle"]) == {"A", "B", "C"}
+
+
+def test_reentrant_rlock_adds_no_edge(armed):
+    rl = tracked_rlock("R")
+    with rl:
+        with rl:                        # owner re-entry: depth bump only
+            pass
+    assert WATCH.payload()["edges"] == []
+    assert WATCH.inversions == []
+
+
+def test_nonblocking_acquire_records_no_edge(armed):
+    """``acquire(blocking=False)`` cannot deadlock — failover's probe
+    lock idiom — so it must not contribute edges (but locks it HOLDS
+    still count for later blocking acquires)."""
+    a = tracked_lock("A")
+    b = tracked_lock("B")
+    with a:
+        assert b.acquire(blocking=False)
+        b.release()
+    assert WATCH.payload()["edges"] == []
+    # held-side still counts: nonblocking-held A + blocking B = edge
+    assert a.acquire(blocking=False)
+    with b:
+        pass
+    a.release()
+    edges = WATCH.payload()["edges"]
+    assert [(e["held"], e["acquired"]) for e in edges] == [("A", "B")]
+
+
+def test_long_hold_recorded_with_stack(armed, monkeypatch):
+    monkeypatch.setattr(WATCH, "hold_ms", 5.0)
+    lk = tracked_lock("slowpoke")
+    with lk:
+        time.sleep(0.02)
+    holds = WATCH.payload()["long_holds"]
+    assert len(holds) == 1
+    assert holds[0]["lock"] == "slowpoke"
+    assert holds[0]["held_ms"] > 5.0
+    assert holds[0]["stack"]               # the holder's acquire site
+
+
+def test_payload_shape_and_reset(armed):
+    with tracked_lock("only"):
+        pass
+    body = WATCH.payload()
+    assert body["enabled"] is True
+    assert body["locks"] == ["only"]
+    assert set(body) == {"enabled", "hold_ms", "locks", "edges",
+                         "inversions", "long_holds"}
+    WATCH.reset()
+    assert WATCH.payload()["locks"] == []
+
+
+# ---------------------------------------------------------------------------
+# the zero-overhead bargain, enforced on the real serving plane
+# ---------------------------------------------------------------------------
+
+def test_off_mode_makes_zero_watch_calls_over_a_real_scheduler_run(
+        monkeypatch):
+    """With the watch off, a full submit→prefill→decode→finish run on a
+    real Scheduler must never enter the watcher: its locks are raw
+    primitives, so the count is exactly zero (not "small")."""
+    monkeypatch.delenv("APP_LOCKWATCH", raising=False)
+    calls = []
+    monkeypatch.setattr(WATCH, "note_acquired",
+                        lambda *a, **k: calls.append(("acq", a)))
+    monkeypatch.setattr(WATCH, "note_released",
+                        lambda *a, **k: calls.append(("rel", a)))
+
+    sched = Scheduler(FakeCore(), ByteTokenizer())
+    assert type(sched._lock) is type(threading.Lock())
+    req = Request(prompt_ids=[65, 66, 67], max_tokens=4, temperature=0.0)
+    sched.submit(req)
+    for _ in range(500):
+        sched._tick()
+        if req.finished_at is not None:
+            break
+        time.sleep(0.0005)
+    assert req.finished_at is not None, "scheduler never finished the run"
+    assert calls == []
+
+
+# ---------------------------------------------------------------------------
+# /debug/locks
+# ---------------------------------------------------------------------------
+
+def test_debug_locks_handler(armed, monkeypatch):
+    from generativeaiexamples_tpu.server import common
+    with tracked_lock("outer"):
+        with tracked_lock("inner"):
+            pass
+    body = json.loads(asyncio.run(common.locks_handler(None)).body)
+    assert body["enabled"] is True
+    assert body["locks"] == ["inner", "outer"]
+    assert [(e["held"], e["acquired"]) for e in body["edges"]] \
+        == [("outer", "inner")]
+
+    # off mode answers disabled + the arming hint, not an empty graph
+    monkeypatch.delenv("APP_LOCKWATCH", raising=False)
+    off = json.loads(asyncio.run(common.locks_handler(None)).body)
+    assert off["enabled"] is False
+    assert "APP_LOCKWATCH" in off["hint"]

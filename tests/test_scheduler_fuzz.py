@@ -54,6 +54,7 @@ from generativeaiexamples_tpu.engine.fakecore import (  # noqa: F401
 from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler, _STOP
 from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
 from generativeaiexamples_tpu.observability import chaos as chaos_mod
+from generativeaiexamples_tpu.observability import lockwatch
 
 @dataclass(frozen=True)
 class _Spec:
@@ -126,6 +127,11 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
     stay exactly on the cached plane, within budget."""
     import os
     rng = np.random.RandomState(seed)
+    # every episode doubles as a deadlock hunt: the scheduler/qos/tier
+    # locks are constructed TRACKED (observability/lockwatch.py) and the
+    # invariants below assert the witness graph stayed acyclic
+    os.environ["APP_LOCKWATCH"] = "on"
+    lockwatch.WATCH.reset()
     if spill or tier:
         os.environ["APP_KV_SPILL_MB"] = "64"
     if tier:
@@ -137,6 +143,7 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
         tok = ByteTokenizer()
         sched = Scheduler(core, tok)
     finally:
+        os.environ.pop("APP_LOCKWATCH", None)
         os.environ.pop("APP_KV_SPILL_MB", None)
         os.environ.pop("APP_KV_TIER", None)
         for key in _QOS_ENV:
@@ -393,6 +400,16 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
                 # it streamed tokens, so it HELD pages across dispatches
                 return (f"req {i}: emitted {req.completion_tokens} tokens "
                         f"but billed zero page-seconds")
+        # lock-order sanitizer: the episode's every blocking acquisition
+        # fed the witness graph — one inversion here is a real deadlock
+        # waiting for the right interleaving (both stacks in the report)
+        inversions = lockwatch.WATCH.inversions
+        if inversions:
+            inv = inversions[0]
+            return (f"lock-order inversion: cycle {inv['cycle']} — "
+                    f"this: {inv['this']['acquire_stack'][-1]} "
+                    f"(thread {inv['this']['thread']}), conflict: "
+                    f"{(inv['conflict'] or {}).get('acquire_stack', ['?'])[-1]}")
         return None
     finally:
         sched_mod._fetch = orig_fetch
@@ -475,6 +492,12 @@ def test_scheduler_fuzz_invariants():
         core_kw = _core_kw(rng)
         specs = _gen_specs(rng, core_kw)
         err = _run_episode(seed, specs, core_kw)
+        if ep == 0:
+            # the deadlock hunt is only as good as its arming: the
+            # episode must have built TRACKED locks, not raw ones
+            seen = lockwatch.WATCH.payload()["locks"]
+            assert "scheduler._lock" in seen, \
+                f"lockwatch armed but scheduler lock untracked: {seen}"
         if err:
             pytest.fail(f"episode {ep}: " + _shrink(seed, specs, core_kw, err))
     elapsed = time.perf_counter() - t0

@@ -906,6 +906,20 @@ def test_every_registered_rule_has_a_firing_fixture():
         "    except Exception:\n        continue\n",
         "def f(rid):\n"
         "    REGISTRY.counter('c', labels={'rid': rid}).inc()\n",
+        # interprocedural (program-scoped) rules: a snippet is a
+        # one-module Program, so transitive facts still flow
+        "import time\ndef helper():\n    time.sleep(1)\n"
+        "def f(self):\n    with self._lock:\n        helper()\n",
+        "import jax\ndef helper(x):\n    return x.item()\n"
+        "@jax.jit\ndef f(x):\n    return helper(x)\n",
+        "import threading\n"
+        "_a_lock = threading.Lock()\n_b_lock = threading.Lock()\n"
+        "def f():\n    with _a_lock:\n        with _b_lock:\n"
+        "            pass\n"
+        "def g():\n    with _b_lock:\n        with _a_lock:\n"
+        "            pass\n",
+        "import threading\ndef work():\n    pass\n"
+        "t = threading.Thread(target=work, daemon=True)\nt.start()\n",
     ]
     for src in snippets:
         fired |= {f.rule for f in analyze_source("s.py", src)}
