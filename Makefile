@@ -108,3 +108,12 @@ simulate:
 .PHONY: simulate-smoke
 simulate-smoke:
 	$(TEST_ENV) python -m pytest tests/test_simulate.py -q
+
+# Tier-1 smoke for the latency forensics plane: drive the real scheduler
+# through a scripted bad episode (mid-serving recompile + page-pressure
+# preemption + qos shed), assert every request's breakdown partitions its
+# wall time and /debug/doctor names the injected causes — plus the
+# burn-rate window math on an injected clock (tests/test_alerts.py).
+.PHONY: doctor-smoke
+doctor-smoke:
+	$(TEST_ENV) python -m pytest tests/test_forensics.py tests/test_alerts.py -q

@@ -1507,12 +1507,20 @@ def _arm_trace(round_name: str) -> Optional[str]:
         pass
     TRACE.configure(mode="on", path=path)
     TRACE.reset()
+    # the forensics plane rides every traced round: tail exemplars +
+    # burn-rate alerts land in the round JSON (observability/forensics.py)
+    from generativeaiexamples_tpu.observability.forensics import FORENSICS
+    FORENSICS.configure(mode="on")
+    FORENSICS.reset()
+    from generativeaiexamples_tpu.observability.alerts import ALERTS
+    ALERTS.reset()
     # rounds that boot engine WORKERS as subprocesses (goodput, chaos,
     # disagg) inherit the sink through env — each worker's trace plane
     # appends to the same JSONL (line-batched appends; the replayer
     # orders by mono+seq, not file position)
     os.environ["APP_TRACE"] = "on"
     os.environ["APP_TRACE_PATH"] = path
+    os.environ["APP_FORENSICS"] = "on"
     return path
 
 
@@ -1520,6 +1528,14 @@ def _seal_trace(extra: dict, path: Optional[str]) -> dict:
     if path is not None:
         TRACE.flush()
         extra["trace_out"] = path
+        # tail forensics ship WITH the round: the top-3 p99 breakdowns
+        # (cause-tagged segments) and every alert raise edge, so an r06+
+        # scoreboard line explains its own tail instead of reporting it
+        from generativeaiexamples_tpu.observability.alerts import ALERTS
+        from generativeaiexamples_tpu.observability.forensics import (
+            FORENSICS)
+        extra["tail_exemplars"] = FORENSICS.top_exemplars(3)
+        extra["alerts_fired"] = ALERTS.fired()
     return extra
 
 

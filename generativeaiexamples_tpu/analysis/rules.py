@@ -293,6 +293,7 @@ def check_clock_discipline(ctx: ModuleContext) -> Iterable[Finding]:
 # record and replay instead of failing loudly
 _CLOCK_POLICY_SUFFIXES = (
     "engine/scheduler.py", "engine/qos.py", "engine/kv_tier.py",
+    "observability/forensics.py", "observability/alerts.py",
 )
 _STDLIB_CLOCK_CALLS = frozenset({
     "time.time", "time.monotonic", "time.perf_counter",

@@ -46,6 +46,7 @@ import numpy as np
 from generativeaiexamples_tpu.core import clock
 from generativeaiexamples_tpu.core.metrics import REGISTRY
 from generativeaiexamples_tpu.observability import chaos as chaos_mod
+from generativeaiexamples_tpu.observability import forensics as forensics_mod
 from generativeaiexamples_tpu.observability import slo as slo_mod
 from generativeaiexamples_tpu.observability import usage as usage_mod
 from generativeaiexamples_tpu.observability.devtime import DEVTIME, pow2_bucket
@@ -694,6 +695,8 @@ class Scheduler:
             self._qos_settle(job)
             usage_mod.USAGE.bill_request(job.request)
             REQUEST_LOG.record(job.request)
+            if forensics_mod.FORENSICS.enabled:
+                forensics_mod.FORENSICS.observe(job.request)
             job.request.out_queue.put(_STOP)
             job.pages = []
             job.slot = -1
@@ -812,6 +815,8 @@ class Scheduler:
         self._qos_settle(job)
         usage_mod.USAGE.bill_request(req)
         REQUEST_LOG.record(req)
+        if forensics_mod.FORENSICS.enabled:
+            forensics_mod.FORENSICS.observe(req)
         req.out_queue.put(_STOP)
         # decode-written pages join the prefix cache before release: a
         # follow-up turn whose templated prompt embeds this conversation
@@ -839,6 +844,8 @@ class Scheduler:
         self._qos_settle(job)
         usage_mod.USAGE.bill_request(job.request)
         REQUEST_LOG.record(job.request)
+        if forensics_mod.FORENSICS.enabled:
+            forensics_mod.FORENSICS.observe(job.request)
         job.request.out_queue.put(_STOP)
 
     def _table_device(self) -> jax.Array:
@@ -1533,9 +1540,16 @@ class Scheduler:
                        padded_tokens=g_bucket * self.core.chunk,
                        weight_passes=1.0)
         if TRACE.enabled:
+            slots_hit = {it.slot for it in items}
+            # rids roster: the forensics plane joins this GLOBAL emit back
+            # to each member request's critical path (finals are removed
+            # from _prefilling only below, so the roster walk sees them)
             TRACE.emit("dispatch", phase="prefill", chunks=len(items),
                        tokens=sum(len(it.chunk_ids) for it in items),
-                       jobs=len({it.slot for it in items}))
+                       jobs=len(slots_hit),
+                       rids=",".join(j.request.request_id
+                                     for j in self._prefilling
+                                     if j.slot in slots_hit))
         for job in finals:
             self._prefilling.remove(job)
             # prompt pages are now fully write-dispatched: publish them
@@ -1995,6 +2009,8 @@ class Scheduler:
         self._qos_settle(job)
         usage_mod.USAGE.bill_request(req)
         REQUEST_LOG.record(req)
+        if forensics_mod.FORENSICS.enabled:
+            forensics_mod.FORENSICS.observe(req)
         req.out_queue.put(_STOP)
         self._release(job)
 
@@ -2581,7 +2597,9 @@ class Scheduler:
             TRACE.emit("dispatch", phase="decode", steps=steps,
                        width=width, slots=len(self._slots),
                        mixed=packed_chunks is not None,
-                       fill=round(len(self._slots) / self.core.batch, 4))
+                       fill=round(len(self._slots) / self.core.batch, 4),
+                       rids=",".join(j.request.request_id
+                                     for j in self._slots.values()))
         # devtime ledger (observability/devtime.py): classify this dispatch
         # into its XLA compile-unit key. Grammar and top-logprob variants
         # ARE separate compiles (static args), so they split the program

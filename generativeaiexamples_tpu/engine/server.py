@@ -211,6 +211,14 @@ class ModelServer:
         # bounded (tenant cardinality cap; fixed-size card).
         body["usage_by_tenant"] = usage_mod.USAGE.rollup()
         body["perf"] = usage_mod.worker_perf_card()
+        # burn-rate alert summary piggybacks the same probe cycle (the
+        # usage-plane pattern): the router surfaces worker alerts without
+        # a second scrape. Off-mode cost is one attribute read.
+        from generativeaiexamples_tpu.observability.forensics import (
+            FORENSICS)
+        if FORENSICS.enabled:
+            from generativeaiexamples_tpu.observability.alerts import ALERTS
+            body["alerts_active"] = ALERTS.active()
         if self.watchdog is not None:
             body["watchdog"] = self.watchdog.status()
             if not self.watchdog.serving_ok():

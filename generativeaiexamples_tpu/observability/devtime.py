@@ -232,6 +232,12 @@ class DevtimeLedger:
         with self._lock:
             self._perf = perf
 
+    def perf(self):
+        """The attached analytic model, or None — the forensics doctor
+        costs symptoms in device-seconds through this."""
+        with self._lock:
+            return self._perf
+
     def mark_warm(self, program: str, bucket: Any) -> None:
         """Record that warmup compiled this key — its first dispatch is not
         a compile event (EngineCore.warmup calls this per compiled key)."""

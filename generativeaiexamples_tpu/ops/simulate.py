@@ -616,6 +616,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--synthetic", action="store_true",
                    help="generate the goodput-round antagonist workload "
                         "instead of loading a trace")
+    p.add_argument("--exemplar", default="", metavar="RID",
+                   help="replay ONE captured forensics exemplar "
+                        "(observability/forensics.py): filters --trace "
+                        "PATH to this request's slice — or pulls it from "
+                        "the in-process exemplar ring when no --trace is "
+                        "given — so a captured p99 request can be "
+                        "counterfactually replayed against what-if knobs")
     p.add_argument("--requests", type=int, default=60)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--replicas", type=int, default=1)
@@ -653,7 +660,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                     tier_mb=args.tier_mb, tier_mode=args.tier_mode,
                     prefill_share=args.prefill_share)
     trace_records: Optional[List[dict]] = None
-    if args.trace:
+    if args.exemplar:
+        from generativeaiexamples_tpu.observability import (
+            forensics as forensics_mod)
+        if args.trace:
+            slice_recs = forensics_mod.trace_slice(
+                args.exemplar, read_jsonl(args.trace))
+        else:
+            ex = forensics_mod.FORENSICS.get(args.exemplar)
+            slice_recs = list((ex or {}).get("trace") or [])
+        if not slice_recs:
+            p.error(f"no trace slice for exemplar {args.exemplar!r} — "
+                    "pass --trace PATH (a round's JSONL sink) or run "
+                    "in-process with APP_FORENSICS=on")
+            return 2
+        trace_records = slice_recs
+        arrivals = arrivals_from_trace(trace_records)
+    elif args.trace:
         trace_records = read_jsonl(args.trace)
         arrivals = arrivals_from_trace(trace_records)
     elif args.synthetic:

@@ -284,9 +284,12 @@ async def trace_handler(request: web.Request) -> web.Response:
     finish records this process emitted, plus the stream's own health
     (recorded/dropped/rotation path). ``?window=<seconds>`` bounds the
     lookback (default 600 s), ``?limit=<n>`` the record count (newest
-    kept, hard cap 8192), ``?kind=a,b`` filters by record kind. Off mode
-    answers ``{"enabled": false}`` with the env hint — a definitive
-    answer on every process, never a 404 to interpret."""
+    kept, hard cap 8192), ``?kind=a,b`` filters by record kind,
+    ``?rid=<id>`` narrows to one request's slice (rid-stamped events
+    plus the global dispatch emits whose ``rids`` roster mention it —
+    the forensics join). Off mode answers ``{"enabled": false}`` with
+    the env hint — a definitive answer on every process, never a 404 to
+    interpret."""
     from generativeaiexamples_tpu.observability.trace import TRACE
     seconds = _query_number(request, "window", TRACE_WINDOW_DEFAULT_S, float)
     limit = _query_number(request, "limit", TRACE_LIMIT_DEFAULT, int,
@@ -294,11 +297,22 @@ async def trace_handler(request: web.Request) -> web.Response:
     kinds_raw = request.query.get("kind", "").strip()
     kinds = ([k.strip() for k in kinds_raw.split(",") if k.strip()]
              or None)
+    rid = request.query.get("rid", "").strip()
     if not TRACE.enabled:
         return web.json_response({
             **TRACE.describe(),
             "hint": "set APP_TRACE=on (worker env) to record the fleet "
                     "event trace; docs/simulation.md"})
+    if rid:
+        from generativeaiexamples_tpu.observability import forensics
+        records = forensics.trace_slice(rid)
+        if kinds is not None:
+            want = frozenset(kinds)
+            records = [r for r in records if r.get("kind") in want]
+        if limit and len(records) > limit:
+            records = records[-limit:]
+        return web.json_response({**TRACE.describe(), "rid": rid,
+                                  "limit": limit, "records": records})
     return web.json_response({**TRACE.describe(),
                               "window_s": seconds,
                               "limit": limit,
@@ -322,6 +336,102 @@ async def locks_handler(request: web.Request) -> web.Response:
                     "start) to arm the lock-order sanitizer; "
                     "docs/static_analysis.md"})
     return web.json_response(lockwatch.WATCH.payload())
+
+
+async def forensics_handler(request: web.Request) -> web.Response:
+    """Tail-exemplar ring listing (observability/forensics.py,
+    APP_FORENSICS=on): the requests that breached their SLO or landed
+    above the trailing p99, auto-captured with their full trace slice.
+    Off mode answers ``{"enabled": false}`` with the env hint."""
+    from generativeaiexamples_tpu.observability.forensics import FORENSICS
+    if not FORENSICS.enabled:
+        return web.json_response({
+            **FORENSICS.describe(),
+            "hint": "set APP_FORENSICS=on (worker env) to capture tail "
+                    "exemplars; docs/observability.md"})
+    return web.json_response({**FORENSICS.describe(),
+                              "exemplars": FORENSICS.exemplars()})
+
+
+def _forensics_join_legs(rid: str) -> list:
+    """Router-side cross-worker join (the usage-plane /health piggyback
+    pattern): ask every live worker for its leg of the request. Runs in
+    an executor — never on the event loop."""
+    from generativeaiexamples_tpu.server import failover as failover_mod
+    router = failover_mod.current_router()
+    if router is None:
+        return []
+    legs = []
+    try:
+        import httpx
+        for w in list(getattr(router, "_workers", []) or []):
+            url = getattr(w, "url", "")
+            if not url:
+                continue
+            try:
+                r = httpx.get(f"{url}/debug/forensics/{rid}", timeout=2.0)
+                if r.status_code != 200:
+                    continue
+                body = r.json()
+                bd = body.get("breakdown") or {}
+                if bd.get("found"):
+                    legs.append({"worker": url, "breakdown": bd})
+            except Exception:   # tpulint: disable=except-swallow -- a worker without the endpoint (or down) simply contributes no leg
+                continue
+    except Exception:   # tpulint: disable=except-swallow -- missing httpx in a stripped process degrades to the local view
+        return legs
+    return legs
+
+
+async def forensics_rid_handler(request: web.Request) -> web.Response:
+    """Critical-path breakdown for ONE request: the captured exemplar if
+    the ring holds it, else a live reconstruction from whatever the
+    trace/request-log planes still hold. On a routing frontend the local
+    (router-axis) breakdown is joined with each worker's leg, fetched
+    over HTTP by rid — mono clocks never compare across hosts, so legs
+    stay on their own axes."""
+    from generativeaiexamples_tpu.observability.forensics import FORENSICS
+    rid = request.match_info.get("rid", "")
+    body = FORENSICS.payload(rid)
+    loop = asyncio.get_running_loop()
+    legs = await loop.run_in_executor(None, _forensics_join_legs, rid)
+    if legs:
+        body["worker_legs"] = legs
+    bd = body.get("breakdown") or {}
+    if not body.get("captured") and not bd.get("found") and not legs:
+        raise web.HTTPNotFound(text=json.dumps(
+            {"error": f"no forensics for request {rid!r} (trace ring and "
+                      "request log have both aged it out)",
+             "enabled": body.get("enabled", False)}))
+    return web.json_response(body)
+
+
+async def alerts_handler(request: web.Request) -> web.Response:
+    """SLO burn-rate alert state (observability/alerts.py): active
+    alerts per objective/scope, the raise-edge log, and the rule
+    definitions in force. Served on every server; alerts only
+    accumulate where verdicts are fed (APP_FORENSICS=on on a scheduler
+    process)."""
+    from generativeaiexamples_tpu.observability.alerts import ALERTS
+    from generativeaiexamples_tpu.observability.forensics import FORENSICS
+    body = ALERTS.payload()
+    body["enabled"] = FORENSICS.enabled
+    if not FORENSICS.enabled:
+        body["hint"] = ("set APP_FORENSICS=on (worker env) to feed the "
+                        "burn-rate windows; docs/observability.md")
+    return web.json_response(body)
+
+
+async def doctor_handler(request: web.Request) -> web.Response:
+    """Symptom→cause diagnosis engine (observability/forensics.py): maps
+    the signals the process already records — recompiles, padding waste,
+    spill thrash, qos sheds, affinity overrides, retry-budget
+    exhaustion, watchdog trips, lock inversions — to named causes ranked
+    by estimated device-seconds lost, each naming the configuration knob
+    to turn (docs/configuration.md)."""
+    from generativeaiexamples_tpu.observability.forensics import (
+        doctor_payload)
+    return web.json_response(doctor_payload())
 
 
 async def slo_handler(request: web.Request) -> web.Response:
@@ -377,6 +487,13 @@ def add_debug_routes(app: web.Application, drain: bool = True) -> None:
         # runtime lock-order sanitizer: witness graph + inversions
         # (docs/static_analysis.md)
         web.get("/debug/locks", locks_handler),
+        # latency forensics plane: tail-exemplar ring, per-request
+        # critical-path breakdowns, burn-rate alerts, and the diagnosis
+        # engine (docs/observability.md "Why was this request slow")
+        web.get("/debug/forensics", forensics_handler),
+        web.get("/debug/forensics/{rid}", forensics_rid_handler),
+        web.get("/debug/alerts", alerts_handler),
+        web.get("/debug/doctor", doctor_handler),
     ])
 
 

@@ -556,7 +556,8 @@ class FailoverLLM:
     def _pick(self, roles: Sequence[str],
               exclude: Sequence[str] = (),
               charge: bool = True,
-              affinity_key: str = "") -> Optional[_Worker]:   # tpulint: hot-path
+              affinity_key: str = "",
+              rid: str = "") -> Optional[_Worker]:   # tpulint: hot-path
         """Least-loaded healthy worker among ``roles``. Stale load views
         refresh via /health on the way (bounded by the probe timeout);
         circuit-broken workers re-probe only once their cooldown expires
@@ -666,15 +667,17 @@ class FailoverLLM:
         if TRACE.enabled:
             # placement decisions ride the same canonical stream the
             # scheduler writes: a replayed trace reconstructs WHERE each
-            # request went and WHY (ops/simulate.py what-if routing)
-            TRACE.emit("route", worker=best.url,
+            # request went and WHY (ops/simulate.py what-if routing); the
+            # rid keys the forensics cross-worker join without requiring
+            # span export to be configured
+            TRACE.emit("route", rid=rid, worker=best.url,
                        role=best.role or "unified",
                        outcome=route_outcome or "load",
                        affinity=affinity_outcome, charged=bool(charge),
                        score=round(best.score, 4), pool=len(up))
         return best
 
-    def _charge(self, w: _Worker) -> None:
+    def _charge(self, w: _Worker, rid: str = "") -> None:
         """Count a dispatch against a worker selected with charge=False —
         called at the instant its hedge leg actually launches."""
         with self._lock:
@@ -684,7 +687,7 @@ class FailoverLLM:
                          labels={"worker": w.url,
                                  "role": w.role or "unified"}).inc()
         if TRACE.enabled:
-            TRACE.emit("hedge", worker=w.url,
+            TRACE.emit("hedge", rid=rid, worker=w.url,
                        role=w.role or "unified")
 
     def _has_disagg(self) -> bool:
@@ -728,6 +731,11 @@ class FailoverLLM:
         rid = uuid.uuid4().hex[:12]
         self._policy.note_request()   # first attempt: retry-budget deposit
         akey = self._affinity_key(messages)
+        if TRACE.enabled:
+            # anchor the router-axis forensics partition at acceptance:
+            # every later leg stamps its own end + duration, so the legs
+            # partition [accept, last leg] on this process's mono clock
+            TRACE.emit("router_leg", rid=rid, leg="accept", dur_s=0.0)
         if self._has_disagg():
             yield from self._chat_disagg(messages, max_tokens, temperature,
                                          top_p, top_k, response_format, rid,
@@ -833,7 +841,7 @@ class FailoverLLM:
                 # retried (retries_denied_total{pool,reason})
                 break
             w = self._pick(("unified", "decode", ""),
-                           affinity_key=affinity_key)
+                           affinity_key=affinity_key, rid=rid)
             if w is None:
                 last_err = RuntimeError("no unified/decode worker up")
                 continue
@@ -861,6 +869,7 @@ class FailoverLLM:
                 # RemoteLLM — a failover RESUME carries the (shrunken)
                 # remaining budget, so the survivor judges against the
                 # deadline the original admission stamped
+                t_disp = time.monotonic()
                 with httpx.stream("POST", f"{w.url}/v1/chat/completions",
                                   json=payload,
                                   headers=self._headers(rid, span),
@@ -875,6 +884,11 @@ class FailoverLLM:
                                        resp.headers.get("x-kv-prefix", ""))
                     try:
                         yield from self._pump_sse(resp, emitted)
+                        if TRACE.enabled:
+                            TRACE.emit("router_leg", rid=rid, leg="stream",
+                                       dur_s=round(
+                                           time.monotonic() - t_disp, 6),
+                                       worker=w.url)
                         return                # clean completion
                     except StreamEvacuated:
                         evacuated = True      # resume below, outside the cm
@@ -964,7 +978,8 @@ class FailoverLLM:
                 # conversation must land on the prefill worker holding its
                 # history; the decode pin (below) keeps the conversation's
                 # decode-side placement stable for the item-3 KV tier
-                pw = self._pick(("prefill",), affinity_key=affinity_key)
+                pw = self._pick(("prefill",), affinity_key=affinity_key,
+                                rid=rid)
                 if pw is None:
                     last_err = RuntimeError("no prefill worker up")
                     continue
@@ -1047,6 +1062,11 @@ class FailoverLLM:
                 # (bench.py reports both wire forms in the disagg round)
                 REGISTRY.histogram("router_kv_payload_bytes").observe(
                     float(len(handoff_body)))
+                if TRACE.enabled:
+                    TRACE.emit("router_leg", rid=rid, leg="prefill",
+                               dur_s=round(time.monotonic() - t_pf, 6),
+                               worker=pw.url,
+                               bytes=len(handoff_body))
                 if span is not None:
                     span.set_attribute("router.attempts", attempt + 1)
                     span.set_attribute("router.prefill_worker", pw.url)
@@ -1062,7 +1082,8 @@ class FailoverLLM:
                 # block key pins a returning chat to the decode replica
                 # whose prefix cache already holds its history (within the
                 # least-loaded slack — _pick documents the trade)
-                dw = self._pick(("decode",), affinity_key=affinity_key)
+                dw = self._pick(("decode",), affinity_key=affinity_key,
+                                rid=rid)
                 if dw is None:
                     last_err = RuntimeError("no decode worker up")
                     continue
@@ -1074,7 +1095,7 @@ class FailoverLLM:
                     # charge=False: arming is not dispatching — the leg is
                     # charged by _open_handoff iff it actually launches
                     dw2 = self._pick(("decode",), exclude=(dw.url,),
-                                     charge=False)
+                                     charge=False, rid=rid)
                     if dw2 is not None:
                         cands.append(dw2)
                 t0 = time.monotonic()
@@ -1125,6 +1146,15 @@ class FailoverLLM:
                     handoff_open = time.monotonic() - t0
                     REGISTRY.histogram("router_handoff_s").observe(
                         handoff_open)
+                    t_stream = time.monotonic()
+                    if TRACE.enabled:
+                        TRACE.emit("router_leg", rid=rid,
+                                   leg="handoff_open",
+                                   dur_s=round(handoff_open, 6),
+                                   worker=winner.url,
+                                   hedged=len(cands) > 1,
+                                   hedge_loser=(dw.url if winner is not dw
+                                                else ""))
                     if span is not None:
                         span.set_attribute("router.decode_worker",
                                            winner.url)
@@ -1142,6 +1172,11 @@ class FailoverLLM:
                                          labels={"worker": dw.url}).inc()
                     try:
                         yield from self._pump_sse(dresp, emitted)
+                        if TRACE.enabled:
+                            TRACE.emit("router_leg", rid=rid, leg="stream",
+                                       dur_s=round(
+                                           time.monotonic() - t_stream, 6),
+                                       worker=winner.url)
                         return                # clean completion
                     except StreamEvacuated:
                         evacuated = True      # resume below, outside cm
@@ -1245,7 +1280,7 @@ class FailoverLLM:
 
         def open_one(w: _Worker):
             if w is not cands[0]:
-                self._charge(w)   # the hedge leg launched: NOW it counts
+                self._charge(w, rid=rid)   # hedge leg launched: NOW it counts
                 usage_mod.USAGE.bill_hedge(tenant or None)
             if chaos_mod.CHAOS.enabled:
                 chaos_mod.CHAOS.http_fault("router.handoff")

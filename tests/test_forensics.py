@@ -1,0 +1,455 @@
+"""Latency forensics plane (observability/forensics.py + alerts.py):
+critical-path reconstruction, tail-exemplar capture, the /debug surfaces,
+and the /debug/doctor diagnosis engine.
+
+Cost bargain as everywhere else in the tier: every live test rides the
+deterministic FakeCore (pure numpy, no compile) through the REAL
+scheduler, and the router tests drive the REAL FailoverLLM against fake
+HTTP workers — so the acceptance criteria hold over real sockets in
+seconds:
+
+  * segment breakdowns PARTITION [submit, finish] exactly — totals equal
+    the e2e by construction, and match the SLO judge's independently
+    perf-stamped e2e within 5%;
+  * a disagg-routed request's router-axis legs sum to the measured wall
+    time within 5% (fake-HTTP prefill+decode workers);
+  * an SLO-breaching request is auto-captured into the exemplar ring
+    while a healthy one is not;
+  * APP_FORENSICS=off makes ZERO forensics/alerts calls over a full
+    real-Scheduler run (monkeypatch-counted — the one-attribute-read
+    guard is load-bearing);
+  * the doctor names every injected cause of a scripted bad episode
+    (recompile + page-pressure preemption + qos shed).
+"""
+
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.engine import qos as qos_mod
+from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
+from generativeaiexamples_tpu.engine.server import ModelServer
+from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+from generativeaiexamples_tpu.observability import alerts as alerts_mod
+from generativeaiexamples_tpu.observability import forensics as forensics_mod
+from generativeaiexamples_tpu.observability import slo as slo_mod
+from generativeaiexamples_tpu.observability.alerts import ALERTS
+from generativeaiexamples_tpu.observability.devtime import DEVTIME
+from generativeaiexamples_tpu.observability.forensics import (
+    CAUSE_PREEMPT, CAUSE_QOS, FORENSICS, build_breakdown, doctor_payload,
+    trace_slice)
+from generativeaiexamples_tpu.observability.trace import TRACE
+from generativeaiexamples_tpu.server.failover import FailoverLLM
+
+from test_chain_server import _ServerThread, _free_port
+from test_devtime import _RecordingWorker
+from test_scheduler_fuzz import FakeCore
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+@pytest.fixture
+def forensics_on(monkeypatch):
+    """Arm the process-global forensics plane (which arms TRACE) for one
+    test and restore the default-off state after — alerts, the SLO
+    tracker, and the trace ring all reset so breach verdicts fed here
+    never leak burn-rate pressure into other suites."""
+    for key in (qos_mod.MODE_ENV, qos_mod.WEIGHTS_ENV,
+                qos_mod.TOKENS_PER_S_ENV):
+        monkeypatch.delenv(key, raising=False)
+    prev_trace = (TRACE.enabled, TRACE.path, TRACE.capacity)
+    prev_enabled = FORENSICS.enabled
+    monkeypatch.setenv("APP_FORENSICS", "on")
+    TRACE.configure(mode="on", path="")
+    TRACE.reset()
+    FORENSICS.configure(mode="on")
+    FORENSICS.reset()
+    ALERTS.reset()
+    slo_mod.SLO.reset()
+    yield FORENSICS
+    FORENSICS.configure(mode="on" if prev_enabled else "off")
+    FORENSICS.reset()
+    ALERTS.reset()
+    slo_mod.SLO.reset()
+    qos_mod.register_policy(None)
+    TRACE.configure(mode="on" if prev_trace[0] else "off",
+                    path=prev_trace[1] or "", capacity=prev_trace[2])
+    TRACE.reset()
+
+
+def _engine(**core_kw):
+    kw = dict(batch=4, max_seq=64, page_size=8, chunk=16, steps=2, group=4)
+    kw.update(core_kw)
+    core = FakeCore(**kw)
+    sched = Scheduler(core, ByteTokenizer())
+    sched.start()
+    return core, sched
+
+
+def _assert_partition(bd):
+    """Segments tile [start, end] exactly: contiguous, and the total
+    equals the e2e up to the 6-decimal per-segment rounding."""
+    assert bd["found"], bd
+    segs = bd["segments"]
+    assert segs
+    assert abs(bd["segments_total_s"] - bd["e2e_s"]) < 1e-4
+    cursor = bd.get("start_mono")
+    if cursor is not None:
+        for seg in segs:
+            assert abs(seg["t0_s"] - cursor) < 1e-4
+            cursor = seg["t0_s"] + seg["dur_s"]
+        assert abs(cursor - bd["end_mono"]) < 1e-4
+
+
+# ------------------------------------------------ critical-path breakdown
+
+def test_breakdown_segments_partition_e2e(forensics_on):
+    _core, sched = _engine()
+    reqs = [Request(prompt_ids=[40 + i] * 20, max_tokens=8, temperature=0.0)
+            for i in range(3)]
+    try:
+        for r in reqs:
+            sched.submit(r)
+        for r in reqs:
+            assert "".join(sched.iter_text(r))
+    finally:
+        sched.stop()
+    for r in reqs:
+        bd = build_breakdown(r.request_id)
+        assert bd["source"] == "trace"
+        _assert_partition(bd)
+        labels = [s["label"] for s in bd["segments"]]
+        # chunked prefill, then ONE aggregate decode segment carrying the
+        # dispatch count (a tick may batch both chunks into one program)
+        assert labels.count("prefill_chunk") >= 1
+        assert "decode" in labels
+        decode = next(s for s in bd["segments"] if s["label"] == "decode")
+        assert decode["dispatches"] >= 1
+        assert decode["max_gap_s"] >= 0.0
+        assert labels[0] == "queue_wait"
+        # the SLO judge stamps e2e from its own perf clock — the two
+        # reconstructions must agree within the 5% acceptance bound
+        verdict = r.slo
+        assert verdict and verdict["outcome"] == "attained"
+        assert abs(bd["e2e_s"] - verdict["e2e_s"]) <= (
+            0.05 * verdict["e2e_s"] + 0.02)
+        assert bd["meta"]["finish"] in ("stop", "eos", "length")
+
+
+def test_trace_slice_joins_global_dispatch_rosters(forensics_on):
+    _core, sched = _engine()
+    r = Request(prompt_ids=[40] * 12, max_tokens=6, temperature=0.0)
+    try:
+        sched.submit(r)
+        assert "".join(sched.iter_text(r))
+    finally:
+        sched.stop()
+    events = trace_slice(r.request_id)
+    kinds = [e["kind"] for e in events]
+    assert kinds[0] == "submit" and "finish" in kinds
+    # the global decode dispatch emits carry no rid — the roster field
+    # joins them into the slice
+    decode = [e for e in events
+              if e["kind"] == "dispatch" and e.get("phase") == "decode"]
+    assert decode
+    assert all(r.request_id in str(e["rids"]).split(",") for e in decode)
+    # monotone order
+    monos = [e["mono"] for e in events]
+    assert monos == sorted(monos)
+    assert trace_slice("no-such-rid") == []
+
+
+# ------------------------------------------------- tail-exemplar capture
+
+def test_slo_breach_auto_captured_healthy_not(forensics_on):
+    breach0 = REGISTRY.counter("forensics_exemplars_total",
+                               labels={"reason": "breach"}).value
+    _core, sched = _engine()
+    healthy = Request(prompt_ids=[40] * 12, max_tokens=6, temperature=0.0)
+    # an impossible deadline: judged `breached` at finish (no qos plane
+    # armed, so it is served, not shed)
+    doomed = Request(prompt_ids=[41] * 12, max_tokens=6, temperature=0.0,
+                     deadline_s=1e-4)
+    try:
+        sched.submit(healthy)
+        sched.submit(doomed)
+        assert "".join(sched.iter_text(healthy))
+        assert "".join(sched.iter_text(doomed))
+    finally:
+        sched.stop()
+    assert healthy.slo["outcome"] == "attained"
+    assert doomed.slo["outcome"] == "breached"
+    ex = FORENSICS.get(doomed.request_id)
+    assert ex is not None and ex["reason"] == "breach"
+    assert ex["trace"], "exemplar must retain the FULL trace slice"
+    _assert_partition(ex["breakdown"])
+    assert FORENSICS.get(healthy.request_id) is None
+    assert [e["rid"] for e in FORENSICS.exemplars()] == [doomed.request_id]
+    top = FORENSICS.top_exemplars(3)
+    assert [t["rid"] for t in top] == [doomed.request_id]
+    assert "trace" not in top[0]          # bench round lines stay greppable
+    d = FORENSICS.describe()
+    assert d["enabled"] is True and d["captured"] == 1
+    assert REGISTRY.counter("forensics_exemplars_total",
+                            labels={"reason": "breach"}).value == breach0 + 1
+
+
+def test_exemplar_ring_bounded(forensics_on):
+    FORENSICS.configure(capacity=4)
+    try:
+        for i in range(9):
+            TRACE.emit("submit", rid=f"cap{i}", prompt_tokens=1)
+            TRACE.emit("finish", rid=f"cap{i}", finish="stop")
+            FORENSICS.capture(f"cap{i}", "breach", {"outcome": "breached"})
+        assert len(FORENSICS.exemplars()) == 4
+        assert FORENSICS.get("cap0") is None          # oldest evicted first
+        assert FORENSICS.get("cap8") is not None
+    finally:
+        FORENSICS.configure(capacity=forensics_mod._DEF_CAPACITY)
+
+
+# ---------------------------------------------- off-mode: zero call sites
+
+def test_off_mode_makes_zero_forensics_calls(monkeypatch):
+    """APP_FORENSICS=off over a FULL real-Scheduler run: the finish-path
+    guard is one attribute read — observe()/capture() and the alert feed
+    are never entered (both replaced with counters)."""
+    monkeypatch.delenv("APP_FORENSICS", raising=False)
+    prev = FORENSICS.enabled
+    FORENSICS.configure(mode="off")
+    calls = []
+    monkeypatch.setattr(FORENSICS, "observe",
+                        lambda req: calls.append("forensics.observe"))
+    monkeypatch.setattr(FORENSICS, "capture",
+                        lambda *a, **k: calls.append("forensics.capture"))
+    monkeypatch.setattr(ALERTS, "observe",
+                        lambda req, verdict: calls.append("alerts.observe"))
+    _core, sched = _engine()
+    try:
+        reqs = [Request(prompt_ids=[40 + i] * 12, max_tokens=6,
+                        temperature=0.0) for i in range(4)]
+        # a breaching request too: even breaches must not wake the plane
+        reqs.append(Request(prompt_ids=[50] * 12, max_tokens=6,
+                            temperature=0.0, deadline_s=1e-4))
+        for r in reqs:
+            sched.submit(r)
+        for r in reqs:
+            "".join(sched.iter_text(r))
+    finally:
+        sched.stop()
+        FORENSICS.configure(mode="on" if prev else "off")
+    assert calls == []
+
+
+# ----------------------------------------------------- /debug over HTTP
+
+@pytest.fixture
+def served_forensics(forensics_on):
+    core = FakeCore(batch=4, max_seq=64, page_size=8, chunk=16, steps=2,
+                    group=4)
+    sched = Scheduler(core, ByteTokenizer())
+    sched.start()
+    port = _free_port()
+    server = _ServerThread(ModelServer(sched, "fake-tpu").app, port)
+    server.start()
+    try:
+        yield f"http://127.0.0.1:{port}"
+    finally:
+        server.stop()
+        sched.stop()
+
+
+def test_debug_surfaces_over_http(served_forensics):
+    base = served_forensics
+    rid = "forens-rid-1"
+    r = requests.post(f"{base}/v1/completions",
+                      json={"prompt": "why was this slow", "max_tokens": 6},
+                      headers={"X-Request-Id": rid}, timeout=30)
+    assert r.status_code == 200
+    # /debug/trace?rid= narrows to exactly this request's slice
+    body = requests.get(f"{base}/debug/trace?rid={rid}", timeout=5).json()
+    assert body["rid"] == rid and body["records"]
+    for rec in body["records"]:
+        assert (rec.get("rid") == rid
+                or rid in str(rec.get("rids", "")).split(","))
+    # kind filter composes with the rid slice
+    only = requests.get(f"{base}/debug/trace?rid={rid}&kind=finish",
+                        timeout=5).json()
+    assert {rec["kind"] for rec in only["records"]} == {"finish"}
+    # per-request breakdown: live reconstruction (healthy → not captured)
+    fb = requests.get(f"{base}/debug/forensics/{rid}", timeout=5).json()
+    assert fb["enabled"] is True and fb["captured"] is False
+    _assert_partition(fb["breakdown"])
+    assert fb["trace"]
+    # unknown rid is a 404, not an empty-dict masquerade
+    assert requests.get(f"{base}/debug/forensics/zzz-unknown",
+                        timeout=5).status_code == 404
+    # ring listing + alerts + doctor all serve
+    ring = requests.get(f"{base}/debug/forensics", timeout=5).json()
+    assert ring["enabled"] is True and "exemplars" in ring
+    al = requests.get(f"{base}/debug/alerts", timeout=5).json()
+    assert al["enabled"] is True
+    assert set(al["rules"]["windows_s"]) == {"fast", "slow"}
+    assert al["objectives"] == ["goodput", "ttft", "tpot"]
+    doc = requests.get(f"{base}/debug/doctor", timeout=5).json()
+    assert "healthy" in doc and isinstance(doc["diagnoses"], list)
+    assert doc["forensics"]["enabled"] is True
+
+
+def test_forensics_endpoints_off_mode_hint(served_forensics):
+    FORENSICS.configure(mode="off")
+    try:
+        ring = requests.get(f"{served_forensics}/debug/forensics",
+                            timeout=5).json()
+        assert ring["enabled"] is False and "APP_FORENSICS" in ring["hint"]
+        al = requests.get(f"{served_forensics}/debug/alerts",
+                          timeout=5).json()
+        assert al["enabled"] is False and "APP_FORENSICS" in al["hint"]
+    finally:
+        FORENSICS.configure(mode="on")
+
+
+# ------------------------------------- cross-worker (router-axis) legs
+
+def test_disagg_router_legs_sum_to_measured_wall(forensics_on):
+    """The acceptance bound: a disaggregated route's router-axis segments
+    (prefill leg → handoff open → stream) partition the request's span
+    and sum to the measured e2e within 5% (fake-HTTP workers)."""
+    pw, dw = _RecordingWorker("prefill"), _RecordingWorker("decode")
+    try:
+        pool = FailoverLLM([pw.url, dw.url], "tiny")
+        t0 = time.monotonic()
+        text = "".join(pool.chat([{"role": "user", "content": "hi"}],
+                                 max_tokens=4))
+        wall = time.monotonic() - t0
+        assert text == "ok"
+        rid = pw.posts["/v1/kv/prefill"][0]["x-request-id"]
+        assert rid == dw.posts["/v1/kv/handoff"][0]["x-request-id"]
+        bd = build_breakdown(rid)
+        assert bd["found"] and bd["source"] == "router_legs"
+        assert bd["meta"]["axis"] == "router"
+        _assert_partition(bd)
+        labels = [s["label"] for s in bd["segments"]]
+        assert "router_prefill" in labels
+        assert "router_handoff_open" in labels
+        assert labels[-1] == "router_stream"
+        # segments sum to the measured e2e within 5%
+        assert abs(bd["e2e_s"] - wall) <= 0.05 * wall + 0.005
+        # single decode candidate: no hedge, so no hedge_loser tag
+        assert all(s["cause"] != "hedge_loser" for s in bd["segments"])
+        # the route/hedge emits carry the rid for the forensics join
+        routes = [e for e in TRACE.records()
+                  if e["kind"] == "route" and e.get("rid") == rid]
+        assert routes, "router route emits must carry rid"
+    finally:
+        pw.close()
+        dw.close()
+
+
+# ----------------------------------------------------------- the doctor
+
+def test_doctor_names_injected_causes(forensics_on, monkeypatch):
+    """Scripted bad episode (the `make doctor-smoke` backing test):
+    a mid-serving recompile + page-pressure preemption storm + a qos
+    shed-before-prefill. The doctor must name all three causes, rank
+    them with device-second estimates, and point at real config knobs —
+    and every request's breakdown still partitions its span."""
+    preempt0 = REGISTRY.counter("preemptions").value
+    # (a) recompile: a program key never warmed, first seen mid-serving
+    DEVTIME.mark_serving()
+    DEVTIME.commit("decode", "doctor-smoke", tokens=1)
+    # (b) + (c): tiny page pool forces preemption; APP_QOS=fair at
+    # construction arms the shed-before-prefill path
+    monkeypatch.setenv(qos_mod.MODE_ENV, "fair")
+    _core, sched = _engine(num_pages=9)
+    monkeypatch.delenv(qos_mod.MODE_ENV, raising=False)
+    assert sched._qos is not None
+    sched._qos.configure_estimate(0.01, 0.01)    # 12 tokens ≈ 0.18 s est
+    doomed = Request(prompt_ids=[40] * 12, max_tokens=6, temperature=0.0,
+                     slo_class="best_effort", deadline_s=0.01)
+    storm = [Request(prompt_ids=[41 + i] * 12, max_tokens=16,
+                     temperature=0.0) for i in range(4)]
+    try:
+        sched.submit(doomed)
+        for r in storm:
+            sched.submit(r)
+        assert "".join(sched.iter_text(doomed)) == ""
+        for r in storm:
+            assert "".join(sched.iter_text(r))
+    finally:
+        sched.stop()
+    assert doomed.slo_outcome == "shed"
+    assert REGISTRY.counter("preemptions").value > preempt0
+    doc = doctor_payload()
+    assert doc["healthy"] is False
+    causes = {d["cause"]: d for d in doc["diagnoses"]}
+    assert {"recompile_hazard", "page_pressure", "qos_shed"} <= set(causes)
+    # estimates and knobs are real: device-seconds ranked, config named
+    assert causes["recompile_hazard"]["est_device_s_lost"] >= 1.0
+    assert causes["recompile_hazard"]["severity"] == "critical"
+    assert causes["page_pressure"]["est_device_s_lost"] > 0.0
+    assert "APP_ENGINE_NUM_PAGES" in causes["page_pressure"]["knob"]
+    assert "APP_ENGINE_QOS_QUOTA" in causes["qos_shed"]["knob"]
+    for d in doc["diagnoses"]:
+        assert d["evidence"]
+    # critical diagnoses rank ahead of warns
+    sevs = [d["severity"] for d in doc["diagnoses"]]
+    assert sevs.index("critical") == 0 if "critical" in sevs else True
+    assert doc["qos"] is not None         # engine process: qos state joins
+    # the episode's breakdowns still partition exactly, and the injected
+    # causes are visible as segment tags
+    bds = [build_breakdown(r.request_id) for r in storm + [doomed]]
+    for bd in bds:
+        _assert_partition(bd)
+    tags = {s["cause"] for bd in bds for s in bd["segments"]}
+    assert CAUSE_PREEMPT in tags
+    assert CAUSE_QOS in tags
+    shed_bd = bds[-1]
+    assert any(s["label"] == "shed" for s in shed_bd["segments"])
+
+
+def test_doctor_healthy_on_quiet_registry(forensics_on, monkeypatch):
+    """With every symptom counter read as zero the doctor answers
+    healthy — monkeypatch the family reads rather than the global
+    registry (other suites' counters are cumulative)."""
+    from generativeaiexamples_tpu.observability.lockwatch import WATCH
+    monkeypatch.setattr(forensics_mod, "_family_sum", lambda name: 0.0)
+    monkeypatch.setattr(forensics_mod, "_family_rows", lambda name: {})
+    monkeypatch.setattr(DEVTIME, "compiles", lambda: {
+        "events": [], "warmed_keys": 0, "recompiles_total": 0})
+    monkeypatch.setattr(DEVTIME, "padding_waste", lambda: 0.0)
+    monkeypatch.setattr(type(WATCH), "inversions", property(lambda self: []))
+    doc = doctor_payload()
+    assert doc["healthy"] is True and doc["diagnoses"] == []
+
+
+# ------------------------------------------------- simulate --exemplar
+
+def test_simulate_replays_captured_exemplar(forensics_on, capsys):
+    """ops/simulate.py --exemplar <rid> seeds the replay from a captured
+    exemplar's retained trace slice."""
+    from generativeaiexamples_tpu.ops import simulate as sim
+    _core, sched = _engine()
+    doomed = Request(prompt_ids=[40] * 12, max_tokens=6, temperature=0.0,
+                     deadline_s=1e-4)
+    try:
+        sched.submit(doomed)
+        assert "".join(sched.iter_text(doomed))
+    finally:
+        sched.stop()
+    rid = doomed.request_id
+    assert FORENSICS.get(rid) is not None
+    rc = sim.main(["--exemplar", rid])
+    assert rc == 0
+    import json
+    report = json.loads(capsys.readouterr().out)
+    # one replayed arrival — the exemplar's — with a fidelity section
+    # quantifying replay-vs-recorded drift
+    assert report["requests"]["total"] == 1
+    assert "fidelity" in report
+    # unknown exemplar is a loud argparse error, not a silent empty run
+    with pytest.raises(SystemExit):
+        sim.main(["--exemplar", "zzz-unknown"])
