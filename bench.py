@@ -691,8 +691,16 @@ def run_roofline_round() -> dict:
     from the shared perfmodel, mid-serving ``recompiles_total`` (must stay
     0 across ladder transitions), and ``devtime_by_program`` proving where
     the remaining gap lives. Knobs for A/B sweeps: BENCH_SPEC_ADAPTIVE,
-    BENCH_WIDTH_LADDER (on|off), BENCH_SPEC_DRAFT, BENCH_QUANT.
+    BENCH_WIDTH_LADDER (on|off), BENCH_SPEC_DRAFT, BENCH_QUANT,
+    BENCH_DECODE_MULTISTEP (top M rung for the multi-step A/B; 0 skips it).
+
+    The round also reports the host-fetch seam (ISSUE 20):
+    ``host_fetches_per_token`` / ``steps_per_fetch`` for the main phase,
+    plus a dedicated multi-step on/off A/B (plain-decode fleets, spec
+    off, so the eligibility predicate holds from the first dispatch)
+    whose ``fetch_reduction_x`` is the lever's scoreboard.
     """
+    import dataclasses as _dc
     import os
     import random as _rnd
 
@@ -737,13 +745,13 @@ def run_roofline_round() -> dict:
     prefix = [32 + (i * 7) % 90 for i in range(2 * ecfg.page_size)]
     counter = [0]
 
-    def make_req(n_prompt: int) -> Request:
+    def make_req(n_prompt: int, max_tok: Optional[int] = None) -> Request:
         counter[0] += 1
         body_rng = _rnd.Random(20_000 + counter[0])
         n_body = max(1, n_prompt - len(prefix))
         ids = (prefix[:max(0, n_prompt - n_body)]
                + [32 + body_rng.randrange(90) for _ in range(n_body)])
-        return Request(prompt_ids=ids, max_tokens=max_tokens,
+        return Request(prompt_ids=ids, max_tokens=max_tok or max_tokens,
                        temperature=0.0)
 
     warm = [make_req(n) for n in warm_lens]
@@ -758,12 +766,15 @@ def run_roofline_round() -> dict:
     gen0 = REGISTRY.counter("tokens_generated").value
     spec0 = REGISTRY.counter("spec_bonus_tokens").value
     base0 = REGISTRY.counter("spec_base_steps").value
+    fetch0 = REGISTRY.counter("engine_host_fetches_total").value
     thr_reqs = [make_req(n) for n in thr_prompts]
     wall = _run_load(sched, thr_reqs)
     decode_steps = REGISTRY.counter("decode_steps").value - steps0
     emitted = REGISTRY.counter("tokens_generated").value - gen0
     spec_bonus = REGISTRY.counter("spec_bonus_tokens").value - spec0
     spec_base = REGISTRY.counter("spec_base_steps").value - base0
+    host_fetches = (REGISTRY.counter("engine_host_fetches_total").value
+                    - fetch0)
 
     # attribution pass: mode=on fences every dispatch — full per-program
     # split without perturbing the timed phase above
@@ -785,6 +796,50 @@ def run_roofline_round() -> dict:
     errors = [r.error for r in thr_reqs + att_reqs if r.error]
     if errors:
         raise RuntimeError(f"roofline round failed requests: {errors[:3]}")
+
+    # multi-step decode A/B (ISSUE 20): host fetches per generated token
+    # with the K·M scan on vs off, on plain-decode fleets (spec off) so
+    # the eligibility predicate holds from the first dispatch
+    mstep = int(os.environ.get("BENCH_DECODE_MULTISTEP", "8"))
+
+    def _fetch_arm(multistep: int) -> dict:
+        ecfg_ab = _dc.replace(ecfg, spec_decode="off",
+                              decode_multistep=multistep)
+        core_ab = EngineCore(model_cfg, ecfg_ab, params, eos_id=tok.eos_id)
+        core_ab.warmup()
+        s = Scheduler(core_ab, tok)
+        s.start()
+        f0 = REGISTRY.counter("engine_host_fetches_total").value
+        g0 = REGISTRY.counter("tokens_generated").value
+        # generations long enough for the M ladder to engage at the base
+        # depth K and amortize its own walk-down tail (the planner never
+        # overshoots a max_tokens finish, so the last block always
+        # descends the rungs) — same length both arms, so the A/B stays
+        # fair
+        ab_tokens = max(max_tokens,
+                        32 * max(1, ecfg.decode_steps_per_dispatch))
+        arm_reqs = [make_req(n, ab_tokens)
+                    for n in thr_prompts[:max(4, ecfg.max_batch_size)]]
+        arm_wall = _run_load(s, arm_reqs)
+        s.stop()
+        fetches = REGISTRY.counter("engine_host_fetches_total").value - f0
+        gen = REGISTRY.counter("tokens_generated").value - g0
+        return {"decode_multistep": multistep,
+                "host_fetches_per_token": (round(fetches / gen, 4)
+                                           if gen else None),
+                "steps_per_fetch": round(DEVTIME.steps_per_fetch(), 2),
+                "gen_tok_s": (round(sum(r.completion_tokens
+                                        for r in arm_reqs) / arm_wall, 1)
+                              if arm_wall else 0.0)}
+
+    multistep_ab = None
+    if mstep:
+        arm_off, arm_on = _fetch_arm(0), _fetch_arm(mstep)
+        f_on, f_off = (arm_on["host_fetches_per_token"],
+                       arm_off["host_fetches_per_token"])
+        multistep_ab = {"off": arm_off, "on": arm_on,
+                        "fetch_reduction_x": (round(f_off / f_on, 2)
+                                              if f_on and f_off else None)}
 
     dt_by_prog: dict = {}
     for row in dt_snap["programs"]:
@@ -817,6 +872,10 @@ def run_roofline_round() -> dict:
         "padding_waste_frac": flight_now["padding_waste_frac"],
         "mixed_dispatch_frac": flight_now["mixed_dispatch_frac"],
         "ragged_row_util": flight_now["ragged_row_util"],
+        "host_fetches_per_token": (round(host_fetches / emitted, 4)
+                                   if emitted else None),
+        "steps_per_fetch": flight_now["steps_per_fetch"],
+        "multistep_ab": multistep_ab,
         "mfu": (round(analytic["mfu"], 4)
                 if analytic["mfu"] is not None else None),
         "hbm_weight_read_util": (round(analytic["hbm_weight_read_util"], 4)

@@ -370,8 +370,14 @@ def check_devtime_fence(ctx: ModuleContext) -> Iterable[Finding]:
     stray ``jax.block_until_ready`` on the hot path quietly re-serializes
     the pipelining PR 2–5 built. Fires on both the module-call and the
     method form, anywhere (a fence in 'cold' code has a way of migrating
-    into a loop). The deliberate exceptions — warmup's compile barrier,
-    the ledger's own helper, bench phase boundaries — carry annotated
+    into a loop). ``jax.device_get`` is the same fence wearing a transfer's
+    clothes — it blocks until the value is computed AND copied — so it is
+    held to the same standard: every result fetch must route through the
+    scheduler's counted ``_fetch`` seam (which feeds
+    ``engine_host_fetches_total`` / ``engine_steps_per_fetch``, the
+    decode-dispatch-tail telemetry). The deliberate exceptions — warmup's
+    compile barrier, the ledger's own helper, bench phase boundaries, the
+    ``_fetch`` seam itself, cold-path KV exports — carry annotated
     suppressions with their reasons."""
     for node in ctx.walk():
         if not isinstance(node, ast.Call):
@@ -386,6 +392,14 @@ def check_devtime_fence(ctx: ModuleContext) -> Iterable[Finding]:
                 "observability/devtime.py's sampled ledger helper "
                 "(APP_DEVTIME gate), or annotate the deliberate fence "
                 "with a reason")
+        elif name == "jax.device_get":
+            yield Finding(
+                ctx.path, node.lineno, "devtime-fence", "error",
+                "bare `jax.device_get` — a device→host fetch is a fence "
+                "plus a transfer; route it through the scheduler's "
+                "counted `_fetch` seam (engine_host_fetches_total / "
+                "engine_steps_per_fetch stay honest), or annotate the "
+                "deliberate fetch with a reason")
 
 
 # --------------------------------------------------------------------------

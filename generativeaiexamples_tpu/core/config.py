@@ -269,6 +269,7 @@ class EngineConfig:
     prefill_chunk: int = configfield("prefill_chunk", default=512, help_txt="Chunked-prefill bucket size.")
     decode_steps_per_dispatch: int = configfield("decode_steps_per_dispatch", default=8, help_txt="Decode steps fused into one device dispatch (lax.scan); amortizes host sync latency. Must be a power of two (each distinct step count is a separate compile).")
     decode_steps_max: int = configfield("decode_steps_max", default=0, help_txt="Adaptive upper bound on fused decode steps: when the batch is at least half full and every active slot has the budget, dispatches deepen up to this many steps (power of two; 0 = always use decode_steps_per_dispatch). Pays when dispatch round trips bound throughput; a device-bound engine is better off at the base depth (measured round 4).")
+    decode_multistep: int = configfield("decode_multistep", default=0, help_txt="Multi-step decode scans with deferred token fetch: ceiling M of the multiplier ladder (power of two >= 2; 0 = off). Eligible steady-state dispatches (no grammar, no top-logprobs, no speculative widening pending, no imminent stop match) scan decode_steps_per_dispatch x M plain decode steps in ONE device program (decode_multi / s<K>m<M> ledger keys) and the scheduler fetches the accumulated token block once per dispatch — host fetches per generated token drop by up to M. The stop/EOS decision rides on-device: EOS/budget/capacity masking as in the per-step scan, plus a conservative stop-string maybe-match flag over a ring of recent token ids that pauses a slot until the host confirms, bounding overshoot. The M ladder is bounded like the width ladder and warmup pre-compiles every rung — M transitions never recompile mid-serving. Emitted streams stay token-identical to the per-step path (the host replays detokenization/stop holdback over the fetched block). The bare env APP_DECODE_MULTISTEP overrides this field.")
     pipeline_depth: int = configfield("pipeline_depth", default=2, help_txt="Decode dispatches kept in flight ahead of result processing. Deeper hides more host-device sync latency but delays done-slot detection by depth x fetch time, costing batch occupancy; 2 measured best on a remote-attached chip once grouped prefill removed the ramp bottleneck (round 4).")
     prefill_group: int = configfield("prefill_group", default=8, help_txt="Max prompts whose prefill chunks are batched into ONE dispatch (group sizes bucketed to powers of two; each bucket is a separate compile). Amortizes per-dispatch overhead during admission ramps and slot refills.")
     prefill_hold_chunks: int = configfield("prefill_hold_chunks", default=16, help_txt="While admissions are prefilling into a batch under half full, hold decode dispatches for up to this many prefill chunks per ramp episode (each decode dispatch at low fill burns a full host round trip on few tokens). 0 disables holding; decode always resumes once the budget is spent, bounding any streamer stall.")

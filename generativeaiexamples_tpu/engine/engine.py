@@ -368,6 +368,34 @@ class EngineCore:
                     f"backend {jax.default_backend()!r} is not tpu")
         self._mixed = mixed == "on" and not reasons
 
+        # ---- multi-step decode ladder (deferred token fetch) -------------
+        # Eligible steady-state dispatches scan K x M plain decode steps in
+        # ONE program (decode_multi) and the host fetches the accumulated
+        # token block once — the per-step dispatch tail ROADMAP item 3
+        # names. M rungs are powers of two (2..ceiling), bounded like the
+        # width ladders so warmup compiles every rung: an M transition
+        # must never pay an XLA compile mid-serving (test-pinned). The
+        # bare env APP_DECODE_MULTISTEP overrides engine.decode_multistep.
+        raw_mm = (os.environ.get("APP_DECODE_MULTISTEP", "").strip()
+                  or str(getattr(engine_cfg, "decode_multistep", 0) or 0))
+        try:
+            mm = int(raw_mm)
+        except ValueError:
+            raise ValueError(f"APP_DECODE_MULTISTEP must be an integer "
+                             f"(0 = off, else a power of two >= 2), "
+                             f"got {raw_mm!r}")
+        if mm < 0 or (mm > 1 and mm & (mm - 1)):
+            raise ValueError(f"decode_multistep ({mm}) must be 0 (off) or "
+                             f"a power of two >= 2")
+        if mm >= 2:
+            rungs, r = [], 2
+            while r <= mm:
+                rungs.append(r)
+                r *= 2
+            self.multi_ms = tuple(rungs)
+        else:
+            self.multi_ms = ()   # 1 is the per-step path already
+
         # device-time ledger gate (observability/devtime.py): the bare env
         # APP_DEVTIME wins, else the config field (engine.devtime /
         # APP_ENGINE_DEVTIME via the env overlay) — applied HERE so a
@@ -486,6 +514,10 @@ class EngineCore:
         self._gram_dist = None
         self._tok_bytes = None                     # (V, L) int32
         self._tok_lens = None
+        # stop-string suspect tables for the multi-step decode scan
+        # (frozenset of stop bytes -> (V+1,) bool device array); bounded —
+        # distinct stop-byte sets are few in practice
+        self._suspect_cache: Dict[Any, jax.Array] = {}
         self._long_fn = jax.jit(self._prefill_long_impl, donate_argnums=dn)
         self._long_last_fn = jax.jit(self._prefill_long_last_impl,
                                      donate_argnums=dn)
@@ -493,6 +525,9 @@ class EngineCore:
                                       donate_argnums=dn)
         self._decode_fn = jax.jit(self._decode_impl, donate_argnums=dn,
                                   static_argnums=(10, 11, 12, 13, 14))
+        self._decode_multi_fn = jax.jit(self._decode_multi_impl,
+                                        donate_argnums=dn,
+                                        static_argnums=(6, 7))
         self._mixed_fn = jax.jit(self._mixed_impl, donate_argnums=dn,
                                  static_argnums=(24, 25, 26, 27))
         self._activate_fn = jax.jit(self._activate_impl, donate_argnums=dn)
@@ -536,6 +571,14 @@ class EngineCore:
         row to q_block regardless of W — narrowing would only cut
         accepted drafts, never padding. One compile per (G, K)."""
         return f"g{group}s{steps}"
+
+    def decode_multi_bucket(self, steps: int, m: int) -> str:
+        """Canonical ledger bucket of a multi-step decode compile unit
+        (program ``decode_multi``). Multi-step dispatches always run the
+        base K at full batch with spec width 1 — the eligibility predicate
+        already excludes grammar/spec/narrow slots — so (K, M) is the
+        whole compile key."""
+        return f"s{steps}m{m}"
 
     # ------------------------------------------------------------------ state
 
@@ -773,6 +816,9 @@ class EngineCore:
         """Sample one token from final-chunk logits (host sync point: TTFT)."""
         tok = self._sample_fn(logits, rng, jnp.float32(temperature),
                               jnp.int32(top_k), jnp.float32(top_p))
+        # tpulint: disable=devtime-fence -- the documented TTFT sync point:
+        # one scalar per admitted request, never per decode step (batched
+        # callers use the scheduler's _fetch seam instead)
         return int(jax.device_get(tok))
 
     def _activate_sampled(self, state: DecodeState, cache, logits, slot,
@@ -1035,6 +1081,37 @@ class EngineCore:
         self._tok_bytes = jnp.asarray(tb)
         self._tok_lens = jnp.asarray(tl)
 
+    def _stop_suspect(self, stops: tuple) -> jax.Array:
+        """(V+1,) bool device table for the multi-step decode scan: token id
+        -> conservative stop-string *maybe-match* flag (byte-set
+        intersection, ops/sampling.stop_suspect_mask). The extra final
+        entry is the on-device ring's padding index and is always False,
+        so a freshly initialised ring never reads as suspect. Sound by
+        construction: any token that could begin or continue a stop match
+        is flagged; false positives only pause a slot's scan early (the
+        host replay is the stop truth), never corrupt it. Without a vocab
+        byte table (ensure_token_bytes not yet called) every real token is
+        suspect — stop-bearing slots simply gain no multi-step depth."""
+        stop_bytes = frozenset(b for s in stops for b in s.encode("utf-8"))
+        hit = self._suspect_cache.get(stop_bytes)
+        if hit is not None:
+            return hit
+        V = self.model_cfg.vocab_size
+        if not stop_bytes:
+            mask = np.zeros((V,), np.bool_)
+        elif self._tok_bytes is None:
+            mask = np.ones((V,), np.bool_)
+        else:
+            from generativeaiexamples_tpu.ops.sampling import (
+                stop_suspect_mask)
+            mask = stop_suspect_mask(np.asarray(self._tok_bytes),
+                                     np.asarray(self._tok_lens), stop_bytes)
+        table = jnp.asarray(np.concatenate([mask, np.zeros((1,), np.bool_)]))
+        if len(self._suspect_cache) >= 64:   # bounded: stop sets are few
+            self._suspect_cache.clear()
+        self._suspect_cache[stop_bytes] = table
+        return table
+
     def register_grammar(self, grammar, active_keys=()) -> int:
         """Install a compiled grammar (engine/grammar.py Grammar) into the
         flat device stack; returns its FLAT START STATE (what PrefillItem
@@ -1176,6 +1253,14 @@ class EngineCore:
                         state, out = self.decode(state, table, steps,
                                                  spec_width=wi, width=bw)
                         last_out = out["packed"]
+            if not gs and self.multi_ms:
+                # every multi-step M rung, at the base K only: multi-step
+                # dispatches never deepen K (the M ladder IS the depth
+                # ladder there), so (base, m) is the whole compile grid —
+                # an M transition mid-serving is always a cache hit
+                for mi in self.multi_ms:
+                    state, out = self.decode_multi(state, table, m=mi)
+                    last_out = out["packed"]
             if self.mixed_supported:
                 # the mixed-phase program at EVERY depth the adaptive
                 # scheduler can pick, in BOTH grammar modes — a grammared
@@ -1226,6 +1311,11 @@ class EngineCore:
                     for bw in self.decode_widths:
                         DEVTIME.mark_warm(f"decode{suffix}",
                                           self.decode_bucket(steps, wi, bw))
+            if not gs and self.multi_ms:
+                base_k = self.cfg.decode_steps_per_dispatch
+                for mi in self.multi_ms:
+                    DEVTIME.mark_warm("decode_multi",
+                                      self.decode_multi_bucket(base_k, mi))
             if self.mixed_supported:
                 for g in sorted({1, self.group_buckets[-1]}):
                     for steps in steps_list:
@@ -1436,9 +1526,10 @@ class EngineCore:
                 # device-native: reshape/slice stay lazy device views;
                 # whoever needs host bytes pays the copy there
                 return a.reshape((L, b) + a.shape[1:])[:, :n_exp]
-            # tpulint: disable=trace-hazard -- the export IS the copy-out:
-            # one deliberate device->host fetch per handed-off request (the
-            # prefill role's per-request sync point, documented above)
+            # tpulint: disable=trace-hazard,devtime-fence -- the export IS
+            # the copy-out: one deliberate device->host fetch per handed-off
+            # request (the prefill role's per-request sync point, documented
+            # above), not a decode-path result fetch
             host = np.asarray(jax.device_get(a))
             return np.ascontiguousarray(
                 host.reshape((L, b) + host.shape[1:])[:, :n_exp])
@@ -1973,6 +2064,76 @@ class EngineCore:
             outs["top_lps"] = outs["top_lps"].reshape(R, B, TOP_LP)
         return outs
 
+    # multi-step decode: recent-token ring width for the on-device
+    # stop-string maybe-match. 8 tokens cover any practical stop string's
+    # first contributing token (the flag is per-token, not per-match).
+    STOP_RING = 8
+
+    def _decode_multi_impl(self, state: DecodeState, params, adapters,
+                           page_table, stop_suspect, has_stop,
+                           steps: int, m: int
+                           ) -> Tuple[DecodeState, Dict[str, Any]]:
+        """K·M plain decode steps in ONE program (the multi-step ladder's
+        whole point: one host interaction — dispatch + deferred packed
+        fetch — per K·M tokens/slot). Reuses the per-step scan body
+        unchanged, so the emitted token stream is identical to M
+        consecutive per-step dispatches by construction. The per-slot
+        stop tail runs on-device: EOS/budget/capacity already end a slot
+        inside the step (``done`` masking); the conservative stop-string
+        maybe-match additionally *pauses* a slot's scan (``running``
+        mask over a ring of recent sampled ids) without touching
+        ``state.active`` — the host replays the fetched block, applies
+        the real stop-string holdback, and the slot resumes on the next
+        dispatch if the suspicion was false. When every slot is done or
+        paused, remaining iterations skip the forward pass entirely
+        (lax.cond) — the while_loop-style early exit."""
+        B = self.batch
+        step = self._decode_step_fn(params, adapters, page_table, None,
+                                    None, None, None, None,
+                                    use_grammar=False, want_top=False,
+                                    spec_width=1, batch=B)
+        v_pad = stop_suspect.shape[0] - 1   # ring padding id, never suspect
+
+        def body(carry, _):
+            st, running, ring = carry
+            live = st.active & running
+
+            def run(st):
+                masked = dataclasses.replace(st, active=live)
+                new_st, out = step(masked)
+                # a paused (not done) slot keeps its activation — it
+                # resumes token-identically on the next dispatch
+                new_st = dataclasses.replace(
+                    new_st,
+                    active=jnp.where(running, new_st.active, st.active))
+                return new_st, out
+
+            def skip(st):
+                zb = jnp.zeros((1, B), bool)
+                zi = jnp.zeros((1, B), jnp.int32)
+                zf = jnp.zeros((1, B), jnp.float32)
+                return st, {"sampled": zi, "emitted": zb, "done": zb,
+                            "hit_eos": zb, "input_tokens": zi,
+                            "sampled_lp": zf, "input_lp": zf}
+
+            st, out = jax.lax.cond(live.any(), run, skip, st)
+            emitted = out["emitted"][0]
+            ring = jnp.where(
+                emitted[:, None],
+                jnp.concatenate([ring[:, 1:], out["sampled"][0][:, None]],
+                                axis=1),
+                ring)
+            maybe = has_stop & stop_suspect[ring].any(axis=1)
+            running = running & ~maybe
+            return (st, running, ring), out
+
+        running0 = jnp.ones((B,), bool)
+        ring0 = jnp.full((B, self.STOP_RING), v_pad, jnp.int32)
+        (state, _, _), outs = jax.lax.scan(
+            body, (state, running0, ring0), None, length=steps * m)
+        return state, self._pack_decode_outs(outs, steps * m,
+                                             want_top=False, spec_width=1)
+
     def _activate_group(self, state: DecodeState, logits, slots, is_last,
                         start_pos, chunk_len, generated, max_gen,
                         temperature, top_k, top_p, seeds, gram_states,
@@ -2235,3 +2396,38 @@ class EngineCore:
                                *self._gram_args(use_grammar),
                                jnp.asarray(draft_cap, jnp.int32), steps,
                                use_grammar, want_top, W, bw)
+
+    def decode_multi(self, state: DecodeState, page_table: jax.Array,
+                     steps: Optional[int] = None, m: Optional[int] = None,
+                     *, stops: tuple = (), has_stop=None
+                     ) -> Tuple[DecodeState, Dict[str, Any]]:
+        """Run ``steps``·``m`` plain decode steps as ONE dispatch with one
+        deferred packed fetch (the decode-dispatch-tail killer; ledger
+        program ``decode_multi``, bucket ``s<K>m<M>``). Only for
+        steady-state slots: no grammar, no top-logprobs, spec width 1 —
+        the scheduler's eligibility predicate (``_multi_plan``) enforces
+        this; the engine enforces the compile key (``m`` must be a warmed
+        ladder rung, ``steps`` the base K). ``stops``: union of the live
+        slots' stop strings (builds the conservative on-device suspect
+        table); ``has_stop``: (B,) bool marking which slots carry stop
+        strings (only those can be paused by a suspect token). Out arrays
+        are stacked (steps·m, B) — identical layout to `decode`, so the
+        host replay path is shared."""
+        if not self.multi_ms:
+            raise ValueError("multi-step decode is off "
+                             "(APP_DECODE_MULTISTEP=0)")
+        m = m or self.multi_ms[-1]
+        if m not in self.multi_ms:
+            raise ValueError(f"multistep m {m} is not a ladder rung "
+                             f"{self.multi_ms}")
+        base = self.cfg.decode_steps_per_dispatch
+        steps = steps or base
+        if steps != base:
+            raise ValueError(f"multi-step dispatches run the base K "
+                             f"({base}), got steps={steps}")
+        suspect = self._stop_suspect(tuple(stops))
+        if has_stop is None:
+            has_stop = np.zeros((self.batch,), np.bool_)
+        return self._decode_multi_fn(state, self.params, self.adapters,
+                                     page_table, suspect,
+                                     jnp.asarray(has_stop, bool), steps, m)

@@ -86,7 +86,7 @@ class FakeCore:
 
     def __init__(self, batch=4, max_seq=64, page_size=8, num_pages=0,
                  chunk=16, steps=4, steps_max=0, group=4, prefix_cache=False,
-                 width_ladder=False, perf_model=None):
+                 width_ladder=False, perf_model=None, multistep=0):
         self.batch, self.max_seq = batch, max_seq
         self.page_size, self.chunk = page_size, chunk
         self.max_pages_per_slot = -(-max_seq // page_size)
@@ -101,6 +101,17 @@ class FakeCore:
             # highest live slot, and rung transitions happen mid-stream as
             # slots fill and drain — the fuzz menu exercises exactly that
             self.decode_widths = (2, batch)
+        # multi-step decode ladder (engine.multi_ms): eligible steady-state
+        # dispatches run K·M steps with one deferred fetch — the fuzz menu
+        # exercises mid-block finish/preempt/evacuate against the oracle
+        if multistep >= 2:
+            rungs, r = [], 2
+            while r <= multistep:
+                rungs.append(r)
+                r *= 2
+            self.multi_ms = tuple(rungs)
+        else:
+            self.multi_ms = ()
         self.cfg = SimpleNamespace(
             decode_steps_per_dispatch=steps, decode_steps_max=steps_max,
             prefill_group=group, long_prefill="off", prefill_hold_chunks=8,
@@ -319,3 +330,17 @@ class FakeCore:
                 else:
                     st.tokens[b] = tok
         return st, {"packed": out, "emitted": out[1]}
+
+    def decode_multi(self, st: _FakeState, table: np.ndarray,
+                     steps: int = 1, m: int = 2, *, stops=(),
+                     has_stop=None) -> tuple:
+        """Multi-step decode: K·M plain steps as one dispatch / one packed
+        block. The fake skips the on-device stop *maybe-match* pause (no
+        vocab byte table) — that flag only bounds overshoot on the real
+        engine; the scheduler's host replay is the stop truth either way,
+        so the emitted stream is token-identical to M per-step dispatches
+        by construction (exactly what the fuzz oracle asserts)."""
+        if m not in self.multi_ms:
+            raise ValueError(f"multistep m {m} is not a ladder rung "
+                             f"{self.multi_ms}")
+        return self.decode(st, table, steps * m)

@@ -557,6 +557,17 @@ def decode_step(params: llama.Params, cfg: llama.LlamaConfig,
     the null page instead); num_pages: pages per layer in the flat pool.
     Returns logits (B, V) and the cache with ``lengths + 1`` (the engine
     restores lengths of inactive slots).
+
+    Re-issue contract (what both the K-step decode scan and the K·M
+    multi-step scan rely on): this step is safe to chain inside a single
+    device program with NO host barrier between iterations. Every write
+    lands at the slot's own ``lengths`` position through the page table
+    (masked slots hit the null page), reads cover exactly ``lengths``
+    positions, and the returned cache carries the advanced lengths — so
+    iteration N+1 reads iteration N's KV purely through the carried
+    value. Nothing here consults host state, which is why the scheduler
+    can defer the token fetch a whole K·M block without the cache and
+    the emitted stream disagreeing.
     """
     logits, new_cache = decode_step_wide(
         params, cfg, tokens[:, None], cache, page_table, write_mask,

@@ -67,14 +67,20 @@ logger = logging.getLogger(__name__)
 _STOP = object()
 
 
-def _fetch(arr, metric: str = "fetch_rtt_s") -> np.ndarray:
+def _fetch(arr, metric: str = "fetch_rtt_s", steps: int = 0) -> np.ndarray:
     """Device→host fetch, run on a fetcher thread (releases the GIL during
     the transfer, so it overlaps the driver thread's dispatching).
     ``metric`` keeps the packed-decode transfers (what pipeline-depth
-    tuning reads) and the tiny first-token scalars in separate histograms."""
+    tuning reads) and the tiny first-token scalars in separate histograms.
+    ``steps`` is how many decode steps this one transfer amortises (K for
+    the per-step path, K·M for a multi-step dispatch; 0 for non-decode
+    scalars) — it feeds the ``engine_steps_per_fetch`` window gauge and
+    the ``engine_host_fetches_total`` counter, the decode-dispatch-tail
+    telemetry."""
     t0 = clock.perf()
-    out = np.asarray(jax.device_get(arr))
+    out = np.asarray(jax.device_get(arr))   # tpulint: disable=devtime-fence -- the ONE counted host-fetch seam; every result fetch routes through here and is accounted by DEVTIME.note_fetch
     REGISTRY.histogram(metric).observe(clock.perf() - t0)
+    DEVTIME.note_fetch(steps)
     return out
 
 
@@ -2415,6 +2421,12 @@ class Scheduler:
     # drafts all land climb back up the ladder (ema == d → cap > d).
     _SPEC_EMA_ALPHA = 0.3
     _SPEC_HEADROOM = 2.0
+    # below this trailing acceptance the slot gets ZERO draft budget:
+    # capping only voids drafted positions (exact-match acceptance —
+    # token-identical), and a draft-cap-0 fleet is what lets the
+    # multi-step decode path engage mid-generation once drafts stop
+    # landing (_multi_plan requires no speculative widening pending)
+    _SPEC_MIN_EMA = 0.05
 
     def _choose_draft(self, job: _Job) -> int:
         """Acceptance-tuned draft budget for one slot: the smallest ladder
@@ -2424,6 +2436,8 @@ class Scheduler:
         any cap token-identical — this tunes waste, never content."""
         if job.spec_ema < 0:
             job.spec_ema = self._spec_ema_global
+        if job.spec_ema < self._SPEC_MIN_EMA:
+            return 0
         want = math.ceil(self._SPEC_HEADROOM * job.spec_ema)
         top = self._spec_w - 1
         for w in self._spec_widths:
@@ -2486,6 +2500,50 @@ class Scheduler:
             self._spec_ema_global = ((1 - a) * self._spec_ema_global
                                      + a * mean)
 
+    def _multi_plan(self) -> int:
+        """M multiplier for THIS dispatch (0 = the per-step path). The
+        multi-step eligibility predicate — ALL of:
+
+          * the core compiled a multi-step ladder (``multi_ms`` non-empty);
+          * every live slot is plain steady-state decode: no grammar, no
+            top-logprobs (separate program variants), no partial
+            stop-string match already held back (``stop_buf`` — a stop is
+            imminent, the per-step path ends it with minimal overshoot);
+          * no speculative widening pending: spec off, or the adaptive
+            controller currently budgets ZERO drafts for every slot
+            (acceptance collapsed — the widened program would verify
+            nothing, so the multi-step scan gives strictly more tokens
+            per host interaction);
+          * the shallowest rung's K·M still fits every slot's remaining
+            generation budget (minus in-flight positions) — a multi-step
+            dispatch must not overshoot a max_tokens finish by a whole
+            block.
+
+        Returns the LARGEST warmed rung whose K·M fits; page-pressure may
+        still shrink it at dispatch (`_dispatch_decode` halves M until the
+        grown page horizon covers it)."""
+        ms = getattr(self.core, "multi_ms", ())
+        if not ms or not self._slots:
+            return 0
+        for j in self._slots.values():
+            if j.gram_on or j.stop_buf:
+                return 0
+            if j.request.logprobs and j.request.top_logprobs > 0:
+                return 0
+        if self._spec_w > 1:
+            if len(self._spec_widths) <= 1:
+                return 0   # static spec width: always widening
+            if any(self._choose_draft(j) > 0 for j in self._slots.values()):
+                return 0
+        base = max(1, self.core.cfg.decode_steps_per_dispatch)
+        rem = min(j.request.max_tokens - len(j.gen_ids)
+                  for j in self._slots.values()) - self._pending_steps
+        m = 0
+        for rung in ms:
+            if base * rung <= max(rem, 0):
+                m = rung
+        return m
+
     def _decode_width(self) -> int:
         """Batch-width ladder rung for a PURE decode dispatch: the smallest
         pre-compiled width covering the highest live slot (lowest-id-first
@@ -2508,7 +2566,38 @@ class Scheduler:
         # width actually dispatched, not the ladder ceiling (a 2x-wide
         # ceiling must not hoard pool slack it will never write into)
         w_plan, _caps_plan = self._spec_plan()
-        steps = self._grow_pages(self._steps, w_plan)
+        # multi-step plan: mixed dispatches carry prefill chunks (per-step
+        # by construction); otherwise an eligible steady-state fleet runs
+        # K·M steps with ONE deferred fetch. Page pressure halves M until
+        # the grown horizon covers the whole block; below M=2 the plan
+        # degrades to the ordinary per-step dispatch.
+        if (not try_mixed and self._slots
+                and getattr(self.core, "multi_ms", ())):
+            # kill the dispatch tail outright: once in-flight steps cover
+            # EVERY live slot's remaining budget, another dispatch can
+            # only produce rows the result path discards (a slot cannot
+            # decode past max_tokens, and EOS/stop only end it sooner) —
+            # skip instead of burning a device program plus a host fetch
+            # on padding. Re-evaluated every tick: if a preemption voids
+            # the in-flight work, _pending_steps drains and dispatch
+            # resumes. Gated on a compiled multi ladder so the legacy
+            # pipeline keeps its exact dispatch cadence when the feature
+            # is off.
+            if (max(j.request.max_tokens - len(j.gen_ids)
+                    for j in self._slots.values()) <= self._pending_steps):
+                return
+        multi_m = 0 if try_mixed else self._multi_plan()
+        if multi_m:
+            base = max(1, self.core.cfg.decode_steps_per_dispatch)
+            grown = self._grow_pages(base * multi_m, 1)
+            while multi_m >= 2 and base * multi_m > grown:
+                multi_m //= 2
+            if multi_m < 2:
+                multi_m = 0
+            steps = base if multi_m else self._grow_pages(self._steps,
+                                                          w_plan)
+        else:
+            steps = self._grow_pages(self._steps, w_plan)
         if not self._slots:
             return
         packed_chunks = self._pack_mixed_chunks() if try_mixed else None
@@ -2547,6 +2636,32 @@ class Scheduler:
             self._mixed_dispatches += 1
             REGISTRY.counter("mixed_dispatches").inc()
             REGISTRY.counter("prefill_chunks").inc(len(items))
+        elif multi_m:
+            # multi-step decode: K·M plain steps, one dispatch, ONE
+            # deferred fetch (the decode-dispatch-tail killer). The
+            # eligibility predicate (_multi_plan) guaranteed every slot
+            # is plain steady-state decode, so the program runs spec
+            # width 1 at full batch. Stop-bearing slots get the
+            # conservative on-device maybe-match pause: the union of
+            # live stop strings builds the suspect table, has_stop marks
+            # which slots it can pause — the host replay below
+            # (_process_decode → _emit_token) stays the stop-string
+            # truth, exactly as on the per-step path.
+            w_disp, caps = 1, None
+            width = self.core.batch
+            stop_set = sorted({s for j in self._slots.values()
+                               for s in j.request.stop})
+            has_stop = np.zeros((self.core.batch,), np.bool_)
+            for s, j in self._slots.items():
+                if j.request.stop:
+                    has_stop[s] = True
+            if stop_set and hasattr(self.core, "ensure_token_bytes"):
+                # vocab byte table: the suspect predicate's input (lazy —
+                # grammarless deployments without stop strings never pay it)
+                self.core.ensure_token_bytes(self.tokenizer)
+            self._state, out = self.core.decode_multi(
+                self._state, self._table_device(), steps, multi_m,
+                stops=tuple(stop_set), has_stop=has_stop)
         else:
             if use_grammar or want_top:
                 # minority program variants stay at the ceiling width and
@@ -2623,6 +2738,19 @@ class Scheduler:
                 padded_tokens=(steps * self.core.batch * w_disp
                                + g_bucket * self.core.chunk),
                 weight_passes=float(steps))
+        elif multi_m:
+            # useful-vs-padded census is DEFERRED to result time
+            # (DEVTIME.note_tokens in _process_decode): a slot may end or
+            # pause mid-scan, so useful tokens are only known once the
+            # block's emitted mask lands on the host — committing M·B
+            # useful here would flatter engine_padding_waste_frac
+            bucket = (self.core.decode_multi_bucket(steps, multi_m)
+                      if hasattr(self.core, "decode_multi_bucket")
+                      else f"s{steps}m{multi_m}")
+            DEVTIME.commit(
+                "decode_multi", bucket, out["packed"], t0=t0,
+                tokens=0, padded_tokens=0,
+                weight_passes=float(steps * multi_m), defer_census=True)
         else:
             bucket = (self.core.decode_bucket(steps, w_disp, width)
                       if hasattr(self.core, "decode_bucket")
@@ -2638,18 +2766,27 @@ class Scheduler:
         # also tried pairing two dispatches' outputs into one transfer —
         # fewer round trips, but tokens then land a dispatch later, slot
         # turnover slows, and measured throughput was net WORSE.)
-        packed = self._fetcher.submit(_fetch, out["packed"])
+        n_steps = steps * multi_m if multi_m else steps
+        packed = self._fetcher.submit(_fetch, out["packed"], "fetch_rtt_s",
+                                      n_steps)
         # snapshot slot→job at dispatch time: a slot freed and reused while
         # this dispatch is in flight must not leak the old job's tokens into
         # the new job's stream (identity-checked at processing).
         # in-flight accounting is in POSITIONS (steps × speculative width);
         # (issue instant, steps) rides along for the watchdog's hung-
-        # dispatch bound (engine/watchdog.py reads the head entry's age)
-        self._inflight.append((steps * w_disp, packed, fresh,
-                               dict(self._slots),
-                               (clock.mono(), steps)))
-        self._pending_steps += steps * w_disp
-        REGISTRY.counter("decode_steps").inc(steps)
+        # dispatch bound (engine/watchdog.py reads the head entry's age).
+        # A multi-step dispatch counts its FULL K·M as steps (w_disp is 1
+        # there, so positions == steps too) and appends a sixth element —
+        # the deferred devtime-census key — which every other consumer
+        # (watchdog head peek, chaos tests) ignores by unpacking entry[:5].
+        entry = (n_steps * w_disp, packed, fresh, dict(self._slots),
+                 (clock.mono(), n_steps))
+        if multi_m:
+            entry += (("decode_multi", bucket,
+                       float(n_steps * self.core.batch)),)
+        self._inflight.append(entry)
+        self._pending_steps += n_steps * w_disp
+        REGISTRY.counter("decode_steps").inc(n_steps)
         if packed_chunks is not None:
             # the fused chunks' writes are now dispatched: advance each
             # job's prefill bookkeeping exactly as _prefill_step_inner
@@ -2675,7 +2812,11 @@ class Scheduler:
         # the watchdog's hung-dispatch bound has to see (popping first
         # would hide a wedged dispatch and degrade detection to the much
         # coarser tick-stall heartbeat)
-        positions, packed, fresh, active_map, issued = self._inflight[0]
+        entry = self._inflight[0]
+        positions, packed, fresh, active_map, issued = entry[:5]
+        # sixth element (multi-step dispatches only): the deferred
+        # devtime-census key — useful tokens are only known now
+        multi_meta = entry[5] if len(entry) > 5 else None
         # one transfer per dispatch, already in flight on the fetcher thread
         t0 = clock.perf()
         out = unpack_decode_out(packed.result())
@@ -2684,6 +2825,14 @@ class Scheduler:
         REGISTRY.histogram("sync_wait_s").observe(clock.perf() - t0)
         now = clock.perf()
         REGISTRY.counter("tokens_generated").inc(int(out["emitted"].sum()))
+        if multi_meta is not None:
+            # deferred useful-vs-padded census: only rows the scan actually
+            # emitted count as useful (early-exited / paused slots stop
+            # emitting mid-block), so engine_padding_waste_frac stays
+            # honest for multi-step dispatches
+            m_prog, m_bucket, m_padded = multi_meta
+            DEVTIME.note_tokens(m_prog, m_bucket,
+                                float(out["emitted"].sum()), m_padded)
         # acceptance telemetry + the adaptive-width controller's EMA feed;
         # the dispatch's OWN width (positions / steps — ladder rungs vary
         # per dispatch), never the engine ceiling
@@ -2762,6 +2911,10 @@ class Scheduler:
             # mirror to flight_* gauges like every numeric field here
             "recompiles": REGISTRY.counter("engine_recompiles_total").value,
             "devtime_attributed_s": round(DEVTIME.attributed_s(), 4),
+            # decode-dispatch-tail telemetry: trailing mean decode steps
+            # amortized per device→host result fetch (K on the per-step
+            # path, K·M when multi-step dispatches engage)
+            "steps_per_fetch": round(DEVTIME.steps_per_fetch(), 2),
         }
 
     def _tick(self) -> bool:   # tpulint: hot-path

@@ -106,7 +106,7 @@ class _Entry:
     __slots__ = ("program", "bucket", "count", "timed", "device_s", "queue_s",
                  "issue_s", "tokens", "padded_tokens", "timed_tokens",
                  "weight_passes", "first_seen_unix", "first_timed_s",
-                 "last_timed_mono", "window")
+                 "last_timed_mono", "window", "pending_timed")
 
     def __init__(self, program: str, bucket: str) -> None:
         self.program = program
@@ -125,6 +125,10 @@ class _Entry:
         self.last_timed_mono: Optional[float] = None
         # trailing timed (tokens, device_s, weight_passes) for live gauges
         self.window: deque = deque(maxlen=_WINDOW)
+        # TIMED defer_census commits awaiting their note_tokens() census —
+        # their device_s is already in; pairing the deferred tokens back
+        # keeps phase_rates' device-seconds-per-token honest
+        self.pending_timed = 0
 
     def snapshot(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -196,9 +200,19 @@ class DevtimeLedger:
         self._last_timed_mono: Optional[float] = None
         # tests may redirect the recompile hazard away from the global SLO
         self.hazard_sink: Optional[Callable[[str, Dict[str, Any]], None]] = None
+        # host-fetch accounting (multi-step decode plane): every device→host
+        # result fetch counts into engine_host_fetches_total; fetches that
+        # deliver decode steps also feed a trailing window whose mean is
+        # the engine_steps_per_fetch gauge — THE observable the multi-step
+        # decode ladder (EngineConfig.decode_multistep) exists to raise.
+        # Census like the pad window: no fence, live even in off mode.
+        self._fetch_window: deque = deque(maxlen=_WINDOW)
+        self._fetch_steps = 0.0
         # the metric families exist (0-valued) from process start, so a
         # scrape before the first dispatch still sees the catalog
         REGISTRY.counter("engine_recompiles_total")
+        REGISTRY.counter("engine_host_fetches_total")
+        REGISTRY.gauge("engine_steps_per_fetch")
         REGISTRY.gauge("engine_hbm_read_util")
         REGISTRY.gauge("engine_padding_waste_frac")
 
@@ -268,6 +282,8 @@ class DevtimeLedger:
             self._pad_window.clear()
             self._pad_useful = 0.0
             self._pad_padded = 0.0
+            self._fetch_window.clear()
+            self._fetch_steps = 0.0
             self._last_timed_mono = None
             if not keep_warm:
                 self._warm.clear()
@@ -284,7 +300,7 @@ class DevtimeLedger:
                t0: Optional[float] = None, tokens: float = 0,
                padded_tokens: float = 0, weight_passes: float = 0.0,
                device_s: Optional[float] = None, mfu: bool = True,
-               retain: bool = True) -> None:
+               retain: bool = True, defer_census: bool = False) -> None:
         """Account one issued device program.
 
         ``out`` is an output array (or pytree) of the dispatch — the fence
@@ -297,6 +313,14 @@ class DevtimeLedger:
         pre-measured duration is recorded with no extra fence in ANY mode.
         ``mfu=False`` keeps non-LLM programs (encoders, KV moves) out of
         the model-FLOP gauges — their tokens are not model forward passes.
+        ``defer_census=True`` declares that this dispatch's useful-token
+        census arrives later via :meth:`note_tokens` (multi-step decode:
+        per-slot early exits are only known once the block is fetched);
+        a TIMED deferred commit is remembered so the late tokens still
+        pair with its device seconds — otherwise ``phase_rates`` would
+        divide real device time by zero tokens and inflate the decode
+        rate every downstream consumer (usage billing, the simulator's
+        QoS costing) prorates with.
         """
         bucket = str(bucket)
         key = (program, bucket)
@@ -369,6 +393,8 @@ class DevtimeLedger:
                 entry.device_s += device_s
                 entry.queue_s += queue_s
                 entry.timed_tokens += tokens
+                if defer_census:
+                    entry.pending_timed += 1
                 entry.last_timed_mono = time.monotonic()
                 self._last_timed_mono = entry.last_timed_mono
                 if entry.first_timed_s is None:
@@ -414,6 +440,72 @@ class DevtimeLedger:
                 REGISTRY.gauge("engine_mfu", labels={"program": program})
         if event is not None:
             self._announce_compile(event)
+
+    def note_fetch(self, steps: float = 0.0) -> None:
+        """Account one device→host result fetch (the scheduler's _fetch
+        helper is the ONE sanctioned call site — tpulint's devtime-fence
+        rule flags any other bare ``jax.device_get`` on the hot path).
+        ``steps`` is the decode steps the fetched block carries (0 for
+        non-decode fetches: first-token snapshots, KV exports) — positive
+        values feed the trailing window behind ``engine_steps_per_fetch``.
+        Census semantics: no fence, counts in every mode."""
+        if steps > 0:
+            with self._lock:
+                if len(self._fetch_window) == self._fetch_window.maxlen:
+                    self._fetch_steps -= self._fetch_window[0]
+                self._fetch_window.append(steps)
+                self._fetch_steps += steps
+                spf = self._fetch_steps / len(self._fetch_window)
+            REGISTRY.gauge("engine_steps_per_fetch").set(round(spf, 2))
+        REGISTRY.counter("engine_host_fetches_total").inc()
+
+    def steps_per_fetch(self) -> float:
+        """Mean decode steps delivered per result fetch over the trailing
+        window (0.0 with no data) — the flight recorder's
+        ``steps_per_fetch`` field and the roofline bench read this."""
+        with self._lock:
+            n = len(self._fetch_window)
+            return self._fetch_steps / n if n else 0.0
+
+    def note_tokens(self, program: str, bucket: Any, tokens: float,
+                    padded_tokens: float) -> None:
+        """Deferred useful-vs-padded census for a dispatch whose useful
+        token count is only known at RESULT time: a multi-step decode scan
+        early-exits on device (EOS / stop maybe-match pause), so tokens
+        actually emitted per slot are in the fetched block, not the
+        dispatch plan. The dispatch-time :meth:`commit` carries the timing
+        and compile-watch with no token census; this adds the honest
+        counts once the block lands, so ``engine_padding_waste_frac``
+        prices early-exited scan steps as the padding they are."""
+        bucket = str(bucket)
+        with self._lock:
+            entry = self._entries.get((program, bucket))
+            if entry is None:
+                entry = self._entries[(program, bucket)] = _Entry(program,
+                                                                  bucket)
+            entry.tokens += tokens
+            entry.padded_tokens += padded_tokens
+            if entry.pending_timed > 0:
+                # settle a TIMED defer_census commit: its device_s landed
+                # at dispatch time with zero tokens — pairing the census
+                # back keeps phase_rates / MFU window sums honest
+                entry.pending_timed -= 1
+                entry.timed_tokens += tokens
+                entry.window.append((tokens, 0.0, 0.0))
+            pad_frac = None
+            if padded_tokens:
+                if len(self._pad_window) == self._pad_window.maxlen:
+                    old_u, old_p = self._pad_window[0]
+                    self._pad_useful -= old_u
+                    self._pad_padded -= old_p
+                self._pad_window.append((tokens, padded_tokens))
+                self._pad_useful += tokens
+                self._pad_padded += padded_tokens
+                if self._pad_padded:
+                    pad_frac = 1.0 - self._pad_useful / self._pad_padded
+        if pad_frac is not None:
+            REGISTRY.gauge("engine_padding_waste_frac").set(
+                round(pad_frac, 4))
 
     def _update_gauges(self, program: str, perf, mfu: bool,
                        sums: Tuple[float, float, float, float]) -> None:
@@ -551,6 +643,9 @@ class DevtimeLedger:
             "programs": rows, "totals": totals,
             "padding_waste_frac": (round(1.0 - pad_u / pad_p, 4)
                                    if pad_p else 0.0),
+            "steps_per_fetch": round(self.steps_per_fetch(), 2),
+            "host_fetches_total": REGISTRY.counter(
+                "engine_host_fetches_total").value,
             "recompiles_total": REGISTRY.counter(
                 "engine_recompiles_total").value,
         }

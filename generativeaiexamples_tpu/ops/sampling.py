@@ -279,3 +279,33 @@ def sample_logits_dynamic(rng: jax.Array, logits: jnp.ndarray,
 
     return jax.lax.cond(jnp.any(temperature > 0), full_path,
                         lambda _: greedy, operand=None)
+
+
+def stop_suspect_mask(tok_bytes, tok_lens, stop_bytes) -> "np.ndarray":
+    """(V,) bool host-side table for the multi-step decode scan's on-device
+    stop *maybe-match* flag: token ``t`` is suspect iff its byte sequence
+    shares at least one byte value with any active stop string.
+
+    Sound by construction: any token that contributes even one byte to a
+    stop-string match necessarily shares that byte with the stop string,
+    so the first contributing token of every possible match is flagged —
+    the scan pauses the slot at or before the step where a match could
+    complete, and the host's replay (the single source of stop truth)
+    confirms or clears it. Deliberately conservative the other way: a
+    token sharing a byte without ever matching costs one paused dispatch
+    tail, never correctness.
+
+    ``tok_bytes``/``tok_lens`` are the engine's (V, L)/(V,) vocab byte
+    table (EngineCore.ensure_token_bytes); ``stop_bytes`` is the set of
+    byte values (0..255) appearing in any active stop string. Pure
+    numpy — called host-side per distinct stop set, cached by the engine.
+    """
+    import numpy as np
+    tb = np.asarray(tok_bytes)
+    tl = np.asarray(tok_lens)
+    if not stop_bytes:
+        return np.zeros((tb.shape[0],), np.bool_)
+    member = np.isin(tb, np.fromiter(stop_bytes, np.int32,
+                                     len(stop_bytes)))
+    valid = np.arange(tb.shape[1])[None, :] < tl[:, None]
+    return np.asarray((member & valid).any(axis=1), np.bool_)

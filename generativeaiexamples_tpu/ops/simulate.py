@@ -43,6 +43,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
 
 from generativeaiexamples_tpu.core import clock
+from generativeaiexamples_tpu.observability import slo as slo_mod
 from generativeaiexamples_tpu.observability.trace import TRACE, read_jsonl
 
 _QUANTUM_S = 2e-4          # virtual step when no dispatch consumed time
@@ -372,15 +373,24 @@ def simulate(arrivals: List[Arrival], cfg: SimConfig,
     prev_trace = (TRACE.enabled, TRACE.path)
     TRACE.configure(mode="on", path="")
     TRACE.reset()
+    # the SLO plane is process-global (like REGISTRY/FLIGHT) and its
+    # burn-rate windows drive real shedding in the scheduler admission
+    # pass — a simulation must neither inherit the live process's
+    # pressure (a critical window from earlier traffic would shed the
+    # simulated workload) nor leak its simulated breaches back out.
+    # Scope a fresh tracker on the VIRTUAL clock for the run's duration.
+    prev_slo = slo_mod.SLO
+    slo_mod.SLO = slo_mod.SloTracker(clock=clock.mono)
     with clock.use(vc):
-        replicas = build_replicas(cfg)
-        router = SimRouter(replicas)
+        replicas: List[SimReplica] = []
         reqs: List[tuple] = []
         finished: set = set()
         next_ix = 0
         ticks = 0
         tick_cap = max(20000, 400 * len(arrivals))
         try:
+            replicas = build_replicas(cfg)
+            router = SimRouter(replicas)
             while True:
                 now = clock.mono()
                 while (next_ix < len(arrivals)
@@ -432,6 +442,7 @@ def simulate(arrivals: List[Arrival], cfg: SimConfig,
                     vc.advance(_QUANTUM_S if not worked else 1e-5)
             span_s = clock.mono()
         finally:
+            slo_mod.SLO = prev_slo
             for rep in replicas:
                 rep.close()
     # the trace's seq field is the run's total order — finish order reads

@@ -1,0 +1,338 @@
+"""Multi-step decode scans with deferred token fetch (ISSUE 20).
+
+The decode dispatch tail, killed: eligible steady-state fleets run K·M
+plain decode steps as ONE device program (`decode_multi`, ledger buckets
+``s<K>m<M>``) and the host fetches the accumulated token block once per
+dispatch instead of once per K steps. The stop/EOS tail moves on-device —
+EOS/budget/capacity already end a slot inside the fused step, and a
+conservative stop-string *maybe-match* over a ring of recent token ids
+PAUSES a stop-bearing slot's scan so overshoot past a stop stays bounded
+while the host replay remains the stop-string truth. These tests pin the
+acceptance bar:
+
+  * token-identity (stream text, stop/EOS truncation, finish reasons) to
+    the per-step oracle on xla/float+spec AND pallas/int8;
+  * a stop string straddling a K·M block boundary: the detok replay's
+    holdback carries the partial match across the fetched chunk edge and
+    the next dispatch falls back to the per-step path (stop_buf held);
+  * zero mid-serving recompiles across M-ladder transitions (warmup owns
+    the whole (K, M) grid — compile-watch asserted);
+  * mid-flight preemption and evacuation: a slot preempted or evacuated
+    while a multi-step dispatch is in flight resumes token-identically;
+  * the point of it all: host fetches per generated token drop ≥ 4×.
+"""
+
+import dataclasses
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from generativeaiexamples_tpu.core.config import EngineConfig
+from generativeaiexamples_tpu.core.metrics import REGISTRY
+from generativeaiexamples_tpu.engine.engine import EngineCore
+from generativeaiexamples_tpu.engine.scheduler import Request, Scheduler
+from generativeaiexamples_tpu.engine.tokenizer import ByteTokenizer
+from generativeaiexamples_tpu.models import llama
+from generativeaiexamples_tpu.observability.devtime import DEVTIME
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+@pytest.fixture(scope="module")
+def served():
+    cfg = llama.LlamaConfig.tiny(vocab_size=300)
+    params = llama.init_params(jax.random.PRNGKey(5), cfg)
+    return cfg, params, ByteTokenizer()
+
+
+def _core(served, **kw):
+    cfg, params, tok = served
+    attn = kw.pop("attn", None)
+    if attn is not None:
+        cfg = dataclasses.replace(cfg, attn_impl=attn)
+    ecfg = EngineConfig(max_batch_size=4, max_seq_len=256, page_size=8,
+                        prefill_chunk=16, **kw)
+    return EngineCore(cfg, ecfg, params, eos_id=tok.eos_id)
+
+
+def _run_all(sched, reqs):
+    for r in reqs:
+        sched.submit(r)
+    while sched._tick():
+        pass
+    out = []
+    for r in reqs:
+        assert r.error is None, r.error
+        parts = []
+        while not r.out_queue.empty():
+            item = r.out_queue.get_nowait()
+            if isinstance(item, str):
+                parts.append(item)
+        out.append("".join(parts))
+    return out
+
+
+def _spy_multi(core):
+    """Count decode_multi dispatches (eligibility actually engaging)."""
+    calls = []
+    orig = core.decode_multi
+
+    def spying(state, table, steps=None, m=None, **kw):
+        calls.append((steps, m))
+        return orig(state, table, steps, m, **kw)
+
+    core.decode_multi = spying
+    return calls
+
+
+# --------------------------------------------------------------- the ladder
+
+def test_multistep_ladder_gate(served):
+    assert _core(served).multi_ms == ()                    # default: off
+    assert _core(served, decode_multistep=1).multi_ms == ()
+    assert _core(served, decode_multistep=8).multi_ms == (2, 4, 8)
+    with pytest.raises(ValueError, match="power of two"):
+        _core(served, decode_multistep=6)
+    with pytest.raises(ValueError, match="power of two"):
+        _core(served, decode_multistep=-2)
+    # off-engine decode_multi is a loud error, not a silent per-step run
+    core = _core(served)
+    with pytest.raises(ValueError, match="multi-step decode is off"):
+        core.decode_multi(core.init_state(), None)
+
+
+def test_multistep_env_override(served, monkeypatch):
+    monkeypatch.setenv("APP_DECODE_MULTISTEP", "4")
+    assert _core(served).multi_ms == (2, 4)
+    monkeypatch.setenv("APP_DECODE_MULTISTEP", "0")
+    assert _core(served, decode_multistep=8).multi_ms == ()
+    monkeypatch.setenv("APP_DECODE_MULTISTEP", "three")
+    with pytest.raises(ValueError, match="APP_DECODE_MULTISTEP"):
+        _core(served)
+
+
+# ------------------------------------------------------ stream equivalence
+
+def test_multistep_stream_identical_xla_float_spec(served):
+    """xla/float with SPECULATION on: a non-repetitive workload collapses
+    the acceptance EMA, the adaptive controller's draft caps reach 0, and
+    the multi-step path engages MID-GENERATION — the emitted streams must
+    equal the multistep-off oracle token for token."""
+    cfg, params, tok = served
+    kw = dict(spec_decode="on", spec_adaptive="on",
+              decode_steps_per_dispatch=1)
+    mk = lambda: [Request(prompt_ids=tok.encode(
+                      "entropy soup 9a8b7c6d5e4f no repeats here",
+                      add_bos=True),
+                      max_tokens=48, temperature=0.0),
+                  Request(prompt_ids=tok.encode("zq xv 13 57 91",
+                                                add_bos=True),
+                          max_tokens=40, temperature=0.9, seed=23)]
+    base = _run_all(Scheduler(_core(served, **kw), tok), mk())
+    core = _core(served, decode_multistep=8, **kw)
+    calls = _spy_multi(core)
+    fast = _run_all(Scheduler(core, tok), mk())
+    assert fast == base
+    assert calls, "draft caps collapsed but multi-step never engaged"
+
+
+@pytest.mark.parametrize("attn,kv_quant", [("pallas", "int8")])
+def test_multistep_stream_identical_pallas_int8(served, attn, kv_quant):
+    """pallas/int8 pool (interpret mode on CPU): multi-step on == off."""
+    cfg, params, tok = served
+    kw = dict(attn=attn, kv_quant=kv_quant, spec_decode="off",
+              decode_steps_per_dispatch=2)
+    mk = lambda: [Request(prompt_ids=tok.encode("quantized pool stream",
+                                                add_bos=True),
+                          max_tokens=18, temperature=0.0),
+                  Request(prompt_ids=tok.encode("second slot", add_bos=True),
+                          max_tokens=12, temperature=0.8, seed=7)]
+    base = _run_all(Scheduler(_core(served, **kw), tok), mk())
+    core = _core(served, decode_multistep=4, **kw)
+    calls = _spy_multi(core)
+    fast = _run_all(Scheduler(core, tok), mk())
+    assert fast == base
+    assert calls, "multi-step never engaged on the pallas/int8 path"
+
+
+# ------------------------------------------------- stop strings / boundaries
+
+def test_multistep_stop_string_straddles_block_boundary(served):
+    """Satellite (a): a stop string whose match spans a K·M block edge.
+    The detok replay holds the partial suffix back exactly as the
+    per-step path does (nothing of the maybe-match streams), the NEXT
+    dispatch falls back to per-step (stop_buf non-empty fails the
+    eligibility predicate), and the final text truncates identically."""
+    cfg, params, tok = served
+    kw = dict(spec_decode="off", decode_steps_per_dispatch=2)
+    oracle = _run_all(Scheduler(_core(served, **kw), tok),
+                      [Request(prompt_ids=tok.encode("boundary straddle",
+                                                     add_bos=True),
+                               max_tokens=24, temperature=0.0)])[0]
+    assert len(oracle) >= 12
+    # first multi dispatch covers 8 steps (K=2, top rung M=4): a stop
+    # spanning emitted chars 6..10 straddles that first block boundary
+    stop = oracle[6:10]
+    assert stop and stop in oracle[6:]
+    mk = lambda: [Request(prompt_ids=tok.encode("boundary straddle",
+                                                add_bos=True),
+                          max_tokens=24, temperature=0.0, stop=[stop])]
+    base = _run_all(Scheduler(_core(served, **kw), tok), mk())
+    core = _core(served, decode_multistep=4, **kw)
+    calls = _spy_multi(core)
+    r = mk()
+    fast = _run_all(Scheduler(core, tok), r)
+    assert fast == base
+    assert fast[0] == oracle[:6]
+    assert r[0].finish_reason == "stop"
+    assert calls, "stop-bearing slot never took the multi-step path"
+
+
+# --------------------------------------------- ladders: zero recompiles
+
+def test_multistep_m_ladder_zero_midserving_recompiles(served):
+    """Warmup owns the whole (K, M) grid: serving traffic whose remaining
+    budgets walk the M ladder up and down — including the per-step
+    fallback and a stop-bearing fleet (fresh suspect table mid-serving) —
+    must pay ZERO mid-serving recompiles (compile-watch counter), while
+    multiple distinct decode_multi buckets demonstrably dispatched."""
+    cfg, params, tok = served
+    core = _core(served, decode_multistep=8, spec_decode="off",
+                 decode_steps_per_dispatch=2, prefill_hold_chunks=0)
+    DEVTIME.reset()
+    try:
+        core.warmup()
+        sched = Scheduler(core, tok)
+        DEVTIME.mark_serving()   # what Scheduler.start() does on the driver
+        base = REGISTRY.counter("engine_recompiles_total").value
+        # long budget (top rung), short budget (shallow rungs near the
+        # finish), and a stop-bearing request (suspect-table arm)
+        _run_all(sched, [Request(prompt_ids=tok.encode("long one",
+                                                       add_bos=True),
+                                 max_tokens=40, temperature=0.0)])
+        _run_all(sched, [Request(prompt_ids=tok.encode("short",
+                                                       add_bos=True),
+                                 max_tokens=6, temperature=0.0)])
+        _run_all(sched, [Request(prompt_ids=tok.encode("with a stop",
+                                                       add_bos=True),
+                                 max_tokens=20, temperature=0.0,
+                                 stop=["zzqq never matches"])])
+        assert REGISTRY.counter("engine_recompiles_total").value == base, \
+            "M-ladder transition paid a mid-serving recompile"
+        buckets = {r["bucket"] for r in DEVTIME.snapshot()["programs"]
+                   if r["program"] == "decode_multi"}
+        assert len(buckets) >= 2, \
+            f"no M-ladder transition actually dispatched: {buckets}"
+    finally:
+        DEVTIME.reset()
+
+
+# ------------------------------------- mid-flight preemption / evacuation
+
+def test_multistep_preemption_under_page_pressure(served):
+    """Satellite (c), real core: a tiny pool forces preemption while
+    multi-step dispatches are in flight (the longer K·M window widens
+    the race) — resumed streams must reproduce the roomy-pool streams."""
+    cfg, params, tok = served
+    kw = dict(spec_decode="off", decode_steps_per_dispatch=2,
+              decode_multistep=4)
+    mk = lambda: [Request(prompt_ids=tok.encode(
+        "first request with a fairly long prompt here ok", add_bos=True),
+        max_tokens=24, temperature=0.0),
+        Request(prompt_ids=tok.encode("second one", add_bos=True),
+                max_tokens=24, temperature=0.0)]
+    roomy = _run_all(Scheduler(_core(served, **kw), tok), mk())
+    p0 = REGISTRY.counter("preemptions").value
+    core = _core(served, num_pages=12, **kw)
+    calls = _spy_multi(core)
+    tight = _run_all(Scheduler(core, tok), mk())
+    assert REGISTRY.counter("preemptions").value > p0
+    assert tight == roomy
+    assert calls, "pool pressure should not have disabled multi-step"
+
+
+def test_multistep_evacuation_resumes_token_identical(served):
+    """A slot evacuated mid-generation (with multi-step dispatches in
+    flight) resumes via submit_prefilled on a peer scheduler and the
+    combined stream equals the unevacuated oracle exactly."""
+    cfg, params, tok = served
+    kw = dict(spec_decode="off", decode_steps_per_dispatch=2,
+              decode_multistep=4)
+    rkw = dict(max_tokens=20, temperature=0.7, seed=123)
+    prompt = tok.encode("the quick brown fox jumps over", add_bos=True)
+
+    peer = Scheduler(_core(served, **kw), tok)
+    ref = Request(prompt_ids=list(prompt), **rkw)
+    oracle = _run_all(peer, [ref])[0]
+    assert oracle
+
+    src_core = _core(served, **kw)
+    calls = _spy_multi(src_core)
+    src = Scheduler(src_core, tok)
+    r = Request(prompt_ids=list(prompt), **rkw)
+    src.submit(r)
+    for _ in range(4000):
+        worked = src._tick()
+        if r.completion_tokens >= 4:
+            break
+        assert r.finished_at is None
+        if not worked:
+            time.sleep(0.001)
+    assert calls, "evacuation raced nothing: multi-step never dispatched"
+    res = src.request_evacuation(wait_s=0.0)
+    assert res.get("queued")
+    for _ in range(50):
+        src._tick()
+        if not src._evac_reqs:
+            break
+    assert r.finish_reason == "evacuated" and r.error is None
+    pre = ""
+    while not r.out_queue.empty():
+        item = r.out_queue.get_nowait()
+        if isinstance(item, str):
+            pre += item
+    assert oracle.startswith(pre) and pre != oracle
+    payload = src.take_evacuated(r.request_id)
+    assert payload is not None
+    rd = Request(prompt_ids=[int(t) for t in payload["prompt_ids"]], **rkw)
+    peer.submit_prefilled(rd, dict(payload))
+    for _ in range(4000):
+        worked = peer._tick()
+        if rd.finished_at is not None:
+            break
+        if not worked:
+            time.sleep(0.001)
+    assert rd.error is None, rd.error
+    tail = ""
+    while not rd.out_queue.empty():
+        item = rd.out_queue.get_nowait()
+        if isinstance(item, str):
+            tail += item
+    assert pre + tail == oracle
+
+
+# ------------------------------------------------------- fetch amortization
+
+def test_multistep_host_fetches_per_token_reduced_4x(served):
+    """The acceptance bar's CPU miniature of the bench A/B: host fetches
+    per generated token must drop ≥ 4× when the multi-step path engages
+    (K=2 per fetch → K·M=16 per fetch at the top rung)."""
+    cfg, params, tok = served
+    kw = dict(spec_decode="off", decode_steps_per_dispatch=2)
+    mk = lambda: [Request(prompt_ids=tok.encode("amortize me", add_bos=True),
+                          max_tokens=96, temperature=0.0)]
+
+    def fetches_per_token(core):
+        f0 = REGISTRY.counter("engine_host_fetches_total").value
+        t0 = REGISTRY.counter("tokens_generated").value
+        _run_all(Scheduler(core, tok), mk())
+        df = REGISTRY.counter("engine_host_fetches_total").value - f0
+        dt = REGISTRY.counter("tokens_generated").value - t0
+        assert dt > 0
+        return df / dt
+
+    off = fetches_per_token(_core(served, **kw))
+    on = fetches_per_token(_core(served, decode_multistep=8, **kw))
+    assert on <= off / 4.0, (off, on)

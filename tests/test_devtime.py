@@ -336,6 +336,78 @@ def test_bench_analytic_totals_pinned_to_hand_constants():
 
 
 # ---------------------------------------------------------------------------
+# host-fetch accounting + the deferred multi-step token census
+# ---------------------------------------------------------------------------
+
+def test_note_fetch_counts_and_windows_steps_per_fetch():
+    led = DevtimeLedger(mode="off")
+    f0 = REGISTRY.counter("engine_host_fetches_total").value
+    led.note_fetch(16)          # one multi-step decode block (K·M = 16)
+    led.note_fetch(2)           # one per-step fallback block (K = 2)
+    led.note_fetch(0)           # a first-token snapshot: counted, unwindowed
+    assert REGISTRY.counter("engine_host_fetches_total").value == f0 + 3
+    assert led.steps_per_fetch() == pytest.approx(9.0)
+    assert REGISTRY.gauge("engine_steps_per_fetch").value == 9.0
+    snap = led.snapshot()
+    assert snap["steps_per_fetch"] == 9.0
+    assert snap["host_fetches_total"] == REGISTRY.counter(
+        "engine_host_fetches_total").value
+    led.reset()
+    assert led.steps_per_fetch() == 0.0
+
+
+def test_deferred_census_prices_early_exit_as_padding():
+    """Satellite (b): a slot that finishes at step 2 of an M=8 scan. The
+    dispatch-time commit carries timing with NO token census (tokens=0,
+    padded_tokens=0 skips the pad window entirely); note_tokens adds the
+    honest counts at result time, so engine_padding_waste_frac prices the
+    6 early-exited scan steps as the padding they are."""
+    led = DevtimeLedger(mode="off")
+    led.commit("decode_multi", "s1m8", np.zeros(2), t0=time.perf_counter(),
+               tokens=0, padded_tokens=0, weight_passes=8.0)
+    # B=1 fleet, budget ended the slot on-device after 2 of 8 steps
+    led.note_tokens("decode_multi", "s1m8", tokens=2.0, padded_tokens=8.0)
+    snap = led.snapshot()
+    row = {(r["program"], r["bucket"]): r
+           for r in snap["programs"]}[("decode_multi", "s1m8")]
+    assert row["count"] == 1
+    assert row["tokens"] == 2.0 and row["padded_tokens"] == 8.0
+    assert row["row_util"] == 0.25
+    assert REGISTRY.gauge("engine_padding_waste_frac").value == 0.75
+
+
+def test_deferred_census_pairs_timed_tokens_for_phase_rates(monkeypatch):
+    """A TIMED defer_census commit must not divide real device seconds by
+    zero tokens: note_tokens settles the census against the pending timed
+    dispatch, so phase_rates' decode rate stays device_s / ACTUAL tokens.
+    (Regression: the inflated rate leaked into every phase_rates consumer
+    — usage billing, the simulator's QoS costing — after any timed
+    multi-step traffic, e.g. a fuzz episode earlier in the process.)"""
+    _count_fences(monkeypatch)
+    led = DevtimeLedger(mode="on")
+    led.commit("decode_multi", "s2m4", np.zeros(2), t0=time.perf_counter(),
+               tokens=0, padded_tokens=0, weight_passes=8.0,
+               device_s=2.0, defer_census=True)
+    assert led.phase_rates()["decode"] is None   # census not settled yet
+    led.note_tokens("decode_multi", "s2m4", tokens=20.0, padded_tokens=32.0)
+    assert led.phase_rates()["decode"] == pytest.approx(2.0 / 20.0)
+    row = {(r["program"], r["bucket"]): r
+           for r in led.snapshot()["programs"]}[("decode_multi", "s2m4")]
+    assert row["timed"] == 1 and row["tokens"] == 20.0
+
+
+def test_note_tokens_never_registers_a_compile():
+    """The deferred census lands on buckets the dispatch already
+    committed; but even on a bucket commit never saw it must not trip
+    compile-watch (it is an accounting write, not a program launch)."""
+    led = DevtimeLedger(mode="off")
+    led.mark_serving()
+    base = REGISTRY.counter("engine_recompiles_total").value
+    led.note_tokens("decode_multi", "s2m4", tokens=5.0, padded_tokens=8.0)
+    assert REGISTRY.counter("engine_recompiles_total").value == base
+
+
+# ---------------------------------------------------------------------------
 # SLO hazard coupling
 # ---------------------------------------------------------------------------
 

@@ -159,9 +159,9 @@ def _run_episode(seed: int, specs: List[_Spec], core_kw: Dict,
     # the driver's ticks, racing the eager-drain and first-fetch paths
     orig_fetch = sched_mod._fetch
 
-    def jittery_fetch(arr, metric="fetch_rtt_s"):
+    def jittery_fetch(arr, metric="fetch_rtt_s", steps=0):
         time.sleep(float(rng.choice([0, 0, 0.0002, 0.001])))
-        return orig_fetch(arr, metric)
+        return orig_fetch(arr, metric, steps)
 
     sched_mod._fetch = jittery_fetch
     t_wall0 = time.perf_counter()
@@ -455,7 +455,12 @@ def _core_kw(rng: np.random.RandomState) -> Dict:
         # decode batch-width ladder: rung transitions mid-stream as slots
         # fill/drain (r06 menu entry — the width picker races admissions,
         # preemptions, and in-flight results here)
-        width_ladder=bool(rng.rand() < 0.5))
+        width_ladder=bool(rng.rand() < 0.5),
+        # multi-step decode ladder: eligible fleets dispatch K·M steps with
+        # one deferred fetch — mid-block finishes, preemption storms,
+        # evacuations, and stop strings all race the longer in-flight
+        # window here; the oracle asserts streams stay token-identical
+        multistep=int(rng.choice([0, 0, 2, 8])))
 
 
 def _shrink(seed: int, specs: List[_Spec], core_kw: Dict, err: str,

@@ -884,6 +884,37 @@ def test_devtime_fence_suppressible_with_reason():
     assert fnd == []
 
 
+def test_devtime_fence_flags_bare_device_get():
+    # a device→host fetch is a fence plus a transfer: every result fetch
+    # must route through the scheduler's counted _fetch seam so the
+    # engine_host_fetches_total / engine_steps_per_fetch telemetry (the
+    # decode-dispatch-tail accounting) cannot be quietly bypassed
+    src = """
+    import jax
+
+    def drain(self, out):
+        return jax.device_get(out)
+    """
+    fnd = findings_for(src, only="devtime-fence")
+    assert [f.line for f in fnd] == [5]
+    assert "_fetch" in fnd[0].message
+
+
+def test_devtime_fence_device_get_suppressible_at_the_seam():
+    # the ONE sanctioned call site (engine/scheduler._fetch) carries an
+    # annotated suppression with its reason — the pattern this pins
+    src = """
+    import jax
+
+    def _fetch(arr):
+        return jax.device_get(arr)   # tpulint: disable=devtime-fence -- the counted host-fetch seam
+    """
+    sup = Suppressions(textwrap.dedent(src))
+    fnd = [f for f in findings_for(src, only="devtime-fence")
+           if not sup.is_suppressed(f.rule, f.line)]
+    assert fnd == []
+
+
 # ---------------------------------------------------------------------------
 # package-wide self-check — the tier-1 gate
 # ---------------------------------------------------------------------------
